@@ -1,0 +1,17 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+Apache MXNet 0.12 (reference mounted at /root/reference), rebuilt from
+scratch on JAX/XLA/Pallas/pjit.  See SURVEY.md for the blueprint.
+
+Usage mirrors the reference: ``import mxnet_tpu as mx``.
+"""
+from .base import MXNetError, __version__
+from .context import Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, \
+    current_context
+from . import base
+from . import ops
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+
+from .ndarray import NDArray

@@ -1,0 +1,354 @@
+"""Imperative autograd.
+
+TPU-native equivalent of the reference's tape (src/imperative/imperative.cc
+RecordOp/Backward, include/mxnet/imperative.h AGInfo; python API
+python/mxnet/autograd.py).  Where the reference builds an nnvm graph node per
+invoked op and runs a Gradient pass, here the tape records each dispatched
+op's pure-jax closure + input snapshots; ``backward`` replays the tape as one
+pure function of the marked variables and differentiates it with ``jax.vjp``
+— so the gradient graph is *compiled by XLA as a whole* rather than executed
+op-by-op.
+
+Handle identity provides the reference's var-versioning: every NDArray owns a
+``_handle`` token; in-place mutation swaps the token, so tape entries always
+refer to the value they observed (the analog of ThreadedVar versions,
+threaded_engine.h:112-214).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+
+class _TapeEntry:
+    __slots__ = ("fn", "attrs", "in_handles", "in_values", "in_arrays",
+                 "out_handles", "out_arrays", "rng_key", "n_keep")
+
+    def __init__(self, fn, attrs, in_handles, in_values, in_arrays,
+                 out_handles, out_arrays, rng_key, n_keep):
+        self.fn = fn                # pure: fn(*in_values, **attrs) -> tuple
+        self.attrs = attrs
+        self.in_handles = in_handles
+        self.in_values = in_values  # jax value snapshot at record time
+        self.in_arrays = in_arrays  # NDArray refs (keeps AGInfo alive)
+        self.out_handles = out_handles
+        self.out_arrays = out_arrays
+        self.rng_key = rng_key
+        self.n_keep = n_keep        # how many leading fn outputs are visible
+
+
+class _State(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.recording = False
+        self.training = False
+        self.tape: List[_TapeEntry] = []
+
+
+_state = _State()
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(is_recording: bool) -> bool:
+    prev, _state.recording = _state.recording, is_recording
+    return prev
+
+
+def set_training(train_mode: bool) -> bool:
+    prev, _state.training = _state.training, train_mode
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *a):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode: bool = True):
+    """Scope: record imperative ops for backward (autograd.py:34-100)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad buffers (reference: MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g if req != "null" else None
+        v._grad_req = req
+
+
+def _record(fn, attrs, in_arrays, in_values, out_arrays, rng_key=None,
+            n_keep=None):
+    """Called by the dispatcher for every op executed under record()."""
+    entry = _TapeEntry(
+        fn=fn, attrs=attrs,
+        in_handles=[a._handle for a in in_arrays],
+        in_values=list(in_values),
+        in_arrays=list(in_arrays),
+        out_handles=[a._handle for a in out_arrays],
+        out_arrays=list(out_arrays),
+        rng_key=rng_key,
+        n_keep=n_keep if n_keep is not None else len(out_arrays))
+    _state.tape.append(entry)
+
+
+def _clear_tape():
+    _state.tape = []
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Differentiate tape-recorded graph wrt marked variables.
+
+    Replays the tape as one pure jax function of the leaf values and calls
+    ``jax.vjp`` — XLA compiles the whole backward as a single program
+    (reference equivalent: Imperative::Backward, imperative.cc:357-575).
+    """
+    from .ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    tape = _state.tape
+    if not tape:
+        raise MXNetError("backward called outside of autograd.record scope "
+                         "or tape is empty")
+
+    # leaves: marked arrays, keyed by the handle *recorded on the tape* (the
+    # version actually used in the graph — an in-place mutation after record
+    # must not orphan the gradient; reference analog: engine var versions).
+    leaf_handles: List[object] = []
+    leaf_arrays: List["NDArray"] = []
+    leaf_values: List[object] = []
+    seen = set()
+    for e in tape:
+        for h, a, v in zip(e.in_handles, e.in_arrays, e.in_values):
+            if (getattr(a, "_grad_req", "null") != "null"
+                    and a._grad is not None and h not in seen):
+                seen.add(h)
+                leaf_handles.append(h)
+                leaf_arrays.append(a)
+                leaf_values.append(v)
+    for h in heads:
+        if (getattr(h, "_grad_req", "null") != "null" and h._grad is not None
+                and h._handle not in seen):
+            seen.add(h._handle)
+            leaf_handles.append(h._handle)
+            leaf_arrays.append(h)
+            leaf_values.append(h._data)
+    if not leaf_handles:
+        raise MXNetError("no marked (attach_grad) variables found in graph")
+
+    head_handles = [h._handle for h in heads]
+
+    def replay(leaf_vals):
+        env = dict(zip(leaf_handles, leaf_vals))
+        for e in tape:
+            ins = [env.get(h, v) for h, v in zip(e.in_handles, e.in_values)]
+            if e.rng_key is not None:
+                outs = e.fn(e.rng_key, *ins, **e.attrs)
+            else:
+                outs = e.fn(*ins, **e.attrs)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for h, o in zip(e.out_handles, outs[:e.n_keep]):
+                env[h] = o
+        missing = [i for i, h in enumerate(head_handles) if h not in env]
+        if missing:
+            raise MXNetError("head output was not produced by recorded graph")
+        return tuple(env[h] for h in head_handles)
+
+    outs, vjp_fn = jax.vjp(lambda *ls: replay(ls), *leaf_values)
+    if head_grads is None:
+        cts = tuple(jnp.ones_like(o) for o in outs)
+    else:
+        cts = tuple(jnp.ones_like(o) if g is None else
+                    (g._data if isinstance(g, NDArray) else jnp.asarray(g))
+                    for o, g in zip(outs, head_grads))
+    grads = vjp_fn(cts)
+    # accumulate per array (the same array may appear under several recorded
+    # versions); honor grad_req write/add
+    per_array: Dict[int, list] = {}
+    order: List["NDArray"] = []
+    for a, g in zip(leaf_arrays, grads):
+        if id(a) not in per_array:
+            per_array[id(a)] = []
+            order.append(a)
+        per_array[id(a)].append(g)
+    for a in order:
+        total = per_array[id(a)][0]
+        for g in per_array[id(a)][1:]:
+            total = total + g
+        if a._grad_req == "add":
+            a._grad._data = a._grad._data + total
+        else:
+            a._grad._data = total
+    if not retain_graph:
+        _clear_tape()
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return grads of heads wrt variables (autograd.py:274).
+
+    create_graph=True records the gradient computation itself for
+    higher-order gradients.
+    """
+    from .ndarray import NDArray
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    tape = list(_state.tape)
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    var_handles = [v._handle for v in variables]
+    head_handles = [h._handle for h in heads]
+
+    def replay(leaf_vals):
+        env = dict(zip(var_handles, leaf_vals))
+        for e in tape:
+            ins = [env.get(h, v) for h, v in zip(e.in_handles, e.in_values)]
+            outs = (e.fn(e.rng_key, *ins, **e.attrs) if e.rng_key is not None
+                    else e.fn(*ins, **e.attrs))
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for h, o in zip(e.out_handles, outs[:e.n_keep]):
+                env[h] = o
+        return tuple(env[h] for h in head_handles)
+
+    leaf_vals = [v._data for v in variables]
+    if create_graph:
+        # differentiate symbolically so the result is itself recordable:
+        # run jax.grad-of-replay eagerly and record it as one tape op
+        def gradfun(*ls):
+            outs, vjp_fn = jax.vjp(lambda *xs: replay(xs), *ls)
+            cts = tuple(jnp.ones_like(o) for o in outs) if head_grads is None \
+                else tuple(g._data for g in head_grads)
+            return vjp_fn(cts)
+        gvals = gradfun(*leaf_vals)
+        out_arrays = [NDArray(g) for g in gvals]
+        if is_recording():
+            _record(lambda *ls: gradfun(*ls), {}, list(variables), leaf_vals,
+                    out_arrays)
+        result = out_arrays
+    else:
+        outs, vjp_fn = jax.vjp(lambda *ls: replay(ls), *leaf_vals)
+        cts = tuple(jnp.ones_like(o) for o in outs) if head_grads is None \
+            else tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                       for g in head_grads)
+        gvals = vjp_fn(cts)
+        result = [NDArray(g) for g in gvals]
+    if not retain_graph:
+        _clear_tape()
+    return result[0] if single else result
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported in mxnet_tpu; "
+                     "use gluon HybridBlock tracing instead")
+
+
+class Function:
+    """Custom differentiable function (reference: autograd.py:369 Function,
+    src/c_api/c_api_function.cc).
+
+    Subclass and override ``forward``/``backward`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+        import numpy as _np
+        func = self
+
+        raw_in = [x._data for x in inputs]
+
+        def _fwd_raw(*vals):
+            with pause():
+                nds = [NDArray(v) for v in vals]
+                outs = func.forward(*nds)
+            if isinstance(outs, NDArray):
+                outs = (outs,)
+            return tuple(o._data for o in outs)
+
+        @jax.custom_vjp
+        def core(*vals):
+            return _fwd_raw(*vals)
+
+        def core_fwd(*vals):
+            return _fwd_raw(*vals), vals
+
+        def core_bwd(res, gs):
+            with pause():
+                nd_gs = [NDArray(g) for g in gs]
+                igrads = func.backward(*nd_gs)
+            if isinstance(igrads, NDArray):
+                igrads = (igrads,)
+            return tuple(g._data for g in igrads)
+
+        core.defvjp(core_fwd, core_bwd)
+
+        out_vals = core(*raw_in)
+        out_arrays = [NDArray(v) for v in out_vals]
+        if is_recording():
+            _record(lambda *vals: core(*vals), {}, list(inputs), raw_in,
+                    out_arrays)
+        return out_arrays[0] if len(out_arrays) == 1 else tuple(out_arrays)

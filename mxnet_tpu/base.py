@@ -1,0 +1,159 @@
+"""Core plumbing shared by every layer of mxnet_tpu.
+
+TPU-native re-imagination of the reference's dmlc-core utilities
+(reference: include/mxnet/base.h, dmlc GetEnv / logging / registry).  There is
+no C ABI boundary here — the "C API" layer of the reference
+(include/mxnet/c_api.h) is subsumed by Python-native classes; a thin stable
+ABI can be added later for non-Python bindings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__version__ = "0.12.0.tpu0"
+
+
+class MXNetError(RuntimeError):
+    """Default error raised by mxnet_tpu (mirrors mxnet.base.MXNetError)."""
+
+
+# ---------------------------------------------------------------------------
+# Runtime flag registry (reference: dmlc::GetEnv call sites, SURVEY.md §5.6).
+# Every env flag the framework consults is declared here with a type and a
+# default so `mxnet_tpu.base.list_env_flags()` is self-documenting.
+# ---------------------------------------------------------------------------
+_ENV_FLAGS: Dict[str, tuple] = {}
+
+
+def declare_env(name: str, typ: type, default, doc: str = "") -> None:
+    _ENV_FLAGS[name] = (typ, default, doc)
+
+
+def env(name: str, default=None):
+    """Typed environment-variable lookup (reference: dmlc::GetEnv)."""
+    if name in _ENV_FLAGS:
+        typ, declared_default, _ = _ENV_FLAGS[name]
+        if default is None:
+            default = declared_default
+    else:
+        typ = type(default) if default is not None else str
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() not in ("0", "false", "off", "")
+    try:
+        return typ(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def list_env_flags() -> Dict[str, tuple]:
+    return dict(_ENV_FLAGS)
+
+
+# The runtime flags carried over from the reference that still make sense on
+# TPU (SURVEY.md §5.6); CUDA/cuDNN-specific knobs intentionally dropped.
+declare_env("MXNET_ENGINE_TYPE", str, "Async",
+            "Async (default, jit-dispatch) or Naive (block after every op)")
+declare_env("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+            "fuse fwd+bwd(+update) into one XLA program in Module")
+declare_env("MXNET_EXEC_BULK_EXEC_INFERENCE", bool, True,
+            "jit whole forward graphs for inference")
+declare_env("MXNET_BACKWARD_DO_MIRROR", bool, False,
+            "rematerialise activations in backward (jax.checkpoint)")
+declare_env("MXNET_PROFILER_MODE", str, "symbolic_only", "")
+declare_env("MXNET_PROFILER_AUTOSTART", bool, False, "")
+declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
+            "host worker threads for the data pipeline")
+declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19, "")
+declare_env("MXNET_DEFAULT_DTYPE", str, "float32",
+            "default real dtype; set bfloat16 for TPU-preferred training")
+
+
+# ---------------------------------------------------------------------------
+# Generic name registry (reference: dmlc registry pattern used for optimizers,
+# initializers, metrics, iterators...).
+# ---------------------------------------------------------------------------
+class Registry:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, Any] = {}
+
+    def register(self, obj=None, name: Optional[str] = None):
+        def _do(o):
+            key = (name or getattr(o, "__name__", None) or str(o)).lower()
+            self._entries[key] = o
+            return o
+        return _do(obj) if obj is not None else _do
+
+    def alias(self, name: str, target: str):
+        self._entries[name.lower()] = self._entries[target.lower()]
+
+    def get(self, name: str):
+        try:
+            return self._entries[name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"unknown {self.kind} {name!r}; registered: "
+                f"{sorted(self._entries)}")
+
+    def find(self, name: str):
+        return self._entries.get(name.lower())
+
+    def keys(self):
+        return sorted(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# Attr (de)serialization for symbol JSON round trips.  The reference stores op
+# hyper-params as strings in graph JSON (nnvm); we keep that convention so
+# saved graphs stay human-readable and diffable.
+# ---------------------------------------------------------------------------
+def attr_to_str(v) -> str:
+    import numpy as _np
+    if isinstance(v, (list, tuple)):
+        return "(" + ", ".join(attr_to_str(x) for x in v) + ("," if len(v) == 1 else "") + ")"
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, _np.dtype):
+        return v.name
+    if isinstance(v, type):
+        return _np.dtype(v).name
+    return str(v)
+
+
+def str_to_attr(s: str):
+    if not isinstance(s, str):
+        return s
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+# thread-local scoping helper used by Context / autograd / name managers
+class _ScopeStack(threading.local):
+    def __init__(self, default=None):
+        super().__init__()
+        self.stack = [default] if default is not None else []
+
+    @property
+    def current(self):
+        return self.stack[-1] if self.stack else None
+
+    def push(self, v):
+        self.stack.append(v)
+
+    def pop(self):
+        return self.stack.pop()
+
+
+_numeric_types = (int, float)
+
+
+def string_types():
+    return (str,)

@@ -1,0 +1,13 @@
+"""mx.nd — imperative NDArray API (reference: python/mxnet/ndarray/)."""
+from .ndarray import (NDArray, array, empty, zeros, ones, full, arange,
+                      concatenate, stack_arrays, onehot_encode, moveaxis,
+                      waitall, load, save, _invoke, _invoke_fn)
+from .register import init_ndarray_module
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+from . import sparse  # noqa: F401
+
+init_ndarray_module(globals())
+
+# a few reference-API spellings not covered by the registry names
+stack = globals().get("stack")

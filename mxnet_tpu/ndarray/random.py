@@ -1,0 +1,86 @@
+"""mx.nd.random — sampling namespace (reference: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from .ndarray import _invoke, NDArray
+
+
+def _shape_kw(shape):
+    return () if shape is None else (shape if isinstance(shape, tuple) else (shape,)) \
+        if not isinstance(shape, (list, tuple)) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(low, NDArray):
+        return _invoke("_sample_uniform", [low, high],
+                       {"shape": shape or (), "dtype": dtype}, out=out)
+    return _invoke("_random_uniform", [],
+                   {"low": low, "high": high, "shape": shape or (),
+                    "dtype": dtype}, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(loc, NDArray):
+        return _invoke("_sample_normal", [loc, scale],
+                       {"shape": shape or (), "dtype": dtype}, out=out)
+    return _invoke("_random_normal", [],
+                   {"loc": loc, "scale": scale, "shape": shape or (),
+                    "dtype": dtype}, out=out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", **kw):
+    return normal(loc, scale, tuple(shape), dtype)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(alpha, NDArray):
+        return _invoke("_sample_gamma", [alpha, beta],
+                       {"shape": shape or (), "dtype": dtype}, out=out)
+    return _invoke("_random_gamma", [],
+                   {"alpha": alpha, "beta": beta, "shape": shape or (),
+                    "dtype": dtype}, out=out)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(scale, NDArray):
+        return _invoke("_sample_exponential", [1.0 / scale],
+                       {"shape": shape or (), "dtype": dtype}, out=out)
+    return _invoke("_random_exponential", [],
+                   {"lam": 1.0 / scale, "shape": shape or (), "dtype": dtype},
+                   out=out)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(lam, NDArray):
+        return _invoke("_sample_poisson", [lam],
+                       {"shape": shape or (), "dtype": dtype}, out=out)
+    return _invoke("_random_poisson", [],
+                   {"lam": lam, "shape": shape or (), "dtype": dtype}, out=out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                      out=None, **kw):
+    return _invoke("_random_negative_binomial", [],
+                   {"k": k, "p": p, "shape": shape or (), "dtype": dtype},
+                   out=out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, out=None, **kw):
+    return _invoke("_random_generalized_negative_binomial", [],
+                   {"mu": mu, "alpha": alpha, "shape": shape or (),
+                    "dtype": dtype}, out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None, **kw):
+    return _invoke("_random_randint", [],
+                   {"low": low, "high": high, "shape": shape or (),
+                    "dtype": dtype}, out=out)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    return _invoke("_sample_multinomial", [data],
+                   {"shape": shape or (), "get_prob": get_prob, "dtype": dtype})
+
+
+def shuffle(data, **kw):
+    return _invoke("_shuffle", [data], {})

@@ -1,0 +1,67 @@
+"""Autogenerate the ``mx.nd.*`` namespace from the op registry.
+
+TPU-native equivalent of the reference's import-time op-wrapper codegen
+(python/mxnet/base.py:384 ``_init_op_module``,
+python/mxnet/ndarray/register.py:29,156 ``_make_ndarray_function``): the
+reference enumerates ops over the C API and exec's generated Python; here the
+registry is already Python, so wrappers are closures — equally introspectable
+via ``mx.nd.<op>.__doc__`` and ``list_ops()``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, _invoke
+
+
+def _is_tensor(x):
+    return isinstance(x, (NDArray, np.ndarray, jax.Array))
+
+
+def make_op_func(opdef: _reg.OpDef, name: str):
+    def op_func(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)  # symbol-naming attr, meaningless eagerly
+        if len(args) == 1 and isinstance(args[0], (list, tuple)) and opdef.variadic:
+            args = tuple(args[0])
+        if opdef.variadic:
+            inputs = [a for a in args if a is not None]
+            attrs = kwargs
+        else:
+            names = (opdef.arg_names or []) + (opdef.aux_names or [])
+            supplied = {}
+            for an in list(kwargs):
+                if an in names and (_is_tensor(kwargs[an]) or kwargs[an] is None):
+                    supplied[an] = kwargs.pop(an)
+            pos = list(args)
+            inputs = []
+            for nm in names:
+                if nm in supplied:
+                    inputs.append(supplied[nm])
+                elif pos:
+                    inputs.append(pos.pop(0))
+                else:
+                    inputs.append(None)
+            inputs.extend(pos)
+            while inputs and inputs[-1] is None:
+                inputs.pop()
+            if any(i is None for i in inputs):
+                # middle optional input (e.g. LeakyReLU gamma unused): replace
+                # with a zero-size placeholder only if impl tolerates None —
+                # pass through and let the impl default handle it.
+                inputs = [i for i in inputs if i is not None]
+            attrs = kwargs
+        return _invoke(opdef.name, inputs, attrs, out=out)
+
+    op_func.__name__ = name
+    op_func.__doc__ = (opdef.doc or "") + \
+        f"\n\n(auto-generated wrapper for registered op {opdef.name!r})"
+    return op_func
+
+
+def init_ndarray_module(namespace: dict):
+    for name in _reg.list_ops():
+        opdef = _reg.get(name)
+        namespace.setdefault(name, make_op_func(opdef, name))

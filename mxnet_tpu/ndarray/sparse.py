@@ -1,0 +1,153 @@
+"""Sparse NDArrays: row_sparse and CSR.
+
+Scoped TPU-native design (SURVEY.md §7 "Hard parts": XLA has no native
+sparse).  The reference implements storage types dense/row_sparse/CSR at the
+NDArray level (include/mxnet/ndarray.h:58-62) with per-op storage-type
+inference and dense fallback.  Here sparse arrays are explicit wrapper
+classes holding dense component arrays (indices + values), chosen because on
+TPU the only wins worth keeping are:
+
+* row_sparse gradients for embeddings (gather/scatter-add — XLA handles
+  these natively and efficiently),
+* CSR x dense matmul via ``jax.experimental.sparse`` BCSR or segment-sum.
+
+Any op without a sparse-aware path falls back to dense via ``.todense()``,
+mirroring the reference's storage-fallback mechanism
+(src/common/exec_utils.h SetupDefaultBlobsInOut).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .ndarray import NDArray, _invoke
+
+
+class BaseSparseNDArray(NDArray):
+    pass
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """values (nnz_rows, *row_shape) + indices (nnz_rows,) — reference:
+    ndarray.h kRowSparseStorage."""
+
+    def __init__(self, data, indices, shape, dtype=None):
+        self._sp_data = data if isinstance(data, NDArray) else NDArray(data, dtype=dtype)
+        self._sp_indices = indices if isinstance(indices, NDArray) else \
+            NDArray(np.asarray(indices, dtype=np.int64), dtype="int64")
+        self._sp_shape = tuple(shape)
+        dense = jnp.zeros(self._sp_shape, self._sp_data._data.dtype).at[
+            self._sp_indices._data.astype(jnp.int32)].set(self._sp_data._data)
+        super().__init__(dense)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return self._sp_data
+
+    @property
+    def indices(self):
+        return self._sp_indices
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        raise MXNetError(f"cast {self.stype} -> {stype} unsupported")
+
+    def todense(self):
+        return NDArray(self._data)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """CSR matrix: data/indices/indptr (reference: ndarray.h kCSRStorage)."""
+
+    def __init__(self, data, indices, indptr, shape, dtype=None):
+        self._sp_data = data if isinstance(data, NDArray) else NDArray(data, dtype=dtype)
+        self._sp_indices = indices if isinstance(indices, NDArray) else \
+            NDArray(np.asarray(indices, dtype=np.int64), dtype="int64")
+        self._sp_indptr = indptr if isinstance(indptr, NDArray) else \
+            NDArray(np.asarray(indptr, dtype=np.int64), dtype="int64")
+        self._sp_shape = tuple(shape)
+        # dense materialization (fallback path)
+        n_rows = shape[0]
+        iptr = np.asarray(self._sp_indptr.asnumpy(), dtype=np.int64)
+        rows = np.repeat(np.arange(n_rows), np.diff(iptr))
+        dense = np.zeros(shape, dtype=np.asarray(self._sp_data.asnumpy()).dtype)
+        dense[rows, self._sp_indices.asnumpy().astype(np.int64)] = \
+            self._sp_data.asnumpy()
+        super().__init__(dense)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return self._sp_data
+
+    @property
+    def indices(self):
+        return self._sp_indices
+
+    @property
+    def indptr(self):
+        return self._sp_indptr
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        raise MXNetError(f"cast {self.stype} -> {stype} unsupported")
+
+    def todense(self):
+        return NDArray(self._data)
+
+
+def row_sparse_array(arg1, shape=None, dtype=None, ctx=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices, shape, dtype=dtype)
+    # from dense
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    nz = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(dense[nz], nz, dense.shape, dtype=dtype)
+
+
+def csr_matrix(arg1, shape=None, dtype=None, ctx=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indices, indptr, shape, dtype=dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    rows, cols = np.nonzero(dense)
+    indptr = np.searchsorted(rows, np.arange(dense.shape[0] + 1))
+    return CSRNDArray(dense[rows, cols], cols, indptr, dense.shape, dtype=dtype)
+
+
+def cast_storage(arr, stype):
+    """reference: tensor/cast_storage-inl.h"""
+    if stype == "default":
+        return NDArray(arr._data)
+    dense = arr.asnumpy()
+    if stype == "row_sparse":
+        return row_sparse_array(NDArray(dense))
+    if stype == "csr":
+        return csr_matrix(NDArray(dense))
+    raise MXNetError(stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "row_sparse":
+        return RowSparseNDArray(np.zeros((0,) + tuple(shape[1:])),
+                                np.zeros((0,)), shape, dtype=dtype)
+    if stype == "csr":
+        return CSRNDArray(np.zeros((0,)), np.zeros((0,)),
+                          np.zeros(shape[0] + 1), shape, dtype=dtype)
+    raise MXNetError(stype)

@@ -1,0 +1,129 @@
+"""Indexing / gather / scatter / one-hot / embedding ops.
+
+TPU-native equivalent of src/operator/tensor/indexing_op.cc (Embedding, take,
+one_hot, gather_nd, scatter_nd) and ordering_op.cc (sort/topk/argsort).
+Gathers lower to XLA dynamic-gather; Embedding is a gather over the vocab
+axis (sharded-vocab variants live in mxnet_tpu/parallel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+@register("Embedding", arg_names=["data", "weight"],
+          attr_defaults={"input_dim": 0, "output_dim": 0, "dtype": "float32",
+                         "sparse_grad": False})
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False, **kw):
+    """reference: indexing_op.cc Embedding"""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("take", arg_names=["a", "indices"],
+          attr_defaults={"axis": 0, "mode": "clip"})
+def _take(a, indices, axis=0, mode="clip", **kw):
+    idx = indices.astype(jnp.int32)
+    n = a.shape[axis]
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, n)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", arg_names=["a", "indices"], aliases=("pick",),
+          attr_defaults={"axis": -1, "keepdims": False})
+def _pick(a, indices, axis=-1, keepdims=False, **kw):
+    """reference: indexing_op.cc pick — select one element along axis per
+    leading-index."""
+    idx = indices.astype(jnp.int32)
+    out = jnp.take_along_axis(a, jnp.expand_dims(idx, axis=axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot", arg_names=["indices"], differentiable=False,
+          attr_defaults={"depth": 0, "on_value": 1.0, "off_value": 0.0,
+                         "dtype": "float32"})
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd", arg_names=["data", "indices"])
+def _gather_nd(data, indices, **kw):
+    """reference: indexing_op.cc gather_nd — indices shape (M, ...) indexes
+    the first M dims of data."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", arg_names=["data", "indices"],
+          attr_defaults={"shape": ()})
+def _scatter_nd(data, indices, shape=(), **kw):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd", arg_names=["lhs", "rhs", "indices"],
+          attr_defaults={"shape": ()})
+def _scatter_set_nd(lhs, rhs, indices, shape=(), **kw):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+# --- ordering (reference: tensor/ordering_op.cc; CUB/Thrust sort subsumed by
+# XLA sort) -----------------------------------------------------------------
+@register("sort", arg_names=["data"],
+          attr_defaults={"axis": -1, "is_ascend": True})
+def _sort(data, axis=-1, is_ascend=True, **kw):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", arg_names=["data"], differentiable=False,
+          attr_defaults={"axis": -1, "is_ascend": True, "dtype": "float32"})
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register("topk", arg_names=["data"], num_outputs=-1, differentiable=False,
+          attr_defaults={"axis": -1, "k": 1, "ret_typ": "indices",
+                         "is_ascend": False, "dtype": "float32"})
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+          dtype="float32", **kw):
+    """reference: ordering_op.cc TopK.  Static k keeps shapes XLA-friendly."""
+    ax = axis % data.ndim
+    moved = jnp.moveaxis(data, ax, -1)
+    sel = -moved if not is_ascend else moved
+    vals, idxs = lax.top_k(-sel, k) if is_ascend else lax.top_k(sel, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxs.astype(jnp.dtype(dtype))
+    if ret_typ == "both":
+        return vals, idxs.astype(jnp.dtype(dtype))
+    if ret_typ == "mask":
+        moved_mask = jnp.zeros(moved.shape, jnp.int32).at[
+            tuple(jnp.indices(idxs.shape)[:-1]) + (idxs,)].set(1)
+        return jnp.moveaxis(moved_mask, -1, ax).astype(data.dtype)
+    raise ValueError(ret_typ)

@@ -1,0 +1,42 @@
+"""Array-creation ops (reference: src/operator/tensor/init_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_zeros", attr_defaults={"shape": (), "dtype": "float32"})
+def _zeros(shape=(), dtype="float32", **kw):
+    return jnp.zeros(tuple(shape), dtype=jnp.dtype(dtype or "float32"))
+
+
+@register("_ones", attr_defaults={"shape": (), "dtype": "float32"})
+def _ones(shape=(), dtype="float32", **kw):
+    return jnp.ones(tuple(shape), dtype=jnp.dtype(dtype or "float32"))
+
+
+@register("_full", attr_defaults={"shape": (), "dtype": "float32", "value": 0.0})
+def _full(shape=(), dtype="float32", value=0.0, **kw):
+    return jnp.full(tuple(shape), value, dtype=jnp.dtype(dtype or "float32"))
+
+
+@register("_arange", attr_defaults={"start": 0.0, "stop": None, "step": 1.0,
+                                    "repeat": 1, "dtype": "float32"})
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", **kw):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype or "float32"))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", attr_defaults={"N": 0, "M": 0, "k": 0, "dtype": "float32"})
+def _eye(N=0, M=0, k=0, dtype="float32", **kw):
+    return jnp.eye(N, M or None, k=k, dtype=jnp.dtype(dtype or "float32"))
+
+
+@register("_linspace", attr_defaults={"start": 0.0, "stop": 1.0, "num": 50,
+                                      "endpoint": True, "dtype": "float32"})
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", **kw):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=jnp.dtype(dtype or "float32"))

@@ -1,0 +1,111 @@
+"""Advanced linear-algebra ops.
+
+TPU-native equivalent of src/operator/tensor/la_op.cc (LAPACK wrapper
+c_lapack_api.h).  XLA provides native lowerings for cholesky/triangular-solve/
+QR; batched forms come free via leading batch dims.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("linalg_gemm", arg_names=["A", "B", "C"],
+          attr_defaults={"transpose_a": False, "transpose_b": False,
+                         "alpha": 1.0, "beta": 1.0})
+def _gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+          **kw):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2", arg_names=["A", "B"],
+          attr_defaults={"transpose_a": False, "transpose_b": False,
+                         "alpha": 1.0})
+def _gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf", arg_names=["A"])
+def _potrf(A, **kw):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri", arg_names=["A"])
+def _potri(A, **kw):
+    """inverse from cholesky factor: (A A^T)^-1 given lower-triangular A."""
+    ident = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = lax.linalg.triangular_solve(A, ident, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_trmm", arg_names=["A", "B"],
+          attr_defaults={"transpose": False, "rightside": False, "alpha": 1.0})
+def _trmm(A, B, transpose=False, rightside=False, alpha=1.0, **kw):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    a = jnp.tril(A) if not transpose else jnp.swapaxes(jnp.tril(A), -1, -2)
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("linalg_trsm", arg_names=["A", "B"],
+          attr_defaults={"transpose": False, "rightside": False, "alpha": 1.0})
+def _trsm(A, B, transpose=False, rightside=False, alpha=1.0, **kw):
+    return alpha * lax.linalg.triangular_solve(
+        A, B, left_side=not rightside, lower=True,
+        transpose_a=transpose)
+
+
+@register("linalg_syrk", arg_names=["A"],
+          attr_defaults={"transpose": False, "alpha": 1.0})
+def _syrk(A, transpose=False, alpha=1.0, **kw):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("linalg_gelqf", arg_names=["A"], num_outputs=2)
+def _gelqf(A, **kw):
+    """LQ factorization via QR of A^T (reference: la_op.cc gelqf)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_sumlogdiag", arg_names=["A"])
+def _sumlogdiag(A, **kw):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_syevd", arg_names=["A"], num_outputs=2)
+def _syevd(A, **kw):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_inverse", arg_names=["A"])
+def _inverse(A, **kw):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", arg_names=["A"])
+def _det(A, **kw):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", arg_names=["A"], num_outputs=2)
+def _slogdet(A, **kw):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("khatri_rao", variadic=True)
+def _khatri_rao(*args, **kw):
+    """column-wise Kronecker product (reference: contrib krprod)."""
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ir,jr->ijr", out, m).reshape(-1, out.shape[1])
+    return out

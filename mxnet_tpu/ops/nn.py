@@ -1,0 +1,489 @@
+"""Neural-network layer ops.
+
+TPU-native equivalents of the reference's legacy stateful ops
+(src/operator/{fully_connected,convolution,pooling,batch_norm,activation,
+dropout,deconvolution,lrn,instance_norm,upsampling}.cc plus the cuDNN
+wrappers src/operator/cudnn_*.h).  Where the reference auto-tunes cuDNN
+algorithms (cudnn_algoreg-inl.h), here convs lower to
+``lax.conv_general_dilated`` and XLA picks the MXU tiling — no algorithm
+registry needed.  All convs keep NCHW user-facing layout (MXNet default);
+XLA's layout assignment transposes internally to the TPU-preferred layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _pair(v, n=2):
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t if len(t) == n else t * n
+
+
+# --------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/fully_connected.cc)
+# --------------------------------------------------------------------------
+@register("FullyConnected", arg_names=["data", "weight", "bias"],
+          attr_defaults={"num_hidden": 0, "no_bias": False, "flatten": True})
+def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                     flatten=True, **kw):
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# --------------------------------------------------------------------------
+# Convolution / Deconvolution (reference: src/operator/convolution.cc,
+# deconvolution.cc; cudnn_convolution-inl.h)
+# --------------------------------------------------------------------------
+_CONV_DN = {  # spatial-rank -> (lhs, rhs, out) dimension_numbers
+    1: ("NCH", "OIH", "NCH"),
+    2: ("NCHW", "OIHW", "NCHW"),
+    3: ("NCDHW", "OIDHW", "NCDHW"),
+}
+
+
+@register("Convolution", arg_names=["data", "weight", "bias"],
+          attr_defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                         "num_filter": 0, "num_group": 1, "no_bias": False,
+                         "layout": None, "workspace": 1024,
+                         "cudnn_tune": None, "cudnn_off": False})
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                 pad=(), num_filter=0, num_group=1, no_bias=False,
+                 layout=None, **kw):
+    rank = data.ndim - 2
+    stride = _pair(stride, rank) if stride else (1,) * rank
+    dilate = _pair(dilate, rank) if dilate else (1,) * rank
+    pad = _pair(pad, rank) if pad else (0,) * rank
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=tuple((p, p) for p in pad),
+        rhs_dilation=dilate,
+        dimension_numbers=_CONV_DN[rank],
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * rank)
+    return out
+
+
+@register("Deconvolution", arg_names=["data", "weight", "bias"],
+          attr_defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                         "adj": (), "target_shape": (), "num_filter": 0,
+                         "num_group": 1, "no_bias": True, "layout": None,
+                         "workspace": 512})
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                   pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
+                   no_bias=True, layout=None, **kw):
+    """Transposed convolution = gradient of Convolution wrt data
+    (reference: deconvolution-inl.h)."""
+    rank = data.ndim - 2
+    stride = _pair(stride, rank) if stride else (1,) * rank
+    dilate = _pair(dilate, rank) if dilate else (1,) * rank
+    pad = _pair(pad, rank) if pad else (0,) * rank
+    adj = _pair(adj, rank) if adj else (0,) * rank
+    kernel = _pair(kernel, rank) if kernel else weight.shape[2:]
+    # effective kernel extent
+    pads = []
+    for k, p, d, a in zip(kernel, pad, dilate, adj):
+        ke = d * (k - 1) + 1
+        pads.append((ke - 1 - p, ke - 1 - p + a))
+    out = lax.conv_general_dilated(
+        data, jnp.swapaxes(weight, 0, 1) if num_group == 1 else _group_swap(weight, num_group),
+        window_strides=(1,) * rank,
+        padding=tuple(pads),
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=_CONV_DN[rank],
+        feature_group_count=num_group)
+    # weight layout for deconv in MXNet: (in_ch, out_ch/group, *k); flip spatial
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * rank)
+    return out
+
+
+def _group_swap(w, g):
+    # (C_in, C_out/g, *k) grouped -> rhs for conv with feature_group_count=g
+    cin, cog = w.shape[0], w.shape[1]
+    wk = w.reshape((g, cin // g) + w.shape[1:])
+    wk = jnp.swapaxes(wk, 1, 2)  # (g, C_out/g, C_in/g, *k)
+    return wk.reshape((g * cog, cin // g) + w.shape[2:])
+
+
+def _deconv_flip(w):
+    return jnp.flip(w, axis=tuple(range(2, w.ndim)))
+
+
+# --------------------------------------------------------------------------
+# Pooling (reference: src/operator/pooling.cc, nn/pool.cuh)
+# --------------------------------------------------------------------------
+@register("Pooling", arg_names=["data"],
+          attr_defaults={"kernel": (), "stride": (), "pad": (),
+                         "pool_type": "max", "global_pool": False,
+                         "pooling_convention": "valid", "cudnn_off": False})
+def _pooling(data, kernel=(), stride=(), pad=(), pool_type="max",
+             global_pool=False, pooling_convention="valid", **kw):
+    rank = data.ndim - 2
+    if global_pool:
+        ax = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=ax, keepdims=True)
+        return jnp.mean(data, axis=ax, keepdims=True)
+    kernel = _pair(kernel, rank)
+    stride = _pair(stride, rank) if stride else (1,) * rank
+    pad = _pair(pad, rank) if pad else (0,) * rank
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+
+    if pooling_convention == "full":
+        # ceil-mode output: pad right edge enough to cover
+        pads = [(0, 0), (0, 0)]
+        for i in range(rank):
+            in_sz = data.shape[2 + i]
+            out_sz = int(np.ceil((in_sz + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - in_sz - pad[i]
+            pads.append((pad[i], max(need, pad[i])))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    pads = tuple(pads)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        # count_include_pad=True matches MXNet default avg pooling
+        return s / np.prod(kernel)
+    raise ValueError(pool_type)
+
+
+@register("UpSampling", variadic=True,
+          attr_defaults={"scale": 1, "sample_type": "nearest",
+                         "num_args": 1, "workspace": 512, "num_filter": 0,
+                         "multi_input_mode": "concat"})
+def _upsampling(*args, scale=1, sample_type="nearest", num_args=1,
+                num_filter=0, multi_input_mode="concat", **kw):
+    """reference: src/operator/upsampling.cc (nearest mode)."""
+    outs = []
+    for data in args:
+        n, c, h, w = data.shape
+        x = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        outs.append(x)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        return sum(outs)
+    return jnp.concatenate(outs, axis=1)
+
+
+# --------------------------------------------------------------------------
+# Normalization (reference: batch_norm.cc, instance_norm.cc, lrn.cc)
+# --------------------------------------------------------------------------
+@register("BatchNorm", arg_names=["data", "gamma", "beta"],
+          aux_names=["moving_mean", "moving_var"], num_aux=2, num_outputs=3,
+          num_visible=1, takes_is_train=True,
+          attr_defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                         "use_global_stats": False, "output_mean_var": False,
+                         "axis": 1, "cudnn_off": False})
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, is_train=True, **kw):
+    """reference: src/operator/batch_norm.cc.
+
+    Training returns (out, batch_mean, batch_var, new_moving_mean,
+    new_moving_var); the trailing pair is written back into the aux arrays by
+    the dispatcher (functional replacement for in-kernel aux mutation).
+    """
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if is_train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        out = (data - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
+        out = out * g.reshape(bshape) + beta.reshape(bshape)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+        return out, mean, var, new_mm, new_mv
+    out = (data - moving_mean.reshape(bshape)) * lax.rsqrt(
+        moving_var.reshape(bshape) + eps)
+    out = out * g.reshape(bshape) + beta.reshape(bshape)
+    return out, moving_mean, moving_var
+
+
+@register("InstanceNorm", arg_names=["data", "gamma", "beta"],
+          attr_defaults={"eps": 1e-3})
+def _instance_norm(data, gamma, beta, eps=1e-3, **kw):
+    """reference: src/operator/instance_norm.cc"""
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("LayerNorm", arg_names=["data", "gamma", "beta"], num_outputs=3,
+          num_visible=1,
+          attr_defaults={"axis": -1, "eps": 1e-5, "output_mean_var": False})
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **kw):
+    """Transformer-era addition (post-dates the reference; kept because the
+    TPU build treats attention workloads as first-class, SURVEY.md §5.7)."""
+    ax = axis % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+
+
+@register("LRN", arg_names=["data"],
+          attr_defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5})
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    """reference: src/operator/lrn.cc — cross-channel local response norm."""
+    sq = jnp.square(data)
+    pad = nsize // 2
+    sq_pad = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    windows = sum(sq_pad[:, i:i + data.shape[1]] for i in range(nsize))
+    return data / jnp.power(knorm + alpha / nsize * windows, beta)
+
+
+# --------------------------------------------------------------------------
+# Activations (reference: activation.cc, leaky_relu.cc)
+# --------------------------------------------------------------------------
+@register("Activation", arg_names=["data"], attr_defaults={"act_type": "relu"})
+def _activation(data, act_type="relu", **kw):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError(act_type)
+
+
+@register("LeakyReLU", arg_names=["data", "gamma"], needs_rng=True,
+          takes_is_train=True,
+          attr_defaults={"act_type": "leaky", "slope": 0.25,
+                         "lower_bound": 0.125, "upper_bound": 0.334})
+def _leaky_relu(key, data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334, is_train=True, **kw):
+    """reference: src/operator/leaky_relu.cc (leaky/prelu/elu/rrelu/selu/gelu)."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "selu":
+        return 1.0507009873554805 * jnp.where(
+            data > 0, data, 1.6732632423543772 * (jnp.exp(data) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(data)
+    if act_type == "rrelu":
+        if is_train:
+            s = jax.random.uniform(key, data.shape, data.dtype,
+                                   lower_bound, upper_bound)
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError(act_type)
+
+
+@register("Dropout", arg_names=["data"], needs_rng=True, takes_is_train=True,
+          num_outputs=2, num_visible=1,
+          attr_defaults={"p": 0.5, "mode": "training", "axes": ()})
+def _dropout(key, data, p=0.5, mode="training", axes=(), is_train=True, **kw):
+    """reference: src/operator/dropout.cc — returns (out, mask)."""
+    if not is_train and mode != "always":
+        return data, jnp.ones_like(data)
+    if p <= 0.0:
+        return data, jnp.ones_like(data)
+    shape = list(data.shape)
+    for a in (axes or ()):
+        shape[a] = 1
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    mask = keep.astype(data.dtype) / (1.0 - p)
+    return data * mask, jnp.broadcast_to(mask, data.shape)
+
+
+# --------------------------------------------------------------------------
+# Softmax family (reference: nn/softmax.cc, softmax_output.cc)
+# --------------------------------------------------------------------------
+@register("softmax", arg_names=["data"],
+          attr_defaults={"axis": -1, "temperature": None})
+def _softmax(data, axis=-1, temperature=None, **kw):
+    if temperature:
+        data = data / temperature
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax", arg_names=["data"],
+          attr_defaults={"axis": -1, "temperature": None})
+def _log_softmax(data, axis=-1, temperature=None, **kw):
+    if temperature:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register("SoftmaxActivation", arg_names=["data"],
+          attr_defaults={"mode": "instance"})
+def _softmax_activation(data, mode="instance", **kw):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization, smooth_alpha):
+    if multi_output:
+        out = jax.nn.softmax(data, axis=1)
+    else:
+        out = jax.nn.softmax(data, axis=-1)
+    return out
+
+
+@jax.custom_vjp
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         multi_output, normalization, smooth_alpha):
+    return _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                               use_ignore, multi_output, normalization,
+                               smooth_alpha)
+
+
+def _softmax_output_vjp_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                            multi_output, normalization, smooth_alpha):
+    out = _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                              use_ignore, multi_output, normalization,
+                              smooth_alpha)
+    return out, (out, label, grad_scale, ignore_label, use_ignore,
+                 multi_output, normalization, smooth_alpha)
+
+
+def _softmax_output_vjp_bwd(res, g):
+    (out, label, grad_scale, ignore_label, use_ignore, multi_output,
+     normalization, smooth_alpha) = res
+    axis = 1 if multi_output else -1
+    nclass = out.shape[axis]
+    if label.ndim == out.ndim:
+        onehot = label  # dense per-class label
+    else:
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, nclass, axis=axis, dtype=out.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1 - smooth_alpha) + smooth_alpha / nclass
+    grad = out - onehot
+    valid = None
+    if use_ignore and label.ndim != out.ndim:
+        keep = (label.astype(jnp.int32) != int(ignore_label))
+        grad = grad * jnp.expand_dims(keep, axis).astype(out.dtype)
+        valid = jnp.maximum(jnp.sum(keep), 1).astype(out.dtype)
+    if normalization == "batch":
+        grad = grad / out.shape[0]
+    elif normalization == "valid":
+        if valid is None:
+            valid = jnp.asarray(
+                np.prod([s for i, s in enumerate(out.shape) if i != (axis % out.ndim)]),
+                out.dtype)
+        grad = grad / valid
+    grad = grad * grad_scale
+    return (grad, jnp.zeros_like(label), None, None, None, None, None, None)
+
+
+_softmax_output_core.defvjp(_softmax_output_vjp_fwd, _softmax_output_vjp_bwd)
+
+
+@register("SoftmaxOutput", arg_names=["data", "label"],
+          aliases=("Softmax",),
+          attr_defaults={"grad_scale": 1.0, "ignore_label": -1.0,
+                         "multi_output": False, "use_ignore": False,
+                         "preserve_shape": False, "normalization": "null",
+                         "out_grad": False, "smooth_alpha": 0.0})
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0, **kw):
+    """reference: src/operator/softmax_output.cc — forward is softmax; the
+    head gradient is (p - onehot(label)) * grad_scale, expressed here as a
+    jax.custom_vjp so jax.grad of any loss-shaped executor reproduces the
+    reference's implicit-loss semantics."""
+    return _softmax_output_core(data, label, grad_scale, ignore_label,
+                                use_ignore, multi_output, normalization,
+                                smooth_alpha)
+
+
+def _make_regression_output(name, link, grad_fn):
+    @jax.custom_vjp
+    def core(data, label, grad_scale):
+        return link(data)
+
+    def fwd(data, label, grad_scale):
+        out = link(data)
+        return out, (out, label, grad_scale)
+
+    def bwd(res, g):
+        out, label, grad_scale = res
+        label = label.reshape(out.shape)
+        grad = grad_fn(out, label) * grad_scale / out.shape[0] * out.shape[0]
+        # MXNet normalizes by num outputs per batch implicitly via grad_scale
+        return (grad * 1.0 / 1.0, jnp.zeros_like(label), None)
+
+    core.defvjp(fwd, bwd)
+
+    @register(name, arg_names=["data", "label"],
+              attr_defaults={"grad_scale": 1.0})
+    def _op(data, label, grad_scale=1.0, **kw):
+        return core(data, label, grad_scale)
+    return _op
+
+
+_make_regression_output("LinearRegressionOutput", lambda x: x,
+                        lambda o, l: (o - l))
+_make_regression_output("MAERegressionOutput", lambda x: x,
+                        lambda o, l: jnp.sign(o - l))
+_make_regression_output("LogisticRegressionOutput", jax.nn.sigmoid,
+                        lambda o, l: (o - l))
+
+
+@register("SVMOutput", arg_names=["data", "label"],
+          attr_defaults={"margin": 1.0, "regularization_coefficient": 1.0,
+                         "use_linear": False})
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False, **kw):
+    """reference: src/operator/svm_output.cc (forward = identity)."""
+    return data
+
+
+@register("MakeLoss", arg_names=["data"],
+          attr_defaults={"grad_scale": 1.0, "valid_thresh": 0.0,
+                         "normalization": "null"})
+def _makeloss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null", **kw):
+    """reference: src/operator/make_loss.cc"""
+    return data
+
+
+@register("softmax_cross_entropy", arg_names=["data", "label"])
+def _softmax_ce(data, label, **kw):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    return -jnp.sum(jnp.take_along_axis(logp, lab[:, None], axis=-1))

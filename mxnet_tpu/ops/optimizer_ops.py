@@ -1,0 +1,139 @@
+"""Optimizer update ops.
+
+TPU-native equivalent of src/operator/optimizer_op.cc — the reference
+registers parameter updates as *ops* so they run on-device inside the engine;
+here they are pure jax functions the KVStore/Trainer fuses into the jitted
+training step (weights donated, so updates are in-place at the XLA level).
+Each op returns (new_weight, *new_states).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _clip_grad(grad, clip_gradient):
+    if clip_gradient is not None and clip_gradient > 0:
+        return jnp.clip(grad, -clip_gradient, clip_gradient)
+    return grad
+
+
+@register("sgd_update", arg_names=["weight", "grad"],
+          attr_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0})
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, **kw):
+    g = _clip_grad(grad * rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", arg_names=["weight", "grad", "mom"],
+          num_outputs=2,
+          attr_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0})
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = _clip_grad(grad * rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", arg_names=["weight", "grad", "weight32"],
+          num_outputs=2,
+          attr_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0})
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, **kw):
+    """fp16 weights with fp32 master copy (reference: optimizer_op.cc
+    MP_SGD; on TPU the same pattern serves bfloat16 training)."""
+    g = _clip_grad(grad.astype(jnp.float32) * rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", arg_names=["weight", "grad", "mom", "weight32"],
+          num_outputs=3,
+          attr_defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0})
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = _clip_grad(grad.astype(jnp.float32) * rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("adam_update", arg_names=["weight", "grad", "mean", "var"],
+          num_outputs=3,
+          attr_defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
+                         "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0})
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 **kw):
+    g = _clip_grad(grad * rescale_grad, clip_gradient) + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * m / (jnp.sqrt(v) + epsilon)
+    return w, m, v
+
+
+@register("rmsprop_update", arg_names=["weight", "grad", "n"], num_outputs=2,
+          attr_defaults={"lr": 0.001, "gamma1": 0.95, "epsilon": 1e-8,
+                         "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0,
+                         "clip_weights": -1.0})
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0, **kw):
+    g = _clip_grad(grad * rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register("rmspropalex_update", arg_names=["weight", "grad", "n", "g", "delta"],
+          num_outputs=4,
+          attr_defaults={"lr": 0.001, "gamma1": 0.95, "gamma2": 0.9,
+                         "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0, "clip_weights": -1.0})
+def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0, **kw):
+    gr = _clip_grad(grad * rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(gr)
+    new_g = gamma1 * g + (1 - gamma1) * gr
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", arg_names=["weight", "grad", "z", "n"], num_outputs=3,
+          attr_defaults={"lr": 0.1, "lamda1": 0.01, "beta": 1.0, "wd": 0.0,
+                         "rescale_grad": 1.0, "clip_gradient": -1.0})
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = _clip_grad(grad * rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+@register("signsgd_update", arg_names=["weight", "grad"],
+          attr_defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                         "clip_gradient": -1.0})
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, **kw):
+    g = _clip_grad(grad * rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)

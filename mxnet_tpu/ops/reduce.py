@@ -1,0 +1,110 @@
+"""Reduction and broadcasting-shape ops.
+
+TPU-native equivalent of src/operator/tensor/broadcast_reduce_op*.cc
+(MXNET_OPERATOR_REGISTER_REDUCE family) — the reference's hand-rolled CUDA
+reduce codegen (tensor/broadcast_reduce-inl.cuh) is subsumed by XLA reduce
+lowering onto the VPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, int):
+        return (axis,)
+    return tuple(axis)
+
+
+def _reg_reduce(name, fn, aliases=()):
+    @register(name, arg_names=["data"],
+              attr_defaults={"axis": None, "keepdims": False, "exclude": False},
+              aliases=aliases)
+    def _impl(data, axis=None, keepdims=False, exclude=False, _f=fn, **kw):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            ax = tuple(i for i in range(data.ndim) if i not in
+                       tuple(a % data.ndim for a in ax))
+        return _f(data, axis=ax, keepdims=keepdims)
+    return _impl
+
+
+_reg_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reg_reduce("mean", jnp.mean)
+_reg_reduce("prod", jnp.prod)
+_reg_reduce("nansum", jnp.nansum)
+_reg_reduce("nanprod", jnp.nanprod)
+_reg_reduce("max", jnp.max, aliases=("max_axis",))
+_reg_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm", arg_names=["data"],
+          attr_defaults={"ord": 2, "axis": None, "keepdims": False})
+def _norm(data, ord=2, axis=None, keepdims=False, **kw):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", arg_names=["data"], differentiable=False,
+          attr_defaults={"axis": None, "keepdims": False})
+def _argmax(data, axis=None, keepdims=False, **kw):
+    out = jnp.argmax(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmin", arg_names=["data"], differentiable=False,
+          attr_defaults={"axis": None, "keepdims": False})
+def _argmin(data, axis=None, keepdims=False, **kw):
+    return jnp.argmin(data, axis=axis, keepdims=bool(keepdims)).astype(jnp.float32)
+
+
+@register("argmax_channel", arg_names=["data"], differentiable=False)
+def _argmax_channel(data, **kw):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+@register("broadcast_to", arg_names=["data"], attr_defaults={"shape": ()})
+def _broadcast_to(data, shape=(), **kw):
+    shape = tuple(int(s) for s in shape)
+    # MXNet semantics: 0 in target shape means "keep input dim"
+    shape = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register("broadcast_axis", arg_names=["data"],
+          attr_defaults={"axis": (), "size": ()}, aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=(), size=(), **kw):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    target = list(data.shape)
+    for a, s in zip(axes, sizes):
+        target[a] = s
+    return jnp.broadcast_to(data, tuple(target))
+
+
+@register("broadcast_like", arg_names=["lhs", "rhs"])
+def _broadcast_like(lhs, rhs, **kw):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("L2Normalization", arg_names=["data"],
+          attr_defaults={"eps": 1e-10, "mode": "instance"})
+def _l2norm(data, eps=1e-10, mode="instance", **kw):
+    """reference: src/operator/l2_normalization.cc"""
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(mode)
+    denom = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / denom

@@ -1,0 +1,149 @@
+"""Fused multi-layer (bi)directional RNN/LSTM/GRU op.
+
+TPU-native equivalent of the reference's cuDNN-only fused ``RNN`` op
+(src/operator/rnn-inl.h:92-124 param struct; src/operator/cudnn_rnn-inl.h).
+Where cuDNN fuses the whole sequence into one persistent kernel, here each
+layer is a ``lax.scan`` whose per-step matmuls XLA maps onto the MXU; the
+input projection for the *entire sequence* is hoisted out of the scan as one
+big (T*N, I) x (I, G*H) matmul — the classic TPU RNN trick — so only the
+recurrent H x H matmul stays sequential.
+
+Weight layout (flat ``parameters`` vector) matches the reference/cuDNN
+packing so ``FusedRNNCell.unpack_weights`` semantics carry over:
+  for layer l, direction d: W_x[gates] (G*H, I_l), W_h[gates] (G*H, H)
+  then all biases:          b_x[gates] (G*H,),     b_h[gates] (G*H,)
+Gate order: lstm = [i, f, g, o]; gru = [r, z, n]; rnn_* = [x].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total flat parameter count (reference: rnn-inl.h GetParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for l in range(num_layers):
+        i_l = input_size if l == 0 else state_size * d
+        size += d * (g * state_size * i_l + g * state_size * state_size)
+    size += num_layers * d * 2 * g * state_size  # biases
+    return size
+
+
+def _unpack(params, num_layers, input_size, state_size, d, g):
+    """Split the flat vector into per-layer weight/bias pytrees."""
+    H, off = state_size, 0
+    Ws = []
+    for l in range(num_layers):
+        i_l = input_size if l == 0 else H * d
+        per_dir = []
+        for _ in range(d):
+            wx = params[off: off + g * H * i_l].reshape(g * H, i_l)
+            off += g * H * i_l
+            wh = params[off: off + g * H * H].reshape(g * H, H)
+            off += g * H * H
+            per_dir.append((wx, wh))
+        Ws.append(per_dir)
+    Bs = []
+    for l in range(num_layers):
+        per_dir = []
+        for _ in range(d):
+            bx = params[off: off + g * H]; off += g * H
+            bh = params[off: off + g * H]; off += g * H
+            per_dir.append((bx, bh))
+        Bs.append(per_dir)
+    return Ws, Bs
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, gates_x, wh, bh):
+            h, c = carry
+            gates = gates_x + h @ wh.T + bh
+            i, f, gg, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+    elif mode == "gru":
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            rx, zx, nx = jnp.split(gates_x, 3, axis=-1)
+            rh, zh, nh = jnp.split(h @ wh.T + bh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            n = jnp.tanh(nx + r * nh)
+            h = (1 - z) * n + z * h
+            return (h,), h
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            h = act(gates_x + h @ wh.T + bh)
+            return (h,), h
+    return step
+
+
+def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
+    """x: (T, N, I) -> (T, N, H); the T*N x I x G*H projection is one MXU call."""
+    T, N, _ = x.shape
+    H = wh.shape[1]
+    gates_x = (x.reshape(T * N, -1) @ wx.T + bx).reshape(T, N, -1)
+    step = _cell_step(mode, H)
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+
+    def scan_fn(carry, gx):
+        return step(carry, gx, wh, bh)
+
+    carry, ys = lax.scan(scan_fn, carry0, gates_x, reverse=reverse)
+    return ys, carry
+
+
+@register("RNN", arg_names=["data", "parameters", "state", "state_cell"],
+          num_outputs=-1, takes_is_train=True, needs_rng=True,
+          attr_defaults={"state_size": 0, "num_layers": 1,
+                         "bidirectional": False, "mode": "lstm", "p": 0.0,
+                         "state_outputs": False, "lstm_state_clip_min": None,
+                         "lstm_state_clip_max": None})
+def _rnn(key, data, parameters, state, state_cell=None, state_size=0,
+         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+         state_outputs=False, is_train=True, **kw):
+    """data: (T, N, I); state: (L*D, N, H); returns out (T, N, H*D)
+    [+ state_out (+ state_cell_out for lstm) if state_outputs]."""
+    T, N, I = data.shape
+    H = state_size
+    d = 2 if bidirectional else 1
+    g = _GATES[mode]
+    Ws, Bs = _unpack(parameters, num_layers, I, H, d, g)
+    x = data
+    h_finals, c_finals = [], []
+    for l in range(num_layers):
+        outs = []
+        for dd in range(d):
+            wx, wh = Ws[l][dd]
+            bx, bh = Bs[l][dd]
+            h0 = state[l * d + dd]
+            c0 = state_cell[l * d + dd] if mode == "lstm" else None
+            ys, carry = _run_layer(x, h0, c0, wx, wh, bx, bh, mode,
+                                   reverse=(dd == 1))
+            outs.append(ys)
+            h_finals.append(carry[0])
+            if mode == "lstm":
+                c_finals.append(carry[1])
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if is_train and p > 0.0 and l < num_layers - 1:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = x * keep.astype(x.dtype) / (1.0 - p)
+    if not state_outputs:
+        return (x,)
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return x, h_out, jnp.stack(c_finals, axis=0)
+    return x, h_out

@@ -1,0 +1,170 @@
+"""Random sampling ops.
+
+TPU-native equivalent of src/operator/random/sample_op.cc and
+multisample_op.cc.  The reference seeds a per-device PRNG resource
+(src/resource.cc kRandom); here every sampling op is pure and takes an
+explicit counter-derived jax.random key threaded by the dispatch layer, so
+sampling works identically under eager, jit, and pjit (keys are split
+per-device by sharding).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype or "float32")
+
+
+@register("_random_uniform", needs_rng=True, differentiable=False,
+          aliases=("uniform", "random_uniform"),
+          attr_defaults={"low": 0.0, "high": 1.0, "shape": (), "dtype": "float32"})
+def _uniform(key, low=0.0, high=1.0, shape=(), dtype="float32", **kw):
+    return jax.random.uniform(key, _shape(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", needs_rng=True, differentiable=False,
+          aliases=("normal", "random_normal"),
+          attr_defaults={"loc": 0.0, "scale": 1.0, "shape": (), "dtype": "float32"})
+def _normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32", **kw):
+    return jax.random.normal(key, _shape(shape), _dt(dtype)) * scale + loc
+
+
+@register("_random_gamma", needs_rng=True, differentiable=False,
+          aliases=("random_gamma",),
+          attr_defaults={"alpha": 1.0, "beta": 1.0, "shape": (), "dtype": "float32"})
+def _gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32", **kw):
+    return jax.random.gamma(key, alpha, _shape(shape), _dt(dtype)) * beta
+
+
+@register("_random_exponential", needs_rng=True, differentiable=False,
+          aliases=("random_exponential",),
+          attr_defaults={"lam": 1.0, "shape": (), "dtype": "float32"})
+def _exponential(key, lam=1.0, shape=(), dtype="float32", **kw):
+    return jax.random.exponential(key, _shape(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", needs_rng=True, differentiable=False,
+          aliases=("random_poisson",),
+          attr_defaults={"lam": 1.0, "shape": (), "dtype": "float32"})
+def _poisson(key, lam=1.0, shape=(), dtype="float32", **kw):
+    return jax.random.poisson(key, lam, _shape(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", needs_rng=True, differentiable=False,
+          aliases=("random_negative_binomial",),
+          attr_defaults={"k": 1, "p": 1.0, "shape": (), "dtype": "float32"})
+def _neg_binomial(key, k=1, p=1.0, shape=(), dtype="float32", **kw):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True,
+          differentiable=False,
+          aliases=("random_generalized_negative_binomial",),
+          attr_defaults={"mu": 1.0, "alpha": 1.0, "shape": (), "dtype": "float32"})
+def _gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(), dtype="float32", **kw):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, 1.0 / alpha, _shape(shape)) * (alpha * mu)
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))
+
+
+@register("_random_randint", needs_rng=True, differentiable=False,
+          aliases=("random_randint",),
+          attr_defaults={"low": 0, "high": 1, "shape": (), "dtype": "int32"})
+def _randint(key, low=0, high=1, shape=(), dtype="int32", **kw):
+    return jax.random.randint(key, _shape(shape), low, high, _dt(dtype))
+
+
+@register("_sample_multinomial", needs_rng=True, differentiable=False,
+          aliases=("sample_multinomial",), arg_names=["data"],
+          attr_defaults={"shape": (), "get_prob": False, "dtype": "int32"})
+def _multinomial(key, data, shape=(), get_prob=False, dtype="int32", **kw):
+    """reference: random/multisample_op.cc — data rows are probability
+    distributions; draw `shape` samples per row."""
+    n = int(jnp.size(jnp.zeros(_shape(shape)))) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        samp = jax.random.categorical(key, logits, shape=_shape(shape) or ())
+    else:
+        sh = (data.shape[0],) + (_shape(shape) or ())
+        samp = jax.random.categorical(key, logits[:, None, :] if shape else logits,
+                                      axis=-1, shape=sh if shape else (data.shape[0],))
+    out = samp.astype(_dt(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-37)),
+            samp.astype(jnp.int32).reshape(data.shape[0], -1) if data.ndim > 1
+            else samp.reshape(-1)[None], axis=-1)
+        return out, lp.reshape(out.shape).astype(data.dtype)
+    return out
+
+
+def _broadcast_param_sample(key, fn, params, shape):
+    """per-element distribution-parameter sampling (_sample_uniform etc.)"""
+    base = params[0]
+    ex = _shape(shape)
+    out_shape = base.shape + ex
+    return fn(key, [jnp.broadcast_to(p.reshape(p.shape + (1,) * len(ex)), out_shape)
+                    for p in params], out_shape)
+
+
+@register("_sample_uniform", needs_rng=True, differentiable=False,
+          arg_names=["low", "high"],
+          attr_defaults={"shape": (), "dtype": "float32"})
+def _sample_uniform(key, low, high, shape=(), dtype="float32", **kw):
+    return _broadcast_param_sample(
+        key, lambda k, ps, sh: jax.random.uniform(k, sh, _dt(dtype)) *
+        (ps[1] - ps[0]) + ps[0], [low, high], shape)
+
+
+@register("_sample_normal", needs_rng=True, differentiable=False,
+          arg_names=["mu", "sigma"],
+          attr_defaults={"shape": (), "dtype": "float32"})
+def _sample_normal(key, mu, sigma, shape=(), dtype="float32", **kw):
+    return _broadcast_param_sample(
+        key, lambda k, ps, sh: jax.random.normal(k, sh, _dt(dtype)) * ps[1] + ps[0],
+        [mu, sigma], shape)
+
+
+@register("_sample_gamma", needs_rng=True, differentiable=False,
+          arg_names=["alpha", "beta"],
+          attr_defaults={"shape": (), "dtype": "float32"})
+def _sample_gamma(key, alpha, beta, shape=(), dtype="float32", **kw):
+    return _broadcast_param_sample(
+        key, lambda k, ps, sh: jax.random.gamma(k, ps[0]).astype(_dt(dtype)) * ps[1],
+        [alpha, beta], shape)
+
+
+@register("_sample_exponential", needs_rng=True, differentiable=False,
+          arg_names=["lam"], attr_defaults={"shape": (), "dtype": "float32"})
+def _sample_exponential(key, lam, shape=(), dtype="float32", **kw):
+    return _broadcast_param_sample(
+        key, lambda k, ps, sh: jax.random.exponential(k, sh, _dt(dtype)) / ps[0],
+        [lam], shape)
+
+
+@register("_sample_poisson", needs_rng=True, differentiable=False,
+          arg_names=["lam"], attr_defaults={"shape": (), "dtype": "float32"})
+def _sample_poisson(key, lam, shape=(), dtype="float32", **kw):
+    return _broadcast_param_sample(
+        key, lambda k, ps, sh: jax.random.poisson(k, ps[0], sh).astype(_dt(dtype)),
+        [lam], shape)
+
+
+@register("_shuffle", needs_rng=True, differentiable=False,
+          aliases=("shuffle",), arg_names=["data"], attr_defaults={})
+def _shuffle(key, data, **kw):
+    return jax.random.permutation(key, data, axis=0)

@@ -1,0 +1,57 @@
+"""Variable-length sequence ops.
+
+TPU-native equivalent of src/operator/sequence_{mask,last,reverse}.cc — the
+reference's tools for padded variable-length batches (SURVEY.md §5.7).
+Sequence axis is 0 (TNC layout) unless noted, matching the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _len_mask(max_len, lengths, total_dims):
+    # (T, N) boolean mask, True where t < length[n]
+    t = jnp.arange(max_len)[:, None]
+    m = t < lengths[None, :]
+    return m.reshape(m.shape + (1,) * (total_dims - 2))
+
+
+@register("SequenceMask", arg_names=["data", "sequence_length"],
+          attr_defaults={"use_sequence_length": False, "value": 0.0, "axis": 0})
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0, **kw):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    x = jnp.swapaxes(data, 0, axis) if axis != 0 else data
+    mask = _len_mask(x.shape[0], sequence_length.astype(jnp.int32), x.ndim)
+    out = jnp.where(mask, x, jnp.asarray(value, x.dtype))
+    return jnp.swapaxes(out, 0, axis) if axis != 0 else out
+
+
+@register("SequenceLast", arg_names=["data", "sequence_length"],
+          attr_defaults={"use_sequence_length": False, "axis": 0})
+def _sequence_last(data, sequence_length=None, use_sequence_length=False,
+                   axis=0, **kw):
+    x = jnp.swapaxes(data, 0, axis) if axis != 0 else data
+    if not use_sequence_length or sequence_length is None:
+        return x[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)  # (N,)
+    return jnp.take_along_axis(
+        x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", arg_names=["data", "sequence_length"],
+          attr_defaults={"use_sequence_length": False, "axis": 0})
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0, **kw):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    lengths = sequence_length.astype(jnp.int32)  # (N,)
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < lengths[None, :], lengths[None, :] - 1 - t, t)  # (T,N)
+    src = src.reshape((T,) + (src.shape[1],) + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)

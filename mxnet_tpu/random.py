@@ -1,0 +1,41 @@
+"""Global PRNG state.
+
+The reference seeds one PRNG per device via the resource manager
+(src/resource.cc kRandom; python/mxnet/random.py mx.random.seed).  Here the
+global state is a counter over a root jax.random key: every random op draw
+folds the counter in, so eager results are reproducible after
+``mx.random.seed(n)`` while traced graphs receive keys as explicit arguments
+(purity under jit).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.counter = 0
+    return _state
+
+
+def seed(seed_state: int) -> None:
+    """mx.random.seed — reseed the global generator."""
+    s = _get()
+    s.key = jax.random.PRNGKey(int(seed_state))
+    s.counter = 0
+
+
+def next_key():
+    """Fresh key for one op invocation."""
+    s = _get()
+    s.counter += 1
+    return jax.random.fold_in(s.key, s.counter)
+
+
+def split_key(n: int):
+    return jax.random.split(next_key(), n)
