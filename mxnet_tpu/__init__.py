@@ -13,5 +13,13 @@ from . import random
 from . import autograd
 from . import ndarray
 from . import ndarray as nd
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
 
 from .ndarray import NDArray

@@ -1,0 +1,52 @@
+"""Symbol attribute scoping (reference: python/mxnet/attribute.py).
+
+``AttrScope`` attaches user attributes (e.g. ``__ctx_group__``,
+``__lr_mult__``) to every symbol created inside the scope — the mechanism
+the reference's model-parallel examples use for manual placement
+(graph_executor.cc:317-431); here ctx groups map to sharding annotations.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class AttrScope:
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes must be strings")
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr):
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old_scope = AttrScope._current.value
+        attr = AttrScope._current.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        assert self._old_scope
+        AttrScope._current.value = self._old_scope
+
+
+AttrScope._current.value = AttrScope()
+
+
+def current():
+    if not hasattr(AttrScope._current, "value"):
+        AttrScope._current.value = AttrScope()
+    return AttrScope._current.value

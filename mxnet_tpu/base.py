@@ -15,6 +15,13 @@ from typing import Any, Callable, Dict, Optional
 
 __version__ = "0.12.0.tpu0"
 
+# The reference's dtype zoo includes float64 (mshadow DType switch); JAX
+# disables 64-bit types by default.  Enable x64 so mx.nd arrays honor
+# requested dtypes — defaults stay float32 because every creation path in
+# this package passes an explicit dtype.
+import jax as _jax  # noqa: E402
+_jax.config.update("jax_enable_x64", True)
+
 
 class MXNetError(RuntimeError):
     """Default error raised by mxnet_tpu (mirrors mxnet.base.MXNetError)."""

@@ -1,0 +1,402 @@
+"""Executor: jit-compiled forward/backward over a Symbol graph.
+
+TPU-native equivalent of the reference's GraphExecutor
+(src/executor/graph_executor.cc:507 Init → memory planning → cached engine
+ops) and the Python wrapper (python/mxnet/executor.py).  The entire
+reference pipeline — gradient-graph construction (InitFullGraph :253),
+memory planning (PlanMemory :868), op bulking (InitOpSegs :1302) — is
+replaced by ONE idea: the symbol graph is interpreted as a pure jax function
+and jit-compiled; XLA performs buffer assignment, fusion and scheduling.
+
+The fused forward+backward program is differentiated with ``jax.vjp`` (the
+XLA-native Gradient pass).  ``forward`` is *lazy*: outputs materialize on
+first read, and a training step that calls forward→backward executes as a
+single XLA program — the analog (and superset) of the reference's bulked
+segment execution.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from . import random as _rnd
+from .ndarray import NDArray
+from .ndarray.ndarray import zeros as nd_zeros
+from .ops import registry as _reg
+from .symbol.symbol import Symbol, node_num_outputs, _topo_sort
+
+
+def build_interpreter(sym: Symbol):
+    """Build ``run(arg_vals, aux_vals, key, is_train) -> (outs, new_aux)``.
+
+    The returned function is pure — jit/vjp/vmap-compatible.  RNG ops get
+    per-node subkeys split from ``key`` (replacement for the reference's
+    per-device PRNG resource, src/resource.cc kRandom).
+    """
+    nodes = _topo_sort(sym.heads)
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    arg_pos = {n: i for i, n in enumerate(arg_names)}
+    aux_pos = {n: i for i, n in enumerate(aux_names)}
+    heads = sym.heads
+    rng_ids = [id(n) for n in nodes
+               if not n.is_variable and _reg.get(n.op).needs_rng]
+    rng_index = {nid: i for i, nid in enumerate(rng_ids)}
+
+    def run(arg_vals, aux_vals, key, is_train, _collect=None):
+        env = {}
+        new_aux = list(aux_vals)
+        if rng_ids:
+            keys = jax.random.split(key, len(rng_ids))
+        for n in nodes:
+            if n.is_variable:
+                if n.name in arg_pos:
+                    env[(id(n), 0)] = arg_vals[arg_pos[n.name]]
+                else:
+                    env[(id(n), 0)] = aux_vals[aux_pos[n.name]]
+                continue
+            opdef = _reg.get(n.op)
+            ins = [env[(id(src), i)] for src, i in n.inputs]
+            kwargs = dict(n.attrs)
+            kwargs.pop("name", None)
+            if opdef.takes_is_train:
+                kwargs["is_train"] = is_train
+            if opdef.needs_rng:
+                outs = opdef.fn(keys[rng_index[id(n)]], *ins, **kwargs)
+            else:
+                outs = opdef.fn(*ins, **kwargs)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            if opdef.num_aux and opdef.takes_is_train and is_train:
+                updates = outs[-opdef.num_aux:]
+                outs = outs[:-opdef.num_aux]
+                aux_inputs = n.inputs[-opdef.num_aux:]
+                for (src, _), u in zip(aux_inputs, updates):
+                    if src.is_variable and src.name in aux_pos:
+                        new_aux[aux_pos[src.name]] = u
+            for i, o in enumerate(outs):
+                env[(id(n), i)] = o
+            if _collect is not None:
+                _collect(n, outs[:node_num_outputs(n)])
+        out_vals = tuple(env[(id(h), i)] for h, i in heads)
+        return out_vals, tuple(new_aux)
+
+    return run, arg_names, aux_names
+
+
+class Executor:
+    """reference: include/mxnet/executor.h:52; python/mxnet/executor.py."""
+
+    def __init__(self, symbol: Symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None, group2ctx=None,
+                 shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        run, arg_names, aux_names = build_interpreter(symbol)
+        self._run = run
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self.arg_arrays = self._canon_arrays(args, arg_names, "args")
+        self.aux_arrays = self._canon_arrays(aux_states, aux_names,
+                                             "aux_states", allow_empty=True)
+        self.grad_req = self._canon_grad_req(grad_req)
+        self.grad_arrays = self._canon_grads(args_grad)
+        self._monitor_callback = None
+        self._monitor_all = False
+
+        self._out_arrays: Optional[List[NDArray]] = None
+        self._snapshot = None
+        self._is_train = False
+        self._last_key = None
+
+        self._jit_fwd = jax.jit(
+            lambda a, x, k, t: run(a, x, k, t), static_argnums=(3,))
+        self._jit_fwd_bwd = jax.jit(self._fused_fwd_bwd)
+
+    # ------------------------------------------------------------------
+    def _canon_arrays(self, arrays, names, what, allow_empty=False):
+        if arrays is None:
+            if allow_empty and not names:
+                return []
+            raise MXNetError(f"bind: {what} must be provided (or use "
+                             f"simple_bind)")
+        if isinstance(arrays, dict):
+            missing = [n for n in names if n not in arrays]
+            if missing:
+                raise MXNetError(f"bind: missing {what}: {missing}")
+            return [arrays[n] for n in names]
+        arrays = list(arrays)
+        if len(arrays) != len(names):
+            raise MXNetError(f"bind: expected {len(names)} {what}, "
+                             f"got {len(arrays)}")
+        return arrays
+
+    def _canon_grad_req(self, grad_req):
+        names = self._arg_names
+        if isinstance(grad_req, str):
+            return {n: grad_req for n in names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(names, grad_req))
+        if isinstance(grad_req, dict):
+            return {n: grad_req.get(n, "null") for n in names}
+        raise TypeError(type(grad_req))
+
+    def _canon_grads(self, args_grad):
+        names = self._arg_names
+        if args_grad is None:
+            return [None] * len(names)
+        if isinstance(args_grad, dict):
+            return [args_grad.get(n) for n in names]
+        args_grad = list(args_grad)
+        if len(args_grad) != len(names):
+            raise MXNetError("bind: args_grad length mismatch")
+        return args_grad
+
+    # -- dict views (reference: executor.py arg_dict etc.) --------------
+    @property
+    def arg_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def simple_bind(cls, symbol: Symbol, ctx=None, grad_req="write",
+                    type_dict=None, shared_exec=None, shapes=None):
+        """reference: MXExecutorSimpleBind (c_api_executor.cc:219) —
+        infer all shapes from the provided input shapes, allocate arg/grad/aux
+        arrays, return a bound executor."""
+        shapes = shapes or {}
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = [nd_zeros(s, dtype=type_dict.get(n, "float32"))
+                for n, s in zip(arg_names, arg_shapes)]
+        aux = [nd_zeros(s, dtype=type_dict.get(n, "float32"))
+               for n, s in zip(aux_names, aux_shapes)]
+        ex = cls(symbol, ctx, args=args, grad_req=grad_req, aux_states=aux)
+        ex.grad_arrays = [
+            nd_zeros(s, dtype=type_dict.get(n, "float32"))
+            if ex.grad_req[n] != "null" else None
+            for n, s in zip(arg_names, arg_shapes)]
+        return ex
+
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Lazy forward: argument *values* are captured now; outputs
+        materialize on first read — and if ``backward`` runs first,
+        forward+backward fuse into ONE XLA program (replacing the
+        reference's op bulking, graph_executor.cc:1302)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+            pos = self._arg_names.index(k)
+            if isinstance(v, NDArray):
+                self.arg_arrays[pos]._set_data(v._data)
+            else:
+                self.arg_arrays[pos]._set_data(jnp.asarray(v))
+        self._is_train = is_train
+        self._last_key = _rnd.next_key()
+        # snapshot the input values: later arg mutation (or a second
+        # forward) must not change what THIS forward's outputs resolve to
+        snapshot = (self._arg_vals(), self._aux_vals(), self._last_key,
+                    is_train)
+        self._snapshot = snapshot
+        out_avals = self._out_aval_list(is_train)
+        out_arrays = [NDArray.__new__(NDArray) for _ in out_avals]
+        self._out_arrays = out_arrays
+
+        def thunk():
+            self._materialize(snapshot, out_arrays)
+
+        for oa, av in zip(out_arrays, out_avals):
+            oa._handle = object()
+            oa._ctx = None
+            oa._grad = None
+            oa._grad_req = "null"
+            oa._payload = None
+            oa._set_lazy(thunk, aval=av)
+        if self._monitor_callback is not None:
+            self._materialize(snapshot, out_arrays, monitor=True)
+        return self._out_arrays
+
+    @property
+    def outputs(self) -> List[NDArray]:
+        if self._out_arrays is None:
+            self.forward(self._is_train)
+        return self._out_arrays
+
+    def _arg_vals(self):
+        return tuple(a._data for a in self.arg_arrays)
+
+    def _aux_vals(self):
+        return tuple(a._data for a in self.aux_arrays)
+
+    def _out_aval_list(self, is_train):
+        cache = getattr(self, "_aval_cache", None)
+        if cache is None:
+            cache = self._aval_cache = {}
+        sig = (tuple((a.shape, str(a.dtype)) for a in self.arg_arrays),
+               is_train)
+        if sig not in cache:
+            dummy = jax.random.PRNGKey(0)
+            cache[sig] = list(jax.eval_shape(
+                lambda a, x, k: self._run(a, x, k, is_train),
+                self._arg_vals(), self._aux_vals(), dummy)[0])
+        return cache[sig]
+
+    def _materialize(self, snapshot, out_arrays, monitor=False):
+        arg_vals, aux_vals, key, is_train = snapshot
+        if monitor:
+            collected = []
+            outs, new_aux = self._run(arg_vals, aux_vals, key, is_train,
+                                      _collect=lambda n, os: collected.append(
+                                          (n, os)))
+            cb = self._monitor_callback
+            for n, os in collected:
+                for i, o in enumerate(os):
+                    nm = (n.name + "_output" if len(os) == 1
+                          else f"{n.name}_output{i}")
+                    cb(nm, NDArray(o))
+        else:
+            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key, is_train)
+        for oa, v in zip(out_arrays, outs):
+            oa._set_data(v)
+        if is_train and snapshot is self._snapshot:
+            for a, v in zip(self.aux_arrays, new_aux):
+                a._set_data(v)
+
+    # ------------------------------------------------------------------
+    def _fused_fwd_bwd(self, arg_vals, aux_vals, key, cotangents,
+                       grad_mask=None):
+        """One XLA program: forward + vjp backward (+ aux updates)."""
+        run = self._run
+
+        def f(av):
+            outs, new_aux = run(av, aux_vals, key, True)
+            diff = tuple(o for o in outs
+                         if jnp.issubdtype(o.dtype, jnp.inexact))
+            return diff, (outs, new_aux)
+
+        diff, vjp_fn, (outs, new_aux) = jax.vjp(f, arg_vals, has_aux=True)
+        grads = vjp_fn(tuple(cotangents))[0]
+        need = tuple(g if self.grad_req[n] != "null" else None
+                     for n, g in zip(self._arg_names, grads))
+        return outs, new_aux, need
+
+    def backward(self, out_grads=None, is_train=True):
+        """Run the fused fwd+bwd program; write gradients per grad_req
+        (reference: GraphExecutor::Backward, graph_executor.cc:93)."""
+        if not any(r != "null" for r in self.grad_req.values()):
+            raise MXNetError("backward: no gradients required "
+                             "(all grad_req are null)")
+        snapshot = getattr(self, "_snapshot", None)
+        if snapshot is not None:
+            arg_vals, aux_vals, key, _ = snapshot
+        else:
+            arg_vals, aux_vals = self._arg_vals(), self._aux_vals()
+            key = self._last_key if self._last_key is not None \
+                else _rnd.next_key()
+        out_avals = self._out_aval_list(True)
+        diff_avals = [o for o in out_avals
+                      if jnp.issubdtype(o.dtype, jnp.inexact)]
+        if out_grads is None:
+            cts = tuple(jnp.ones(o.shape, o.dtype) for o in diff_avals)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            vals = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                    for g in out_grads]
+            diff_idx = [i for i, o in enumerate(out_avals)
+                        if jnp.issubdtype(o.dtype, jnp.inexact)]
+            cts = tuple(vals[i] for i in diff_idx)
+        outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals, key, cts)
+        if self._out_arrays is None:
+            self._out_arrays = [NDArray(o) for o in outs]
+        else:
+            for oa, v in zip(self._out_arrays, outs):
+                oa._set_data(v)
+        for a, v in zip(self.aux_arrays, new_aux):
+            a._set_data(v)
+        for name, garr, g in zip(self._arg_names, self.grad_arrays, grads):
+            req = self.grad_req[name]
+            if req == "null" or g is None:
+                continue
+            if garr is None:
+                continue
+            if req == "add":
+                garr._set_data(garr._data + g)
+            else:
+                garr._set_data(g)
+
+    # ------------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """reference: executor.py copy_params_from."""
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    arr._data if isinstance(arr, NDArray)
+                    else jnp.asarray(arr))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument {name!r}")
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(
+                        arr._data if isinstance(arr, NDArray)
+                        else jnp.asarray(arr))
+                elif not allow_extra_params:
+                    raise MXNetError(f"unknown aux state {name!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new input shapes (jit recompiles per shape —
+        reference: executor.py reshape)."""
+        shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+        shapes.update({k: tuple(v) for k, v in kwargs.items()})
+        new = Executor.simple_bind(self._symbol, self._ctx,
+                                   grad_req=self.grad_req, shapes=shapes)
+        for n, a in self.arg_dict.items():
+            if n not in kwargs and n in new.arg_dict:
+                if new.arg_dict[n].shape == a.shape:
+                    new.arg_dict[n]._set_data(a._data)
+        for n, a in self.aux_dict.items():
+            if n in new.aux_dict and new.aux_dict[n].shape == a.shape:
+                new.aux_dict[n]._set_data(a._data)
+        return new
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """reference: GraphExecutor::SetMonitorCallback
+        (graph_executor.cc:120) — per-output stats for mx.mon.Monitor."""
+        self._monitor_callback = callback
+        self._monitor_all = monitor_all
+
+    def debug_str(self):
+        lines = [f"Symbol outputs: {self._symbol.list_outputs()}"]
+        for n in self._symbol.nodes():
+            if n.is_variable:
+                lines.append(f"Variable:{n.name}")
+            else:
+                lines.append(f"Op:{n.op}, Name={n.name}")
+        return "\n".join(lines)

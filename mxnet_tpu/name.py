@@ -1,0 +1,58 @@
+"""Automatic symbol naming (reference: python/mxnet/name.py).
+
+``NameManager`` assigns ``{op}{counter}`` names to anonymous symbols;
+``Prefix`` prepends a scope prefix — both are context managers, same
+semantics as the reference.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class NameManager:
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._current, "value"):
+            NameManager._current.value = NameManager()
+        self._old_manager = NameManager._current.value
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        assert self._old_manager
+        NameManager._current.value = self._old_manager
+
+
+class Prefix(NameManager):
+    """Prepend a prefix to all names created inside the scope."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+NameManager._current.value = NameManager()
+
+
+def current():
+    if not hasattr(NameManager._current, "value"):
+        NameManager._current.value = NameManager()
+    return NameManager._current.value

@@ -34,12 +34,13 @@ def _default_dtype():
 
 
 class NDArray:
-    __slots__ = ("_data", "_handle", "_ctx", "_grad", "_grad_req",
-                 "_deferred_init", "__weakref__")
+    __slots__ = ("_payload", "_thunk", "_handle", "_ctx", "_grad",
+                 "_grad_req", "_deferred_init", "__weakref__")
     # make NumPy defer to our reflected operators (a + nd works)
     __array_priority__ = 1000.0
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        self._thunk = None
         if isinstance(data, NDArray):
             data = data._data
         if not isinstance(data, jax.Array):
@@ -54,11 +55,33 @@ class NDArray:
                 data = jnp.asarray(data)
         elif dtype is not None and data.dtype != jnp.dtype(dtype):
             data = data.astype(jnp.dtype(dtype))
-        self._data = data
+        self._payload = data
         self._handle = object()
         self._ctx = ctx
         self._grad = None
         self._grad_req = "null"
+
+    # -- lazy payload (engine-style deferred execution) ---------------------
+    # An executor may hand out output handles whose value is produced by a
+    # not-yet-dispatched fused XLA program (reference analog: engine vars
+    # whose value exists only after the pushed opr completes).  Reading
+    # ``_data`` forces the producer; ``_set_data`` fulfils it.
+    @property
+    def _data(self):
+        if self._thunk is not None:
+            thunk, self._thunk = self._thunk, None
+            thunk()  # expected to _set_data on this (and sibling) arrays
+        return self._payload
+
+    @_data.setter
+    def _data(self, value):
+        self._payload = value
+        self._thunk = None
+
+    def _set_lazy(self, thunk, aval=None):
+        self._thunk = thunk
+        if aval is not None:
+            self._payload = aval  # ShapeDtypeStruct placeholder for .shape
 
     # -- engine sync points (reference: NDArray::WaitToRead/WaitToWrite) ----
     def wait_to_read(self):
@@ -67,30 +90,30 @@ class NDArray:
 
     wait_to_write = wait_to_read
 
-    # -- basic properties ---------------------------------------------------
+    # -- basic properties (read the placeholder aval, never force) ----------
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        return tuple(self._payload.shape)
 
     @property
     def dtype(self):
-        return np.dtype(str(self._data.dtype)) if self._data.dtype != jnp.bfloat16 \
-            else self._data.dtype
+        return np.dtype(str(self._payload.dtype)) \
+            if self._payload.dtype != jnp.bfloat16 else self._payload.dtype
 
     @property
     def size(self):
-        return int(self._data.size)
+        return int(np.prod(self._payload.shape)) if self._payload.shape else 1
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self._payload.shape)
 
     @property
     def context(self) -> Context:
         if self._ctx is not None:
             return self._ctx
         try:
-            dev = list(self._data.devices())[0]
+            dev = list(self._payload.devices())[0]
             return Context("cpu" if dev.platform == "cpu" else "tpu", dev.id)
         except Exception:
             return cpu()

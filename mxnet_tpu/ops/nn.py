@@ -11,6 +11,8 @@ XLA's layout assignment transposes internally to the TPU-preferred layout.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -364,7 +366,7 @@ def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
     return out
 
 
-@jax.custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
                          multi_output, normalization, smooth_alpha):
     return _softmax_output_fwd(data, label, grad_scale, ignore_label,
@@ -377,13 +379,13 @@ def _softmax_output_vjp_fwd(data, label, grad_scale, ignore_label, use_ignore,
     out = _softmax_output_fwd(data, label, grad_scale, ignore_label,
                               use_ignore, multi_output, normalization,
                               smooth_alpha)
-    return out, (out, label, grad_scale, ignore_label, use_ignore,
-                 multi_output, normalization, smooth_alpha)
+    return out, (out, label)
 
 
-def _softmax_output_vjp_bwd(res, g):
-    (out, label, grad_scale, ignore_label, use_ignore, multi_output,
-     normalization, smooth_alpha) = res
+def _softmax_output_vjp_bwd(grad_scale, ignore_label, use_ignore,
+                            multi_output, normalization, smooth_alpha,
+                            res, g):
+    (out, label) = res
     axis = 1 if multi_output else -1
     nclass = out.shape[axis]
     if label.ndim == out.ndim:
@@ -408,7 +410,7 @@ def _softmax_output_vjp_bwd(res, g):
                 out.dtype)
         grad = grad / valid
     grad = grad * grad_scale
-    return (grad, jnp.zeros_like(label), None, None, None, None, None, None)
+    return (grad, jnp.zeros_like(label))
 
 
 _softmax_output_core.defvjp(_softmax_output_vjp_fwd, _softmax_output_vjp_bwd)
@@ -433,20 +435,18 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
 
 def _make_regression_output(name, link, grad_fn):
-    @jax.custom_vjp
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
     def core(data, label, grad_scale):
         return link(data)
 
     def fwd(data, label, grad_scale):
         out = link(data)
-        return out, (out, label, grad_scale)
+        return out, (out, label)
 
-    def bwd(res, g):
-        out, label, grad_scale = res
-        label = label.reshape(out.shape)
-        grad = grad_fn(out, label) * grad_scale / out.shape[0] * out.shape[0]
-        # MXNet normalizes by num outputs per batch implicitly via grad_scale
-        return (grad * 1.0 / 1.0, jnp.zeros_like(label), None)
+    def bwd(grad_scale, res, g):
+        out, label = res
+        grad = grad_fn(out, label.reshape(out.shape)) * grad_scale
+        return (grad, jnp.zeros_like(label))
 
     core.defvjp(fwd, bwd)
 

@@ -1,0 +1,6 @@
+"""Symbolic API (``mx.sym`` / ``mx.symbol``)."""
+from .symbol import (Symbol, Node, Variable, var, Group, load, load_json,
+                     zeros, ones, arange)
+from .register import init_symbol_module
+
+init_symbol_module(globals())

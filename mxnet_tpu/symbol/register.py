@@ -1,0 +1,59 @@
+"""Autogenerate the ``mx.sym.*`` namespace from the op registry.
+
+Mirror of the reference's symbol wrapper codegen
+(python/mxnet/symbol/register.py; C side MXSymbolCreateAtomicSymbol +
+Compose, src/c_api/c_api_symbolic.cc).  Shares the single OpDef registry
+with the NDArray frontend — one registration path serves both (SURVEY.md §7
+design stance).
+"""
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _compose, _skip_args
+
+
+def make_sym_func(opdef: _reg.OpDef, name: str):
+    def sym_func(*args, **kwargs):
+        sym_name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        if len(args) == 1 and isinstance(args[0], (list, tuple)) and opdef.variadic:
+            args = tuple(args[0])
+        if opdef.variadic:
+            inputs = [a for a in args if isinstance(a, Symbol)]
+            attrs = {k: v for k, v in kwargs.items()
+                     if not isinstance(v, Symbol)}
+            inputs += [v for v in kwargs.values() if isinstance(v, Symbol)]
+            return _compose(opdef.name, inputs, attrs, sym_name)
+        arg_names = list(opdef.arg_names or [])
+        aux_names = list(opdef.aux_names or [])
+        attrs = {}
+        supplied = {}
+        for k in list(kwargs):
+            if isinstance(kwargs[k], Symbol):
+                supplied[k] = kwargs.pop(k)
+            else:
+                attrs[k] = kwargs[k]
+        skip = _skip_args(opdef.name, attrs)
+        wanted = [a for a in arg_names + aux_names if a not in skip]
+        pos = list(args)
+        inputs = []
+        for nm in wanted:
+            if nm in supplied:
+                inputs.append(supplied.pop(nm))
+            elif pos:
+                inputs.append(pos.pop(0))
+            else:
+                break  # remaining become auto-created variables in _compose
+        inputs.extend(pos)
+        return _compose(opdef.name, inputs, attrs, sym_name)
+
+    sym_func.__name__ = name
+    sym_func.__doc__ = (opdef.doc or "") + \
+        f"\n\n(auto-generated symbol wrapper for registered op {opdef.name!r})"
+    return sym_func
+
+
+def init_symbol_module(namespace: dict):
+    for name in _reg.list_ops():
+        opdef = _reg.get(name)
+        namespace.setdefault(name, make_sym_func(opdef, name))

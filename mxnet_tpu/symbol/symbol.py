@@ -1,0 +1,816 @@
+"""Symbol: the declarative graph frontend.
+
+TPU-native equivalent of the reference's nnvm ``Symbol``/``Graph``
+(python/mxnet/symbol/symbol.py; nnvm op graph built by
+src/c_api/c_api_symbolic.cc).  A Symbol is a list of (node, output-index)
+heads over a DAG of ``Node`` objects.  Unlike the reference there is no
+C++ graph IR — the graph *is* the trace program: binding a symbol builds a
+pure jax function that an :class:`~mxnet_tpu.executor.Executor` jit-compiles
+(the XLA-native replacement for GraphExecutor's memory planning / op bulking,
+src/executor/graph_executor.cc:507-1456 — XLA buffer assignment and fusion
+subsume both).
+
+Graph JSON save/load mirrors the nnvm JSON layout (nodes / arg_nodes /
+heads — nnvm SaveJSON as used by mx.model.save_checkpoint, model.py:340) so
+checkpoints remain structurally familiar.
+"""
+from __future__ import annotations
+
+import json
+import numbers
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import name as _name
+from .. import attribute as _attribute
+from ..ops import registry as _reg
+
+
+class Node:
+    """One graph node: an op application or (op=None) a variable."""
+    __slots__ = ("op", "name", "attrs", "inputs", "_user_attrs")
+
+    def __init__(self, op: Optional[str], name: str, attrs: dict,
+                 inputs: List[Tuple["Node", int]], user_attrs=None):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self._user_attrs = dict(user_attrs or {})
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def opdef(self) -> Optional[_reg.OpDef]:
+        return _reg.get(self.op) if self.op else None
+
+    def num_outputs(self) -> int:
+        return node_num_outputs(self)
+
+
+def node_num_outputs(node: Node) -> int:
+    if node.op is None:
+        return 1
+    opdef = _reg.get(node.op)
+    n = opdef.num_visible if opdef.num_visible is not None else opdef.num_outputs
+    if n == -1:
+        # attr-dependent output count (reference: SliceChannel num_outputs)
+        if node.op in ("SliceChannel", "split"):
+            return int(node.attrs.get("num_outputs", 1))
+        if node.op == "topk":
+            return 2 if node.attrs.get("ret_typ", "indices") == "both" else 1
+        if node.op == "RNN":
+            return 3 if node.attrs.get("state_outputs") else 1
+        return 1
+    return n
+
+
+def _topo_sort(heads: Sequence[Tuple[Node, int]]) -> List[Node]:
+    order: List[Node] = []
+    visited = set()
+
+    def visit(node):
+        stack = [(node, False)]
+        while stack:
+            n, processed = stack.pop()
+            if processed:
+                order.append(n)
+                continue
+            if id(n) in visited:
+                continue
+            visited.add(id(n))
+            stack.append((n, True))
+            for inp, _ in reversed(n.inputs):
+                if id(inp) not in visited:
+                    stack.append((inp, False))
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+# ---------------------------------------------------------------------------
+# parameter-shape inference hooks (reference: per-op InferShape filling
+# unknown arg shapes, src/executor/infer_graph_attr_pass.cc:368; e.g.
+# FullyConnectedProp::InferShape derives weight from data × num_hidden)
+# ---------------------------------------------------------------------------
+def _fc_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")
+    if data is None:
+        return {}
+    nh = int(attrs.get("num_hidden", 0))
+    flatten = attrs.get("flatten", True)
+    in_dim = int(np.prod(data[1:])) if flatten else data[-1]
+    out = {"weight": (nh, in_dim)}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nh,)
+    return out
+
+
+def _conv_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")
+    if data is None:
+        return {}
+    kernel = tuple(int(k) for k in attrs.get("kernel", ()))
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    cin = data[1]
+    out = {"weight": (nf, cin // ng) + kernel}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _deconv_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")
+    if data is None:
+        return {}
+    kernel = tuple(int(k) for k in attrs.get("kernel", ()))
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    cin = data[1]
+    out = {"weight": (cin, nf // ng) + kernel}
+    if not attrs.get("no_bias", True):
+        out["bias"] = (nf,)
+    return out
+
+
+def _bn_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")
+    if data is None:
+        return {}
+    ax = int(attrs.get("axis", 1)) % len(data)
+    c = data[ax]
+    return {"gamma": (c,), "beta": (c,),
+            "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _in_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")
+    if data is None:
+        return {}
+    return {"gamma": (data[1],), "beta": (data[1],)}
+
+
+def _ln_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")
+    if data is None:
+        return {}
+    ax = int(attrs.get("axis", -1)) % len(data)
+    return {"gamma": (data[ax],), "beta": (data[ax],)}
+
+
+def _embedding_param_shapes(attrs, in_shapes):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _prelu_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")
+    if data is None or attrs.get("act_type", "leaky") != "prelu":
+        return {}
+    return {"gamma": (data[1] if len(data) > 1 else 1,)}
+
+
+def _rnn_param_shapes(attrs, in_shapes):
+    data = in_shapes.get("data")  # (seq, batch, input)
+    if data is None:
+        return {}
+    from ..ops.rnn import rnn_param_size
+    mode = attrs.get("mode", "lstm")
+    sh = int(attrs["state_size"])
+    nl = int(attrs.get("num_layers", 1))
+    bidir = bool(attrs.get("bidirectional", False))
+    d = 2 if bidir else 1
+    psize = rnn_param_size(nl, data[2], sh, bidir, mode)
+    shapes = {"parameters": (psize,),
+              "state": (nl * d, data[1], sh)}
+    if mode == "lstm":
+        shapes["state_cell"] = (nl * d, data[1], sh)
+    return shapes
+
+
+PARAM_SHAPE_INFER = {
+    "FullyConnected": _fc_param_shapes,
+    "Convolution": _conv_param_shapes,
+    "Deconvolution": _deconv_param_shapes,
+    "BatchNorm": _bn_param_shapes,
+    "InstanceNorm": _in_param_shapes,
+    "LayerNorm": _ln_param_shapes,
+    "L2Normalization": lambda a, s: {},
+    "Embedding": _embedding_param_shapes,
+    "LeakyReLU": _prelu_param_shapes,
+    "RNN": _rnn_param_shapes,
+}
+
+# args skipped at composition time depending on attrs (reference: each op's
+# ListArguments respects flags like no_bias)
+def _skip_args(op: str, attrs: dict) -> set:
+    skip = set()
+    opdef = _reg.find(op)
+    no_bias_default = (opdef.attr_defaults.get("no_bias", False)
+                       if opdef else False)
+    if attrs.get("no_bias", no_bias_default) in (True, "True", "true", 1):
+        skip.add("bias")
+    if op == "LeakyReLU" and attrs.get("act_type", "leaky") != "prelu":
+        skip.add("gamma")
+    if op == "RNN" and attrs.get("mode", "lstm") != "lstm":
+        skip.add("state_cell")
+    return skip
+
+
+class Symbol:
+    """A list of output heads over the op DAG (reference Symbol semantics)."""
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads: List[Tuple[Node, int]]):
+        self._heads = list(heads)
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def __repr__(self):
+        names = ", ".join(n.name for n, _ in self._heads)
+        return f"<Symbol {names}>"
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def __len__(self):
+        return sum(node_num_outputs(n) if i is None else 1
+                   for n, i in self._heads)
+
+    # -- composition helpers ------------------------------------------------
+    def _single_head(self) -> Tuple[Node, int]:
+        if len(self._heads) != 1:
+            raise MXNetError("operation requires a single-output symbol")
+        return self._heads[0]
+
+    def __getitem__(self, index):
+        outputs = self._expanded_heads()
+        if isinstance(index, str):
+            names = self.list_outputs()
+            matches = [i for i, n in enumerate(names)
+                       if n == index or n == index + "_output"]
+            if not matches:
+                raise ValueError(f"no output named {index!r}")
+            return Symbol([outputs[matches[0]]])
+        if isinstance(index, slice):
+            return Symbol(outputs[index])
+        return Symbol([outputs[index]])
+
+    def _expanded_heads(self) -> List[Tuple[Node, int]]:
+        out = []
+        for node, idx in self._heads:
+            if idx is None:
+                for i in range(node_num_outputs(node)):
+                    out.append((node, i))
+            else:
+                out.append((node, idx))
+        return out
+
+    @property
+    def heads(self):
+        return self._expanded_heads()
+
+    # -- graph introspection ------------------------------------------------
+    def nodes(self) -> List[Node]:
+        return _topo_sort(self._expanded_heads())
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self.nodes()
+                if n.is_variable and not n._user_attrs.get("__is_aux__")]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._expanded_heads():
+            if node.is_variable:
+                names.append(node.name)
+            elif node_num_outputs(node) == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append(f"{node.name}_output{idx}")
+        return names
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self.nodes()
+                if n.is_variable and n._user_attrs.get("__is_aux__")]
+
+    def list_inputs(self):
+        return [n.name for n in self.nodes() if n.is_variable]
+
+    def get_internals(self) -> "Symbol":
+        heads = []
+        for n in self.nodes():
+            for i in range(node_num_outputs(n)):
+                heads.append((n, i))
+        return Symbol(heads)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node, _ = self._single_head()
+        if not node.inputs:
+            return None
+        return Symbol([(n, i) for n, i in node.inputs])
+
+    # -- attributes ---------------------------------------------------------
+    def attr(self, key):
+        node, _ = self._single_head()
+        return node._user_attrs.get(key)
+
+    def list_attr(self):
+        node, _ = self._single_head()
+        return {k: v for k, v in node._user_attrs.items()
+                if not k.startswith("__is_aux")}
+
+    def attr_dict(self):
+        out = {}
+        for n in self.nodes():
+            attrs = {k: v for k, v in n._user_attrs.items()
+                     if not k.startswith("__is_aux")}
+            attrs.update({k: str(v) for k, v in n.attrs.items()})
+            if attrs:
+                out[n.name] = attrs
+        return out
+
+    def _set_attr(self, **kwargs):
+        node, _ = self._single_head()
+        node._user_attrs.update(kwargs)
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(f"infer_shape error: {e}")
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(True, *args, **kwargs)
+        except Exception:
+            n_args = len(self.list_arguments())
+            return ([None] * n_args, None, None)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, tuple] = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, dtypes = _infer_graph_shapes(self, known, {})
+        aux_names = self.list_auxiliary_states()
+        out_shapes = shapes["__outputs__"]
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        if not partial and any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError(f"infer_shape: cannot infer shapes for {missing}")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, np.dtype] = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                if t is not None:
+                    known[n] = np.dtype(t)
+        known.update({k: np.dtype(v) for k, v in kwargs.items()
+                      if v is not None})
+        # propagate: any explicitly-known dtype becomes the default for all
+        # unspecified inputs (the reference's InferType forward/backward
+        # propagation collapses to this for homogeneous-dtype graphs)
+        default = next(iter(known.values()), np.dtype("float32"))
+        all_known = dict(known)
+        for n in arg_names + self.list_auxiliary_states():
+            all_known.setdefault(n, default)
+        _, dtypes = _infer_graph_shapes(self, {}, all_known,
+                                        shapes_optional=True,
+                                        dummy_shapes=True)
+        arg_types = [dtypes.get(n, default) for n in arg_names]
+        aux_types = [dtypes.get(n, default)
+                     for n in self.list_auxiliary_states()]
+        out_types = dtypes.get("__outputs__",
+                               [default] * len(self.list_outputs()))
+        return arg_types, out_types, aux_types
+
+    # -- save/load ----------------------------------------------------------
+    def tojson(self):
+        nodes = self.nodes()
+        node_index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jn = {
+                "op": n.op if n.op else "null",
+                "name": n.name,
+                "inputs": [[node_index[id(src)], idx, 0]
+                           for src, idx in n.inputs],
+            }
+            attrs = {k: _attr_to_str(v) for k, v in n.attrs.items()}
+            attrs.update({k: str(v) for k, v in n._user_attrs.items()})
+            if attrs:
+                jn["attrs"] = attrs
+            jnodes.append(jn)
+        heads = [[node_index[id(n)], i, 0] for n, i in self._expanded_heads()]
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 1200]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binop(self, other, op, scalar_op, rop=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if rop else (self, other)
+            return _compose(op, [a, b], {}, None)
+        if isinstance(other, numbers.Number):
+            return _compose(scalar_op, [self], {"scalar": float(other)}, None)
+        return NotImplemented
+
+    def __add__(self, o): return self._binop(o, "broadcast_add", "_plus_scalar")
+    __radd__ = __add__
+    def __sub__(self, o): return self._binop(o, "broadcast_sub", "_minus_scalar")
+    def __rsub__(self, o): return self._binop(o, "broadcast_sub", "_rminus_scalar", rop=True)
+    def __mul__(self, o): return self._binop(o, "broadcast_mul", "_mul_scalar")
+    __rmul__ = __mul__
+    def __truediv__(self, o): return self._binop(o, "broadcast_div", "_div_scalar")
+    def __rtruediv__(self, o): return self._binop(o, "broadcast_div", "_rdiv_scalar", rop=True)
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+    def __pow__(self, o): return self._binop(o, "broadcast_power", "_power_scalar")
+    def __mod__(self, o): return self._binop(o, "broadcast_mod", "_mod_scalar")
+    def __neg__(self): return _compose("negative", [self], {}, None)
+    def __eq__(self, o): return self._binop(o, "broadcast_equal", "_equal_scalar")
+    def __ne__(self, o): return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+    def __gt__(self, o): return self._binop(o, "broadcast_greater", "_greater_scalar")
+    def __ge__(self, o): return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+    def __lt__(self, o): return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+    def __le__(self, o): return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __copy__(self):
+        return Symbol(list(self._heads))
+
+    def __deepcopy__(self, memo):
+        # graph nodes are immutable-by-convention; sharing is safe
+        return Symbol(list(self._heads))
+
+    # -- convenience method mirrors (subset used by models/tests) -----------
+    def reshape(self, *shape, **kw):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _compose("Reshape", [self], {"shape": shape, **kw}, None)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _compose("transpose", [self], {"axes": axes}, None)
+
+    def astype(self, dtype):
+        return _compose("Cast", [self], {"dtype": np.dtype(dtype).name}, None)
+
+    def sum(self, axis=None, keepdims=False):
+        return _compose("sum", [self], {"axis": axis, "keepdims": keepdims}, None)
+
+    def mean(self, axis=None, keepdims=False):
+        return _compose("mean", [self], {"axis": axis, "keepdims": keepdims}, None)
+
+    def flatten(self):
+        return _compose("Flatten", [self], {}, None)
+
+    def slice_axis(self, axis, begin, end):
+        return _compose("slice_axis", [self],
+                        {"axis": axis, "begin": begin, "end": end}, None)
+
+    def expand_dims(self, axis):
+        return _compose("expand_dims", [self], {"axis": axis}, None)
+
+    def softmax(self, axis=-1):
+        return _compose("softmax", [self], {"axis": axis}, None)
+
+    # -- evaluation / binding ----------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        from ..executor import Executor
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict,
+                                    shared_exec=shared_exec,
+                                    shapes=kwargs)
+
+    # gradient symbol (reference: nnvm Gradient pass exposed as Symbol.grad
+    # in old API) — not needed: Executor differentiates via jax.vjp.
+    def grad(self, wrt):
+        raise MXNetError("Symbol.grad is not supported; bind and use "
+                         "backward (jax.vjp differentiates the whole graph)")
+
+
+def _attr_to_str(v):
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# composition (reference: MXSymbolCreateAtomicSymbol + Compose,
+# c_api_symbolic.cc)
+# ---------------------------------------------------------------------------
+def _compose(op_name: str, inputs: List[Symbol], attrs: dict,
+             name: Optional[str]) -> Symbol:
+    opdef = _reg.get(op_name)
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    hint = op_name.lower().lstrip("_")
+    name = _name.current().get(name, hint)
+    user_attrs = _attribute.current().get(None)
+
+    heads: List[Tuple[Node, int]] = []
+    for s in inputs:
+        hs = s._expanded_heads()
+        heads.extend(hs)
+
+    if not opdef.variadic:
+        # auto-create missing parameter/aux variables
+        arg_names = list(opdef.arg_names or [])
+        aux_names = list(opdef.aux_names or [])
+        skip = _skip_args(op_name, attrs)
+        wanted = [a for a in arg_names + aux_names if a not in skip]
+        n_missing = len(wanted) - len(heads)
+        if n_missing > 0:
+            for extra in wanted[len(heads):]:
+                is_aux = extra in aux_names
+                v = Variable(f"{name}_{extra}",
+                             __is_aux__="1" if is_aux else None)
+                heads.extend(v._expanded_heads())
+
+    node = Node(op_name, name, attrs, heads, user_attrs)
+    return Symbol([(node, None)])
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs) -> Symbol:
+    """Create a variable symbol (reference: symbol.py var/Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    user_attrs = _attribute.current().get(attr)
+    if shape is not None:
+        user_attrs["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        user_attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        user_attrs["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        user_attrs["__dtype__"] = np.dtype(dtype).name
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        user_attrs["__init__"] = init
+    for k, v in kwargs.items():
+        if v is None:
+            continue
+        if k.startswith("__") and k.endswith("__"):
+            user_attrs[k] = str(v)
+        else:
+            user_attrs[k] = str(v)
+    user_attrs = {k: v for k, v in user_attrs.items() if v is not None}
+    node = Node(None, name, {}, [], user_attrs)
+    return Symbol([(node, None)])
+
+
+Variable = var
+
+
+def Group(symbols) -> Symbol:
+    heads = []
+    for s in symbols:
+        if not isinstance(s, Symbol):
+            raise TypeError("Group expects Symbols")
+        heads.extend(s._expanded_heads())
+    return Symbol(heads)
+
+
+def load_json(json_str: str) -> Symbol:
+    g = json.loads(json_str)
+    nodes: List[Node] = []
+    for jn in g["nodes"]:
+        attrs = dict(jn.get("attrs", jn.get("param", {})) or {})
+        user_attrs = {k: v for k, v in attrs.items()
+                      if k.startswith("__") or k in ("ctx_group",)}
+        op = jn["op"]
+        if op == "null":
+            node = Node(None, jn["name"], {}, [], user_attrs)
+        else:
+            opdef = _reg.find(op)
+            if opdef is None:
+                raise MXNetError(f"cannot load graph: unknown op {op!r}")
+            op_attrs = {k: _parse_attr(v, opdef.attr_defaults.get(k))
+                        for k, v in attrs.items() if not k.startswith("__")}
+            inputs = [(nodes[i], idx) for i, idx, _ in jn["inputs"]]
+            node = Node(op, jn["name"], op_attrs, inputs, user_attrs)
+        nodes.append(node)
+    heads = [(nodes[i], idx) for i, idx, _ in g["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _parse_attr(v, default=None):
+    """Parse a stringified attr back to python (tuples, bools, numbers)."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    if s in ("True", "true"):
+        return True
+    if s in ("False", "false"):
+        return False
+    if s in ("None", ""):
+        return None
+    if s.startswith("(") or s.startswith("["):
+        inner = s[1:-1].strip()
+        if not inner:
+            return ()
+        parts = [p.strip() for p in inner.split(",") if p.strip()]
+        return tuple(_parse_attr(p) for p in parts)
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return v
+
+
+# ---------------------------------------------------------------------------
+# graph shape/type inference engine
+# ---------------------------------------------------------------------------
+def _infer_graph_shapes(sym: Symbol, known_shapes: Dict[str, tuple],
+                        known_dtypes: Dict[str, np.dtype],
+                        shapes_optional=False, dummy_shapes=False):
+    """Forward abstract interpretation with parameter-shape back-fill.
+
+    Returns (shapes, dtypes) dicts keyed by variable name, plus
+    ``"__outputs__"`` entries listing per-head results.
+    """
+    nodes = _topo_sort(sym._expanded_heads())
+    default_dtype = np.dtype("float32")
+    var_shape: Dict[int, Optional[tuple]] = {}
+    var_dtype: Dict[int, np.dtype] = {}
+    val: Dict[Tuple[int, int], jax.ShapeDtypeStruct] = {}
+
+    for n in nodes:
+        if n.is_variable:
+            shp = known_shapes.get(n.name)
+            if shp is None and "__shape__" in n._user_attrs:
+                shp = _parse_attr(n._user_attrs["__shape__"])
+            if shp is None and dummy_shapes:
+                shp = (1,)  # dtype-only inference: shapes are throwaway
+            var_shape[id(n)] = tuple(shp) if shp else None
+            dt = known_dtypes.get(n.name)
+            if dt is None and "__dtype__" in n._user_attrs:
+                dt = np.dtype(n._user_attrs["__dtype__"])
+            var_dtype[id(n)] = dt or default_dtype
+
+    for n in nodes:
+        if n.is_variable:
+            if var_shape[id(n)] is not None:
+                val[(id(n), 0)] = jax.ShapeDtypeStruct(
+                    var_shape[id(n)], var_dtype[id(n)])
+            continue
+        opdef = _reg.get(n.op)
+        # back-fill parameter shapes from data shapes
+        infer_hook = PARAM_SHAPE_INFER.get(n.op)
+        argmap = {}
+        names = (opdef.arg_names or []) + (opdef.aux_names or [])
+        skip = _skip_args(n.op, n.attrs)
+        names = [a for a in names if a not in skip]
+        for an, (src, idx) in zip(names, n.inputs):
+            argmap[an] = (src, idx)
+        if infer_hook:
+            in_shapes = {an: val[(id(src), idx)].shape
+                         for an, (src, idx) in argmap.items()
+                         if (id(src), idx) in val}
+            try:
+                fills = infer_hook(n.attrs, in_shapes)
+            except Exception:
+                fills = {}
+            for an, shp in fills.items():
+                if an in argmap:
+                    src, idx = argmap[an]
+                    if src.is_variable and var_shape.get(id(src)) is None:
+                        var_shape[id(src)] = tuple(shp)
+                        val[(id(src), 0)] = jax.ShapeDtypeStruct(
+                            tuple(shp), var_dtype.get(id(src), default_dtype))
+        # elementwise mirroring: same-shape binary ops
+        in_specs = []
+        missing = []
+        for src, idx in n.inputs:
+            sds = val.get((id(src), idx))
+            if sds is None:
+                missing.append((src, idx))
+            in_specs.append(sds)
+        if missing:
+            knowns = [s for s in in_specs if s is not None]
+            if knowns and all(m[0].is_variable for m in missing):
+                for src, idx in missing:
+                    val[(id(src), idx)] = knowns[0]
+                    var_shape[id(src)] = knowns[0].shape
+                in_specs = [val[(id(src), idx)] for src, idx in n.inputs]
+            elif shapes_optional:
+                continue
+            else:
+                raise MXNetError(
+                    f"infer_shape: insufficient information at node "
+                    f"{n.name!r} ({n.op})")
+        try:
+            out_specs = _eval_node_shape(n, opdef, in_specs)
+        except Exception:
+            if shapes_optional:
+                continue  # dtype-only mode with throwaway shapes
+            raise
+        for i, sds in enumerate(out_specs):
+            val[(id(n), i)] = sds
+
+    shapes = {"__outputs__": []}
+    dtypes = {"__outputs__": []}
+    for node in nodes:
+        if node.is_variable:
+            shapes[node.name] = var_shape.get(id(node))
+            dtypes[node.name] = var_dtype.get(id(node), default_dtype)
+    for hn, hi in sym._expanded_heads():
+        sds = val.get((id(hn), hi))
+        shapes["__outputs__"].append(tuple(sds.shape)
+                                     if sds is not None else None)
+        dtypes["__outputs__"].append(np.dtype(str(sds.dtype))
+                                     if sds is not None else default_dtype)
+    return shapes, dtypes
+
+
+def _eval_node_shape(n: Node, opdef: _reg.OpDef, in_specs):
+    import jax.random as jrandom
+    attrs = dict(n.attrs)
+    kwargs = dict(attrs)
+    if opdef.takes_is_train:
+        kwargs["is_train"] = True
+
+    def f(*vals):
+        if opdef.needs_rng:
+            out = opdef.fn(jrandom.PRNGKey(0), *vals, **kwargs)
+        else:
+            out = opdef.fn(*vals, **kwargs)
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+    out = jax.eval_shape(f, *in_specs)
+    return list(out)[:node_num_outputs(n)]
+
+
+def zeros(shape, dtype="float32", **kw):
+    return _compose("_zeros", [], {"shape": tuple(shape) if not isinstance(
+        shape, numbers.Integral) else (shape,), "dtype": np.dtype(dtype).name}, kw.get("name"))
+
+
+def ones(shape, dtype="float32", **kw):
+    return _compose("_ones", [], {"shape": tuple(shape) if not isinstance(
+        shape, numbers.Integral) else (shape,), "dtype": np.dtype(dtype).name}, kw.get("name"))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    return _compose("_arange", [], {"start": start, "stop": stop,
+                                    "step": step, "repeat": repeat,
+                                    "dtype": np.dtype(dtype).name}, name)

@@ -1,17 +1,27 @@
 """Test config: run the whole suite on a virtual 8-device CPU mesh.
 
 Mirrors the reference's strategy of testing multi-device logic with multiple
-CPU contexts (SURVEY.md §4): ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+CPU contexts (SURVEY.md §4): ``xla_force_host_platform_device_count=8``
 gives 8 CPU "chips" so sharding/collective paths compile and execute without
 TPU hardware.  Benchmarks (bench.py) run on the real chip instead.
+
+The axon TPU-tunnel plugin (registered by sitecustomize when
+``PALLAS_AXON_POOL_IPS`` is set) is stripped here: the tunnel admits one
+client at a time, so letting unit tests grab it would deadlock against any
+concurrent benchmark process.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,149 @@
+"""Executor fwd/bwd tests (reference: tests/python/unittest/test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_bind_simple_fwd_bwd():
+    a = sym.Variable('a')
+    b = sym.Variable('b')
+    c = a * b
+    ex = c.simple_bind(a=(4,), b=(4,), grad_req='write')
+    av = np.array([1., 2., 3., 4.], np.float32)
+    bv = np.array([5., 6., 7., 8.], np.float32)
+    ex.arg_dict['a']._set_data(av)
+    ex.arg_dict['b']._set_data(bv)
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), av * bv)
+    ex.backward(out_grads=mx.nd.array(np.ones(4, np.float32)))
+    np.testing.assert_allclose(ex.grad_dict['a'].asnumpy(), bv)
+    np.testing.assert_allclose(ex.grad_dict['b'].asnumpy(), av)
+
+
+def test_grad_req_add():
+    a = sym.Variable('a')
+    c = a * a
+    ex = c.simple_bind(a=(3,), grad_req='add')
+    ex.arg_dict['a']._set_data(np.array([1., 2., 3.], np.float32))
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward(out_grads=mx.nd.array(np.ones(3, np.float32)))
+    np.testing.assert_allclose(ex.grad_dict['a'].asnumpy(),
+                               2 * 2 * np.array([1., 2., 3.]))
+
+
+def test_grad_req_null():
+    a = sym.Variable('a')
+    b = sym.Variable('b')
+    c = a * b
+    ex = c.simple_bind(a=(2,), b=(2,), grad_req={'a': 'write', 'b': 'null'})
+    ex.arg_dict['a']._set_data(np.ones(2, np.float32))
+    ex.arg_dict['b']._set_data(np.full(2, 3., np.float32))
+    ex.forward(is_train=True)
+    ex.backward(out_grads=mx.nd.array(np.ones(2, np.float32)))
+    np.testing.assert_allclose(ex.grad_dict['a'].asnumpy(), [3., 3.])
+    assert ex.grad_dict['b'] is None
+
+
+def test_forward_kwargs_update():
+    a = sym.Variable('a')
+    c = a * 2.0
+    ex = c.simple_bind(a=(2,))
+    ex.forward(a=mx.nd.array(np.array([1., 2.], np.float32)))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [2., 4.])
+    ex.forward(a=mx.nd.array(np.array([3., 4.], np.float32)))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [6., 8.])
+
+
+def test_dropout_train_vs_eval():
+    d = sym.Variable('d')
+    out = sym.Dropout(d, p=0.5, name='drop')
+    ex = out.simple_bind(d=(100, 100))
+    ex.arg_dict['d']._set_data(np.ones((100, 100), np.float32))
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               np.ones((100, 100)))
+    ex.forward(is_train=True)
+    v = ex.outputs[0].asnumpy()
+    assert 0.3 < (v == 0).mean() < 0.7  # roughly half dropped
+
+
+def test_batchnorm_aux_update():
+    d = sym.Variable('d')
+    bn = sym.BatchNorm(d, name='bn', momentum=0.5)
+    ex = bn.simple_bind(d=(8, 4))
+    rng = np.random.RandomState(0)
+    ex.arg_dict['d']._set_data(rng.randn(8, 4).astype(np.float32) + 3.0)
+    ex.arg_dict['bn_gamma']._set_data(np.ones(4, np.float32))
+    ex.aux_dict['bn_moving_var']._set_data(np.ones(4, np.float32))
+    ex.forward(is_train=True)
+    ex.outputs[0].asnumpy()
+    mm = ex.aux_dict['bn_moving_mean'].asnumpy()
+    assert np.all(mm > 0.5)  # moved toward batch mean (~3)
+    # eval mode must use (not update) the stats
+    ex.forward(is_train=False)
+    ex.outputs[0].asnumpy()
+    np.testing.assert_allclose(ex.aux_dict['bn_moving_mean'].asnumpy(), mm)
+
+
+def test_softmax_output_implicit_loss_grad():
+    data = sym.Variable('data')
+    out = sym.SoftmaxOutput(data, name='sm')
+    ex = out.simple_bind(data=(2, 3), sm_label=(2,), grad_req='write')
+    logits = np.array([[1., 2., 3.], [0., 0., 0.]], np.float32)
+    labels = np.array([2., 0.], np.float32)
+    ex.arg_dict['data']._set_data(logits)
+    ex.arg_dict['sm_label']._set_data(labels)
+    ex.forward(is_train=True)
+    ex.backward()
+    p = ex.outputs[0].asnumpy()
+    expect = p.copy()
+    expect[0, 2] -= 1.0
+    expect[1, 0] -= 1.0
+    np.testing.assert_allclose(ex.grad_dict['data'].asnumpy(), expect,
+                               rtol=1e-5)
+
+
+def test_fused_lazy_forward_backward():
+    """forward + backward must produce outputs AND grads consistently."""
+    a = sym.Variable('a')
+    loss = sym.sum(a * a)
+    ex = loss.simple_bind(a=(5,), grad_req='write')
+    ex.arg_dict['a']._set_data(np.arange(5, dtype=np.float32))
+    ex.forward(is_train=True)
+    ex.backward()  # ones head grad
+    np.testing.assert_allclose(ex.grad_dict['a'].asnumpy(),
+                               2 * np.arange(5))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), 30.0)
+
+
+def test_copy_params_from():
+    a = sym.Variable('a')
+    c = a * 1.0
+    ex = c.simple_bind(a=(2,))
+    ex.copy_params_from({'a': mx.nd.array(np.array([7., 8.], np.float32))})
+    ex.forward()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [7., 8.])
+
+
+def test_reshape():
+    a = sym.Variable('a')
+    c = a * 2.0
+    ex = c.simple_bind(a=(2, 3))
+    ex2 = ex.reshape(a=(4, 3))
+    ex2.arg_dict['a']._set_data(np.ones((4, 3), np.float32))
+    ex2.forward()
+    assert ex2.outputs[0].shape == (4, 3)
+
+
+def test_monitor_callback():
+    a = sym.Variable('a')
+    b = sym.sqrt(a, name='sq')
+    ex = b.simple_bind(a=(2,))
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.arg_dict['a']._set_data(np.ones(2, np.float32))
+    ex.forward()
+    assert any('sq' in s for s in seen)

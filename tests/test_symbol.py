@@ -1,0 +1,142 @@
+"""Symbol composition / inference / serialization tests.
+
+Modeled on the reference's tests/python/unittest/test_symbol.py and
+test_infer_shape.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, num_hidden=64, name='fc1')
+    act = sym.Activation(fc1, act_type='relu', name='relu1')
+    fc2 = sym.FullyConnected(act, num_hidden=10, name='fc2')
+    return sym.SoftmaxOutput(fc2, name='softmax')
+
+
+def test_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        'data', 'fc1_weight', 'fc1_bias', 'fc2_weight', 'fc2_bias',
+        'softmax_label']
+    assert out.list_outputs() == ['softmax_output']
+
+
+def test_infer_shape_mlp():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(32, 100), softmax_label=(32,))
+    assert arg_shapes == [(32, 100), (64, 100), (64,), (10, 64), (10,),
+                          (32,)]
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = sym.Variable('data')
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name='c1')
+    bn = sym.BatchNorm(c, name='bn1')
+    p = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type='max',
+                    name='p1')
+    fc = sym.FullyConnected(sym.Flatten(p), num_hidden=10, name='fc')
+    arg_shapes, out_shapes, aux_shapes = fc.infer_shape(data=(4, 3, 28, 28))
+    args = dict(zip(fc.list_arguments(), arg_shapes))
+    assert args['c1_weight'] == (8, 3, 3, 3)
+    assert args['bn1_gamma'] == (8,)
+    assert out_shapes == [(4, 10)]
+    aux = dict(zip(fc.list_auxiliary_states(), aux_shapes))
+    assert aux['bn1_moving_mean'] == (8,)
+    assert aux['bn1_moving_var'] == (8,)
+
+
+def test_no_bias_skips_variable():
+    data = sym.Variable('data')
+    fc = sym.FullyConnected(data, num_hidden=5, no_bias=True, name='fc')
+    assert fc.list_arguments() == ['data', 'fc_weight']
+
+
+def test_compose_named_inputs():
+    data = sym.Variable('data')
+    w = sym.Variable('myw')
+    fc = sym.FullyConnected(data=data, weight=w, num_hidden=3, name='fc')
+    assert fc.list_arguments() == ['data', 'myw', 'fc_bias']
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    a1, o1, _ = out.infer_shape(data=(8, 20), softmax_label=(8,))
+    a2, o2, _ = out2.infer_shape(data=(8, 20), softmax_label=(8,))
+    assert a1 == a2 and o1 == o2
+
+
+def test_save_load(tmp_path):
+    out = _mlp()
+    fn = str(tmp_path / "sym.json")
+    out.save(fn)
+    out2 = sym.load(fn)
+    assert out2.list_arguments() == out.list_arguments()
+
+
+def test_group_and_internals():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, num_hidden=4, name='fc1')
+    fc2 = sym.FullyConnected(fc1, num_hidden=2, name='fc2')
+    g = sym.Group([fc1, fc2])
+    assert g.list_outputs() == ['fc1_output', 'fc2_output']
+    internals = fc2.get_internals()
+    assert 'fc1_output' in internals.list_outputs()
+    sub = internals['fc1_output']
+    assert sub.list_outputs() == ['fc1_output']
+
+
+def test_symbol_arithmetic_exec():
+    a = sym.Variable('a')
+    b = sym.Variable('b')
+    c = 2.0 * a + b ** 2
+    ex = c.simple_bind(a=(3,), b=(3,), grad_req='write')
+    ex.arg_dict['a']._set_data(np.array([1., 2., 3.], np.float32))
+    ex.arg_dict['b']._set_data(np.array([4., 5., 6.], np.float32))
+    ex.forward()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               [18., 29., 42.])
+
+
+def test_attr_scope():
+    with mx.AttrScope(group='4'):
+        a = sym.Variable('a')
+    assert a.attr('group') == '4'
+
+
+def test_name_prefix():
+    with mx.name.Prefix('mynet_'):
+        d = sym.Variable('d')
+        fc = sym.FullyConnected(d, num_hidden=2)
+    assert fc.name.startswith('mynet_')
+
+
+def test_variable_shape_attr():
+    a = sym.Variable('a', shape=(4, 5))
+    b = sym.Variable('b')
+    c = a + b
+    arg_shapes, out_shapes, _ = c.infer_shape()
+    assert out_shapes == [(4, 5)]
+
+
+def test_multi_output_slicechannel():
+    data = sym.Variable('data')
+    parts = sym.SliceChannel(data, num_outputs=3, axis=1, name='sc')
+    assert len(parts.list_outputs()) == 3
+    p0 = parts[0]
+    ex = p0.simple_bind(data=(2, 6))
+    ex.arg_dict['data']._set_data(np.arange(12, dtype=np.float32).reshape(2, 6))
+    ex.forward()
+    assert ex.outputs[0].shape == (2, 2)
