@@ -21,5 +21,23 @@ from . import symbol as sym
 from .symbol import Symbol
 from . import executor
 from .executor import Executor
+from . import initializer
+from .initializer import Initializer
+from . import optimizer
+from . import optimizer as opt
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from .io import DataBatch, DataIter, DataDesc, NDArrayIter, ResizeIter, \
+    PrefetchingIter, CSVIter
+from . import kvstore as kv
+from . import kvstore
+from . import model
+from . import module
+from . import module as mod
+from .module import Module, BaseModule
+from . import serialization
 
 from .ndarray import NDArray

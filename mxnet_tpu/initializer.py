@@ -1,0 +1,389 @@
+"""Weight initializers (reference: python/mxnet/initializer.py).
+
+Pattern matching on parameter *names* decides the init (weight/bias/gamma/
+beta/moving_*) exactly as the reference's ``Initializer.__call__`` does.
+Randomness draws from the global mx.random key chain.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import re
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, Registry
+from . import random as _rnd
+from .ndarray import NDArray
+
+_INIT_REGISTRY = Registry("initializer")
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers
+    (reference: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base init (reference: initializer.py Initializer)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        if print_func is None:
+            def asum_stat(x):
+                return str((np.abs(x.asnumpy()).mean(),))
+            print_func = asum_stat
+        self._print_func = print_func
+        return self
+
+    def _verbose_print(self, desc, init, arr):
+        if self._verbose and self._print_func:
+            logging.info('Initialized %s as %s: %s', desc, init,
+                         self._print_func(arr))
+
+    def dumps(self):
+        name = self.__class__.__name__.lower()
+        return json.dumps([name, self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be a string (InitDesc)")
+        if desc.endswith('weight'):
+            self._init_weight(desc, arr)
+            self._verbose_print(desc, 'weight', arr)
+        elif desc.endswith('bias'):
+            self._init_bias(desc, arr)
+            self._verbose_print(desc, 'bias', arr)
+        elif desc.endswith('gamma'):
+            self._init_gamma(desc, arr)
+            self._verbose_print(desc, 'gamma', arr)
+        elif desc.endswith('beta'):
+            self._init_beta(desc, arr)
+            self._verbose_print(desc, 'beta', arr)
+        elif desc.endswith('min'):
+            self._init_zero(desc, arr)
+        elif desc.endswith('max'):
+            self._init_one(desc, arr)
+        elif desc.endswith('running_mean') or desc.endswith('moving_mean'):
+            self._init_zero(desc, arr)
+        elif desc.endswith('running_var') or desc.endswith('moving_var'):
+            self._init_one(desc, arr)
+        elif desc.endswith('moving_inv_var'):
+            self._init_zero(desc, arr)
+        elif desc.endswith('moving_avg'):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _set(self, arr, value):
+        arr._set_data(jnp.asarray(value, arr._data.dtype))
+
+    def _init_zero(self, name, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+    def _init_one(self, name, arr):
+        self._set(arr, jnp.ones(arr.shape))
+
+    def _init_bias(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_gamma(self, name, arr):
+        self._init_one(name, arr)
+
+    def _init_beta(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override it")
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            f'Unknown initialization pattern for {name}. Default '
+            'initialization is now limited to "weight", "bias", "gamma" '
+            '(1.0), and "beta" (0.0). Please use mx.sym.Variable(init=...) '
+            'to set initialization pattern')
+
+
+register = _INIT_REGISTRY.register
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _INIT_REGISTRY.get(name)(**kwargs)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(name, arr)
+
+
+_INIT_REGISTRY.alias("zeros", "zero")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(name, arr)
+
+
+_INIT_REGISTRY.alias("ones", "one")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        self._set(arr, jnp.full(arr.shape, self.value))
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference: initializer.py Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        self._set(arr, jax.random.uniform(
+            _rnd.next_key(), arr.shape, jnp.float32,
+            -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (reference: initializer.py Normal)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        self._set(arr, jax.random.normal(
+            _rnd.next_key(), arr.shape, jnp.float32) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    """reference: initializer.py Orthogonal (Saxe et al.)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(_rnd.next_key(), (nout, nin),
+                                     jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(_rnd.next_key(), (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """reference: initializer.py Xavier (gaussian/uniform × avg/in/out)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.
+        if len(shape) < 2:
+            raise ValueError(
+                f'Xavier initializer cannot be applied to vector {name}. '
+                'It requires at least 2D.')
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, jax.random.uniform(
+                _rnd.next_key(), shape, jnp.float32, -scale, scale))
+        elif self.rnd_type == "gaussian":
+            self._set(arr, jax.random.normal(
+                _rnd.next_key(), shape, jnp.float32) * scale)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """reference: initializer.py MSRAPrelu (He init for PReLU nets)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2. / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {'factor_type': factor_type, 'slope': slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: initializer.py Bilinear)."""
+
+    def _init_weight(self, name, arr):
+        weight = np.zeros(arr.shape, dtype='float32')
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.)
+        c = (2 * f - 1 - f % 2) / (2. * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype='float32')
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+@register
+class FusedRNN(Initializer):
+    """Init packed fused-RNN parameter blobs (reference: initializer.py
+    FusedRNN) — delegates per-gate slices to a sub-initializer."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INIT_REGISTRY.get(klass)(**kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        try:
+            from .rnn import rnn_cell
+        except ImportError as e:
+            raise RuntimeError(
+                "FusedRNN initializer requires mxnet_tpu.rnn "
+                f"(import failed: {e})")
+        cell = rnn_cell.FusedRNNCell(self._num_hidden, self._num_layers,
+                                     self._mode, self._bidirectional,
+                                     forget_bias=self._forget_bias)
+        args = cell.unpack_weights({'parameters': arr})
+        for name in args:
+            desc2 = InitDesc(name)
+            # for lstm bias, we use a custom initializer which adds a bias to
+            # the forget gate (reference behavior)
+            if self._mode == 'lstm' and name.endswith("_f_bias"):
+                args[name]._set_data(jnp.full(args[name].shape,
+                                              self._forget_bias))
+            elif self._init is not None:
+                self._init(desc2, args[name])
+        arr._set_data(cell.pack_weights(args)['parameters']._data)
+
+
+@register
+class Load:
+    """Init from a dict of arrays, fall back otherwise
+    (reference: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .serialization import load_ndarrays
+            param = load_ndarrays(param)
+        self.param = {}
+        for name, arr in param.items():
+            self.param[name.replace('arg:', '').replace('aux:', '')] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if arr.shape != self.param[name].shape:
+                raise AssertionError(
+                    f'Parameter {name} cannot be initialized from loading. '
+                    f'Shape mismatch, target {arr.shape} vs loaded '
+                    f'{self.param[name].shape}')
+            arr._set_data(self.param[name]._data)
+            if self.verbose:
+                logging.info('Initialized %s by loading', name)
+        else:
+            if self.default_init is None:
+                raise AssertionError(
+                    f"Cannot Initialize {name}. Not found in loaded param and "
+                    "no default Initializer is provided.")
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info('Initialized %s by default', name)
+
+
+@register
+class Mixed:
+    """Regex-pattern dispatch to sub-initializers
+    (reference: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must have the same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            f'Parameter name {name} did not match any pattern. Consider '
+            'adding a ".*" pattern at the end with default Initializer.')

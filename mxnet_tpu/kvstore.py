@@ -1,0 +1,202 @@
+"""KVStore: key-value parameter synchronization.
+
+TPU-native re-design of the reference's kvstore stack (include/mxnet/
+kvstore.h:45-397; src/kvstore/kvstore_local.h, comm.h, kvstore_dist.h).
+The public API (init/push/pull/row_sparse_pull/set_optimizer/rank/
+num_workers/barrier) is preserved; the transport is re-imagined:
+
+* ``local`` / ``device`` — single-process aggregation.  The reference's
+  CommCPU/CommDevice reduction trees (comm.h:90,462) collapse to a jnp sum
+  (XLA emits the optimal reduction; cross-device copies ride ICI when the
+  values live on different chips of a mesh).
+* ``tpu`` — values that are sharded jax.Arrays over a device mesh are
+  reduced with a jitted psum-style sum so gradient aggregation fuses and
+  rides ICI collectives (SURVEY.md §5.8 north star).  ``dist_sync`` over
+  multi-host meshes reuses the same path: under ``jax.distributed`` a
+  global mesh makes the SAME code do cross-host allreduce over DCN — there
+  are no parameter-server processes to run (kvstore_dist_server.h is
+  intentionally not ported; see docs/design/kvstore.md).
+* ``dist_async`` — unsupported on TPU (documented; raises).
+
+Update-on-kvstore (reference: server-side optimizer, kvstore_dist_server.h
+:131) is supported: ``set_optimizer`` installs an Updater that runs the
+fused update on the aggregated gradient.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import optimizer as opt
+
+
+def _key(k):
+    return str(k)
+
+
+class KVStore:
+    """Single-process store (reference: KVStoreLocal, kvstore_local.h)."""
+
+    def __init__(self, kvtype="local"):
+        self.type = kvtype
+        self._store: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._barrier_count = 0
+        # jitted multi-value reducer cache keyed by (n_values, shape, dtype)
+        self._sum_cache = {}
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self.type.startswith(("dist", "tpu")) \
+            else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self.type.startswith(("dist", "tpu")) \
+            else 1
+
+    # -- init ----------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._canon(key, value)
+        for k, vs in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"duplicate init of key {k}")
+            self._store[k] = NDArray(vs[0]._data)
+
+    # -- push/pull ------------------------------------------------------------
+    def push(self, key, value, priority=0):
+        """Aggregate value(s) into the store; runs updater if installed
+        (reference: KVStoreLocal::PushImpl, kvstore_local.h:149)."""
+        keys, values = self._canon(key, value)
+        for k, vs in zip(keys, values):
+            agg = self._reduce(vs)
+            if k not in self._store:
+                raise MXNetError(f"push to uninitialized key {k}")
+            if self._updater is not None:
+                self._updater(self._key_int(k), NDArray(agg), self._store[k])
+            else:
+                # no updater: store holds the reduced value (reference:
+                # kvstore_local.h:173 local = merged — assign, don't add)
+                self._store[k]._set_data(agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast stored value to out array(s)
+        (reference: KVStoreLocal::PullImpl, kvstore_local.h:188)."""
+        assert out is not None
+        keys, outs = self._canon(key, out)
+        for k, os_ in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"pull of uninitialized key {k}")
+            src = self._store[k]
+            for o in os_:
+                o._set_data(jax.device_put(src._data)
+                            if o.context == src.context else
+                            jax.device_put(src._data,
+                                           o.context.jax_device()))
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference: kvstore.h PullRowSparse).
+
+        Dense-backed: gathers rows on device — the sparse storage formats of
+        the reference map to gather/scatter on TPU (see ndarray/sparse.py).
+        """
+        assert out is not None and row_ids is not None
+        keys, outs = self._canon(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, os_, rid in zip(keys, outs, row_ids):
+            src = self._store[k]
+            idx = rid._data.astype(jnp.int32)
+            rows = jnp.take(src._data, idx, axis=0)
+            for o in os_:
+                # scatter picked rows into a dense out of full shape
+                o._set_data(jnp.zeros_like(src._data).at[idx].set(rows))
+
+    # -- optimizer ------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run optimizer inside the store (reference: kvstore.py:353
+        update-on-kvstore; server-side optimizer in dist mode)."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    # -- coordination ---------------------------------------------------------
+    def barrier(self):
+        """Global barrier (reference: Postoffice::Barrier).  Multi-host: an
+        allreduce over a tiny array forces synchronization."""
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+    def _send_command_to_servers(self, head, body):
+        pass  # no server processes exist in the TPU design
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer installed")
+        with open(fname, 'wb') as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer installed")
+        with open(fname, 'rb') as fin:
+            self._updater.set_states(fin.read())
+
+    # -- internals ------------------------------------------------------------
+    def _reduce(self, vs: List[NDArray]):
+        if len(vs) == 1:
+            return vs[0]._data
+        sig = (len(vs), vs[0].shape, str(vs[0].dtype))
+        if sig not in self._sum_cache:
+            self._sum_cache[sig] = jax.jit(
+                lambda *xs: jnp.sum(jnp.stack(xs), axis=0)
+                if len(xs) > 2 else (xs[0] + xs[1]))
+        return self._sum_cache[sig](*[v._data for v in vs])
+
+    @staticmethod
+    def _key_int(k):
+        try:
+            return int(k)
+        except ValueError:
+            return k
+
+    @staticmethod
+    def _canon(key, value):
+        single = not isinstance(key, (list, tuple))
+        keys = [key] if single else list(key)
+        if single:
+            values = [value if isinstance(value, (list, tuple)) else [value]]
+        else:
+            values = [v if isinstance(v, (list, tuple)) else [v]
+                      for v in value]
+        return [_key(k) for k in keys], values
+
+
+def create(name="local") -> KVStore:
+    """reference: kvstore.py:534 create → KVStore::Create (kvstore.cc:34)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "tpu", "dist_sync", "dist_device_sync", "dist",
+                "nccl"):
+        if name == "dist_async":
+            pass
+        return KVStore(name)
+    if name == "dist_async":
+        raise MXNetError(
+            "kvstore 'dist_async' is not supported by the TPU design: SPMD "
+            "collectives are synchronous. Use 'dist_sync' (allreduce over "
+            "the global mesh) — see docs/design/kvstore.md")
+    raise MXNetError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,119 @@
+"""Model helpers: checkpointing + kvstore wiring
+(reference: python/mxnet/model.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from collections import namedtuple
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import symbol as sym_mod
+from . import kvstore as kvs
+from .serialization import save_ndarrays, load_ndarrays
+
+BatchEndParam = namedtuple('BatchEndParams',
+                           ['epoch', 'nbatch', 'eval_metric', 'locals'])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """reference: model.py:57 — decide store + update_on_kvstore."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and 'dist' not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == 'local':
+                max_size = max(np.prod(param.shape)
+                               for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError('kvstore must be KVStore, str or None')
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """reference: model.py:96."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            if isinstance(param_on_devs, (list, tuple)):
+                kvstore.pull(name, param_on_devs, priority=-idx)
+            else:
+                kvstore.pull(name, [param_on_devs], priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """reference: model.py:105 — push grads, pull updated weights."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or (isinstance(grad_list, list)
+                                 and grad_list[0] is None):
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """reference: model.py:117 — reduce via kvstore, update locally."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if not isinstance(arg_list, (list, tuple)):
+            arg_list, grad_list = [arg_list], [grad_list]
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            # key by param NAME when known so lr_mult/wd_mult (and the fused
+            # path's name-keyed optimizer state) stay consistent
+            key = param_names[index] if param_names else \
+                index * num_device + k
+            updater(key, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """reference: model.py:340 — prefix-symbol.json + prefix-%04d.params."""
+    if symbol is not None:
+        symbol.save('%s-symbol.json' % prefix)
+    save_dict = {('arg:%s' % k): v for k, v in arg_params.items()}
+    save_dict.update({('aux:%s' % k): v for k, v in aux_params.items()})
+    param_name = '%s-%04d.params' % (prefix, epoch)
+    save_ndarrays(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """reference: model.py:370 — returns (symbol, arg_params, aux_params)."""
+    symbol = None
+    if os.path.exists('%s-symbol.json' % prefix):
+        symbol = sym_mod.load('%s-symbol.json' % prefix)
+    save_dict = load_ndarrays('%s-%04d.params' % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(':', 1)
+        if tp == 'arg':
+            arg_params[name] = v
+        if tp == 'aux':
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)

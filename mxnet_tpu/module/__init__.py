@@ -1,0 +1,3 @@
+"""Module API (``mx.mod``) — reference: python/mxnet/module/."""
+from .base_module import BaseModule
+from .module import Module

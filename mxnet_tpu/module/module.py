@@ -1,0 +1,481 @@
+"""Module: symbol + executor + optimizer, the intermediate-level trainer
+(reference: python/mxnet/module/module.py).
+
+TPU-first design: the reference's DataParallelExecutorGroup (one executor
+per GPU, batch split host-side, kvstore reduce — executor_group.py:99,233)
+is replaced by ONE executor whose arrays may be sharded over a device mesh
+(data-parallel = batch-axis sharding; see mxnet_tpu.parallel).  ``update``
+runs a FUSED training step: forward + backward + optimizer update compile
+into a single XLA program (the reference needed three engine passes plus a
+kvstore round trip per step).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, env
+from ..context import Context, cpu, current_context
+from ..executor import Executor
+from ..initializer import Uniform, InitDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from .. import random as _rnd
+from .base_module import BaseModule, _check_input_names, _parse_data_desc
+
+
+class Module(BaseModule):
+    """reference: module.py:39 Module."""
+
+    def __init__(self, symbol, data_names=('data',),
+                 label_names=('softmax_label',), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = [current_context()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) \
+            if fixed_param_names is not None else []
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._grad_req = None
+        self._exec: Optional[Executor] = None
+        self._fused_step = None
+        self._opt_states: Dict[str, tuple] = {}
+        self._pending_backward = False
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """reference: module.py load."""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = '%s-%04d.states' % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """reference: module.py save_checkpoint."""
+        self._symbol.save('%s-symbol.json' % prefix)
+        param_name = '%s-%04d.params' % (prefix, epoch)
+        self.save_params(param_name)
+        logging.info('Saved checkpoint to "%s"', param_name)
+        if save_optimizer_states:
+            state_name = '%s-%04d.states' % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+            logging.info('Saved optimizer state to "%s"', state_name)
+
+    # -- properties -----------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in
+                zip(self._output_names, self._exec.outputs)]
+
+    # -- params ---------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        return ({n: self._exec.arg_dict[n] for n in self._param_names},
+                dict(self._exec.aux_dict))
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """reference: module.py:460 init_params."""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, 'call bind before initializing the parameters'
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    arr._set_data(cache_arr._data)
+            else:
+                if not allow_missing and cache is not None:
+                    raise RuntimeError(f"{name} is not presented")
+                if initializer is not None:
+                    init = initializer
+                    attrs = self._symbol.attr_dict()
+                    if name in attrs and '__init__' in attrs[name]:
+                        from .. import initializer as init_mod
+                        import json as _json
+                        klass, kw = _json.loads(attrs[name]['__init__'])
+                        init = init_mod.create(klass, **kw)
+                    init(InitDesc(name), arr)
+
+        cache_arg = arg_params if arg_params is not None else \
+            (self._arg_params if self._arg_params else None)
+        cache_aux = aux_params if aux_params is not None else \
+            (self._aux_params if self._aux_params else None)
+        for name in self._param_names:
+            _impl(name, self._exec.arg_dict[name], cache_arg)
+        for name in self._aux_names:
+            _impl(name, self._exec.aux_dict[name], cache_aux)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    # -- bind -----------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req='write'):
+        """reference: module.py bind → DataParallelExecutorGroup; here: one
+        simple_bind'ed jit executor (sharding covers multi-device)."""
+        if force_rebind:
+            self._reset_bind()
+        if self.binded:
+            self.logger.warning('Already bound, ignoring bind()')
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        self._data_shapes, self._label_shapes = _parse_data_desc(
+            self.data_names, self.label_names, data_shapes, label_shapes)
+
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        type_dict = {d.name: getattr(d, 'dtype', np.float32)
+                     for d in self._data_shapes}
+        if self._label_shapes:
+            shapes.update({l.name: l.shape for l in self._label_shapes})
+            type_dict.update({l.name: getattr(l, 'dtype', np.float32)
+                              for l in self._label_shapes})
+
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._data_names:
+                req[name] = 'write' if inputs_need_grad else 'null'
+            elif name in self._label_names or name in self._state_names:
+                req[name] = 'null'
+            elif name in self._fixed_param_names:
+                req[name] = 'null'
+            else:
+                req[name] = grad_req if for_training else 'null'
+        self._grad_req = req
+
+        self._exec = Executor.simple_bind(
+            self._symbol, self._context[0], grad_req=req,
+            type_dict=type_dict, shapes=shapes)
+        self._fused_step = None
+        if self.params_initialized:
+            # params loaded before bind (Module.load) — copy into executor
+            # (reference: module.py bind → exec_group.set_params)
+            if self._arg_params:
+                self._exec.copy_params_from(self._arg_params,
+                                            self._aux_params,
+                                            allow_extra_params=True)
+        if shared_module is not None and shared_module.params_initialized:
+            arg, aux = shared_module.get_params()
+            self._exec.copy_params_from(arg, aux, allow_extra_params=True)
+            self.params_initialized = True
+
+    def _reset_bind(self):
+        self.binded = False
+        self._exec = None
+        self._fused_step = None
+
+    # -- optimizer ------------------------------------------------------------
+    def init_optimizer(self, kvstore='local', optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.01),),
+                       force_init=False):
+        """reference: module.py:556 init_optimizer."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning('optimizer already initialized, ignoring...')
+            return
+        if self._params_dirty:
+            self._sync_params_from_devices()
+
+        arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        (kvstore_obj, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), arg_params)
+        batch_size = self._data_shapes[0].shape[0]
+        if kvstore_obj and 'dist' in kvstore_obj.type:
+            batch_size *= kvstore_obj.num_workers
+        if isinstance(optimizer, str):
+            idx2name = {n: n for n in self._param_names}
+            optimizer_params = dict(optimizer_params)
+            if 'rescale_grad' not in optimizer_params:
+                # reference: module.py:486 — grads are per-batch sums
+                optimizer_params['rescale_grad'] = 1.0 / batch_size
+            optimizer = opt_mod.create(
+                optimizer, sym=self.symbol, param_idx2name=idx2name,
+                **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt_mod.Optimizer)
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore_obj
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore_obj:
+            # copy initialized params into the store
+            _initialize_kvstore(kvstore=kvstore_obj,
+                                param_arrays=[[arg_params[n]] for n in
+                                              self._param_names],
+                                arg_params=arg_params,
+                                param_names=self._param_names,
+                                update_on_kvstore=update_on_kvstore)
+        if update_on_kvstore:
+            kvstore_obj.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt_mod.get_updater(optimizer)
+
+        # per-param optimizer state for the fused step
+        self._opt_states = {
+            n: optimizer.create_state(n, self._exec.arg_dict[n])
+            for n in self._update_names()}
+
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def _update_names(self):
+        return [n for n in self._param_names
+                if self._grad_req.get(n, 'null') != 'null']
+
+    # -- compute --------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        kwargs = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            kwargs[name] = arr
+        if data_batch.label is not None and self._label_names:
+            for name, arr in zip(self._label_names, data_batch.label):
+                kwargs[name] = arr
+        # shape change (e.g. final partial batch with pad) → jit recompiles;
+        # data AND label shapes must move together (reference: module.py
+        # reshape(data_shapes, label_shapes))
+        io_names = self._data_names + self._label_names
+        cur = {n: tuple(self._exec.arg_dict[n].shape)
+               for n in io_names if n in self._exec.arg_dict}
+        new = {n: tuple(kwargs[n].shape) for n in io_names if n in kwargs}
+        if any(cur.get(n) != s for n, s in new.items()):
+            self._exec = self._exec.reshape(**new)
+            self._fused_step = None
+        self._exec.forward(is_train=is_train, **kwargs)
+        self._pending_backward = False
+        self._out_grads = None
+
+    def backward(self, out_grads=None):
+        """Mark backward pending; gradients materialize lazily (or fuse into
+        update())."""
+        assert self.binded and self.params_initialized
+        self._pending_backward = True
+        self._out_grads = out_grads
+        exec_ = self._exec
+        for name, garr in exec_.grad_dict.items():
+            if garr is not None:
+                garr._set_lazy(
+                    lambda og=out_grads: exec_.backward(out_grads=og))
+
+    def update(self):
+        """One fused XLA program: forward + backward + optimizer update
+        (reference: module.py:615 update → kvstore push/pull + updater)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        opt = self._optimizer
+        names = self._update_names()
+        use_fused = (env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
+                     and getattr(opt, "pure_update", False)
+                     and not self._update_on_kvstore
+                     and getattr(self, '_out_grads', None) is None)
+        if not use_fused:
+            self._exec.backward(out_grads=getattr(self, '_out_grads', None))
+            if self._update_on_kvstore:
+                _update_params_on_kvstore(
+                    [[self._exec.arg_dict[n]] for n in names],
+                    [[self._exec.grad_dict[n]] for n in names],
+                    self._kvstore, names)
+            else:
+                _update_params(
+                    [self._exec.arg_dict[n] for n in names],
+                    [self._exec.grad_dict[n] for n in names],
+                    updater=self._updater, num_device=1,
+                    kvstore=self._kvstore, param_names=names)
+            self._pending_backward = False
+            return
+
+        if self._fused_step is None:
+            self._fused_step = self._build_fused_step(names)
+        for n in names:
+            opt._update_count(n)
+        t = opt._index_update_count[names[0]] if names else 1
+        lrs = tuple(np.float32(opt._get_lr(n)) for n in names)
+        wds = tuple(np.float32(opt._get_wd(n)) for n in names)
+        snapshot = self._exec._snapshot
+        if snapshot is None:
+            raise MXNetError("update() called before forward()")
+        arg_vals, aux_vals, key, _ = snapshot
+        states = tuple(tuple(s._data for s in self._opt_states[n])
+                       for n in names)
+        outs, new_aux, new_params, new_states = self._fused_step(
+            arg_vals, aux_vals, key, states, lrs, wds,
+            jnp.asarray(t, jnp.int32))
+        exec_ = self._exec
+        if exec_._out_arrays is not None:
+            for oa, v in zip(exec_._out_arrays, outs):
+                oa._set_data(v)
+        for a, v in zip(exec_.aux_arrays, new_aux):
+            a._set_data(v)
+        for n, w in zip(names, new_params):
+            exec_.arg_dict[n]._set_data(w)
+        for n, st in zip(names, new_states):
+            for s, v in zip(self._opt_states[n], st):
+                s._set_data(v)
+        self._pending_backward = False
+
+    def _build_fused_step(self, names):
+        exec_ = self._exec
+        run = exec_._run
+        arg_names = exec_._arg_names
+        upd_idx = [arg_names.index(n) for n in names]
+        opt = self._optimizer
+        needs_t = getattr(opt, "needs_t", False)
+
+        def step(arg_vals, aux_vals, key, states, lrs, wds, t):
+            def f(pvals):
+                av = list(arg_vals)
+                for i, v in zip(upd_idx, pvals):
+                    av[i] = v
+                outs, new_aux = run(tuple(av), aux_vals, key, True)
+                diff = tuple(o for o in outs
+                             if jnp.issubdtype(o.dtype, jnp.inexact))
+                return diff, (outs, new_aux)
+
+            pvals = tuple(arg_vals[i] for i in upd_idx)
+            diff, vjp_fn, (outs, new_aux) = jax.vjp(f, pvals, has_aux=True)
+            cts = tuple(jnp.ones(o.shape, o.dtype) for o in diff)
+            grads = vjp_fn(cts)[0]
+            new_params = []
+            new_states = []
+            for i, (pi, g, st, lr, wd) in enumerate(
+                    zip(upd_idx, grads, states, lrs, wds)):
+                w = arg_vals[pi]
+                if needs_t:
+                    nw, ns = opt._update_impl(w, g, st, lr, wd, t=t)
+                else:
+                    nw, ns = opt._update_impl(w, g, st, lr, wd)
+                new_params.append(nw)
+                new_states.append(tuple(ns))
+            return outs, new_aux, tuple(new_params), tuple(new_states)
+
+        return jax.jit(step)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self._output_names, self.get_outputs())))
+
+    # -- state ---------------------------------------------------------------
+    def _sync_params_from_devices(self):
+        self._params_dirty = False
+
+    def save_optimizer_states(self, fname):
+        """reference: module.py save_optimizer_states."""
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            import pickle
+            states = {n: tuple(np.asarray(s._data) for s in st)
+                      for n, st in self._opt_states.items()}
+            with open(fname, 'wb') as fout:
+                pickle.dump(states, fout)
+
+    def load_optimizer_states(self, fname):
+        """reference: module.py load_optimizer_states."""
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            import pickle
+            with open(fname, 'rb') as fin:
+                states = pickle.load(fin)
+            for n, st in states.items():
+                if n in self._opt_states:
+                    for s, v in zip(self._opt_states[n], st):
+                        s._set_data(jnp.asarray(v))
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    def prepare(self, data_batch):
+        pass

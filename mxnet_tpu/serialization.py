@@ -1,0 +1,86 @@
+"""NDArray serialization (reference: NDArray::Save/Load, ndarray.cc:826,939;
+C API MXNDArraySave/Load, c_api.cc:292,315).
+
+Format ``MXTPU001``: 8-byte magic, uint64 LE header length, JSON header
+(list of {name, dtype, shape, offset, nbytes}), then raw little-endian
+buffers.  Self-describing and append-friendly like the reference's
+dmlc::Stream format; supports bfloat16 (stored raw, tagged by dtype name).
+A ``.params`` file written by ``mx.model.save_checkpoint`` uses the same
+container with ``arg:``/``aux:`` name prefixes, mirroring the reference's
+checkpoint convention (model.py:340).
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+_MAGIC = b"MXTPU001"
+
+
+def _to_numpy(arr: NDArray) -> np.ndarray:
+    return np.asarray(arr._data)
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def save_ndarrays(fname: str, data) -> None:
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        items = [(k, v) for k, v in data.items()]
+    elif isinstance(data, (list, tuple)):
+        items = [("", v) for v in data]
+    else:
+        raise MXNetError("save: data must be NDArray, list, or dict")
+    header: List[dict] = []
+    bufs: List[bytes] = []
+    offset = 0
+    for name, arr in items:
+        if not isinstance(arr, NDArray):
+            raise MXNetError(f"save: value for {name!r} is not an NDArray")
+        a = _to_numpy(arr)
+        raw = np.ascontiguousarray(a).tobytes()
+        header.append({"name": name, "dtype": str(a.dtype),
+                       "shape": list(a.shape), "offset": offset,
+                       "nbytes": len(raw)})
+        bufs.append(raw)
+        offset += len(raw)
+    hjson = json.dumps(header).encode()
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for raw in bufs:
+            f.write(raw)
+
+
+def load_ndarrays(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError(f"{fname}: not an mxnet_tpu NDArray file "
+                             f"(bad magic {magic!r})")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode())
+        blob = f.read()
+    out = []
+    for ent in header:
+        dt = _np_dtype(ent["dtype"])
+        a = np.frombuffer(blob, dtype=dt, count=int(np.prod(ent["shape"]))
+                          if ent["shape"] else 1,
+                          offset=ent["offset"]).reshape(ent["shape"])
+        out.append((ent["name"], NDArray(a.copy())))
+    if all(n == "" for n, _ in out):
+        return [a for _, a in out]
+    return {n: a for n, a in out}

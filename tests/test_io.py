@@ -1,0 +1,134 @@
+"""Data iterator tests (reference: tests/python/unittest/test_io.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(100).reshape(25, 4).astype('float32')
+    label = np.arange(25).astype('float32')
+    it = mx.io.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(), label[:5])
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarrayiter_pad():
+    data = np.arange(28).reshape(7, 4).astype('float32')
+    it = mx.io.NDArrayIter(data, np.zeros(7), batch_size=5,
+                           last_batch_handle='pad')
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 3
+    assert batches[1].data[0].shape == (5, 4)
+
+
+def test_ndarrayiter_discard():
+    data = np.arange(28).reshape(7, 4).astype('float32')
+    it = mx.io.NDArrayIter(data, np.zeros(7), batch_size=5,
+                           last_batch_handle='discard')
+    assert len(list(it)) == 1
+
+
+def test_ndarrayiter_shuffle_consistent():
+    data = np.arange(40).reshape(10, 4).astype('float32')
+    label = np.arange(10).astype('float32')
+    it = mx.io.NDArrayIter(data, label, batch_size=5, shuffle=True)
+    for batch in it:
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        # row i of data is 4*label .. 4*label+3
+        np.testing.assert_array_equal(d[:, 0], l * 4)
+
+
+def test_provide_data_desc():
+    data = np.zeros((10, 3, 8, 8), 'float32')
+    it = mx.io.NDArrayIter(data, np.zeros(10), batch_size=2)
+    desc = it.provide_data[0]
+    assert desc.name == 'data'
+    assert desc.shape == (2, 3, 8, 8)
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), 'float32')
+    base = mx.io.NDArrayIter(data, np.zeros(10), batch_size=5)
+    it = mx.io.ResizeIter(base, 5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(10, 4).astype('float32')
+    base = mx.io.NDArrayIter(data, np.zeros(10), batch_size=5)
+    it = mx.io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), data[:5])
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(12, 3).astype('float32')
+    label = np.arange(12).astype('float32')
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    np.savetxt(data_path, data, delimiter=',')
+    np.savetxt(label_path, label, delimiter=',')
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(3,),
+                       label_csv=label_path, batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                               rtol=1e-5)
+
+
+def test_initializers():
+    from mxnet_tpu import initializer as init
+    for name, kw in [('uniform', {}), ('normal', {}), ('xavier', {}),
+                     ('orthogonal', {}), ('msraprelu', {}),
+                     ('constant', {'value': 3.0})]:
+        i = init.create(name, **kw)
+        arr = mx.nd.zeros((8, 8))
+        i(init.InitDesc('test_weight'), arr)
+        v = arr.asnumpy()
+        assert np.isfinite(v).all()
+        if name != 'zero':
+            assert np.abs(v).sum() > 0
+    # bias goes to zero by default
+    i = init.create('xavier')
+    arr = mx.nd.ones((4,))
+    i(init.InitDesc('fc_bias'), arr)
+    np.testing.assert_array_equal(arr.asnumpy(), np.zeros(4))
+
+
+def test_serialization_roundtrip(tmp_path):
+    from mxnet_tpu.serialization import save_ndarrays, load_ndarrays
+    fn = str(tmp_path / "t.params")
+    d = {'a': mx.nd.array(np.random.randn(3, 4).astype('float32')),
+         'b': mx.nd.array(np.arange(5, dtype='int32'))}
+    save_ndarrays(fn, d)
+    out = load_ndarrays(fn)
+    np.testing.assert_allclose(out['a'].asnumpy(), d['a'].asnumpy())
+    np.testing.assert_array_equal(out['b'].asnumpy(), d['b'].asnumpy())
+    # list form
+    save_ndarrays(fn, [d['a'], d['b']])
+    out = load_ndarrays(fn)
+    assert isinstance(out, list) and len(out) == 2
+
+
+def test_serialization_bfloat16(tmp_path):
+    import jax.numpy as jnp
+    from mxnet_tpu.serialization import save_ndarrays, load_ndarrays
+    fn = str(tmp_path / "bf16.params")
+    a = mx.nd.array(np.random.randn(4, 4).astype('float32'),
+                    dtype=jnp.bfloat16)
+    save_ndarrays(fn, {'w': a})
+    out = load_ndarrays(fn)
+    assert str(out['w'].dtype) == 'bfloat16'
+    np.testing.assert_allclose(
+        out['w'].asnumpy().astype('float32'),
+        a.asnumpy().astype('float32'))

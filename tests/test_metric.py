@@ -1,0 +1,81 @@
+"""Metric tests (reference: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.create('acc')
+    pred = mx.nd.array(np.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]]))
+    label = mx.nd.array(np.array([1., 0., 0.]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(2.0 / 3.0)
+
+
+def test_topk():
+    m = metric.create('top_k_accuracy', top_k=2)
+    pred = mx.nd.array(np.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]]))
+    label = mx.nd.array(np.array([2., 1.]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)  # both within top-2
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array(np.array([[1.], [2.]]))
+    label = mx.nd.array(np.array([[0.], [4.]]))
+    m = metric.create('mse')
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx((1 + 4) / 2.0)
+    m = metric.create('mae')
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.5)
+    m = metric.create('rmse')
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(np.sqrt(2.5))
+
+
+def test_perplexity():
+    m = metric.create('perplexity', ignore_label=None)
+    pred = mx.nd.array(np.array([[0.5, 0.5], [0.9, 0.1]]))
+    label = mx.nd.array(np.array([0., 0.]))
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert m.get()[1] == pytest.approx(expected, rel=1e-5)
+
+
+def test_f1():
+    m = metric.create('f1')
+    pred = mx.nd.array(np.array([[0.3, 0.7], [0.8, 0.2], [0.1, 0.9]]))
+    label = mx.nd.array(np.array([1., 0., 1.]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_composite():
+    m = metric.create(['acc', 'mse'])
+    assert isinstance(m, metric.CompositeEvalMetric)
+    names, values = None, None
+    pred = mx.nd.array(np.array([[0.3, 0.7]]))
+    label = mx.nd.array(np.array([1.]))
+    m.update([label], [pred])
+    names, values = m.get()
+    assert 'accuracy' in names and 'mse' in names
+
+
+def test_custom_metric():
+    m = metric.np(lambda label, pred: float((label == pred.argmax(1)).mean()))
+    pred = mx.nd.array(np.array([[0.3, 0.7], [0.8, 0.2]]))
+    label = mx.nd.array(np.array([1., 0.]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_cross_entropy():
+    m = metric.create('ce')
+    pred = mx.nd.array(np.array([[0.2, 0.8], [0.6, 0.4]]))
+    label = mx.nd.array(np.array([1., 0.]))
+    m.update([label], [pred])
+    expected = -(np.log(0.8) + np.log(0.6)) / 2
+    assert m.get()[1] == pytest.approx(expected, rel=1e-4)

@@ -1,0 +1,147 @@
+"""Module training tests (reference: tests/python/unittest/test_module.py,
+tests/python/train/test_mlp.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _xor_data(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 2).astype('float32')
+    Y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype('float32')
+    return X, Y
+
+
+def _mlp_symbol(hidden=16, classes=2):
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=hidden, name='fc1')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=classes, name='fc2')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def test_module_fit_xor():
+    """End-to-end: Module.fit learns XOR above 90% accuracy."""
+    X, Y = _xor_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=25, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.5},
+            initializer=mx.initializer.Xavier(),
+            eval_metric='acc')
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=40), 'acc')
+    assert score[0][1] > 0.9, score
+
+
+def test_module_fit_adam():
+    X, Y = _xor_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=20, optimizer='adam',
+            optimizer_params={'learning_rate': 0.05},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=40), 'acc')
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict():
+    X, Y = _xor_data(80)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[('data', (20, 2))],
+             label_shapes=[('softmax_label', (20,))])
+    mod.init_params()
+    out = mod.predict(mx.io.NDArrayIter(X, Y, batch_size=20))
+    assert out.shape == (80, 2)
+
+
+def test_module_checkpoint(tmp_path):
+    X, Y = _xor_data(80)
+    train = mx.io.NDArrayIter(X, Y, batch_size=20)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=2,
+            optimizer_params={'learning_rate': 0.1})
+    prefix = str(tmp_path / "xor")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 2)
+    mod2.bind(data_shapes=[('data', (20, 2))],
+              label_shapes=[('softmax_label', (20,))])
+    mod2.init_params()
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_module_input_grads():
+    d = sym.Variable('data')
+    out = sym.SoftmaxOutput(sym.FullyConnected(d, num_hidden=3, name='fc'),
+                            name='softmax')
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (4, 5))],
+             label_shapes=[('softmax_label', (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.randn(4, 5).astype('float32'))],
+        label=[mx.nd.array(np.array([0., 1., 2., 0.], 'float32'))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 5)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_fixed_params():
+    X, Y = _xor_data(80)
+    train = mx.io.NDArrayIter(X, Y, batch_size=20)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu(),
+                        fixed_param_names=['fc1_weight'])
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    w0 = mod.get_params()[0]['fc1_weight'].asnumpy().copy()
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.5})
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    w1 = mod.get_params()[0]['fc1_weight'].asnumpy()
+    np.testing.assert_array_equal(w0, w1)
+    w2 = mod.get_params()[0]['fc2_weight'].asnumpy()
+    assert np.abs(w2).sum() > 0
+
+
+def test_module_batchnorm_training():
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=8, name='fc1')
+    net = sym.BatchNorm(net, name='bn1')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=2, name='fc2')
+    net = sym.SoftmaxOutput(net, name='softmax')
+    X, Y = _xor_data(200)
+    train = mx.io.NDArrayIter(X, Y, batch_size=50, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=10,
+            optimizer_params={'learning_rate': 0.5},
+            initializer=mx.initializer.Xavier())
+    _, aux = mod.get_params()
+    assert np.abs(aux['bn1_moving_mean'].asnumpy()).sum() > 0
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=50), 'acc')
+    assert score[0][1] > 0.8, score
+
+
+def test_lr_scheduler_in_fit():
+    X, Y = _xor_data(80)
+    train = mx.io.NDArrayIter(X, Y, batch_size=20)
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.4, 'lr_scheduler': sched})
+    assert mod._optimizer._get_lr('fc1_weight') < 0.4

@@ -1,0 +1,134 @@
+"""Optimizer tests (reference: tests/python/unittest/test_optimizer.py) —
+each optimizer's update is checked against a numpy reference implementation.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run_steps(optimizer, w0, grads, nsteps=3):
+    w = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for i in range(nsteps):
+        g = mx.nd.array(grads[i])
+        optimizer.update(0, w, g, state)
+    return w.asnumpy()
+
+
+RNG = np.random.RandomState(42)
+W0 = RNG.randn(4, 3).astype('float32')
+GRADS = [RNG.randn(4, 3).astype('float32') for _ in range(3)]
+
+
+def test_sgd_matches_numpy():
+    o = opt.create('sgd', learning_rate=0.1, momentum=0.9, wd=0.01)
+    got = _run_steps(o, W0, GRADS)
+    w = W0.copy()
+    mom = np.zeros_like(w)
+    for g in GRADS:
+        mom = 0.9 * mom - 0.1 * (g + 0.01 * w)
+        w = w + mom
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_sgd_clip_gradient():
+    o = opt.create('sgd', learning_rate=1.0, clip_gradient=0.1)
+    got = _run_steps(o, W0, GRADS, nsteps=1)
+    w = W0 - 1.0 * np.clip(GRADS[0], -0.1, 0.1)
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    o = opt.create('adam', learning_rate=0.01)
+    got = _run_steps(o, W0, GRADS)
+    w = W0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(GRADS, 1):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    o = opt.create('rmsprop', learning_rate=0.01, gamma1=0.9)
+    got = _run_steps(o, W0, GRADS)
+    w = W0.copy()
+    n = np.zeros_like(w)
+    for g in GRADS:
+        n = 0.9 * n + 0.1 * g * g
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adagrad_matches_numpy():
+    o = opt.create('adagrad', learning_rate=0.05)
+    got = _run_steps(o, W0, GRADS)
+    w = W0.copy()
+    h = np.zeros_like(w)
+    for g in GRADS:
+        h += g * g
+        w = w - 0.05 * g / np.sqrt(h + 1e-7)
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+@pytest.mark.parametrize("name", ['sgd', 'nag', 'adam', 'adagrad', 'rmsprop',
+                                  'adadelta', 'ftrl', 'adamax', 'nadam',
+                                  'signum', 'sgld', 'dcasgd'])
+def test_all_optimizers_step(name):
+    """Every registered optimizer must take a step without error and move
+    the weights."""
+    o = opt.create(name, learning_rate=0.01)
+    got = _run_steps(o, W0, GRADS, nsteps=2)
+    assert got.shape == W0.shape
+    assert np.isfinite(got).all()
+    assert np.abs(got - W0).sum() > 0
+
+
+def test_lr_mult_wd_mult():
+    o = opt.create('sgd', learning_rate=0.1, wd=0.1,
+                   param_idx2name={0: 'w_weight', 1: 'b_bias'})
+    o.set_lr_mult({'w_weight': 0.5})
+    assert o._get_lr(0) == pytest.approx(0.05)
+    # bias gets wd_mult 0 automatically (reference behavior)
+    assert o._get_wd(1) == pytest.approx(0.0)
+    assert o._get_wd(0) == pytest.approx(0.1)
+
+
+def test_updater_and_states_roundtrip(tmp_path):
+    o = opt.create('sgd', learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = mx.nd.array(W0.copy())
+    u(0, mx.nd.array(GRADS[0]), w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.create('sgd', learning_rate=0.1, momentum=0.9))
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_lr_scheduler_factor():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(5) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    s = mx.lr_scheduler.MultiFactorScheduler(step=[5, 9], factor=0.1)
+    s.base_lr = 1.0
+    assert s(1) == 1.0
+    assert s(6) == pytest.approx(0.1)
+    assert s(10) == pytest.approx(0.01)
+
+
+def test_lr_scheduler_poly():
+    s = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert s(0) == 1.0
+    assert s(100) == 0.0
+    assert 0 < s(50) < 1.0
