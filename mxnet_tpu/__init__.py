@@ -39,5 +39,6 @@ from . import module
 from . import module as mod
 from .module import Module, BaseModule
 from . import serialization
+from . import models
 
 from .ndarray import NDArray

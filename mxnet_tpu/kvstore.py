@@ -191,8 +191,6 @@ def create(name="local") -> KVStore:
     if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
                 "device", "tpu", "dist_sync", "dist_device_sync", "dist",
                 "nccl"):
-        if name == "dist_async":
-            pass
         return KVStore(name)
     if name == "dist_async":
         raise MXNetError(

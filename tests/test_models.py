@@ -1,0 +1,71 @@
+"""Model zoo coverage: every builder constructs, infers shape, and runs one
+forward/backward on tiny inputs (mirrors reference symbols/ being exercised
+by example configs + test_forward.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+SMALL = [
+    ("mlp", dict(num_classes=10), (2, 1, 28, 28)),
+    ("lenet", dict(num_classes=10), (2, 1, 28, 28)),
+    ("resnet", dict(num_layers=18, num_classes=10,
+                    image_shape="3,32,32"), (2, 3, 32, 32)),
+    ("resnet", dict(num_layers=50, num_classes=10,
+                    image_shape="3,64,64"), (1, 3, 64, 64)),
+    ("resnext", dict(num_layers=50, num_classes=10,
+                     image_shape="3,64,64", num_group=4), (1, 3, 64, 64)),
+    ("mobilenet", dict(num_classes=10, multiplier=0.25), (1, 3, 64, 64)),
+    ("squeezenet", dict(num_classes=10), (1, 3, 64, 64)),
+]
+
+LARGE = [
+    ("alexnet", dict(num_classes=1000), (1, 3, 224, 224)),
+    ("vgg", dict(num_layers=11, num_classes=1000), (1, 3, 224, 224)),
+    ("inception-bn", dict(num_classes=1000), (1, 3, 224, 224)),
+    ("inception-v3", dict(num_classes=1000), (1, 3, 299, 299)),
+]
+
+
+@pytest.mark.parametrize("net,kwargs,dshape", SMALL)
+def test_small_models_forward_backward(net, kwargs, dshape):
+    symbol = models.get_symbol(net, **kwargs)
+    arg_shapes, out_shapes, _ = symbol.infer_shape(data=dshape)
+    assert out_shapes[0] == (dshape[0], kwargs["num_classes"])
+    ex = symbol.simple_bind(mx.cpu(), data=dshape,
+                            softmax_label=(dshape[0],))
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (dshape[0], kwargs["num_classes"])
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-4)
+    ex.backward()
+    # every trainable arg got a gradient
+    for name, g in ex.grad_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        assert np.isfinite(g.asnumpy()).all(), name
+
+
+@pytest.mark.parametrize("net,kwargs,dshape", LARGE)
+def test_large_models_shape_only(net, kwargs, dshape):
+    symbol = models.get_symbol(net, **kwargs)
+    arg_shapes, out_shapes, _ = symbol.infer_shape(data=dshape)
+    assert out_shapes[0] == (dshape[0], kwargs["num_classes"])
+
+
+def test_resnet50_imagenet_shapes():
+    symbol = models.resnet(num_layers=50, num_classes=1000,
+                           image_shape="3,224,224")
+    args = symbol.list_arguments()
+    arg_shapes, out_shapes, _ = symbol.infer_shape(data=(2, 3, 224, 224))
+    n_params = sum(int(np.prod(s)) for name, s in zip(args, arg_shapes)
+                   if name not in ("data", "softmax_label"))
+    # ResNet-50 ~25.5M params (reference zoo resnet-50 checkpoint size)
+    assert 24e6 < n_params < 27e6, n_params
+
+
+def test_unknown_network():
+    with pytest.raises(ValueError):
+        models.get_symbol("nonexistent")
