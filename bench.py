@@ -1,0 +1,71 @@
+"""Benchmark: ResNet-50 fused training-step throughput on one real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's published ResNet-50 training speed — 109
+images/sec on 1× K80 at batch 32 (BASELINE.md,
+example/image-classification/README.md:147-157).  The measured step is the
+same work: forward + backward + SGD-momentum update at batch 32, driven
+through the framework's own Module API (bind/init/forward/backward/update),
+compiled by XLA into one program per step.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 109.0   # ResNet-50, 1x K80, batch 32
+BATCH = 32
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    sym = models.resnet(num_classes=1000, num_layers=50,
+                        image_shape=(3, 224, 224))
+    mod = mx.mod.Module(sym, context=mx.tpu(0))
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (BATCH, 3, 224, 224)).astype(np.float32)
+    y = rng.randint(0, 1000, (BATCH,)).astype(np.float32)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=BATCH)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian", magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9, "wd": 1e-4})
+    batch = next(iter(it))
+
+    def step():
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+
+    for _ in range(WARMUP):
+        step()
+    # sync: force params to materialize on host
+    mod.get_params()[0]["fc1_weight"].asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        step()
+    mod.get_params()[0]["fc1_weight"].asnumpy()
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_batch32",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

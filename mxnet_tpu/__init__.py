@@ -40,5 +40,7 @@ from . import module as mod
 from .module import Module, BaseModule
 from . import serialization
 from . import models
+from . import parallel
+from . import gluon
 
 from .ndarray import NDArray

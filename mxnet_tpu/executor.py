@@ -109,6 +109,9 @@ class Executor:
         self.grad_arrays = self._canon_grads(args_grad)
         self._monitor_callback = None
         self._monitor_all = False
+        self._mesh = None
+        self._arg_shardings = None   # name -> NamedSharding
+        self._aux_shardings = None
 
         self._out_arrays: Optional[List[NDArray]] = None
         self._snapshot = None
@@ -247,11 +250,42 @@ class Executor:
             self.forward(self._is_train)
         return self._out_arrays
 
+    def set_shardings(self, mesh, arg_pspecs, aux_pspecs=None):
+        """Annotate arguments with mesh shardings (mxnet_tpu.parallel).
+
+        Every subsequent forward/backward/fused step runs as ONE SPMD
+        program over ``mesh`` — GSPMD inserts the gradient psum that the
+        reference implemented as kvstore push/pull (comm.h:462) and the
+        activation collectives that `group2ctx` placement implemented as
+        _CrossDeviceCopy nodes (graph_executor.cc:403)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._mesh = mesh
+        self._arg_shardings = {
+            n: NamedSharding(mesh, arg_pspecs.get(n, PartitionSpec()))
+            for n in self._arg_names}
+        self._aux_shardings = {
+            n: NamedSharding(mesh, (aux_pspecs or {}).get(n, PartitionSpec()))
+            for n in self._aux_names}
+
+    def _sharded(self, val, sh):
+        if sh is None:
+            return val
+        cur = getattr(val, "sharding", None)
+        if cur is not None and cur == sh:
+            return val
+        return jax.device_put(val, sh)
+
     def _arg_vals(self):
-        return tuple(a._data for a in self.arg_arrays)
+        if self._arg_shardings is None:
+            return tuple(a._data for a in self.arg_arrays)
+        return tuple(self._sharded(a._data, self._arg_shardings[n])
+                     for n, a in zip(self._arg_names, self.arg_arrays))
 
     def _aux_vals(self):
-        return tuple(a._data for a in self.aux_arrays)
+        if self._aux_shardings is None:
+            return tuple(a._data for a in self.aux_arrays)
+        return tuple(self._sharded(a._data, self._aux_shardings[n])
+                     for n, a in zip(self._aux_names, self.aux_arrays))
 
     def _out_aval_list(self, is_train):
         cache = getattr(self, "_aval_cache", None)
@@ -384,6 +418,13 @@ class Executor:
         for n, a in self.aux_dict.items():
             if n in new.aux_dict and new.aux_dict[n].shape == a.shape:
                 new.aux_dict[n]._set_data(a._data)
+        if self._mesh is not None:
+            # carry the sharding annotations over (pspecs are rank-generic,
+            # so the same specs apply to the reshaped arrays)
+            new.set_shardings(
+                self._mesh,
+                {n: s.spec for n, s in self._arg_shardings.items()},
+                {n: s.spec for n, s in self._aux_shardings.items()})
         return new
 
     def set_monitor_callback(self, callback, monitor_all=False):

@@ -1,0 +1,17 @@
+"""Gluon: the imperative high-level API (reference: python/mxnet/gluon/).
+
+TPU-native design notes: Parameters are single logical (possibly
+mesh-sharded) jax arrays, hybridization compiles to jit-cached XLA
+programs (block.py), and Trainer's gradient allreduce is fused into
+backward by GSPMD (trainer.py).
+"""
+from .parameter import (Parameter, Constant, ParameterDict,
+                        DeferredInitializationError)
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import model_zoo
+from . import utils
+from .utils import split_and_load

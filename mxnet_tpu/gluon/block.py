@@ -1,0 +1,455 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+TPU-native re-design of python/mxnet/gluon/block.py (Block :120,
+HybridBlock :305, SymbolBlock :497).  The reference's hybridization caches
+an nnvm graph in a CachedOp (`_build_cache` block.py:364 →
+imperative::CachedOp, src/imperative/cached_op.cc); here hybridization
+traces ``hybrid_forward(F=symbol, ...)`` into a Symbol once per input
+signature and jit-compiles its interpreter — the CachedOp *is* the XLA
+compilation cache.  Under ``autograd.record`` the whole cached program is
+recorded as ONE tape entry, exactly as the reference records the CachedOp
+as a single node (TIsLayerOpBackward, cached_op.cc:475).
+"""
+from __future__ import annotations
+
+import copy
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+
+from ..base import MXNetError
+from .. import name as _name_mod
+from ..ndarray import NDArray
+from .. import autograd
+from .. import random as _rnd
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+
+class _BlockScope:
+    """Name scoping for Blocks (reference: block.py:33 _BlockScope)."""
+    _current = None
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = _BlockScope._current
+        if current is None:
+            if prefix is None:
+                mgr = getattr(_name_mod.NameManager._current, 'value', None)
+                if mgr is None:
+                    mgr = _name_mod.NameManager()
+                    _name_mod.NameManager._current.value = mgr
+                prefix = mgr.get(None, hint) + '_'
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f'{hint}{count}_'
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = _BlockScope._current
+        _BlockScope._current = self
+        return self
+
+    def __exit__(self, *a):
+        _BlockScope._current = self._old_scope
+
+
+class Block:
+    """Base class of all neural-net layers/models (reference: block.py:120)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ''
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith('_') \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: List[Block] = []
+        self._reg_params: Dict[str, Parameter] = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = '{name}(\n{modstr}\n)'
+        modstr = '\n'.join(
+            f'  ({i}): {_indent(repr(b), 2)}'
+            for i, b in enumerate(self._children))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Registers children/params automatically (block.py:180)."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError(
+                    f"Changing attribute type for {name!r} from "
+                    f"{type(existing)} to {type(value)} is not allowed.")
+            if isinstance(existing, Block) and isinstance(value, Block):
+                # reassignment replaces the old child in place
+                self._children[self._children.index(existing)] = value
+                super().__setattr__(name, value)
+                return
+        if isinstance(value, Block):
+            self.register_child(value)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """All Parameters of this block and children
+        (reference: block.py:228; `select` regex added in 1.x kept for
+        API parity)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self.params.items()
+                        if pat.match(k)})
+        for child in self._children:
+            ret.update(child.collect_params(select))
+        return ret
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer as init_mod
+        self.collect_params().initialize(
+            init or init_mod.Uniform(), ctx, verbose,
+            force_reinit=force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children:
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def save_params(self, filename):
+        """reference: block.py save_params."""
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        """reference: block.py load_params."""
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra, self.prefix)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Per-layer output-shape summary (print_summary analog)."""
+        lines = [f"{'Layer':<40}{'Output':<24}"]
+
+        def walk(b, x):
+            y = b(x)
+            lines.append(f"{b.name:<40}{str(getattr(y, 'shape', '?')):<24}")
+            return y
+        x = inputs[0]
+        for c in self._children:
+            x = walk(c, x)
+        return '\n'.join(lines)
+
+
+def _indent(s, n):
+    pad = ' ' * n
+    return ('\n' + pad).join(s.split('\n'))
+
+
+class _CachedGraph:
+    """The CachedOp equivalent: Symbol traced from hybrid_forward +
+    jit-compiled interpreter, keyed by input signature
+    (reference: cached_op.cc GetForwardGraph :175 per-config caching)."""
+
+    def __init__(self, sym, data_names, param_names):
+        from ..executor import build_interpreter
+        self.sym = sym
+        run, arg_names, aux_names = build_interpreter(sym)
+        self.run = run
+        self.arg_names = arg_names
+        self.aux_names = aux_names
+        self.data_names = data_names
+        self.param_names = param_names
+        self._jit = jax.jit(
+            lambda args, aux, key, t: run(args, aux, key, t),
+            static_argnums=(3,))
+
+    def __call__(self, data_vals, param_map, aux_map, is_train):
+        by_name = dict(zip(self.data_names, data_vals))
+        by_name.update(param_map)
+        args = tuple(by_name[n] for n in self.arg_names)
+        aux = tuple(aux_map[n] for n in self.aux_names)
+        key = _rnd.next_key()
+
+        is_train = bool(is_train)
+        if autograd.is_recording():
+            # record the WHOLE cached program as one tape entry; train mode
+            # follows autograd.is_training() (record(train_mode=False) must
+            # keep Dropout/BN in inference mode, autograd.py:34-100)
+            run = self.run
+            n_args = len(args)
+
+            def fn(key, *vals, **_):
+                a, x = vals[:n_args], vals[n_args:]
+                outs, new_aux = run(a, x, key, is_train)
+                return tuple(outs) + tuple(new_aux)
+            vals = args + aux
+            outs, new_aux = self._jit(args, aux, key, is_train)
+            return outs, new_aux, (fn, key, vals)
+        outs, new_aux = self._jit(args, aux, key, is_train)
+        return outs, new_aux, None
+
+
+class HybridBlock(Block):
+    """reference: block.py:305 HybridBlock."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graphs: Dict[tuple, _CachedGraph] = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_graphs = {}
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_graphs = {}
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Deferred-shape inference: trace symbolically with the input
+        shapes and finish deferred param init
+        (reference: block.py _infer_attrs/infer_shape)."""
+        self._deferred_infer(args)
+
+    def _deferred_infer(self, args):
+        from .. import symbol as sym_mod
+        params = {**{n: p for n, p in self._reg_params.items()}}
+        with autograd.pause():
+            inputs = [sym_mod.Variable(f'data{i}')
+                      for i in range(len(args))]
+            try:
+                out = self.hybrid_forward(
+                    sym_mod, *inputs,
+                    **{n: p.var() for n, p in params.items()})
+            except DeferredInitializationError:
+                raise MXNetError(
+                    f"{self.name}: cannot infer shapes symbolically")
+            out = _flatten_output(out)
+            shapes = {f'data{i}': tuple(a.shape)
+                      for i, a in enumerate(args)}
+            grouped = sym_mod.Group(out) if len(out) > 1 else out[0]
+            arg_shapes, _, aux_shapes = grouped.infer_shape_partial(**shapes)
+            names = grouped.list_arguments()
+            aux_names = grouped.list_auxiliary_states()
+            shape_of = dict(zip(names, arg_shapes))
+            shape_of.update(dict(zip(aux_names, aux_shapes)))
+            for p in self.collect_params().values():
+                if p._deferred_init is not None and p.name in shape_of:
+                    p._finish_deferred_init(shape_of[p.name])
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            from .. import ndarray as nd_mod
+            params_uninit = [p for p in self._reg_params.values()
+                             if p._deferred_init is not None]
+            if params_uninit:
+                self._deferred_infer((x,) + args)
+            if self._active:
+                return self._call_cached(x, *args)
+            try:
+                pdata = {n: p.data() for n, p in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer((x,) + args)
+                pdata = {n: p.data() for n, p in self._reg_params.items()}
+            return self.hybrid_forward(nd_mod, x, *args, **pdata)
+        from .. import symbol as sym_mod
+        pvars = {n: p.var() for n, p in self._reg_params.items()}
+        return self.hybrid_forward(sym_mod, x, *args, **pvars)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- cached (hybridized) path ------------------------------------------
+    def _trace_symbol(self, n_inputs):
+        from .. import symbol as sym_mod
+        inputs = [sym_mod.Variable(f'data{i}') for i in range(n_inputs)]
+        out = self(*inputs)
+        out = _flatten_output(out)
+        sym = sym_mod.Group(out) if len(out) > 1 else out[0]
+        return sym, [f'data{i}' for i in range(n_inputs)]
+
+    def _call_cached(self, *args):
+        params = self.collect_params()
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        cg = self._cached_graphs.get(sig)
+        if cg is None:
+            sym, data_names = self._trace_symbol(len(args))
+            cg = _CachedGraph(sym, data_names,
+                              [p.name for p in params.values()])
+            self._cached_graphs[sig] = cg
+        # finish deferred param init from the traced graph's shapes
+        # (reference: _build_cache → infer_shape → _finish_deferred_init)
+        deferred = [p for p in params.values()
+                    if p._deferred_init is not None]
+        if deferred:
+            shapes = {dn: tuple(a.shape)
+                      for dn, a in zip(cg.data_names, args)}
+            arg_shapes, _, aux_shapes = cg.sym.infer_shape_partial(**shapes)
+            shape_of = dict(zip(cg.sym.list_arguments(), arg_shapes or []))
+            shape_of.update(zip(cg.sym.list_auxiliary_states(),
+                                aux_shapes or []))
+            for p in deferred:
+                if shape_of.get(p.name):
+                    p._finish_deferred_init(shape_of[p.name])
+        param_map = {}
+        aux_map = {}
+        for n in cg.arg_names:
+            if n in cg.data_names:
+                continue
+            param_map[n] = params[n].data()._data
+        for n in cg.aux_names:
+            aux_map[n] = params[n].data()._data
+        data_vals = tuple(a._data for a in args)
+        is_train = autograd.is_training()
+        outs, new_aux, rec = cg(data_vals, param_map, aux_map, is_train)
+
+        out_arrays = [NDArray(o) for o in outs]
+        aux_arrays = []
+        if is_train:
+            for n, v in zip(cg.aux_names, new_aux):
+                params[n].data()._set_data(v)
+                aux_arrays.append(params[n].data())
+        if rec is not None:
+            fn, key, vals = rec
+            name_to_arr = dict(zip(cg.data_names, args))
+            # in_arrays aligned 1:1 with vals = args(arg_names order) + aux
+            in_arrays = [name_to_arr[n] if n in name_to_arr
+                         else params[n].data() for n in cg.arg_names] + \
+                        [params[n].data() for n in cg.aux_names]
+            autograd._record(fn, {}, in_arrays, list(vals),
+                             out_arrays + aux_arrays, rng_key=key,
+                             n_keep=len(out_arrays) + len(aux_arrays))
+        if len(out_arrays) == 1:
+            return out_arrays[0]
+        return out_arrays
+
+    def export(self, path, epoch=0):
+        """Save symbol + params like the reference's HybridBlock.export."""
+        if not self._cached_graphs:
+            raise MXNetError("run forward at least once before export()")
+        cg = next(iter(self._cached_graphs.values()))
+        cg.sym.save(f'{path}-symbol.json')
+        from .. import serialization
+        params = self.collect_params()
+        arg = {}
+        for n in cg.arg_names:
+            if n in cg.data_names:
+                continue
+            arg['arg:' + n] = params[n].data()
+        for n in cg.aux_names:
+            arg['aux:' + n] = params[n].data()
+        serialization.save_ndarrays('%s-%04d.params' % (path, epoch), arg)
+
+
+def _flatten_output(out):
+    if isinstance(out, (list, tuple)):
+        res = []
+        for o in out:
+            res.extend(_flatten_output(o))
+        return res
+    return [out]
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap an existing Symbol as a callable block
+    (reference: block.py:497)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        # raw symbol names ARE the param names (reference: block.py:497
+        # SymbolBlock builds its dict with an empty prefix)
+        self._params = ParameterDict('', params)
+        from .. import symbol as sym_mod
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        self._output_sym = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        for n in arg_names:
+            if n not in self._input_names:
+                self.params.get(n, allow_deferred_init=True,
+                                grad_req='null')
+        for n in aux_names:
+            self.params.get(n, allow_deferred_init=True, grad_req='null')
+        self._cached = None
+
+    def forward(self, *args):
+        from ..executor import build_interpreter
+        params = self.collect_params()
+        if self._cached is None:
+            run, arg_names, aux_names = build_interpreter(self._output_sym)
+            self._cached = (jax.jit(
+                lambda a, x, k: run(a, x, k, False)), arg_names, aux_names)
+        jfn, arg_names, aux_names = self._cached
+        by_name = dict(zip(self._input_names, (a._data for a in args)))
+        for n in arg_names:
+            if n not in by_name:
+                by_name[n] = params[n].data()._data
+        aux = tuple(params[n].data()._data for n in aux_names)
+        outs, _ = jfn(tuple(by_name[n] for n in arg_names), aux,
+                      _rnd.next_key())
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs

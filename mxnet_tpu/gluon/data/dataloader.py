@@ -1,0 +1,81 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py).
+
+The reference forks worker processes that serialize NDArrays over pipes;
+here workers are a thread pool (the heavy lifting — decode/augment — is
+numpy/PIL releasing the GIL, and device transfer happens once per batch on
+the main thread, overlapped with compute by XLA's async dispatch).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...ndarray import NDArray
+from ...ndarray.ndarray import array as nd_array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py:36)."""
+    if isinstance(data[0], NDArray):
+        return nd_array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd_array(data)
+
+
+class DataLoader:
+    """reference: dataloader.py:66."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or 'keep')
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+
+    def __iter__(self):
+        if self._num_workers > 0:
+            from collections import deque
+
+            def fetch(batch):
+                return self._batchify_fn([self._dataset[i] for i in batch])
+
+            with ThreadPoolExecutor(self._num_workers) as pool:
+                # bounded prefetch window (~2 batches per worker): keeps the
+                # pool busy without materializing the whole epoch in memory
+                pending = deque()
+                it = iter(self._batch_sampler)
+                for batch in it:
+                    pending.append(pool.submit(fetch, batch))
+                    if len(pending) >= 2 * self._num_workers:
+                        yield pending.popleft().result()
+                while pending:
+                    yield pending.popleft().result()
+        else:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+
+    def __len__(self):
+        return len(self._batch_sampler)

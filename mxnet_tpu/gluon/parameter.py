@@ -1,0 +1,370 @@
+"""Gluon Parameter / ParameterDict.
+
+TPU-native re-design of the reference's python/mxnet/gluon/parameter.py
+(Parameter :43, ParameterDict :416).  The reference keeps one NDArray copy
+per GPU context (`_init_impl` → `_data` list) and cross-reduces gradients
+(`_reduce` :245); here a parameter owns ONE logical jax-backed NDArray —
+multi-chip placement is a *sharding* of that array over the active mesh
+(mxnet_tpu.parallel), not replication-by-copy, so `list_data` has a single
+element and Trainer's gradient aggregation is a GSPMD psum.
+
+Deferred initialization is kept: shape entries of 0 are unknown until the
+first forward's input shapes arrive (parameter.py:585 _finish_deferred_init).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from ..ndarray.ndarray import zeros as nd_zeros, array as nd_array
+from .. import initializer as init_mod
+from .. import autograd
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter used before its shape is known (parameter.py:35)."""
+
+
+class Parameter:
+    """A weight/bias tensor with lazy shape + initializer.
+
+    reference: gluon/parameter.py:43.
+    """
+
+    def __init__(self, name, grad_req='write', shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype='default', grad_stype='default'):
+        self.name = name
+        self._grad_req = grad_req if differentiable else 'null'
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = None   # (init, ctx, default_init)
+        self._trainer = None
+        self._stype = stype
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={np.dtype(self.dtype).name if self.dtype else None})")
+
+    # -- grad_req -----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ('write', 'add', 'null')
+        if not self._differentiable:
+            req = 'null'
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == 'null':
+            self._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    # -- init ---------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """reference: parameter.py:303 initialize."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self.shape is None or any(s == 0 for s in (self.shape or ())):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter {self.name!r}: unknown shape "
+                f"{self.shape} and allow_deferred_init=False")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = nd_zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
+        explicit = init or self.init
+        if isinstance(explicit, str):
+            explicit = init_mod.create(explicit)
+        if explicit is not None:
+            # per-parameter init applies regardless of the name pattern
+            # (reference: initializer.py __call__ '__init__' attr path)
+            explicit._init_weight(init_mod.InitDesc(self.name), data)
+        else:
+            initializer = default_init
+            if isinstance(initializer, str):
+                initializer = init_mod.create(initializer)
+            initializer(init_mod.InitDesc(self.name), data)
+        self._data = data
+        self._deferred_init = None
+        if self._grad_req != 'null':
+            self._init_grad()
+
+    def _finish_deferred_init(self, shape):
+        """Complete deferred init once the input-driven shape is known
+        (reference: parameter.py:585)."""
+        if self._deferred_init is None:
+            raise DeferredInitializationError(self.name)
+        if self.shape is not None and len(self.shape) == len(shape):
+            # merge known dims (0 = unknown)
+            merged = tuple(s if s != 0 else t
+                           for s, t in zip(self.shape, shape))
+        else:
+            merged = tuple(shape)
+        if any(s == 0 for s in merged):
+            raise MXNetError(f"deferred init of {self.name!r}: shape "
+                             f"{merged} still has unknown dims")
+        self.shape = merged
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _init_grad(self):
+        self._grad = nd_zeros(self.shape, dtype=self.dtype)
+        autograd.mark_variables([self._data], [self._grad],
+                                [self._grad_req])
+
+    # -- access -------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} has not been initialized yet "
+                f"because initialization was deferred (unknown shape). "
+                f"Run a forward pass first")
+        raise MXNetError(
+            f"Parameter {self.name!r} has not been initialized. "
+            f"You should initialize parameters (e.g. net.initialize()) "
+            f"before use")
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient of Parameter {self.name!r}: "
+                f"grad_req='null'")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def set_data(self, data):
+        """reference: parameter.py set_data."""
+        if self._data is None:
+            if self._deferred_init is not None:
+                self.shape = tuple(data.shape)
+                init, ctx, default_init = self._deferred_init
+                self._finish_init(init, ctx, default_init)
+            else:
+                self._check_initialized()
+        if isinstance(data, NDArray):
+            self._data._set_data(data._data)
+        else:
+            self._data._set_data(nd_array(data)._data)
+
+    def reset_ctx(self, ctx):
+        pass  # single logical array; placement = sharding
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                autograd.mark_variables([self._data], [self._grad],
+                                        [self._grad_req])
+
+    # -- symbol bridge ------------------------------------------------------
+    def var(self):
+        from .. import symbol as sym
+        shape = self.shape
+        if shape is not None and any(s == 0 for s in shape):
+            shape = None   # unknown dims: let graph inference back-fill
+        return sym.Variable(self.name, shape=shape, dtype=self.dtype)
+
+
+class Constant(Parameter):
+    """A non-differentiable parameter with a fixed value
+    (reference: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd_array(value)
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(_self, _name, arr):
+                arr[:] = value
+
+        super().__init__(name, grad_req='null', shape=value.shape,
+                         dtype=value.dtype, init=_CInit(),
+                         differentiable=False)
+
+
+class ParameterDict:
+    """Prefix-scoped dict of Parameters (reference: parameter.py:416)."""
+
+    def __init__(self, prefix='', shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = '\n'.join(f'  {v}' for v in self._params.values())
+        return f"ParameterDict {self._prefix!r} (\n{s}\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get or create a Parameter named prefix+name
+        (reference: parameter.py:472)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == 'shape' and v is not None:
+                        v = tuple(v)
+                        if existing is not None and len(existing) == len(v):
+                            merged = tuple(
+                                a if a != 0 else b
+                                for a, b in zip(existing, v))
+                            param.shape = merged
+                            continue
+                    if v is not None and existing != v and k in (
+                            'dtype',):
+                        raise AssertionError(
+                            f"Parameter {name!r} {k} mismatch: "
+                            f"{existing} vs {v}")
+                elif v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"no constant {name!r} and no value given")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k!r}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """reference: parameter.py:800."""
+        if init is None:
+            init = init_mod.Uniform()
+        for v in self._params.values():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self._params.values():
+            v.zero_grad()
+
+    def setattr(self, name, value):
+        for v in self._params.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=''):
+        """reference: parameter.py save → NDArray map file
+        (serialization.py format)."""
+        from .. import serialization
+        arg = {}
+        for p in self._params.values():
+            nm = p.name
+            if strip_prefix and nm.startswith(strip_prefix):
+                nm = nm[len(strip_prefix):]
+            arg[nm] = p._data if p._data is not None else None
+            if arg[nm] is None:
+                raise MXNetError(f"cannot save uninitialized param {p.name!r}")
+        serialization.save_ndarrays(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=''):
+        """reference: parameter.py load."""
+        from .. import serialization
+        loaded = serialization.load_ndarrays(filename)
+        loaded = {restore_prefix + k.split(':', 1)[-1]: v
+                  for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise MXNetError(f"param {name!r} missing in {filename}")
+        for name, v in loaded.items():
+            if name not in self._params:
+                if ignore_extra:
+                    continue
+                raise MXNetError(
+                    f"param {name!r} in file not in ParameterDict; "
+                    f"set ignore_extra=True to skip")
+            self._params[name].set_data(v)

@@ -1,0 +1,98 @@
+"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py:27).
+
+The reference's step() pushes gradients to a kvstore (allreduce across
+devices) and pulls updated weights (trainer.py:148).  Here a parameter is
+ONE logical array (possibly mesh-sharded), so `step` = run the optimizer
+update on each param's gradient; cross-chip gradient reduction already
+happened inside the backward program (GSPMD psum).  The kvstore argument is
+accepted for API parity and drives update_on_kvstore semantics.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .. import kvstore as kvs_mod
+from .parameter import ParameterDict, Parameter
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore='device', compression_params=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}")
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(f"not a Parameter: {param!r}")
+            param._trainer = self
+            self._params.append(param)
+        self._scale = 1.0
+        optimizer_params = dict(optimizer_params or {})
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = param_dict
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """reference: trainer.py:102 — create the store lazily at first
+        step; on TPU it is a facade over in-program collectives."""
+        if self._kv_type:
+            self._kvstore = kvs_mod.create(self._kv_type) \
+                if isinstance(self._kv_type, str) else self._kv_type
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """reference: trainer.py:148."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null':
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        f"Parameter {param.name!r} was not initialized")
+                continue
+            updater(i, param.grad(), param.data())
+
+    def allreduce_grads(self):
+        """No-op on TPU: gradient reduction is fused into backward
+        (GSPMD psum) — kept for API parity (reference: trainer.py
+        allreduce_grads)."""
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self.step(batch_size, ignore_stale_grad)
+
+    def save_states(self, fname):
+        """reference: trainer.py save_states."""
+        with open(fname, 'wb') as fout:
+            fout.write(self._updaters[0].get_states())
+
+    def load_states(self, fname):
+        with open(fname, 'rb') as fin:
+            self._updaters[0].set_states(fin.read())

@@ -37,7 +37,7 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=('data',),
                  label_names=('softmax_label',), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, mesh=None, sharding_rules=None):
         super().__init__(logger=logger)
         if context is None:
             context = [current_context()]
@@ -45,6 +45,21 @@ class Module(BaseModule):
             context = [context]
         self._context = context
         self._work_load_list = work_load_list
+        # -- mesh parallelism (mxnet_tpu.parallel) -------------------------
+        # The reference replicated one executor per context and split the
+        # batch host-side (executor_group.py:99,233).  Here a context list
+        # becomes a dp mesh over those devices, and an explicit `mesh`
+        # (or an ambient parallel.use_mesh scope) enables arbitrary
+        # dp/tp/pp/sp/ep layouts on the SAME Module code path.
+        from .. import parallel as _par
+        if mesh is None:
+            mesh = _par.current_mesh()
+        if mesh is None and len(context) > 1:
+            mesh = _par.make_mesh(
+                dp=len(context),
+                devices=[c.jax_device() for c in context])
+        self._mesh = mesh
+        self._sharding_rules = sharding_rules
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -218,6 +233,7 @@ class Module(BaseModule):
         self._exec = Executor.simple_bind(
             self._symbol, self._context[0], grad_req=req,
             type_dict=type_dict, shapes=shapes)
+        self._apply_shardings()
         self._fused_step = None
         if self.params_initialized:
             # params loaded before bind (Module.load) — copy into executor
@@ -235,6 +251,32 @@ class Module(BaseModule):
         self.binded = False
         self._exec = None
         self._fused_step = None
+
+    def _apply_shardings(self):
+        """Annotate the executor's args with mesh shardings: inputs batch-
+        sharded over dp, params per the rules (default replicated)."""
+        if self._mesh is None or self._exec is None:
+            return
+        from .. import parallel as _par
+        mesh = self._mesh
+        dp = _par.mesh_shape(mesh).get("dp", 1)
+        pspecs = {}
+        io_names = set(self._data_names) | set(self._label_names)
+        for n, arr in self._exec.arg_dict.items():
+            if n in io_names:
+                if dp > 1 and arr.ndim and arr.shape[0] % dp:
+                    raise MXNetError(
+                        f"batch dim of {n!r} ({arr.shape[0]}) not divisible "
+                        f"by dp={dp}; pad the batch (NDArrayIter pads the "
+                        f"final partial batch)")
+                pspecs[n] = _par.data_pspec(arr.ndim)
+            else:
+                pspecs[n] = _par.infer_pspec(n, arr.shape, mesh,
+                                             self._sharding_rules)
+        aux_pspecs = {
+            n: _par.infer_pspec(n, a.shape, mesh, self._sharding_rules)
+            for n, a in self._exec.aux_dict.items()}
+        self._exec.set_shardings(mesh, pspecs, aux_pspecs)
 
     # -- optimizer ------------------------------------------------------------
     def init_optimizer(self, kvstore='local', optimizer='sgd',
@@ -318,6 +360,7 @@ class Module(BaseModule):
         new = {n: tuple(kwargs[n].shape) for n in io_names if n in kwargs}
         if any(cur.get(n) != s for n, s in new.items()):
             self._exec = self._exec.reshape(**new)
+            self._apply_shardings()
             self._fused_step = None
         self._exec.forward(is_train=is_train, **kwargs)
         self._pending_backward = False

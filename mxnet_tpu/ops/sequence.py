@@ -6,6 +6,7 @@ Sequence axis is 0 (TNC layout) unless noted, matching the reference.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -55,3 +56,84 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
     src = jnp.where(t < lengths[None, :], lengths[None, :] - 1 - t, t)  # (T,N)
     src = src.reshape((T,) + (src.shape[1],) + (1,) * (data.ndim - 2))
     return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)
+
+
+# --------------------------------------------------------------------------
+# CTC loss (reference: src/operator/contrib/ctc_loss.cc + plugin/warpctc).
+# The reference binds Baidu warp-ctc CUDA kernels; here the standard CTC
+# forward algorithm runs in log space as a lax.scan over time — XLA compiles
+# the whole recursion, and jax.vjp differentiates it (no hand-written
+# backward as warp-ctc needs).
+# --------------------------------------------------------------------------
+@register("CTCLoss",
+          arg_names=["data", "label", "data_lengths", "label_lengths"],
+          attr_defaults={"use_data_lengths": False,
+                         "use_label_lengths": False,
+                         "blank_label": "first"},
+          aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", **kw):
+    """data: (T, N, C) activations; label: (N, L) padded.  Returns (N,).
+
+    blank_label='first': channel 0 is blank, 0 pads labels;
+    'last': channel C-1 is blank, -1 pads labels (contrib/ctc_loss.cc doc).
+    """
+    if use_label_lengths and not use_data_lengths and \
+            label_lengths is None and data_lengths is not None:
+        # only label_lengths was supplied: positional input filtering put
+        # it in the data_lengths slot — the use_* flags disambiguate
+        label_lengths, data_lengths = data_lengths, None
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        blank = 0
+        pad = 0
+    else:
+        blank = C - 1
+        pad = -1
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum((lab != pad).astype(jnp.int32), axis=1)
+    if use_data_lengths and data_lengths is not None:
+        dat_len = data_lengths.astype(jnp.int32)
+    else:
+        dat_len = jnp.full((N,), T, jnp.int32)
+
+    S = 2 * L + 1
+    NEG = jnp.float32(-1e30)
+
+    def one(lp, lb, t_n, l_n):
+        # lp: (T, C); lb: (L,)
+        z = jnp.full((S,), blank, jnp.int32).at[1::2].set(lb)
+        z_prev2 = jnp.concatenate(
+            [jnp.full((2,), -1, jnp.int32), z[:-2]])
+        can_skip = (z != blank) & (z != z_prev2)
+        smask = jnp.arange(S) < 2 * l_n + 1
+
+        alpha0 = jnp.full((S,), NEG)
+        alpha0 = alpha0.at[0].set(lp[0, blank])
+        alpha0 = alpha0.at[1].set(
+            jnp.where(l_n > 0, lp[0, z[1]], NEG))
+        alpha0 = jnp.where(smask, alpha0, NEG)
+        final0 = jnp.where(t_n == 1, alpha0, jnp.full((S,), NEG))
+
+        def step(carry, t):
+            a_prev, final = carry
+            p1 = jnp.concatenate([jnp.array([NEG]), a_prev[:-1]])
+            p2 = jnp.concatenate([jnp.array([NEG, NEG]), a_prev[:-2]])
+            p2 = jnp.where(can_skip, p2, NEG)
+            a = jnp.logaddexp(jnp.logaddexp(a_prev, p1), p2) + lp[t, z]
+            a = jnp.where(smask, a, NEG)
+            final = jnp.where(t == t_n - 1, a, final)
+            return (a, final), None
+
+        (_, final), _ = lax.scan(step, (alpha0, final0), jnp.arange(1, T))
+        end_blank = final[2 * l_n]
+        end_label = jnp.where(l_n > 0, final[2 * l_n - 1], NEG)
+        return -jnp.logaddexp(end_blank, end_label)
+
+    return jax.vmap(one)(jnp.moveaxis(logp, 1, 0), lab, dat_len, lab_len)

@@ -1,0 +1,30 @@
+"""mxnet_tpu.parallel — device-mesh parallelism.
+
+TPU-native replacement for the reference's entire distribution stack
+(SURVEY.md §2.5): DataParallelExecutorGroup (executor_group.py:99),
+CommCPU/CommDevice reduction trees (src/kvstore/comm.h:90,462) and the
+ps-lite parameter server (src/kvstore/kvstore_dist.h) all collapse into ONE
+mechanism — a :class:`jax.sharding.Mesh` plus named shardings on the jitted
+training step.  XLA/GSPMD inserts the allreduce/allgather collectives and
+routes them over ICI (intra-slice) or DCN (cross-slice); there are no
+parameter-server processes, no reduction threads, no P2P setup.
+
+Axes (all always present; unused axes have size 1):
+
+* ``dp`` — data parallel: batch dimension sharded; gradient psum inserted
+  by GSPMD (replaces kvstore push/pull).
+* ``tp`` — tensor parallel: weight matrices sharded along output features
+  (new capability; the reference only had manual `group2ctx` placement).
+* ``pp`` — pipeline parallel stage axis (used by parallel.pipeline).
+* ``sp`` — sequence/context parallel (ring attention, parallel.ring).
+* ``ep`` — expert parallel (MoE dispatch).
+"""
+from .mesh import (AXES, make_mesh, current_mesh, use_mesh, mesh_shape,
+                   data_pspec, replicated, named_sharding)
+from .sharding import (ShardingRules, infer_pspec, shard_params,
+                       shard_batch, tp_rules_for_symbol)
+
+__all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh", "mesh_shape",
+           "data_pspec", "replicated", "named_sharding", "ShardingRules",
+           "infer_pspec", "shard_params", "shard_batch",
+           "tp_rules_for_symbol"]

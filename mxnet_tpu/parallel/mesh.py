@@ -1,0 +1,90 @@
+"""Device-mesh construction and the active-mesh scope.
+
+The reference enumerates GPUs into per-device executors
+(executor_group.py:233 decide_slices); here devices form a logical
+N-dimensional :class:`jax.sharding.Mesh` whose axes name the parallelism
+kinds.  One jitted SPMD program spans the whole mesh.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+#: canonical axis order: data, pipeline, sequence, expert, tensor.
+#: tp is last so tensor-sharded matmuls ride the fastest (innermost) ICI
+#: links; dp is outermost so its gradient allreduce tolerates DCN hops on
+#: multi-slice topologies (scaling-book recipe: collectives that move the
+#: most bytes per step get the closest links).
+AXES = ("dp", "pp", "sp", "ep", "tp")
+
+_state = threading.local()
+
+
+def make_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1,
+              sp: int = 1, ep: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all of them).
+
+    ``dp=None`` means "whatever is left over": dp = ndev // (tp*pp*sp*ep).
+    Every axis is always present (size-1 axes are free), so PartitionSpecs
+    written against :data:`AXES` work on any mesh shape.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    ndev = len(devices)
+    rest = tp * pp * sp * ep
+    if dp is None:
+        if ndev % rest:
+            raise MXNetError(
+                f"make_mesh: {ndev} devices not divisible by tp*pp*sp*ep={rest}")
+        dp = ndev // rest
+    if dp * rest != ndev:
+        raise MXNetError(
+            f"make_mesh: dp*tp*pp*sp*ep={dp * rest} != num devices {ndev}")
+    shape = {"dp": dp, "pp": pp, "sp": sp, "ep": ep, "tp": tp}
+    arr = np.array(devices).reshape([shape[a] for a in AXES])
+    return Mesh(arr, AXES)
+
+
+def mesh_shape(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def current_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Scope ``mesh`` as the active mesh (picked up by Module/Trainer when
+    no explicit mesh argument is given)."""
+    prev = current_mesh()
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def data_pspec(ndim: int, batch_axes=("dp",)) -> P:
+    """PartitionSpec for an input batch: dim 0 over dp (the reference's
+    decide_slices batch split), other dims unsharded."""
+    if ndim == 0:
+        return P()
+    return P(tuple(batch_axes), *([None] * (ndim - 1)))
+
+
+def replicated() -> P:
+    return P()
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

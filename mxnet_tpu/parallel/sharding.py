@@ -1,0 +1,118 @@
+"""Parameter/batch sharding rules.
+
+Replaces the reference's manual placement machinery (`group2ctx` attr →
+nnvm PlaceDevice pass, graph_executor.cc:317-431): instead of inserting
+_CrossDeviceCopy nodes, parameters get :class:`PartitionSpec` annotations
+and GSPMD propagates them through the jitted program.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import data_pspec, mesh_shape
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) rules; first match wins, default
+    replicated.  The TPU analog of the reference's per-name `__ctx_group__`
+    attributes (symbol attrs consulted by AssignContext)."""
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None):
+        self._rules: List[Tuple[re.Pattern, P]] = [
+            (re.compile(pat), spec) for pat, spec in (rules or [])]
+
+    def add(self, pattern: str, spec: P) -> "ShardingRules":
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, shape: Tuple[int, ...],
+                 mesh: Mesh) -> P:
+        sizes = mesh_shape(mesh)
+        for pat, spec in self._rules:
+            if pat.search(name):
+                if _spec_fits(spec, shape, sizes):
+                    return spec
+                break  # matched but indivisible → replicate
+        return P()
+
+    def __iter__(self):
+        return iter(self._rules)
+
+
+def _spec_fits(spec: P, shape, sizes) -> bool:
+    """A dim can be sharded only if divisible by the product of its axes."""
+    for dim, entry in zip(shape, tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        k = 1
+        for a in axes:
+            k *= sizes.get(a, 1)
+        if k > 1 and dim % k:
+            return False
+    return True
+
+
+def infer_pspec(name: str, shape, mesh: Mesh,
+                rules: Optional[ShardingRules]) -> P:
+    if rules is None:
+        return P()
+    return rules.spec_for(name, tuple(shape), mesh)
+
+
+def shard_params(params: Dict[str, "jax.Array"], mesh: Mesh,
+                 rules: Optional[ShardingRules] = None
+                 ) -> Dict[str, "jax.Array"]:
+    """device_put every param to its NamedSharding (replicated unless a
+    rule shards it)."""
+    out = {}
+    for n, v in params.items():
+        spec = infer_pspec(n, v.shape, mesh, rules)
+        out[n] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+def shard_batch(value, mesh: Mesh, batch_axes=("dp",)):
+    """Shard an input batch along dim 0 of the mesh's data axes."""
+    ndim = getattr(value, "ndim", 0)
+    return jax.device_put(value,
+                          NamedSharding(mesh, data_pspec(ndim, batch_axes)))
+
+
+def tp_rules_for_symbol(symbol, mesh: Mesh) -> ShardingRules:
+    """Derive tensor-parallel rules for a Symbol graph: FullyConnected
+    weights shard along output features (dim 0 — MXNet FC weight layout is
+    (num_hidden, in), ops/nn.py _fully_connected), their biases along dim 0,
+    Convolution weights along output channels (dim 0, OIHW).
+
+    This is the Megatron-style column split expressed as GSPMD annotations;
+    the compiler inserts the matching allgather/reduce-scatter.  New
+    capability vs the reference (SURVEY.md §2.5: tensor parallelism ABSENT).
+    """
+    rules = ShardingRules()
+    tp = mesh_shape(mesh).get("tp", 1)
+    if tp <= 1:
+        return rules
+    try:
+        nodes = symbol.nodes()
+    except Exception:
+        return rules
+    for n in nodes:
+        if n.is_variable:
+            continue
+        if n.op == "FullyConnected":
+            for src, _ in n.inputs:
+                if src.is_variable and src.name.endswith("weight"):
+                    rules.add(f"^{re.escape(src.name)}$", P("tp", None))
+                if src.is_variable and src.name.endswith("bias"):
+                    rules.add(f"^{re.escape(src.name)}$", P("tp"))
+        elif n.op == "Convolution":
+            for src, _ in n.inputs:
+                if src.is_variable and src.name.endswith("weight"):
+                    rules.add(f"^{re.escape(src.name)}$",
+                              P("tp", None, None, None))
+    return rules

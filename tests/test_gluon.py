@@ -1,0 +1,267 @@
+"""Gluon tests (model: tests/python/unittest/test_gluon.py,
+test_gluon_trainer.py, test_gluon_data.py — SURVEY.md §4)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter('weight', shape=(10, 10))
+    p.initialize(init='xavier')
+    assert p.shape == (10, 10)
+    assert p.data().shape == (10, 10)
+    assert len(p.list_data()) == 1
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_dict_scoping():
+    params = gluon.ParameterDict('net_')
+    p = params.get('weight', shape=(4, 4))
+    assert p.name == 'net_weight'
+    assert params.get('weight') is p
+
+
+def test_constant():
+    c = gluon.Constant('const', np.ones((2, 2)))
+    c.initialize()
+    assert c.grad_req == 'null'
+    np.testing.assert_allclose(c.data().asnumpy(), np.ones((2, 2)))
+
+
+def test_dense_eager_and_shapes():
+    net = nn.Dense(8, in_units=4, activation='relu')
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 4).astype('float32'))
+    y = net(x)
+    assert y.shape == (2, 8)
+    assert (y.asnumpy() >= 0).all()
+
+
+def test_deferred_init_and_hybridize_consistency():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation='relu'))
+            net.add(nn.Dense(5))
+        return net
+    x = mx.nd.array(np.random.RandomState(0).randn(6, 12).astype('float32'))
+    net = build()
+    net.initialize(mx.initializer.Xavier())
+    # eager forward triggers deferred init from input shape
+    y_eager = net(x).asnumpy()
+    assert net[0].weight.shape == (16, 12)
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(y_eager, y_hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_autograd_matches_eager():
+    """Gradients through the cached (hybridized) program must equal the
+    eager tape's (reference: CachedOp backward, cached_op.cc:385)."""
+    rng = np.random.RandomState(2)
+    x = mx.nd.array(rng.randn(4, 6).astype('float32'))
+    lbl = mx.nd.array(rng.randn(4, 3).astype('float32'))
+    L = gluon.loss.L2Loss()
+
+    def run(hybridize):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation='tanh'))
+            net.add(nn.Dense(3))
+        net.initialize(mx.initializer.Xavier(rnd_type='gaussian'))
+        if hybridize:
+            net.hybridize()
+        with autograd.record():
+            loss = L(net(x), lbl)
+        loss.backward()
+        return {k: p.grad().asnumpy()
+                for k, p in net.collect_params().items()
+                if p.grad_req != 'null'}
+
+    g_eager = run(False)
+    g_hybrid = run(True)
+    for (k1, v1), (k2, v2) in zip(sorted(g_eager.items()),
+                                  sorted(g_hybrid.items())):
+        np.testing.assert_allclose(v1, v2, rtol=1e-5, atol=1e-6,
+                                   err_msg=f'{k1}/{k2}')
+
+
+def test_conv2d_pool_batchnorm():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation('relu'))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8).astype('float32'))
+    y = net(x)
+    assert y.shape == (2, 4)
+    # BatchNorm updates running stats only under autograd.record(train)
+    rm_before = net[1].running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm_after = net[1].running_mean.data().asnumpy()
+    assert not np.allclose(rm_before, rm_after)
+
+
+def test_trainer_convergence():
+    """A tiny regression must converge — end-to-end Gluon training loop
+    (reference: tests/python/train/test_autograd.py style)."""
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(3, 5).astype('float32')
+    x_np = rng.randn(64, 5).astype('float32')
+    y_np = x_np @ w_true.T
+
+    net = nn.Dense(3, in_units=5, use_bias=False)
+    net.initialize(mx.initializer.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.5})
+    L = gluon.loss.L2Loss()
+    x, y = mx.nd.array(x_np), mx.nd.array(y_np)
+    for _ in range(100):
+        with autograd.record():
+            loss = L(net(x), y)
+        loss.backward()
+        trainer.step(64)
+    final = loss.asnumpy().mean()
+    assert final < 1e-3, final
+
+
+def test_losses_values():
+    pred = mx.nd.array(np.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]],
+                                'float32'))
+    lbl = mx.nd.array(np.array([2, 0], 'float32'))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, lbl).asnumpy()
+    logp = np.log(np.exp([[1, 2, 3], [1, 1, 1]]) /
+                  np.exp([[1, 2, 3], [1, 1, 1]]).sum(1, keepdims=True))
+    expect = -np.array([logp[0, 2], logp[1, 0]])
+    np.testing.assert_allclose(l, expect, rtol=1e-5)
+
+    p2 = mx.nd.array(np.array([[0.5], [-0.5]], 'float32'))
+    t2 = mx.nd.array(np.array([[1.0], [0.0]], 'float32'))
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(p2, t2).asnumpy()
+    sig = 1 / (1 + np.exp(-np.array([0.5, -0.5])))
+    expect2 = -np.array([np.log(sig[0]), np.log(1 - sig[1])])
+    np.testing.assert_allclose(bce, expect2, rtol=1e-5)
+
+
+def test_ctc_loss_matches_torch_reference():
+    torch = pytest.importorskip('torch')
+    rng = np.random.RandomState(0)
+    T, N, C = 8, 3, 6
+    data = rng.randn(T, N, C).astype('float32')
+    label = np.array([[1, 2, 3, 0], [2, 2, 4, 5], [3, 0, 0, 0]], 'int32')
+    lens = (label != 0).sum(1)
+    out = gluon.loss.CTCLoss(layout='TNC')(
+        mx.nd.array(data), mx.nd.array(label)).asnumpy()
+    lp = torch.log_softmax(torch.tensor(data), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(label.astype('int64')),
+        torch.tensor([T] * N), torch.tensor(lens.astype('int64')),
+        blank=0, reduction='none').numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sequential_nesting_collect_params():
+    net = nn.Sequential()
+    inner = nn.Sequential()
+    inner.add(nn.Dense(4, in_units=4))
+    net.add(inner)
+    net.add(nn.Dense(2, in_units=4))
+    params = net.collect_params()
+    assert len(list(params.keys())) == 4  # 2 layers × (weight, bias)
+
+
+def test_save_load_params(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize(mx.initializer.Xavier())
+    f = str(tmp_path / 'dense.params')
+    net.save_params(f)
+    net2 = nn.Dense(4, in_units=3, prefix=net.prefix)
+    net2.initialize()
+    net2.load_params(f)
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               net2.weight.data().asnumpy())
+
+
+def test_symbol_block():
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=6, name='fc')
+    out = mx.sym.Activation(fc, act_type='relu')
+    blk = gluon.SymbolBlock(out, data)
+    blk.collect_params().initialize()
+    x = mx.nd.array(np.random.randn(2, 4).astype('float32'))
+    # deferred init from first forward
+    for p in blk.collect_params().values():
+        if p._deferred_init is not None:
+            p._finish_deferred_init((6, 4) if 'weight' in p.name else (6,))
+    y = blk(x)
+    assert y.shape == (2, 6)
+
+
+def test_dataset_dataloader():
+    X = np.arange(40, dtype='float32').reshape(10, 4)
+    Y = np.arange(10, dtype='float32')
+    ds = gluon.data.ArrayDataset(X, Y)
+    assert len(ds) == 10
+    loader = gluon.data.DataLoader(ds, batch_size=3, last_batch='keep')
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (3, 4) and yb.shape == (3,)
+    # discard mode
+    loader = gluon.data.DataLoader(ds, batch_size=3, last_batch='discard')
+    assert len(list(loader)) == 3
+    # transform
+    ds2 = ds.transform_first(lambda x: x * 2)
+    x0, y0 = ds2[0]
+    np.testing.assert_allclose(np.asarray(x0), X[0] * 2)
+
+
+def test_dataloader_shuffle_and_workers():
+    X = np.arange(100, dtype='float32').reshape(50, 2)
+    ds = gluon.data.ArrayDataset(X)
+    loader = gluon.data.DataLoader(ds, batch_size=10, shuffle=True,
+                                   num_workers=2)
+    seen = np.concatenate([b.asnumpy()[:, 0] for b in loader])
+    assert sorted(seen.tolist()) == sorted(X[:, 0].tolist())
+
+
+def test_model_zoo_builds_and_runs():
+    from mxnet_tpu.gluon.model_zoo import vision as models
+    x = mx.nd.array(np.random.randn(1, 3, 32, 32).astype('float32'))
+    for name in ['resnet18_v1', 'resnet18_v2']:
+        net = models.get_model(name, classes=10, thumbnail=True)
+        net.initialize(mx.initializer.Xavier())
+        y = net(x)
+        assert y.shape == (1, 10), name
+
+
+def test_model_zoo_full_stem():
+    from mxnet_tpu.gluon.model_zoo import vision as models
+    net = models.squeezenet1_1(classes=7)
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.randn(1, 3, 224, 224).astype('float32'))
+    assert net(x).shape == (1, 7)
+
+
+def test_split_and_load_and_clip():
+    x = np.arange(24, dtype='float32').reshape(8, 3)
+    parts = gluon.utils.split_data(mx.nd.array(x), 4)
+    assert [p.shape for p in parts] == [(2, 3)] * 4
+    arrs = [mx.nd.array(np.ones(4, 'float32') * 3),
+            mx.nd.array(np.ones(4, 'float32') * 4)]
+    total = gluon.utils.clip_global_norm(arrs, 1.0)
+    assert abs(total - 10.0) < 1e-4
+    new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrs))
+    np.testing.assert_allclose(new_norm, 1.0, rtol=1e-4)
