@@ -42,5 +42,6 @@ from . import serialization
 from . import models
 from . import parallel
 from . import gluon
+from . import rnn
 
 from .ndarray import NDArray

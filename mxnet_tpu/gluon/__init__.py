@@ -10,6 +10,7 @@ from .parameter import (Parameter, Constant, ParameterDict,
 from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
 from . import nn
+from . import rnn
 from . import loss
 from . import data
 from . import model_zoo

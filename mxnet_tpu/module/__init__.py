@@ -1,3 +1,4 @@
 """Module API (``mx.mod``) — reference: python/mxnet/module/."""
 from .base_module import BaseModule
 from .module import Module
+from .bucketing_module import BucketingModule

@@ -1,0 +1,271 @@
+"""BucketingModule (reference: python/mxnet/module/bucketing_module.py:35).
+
+One child Module per bucket key, all sharing parameters.  The reference
+shares one memory pool between per-bucket executors (shared_module binding,
+graph_executor.cc:878); here each bucket is its own jit-compiled XLA
+program (one compile per bucket shape — the cache discipline of
+SURVEY.md §5.7) and parameter sharing is by reference: every child Module
+binds against the SAME arrays, so no copies ever happen on switch.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..initializer import Uniform
+from .base_module import BaseModule, _check_input_names
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    """reference: bucketing_module.py:35."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, mesh=None, sharding_rules=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._default_bucket_key = default_bucket_key
+        self._sym_gen = sym_gen
+        self._context = context
+        self._work_load_list = work_load_list
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+        self._mesh = mesh
+        self._sharding_rules = sharding_rules
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._params_dirty = False
+        self._monitor = None
+        self._grad_req = None
+
+    def _reset_bind(self):
+        self.binded = False
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+
+    def _call_sym_gen(self, *args, **kwargs):
+        return self._sym_gen(*args, **kwargs)
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
+        return data_names
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
+        return symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._curr_module.output_shapes
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    def get_params(self):
+        """reference: bucketing_module.py get_params."""
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init)
+            return
+        assert self.binded and self.params_initialized
+        # write to the DEFAULT bucket: it is the sync source of truth that
+        # _share_params copies from on every non-default forward
+        self._buckets[self._default_bucket_key].set_params(
+            arg_params, aux_params, allow_missing=allow_missing,
+            force_init=force_init, allow_extra=allow_extra)
+        self._params_dirty = False
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, 'call bind before initializing the parameters'
+        self._buckets[self._default_bucket_key].init_params(
+            initializer=initializer, arg_params=arg_params,
+            aux_params=aux_params, allow_missing=allow_missing,
+            force_init=force_init, allow_extra=allow_extra)
+        for mod in self._buckets.values():
+            mod.params_initialized = True
+        self._params_dirty = False
+        self.params_initialized = True
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req='write'):
+        """Bind the default-bucket module
+        (reference: bucketing_module.py:313)."""
+        assert shared_module is None, \
+            'shared_module for BucketingModule is not supported'
+        if force_rebind:
+            self._reset_bind()
+        if self.binded:
+            self.logger.warning('Already bound, ignoring bind()')
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        self._grad_req = grad_req
+
+        symbol, data_names, label_names = self._call_sym_gen(
+            self._default_bucket_key)
+        module = Module(symbol, data_names, label_names,
+                        logger=self.logger, context=self._context,
+                        work_load_list=self._work_load_list,
+                        fixed_param_names=self._fixed_param_names,
+                        state_names=self._state_names,
+                        mesh=self._mesh,
+                        sharding_rules=self._sharding_rules)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, force_rebind=False,
+                    shared_module=None, grad_req=self._grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Switch to the executor for bucket_key, binding it on first use
+        (reference: bucketing_module.py:333)."""
+        assert self.binded, 'call bind before switching bucket'
+        if bucket_key not in self._buckets:
+            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
+            module = Module(symbol, data_names, label_names,
+                            logger=self.logger, context=self._context,
+                            work_load_list=self._work_load_list,
+                            fixed_param_names=self._fixed_param_names,
+                            state_names=self._state_names,
+                            mesh=self._mesh,
+                            sharding_rules=self._sharding_rules)
+            module.bind(data_shapes, label_shapes, self._curr_module.
+                        for_training, self._curr_module.inputs_need_grad,
+                        force_rebind=False, shared_module=self._buckets[
+                            self._default_bucket_key],
+                        grad_req=self._grad_req)
+            if self._monitor is not None:
+                module.install_monitor(self._monitor)
+            if self.params_initialized:
+                module.params_initialized = True
+            if self.optimizer_initialized:
+                module.borrow_optimizer(
+                    self._buckets[self._default_bucket_key])
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def _share_params(self, module):
+        """Alias the default bucket's param arrays into `module` so all
+        buckets update the same storage (replaces the reference's shared
+        memory pool)."""
+        default = self._buckets[self._default_bucket_key]
+        arg, aux = default.get_params()
+        module._exec.copy_params_from(arg, aux, allow_extra_params=True)
+
+    def init_optimizer(self, kvstore='local', optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning('optimizer already initialized, '
+                                'ignoring.')
+            return
+        self._curr_module.init_optimizer(kvstore, optimizer,
+                                         optimizer_params,
+                                         force_init=force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod.borrow_optimizer(self._curr_module)
+        self.optimizer_initialized = True
+
+    def prepare(self, data_batch):
+        """reference: bucketing_module.py prepare."""
+        assert self.binded and self.params_initialized
+        bucket_key = data_batch.bucket_key
+        original_bucket_key = self._curr_bucket_key
+        data_shapes = data_batch.provide_data
+        label_shapes = data_batch.provide_label
+        self.switch_bucket(bucket_key, data_shapes, label_shapes)
+        self.switch_bucket(original_bucket_key, None, None)
+
+    def forward(self, data_batch, is_train=None):
+        """reference: bucketing_module.py:404."""
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._sync_current()
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def _sync_current(self):
+        """Point the current bucket's executor at the shared params."""
+        if self._curr_bucket_key == self._default_bucket_key:
+            return
+        self._share_params(self._curr_module)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        self._curr_module.update()
+        if self._curr_bucket_key != self._default_bucket_key:
+            # write updated params back into the default bucket's storage
+            arg, aux = self._curr_module.get_params()
+            default = self._buckets[self._default_bucket_key]
+            default._exec.copy_params_from(arg, aux,
+                                           allow_extra_params=True)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        assert self.binded and self.params_initialized
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        """reference: bucketing_module.py install_monitor."""
+        assert self.binded
+        self._monitor = mon
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._curr_module.save_checkpoint(prefix, epoch,
+                                          save_optimizer_states)

@@ -516,6 +516,18 @@ class Module(BaseModule):
                     for s, v in zip(self._opt_states[n], st):
                         s._set_data(jnp.asarray(v))
 
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer/updater/state with another Module
+        (reference: module.py borrow_optimizer — BucketingModule makes all
+        buckets apply updates through one optimizer)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self._opt_states = shared_module._opt_states
+        self.optimizer_initialized = True
+
     def install_monitor(self, mon):
         assert self.binded
         mon.install(self._exec)

@@ -94,6 +94,11 @@ def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
     """x: (T, N, I) -> (T, N, H); the T*N x I x G*H projection is one MXU call."""
     T, N, _ = x.shape
     H = wh.shape[1]
+    # size-1 batch states (sym.zeros unknown-dim convention) broadcast up
+    if h0.shape[0] != N:
+        h0 = jnp.broadcast_to(h0, (N, H))
+    if c0 is not None and c0.shape[0] != N:
+        c0 = jnp.broadcast_to(c0, (N, H))
     gates_x = (x.reshape(T * N, -1) @ wx.T + bx).reshape(T, N, -1)
     step = _cell_step(mode, H)
     carry0 = (h0, c0) if mode == "lstm" else (h0,)

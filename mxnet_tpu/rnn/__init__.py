@@ -1,0 +1,5 @@
+"""Symbolic RNN cells + bucketing IO (reference: python/mxnet/rnn/)."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ZoneoutCell, ResidualCell, ModifierCell)
+from .io import BucketSentenceIter, encode_sentences

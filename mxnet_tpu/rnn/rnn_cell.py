@@ -1,0 +1,742 @@
+"""Symbolic RNN cells (reference: python/mxnet/rnn/rnn_cell.py:108-1176).
+
+Unfused cells build per-step graph nodes composed by ``unroll``; the
+``FusedRNNCell`` emits the single fused ``RNN`` op (ops/rnn.py — the
+lax.scan replacement for cuDNN's persistent kernel).  Weight naming and
+gate order match the reference so pack/unpack round-trips.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import symbol as sym_mod
+from ..ops.rnn import rnn_param_size
+
+
+class RNNParams:
+    """Container for cell parameters (reference: rnn_cell.py:36)."""
+
+    def __init__(self, prefix=''):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym_mod.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """reference: rnn_cell.py:108."""
+
+    def __init__(self, prefix='', params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [ele['shape'] for ele in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=sym_mod.zeros, **kwargs):
+        """reference: rnn_cell.py:166."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called"
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if info is not None:
+                info = dict(info, **kwargs)
+            else:
+                info = kwargs
+            if 'shape' in info:
+                # 0 = unknown dim (MXNet shape convention): materialize as
+                # 1 — a zero state broadcasts over the batch identically
+                # (ops/rnn.py broadcasts fused-op states the same way)
+                info['shape'] = tuple(1 if s == 0 else s
+                                      for s in info['shape'])
+            state = func(name=f'{self._prefix}begin_state_'
+                              f'{self._init_counter}', **info)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Split packed gate weights into per-gate arrays
+        (reference: rnn_cell.py:199)."""
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group_name in ['i2h', 'h2h']:
+            weight = args.pop(f'{self._prefix}{group_name}_weight')
+            bias = args.pop(f'{self._prefix}{group_name}_bias')
+            for j, gate in enumerate(self._gate_names):
+                wname = f'{self._prefix}{group_name}{gate}_weight'
+                args[wname] = weight[j * h: (j + 1) * h].copy()
+                bname = f'{self._prefix}{group_name}{gate}_bias'
+                args[bname] = bias[j * h: (j + 1) * h].copy()
+        return args
+
+    def pack_weights(self, args):
+        """reference: rnn_cell.py:226."""
+        from ..ndarray.ndarray import concatenate
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        for group_name in ['i2h', 'h2h']:
+            weight = []
+            bias = []
+            for gate in self._gate_names:
+                weight.append(args.pop(
+                    f'{self._prefix}{group_name}{gate}_weight'))
+                bias.append(args.pop(
+                    f'{self._prefix}{group_name}{gate}_bias'))
+            args[f'{self._prefix}{group_name}_weight'] = \
+                concatenate(weight, axis=0)
+            args[f'{self._prefix}{group_name}_bias'] = \
+                concatenate(bias, axis=0)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        """reference: rnn_cell.py:253."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+    # -- helpers ------------------------------------------------------------
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return sym_mod.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """reference: rnn_cell.py:46 _normalize_sequence."""
+    assert inputs is not None
+    axis = layout.find('T')
+    in_axis = in_layout.find('T') if in_layout is not None else axis
+    if isinstance(inputs, sym_mod.Symbol):
+        if merge is False:
+            if len(inputs.list_outputs()) != 1:
+                raise MXNetError(
+                    "unroll doesn't allow grouped symbol as input. ")
+            inputs = list(sym_mod.SliceChannel(
+                inputs, axis=in_axis, num_outputs=length, squeeze_axis=1))
+    else:
+        if merge is True:
+            inputs = [sym_mod.expand_dims(i, axis=axis) for i in inputs]
+            inputs = sym_mod.Concat(*inputs, dim=axis)
+            in_axis = axis
+    if isinstance(inputs, sym_mod.Symbol) and axis != in_axis:
+        inputs = sym_mod.SwapAxis(inputs, dim1=axis, dim2=in_axis)
+    return inputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell (reference: rnn_cell.py:330)."""
+
+    def __init__(self, num_hidden, activation='tanh', prefix='rnn_',
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get('i2h_weight')
+        self._iB = self.params.get('i2h_bias')
+        self._hW = self.params.get('h2h_weight')
+        self._hB = self.params.get('h2h_bias')
+
+    @property
+    def state_info(self):
+        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+
+    @property
+    def _gate_names(self):
+        return ('',)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f'{self._prefix}t{self._counter}_'
+        i2h = sym_mod.FullyConnected(data=inputs, weight=self._iW,
+                                     bias=self._iB,
+                                     num_hidden=self._num_hidden,
+                                     name=f'{name}i2h')
+        h2h = sym_mod.FullyConnected(data=states[0], weight=self._hW,
+                                     bias=self._hB,
+                                     num_hidden=self._num_hidden,
+                                     name=f'{name}h2h')
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name=f'{name}out')
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (reference: rnn_cell.py:389); gate order [i, f, g, o]
+    matches the fused op."""
+
+    def __init__(self, num_hidden, prefix='lstm_', params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get('i2h_weight')
+        self._hW = self.params.get('h2h_weight')
+        from ..initializer import LSTMBias
+        self._iB = self.params.get(
+            'i2h_bias', init=LSTMBias(forget_bias=forget_bias))
+        self._hB = self.params.get('h2h_bias')
+
+    @property
+    def state_info(self):
+        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'},
+                {'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+
+    @property
+    def _gate_names(self):
+        return ['_i', '_f', '_c', '_o']
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f'{self._prefix}t{self._counter}_'
+        i2h = sym_mod.FullyConnected(data=inputs, weight=self._iW,
+                                     bias=self._iB,
+                                     num_hidden=self._num_hidden * 4,
+                                     name=f'{name}i2h')
+        h2h = sym_mod.FullyConnected(data=states[0], weight=self._hW,
+                                     bias=self._hB,
+                                     num_hidden=self._num_hidden * 4,
+                                     name=f'{name}h2h')
+        gates = i2h + h2h
+        slices = list(sym_mod.SliceChannel(gates, num_outputs=4,
+                                           name=f'{name}slice'))
+        in_gate = sym_mod.Activation(slices[0], act_type='sigmoid')
+        forget_gate = sym_mod.Activation(slices[1], act_type='sigmoid')
+        in_transform = sym_mod.Activation(slices[2], act_type='tanh')
+        out_gate = sym_mod.Activation(slices[3], act_type='sigmoid')
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * sym_mod.Activation(next_c, act_type='tanh')
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (reference: rnn_cell.py:461); gate order [r, z, n]."""
+
+    def __init__(self, num_hidden, prefix='gru_', params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get('i2h_weight')
+        self._iB = self.params.get('i2h_bias')
+        self._hW = self.params.get('h2h_weight')
+        self._hB = self.params.get('h2h_bias')
+
+    @property
+    def state_info(self):
+        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+
+    @property
+    def _gate_names(self):
+        return ['_r', '_z', '_o']
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f'{self._prefix}t{self._counter}_'
+        prev_state_h = states[0]
+        i2h = sym_mod.FullyConnected(data=inputs, weight=self._iW,
+                                     bias=self._iB,
+                                     num_hidden=self._num_hidden * 3,
+                                     name=f'{name}i2h')
+        h2h = sym_mod.FullyConnected(data=prev_state_h, weight=self._hW,
+                                     bias=self._hB,
+                                     num_hidden=self._num_hidden * 3,
+                                     name=f'{name}h2h')
+        i2h_r, i2h_z, i2h = list(sym_mod.SliceChannel(
+            i2h, num_outputs=3, name=f'{name}i2h_slice'))
+        h2h_r, h2h_z, h2h = list(sym_mod.SliceChannel(
+            h2h, num_outputs=3, name=f'{name}h2h_slice'))
+        reset_gate = sym_mod.Activation(i2h_r + h2h_r, act_type='sigmoid')
+        update_gate = sym_mod.Activation(i2h_z + h2h_z, act_type='sigmoid')
+        next_h_tmp = sym_mod.Activation(i2h + reset_gate * h2h,
+                                        act_type='tanh')
+        next_h = update_gate * prev_state_h + \
+            (1. - update_gate) * next_h_tmp
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN (reference: rnn_cell.py:536) → single `RNN`
+    op (ops/rnn.py lax.scan kernel)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode='lstm',
+                 bidirectional=False, dropout=0., get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = f'{mode}_'
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._directions = ['l', 'r'] if bidirectional else ['l']
+        self._parameter = self.params.get('parameters')
+
+    @property
+    def state_info(self):
+        b = self._num_layers * (2 if self._bidirectional else 1)
+        n = 2 if self._mode == 'lstm' else 1
+        return [{'shape': (b, 0, self._num_hidden), '__layout__': 'LNC'}
+                for _ in range(n)]
+
+    @property
+    def _gate_names(self):
+        return {'rnn_relu': [''], 'rnn_tanh': [''],
+                'lstm': ['_i', '_f', '_c', '_o'],
+                'gru': ['_r', '_z', '_o']}[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def _slice_weights(self, arr, li, lh):
+        """Map the flat vector to per-layer views
+        (reference: rnn_cell.py:595)."""
+        args = {}
+        gate_names = self._gate_names
+        directions = self._directions
+        b = len(directions)
+        p = 0
+        for layer in range(self._num_layers):
+            for direction in directions:
+                for gate in gate_names:
+                    name = f'{self._prefix}{direction}{layer}_i2h' \
+                           f'{gate}_weight'
+                    size = (li if layer == 0 else lh * b) * lh
+                    args[name] = arr[p:p + size].reshape(
+                        (lh, li if layer == 0 else lh * b))
+                    p += size
+                for gate in gate_names:
+                    name = f'{self._prefix}{direction}{layer}_h2h' \
+                           f'{gate}_weight'
+                    size = lh ** 2
+                    args[name] = arr[p:p + size].reshape((lh, lh))
+                    p += size
+        for layer in range(self._num_layers):
+            for direction in directions:
+                for group in ['i2h', 'h2h']:
+                    for gate in gate_names:
+                        name = f'{self._prefix}{direction}{layer}_' \
+                               f'{group}{gate}_bias'
+                        args[name] = arr[p:p + lh]
+                        p += lh
+        assert p == arr.size, "Invalid parameters size for FusedRNNCell"
+        return args
+
+    def unpack_weights(self, args):
+        args = dict(args)
+        arr = args.pop(self._parameter.name)
+        b = len(self._directions)
+        m = self._num_gates
+        h = self._num_hidden
+        num_input = arr.size // b // h // m - \
+            (self._num_layers - 1) * (h + b * h + 2) - h - 2
+        nargs = self._slice_weights(arr, num_input, h)
+        args.update({name: nd.copy() for name, nd in nargs.items()})
+        return args
+
+    def pack_weights(self, args):
+        from ..ndarray.ndarray import zeros as nd_zeros
+        args = dict(args)
+        w0 = args[f'{self._prefix}l0_i2h'
+                  f'{self._gate_names[0]}_weight']
+        num_input = w0.shape[1]
+        total = rnn_param_size(self._num_layers, num_input,
+                               self._num_hidden, self._bidirectional,
+                               self._mode)
+        arr = nd_zeros((total,), dtype=w0.dtype)
+        for name, block in self._slice_weights(arr, num_input,
+                                               self._num_hidden).items():
+            block[:] = args.pop(name)
+        args[self._parameter.name] = arr
+        return args
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "FusedRNNCell cannot be stepped. Please use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        """reference: rnn_cell.py:686 — emits ONE `RNN` node."""
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, True)
+        if axis == 1:
+            inputs = sym_mod.SwapAxis(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        if self._mode == 'lstm':
+            states = {'state': states[0], 'state_cell': states[1]}
+        else:
+            states = {'state': states[0]}
+        rnn = sym_mod.RNN(data=inputs, parameters=self._parameter,
+                          state_size=self._num_hidden,
+                          num_layers=self._num_layers,
+                          bidirectional=self._bidirectional,
+                          p=self._dropout,
+                          state_outputs=self._get_next_state,
+                          mode=self._mode, name=f'{self._prefix}rnn',
+                          **states)
+        if not self._get_next_state:
+            outputs, states = rnn, []
+        elif self._mode == 'lstm':
+            outs = list(rnn)
+            outputs, states = outs[0], [outs[1], outs[2]]
+        else:
+            outs = list(rnn)
+            outputs, states = outs[0], [outs[1]]
+        if axis == 1:
+            outputs = sym_mod.SwapAxis(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            outputs = list(sym_mod.SliceChannel(
+                outputs, axis=0 if axis == 0 else 1, num_outputs=length,
+                squeeze_axis=1))
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (reference: rnn_cell.py:757)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            'rnn_relu': lambda p: RNNCell(self._num_hidden,
+                                          activation='relu', prefix=p),
+            'rnn_tanh': lambda p: RNNCell(self._num_hidden,
+                                          activation='tanh', prefix=p),
+            'lstm': lambda p: LSTMCell(self._num_hidden, prefix=p),
+            'gru': lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell(f'{self._prefix}l{i}_'),
+                    get_cell(f'{self._prefix}r{i}_'),
+                    output_prefix=f'{self._prefix}bi_l{i}_'))
+            else:
+                stack.add(get_cell(f'{self._prefix}l{i}_'))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix=f'{self._prefix}_dropout{i}_'))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells (reference: rnn_cell.py:793)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix='', params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        self.reset()
+        num_cells = len(self._cells)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(states)
+        return inputs, next_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """reference: rnn_cell.py:857."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix='bi_'):
+        super().__init__('', params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            assert l_cell._own_params and r_cell._own_params
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        l_cell, r_cell = self._cells
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[:len(l_cell.state_info)],
+            layout=layout, merge_outputs=merge_outputs)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[len(l_cell.state_info):],
+            layout=layout, merge_outputs=merge_outputs)
+        if merge_outputs is None:
+            merge_outputs = isinstance(l_outputs, sym_mod.Symbol) and \
+                isinstance(r_outputs, sym_mod.Symbol)
+            if not merge_outputs:
+                if isinstance(l_outputs, sym_mod.Symbol):
+                    l_outputs = list(sym_mod.SliceChannel(
+                        l_outputs, axis=axis, num_outputs=length,
+                        squeeze_axis=1))
+                if isinstance(r_outputs, sym_mod.Symbol):
+                    r_outputs = list(sym_mod.SliceChannel(
+                        r_outputs, axis=axis, num_outputs=length,
+                        squeeze_axis=1))
+        if merge_outputs:
+            reversed_r = sym_mod.SequenceReverse(r_outputs) if axis == 0 \
+                else sym_mod.SwapAxis(sym_mod.SequenceReverse(
+                    sym_mod.SwapAxis(r_outputs, dim1=0, dim2=1)),
+                    dim1=0, dim2=1)
+            outputs = sym_mod.Concat(l_outputs, reversed_r, dim=2,
+                                     name=f'{self._output_prefix}out')
+        else:
+            outputs = [
+                sym_mod.Concat(l_o, r_o, dim=1,
+                               name=f'{self._output_prefix}t{i}')
+                for i, (l_o, r_o) in enumerate(
+                    zip(l_outputs, reversed(r_outputs)))]
+        states = l_states + r_states
+        return outputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells wrapping another cell (reference: rnn_cell.py:944)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=sym_mod.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class DropoutCell(BaseRNNCell):
+    """reference: rnn_cell.py:920."""
+
+    def __init__(self, dropout, prefix='dropout_', params=None):
+        super().__init__(prefix, params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = sym_mod.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """reference: rnn_cell.py:1004."""
+
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell doesn't support zoneout. Use unfuse() first."
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout since it doesn't " \
+            "support step. Please add ZoneoutCell to the cells underneath."
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = (
+            self.base_cell, self.zoneout_outputs, self.zoneout_states)
+        next_output, next_states = cell(inputs, states)
+
+        def mask(p, like):
+            return sym_mod.Dropout(sym_mod.ones_like(like), p=p)
+        prev_output = self.prev_output if self.prev_output is not None \
+            else sym_mod.zeros_like(next_output)
+        output = sym_mod.where(mask(p_outputs, next_output), next_output,
+                               prev_output) if p_outputs != 0. \
+            else next_output
+        states = [sym_mod.where(mask(p_states, new_s), new_s, old_s)
+                  for new_s, old_s in zip(next_states, states)] \
+            if p_states != 0. else next_states
+        self.prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """reference: rnn_cell.py:1055."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = sym_mod.elemwise_add(output, inputs,
+                                      name=f'{output.name}_plus_residual')
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs)
+        self.base_cell._modified = True
+        merge_outputs = isinstance(outputs, sym_mod.Symbol) \
+            if merge_outputs is None else merge_outputs
+        inputs, _ = _normalize_sequence(length, inputs, layout,
+                                        merge_outputs)
+        if merge_outputs:
+            outputs = sym_mod.elemwise_add(outputs, inputs)
+        else:
+            outputs = [sym_mod.elemwise_add(out, inp)
+                       for out, inp in zip(outputs, inputs)]
+        return outputs, states
+
+
+def _cells_state_info(cells):
+    return sum([c.state_info for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _cells_unpack_weights(cells, args):
+    for cell in cells:
+        args = cell.unpack_weights(args)
+    return args
+
+
+def _cells_pack_weights(cells, args):
+    for cell in cells:
+        args = cell.pack_weights(args)
+    return args

@@ -801,13 +801,19 @@ def _eval_node_shape(n: Node, opdef: _reg.OpDef, in_specs):
 
 
 def zeros(shape, dtype="float32", **kw):
-    return _compose("_zeros", [], {"shape": tuple(shape) if not isinstance(
-        shape, numbers.Integral) else (shape,), "dtype": np.dtype(dtype).name}, kw.get("name"))
+    if isinstance(shape, numbers.Integral):
+        shape = (shape,)
+    return _compose("_zeros", [], {"shape": tuple(shape),
+                                   "dtype": np.dtype(dtype).name},
+                    kw.get("name"))
 
 
 def ones(shape, dtype="float32", **kw):
-    return _compose("_ones", [], {"shape": tuple(shape) if not isinstance(
-        shape, numbers.Integral) else (shape,), "dtype": np.dtype(dtype).name}, kw.get("name"))
+    if isinstance(shape, numbers.Integral):
+        shape = (shape,)
+    return _compose("_ones", [], {"shape": tuple(shape),
+                                  "dtype": np.dtype(dtype).name},
+                    kw.get("name"))
 
 
 def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):

@@ -1,0 +1,226 @@
+"""RNN tests (model: tests/python/unittest/test_rnn.py, test_gluon_rnn.py,
+tests/python/train/test_bucketing.py — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn as grnn
+
+
+def test_symbolic_lstm_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(num_hidden=16, prefix='lstm_')
+    data = mx.sym.Variable('data')
+    outputs, states = cell.unroll(4, inputs=data, merge_outputs=True,
+                                  layout='NTC')
+    args = set(outputs.list_arguments())
+    assert {'lstm_i2h_weight', 'lstm_i2h_bias', 'lstm_h2h_weight',
+            'lstm_h2h_bias'} <= args
+    ex = mx.Executor.simple_bind(outputs, shapes={'data': (2, 4, 8)})
+    out = ex.forward()[0]
+    assert out.shape == (2, 4, 16)
+
+
+def test_fused_matches_unfused():
+    """FusedRNNCell (lax.scan op) must match its unfuse() stack, like the
+    reference's cuDNN-vs-unrolled consistency tests (test_rnn.py)."""
+    T, N, I, H = 5, 3, 8, 10
+    fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode='lstm',
+                                prefix='lstm_', get_next_state=False)
+    data = mx.sym.Variable('data')
+    f_out, _ = fused.unroll(T, inputs=data, merge_outputs=True,
+                            layout='TNC')
+    f_ex = mx.Executor.simple_bind(f_out, shapes={'data': (T, N, I)})
+
+    stack = fused.unfuse()
+    u_out, _ = stack.unroll(T, inputs=data, merge_outputs=True,
+                            layout='TNC')
+    u_ex = mx.Executor.simple_bind(u_out, shapes={'data': (T, N, I)})
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype('float32')
+    # random fused params; unpack into the unfused arg names
+    psize = f_ex.arg_dict['lstm_parameters'].shape[0]
+    params = rng.uniform(-0.1, 0.1, (psize,)).astype('float32')
+    f_ex.arg_dict['data']._set_data(np.asarray(x))
+    f_ex.arg_dict['lstm_parameters']._set_data(np.asarray(params))
+    from mxnet_tpu.ndarray.ndarray import array as nd_array
+    unpacked = stack.pack_weights(fused.unpack_weights(
+        {'lstm_parameters': nd_array(params)}))
+    u_ex.arg_dict['data']._set_data(np.asarray(x))
+    for k, v in unpacked.items():
+        if k in u_ex.arg_dict:
+            u_ex.arg_dict[k]._set_data(v._data)
+    f_res = f_ex.forward()[0].asnumpy()
+    u_res = u_ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(f_res, u_res, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize('mode', ['rnn_relu', 'rnn_tanh', 'gru'])
+def test_fused_modes_run(mode):
+    T, N, I, H = 4, 2, 6, 8
+    cell = mx.rnn.FusedRNNCell(H, num_layers=1, mode=mode,
+                               prefix=f'{mode}_', get_next_state=True)
+    data = mx.sym.Variable('data')
+    out, states = cell.unroll(T, inputs=data, merge_outputs=True,
+                              layout='TNC')
+    grp = mx.sym.Group([out] + states)
+    ex = mx.Executor.simple_bind(grp, shapes={'data': (T, N, I)})
+    outs = ex.forward()
+    assert outs[0].shape == (T, N, H)
+    assert outs[1].shape == (1, N, H)
+
+
+def test_bidirectional_fused():
+    T, N, I, H = 4, 2, 6, 8
+    cell = mx.rnn.FusedRNNCell(H, num_layers=2, mode='lstm',
+                               bidirectional=True, prefix='bi_')
+    data = mx.sym.Variable('data')
+    out, _ = cell.unroll(T, inputs=data, merge_outputs=True, layout='TNC')
+    ex = mx.Executor.simple_bind(out, shapes={'data': (T, N, I)})
+    assert ex.forward()[0].shape == (T, N, 2 * H)
+
+
+def test_residual_zoneout_dropout_cells():
+    data = mx.sym.Variable('data')
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(8, prefix='g0_'))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(8, prefix='g1_')))
+    stack.add(mx.rnn.DropoutCell(0.2))
+    out, states = stack.unroll(3, inputs=data, merge_outputs=True)
+    ex = mx.Executor.simple_bind(out, shapes={'data': (2, 3, 8)})
+    assert ex.forward()[0].shape == (2, 3, 8)
+
+    z = mx.rnn.ZoneoutCell(mx.rnn.LSTMCell(8, prefix='zl_'),
+                           zoneout_outputs=0.2, zoneout_states=0.1)
+    out, _ = z.unroll(3, inputs=data, merge_outputs=True)
+    ex = mx.Executor.simple_bind(out, shapes={'data': (2, 3, 8)})
+    assert ex.forward()[0].shape == (2, 3, 8)
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(0)
+    sents = [list(rng.randint(1, 20, size=rng.randint(2, 9)))
+             for _ in range(100)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[4, 8],
+                                   invalid_label=0)
+    batch = next(iter(it))
+    assert batch.bucket_key in (4, 8)
+    assert batch.data[0].shape == (4, batch.bucket_key)
+    # label is data shifted by one
+    d = batch.data[0].asnumpy()
+    l = batch.label[0].asnumpy()
+    np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+
+
+def test_bucketing_module_trains():
+    """Config-4 analog (LSTM PTB via BucketingModule) at toy scale:
+    loss must drop across epochs."""
+    rng = np.random.RandomState(0)
+    V, E, H = 20, 8, 16
+    # predictable sequences: next token = (tok + 1) % V
+    sents = []
+    for _ in range(120):
+        start = rng.randint(1, V)
+        ln = rng.randint(3, 10)
+        sents.append([(start + k) % (V - 1) + 1 for k in range(ln)])
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=8, buckets=[5, 10],
+                                   invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=H, prefix='lstm_l0_'))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable('data')
+        label = mx.sym.Variable('softmax_label')
+        embed = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                                 name='embed')
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, num_hidden=V, name='pred')
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label_r, name='softmax')
+        return pred, ('data',), ('softmax_label',)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer='adam',
+                       optimizer_params={'learning_rate': 0.01})
+    metric = mx.metric.Perplexity(0)
+
+    def run_epoch():
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        return metric.get()[1]
+
+    first = run_epoch()
+    for _ in range(3):
+        last = run_epoch()
+    assert last < first * 0.8, (first, last)
+    assert len(mod._buckets) == 2
+
+
+def test_gluon_lstm_layer():
+    x = mx.nd.array(np.random.RandomState(0).randn(5, 3, 8)
+                    .astype('float32'))
+    lstm = grnn.LSTM(16, num_layers=2, bidirectional=True)
+    lstm.initialize(mx.initializer.Xavier())
+    out = lstm(x)
+    assert out.shape == (5, 3, 32)
+    st = lstm.begin_state(batch_size=3)
+    out, st2 = lstm(x, st)
+    assert out.shape == (5, 3, 32)
+    assert [tuple(s.shape) for s in st2] == [(4, 3, 16), (4, 3, 16)]
+    with autograd.record():
+        loss = mx.nd.sum(lstm(x))
+    loss.backward()
+    assert float(lstm.l0_i2h_weight.grad().asnumpy().std()) > 0
+
+
+def test_gluon_fused_layer_matches_cell():
+    x = mx.nd.array(np.random.RandomState(1).randn(5, 3, 8)
+                    .astype('float32'))
+    lstm = grnn.LSTM(6, num_layers=1)
+    lstm.initialize(mx.initializer.Xavier())
+    ref = lstm(x).asnumpy()
+    cell = grnn.LSTMCell(6)
+    cell.initialize()
+    cell(x[0], cell.begin_state(batch_size=3))  # trigger deferred init
+    for nm in ['i2h_weight', 'h2h_weight', 'i2h_bias', 'h2h_bias']:
+        getattr(cell, nm).set_data(getattr(lstm, f'l0_{nm}').data())
+    outs, _ = cell.unroll(5, x, layout='TNC', merge_outputs=True)
+    np.testing.assert_allclose(outs.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_cells_and_modifiers():
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 2, 6)
+                    .astype('float32'))
+    stack = grnn.SequentialRNNCell()
+    stack.add(grnn.GRUCell(6))
+    stack.add(grnn.ResidualCell(grnn.GRUCell(6)))
+    stack.initialize()
+    out, states = stack.unroll(4, x, layout='TNC', merge_outputs=True)
+    assert out.shape == (4, 2, 6)
+    bi = grnn.BidirectionalCell(grnn.LSTMCell(5), grnn.LSTMCell(5))
+    bi.initialize()
+    out, states = bi.unroll(4, x, layout='TNC', merge_outputs=True)
+    assert out.shape == (4, 2, 10)
+    assert len(states) == 4
+
+
+def test_bucket_iter_empty_bucket():
+    """A bucket with zero sentences must not crash reset (review fix)."""
+    sents = [[1, 2], [2, 3], [1, 3], [3, 1]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[4, 8],
+                                   invalid_label=0)
+    batch = next(iter(it))
+    assert batch.bucket_key == 4
