@@ -32,6 +32,11 @@ from . import callback
 from . import io
 from .io import DataBatch, DataIter, DataDesc, NDArrayIter, ResizeIter, \
     PrefetchingIter, CSVIter
+from .image_record_iter import ImageRecordIter
+io.ImageRecordIter = ImageRecordIter   # reference API: mx.io.ImageRecordIter
+from . import recordio
+from . import image
+from . import image as img
 from . import kvstore as kv
 from . import kvstore
 from . import model

@@ -84,7 +84,7 @@ class RecordFileDataset(Dataset):
     def __init__(self, filename):
         from ... import recordio
         idx_file = filename[:filename.rfind('.')] + '.idx'
-        self._record = recordio.IndexedRecordIO(idx_file, filename, 'r')
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, 'r')
 
     def __len__(self):
         return len(self._record.keys)

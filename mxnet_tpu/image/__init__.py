@@ -1,0 +1,10 @@
+"""Image API (reference: python/mxnet/image/)."""
+from .image import (imdecode, imread, imresize, resize_short, fixed_crop,
+                    random_crop, center_crop, color_normalize,
+                    random_size_crop, scale_down,
+                    Augmenter, ResizeAug, ForceResizeAug, RandomCropAug,
+                    RandomSizedCropAug, CenterCropAug, HorizontalFlipAug,
+                    CastAug, BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug, ColorJitterAug, LightingAug,
+                    ColorNormalizeAug, RandomOrderAug, SequentialAug,
+                    CreateAugmenter, ImageIter)

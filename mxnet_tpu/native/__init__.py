@@ -1,0 +1,138 @@
+"""ctypes bindings for the native IO runtime (io_native.cc).
+
+The shared library is built on first use (``make -C mxnet_tpu/native``),
+mirroring how the reference ships its C++ pipeline inside libmxnet.so.
+``available()`` gates callers: every user has a pure-Python fallback, so a
+missing toolchain degrades performance, not functionality.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libmxtpu_io.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.rec_index_file.restype = ctypes.c_long
+        lib.rec_index_file.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_long]
+        lib.rec_read_batch.restype = ctypes.c_int
+        lib.rec_read_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_long, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.jpeg_decode_resize_batch.restype = ctypes.c_int
+        lib.jpeg_decode_resize_batch.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
+        lib.jpeg_probe.restype = ctypes.c_int
+        lib.jpeg_probe.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def index_rec_file(path, max_records=1 << 24):
+    """Offsets of every logical record in a .rec file."""
+    lib = get_lib()
+    offsets = np.zeros(max_records, dtype=np.int64)
+    n = lib.rec_index_file(
+        path.encode(), offsets.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)), max_records)
+    if n < 0:
+        raise IOError(f"rec_index_file failed for {path}")
+    return offsets[:n].copy()
+
+
+def read_records(path, offsets, est_size=1 << 20):
+    """Read logical records at the given offsets; returns list of bytes."""
+    lib = get_lib()
+    n = len(offsets)
+    offs = np.ascontiguousarray(offsets, dtype=np.int64)
+    bufs = [np.empty(est_size, dtype=np.uint8) for _ in range(n)]
+    lens = np.full(n, est_size, dtype=np.int64)
+    arr_t = ctypes.POINTER(ctypes.c_uint8) * n
+    ptrs = arr_t(*[b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+                   for b in bufs])
+    rc = lib.rec_read_batch(
+        path.encode(), offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        raise IOError(f"rec_read_batch failed ({rc}) for {path}")
+    out = []
+    retry = [(i, -lens[i]) for i in range(n) if lens[i] < 0]
+    for i, need in retry:
+        big = np.empty(int(need), dtype=np.uint8)
+        lens2 = np.full(1, int(need), dtype=np.int64)
+        one = arr_t.__class__  # noqa: F841 (clarity)
+        p1 = (ctypes.POINTER(ctypes.c_uint8) * 1)(
+            big.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        o1 = np.array([offs[i]], dtype=np.int64)
+        rc = lib.rec_read_batch(
+            path.encode(),
+            o1.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), 1, p1,
+            lens2.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0 or lens2[0] < 0:
+            raise IOError(f"rec_read_batch retry failed for {path}")
+        bufs[i] = big
+        lens[i] = lens2[0]
+    for i in range(n):
+        out.append(bufs[i][:lens[i]].tobytes())
+    return out
+
+
+def decode_jpeg_batch(jpeg_buffers, height, width, channels=3,
+                      nthreads=0):
+    """Decode+resize a list of JPEG byte strings to one NHWC uint8 array."""
+    lib = get_lib()
+    n = len(jpeg_buffers)
+    arrs = [np.frombuffer(b, dtype=np.uint8) for b in jpeg_buffers]
+    lens = np.array([a.size for a in arrs], dtype=np.int64)
+    arr_t = ctypes.POINTER(ctypes.c_uint8) * n
+    ptrs = arr_t(*[a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+                   for a in arrs])
+    out = np.empty((n, height, width, channels), dtype=np.uint8)
+    failures = lib.jpeg_decode_resize_batch(
+        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        height, width, channels, nthreads)
+    return out, failures

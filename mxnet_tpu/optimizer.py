@@ -312,8 +312,10 @@ class Adam(Optimizer):
         mean, var = states
         if t is None:
             t = self._index_update_count.get(0, self.num_update) or 1
-        coef1 = 1. - jnp.asarray(self.beta1) ** t
-        coef2 = 1. - jnp.asarray(self.beta2) ** t
+        # f32 scalars: a bare jnp.asarray would be float64 under the
+        # global x64 mode (base.py) and silently promote the whole update
+        coef1 = 1. - jnp.float32(self.beta1) ** t
+        coef2 = 1. - jnp.float32(self.beta2) ** t
         lr = lr * jnp.sqrt(coef2) / coef1
         g = _clip(grad * self.rescale_grad, self.clip_gradient) + wd * weight
         m = self.beta1 * mean + (1. - self.beta1) * g

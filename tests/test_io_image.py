@@ -1,0 +1,193 @@
+"""RecordIO / image-pipeline tests (model: tests/python/unittest/
+test_recordio.py, test_image.py, test_io.py — SURVEY.md §4)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, native, image
+
+
+@pytest.fixture(scope='module')
+def rec_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp('rec')
+    rec_path = str(root / 'data.rec')
+    idx_path = str(root / 'data.idx')
+    rng = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, 'w')
+    imgs = []
+    for i in range(32):
+        img = rng.randint(0, 255, (48 + i % 5, 56, 3), dtype=np.uint8)
+        imgs.append(img)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, quality=95))
+    rec.close()
+    return rec_path, idx_path, imgs
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / 't.rec')
+    w = recordio.MXRecordIO(path, 'w')
+    payloads = [b'hello', b'x' * 1000, b'', b'\x0a\x23\xd7\xce embedded',
+                recordio._MAGIC_BYTES + b'starts with magic']
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, 'r')
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / 'i.rec')
+    idx = str(tmp_path / 'i.idx')
+    w = recordio.MXIndexedRecordIO(idx, path, 'w')
+    for i in range(10):
+        w.write_idx(i, b'rec%d' % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, 'r')
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b'rec7'
+    assert r.read_idx(2) == b'rec2'
+    r.close()
+
+
+def test_pack_unpack_header():
+    hdr = recordio.IRHeader(0, 3.5, 42, 0)
+    s = recordio.pack(hdr, b'payload')
+    h2, body = recordio.unpack(s)
+    assert body == b'payload'
+    assert h2.label == 3.5 and h2.id == 42
+    # multi-label
+    hdr = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    h3, body = recordio.unpack(recordio.pack(hdr, b'xyz'))
+    np.testing.assert_allclose(h3.label, [1, 2, 3])
+    assert body == b'xyz'
+
+
+def test_native_index_matches_python(rec_dataset):
+    rec_path, idx_path, _ = rec_dataset
+    if not native.available():
+        pytest.skip('native lib unavailable')
+    offs = native.index_rec_file(rec_path)
+    # python indexed reader's offsets from the .idx file
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, 'r')
+    py_offs = [r.idx[k] for k in r.keys]
+    np.testing.assert_array_equal(offs, py_offs)
+    # native read returns identical payloads
+    recs = native.read_records(rec_path, offs[:5])
+    for k, data in zip(r.keys[:5], recs):
+        assert data == r.read_idx(k)
+    r.close()
+
+
+def test_native_decode_matches_pil(rec_dataset):
+    rec_path, idx_path, _ = rec_dataset
+    if not native.available():
+        pytest.skip('native lib unavailable')
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, 'r')
+    _, jpg = recordio.unpack(r.read_idx(0))
+    pil = image.imdecode(jpg, to_ndarray=False)
+    out, fails = native.decode_jpeg_batch([jpg], pil.shape[0],
+                                          pil.shape[1], 3, 1)
+    assert fails == 0
+    # JPEG decoders may differ by a few ULP in IDCT; mean abs diff small
+    assert np.abs(out[0].astype(int) - pil.astype(int)).mean() < 2.0
+    r.close()
+
+
+def test_image_record_iter(rec_dataset):
+    rec_path, _, _ = rec_dataset
+    it = mx.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                            batch_size=8, shuffle=True, rand_mirror=True,
+                            rand_crop=True, resize=40,
+                            mean_r=123.0, mean_g=117.0, mean_b=104.0,
+                            preprocess_threads=2)
+    seen = 0
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 32, 32)
+        assert batch.label[0].shape == (8,)
+        seen += 8 - batch.pad
+    assert seen == 32
+    it.reset()
+    assert sum(1 for _ in it) == 4
+
+
+def test_image_record_iter_partition(rec_dataset):
+    rec_path, _, _ = rec_dataset
+    a = mx.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 24, 24),
+                           batch_size=4, part_index=0, num_parts=2)
+    b = mx.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 24, 24),
+                           batch_size=4, part_index=1, num_parts=2)
+    la = [float(x) for bt in a for x in bt.label[0].asnumpy()]
+    lb = [float(x) for bt in b for x in bt.label[0].asnumpy()]
+    assert len(la) == len(lb) == 16
+
+
+def test_image_iter_and_augmenters(rec_dataset):
+    rec_path, idx_path, _ = rec_dataset
+    it = image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                         path_imgrec=rec_path, path_imgidx=idx_path,
+                         shuffle=True, rand_crop=True, rand_mirror=True,
+                         brightness=0.1, contrast=0.1, saturation=0.1,
+                         pca_noise=0.05, mean=True, std=True)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 28, 28)
+
+
+def test_augmenter_primitives():
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, (40, 60, 3), dtype=np.uint8)
+    out = image.resize_short(img, 32)
+    assert min(out.shape[:2]) == 32
+    out, _ = image.center_crop(img, (20, 24))
+    assert out.shape == (24, 20, 3)
+    out, _ = image.random_crop(img, (16, 16))
+    assert out.shape == (16, 16, 3)
+    out, _ = image.random_size_crop(img, (20, 20), 0.3, (0.75, 1.33))
+    assert out.shape == (20, 20, 3)
+    norm = image.color_normalize(img.astype(np.float32),
+                                 np.array([128, 128, 128], np.float32),
+                                 np.array([2, 2, 2], np.float32))
+    assert norm.asnumpy().max() < 128
+
+
+def test_im2rec_tool(tmp_path):
+    from PIL import Image
+    root = tmp_path / 'imgs'
+    for cls in ['a', 'b']:
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = np.random.RandomState(i).randint(
+                0, 255, (30, 30, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(root / cls / f'{cls}{i}.jpg')
+    prefix = str(tmp_path / 'ds')
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'im2rec.py')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    subprocess.run([sys.executable, tool, prefix, str(root), '--list',
+                    '--recursive'], check=True, env=env)
+    subprocess.run([sys.executable, tool, prefix, str(root)], check=True,
+                   env=env)
+    assert os.path.exists(prefix + '.rec')
+    it = mx.ImageRecordIter(path_imgrec=prefix + '.rec',
+                            data_shape=(3, 24, 24), batch_size=2)
+    labels = set()
+    for b in it:
+        labels.update(b.label[0].asnumpy().tolist())
+    assert labels == {0.0, 1.0}
+
+
+def test_gluon_image_record_dataset(rec_dataset):
+    rec_path, idx_path, _ = rec_dataset
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+    ds = ImageRecordDataset(rec_path)
+    assert len(ds) == 32
+    img, label = ds[5]
+    assert img.shape[2] == 3
+    assert float(label) == 5 % 4

@@ -132,3 +132,18 @@ def test_lr_scheduler_poly():
     assert s(0) == 1.0
     assert s(100) == 0.0
     assert 0 < s(50) < 1.0
+
+
+def test_adam_preserves_dtype():
+    """Adam's bias-correction scalars must not promote f32 weights to f64
+    under the global x64 mode (regression: jnp.asarray(beta) was f64)."""
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    w = jnp.ones((4,), jnp.float32)
+    g = jnp.ones((4,), jnp.float32)
+    st = (jnp.zeros((4,), jnp.float32), jnp.zeros((4,), jnp.float32))
+    nw, nst = opt._update_impl(w, g, st, np.float32(0.01), np.float32(0.0),
+                               t=jnp.asarray(1, jnp.int32))
+    assert nw.dtype == jnp.float32
+    assert all(s.dtype == jnp.float32 for s in nst)

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""im2rec: pack an image directory or .lst file into RecordIO.
+
+Re-implementation of the reference's tools/im2rec.py (and im2rec.cc) for
+the TPU-native framework: same .lst format (idx\\tlabel...\\tpath), same
+.rec/.idx output consumed by ImageRecordIter.  Multiprocessing pool
+encodes JPEGs in parallel (the reference's OpenCV worker threads).
+"""
+import argparse
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def list_image(root, recursive, exts):
+    """reference: im2rec.py list_image."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, 'w') as fout:
+        for i, item in enumerate(image_list):
+            line = '%d\t' % item[0]
+            for j in item[2:]:
+                line += '%f\t' % j
+            line += '%s\n' % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    """reference: im2rec.py read_list."""
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split('\t')]
+            line_len = len(line)
+            if line_len < 3:
+                print('lst should have at least has three parts, but only '
+                      'has %s parts for %s' % (line_len, line))
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] + \
+                    [float(i) for i in line[1:-1]]
+            except Exception as e:
+                print('Parsing lst met error for %s, detail: %s'
+                      % (line, e))
+                continue
+            yield item
+
+
+def image_encode(args, i, item, q_out):
+    """Load, optionally resize/center-crop, JPEG-encode one image."""
+    from PIL import Image
+    fullpath = os.path.join(args.root, item[1])
+    header = recordio.IRHeader(0, item[2] if len(item) == 3 else
+                               np.array(item[2:], np.float32), item[0], 0)
+    if args.pass_through:
+        with open(fullpath, 'rb') as fin:
+            img = fin.read()
+        q_out.append((i, recordio.pack(header, img), item))
+        return
+    try:
+        img = Image.open(fullpath).convert('RGB')
+    except Exception as e:
+        print('imread error trying to load file: %s (%s)' % (fullpath, e))
+        q_out.append((i, None, item))
+        return
+    w, h = img.size
+    if args.center_crop and w != h:
+        m = min(w, h)
+        img = img.crop(((w - m) // 2, (h - m) // 2,
+                        (w - m) // 2 + m, (h - m) // 2 + m))
+        w, h = img.size
+    if args.resize and min(w, h) > args.resize:
+        if w > h:
+            img = img.resize((args.resize * w // h, args.resize),
+                             Image.BICUBIC)
+        else:
+            img = img.resize((args.resize, args.resize * h // w),
+                             Image.BICUBIC)
+    arr = np.asarray(img, np.uint8)
+    q_out.append((i, recordio.pack_img(header, arr, quality=args.quality,
+                                       img_fmt=args.encoding), item))
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description='Create an image list or RecordIO file '
+                    '(reference: tools/im2rec.py)')
+    parser.add_argument('prefix', help='prefix of input/output lst and '
+                                       'rec files')
+    parser.add_argument('root', help='path to folder containing images')
+    cgroup = parser.add_argument_group('Options for creating image lists')
+    cgroup.add_argument('--list', action='store_true',
+                        help='make image list')
+    cgroup.add_argument('--exts', nargs='+',
+                        default=['.jpeg', '.jpg', '.png'])
+    cgroup.add_argument('--chunks', type=int, default=1)
+    cgroup.add_argument('--train-ratio', type=float, default=1.0)
+    cgroup.add_argument('--test-ratio', type=float, default=0)
+    cgroup.add_argument('--recursive', action='store_true')
+    cgroup.add_argument('--shuffle', type=bool, default=True)
+    rgroup = parser.add_argument_group('Options for creating rec files')
+    rgroup.add_argument('--pass-through', action='store_true',
+                        help='skip transformation and copy original bytes')
+    rgroup.add_argument('--resize', type=int, default=0)
+    rgroup.add_argument('--center-crop', action='store_true')
+    rgroup.add_argument('--quality', type=int, default=95)
+    rgroup.add_argument('--num-thread', type=int, default=1)
+    rgroup.add_argument('--encoding', type=str, default='.jpg',
+                        choices=['.jpg', '.png'])
+    return parser.parse_args()
+
+
+def make_lists(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = '_%dof%d' % (i, args.chunks) if args.chunks > 1 else ''
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + '.lst', chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + '_test.lst',
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + '_val.lst',
+                           chunk[sep + sep_test:])
+            write_list(args.prefix + str_chunk + '_train.lst',
+                       chunk[sep_test:sep + sep_test])
+
+
+def make_rec(args, fname):
+    print('Creating .rec file from', fname, 'in', os.path.dirname(fname)
+          or '.')
+    fname_base = os.path.splitext(fname)[0]
+    image_list = list(read_list(fname))
+    record = recordio.MXIndexedRecordIO(fname_base + '.idx',
+                                        fname_base + '.rec', 'w')
+    tic = time.time()
+    cnt = 0
+    for i, item in enumerate(image_list):
+        out = []
+        image_encode(args, i, item, out)
+        _, packed, _ = out[0]
+        if packed is None:
+            continue
+        record.write_idx(item[0], packed)
+        if cnt % 1000 == 0 and cnt > 0:
+            print('time:', time.time() - tic, ' count:', cnt)
+            tic = time.time()
+        cnt += 1
+    record.close()
+    print('total', cnt, 'images packed')
+
+
+if __name__ == '__main__':
+    args = parse_args()
+    if args.list:
+        make_lists(args)
+    else:
+        files = [f for f in sorted(os.listdir(
+            os.path.dirname(args.prefix) or '.'))
+            if f.startswith(os.path.basename(args.prefix)) and
+            f.endswith('.lst')]
+        if not files:
+            raise RuntimeError(
+                f'no .lst file found with prefix {args.prefix}; run with '
+                f'--list first')
+        for f in files:
+            make_rec(args, os.path.join(os.path.dirname(args.prefix)
+                                        or '.', f))
