@@ -260,8 +260,8 @@ class ContrastJitterAug(Augmenter):
         arr = _as_np(src).astype(np.float32)
         alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
         gray = (arr * self._coef).sum(axis=2, keepdims=True)
-        mean = gray.mean() * (1.0 - alpha) * np.ones_like(arr) / 3.0
-        return [nd_array(arr * alpha + mean * 3.0 / arr.shape[2])]
+        # contrast scales around the gray mean: gray image stays put
+        return [nd_array(arr * alpha + gray.mean() * (1.0 - alpha))]
 
 
 class SaturationJitterAug(Augmenter):
@@ -403,8 +403,7 @@ class ImageIter(DataIter):
                  data_name='data', label_name='softmax_label', **kwargs):
         super().__init__()
         assert path_imgrec or path_imglist or isinstance(imglist, list)
-        assert len(data_shape) == 3 and data_shape[0] == 3 or \
-            data_shape[0] == 1
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_width = label_width

@@ -115,18 +115,14 @@ class ImageRecordIter(DataIter):
                 lab[:self.label_width]
             jpegs.append(img)
         c, h, w = self.data_shape
+        # decode size must cover the crop; with resize set, decode at
+        # (>=resize, aspect not preserved — a deliberate simplification of
+        # the reference's shorter-edge resize) but never below (h, w)
+        dec_h = max(h, self.resize) if self.resize > 0 else h
+        dec_w = max(w, self.resize) if self.resize > 0 else w
         if self._native:
-            if self.rand_crop or self.resize > 0:
-                # decode at the resize edge, then crop on host
-                dec_h = dec_w = max(self.resize, h) if self.resize > 0 \
-                    else h
-                if self.resize > 0:
-                    dec_h = dec_w = self.resize
-                arr, fails = native.decode_jpeg_batch(
-                    jpegs, dec_h, dec_w, c, self.nthreads)
-            else:
-                arr, fails = native.decode_jpeg_batch(
-                    jpegs, h, w, c, self.nthreads)
+            arr, fails = native.decode_jpeg_batch(
+                jpegs, dec_h, dec_w, c, self.nthreads)
             if fails:
                 logging.debug("%d corrupt images zero-filled", fails)
         else:
@@ -136,11 +132,9 @@ class ImageRecordIter(DataIter):
                 im = np.asarray(imdecode(b, 1 if c == 3 else 0)
                                 .asnumpy(), np.uint8)
                 from PIL import Image
-                size = (self.resize, self.resize) if self.resize > 0 \
-                    else (w, h)
                 im = np.asarray(Image.fromarray(
                     im if c == 3 else im[:, :, 0]).resize(
-                        size, Image.BILINEAR), np.uint8)
+                        (dec_w, dec_h), Image.BILINEAR), np.uint8)
                 if c == 1:
                     im = im[:, :, None]
                 outs.append(im)
@@ -169,25 +163,29 @@ class ImageRecordIter(DataIter):
         labels = labels[:, 0] if self.label_width == 1 else labels
         return arr, labels
 
-    def _producer(self, order):
+    def _producer(self, order, out_queue, stop):
+        # queue/stop passed by value: a worker outliving reset() keeps
+        # talking to ITS epoch's queue, never the replacement's
         try:
             n = len(order)
             for start in range(0, n - self.batch_size + 1,
                                self.batch_size):
-                if self._stop.is_set():
+                if stop.is_set():
                     return
                 idxs = order[start:start + self.batch_size]
-                self._queue.put(self._load_batch(idxs))
+                out_queue.put(self._load_batch(idxs))
             rem = n % self.batch_size
-            if rem and self.round_batch and n >= self.batch_size:
+            if rem and self.round_batch:
                 # wrap around to fill the final batch (reference:
-                # round_batch pads from the epoch start)
+                # round_batch pads from the epoch start); datasets smaller
+                # than batch_size tile cyclically
                 idxs = np.concatenate([order[n - rem:],
-                                       order[:self.batch_size - rem]])
+                                       order[np.arange(
+                                           self.batch_size - rem) % n]])
                 batch = self._load_batch(idxs)
-                self._queue.put(batch + (self.batch_size - rem,))
+                out_queue.put(batch + (self.batch_size - rem,))
         finally:
-            self._queue.put(None)
+            out_queue.put(None)
 
     def reset(self):
         self._stop.set()
@@ -200,17 +198,22 @@ class ImageRecordIter(DataIter):
                 pass
             self._worker.join(timeout=5)
         self._stop = threading.Event()
+        self._done = False
         order = self._order.copy()
         if self.shuffle:
             self._rng.shuffle(order)
         self._queue = queue.Queue(maxsize=self._prefetch_n)
-        self._worker = threading.Thread(target=self._producer,
-                                        args=(order,), daemon=True)
+        self._worker = threading.Thread(
+            target=self._producer, args=(order, self._queue, self._stop),
+            daemon=True)
         self._worker.start()
 
     def next(self):
+        if self._done:
+            raise StopIteration
         item = self._queue.get()
         if item is None:
+            self._done = True
             raise StopIteration
         if len(item) == 3:
             data, label, pad = item

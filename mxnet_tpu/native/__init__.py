@@ -71,25 +71,43 @@ def available() -> bool:
     return get_lib() is not None
 
 
-def index_rec_file(path, max_records=1 << 24):
+def index_rec_file(path):
     """Offsets of every logical record in a .rec file."""
     lib = get_lib()
-    offsets = np.zeros(max_records, dtype=np.int64)
+    # every record costs >= 8 bytes of framing, so filesize/8 bounds the
+    # record count — no oversized guess allocation
+    cap = os.path.getsize(path) // 8 + 1
+    offsets = np.zeros(cap, dtype=np.int64)
     n = lib.rec_index_file(
         path.encode(), offsets.ctypes.data_as(
-            ctypes.POINTER(ctypes.c_int64)), max_records)
+            ctypes.POINTER(ctypes.c_int64)), cap)
     if n < 0:
         raise IOError(f"rec_index_file failed for {path}")
     return offsets[:n].copy()
 
 
-def read_records(path, offsets, est_size=1 << 20):
-    """Read logical records at the given offsets; returns list of bytes."""
+def read_records(path, offsets, file_offsets=None):
+    """Read logical records at the given offsets; returns list of bytes.
+
+    ``file_offsets``: the full sorted offset array for the file (e.g. from
+    :func:`index_rec_file`) — used to size each record's buffer exactly
+    from consecutive-offset deltas.  Without it, a sort of ``offsets``
+    plus the file size provides a (looser) upper bound per record.
+    """
     lib = get_lib()
     n = len(offsets)
     offs = np.ascontiguousarray(offsets, dtype=np.int64)
-    bufs = [np.empty(est_size, dtype=np.uint8) for _ in range(n)]
-    lens = np.full(n, est_size, dtype=np.int64)
+    fsize = os.path.getsize(path)
+    if file_offsets is None:
+        file_offsets = offs
+    # unique-sort: requested offsets may repeat (wrap-around batches)
+    bounds = np.concatenate([np.unique(np.asarray(file_offsets, np.int64)),
+                             [fsize]])
+    # payload <= on-disk extent of the record (framing makes it smaller)
+    pos = np.searchsorted(bounds, offs)
+    caps = bounds[pos + 1] - offs
+    bufs = [np.empty(int(c), dtype=np.uint8) for c in caps]
+    lens = caps.astype(np.int64)
     arr_t = ctypes.POINTER(ctypes.c_uint8) * n
     ptrs = arr_t(*[b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
                    for b in bufs])
@@ -98,26 +116,10 @@ def read_records(path, offsets, est_size=1 << 20):
         n, ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     if rc != 0:
         raise IOError(f"rec_read_batch failed ({rc}) for {path}")
-    out = []
-    retry = [(i, -lens[i]) for i in range(n) if lens[i] < 0]
-    for i, need in retry:
-        big = np.empty(int(need), dtype=np.uint8)
-        lens2 = np.full(1, int(need), dtype=np.int64)
-        one = arr_t.__class__  # noqa: F841 (clarity)
-        p1 = (ctypes.POINTER(ctypes.c_uint8) * 1)(
-            big.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
-        o1 = np.array([offs[i]], dtype=np.int64)
-        rc = lib.rec_read_batch(
-            path.encode(),
-            o1.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), 1, p1,
-            lens2.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
-        if rc != 0 or lens2[0] < 0:
-            raise IOError(f"rec_read_batch retry failed for {path}")
-        bufs[i] = big
-        lens[i] = lens2[0]
-    for i in range(n):
-        out.append(bufs[i][:lens[i]].tobytes())
-    return out
+    if (lens < 0).any():
+        raise IOError(f"rec_read_batch: record larger than its on-disk "
+                      f"extent in {path} (corrupt index?)")
+    return [bufs[i][:lens[i]].tobytes() for i in range(n)]
 
 
 def decode_jpeg_batch(jpeg_buffers, height, width, channels=3,

@@ -1,0 +1,167 @@
+"""Flash attention — Pallas TPU kernel.
+
+NEW capability relative to the reference (SURVEY.md §5.7: the transformer
+era postdates MXNet 0.12; nothing like this exists there).  This is the
+TPU answer to the reference's cuDNN-fused kernels: an online-softmax
+blocked attention whose QK^T and PV matmuls tile onto the MXU and whose
+working set stays in VMEM — O(S) memory instead of the O(S²) a naive
+softmax(QK^T)V materializes.
+
+The backward pass is a recompute-based vjp expressed in jnp (XLA fuses it
+well); the forward kernel is where the memory win lives.  On non-TPU
+backends the same kernel runs in pallas interpret mode, so unit tests
+cover the identical code path (SURVEY.md §4 device-consistency strategy).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _pick_interpret():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_fwd(q, k, v, causal=False, scale=None, block_q=128,
+               block_k=128, interpret=None):
+    """q: (B, H, Sq, D); k/v: (B, H, Sk, D) → (B, H, Sq, D)."""
+    from jax.experimental import pallas as pl
+
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = _pick_interpret()
+
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+
+    # pad head dim to the 128-lane tile and seqs to block multiples
+    Dp = max(128, D) if not interpret else D
+    pad_q = (-Sq) % block_q
+    pad_k = (-Sk) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, Dp - D)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, Dp - D)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, Dp - D)))
+    Sqp, Skp = Sq + pad_q, Sk + pad_k
+    nq = Sqp // block_q
+    nk = Skp // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[0].astype(jnp.float32)          # (BQ, Dp)
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)            # global q rows
+
+        if causal:
+            # blocks strictly above the diagonal contribute nothing
+            hi = jnp.minimum(
+                jnp.int32(nk),
+                (qi * block_q + block_q + block_k - 1) // block_k
+            ).astype(jnp.int32)
+        else:
+            hi = nk
+
+        def body(i, carry):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(i * block_k, block_k), :] \
+                .astype(jnp.float32)               # (BK, Dp)
+            vb = v_ref[0, pl.ds(i * block_k, block_k), :] \
+                .astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+            k_pos = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            valid = k_pos < Sk                      # mask K padding
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+            s = jnp.where(valid, s, _NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + p.sum(axis=-1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        a0 = jnp.zeros((block_q, Dp), jnp.float32)
+        m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, a0))
+        o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+    qr = qp.reshape(B * H, Sqp, Dp)
+    kr = kp.reshape(B * H, Skp, Dp)
+    vr = vp.reshape(B * H, Skp, Dp)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Skp, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Skp, Dp), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sqp, Dp), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Sqp, Dp)[:, :, :Sq, :D]
+
+
+def _attn_reference(q, k, v, causal, scale):
+    """Plain-XLA attention used by the recompute backward."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Sq, Sk = q.shape[2], k.shape[2]
+        mask = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1) <= \
+            lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, scale=None):
+    """Blocked online-softmax attention.  q/k/v: (B, H, S, D)."""
+    return _flash_fwd(q, k, v, causal=causal, scale=scale)
+
+
+def _fa_fwd(q, k, v, causal, scale):
+    return _flash_fwd(q, k, v, causal=causal, scale=scale), (q, k, v)
+
+
+def _fa_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_:
+                     _attn_reference(q_, k_, v_, causal, scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@register("_contrib_FlashAttention",
+          arg_names=["query", "key", "value"],
+          attr_defaults={"causal": False, "scale": None},
+          aliases=("flash_attention", "_contrib_flash_attention"))
+def _flash_attention_op(query, key, value, causal=False, scale=None, **kw):
+    """Registry entry point: usable from mx.nd / mx.sym / gluon."""
+    return flash_attention(query, key, value, bool(causal), scale)

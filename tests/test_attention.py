@@ -1,0 +1,107 @@
+"""Flash/ring attention tests (new TPU-native capability, SURVEY.md §5.7).
+
+Pallas kernel runs in interpret mode on the CPU mesh — same code path as
+TPU (SURVEY.md §4 consistency strategy)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.ops.attention import flash_attention, _attn_reference
+
+
+def _rand_qkv(B=2, H=2, S=96, D=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, H, S, D).astype('float32'))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = _rand_qkv()
+    out = flash_attention(q, k, v, causal, None)
+    ref = _attn_reference(q, k, v, causal, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_unaligned_seq():
+    """Sequence not a multiple of the block size exercises the padding
+    masks."""
+    q, k, v = _rand_qkv(S=100)
+    out = flash_attention(q, k, v, True, None)
+    ref = _attn_reference(q, k, v, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_cross_attention():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 2, 48, 16).astype('float32'))
+    k = jnp.asarray(rng.randn(1, 2, 80, 16).astype('float32'))
+    v = jnp.asarray(rng.randn(1, 2, 80, 16).astype('float32'))
+    out = flash_attention(q, k, v, False, None)
+    ref = _attn_reference(q, k, v, False, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_gradients():
+    q, k, v = _rand_qkv(S=64)
+    f = lambda *xs: jnp.sum(flash_attention(*xs, True, None) ** 2)
+    fr = lambda *xs: jnp.sum(_attn_reference(*xs, True, None) ** 2)
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_via_op_registry():
+    """The op is reachable from the nd/sym frontends."""
+    q, k, v = _rand_qkv(S=32, D=16)
+    out = mx.nd.flash_attention(mx.nd.NDArray(q), mx.nd.NDArray(k),
+                                mx.nd.NDArray(v), causal=True)
+    ref = _attn_reference(q, k, v, True, None)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_ring_attention_exact(causal):
+    mesh = par.make_mesh(dp=1, sp=8)
+    q, k, v = _rand_qkv(S=64)
+    qs, ks, vs = (par.shard_seq(x, mesh) for x in (q, k, v))
+    out = par.ring_attention(qs, ks, vs, mesh, causal=causal)
+    ref = _attn_reference(q, k, v, causal, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_grad():
+    mesh = par.make_mesh(dp=1, sp=8)
+    q, k, v = _rand_qkv(S=64)
+    qs, ks, vs = (par.shard_seq(x, mesh) for x in (q, k, v))
+    f = lambda a, b, c: jnp.sum(
+        par.ring_attention(a, b, c, mesh, causal=True) ** 2)
+    fr = lambda a, b, c: jnp.sum(_attn_reference(a, b, c, True, None) ** 2)
+    g = jax.grad(f, argnums=(0, 1, 2))(qs, ks, vs)
+    gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_dp_sp():
+    """dp and sp compose: batch over dp, sequence over the sp ring."""
+    mesh = par.make_mesh(dp=2, sp=4)
+    q, k, v = _rand_qkv(B=4, S=32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P('dp', None, 'sp', None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = par.ring_attention(qs, ks, vs, mesh, causal=True)
+    ref = _attn_reference(q, k, v, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

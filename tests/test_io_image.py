@@ -191,3 +191,52 @@ def test_gluon_image_record_dataset(rec_dataset):
     img, label = ds[5]
     assert img.shape[2] == 3
     assert float(label) == 5 % 4
+
+
+def test_image_record_iter_small_dataset(tmp_path):
+    """Fewer records than batch_size yields one wrapped batch (review
+    fix); a second next() after exhaustion raises StopIteration."""
+    rec_path = str(tmp_path / 's.rec')
+    idx_path = str(tmp_path / 's.idx')
+    rng = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, 'w')
+    for i in range(5):
+        img = rng.randint(0, 255, (20, 20, 3), dtype=np.uint8)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    rec.close()
+    it = mx.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 16, 16),
+                            batch_size=8)
+    b = next(iter(it))
+    assert b.data[0].shape == (8, 3, 16, 16)
+    assert b.pad == 3
+    with pytest.raises(StopIteration):
+        it.next()
+    with pytest.raises(StopIteration):
+        it.next()   # repeated calls must not hang
+
+
+def test_image_record_iter_nonsquare(tmp_path):
+    rec_path = str(tmp_path / 'n.rec')
+    idx_path = str(tmp_path / 'n.idx')
+    rng = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, 'w')
+    for i in range(8):
+        img = rng.randint(0, 255, (50, 70, 3), dtype=np.uint8)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    rec.close()
+    it = mx.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 64),
+                            batch_size=4, rand_crop=True, resize=40)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 32, 64)
+
+
+def test_contrast_jitter_preserves_gray_mean():
+    gray = np.full((10, 10, 3), 100, np.uint8)
+    aug = image.ContrastJitterAug(0.5)
+    out = aug(gray)[0].asnumpy()
+    # contrast around the mean: a uniform gray image keeps its gray value
+    lum = (out * np.array([[[0.299, 0.587, 0.114]]])).sum(2)
+    np.testing.assert_allclose(lum.mean(), 100.0 * (0.299+0.587+0.114),
+                               rtol=0.05)
