@@ -43,6 +43,14 @@ from . import model
 from . import module
 from . import module as mod
 from .module import Module, BaseModule
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+from . import operator
+from . import operator as op
 from . import serialization
 from . import models
 from . import parallel

@@ -17,6 +17,7 @@ segment execution.
 from __future__ import annotations
 
 import functools
+from contextlib import nullcontext as _nullcontext
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -303,10 +304,12 @@ class Executor:
     def _materialize(self, snapshot, out_arrays, monitor=False):
         arg_vals, aux_vals, key, is_train = snapshot
         if monitor:
+            from . import profiler as _prof
             collected = []
-            outs, new_aux = self._run(arg_vals, aux_vals, key, is_train,
-                                      _collect=lambda n, os: collected.append(
-                                          (n, os)))
+            with _prof.scope("executor_forward_monitored", "symbolic"):
+                outs, new_aux = self._run(
+                    arg_vals, aux_vals, key, is_train,
+                    _collect=lambda n, os: collected.append((n, os)))
             cb = self._monitor_callback
             for n, os in collected:
                 for i, o in enumerate(os):
@@ -314,7 +317,10 @@ class Executor:
                           else f"{n.name}_output{i}")
                     cb(nm, NDArray(o))
         else:
-            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key, is_train)
+            from . import profiler as _prof
+            with _prof.scope("executor_forward", "symbolic"):
+                outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
+                                              is_train)
         for oa, v in zip(out_arrays, outs):
             oa._set_data(v)
         if is_train and snapshot is self._snapshot:
@@ -365,7 +371,10 @@ class Executor:
             diff_idx = [i for i, o in enumerate(out_avals)
                         if jnp.issubdtype(o.dtype, jnp.inexact)]
             cts = tuple(vals[i] for i in diff_idx)
-        outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals, key, cts)
+        from . import profiler as _prof
+        with _prof.scope("executor_fwd_bwd", "symbolic"):
+            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
+                                                     key, cts)
         if self._out_arrays is None:
             self._out_arrays = [NDArray(o) for o in outs]
         else:

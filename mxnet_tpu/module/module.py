@@ -419,9 +419,11 @@ class Module(BaseModule):
         arg_vals, aux_vals, key, _ = snapshot
         states = tuple(tuple(s._data for s in self._opt_states[n])
                        for n in names)
-        outs, new_aux, new_params, new_states = self._fused_step(
-            arg_vals, aux_vals, key, states, lrs, wds,
-            jnp.asarray(t, jnp.int32))
+        from .. import profiler as _prof
+        with _prof.scope("fused_train_step", "symbolic"):
+            outs, new_aux, new_params, new_states = self._fused_step(
+                arg_vals, aux_vals, key, states, lrs, wds,
+                jnp.asarray(t, jnp.int32))
         exec_ = self._exec
         if exec_._out_arrays is not None:
             for oa, v in zip(exec_._out_arrays, outs):

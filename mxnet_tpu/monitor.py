@@ -1,0 +1,78 @@
+"""Monitor: per-output tensor statistics during training.
+
+TPU-native port of python/mxnet/monitor.py:33 — installs the executor's
+monitor callback (Executor.set_monitor_callback ↔ the reference's
+GraphExecutor::SetMonitorCallback, graph_executor.cc:120) and prints
+``stat_func`` of every output matching ``pattern`` each ``interval``
+batches.  Note the cost model differs from CUDA: a monitored step runs
+the graph UN-fused (per-node) to observe intermediates, so enable it for
+debugging, not production epochs.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as np
+
+from .ndarray import NDArray
+
+
+class Monitor:
+    """reference: monitor.py:33."""
+
+    def __init__(self, interval, stat_func=None, pattern='.*',
+                 sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return np.abs(x.asnumpy()).mean()
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """reference: monitor.py install."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch (reference: monitor.py tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; return stats (reference: monitor.py toc)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, (list, tuple)):
+                res.append((n, k, ' '.join(str(v) for v in v_list)))
+            else:
+                res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """reference: monitor.py toc_print."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info('Batch: %7d %30s %s', n, k, v)
+        return res

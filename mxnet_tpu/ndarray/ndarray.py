@@ -16,6 +16,7 @@ The reference's engine-var read/write dependency system
 from __future__ import annotations
 
 import numbers
+from contextlib import nullcontext as _nullcontext
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -475,10 +476,12 @@ def _invoke(op_name: str, inputs, attrs, out=None):
 
     vals = [x._data for x in inputs]
     fn = opdef.fn
-    if rng_key is not None:
-        outs = fn(rng_key, *vals, **kwargs)
-    else:
-        outs = fn(*vals, **kwargs)
+    from .. import profiler as _prof
+    with _prof.scope(opdef.name, require_mode="all"):
+        if rng_key is not None:
+            outs = fn(rng_key, *vals, **kwargs)
+        else:
+            outs = fn(*vals, **kwargs)
     single = not isinstance(outs, (tuple, list))
     if single:
         outs = (outs,)

@@ -1,0 +1,154 @@
+"""Custom operators defined in Python.
+
+TPU-native re-design of the reference's custom-op stack
+(python/mxnet/operator.py CustomOp/CustomOpProp/register;
+src/operator/custom/custom.cc dispatching through an MXCallbackList with
+async-engine integration).  Here the host↔device boundary is
+``jax.pure_callback``: the user's numpy ``forward``/``backward`` run on
+host while staying embeddable in jit-compiled graphs; a ``jax.custom_vjp``
+wires the user's backward into autodiff.  The performance caveat of the
+reference (custom ops serialize the engine) maps to the TPU caveat
+(callbacks force a device→host→device round trip) — same tool, same cost
+profile, SURVEY.md §7 "hard parts".
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+
+class CustomOp:
+    """Base class for operator implementations
+    (reference: operator.py:466 CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """reference: operator.py CustomOp.assign."""
+        if req == 'null':
+            return
+        if req in ('write', 'inplace'):
+            dst[:] = src
+        elif req == 'add':
+            dst[:] += src
+
+
+class CustomOpProp:
+    """Operator metadata: shapes/types/state
+    (reference: operator.py:533 CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_arguments(self):
+        return ['data']
+
+    def list_outputs(self):
+        return ['output']
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_PROP_REGISTRY: Dict[str, type] = {}
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp
+    (reference: operator.py:743 register / MXCustomOpRegister)."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("can only register subclasses of CustomOpProp")
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_prop_cls(op_type):
+    if op_type not in _PROP_REGISTRY:
+        raise MXNetError(
+            f"custom op type {op_type!r} is not registered "
+            f"(use @mx.operator.register({op_type!r}))")
+    return _PROP_REGISTRY[op_type]
+
+
+def _make_prop(op_type, attrs):
+    kwargs = {k: v for k, v in attrs.items()
+              if k not in ('op_type',) and not k.startswith('__')}
+    return get_prop_cls(op_type)(**kwargs)
+
+
+def num_outputs_for(attrs):
+    return len(_make_prop(attrs.get('op_type', ''), attrs).list_outputs())
+
+
+class _HostState:
+    """Keeps the stateful CustomOp instance alive across jit replays,
+    keyed per call site (the analog of the reference's stateful
+    FStatefulComputeEx dispatch)."""
+
+    def __init__(self, prop, in_shapes, in_dtypes):
+        self.prop = prop
+        self.op = prop.create_operator(None, in_shapes, in_dtypes)
+
+
+class _NDView:
+    """Mutable numpy holder passed to user forward/backward: supports the
+    [:] assignment pattern plus asnumpy()."""
+
+    def __init__(self, arr):
+        self.arr = np.array(arr, copy=True)
+
+    def __getitem__(self, k):
+        return self.arr[k]
+
+    def __setitem__(self, k, v):
+        self.arr[k] = np.asarray(v.asnumpy() if hasattr(v, 'asnumpy')
+                                 else v)
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+    def asnumpy(self):
+        return self.arr
+
+
+
+# NDArrayOp / NumpyOp legacy aliases (reference: operator.py NDArrayOp —
+# older callback op generations; the modern CustomOp covers them)
+NDArrayOp = CustomOp

@@ -1,0 +1,81 @@
+"""The `Custom` op: bridges mxnet_tpu.operator's CustomOp/CustomOpProp
+into the registry (reference: src/operator/custom/custom.cc).  Lives in
+ops/ so the nd/sym namespace autogeneration picks it up at import."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register as _op_register
+
+
+@_op_register("Custom", variadic=True, num_outputs=-1,
+              takes_is_train=True,
+              attr_defaults={"op_type": ""})
+def _custom(*inputs, op_type="", is_train=True, **attrs):
+    """reference: src/operator/custom/custom.cc (op `Custom`)."""
+    from .. import operator as _custom_mod
+    prop = _custom_mod._make_prop(op_type, attrs)
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+    n_in = len(prop.list_arguments())
+    data_in = inputs[:n_in]
+    aux_in = inputs[n_in:n_in + n_aux]
+
+    in_shapes = [tuple(x.shape) for x in data_in]
+    _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+    in_types = [x.dtype for x in data_in]
+    _, out_types, _ = prop.infer_type(list(in_types))
+    state = _custom_mod._HostState(prop, in_shapes, in_types)
+    out_avals = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                      for s, d in zip(out_shapes, out_types))
+
+    def host_forward(*vals):
+        ins = [_custom_mod._NDView(v) for v in vals[:n_in]]
+        auxs = [_custom_mod._NDView(v) for v in vals[n_in:]]
+        outs = [_custom_mod._NDView(np.zeros(s, d))
+                for s, d in zip(out_shapes, out_types)]
+        state.op.forward(is_train, ['write'] * n_out, ins, outs, auxs)
+        return tuple(o.arr for o in outs)
+
+    def host_backward(*vals):
+        # vals = out_grads + in_data + aux + SAVED out_data (no forward
+        # recompute: a stateful op's outputs must be the actual ones)
+        ogs = [_custom_mod._NDView(v) for v in vals[:n_out]]
+        ins = [_custom_mod._NDView(v) for v in vals[n_out:n_out + n_in]]
+        auxs = [_custom_mod._NDView(v)
+                for v in vals[n_out + n_in:-n_out]] if n_aux else []
+        outs = [_custom_mod._NDView(v) for v in vals[len(vals) - n_out:]]
+        igs = [_custom_mod._NDView(np.zeros(s, d))
+               for s, d in zip(in_shapes, in_types)]
+        state.op.backward(['write'] * n_in, ogs, ins, outs, igs, auxs)
+        return tuple(g.arr for g in igs)
+
+    @jax.custom_vjp
+    def fwd(*vals):
+        return jax.pure_callback(host_forward, out_avals, *vals,
+                                 vmap_method=None)
+
+    def fwd_fwd(*vals):
+        outs = fwd(*vals)
+        return outs, (vals, outs)
+
+    def fwd_bwd(res, gs):
+        vals, outs = res
+        in_avals = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                         for s, d in zip(in_shapes, in_types))
+        gs = gs if isinstance(gs, tuple) else (gs,)
+        igs = jax.pure_callback(host_backward, in_avals,
+                                *(tuple(gs) + tuple(vals) + tuple(outs)),
+                                vmap_method=None)
+        igs = igs if isinstance(igs, tuple) else (igs,)
+        # no gradient for aux states
+        return tuple(igs) + tuple(
+            jnp.zeros(a.shape, a.dtype) for a in vals[n_in:])
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    outs = fwd(*data_in, *aux_in)
+    if n_out == 1:
+        return outs[0] if isinstance(outs, tuple) else outs
+    return outs

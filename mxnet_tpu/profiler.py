@@ -1,0 +1,175 @@
+"""Profiler: chrome://tracing JSON + XLA (xplane) trace capture.
+
+TPU-native re-design of the reference profiler (src/engine/profiler.h:79
+OprExecStat collection inside the engine; python/mxnet/profiler.py:27-55
+set_config/set_state/dump_profile).  Two layers:
+
+* **host events** — the dispatch layer (eager `_invoke`, Executor
+  forward/backward, fused Module steps) records {name, start µs, dur µs}
+  pairs exactly like the reference's per-opr stats, dumped in
+  chrome://tracing format so the same tooling opens both.
+* **device truth** — `start()/stop()` also drive `jax.profiler`
+  (``MXNET_PROFILER_XLA_LOGDIR``), capturing the XLA/TPU xplane trace;
+  per-op names survive into HLO metadata.
+
+Env parity: ``MXNET_PROFILER_AUTOSTART=1`` begins profiling at import
+(reference: src/engine/profiler.cc autostart).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from .base import MXNetError, env
+
+PROFILER_STATE_STOP = 0
+PROFILER_STATE_RUN = 1
+
+_MODE_SYMBOLIC = "symbolic"
+_MODE_ALL = "all"
+
+
+class _Profiler:
+    def __init__(self):
+        self.state = PROFILER_STATE_STOP
+        self.mode = _MODE_SYMBOLIC
+        self.filename = "profile.json"
+        self.continuous_dump = False
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._xla_logdir: Optional[str] = None
+        self._xla_running = False
+
+    # -- event capture -----------------------------------------------------
+    def record(self, name, start_us, dur_us, category="operator",
+               tid=None):
+        if self.state != PROFILER_STATE_RUN:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": category, "ph": "X",
+                "ts": start_us, "dur": dur_us,
+                "pid": os.getpid(),
+                "tid": tid if tid is not None else
+                threading.get_ident() % 100000,
+            })
+
+    def scope(self, name, category="operator"):
+        return _Scope(self, name, category)
+
+    # -- lifecycle ---------------------------------------------------------
+    def set_state(self, state):
+        if state == PROFILER_STATE_RUN and \
+                self.state != PROFILER_STATE_RUN:
+            self._maybe_start_xla()
+        if state == PROFILER_STATE_STOP and \
+                self.state == PROFILER_STATE_RUN:
+            self._maybe_stop_xla()
+            if self.continuous_dump:
+                self.state = state
+                self.dump()
+        self.state = state
+
+    def _maybe_start_xla(self):
+        logdir = self._xla_logdir or env("MXNET_PROFILER_XLA_LOGDIR", None)
+        if logdir:
+            import jax
+            jax.profiler.start_trace(logdir)
+            self._xla_running = True
+
+    def _maybe_stop_xla(self):
+        if self._xla_running:
+            import jax
+            jax.profiler.stop_trace()
+            self._xla_running = False
+
+    def dump(self, finished=True):
+        with self._lock:
+            events = list(self._events)
+            if finished:
+                self._events = []
+        with open(self.filename, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+class _Scope:
+    __slots__ = ("_p", "_name", "_cat", "_t0")
+
+    def __init__(self, p, name, cat):
+        self._p = p
+        self._name = name
+        self._cat = cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *a):
+        if self._p.state == PROFILER_STATE_RUN:
+            t1 = time.perf_counter_ns()
+            self._p.record(self._name, self._t0 // 1000,
+                           (t1 - self._t0) // 1000, self._cat)
+
+
+_profiler = _Profiler()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        continuous_dump=False, **kwargs):
+    """reference: profiler.py:27 profiler_set_config / MXSetProfilerConfig."""
+    if mode not in (_MODE_SYMBOLIC, _MODE_ALL):
+        raise MXNetError(f"invalid profiler mode {mode!r}")
+    _profiler.mode = mode
+    _profiler.filename = filename
+    _profiler.continuous_dump = continuous_dump
+
+
+set_config = profiler_set_config
+
+
+def profiler_set_state(state="stop"):
+    """reference: profiler.py:40 / MXSetProfilerState."""
+    s = {"stop": PROFILER_STATE_STOP, "run": PROFILER_STATE_RUN}
+    if state not in s:
+        raise MXNetError(f"invalid profiler state {state!r}")
+    _profiler.set_state(s[state])
+
+
+set_state = profiler_set_state
+
+
+def dump_profile():
+    """reference: profiler.py:52 dump_profile / MXDumpProfile."""
+    _profiler.dump()
+
+
+dump = dump_profile
+
+
+def is_running():
+    return _profiler.state == PROFILER_STATE_RUN
+
+
+def record_event(name, start_us, dur_us, category="operator"):
+    _profiler.record(name, start_us, dur_us, category)
+
+
+_NULL = __import__("contextlib").nullcontext()
+
+
+def scope(name, category="operator", require_mode=None):
+    """Context manager for dispatch sites.  Returns a no-op context when
+    the profiler is stopped (or the mode doesn't match), so call sites
+    are just ``with profiler.scope(...):`` — all gating lives here."""
+    if _profiler.state != PROFILER_STATE_RUN:
+        return _NULL
+    if require_mode is not None and _profiler.mode != require_mode:
+        return _NULL
+    return _profiler.scope(name, category)
+
+
+if env("MXNET_PROFILER_AUTOSTART", 0):
+    profiler_set_state("run")

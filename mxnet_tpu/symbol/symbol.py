@@ -66,6 +66,9 @@ def node_num_outputs(node: Node) -> int:
             return 2 if node.attrs.get("ret_typ", "indices") == "both" else 1
         if node.op == "RNN":
             return 3 if node.attrs.get("state_outputs") else 1
+        if node.op == "Custom":
+            from .. import operator as _custom_mod
+            return _custom_mod.num_outputs_for(node.attrs)
         return 1
     return n
 
