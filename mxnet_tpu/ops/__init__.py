@@ -18,6 +18,7 @@ from . import sampling      # noqa: F401
 from . import sequence      # noqa: F401
 from . import attention     # noqa: F401
 from . import custom        # noqa: F401
+from . import detection     # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn           # noqa: F401
 from . import linalg        # noqa: F401

@@ -1,0 +1,319 @@
+"""Object-detection ops: MultiBox family + ROIPooling.
+
+TPU-native equivalents of the reference's SSD/detection operators
+(src/operator/contrib/multibox_prior.cc, multibox_target.cc,
+multibox_detection.cc; src/operator/roi_pooling.cc).  The reference's
+sequential C++ loops (greedy bipartite matching, NMS) become bounded
+``lax.fori_loop``s with masking so the whole pipeline stays inside one
+compiled program — no host round trips, static shapes throughout.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG = jnp.float32(-1.0)
+
+
+def _parse_floats(v, default):
+    if v is None or v == ():
+        return tuple(default)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# --------------------------------------------------------------------------
+# MultiBoxPrior (multibox_prior.cc MultiBoxPriorForward)
+# --------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", arg_names=["data"],
+          attr_defaults={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                         "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)},
+          aliases=("MultiBoxPrior",))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """data: (N, C, H, W) → anchors (1, H*W*(S+R-1), 4) normalized
+    [xmin, ymin, xmax, ymax]."""
+    sizes = _parse_floats(sizes, (1.0,))
+    ratios = _parse_floats(ratios, (1.0,))
+    steps = _parse_floats(steps, (-1.0, -1.0))
+    offsets = _parse_floats(offsets, (0.5, 0.5))
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    # per-cell anchor half-extents, in the reference's order:
+    # all sizes at ratio[0], then size[0] at ratios[1:]
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * H / W / 2.0)
+        hs.append(s / 2.0)
+    for r in ratios[1:]:
+        sq = float(np.sqrt(r))
+        ws.append(sizes[0] * H / W * sq / 2.0)
+        hs.append(sizes[0] / sq / 2.0)
+    ws = jnp.asarray(ws, jnp.float32)      # (A,)
+    hs = jnp.asarray(hs, jnp.float32)
+    cxg, cyg = jnp.meshgrid(cx, cy)        # (H, W)
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    boxes = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs],
+                      axis=-1)             # (H, W, A, 4)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.astype(jnp.float32)
+
+
+def _iou_matrix(anchors, gts):
+    """anchors (A,4) × gts (G,4) → (A,G) IoU
+    (multibox_detection.cc CalculateOverlap)."""
+    ax0, ay0, ax1, ay1 = [anchors[:, i:i + 1] for i in range(4)]
+    gx0, gy0, gx1, gy1 = [gts[None, :, i] for i in range(4)]
+    iw = jnp.maximum(0.0, jnp.minimum(ax1, gx1) - jnp.maximum(ax0, gx0))
+    ih = jnp.maximum(0.0, jnp.minimum(ay1, gy1) - jnp.maximum(ay0, gy0))
+    inter = iw * ih
+    union = (ax1 - ax0) * (ay1 - ay0) + \
+        (gx1 - gx0) * (gy1 - gy0) - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_loc(anchors, gt_boxes, variances):
+    """SSD offset encoding (multibox_target.cc AssignLocTargets)."""
+    v0, v1, v2, v3 = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = gt_boxes[:, 2] - gt_boxes[:, 0]
+    gh = gt_boxes[:, 3] - gt_boxes[:, 1]
+    gx = (gt_boxes[:, 0] + gt_boxes[:, 2]) / 2
+    gy = (gt_boxes[:, 1] + gt_boxes[:, 3]) / 2
+    aw = jnp.maximum(aw, 1e-8)
+    ah = jnp.maximum(ah, 1e-8)
+    return jnp.stack([
+        (gx - ax) / aw / v0,
+        (gy - ay) / ah / v1,
+        jnp.log(jnp.maximum(gw / aw, 1e-8)) / v2,
+        jnp.log(jnp.maximum(gh / ah, 1e-8)) / v3], axis=1)
+
+
+@register("_contrib_MultiBoxTarget",
+          arg_names=["anchor", "label", "cls_pred"], num_outputs=3,
+          attr_defaults={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                         "negative_mining_ratio": -1.0,
+                         "negative_mining_thresh": 0.5,
+                         "minimum_negative_samples": 0,
+                         "variances": (0.1, 0.1, 0.2, 0.2)},
+          aliases=("MultiBoxTarget",))
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5,
+                     minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """anchor (1, A, 4); label (N, G, 5) [cls, xmin, ymin, xmax, ymax],
+    padded with -1 rows; cls_pred (N, C, A).
+    Returns loc_target (N, 4A), loc_mask (N, 4A), cls_target (N, A)."""
+    variances = _parse_floats(variances, (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    G = label.shape[1]
+
+    def one(lab, cpred):
+        gt_valid = lab[:, 0] >= 0                       # (G,)
+        ious = _iou_matrix(anchors, lab[:, 1:5])        # (A, G)
+        ious = jnp.where(gt_valid[None, :], ious, -1.0)
+
+        # phase 1: greedy bipartite (multibox_target.cc:111-147) — at
+        # most G rounds, each claiming the globally-best (anchor, gt)
+        def bipartite(i, carry):
+            match_gt, match_iou, a_used, g_used = carry
+            masked = jnp.where(a_used[:, None] | g_used[None, :],
+                               -1.0, ious)
+            flat = jnp.argmax(masked)
+            aj = (flat // G).astype(jnp.int32)
+            gk = (flat % G).astype(jnp.int32)
+            best = masked[aj, gk]
+            ok = best > 1e-6
+            match_gt = jnp.where(ok, match_gt.at[aj].set(gk), match_gt)
+            match_iou = jnp.where(ok, match_iou.at[aj].set(best),
+                                  match_iou)
+            a_used = jnp.where(ok, a_used.at[aj].set(True), a_used)
+            g_used = jnp.where(ok, g_used.at[gk].set(True), g_used)
+            return match_gt, match_iou, a_used, g_used
+
+        match_gt = jnp.full((A,), -1, jnp.int32)
+        match_iou = jnp.full((A,), -1.0, jnp.float32)
+        a_pos = jnp.zeros((A,), bool)
+        g_used = jnp.zeros((G,), bool)
+        match_gt, match_iou, a_pos, g_used = lax.fori_loop(
+            0, G, bipartite, (match_gt, match_iou, a_pos, g_used))
+
+        # phase 2: per-anchor threshold matching (:149-178)
+        best_gt = jnp.argmax(ious, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(ious, axis=1)
+        thresh_pos = (~a_pos) & (best_iou > overlap_threshold) & \
+            (overlap_threshold > 0)
+        match_gt = jnp.where(a_pos, match_gt,
+                             jnp.where(best_iou > -1.0, best_gt, -1))
+        match_iou = jnp.where(a_pos, match_iou, best_iou)
+        a_pos = a_pos | thresh_pos
+        num_pos = a_pos.sum()
+
+        # negatives: mined or all (:180-247)
+        if negative_mining_ratio > 0:
+            # background prob of each anchor under softmax over classes
+            logits = cpred                              # (C, A)
+            m = logits.max(axis=0)
+            p_bg = jnp.exp(logits[0] - m) / \
+                jnp.exp(logits - m[None, :]).sum(axis=0)
+            eligible = (~a_pos) & (match_iou < negative_mining_thresh)
+            # order by -p_bg descending == hardest negatives first
+            score = jnp.where(eligible, -p_bg, -jnp.inf)
+            order = jnp.argsort(-score)
+            num_neg = jnp.minimum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                eligible.sum().astype(jnp.int32))
+            num_neg = jnp.maximum(num_neg,
+                                  jnp.int32(minimum_negative_samples))
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(
+                jnp.arange(A, dtype=jnp.int32))
+            a_neg = eligible & (rank < num_neg)
+        else:
+            a_neg = ~a_pos
+
+        safe_gt = jnp.clip(match_gt, 0, G - 1)
+        gt_rows = lab[safe_gt]                           # (A, 5)
+        loc_t = _encode_loc(anchors, gt_rows[:, 1:5], variances)
+        loc_t = jnp.where(a_pos[:, None], loc_t, 0.0)
+        loc_m = jnp.where(a_pos[:, None],
+                          jnp.ones((A, 4), jnp.float32), 0.0)
+        cls_t = jnp.where(a_pos, gt_rows[:, 0] + 1.0,
+                          jnp.where(a_neg, 0.0, float(ignore_label)))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(one)(label, cls_pred)
+    return loc_target, loc_mask, cls_target
+
+
+@register("_contrib_MultiBoxDetection",
+          arg_names=["cls_prob", "loc_pred", "anchor"],
+          attr_defaults={"clip": True, "threshold": 0.01,
+                         "background_id": 0, "nms_threshold": 0.5,
+                         "force_suppress": False,
+                         "variances": (0.1, 0.1, 0.2, 0.2),
+                         "nms_topk": -1},
+          aliases=("MultiBoxDetection",))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1,
+                        **kw):
+    """cls_prob (N, C, A); loc_pred (N, 4A); anchor (1, A, 4)
+    → (N, A, 6) rows [class_id, score, xmin, ymin, xmax, ymax]
+    with id = -1 for suppressed/invalid (multibox_detection.cc)."""
+    variances = _parse_floats(variances, (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(cprob, lpred):
+        # class/score per anchor (background excluded)
+        fg = cprob[1:] if background_id == 0 else \
+            jnp.concatenate([cprob[:background_id],
+                             cprob[background_id + 1:]], axis=0)
+        cid = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score >= threshold
+        cid = jnp.where(keep, cid, -1.0)
+
+        lp = lpred.reshape(A, 4)
+        ox = lp[:, 0] * variances[0] * aw + ax
+        oy = lp[:, 1] * variances[1] * ah + ay
+        ow = jnp.exp(lp[:, 2] * variances[2]) * aw / 2
+        oh = jnp.exp(lp[:, 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+
+        # sort by score descending; NMS over the top nms_topk
+        order = jnp.argsort(-jnp.where(cid >= 0, score, -jnp.inf))
+        cid_s = cid[order]
+        score_s = score[order]
+        boxes_s = boxes[order]
+        k = A if nms_topk < 0 else min(int(nms_topk), A)
+        ious = _iou_matrix(boxes_s, boxes_s)            # (A, A)
+
+        def nms_step(i, alive):
+            is_alive = alive[i] & (i < k)
+            same_cls = cid_s == cid_s[i] if not force_suppress else \
+                jnp.ones((A,), bool)
+            sup = (ious[i] > nms_threshold) & same_cls & \
+                (jnp.arange(A) > i)
+            return jnp.where(is_alive, alive & ~sup, alive)
+
+        alive = cid_s >= 0
+        alive = lax.fori_loop(0, k, nms_step, alive)
+        cid_s = jnp.where(alive, cid_s, -1.0)
+        return jnp.concatenate(
+            [cid_s[:, None], score_s[:, None], boxes_s], axis=1)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# --------------------------------------------------------------------------
+# ROIPooling (src/operator/roi_pooling.cc)
+# --------------------------------------------------------------------------
+@register("ROIPooling", arg_names=["data", "rois"],
+          attr_defaults={"pooled_size": (7, 7), "spatial_scale": 1.0})
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0, **kw):
+    """data (N, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in
+    image coords → (R, C, PH, PW) max-pooled."""
+    PH, PW = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (int(pooled_size), int(pooled_size)))
+    PH, PW = int(PH), int(PW)
+    N, C, H, W = data.shape
+
+    ygrid = jnp.arange(H, dtype=jnp.float32)
+    xgrid = jnp.arange(W, dtype=jnp.float32)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_h = rh / PH
+        bin_w = rw / PW
+        feat = data[b]                                   # (C, H, W)
+
+        ph = jnp.arange(PH, dtype=jnp.float32)
+        pw = jnp.arange(PW, dtype=jnp.float32)
+        hstart = jnp.floor(ph * bin_h) + y1              # (PH,)
+        hend = jnp.ceil((ph + 1) * bin_h) + y1
+        wstart = jnp.floor(pw * bin_w) + x1              # (PW,)
+        wend = jnp.ceil((pw + 1) * bin_w) + x1
+        ymask = (ygrid[None, :] >= hstart[:, None]) & \
+            (ygrid[None, :] < hend[:, None])             # (PH, H)
+        xmask = (xgrid[None, :] >= wstart[:, None]) & \
+            (xgrid[None, :] < wend[:, None])             # (PW, W)
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]
+        big = jnp.where(m[None], feat[:, None, None, :, :], -jnp.inf)
+        out = big.max(axis=(3, 4))                       # (C, PH, PW)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one)(rois.astype(jnp.float32)).astype(data.dtype)

@@ -26,7 +26,7 @@ def _reg_unary(name, fn, aliases=()):
 _UNARY = {
     "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint,
     "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
-    "fix": jnp.fix, "square": jnp.square, "sqrt": jnp.sqrt,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
     "rsqrt": lax.rsqrt, "cbrt": jnp.cbrt,
     "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
     "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10,

@@ -1,0 +1,172 @@
+"""Detection-op tests (model: the reference's SSD example + contrib op
+tests; SURVEY.md config 5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_multibox_prior_layout():
+    x = mx.nd.array(np.zeros((1, 3, 4, 6), 'float32'))
+    an = mx.nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    assert an.shape == (1, 4 * 6 * 3, 4)
+    a = an.asnumpy()[0]
+    # first cell, first size: center ((0.5)/6, (0.5)/4), w=s*H/W/2, h=s/2
+    np.testing.assert_allclose(
+        a[0], [0.5 / 6 - 0.5 * 4 / 6 / 2, 0.125 - 0.25,
+               0.5 / 6 + 0.5 * 4 / 6 / 2, 0.125 + 0.25], rtol=1e-5)
+    # clip
+    an2 = mx.nd.MultiBoxPrior(x, sizes=(0.9,), clip=True).asnumpy()
+    assert an2.min() >= 0.0 and an2.max() <= 1.0
+
+
+def test_multibox_target_matching():
+    anchor = mx.nd.array(np.array(
+        [[[0., 0., 0.5, 0.5], [0.4, 0.4, 0.9, 0.9],
+          [0., 0.5, 0.5, 1.0]]], 'float32'))
+    label = mx.nd.array(np.array(
+        [[[1., 0.42, 0.42, 0.88, 0.88]]], 'float32'))
+    cls_pred = mx.nd.array(np.zeros((1, 3, 3), 'float32'))
+    lt, lm, ct = mx.nd.MultiBoxTarget(anchor, label, cls_pred)
+    # anchor 1 overlaps the gt → positive with class 1+1=2; rest negative
+    np.testing.assert_allclose(ct.asnumpy(), [[0., 2., 0.]])
+    lm = lm.asnumpy().reshape(3, 4)
+    np.testing.assert_allclose(lm[:, 0], [0., 1., 0.])
+    # encoded loc target for the positive anchor: finite, non-zero
+    lt = lt.asnumpy().reshape(3, 4)
+    assert np.isfinite(lt).all()
+    assert np.abs(lt[1]).sum() > 0
+
+
+def test_multibox_target_padded_labels_and_mining():
+    anchor = mx.nd.array(np.random.RandomState(0)
+                         .rand(1, 20, 4).astype('float32'))
+    # one real gt + padding rows of -1
+    label = np.full((1, 4, 5), -1.0, 'float32')
+    label[0, 0] = [0, 0.2, 0.2, 0.7, 0.7]
+    cls_pred = mx.nd.array(np.random.RandomState(1)
+                           .randn(1, 3, 20).astype('float32'))
+    lt, lm, ct = mx.nd.MultiBoxTarget(
+        anchor, mx.nd.array(label), cls_pred,
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_neg = (ct == 0).sum()
+    n_ignore = (ct == -1).sum()
+    assert n_pos >= 1
+    assert n_neg <= max(3 * n_pos, 1)
+    assert n_pos + n_neg + n_ignore == 20
+
+
+def test_multibox_detection_decode_and_nms():
+    anchor = mx.nd.array(np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.12, 0.12, 0.42, 0.42],
+          [0.6, 0.6, 0.9, 0.9]]], 'float32'))
+    # anchors 0/1 heavily overlap; scores favor 0, so 1 is suppressed
+    cls_prob = np.zeros((1, 2, 3), 'float32')
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]
+    det = mx.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(np.zeros((1, 12), 'float32')),
+        anchor, nms_threshold=0.5).asnumpy()[0]
+    ids = det[:, 0]
+    assert (ids >= 0).sum() == 2          # one of the pair suppressed
+    kept = det[ids >= 0]
+    np.testing.assert_allclose(kept[0, 2:], [0.1, 0.1, 0.4, 0.4],
+                               atol=1e-6)
+    # zero loc_pred decodes back to the anchor box
+
+
+def test_multibox_detection_threshold():
+    anchor = mx.nd.array(np.array([[[0.1, 0.1, 0.4, 0.4]]], 'float32'))
+    cls_prob = np.zeros((1, 2, 1), 'float32')
+    cls_prob[0, 1, 0] = 0.005   # below threshold
+    det = mx.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(np.zeros((1, 4), 'float32')),
+        anchor, threshold=0.01).asnumpy()
+    assert det[0, 0, 0] == -1
+
+
+def test_roi_pooling_values_and_grad():
+    feat_np = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    rois_np = np.array([[0, 0, 0, 3, 3], [0, 2, 2, 3, 3]], 'float32')
+    out = mx.nd.ROIPooling(mx.nd.array(feat_np), mx.nd.array(rois_np),
+                           pooled_size=(2, 2), spatial_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy()[0, 0],
+                               [[5., 7.], [13., 15.]])
+    np.testing.assert_allclose(out.asnumpy()[1, 0],
+                               [[10., 11.], [14., 15.]])
+    # gradient flows to the max elements
+    from mxnet_tpu import autograd
+    x = mx.nd.array(feat_np)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.ROIPooling(x, mx.nd.array(rois_np[:1]),
+                             pooled_size=(2, 2), spatial_scale=1.0)
+        s = mx.nd.sum(y)
+    s.backward()
+    g = x.grad.asnumpy()[0, 0]
+    assert g[1, 1] == 1.0 and g[3, 3] == 1.0 and g[0, 0] == 0.0
+
+
+def test_ssd_mini_end_to_end():
+    """Config-5 analog at toy scale: conv features → priors + preds →
+    MultiBoxTarget loss → detection output after training."""
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable('data')
+    label = mx.sym.Variable('label')
+    body = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                              pad=(1, 1), name='c1')
+    body = mx.sym.Activation(body, act_type='relu')
+    body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                          pool_type='max')   # (N,8,8,8)
+    num_classes = 3   # bg + 2
+    A_per = 2
+    anchors = mx.sym.MultiBoxPrior(body, sizes=(0.3, 0.6), name='priors')
+    cls_pred = mx.sym.Convolution(body, num_filter=A_per * num_classes,
+                                  kernel=(1, 1), name='clsp')
+    cls_pred = mx.sym.Reshape(mx.sym.transpose(
+        cls_pred, axes=(0, 2, 3, 1)), shape=(0, -1, num_classes))
+    cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 1))   # (N,C,A)
+    loc_pred = mx.sym.Convolution(body, num_filter=A_per * 4,
+                                  kernel=(1, 1), name='locp')
+    loc_pred = mx.sym.Flatten(mx.sym.transpose(loc_pred,
+                                               axes=(0, 2, 3, 1)))
+    tgt = mx.sym.MultiBoxTarget(anchors, label, cls_pred, name='tgt')
+    loc_target, loc_mask, cls_target = tgt[0], tgt[1], tgt[2]
+    cls_prob = mx.sym.SoftmaxOutput(cls_pred, cls_target,
+                                    ignore_label=-1,
+                                    use_ignore=True, multi_output=True,
+                                    normalization='valid', name='cls_prob')
+    loc_loss = mx.sym.smooth_l1(loc_pred - loc_target, scalar=1.0)
+    loc_loss = mx.sym.MakeLoss(loc_loss * loc_mask,
+                               normalization='valid', name='loc_loss')
+    out = mx.sym.Group([cls_prob, loc_loss])
+
+    N = 4
+    x = rng.rand(N, 3, 16, 16).astype('float32')
+    y = np.full((N, 2, 5), -1.0, 'float32')
+    for i in range(N):
+        y[i, 0] = [0, 0.2, 0.2, 0.8, 0.8]
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=N,
+                           label_name='label')
+    mod = mx.mod.Module(out, label_names=('label',))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.5})
+    batch = next(iter(it))
+    for _ in range(10):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # detection path runs and finds the trained object
+    mod.forward(batch, is_train=False)
+    cls_prob_out = mod.get_outputs()[0]
+    ex_anchors = mx.nd.MultiBoxPrior(
+        mx.nd.array(np.zeros((1, 8, 8, 8), 'float32')),
+        sizes=(0.3, 0.6))
+    # probabilities per class over anchors
+    det = mx.nd.MultiBoxDetection(
+        cls_prob_out, mx.nd.zeros((N, ex_anchors.shape[1] * 4)),
+        ex_anchors, threshold=0.01)
+    assert det.shape == (N, ex_anchors.shape[1], 6)
