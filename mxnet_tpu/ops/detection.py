@@ -18,8 +18,6 @@ from jax import lax
 
 from .registry import register
 
-_NEG = jnp.float32(-1.0)
-
 
 def _parse_floats(v, default):
     if v is None or v == ():

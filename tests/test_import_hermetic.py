@@ -1,0 +1,39 @@
+"""Importing mxnet_tpu must never initialize a JAX backend.
+
+Round-1 regression: ``ops/detection.py`` had a module-level
+``jnp.float32(-1.0)`` that dispatched an eager JAX primitive at import time,
+forcing TPU-backend initialization during ``import mxnet_tpu``.  That crashed
+bench.py on the driver and deadlocked any subprocess importing the package
+(the axon TPU tunnel admits one client).  Import must be hermetic: zero
+device dispatch, zero backend init.
+"""
+import os
+import subprocess
+import sys
+
+_CHECK = """
+import jax
+from jax._src import xla_bridge
+# Strip any TPU-tunnel plugin and pin CPU *before* importing the framework:
+# on regression (an eager dispatch at import) the CPU backend initializes and
+# the assert below fails fast, instead of the subprocess hanging on the
+# single-client TPU tunnel until the timeout.
+xla_bridge._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu
+assert not xla_bridge._backends, (
+    "import mxnet_tpu initialized JAX backend(s): %r" %
+    list(xla_bridge._backends))
+print("HERMETIC")
+"""
+
+
+def test_import_is_hermetic():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", _CHECK], env=env, capture_output=True,
+        text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert "HERMETIC" in out.stdout
