@@ -1,12 +1,22 @@
 """Benchmark: ResNet-50 fused training-step throughput on one real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline: the reference's published ResNet-50 training speed — 109
-images/sec on 1× K80 at batch 32 (BASELINE.md,
-example/image-classification/README.md:147-157).  The measured step is the
-same work: forward + backward + SGD-momentum update at batch 32, driven
-through the framework's own Module API (bind/init/forward/backward/update),
-compiled by XLA into one program per step.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+TPU-shaped config: bfloat16 compute with fp32 master weights (the
+framework's compute_dtype mixed precision), batch 256, donated
+param/aux/optimizer buffers (in-place HBM updates), device-resident input
+batches rotated per step (the steady state an overlapped host input
+pipeline delivers — keeps the network tunnel to the chip out of the
+measurement).  The measured step is forward + backward + SGD-momentum
+update driven through the framework's own Module API
+(bind/init/forward/update), compiled by XLA into ONE program per step.
+
+Reported: imgs/sec, step_ms, and MFU (XLA cost-analysis FLOPs of the fused
+step divided by the chip's peak bf16 FLOP rate).
+
+Baseline for vs_baseline: the reference's published ResNet-50 training
+speed — 109 images/sec on 1× K80 at batch 32 (BASELINE.md,
+example/image-classification/README.md:147-157).
 """
 import json
 import os
@@ -15,21 +25,64 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+_T0 = time.perf_counter()
+
+
+def _mark(msg):
+    print("[bench +%.1fs] %s" % (time.perf_counter() - _T0, msg),
+          file=sys.stderr, flush=True)
+
 import numpy as np
 
 BASELINE_IMGS_PER_SEC = 109.0   # ResNet-50, 1x K80, batch 32
-BATCH = 32
-WARMUP = 3
-ITERS = 20
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
+ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+
+# peak dense bf16 FLOP/s per chip, keyed by jax device_kind substring
+PEAK_BF16 = [
+    ("v5 lite", 197e12),   # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),        # Trillium
+    ("trillium", 918e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
 
 
 def main():
+    # initialize the backend explicitly, with a clear diagnostic on failure
+    import jax
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "resnet50_train_imgs_per_sec",
+                          "value": None, "unit": "imgs/sec",
+                          "vs_baseline": None,
+                          "error": "backend init failed: %s" % e}))
+        return 1
+    _mark("backend up: %s" % dev.device_kind)
+    import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu import models
 
     sym = models.resnet(num_classes=1000, num_layers=50,
                         image_shape=(3, 224, 224))
-    mod = mx.mod.Module(sym, context=mx.tpu(0))
+    compute_dtype = None if DTYPE in ("float32", "fp32") else jnp.dtype(DTYPE)
+    mod = mx.mod.Module(sym, context=mx.tpu(0),
+                        compute_dtype=compute_dtype)
 
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (BATCH, 3, 224, 224)).astype(np.float32)
@@ -40,32 +93,79 @@ def main():
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.1,
                                          "momentum": 0.9, "wd": 1e-4})
-    batch = next(iter(it))
+    _mark("module bound + params initialized")
 
-    def step():
-        mod.forward(batch, is_train=True)
-        mod.backward()
+    # two device-resident batches, rotated per step — generated ON device
+    # (a 256x3x224x224 fp32 batch is 154 MB; pushing it through a
+    # remote-attached chip's tunnel would measure the tunnel, not the chip)
+    batches = []
+    for seed in (0, 1):
+        k = jax.random.PRNGKey(seed)
+        kx, ky = jax.random.split(k)
+        bx = mx.nd.NDArray(jax.random.uniform(
+            kx, (BATCH, 3, 224, 224), jnp.float32, -1.0, 1.0))
+        by = mx.nd.NDArray(jax.random.randint(
+            ky, (BATCH,), 0, 1000).astype(jnp.float32))
+        bx.wait_to_read()
+        by.wait_to_read()
+        batches.append(mx.io.DataBatch(data=[bx], label=[by]))
+
+    def step(i):
+        b = batches[i % len(batches)]
+        mod.forward(b, is_train=True)
         mod.update()
 
-    for _ in range(WARMUP):
-        step()
-    # sync: force params to materialize on host
-    mod.get_params()[0]["fc1_weight"].asnumpy()
+    _mark("device batches ready")
+    for i in range(WARMUP):
+        step(i)
+        if i == 0:
+            mod._exec.arg_dict["fc1_weight"].wait_to_read()
+            _mark("first step done (compile)")
+    mod._exec.arg_dict["fc1_weight"].wait_to_read()
+    _mark("warmup done")
+
+    # FLOPs of one fused step from XLA cost analysis (fwd + bwd + update)
+    mod.forward(batches[0], is_train=True)
+    try:
+        flops_per_step = mod.fused_step_flops()
+    except Exception:  # noqa: BLE001
+        flops_per_step = None
+    if not flops_per_step:
+        # analytic fallback: ResNet-50 ≈ 4.1e9 MACs fwd → 3x for training
+        flops_per_step = 2 * 4.1e9 * 3 * BATCH
+        flops_source = "analytic"
+    else:
+        flops_source = "xla_cost_analysis"
+    mod.update()  # consume the snapshot taken for cost analysis
+    _mark("cost analysis done: %s" % flops_per_step)
 
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        step()
-    mod.get_params()[0]["fc1_weight"].asnumpy()
+    for i in range(ITERS):
+        step(i)
+    mod._exec.arg_dict["fc1_weight"].wait_to_read()
     dt = time.perf_counter() - t0
 
-    imgs_per_sec = BATCH * ITERS / dt
-    print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_batch32",
+    step_s = dt / ITERS
+    imgs_per_sec = BATCH / step_s
+    peak = _peak_flops(dev.device_kind)
+    mfu = (flops_per_step / step_s / peak) if peak else None
+    out = {
+        "metric": "resnet50_train_imgs_per_sec",
         "value": round(imgs_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
-    }))
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
+        "step_ms": round(step_s * 1e3, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch": BATCH,
+        "dtype": str(DTYPE),
+        "device": dev.device_kind,
+        "flops_per_step": flops_per_step,
+        "flops_source": flops_source,
+        "peak_flops": peak,
+    }
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -33,12 +33,32 @@ from .ops import registry as _reg
 from .symbol.symbol import Symbol, node_num_outputs, _topo_sort
 
 
-def build_interpreter(sym: Symbol):
+# Ops kept in float32 under mixed precision: normalization statistics and
+# loss heads.  This is the TPU-native analog of the reference's fp16
+# training recipe (example train scripts cast data to fp16 but cuDNN
+# BatchNorm keeps fp32 statistics, and SoftmaxOutput runs on an fp32 cast).
+AMP_FP32_OPS = frozenset({
+    "BatchNorm", "InstanceNorm", "L2Normalization", "LRN", "norm",
+    "SoftmaxOutput", "SoftmaxActivation", "softmax", "log_softmax",
+    "log_softmax_mx", "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "MakeLoss", "SVMOutput", "CTCLoss",
+    "softmax_cross_entropy",
+})
+
+
+def build_interpreter(sym: Symbol, compute_dtype=None):
     """Build ``run(arg_vals, aux_vals, key, is_train) -> (outs, new_aux)``.
 
     The returned function is pure — jit/vjp/vmap-compatible.  RNG ops get
     per-node subkeys split from ``key`` (replacement for the reference's
     per-device PRNG resource, src/resource.cc kRandom).
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision: all
+    floating-point op inputs are cast to it except ops in ``AMP_FP32_OPS``,
+    which run in float32.  Master parameters stay float32 in HBM; the casts
+    are inserted per-use and fused by XLA into the surrounding ops, so the
+    MXU sees bf16 operands while optimizer state and normalization
+    statistics keep full precision.
     """
     nodes = _topo_sort(sym.heads)
     arg_names = sym.list_arguments()
@@ -49,6 +69,15 @@ def build_interpreter(sym: Symbol):
     rng_ids = [id(n) for n in nodes
                if not n.is_variable and _reg.get(n.op).needs_rng]
     rng_index = {nid: i for i, nid in enumerate(rng_ids)}
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def _amp_cast(ins, op):
+        want = jnp.float32 if op in AMP_FP32_OPS else cd
+        return [v.astype(want)
+                if (hasattr(v, "dtype")
+                    and jnp.issubdtype(v.dtype, jnp.floating)
+                    and v.dtype != want) else v
+                for v in ins]
 
     def run(arg_vals, aux_vals, key, is_train, _collect=None):
         env = {}
@@ -64,6 +93,8 @@ def build_interpreter(sym: Symbol):
                 continue
             opdef = _reg.get(n.op)
             ins = [env[(id(src), i)] for src, i in n.inputs]
+            if cd is not None:
+                ins = _amp_cast(ins, n.op)
             kwargs = dict(n.attrs)
             kwargs.pop("name", None)
             if opdef.takes_is_train:
@@ -91,15 +122,33 @@ def build_interpreter(sym: Symbol):
     return run, arg_names, aux_names
 
 
+def poison_stale(arr, what):
+    """Permanently mark a lazy NDArray as unavailable with a clear error.
+
+    Used after a donated fused training step consumes the buffers a pending
+    thunk would need.  The poison thunk re-arms itself before raising, so
+    every read fails loudly instead of only the first (NDArray._data pops
+    the thunk before invoking it)."""
+    def thunk():
+        arr._set_lazy(thunk)  # re-arm: stay poisoned across reads
+        raise MXNetError(
+            f"{what} buffers were fused into the donated training step and "
+            "are not materialized after update(); read them before "
+            "update(), or set MXNET_FUSED_DONATE=0 / "
+            "MXNET_EXEC_BULK_EXEC_TRAIN=0 to keep them live")
+    arr._set_lazy(thunk)
+
+
 class Executor:
     """reference: include/mxnet/executor.h:52; python/mxnet/executor.py."""
 
     def __init__(self, symbol: Symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None, group2ctx=None,
-                 shared_exec=None):
+                 shared_exec=None, compute_dtype=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
-        run, arg_names, aux_names = build_interpreter(symbol)
+        self._compute_dtype = compute_dtype
+        run, arg_names, aux_names = build_interpreter(symbol, compute_dtype)
         self._run = run
         self._arg_names = arg_names
         self._aux_names = aux_names
@@ -118,6 +167,10 @@ class Executor:
         self._snapshot = None
         self._is_train = False
         self._last_key = None
+        # output handles issued by forward() whose thunks still reference a
+        # live snapshot — must be poisoned if a donated step consumes the
+        # snapshot's buffers
+        self._issued_outs: List[NDArray] = []
 
         self._jit_fwd = jax.jit(
             lambda a, x, k, t: run(a, x, k, t), static_argnums=(3,))
@@ -186,7 +239,8 @@ class Executor:
     # ------------------------------------------------------------------
     @classmethod
     def simple_bind(cls, symbol: Symbol, ctx=None, grad_req="write",
-                    type_dict=None, shared_exec=None, shapes=None):
+                    type_dict=None, shared_exec=None, shapes=None,
+                    compute_dtype=None):
         """reference: MXExecutorSimpleBind (c_api_executor.cc:219) —
         infer all shapes from the provided input shapes, allocate arg/grad/aux
         arrays, return a bound executor."""
@@ -199,7 +253,8 @@ class Executor:
                 for n, s in zip(arg_names, arg_shapes)]
         aux = [nd_zeros(s, dtype=type_dict.get(n, "float32"))
                for n, s in zip(aux_names, aux_shapes)]
-        ex = cls(symbol, ctx, args=args, grad_req=grad_req, aux_states=aux)
+        ex = cls(symbol, ctx, args=args, grad_req=grad_req, aux_states=aux,
+                 compute_dtype=compute_dtype)
         ex.grad_arrays = [
             nd_zeros(s, dtype=type_dict.get(n, "float32"))
             if ex.grad_req[n] != "null" else None
@@ -230,6 +285,9 @@ class Executor:
         out_avals = self._out_aval_list(is_train)
         out_arrays = [NDArray.__new__(NDArray) for _ in out_avals]
         self._out_arrays = out_arrays
+        self._issued_outs = [a for a in self._issued_outs
+                             if a._thunk is not None]
+        self._issued_outs.extend(out_arrays)
 
         def thunk():
             self._materialize(snapshot, out_arrays)
@@ -419,7 +477,8 @@ class Executor:
         shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
         shapes.update({k: tuple(v) for k, v in kwargs.items()})
         new = Executor.simple_bind(self._symbol, self._ctx,
-                                   grad_req=self.grad_req, shapes=shapes)
+                                   grad_req=self.grad_req, shapes=shapes,
+                                   compute_dtype=self._compute_dtype)
         for n, a in self.arg_dict.items():
             if n not in kwargs and n in new.arg_dict:
                 if new.arg_dict[n].shape == a.shape:

@@ -37,7 +37,8 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=('data',),
                  label_names=('softmax_label',), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None, mesh=None, sharding_rules=None):
+                 state_names=None, mesh=None, sharding_rules=None,
+                 compute_dtype=None):
         super().__init__(logger=logger)
         if context is None:
             context = [current_context()]
@@ -60,6 +61,11 @@ class Module(BaseModule):
                 devices=[c.jax_device() for c in context])
         self._mesh = mesh
         self._sharding_rules = sharding_rules
+        # Mixed precision: master weights stay fp32; the executor casts
+        # per-op inputs to this dtype (see executor.AMP_FP32_OPS).  The
+        # TPU-native analog of the reference's --dtype float16 training
+        # recipe (example/image-classification/common/fit.py).
+        self._compute_dtype = compute_dtype
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -232,7 +238,8 @@ class Module(BaseModule):
 
         self._exec = Executor.simple_bind(
             self._symbol, self._context[0], grad_req=req,
-            type_dict=type_dict, shapes=shapes)
+            type_dict=type_dict, shapes=shapes,
+            compute_dtype=self._compute_dtype)
         self._apply_shardings()
         self._fused_step = None
         if self.params_initialized:
@@ -326,9 +333,12 @@ class Module(BaseModule):
         else:
             self._updater = opt_mod.get_updater(optimizer)
 
-        # per-param optimizer state for the fused step
+        # per-param optimizer state for the fused step (multi-precision
+        # prepends an fp32 master copy for fp16/bf16 weights — reference:
+        # optimizer.py Updater master-weight cast)
         self._opt_states = {
-            n: optimizer.create_state(n, self._exec.arg_dict[n])
+            n: optimizer.create_state_multi_precision(
+                n, self._exec.arg_dict[n])
             for n in self._update_names()}
 
         self.optimizer_initialized = True
@@ -413,16 +423,29 @@ class Module(BaseModule):
         t = opt._index_update_count[names[0]] if names else 1
         lrs = tuple(np.float32(opt._get_lr(n)) for n in names)
         wds = tuple(np.float32(opt._get_wd(n)) for n in names)
+        # cache lr/wd device buffers while unchanged: per-step host→device
+        # scalar transfers (2 per param) would dominate step latency on a
+        # remote-attached chip
+        cache = getattr(self, "_lrwd_cache", None)
+        if cache is not None and cache[0] == (lrs, wds):
+            lrs, wds = cache[1]
+        else:
+            key_ = (lrs, wds)
+            lrs = tuple(jnp.asarray(v) for v in lrs)
+            wds = tuple(jnp.asarray(v) for v in wds)
+            self._lrwd_cache = (key_, (lrs, wds))
         snapshot = self._exec._snapshot
         if snapshot is None:
             raise MXNetError("update() called before forward()")
         arg_vals, aux_vals, key, _ = snapshot
+        pvals = tuple(arg_vals[i] for i in self._fused_upd_idx)
+        io_vals = tuple(arg_vals[i] for i in self._fused_io_idx)
         states = tuple(tuple(s._data for s in self._opt_states[n])
                        for n in names)
         from .. import profiler as _prof
         with _prof.scope("fused_train_step", "symbolic"):
             outs, new_aux, new_params, new_states = self._fused_step(
-                arg_vals, aux_vals, key, states, lrs, wds,
+                pvals, io_vals, aux_vals, key, states, lrs, wds,
                 jnp.asarray(t, jnp.int32))
         exec_ = self._exec
         if exec_._out_arrays is not None:
@@ -435,6 +458,20 @@ class Module(BaseModule):
         for n, st in zip(names, new_states):
             for s, v in zip(self._opt_states[n], st):
                 s._set_data(v)
+        if self._fused_donate:
+            # The step consumed (donated) the old param/aux/state buffers;
+            # the pre-step snapshots and any lazy thunks referencing them
+            # (gradients, outputs from earlier forwards) are no longer
+            # executable — poison them with a clear error.
+            from ..executor import poison_stale
+            exec_._snapshot = None
+            for name, garr in exec_.grad_dict.items():
+                if garr is not None and garr._thunk is not None:
+                    poison_stale(garr, "gradient")
+            for oarr in exec_._issued_outs:
+                if oarr._thunk is not None:
+                    poison_stale(oarr, "output")
+            exec_._issued_outs = []
         self._pending_backward = False
 
     def _build_fused_step(self, names):
@@ -442,37 +479,81 @@ class Module(BaseModule):
         run = exec_._run
         arg_names = exec_._arg_names
         upd_idx = [arg_names.index(n) for n in names]
+        upd_set = set(upd_idx)
+        io_idx = [i for i in range(len(arg_names)) if i not in upd_set]
+        self._fused_upd_idx = upd_idx
+        self._fused_io_idx = io_idx
         opt = self._optimizer
         needs_t = getattr(opt, "needs_t", False)
+        # static per-param decision: multi-precision iff a master fp32 copy
+        # was prepended by create_state_multi_precision
+        use_mp = [opt.mp_states_active(exec_.arg_dict[n],
+                                       self._opt_states[n])
+                  for n in names]
 
-        def step(arg_vals, aux_vals, key, states, lrs, wds, t):
-            def f(pvals):
-                av = list(arg_vals)
-                for i, v in zip(upd_idx, pvals):
+        def step(pvals, io_vals, aux_vals, key, states, lrs, wds, t):
+            def f(pv):
+                av = [None] * len(arg_names)
+                for i, v in zip(upd_idx, pv):
+                    av[i] = v
+                for i, v in zip(io_idx, io_vals):
                     av[i] = v
                 outs, new_aux = run(tuple(av), aux_vals, key, True)
                 diff = tuple(o for o in outs
                              if jnp.issubdtype(o.dtype, jnp.inexact))
                 return diff, (outs, new_aux)
 
-            pvals = tuple(arg_vals[i] for i in upd_idx)
             diff, vjp_fn, (outs, new_aux) = jax.vjp(f, pvals, has_aux=True)
             cts = tuple(jnp.ones(o.shape, o.dtype) for o in diff)
             grads = vjp_fn(cts)[0]
             new_params = []
             new_states = []
-            for i, (pi, g, st, lr, wd) in enumerate(
-                    zip(upd_idx, grads, states, lrs, wds)):
-                w = arg_vals[pi]
-                if needs_t:
-                    nw, ns = opt._update_impl(w, g, st, lr, wd, t=t)
+            kw = {"t": t} if needs_t else {}
+            for k, (w, g, st, lr, wd) in enumerate(
+                    zip(pvals, grads, states, lrs, wds)):
+                if use_mp[k]:
+                    nw32, ns = opt._update_impl(
+                        st[0], g.astype(jnp.float32), st[1:], lr, wd, **kw)
+                    new_params.append(nw32.astype(w.dtype))
+                    new_states.append((nw32,) + tuple(ns))
                 else:
-                    nw, ns = opt._update_impl(w, g, st, lr, wd)
-                new_params.append(nw)
-                new_states.append(tuple(ns))
+                    nw, ns = opt._update_impl(w, g, st, lr, wd, **kw)
+                    new_params.append(nw)
+                    new_states.append(tuple(ns))
             return outs, new_aux, tuple(new_params), tuple(new_states)
 
-        return jax.jit(step)
+        # Donate the buffers the step replaces — params, aux (BN stats),
+        # optimizer state — so XLA updates them in place in HBM (the analog
+        # of the reference's in-place engine writes; halves peak param
+        # memory and removes copy traffic).
+        self._fused_donate = bool(env("MXNET_FUSED_DONATE", True))
+        donate = (0, 2, 4) if self._fused_donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def fused_step_flops(self):
+        """XLA cost-analysis FLOPs of one fused training step (for MFU
+        reporting).  Requires a bound, optimizer-initialized module with a
+        fresh forward() snapshot (i.e. call right after forward())."""
+        names = self._update_names()
+        if self._fused_step is None:
+            self._fused_step = self._build_fused_step(names)
+        snapshot = self._exec._snapshot
+        if snapshot is None:
+            raise MXNetError("fused_step_flops: call forward() first")
+        arg_vals, aux_vals, key, _ = snapshot
+        pvals = tuple(arg_vals[i] for i in self._fused_upd_idx)
+        io_vals = tuple(arg_vals[i] for i in self._fused_io_idx)
+        states = tuple(tuple(s._data for s in self._opt_states[n])
+                       for n in names)
+        lrs = tuple(np.float32(1e-3) for _ in names)
+        wds = tuple(np.float32(0.0) for _ in names)
+        lowered = self._fused_step.lower(
+            pvals, io_vals, aux_vals, key, states, lrs, wds,
+            jnp.asarray(1, jnp.int32))
+        ca = lowered.cost_analysis()
+        if not ca:
+            return None
+        return float(ca.get("flops", 0.0)) or None
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -110,8 +110,8 @@ def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
     """reference: ordering_op.cc TopK.  Static k keeps shapes XLA-friendly."""
     ax = axis % data.ndim
     moved = jnp.moveaxis(data, ax, -1)
-    sel = -moved if not is_ascend else moved
-    vals, idxs = lax.top_k(-sel, k) if is_ascend else lax.top_k(sel, k)
+    sel = -moved if is_ascend else moved
+    vals, idxs = lax.top_k(sel, k)
     if is_ascend:
         vals = -vals
     vals = jnp.moveaxis(vals, -1, ax)

@@ -75,7 +75,7 @@ def _squeeze(data, axis=None, **kw):
         (axis,) if isinstance(axis, int) else axis))
 
 
-@register("slice", arg_names=["data"],
+@register("slice", arg_names=["data"], aliases=("crop",),
           attr_defaults={"begin": (), "end": (), "step": ()})
 def _slice(data, begin=(), end=(), step=(), **kw):
     idx = []
@@ -188,7 +188,7 @@ def _pad(data, mode="constant", pad_width=(), constant_value=0, **kw):
     return jnp.pad(data, pairs, mode=jmode)
 
 
-@register("Crop", variadic=True, aliases=("crop",),
+@register("Crop", variadic=True,
           attr_defaults={"num_args": 1, "offset": (0, 0), "h_w": (0, 0),
                          "center_crop": False})
 def _crop(*args, num_args=1, offset=(0, 0), h_w=(0, 0), center_crop=False, **kw):

@@ -72,10 +72,7 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         padding=tuple((p, p) for p in pad),
         rhs_dilation=dilate,
         dimension_numbers=_CONV_DN[rank],
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        feature_group_count=num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * rank)
     return out
@@ -102,15 +99,20 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     for k, p, d, a in zip(kernel, pad, dilate, adj):
         ke = d * (k - 1) + 1
         pads.append((ke - 1 - p, ke - 1 - p + a))
+    # weight layout for deconv in MXNet: (in_ch, out_ch/group, *k);
+    # transposed conv = input-dilated conv with the spatially-flipped,
+    # in/out-swapped kernel
+    w = jnp.swapaxes(weight, 0, 1) if num_group == 1 \
+        else _group_swap(weight, num_group)
+    w = _deconv_flip(w)
     out = lax.conv_general_dilated(
-        data, jnp.swapaxes(weight, 0, 1) if num_group == 1 else _group_swap(weight, num_group),
+        data, w,
         window_strides=(1,) * rank,
         padding=tuple(pads),
         lhs_dilation=stride,
         rhs_dilation=dilate,
         dimension_numbers=_CONV_DN[rank],
         feature_group_count=num_group)
-    # weight layout for deconv in MXNet: (in_ch, out_ch/group, *k); flip spatial
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * rank)
     return out

@@ -80,6 +80,16 @@ class Optimizer:
             return (w32,) + self.create_state(index, w32)
         return self.create_state(index, weight)
 
+    def mp_states_active(self, weight, states):
+        """True when ``states`` carry an fp32 master copy for a
+        low-precision ``weight`` (i.e. create_state_multi_precision
+        prepended one).  Single source of truth for both the imperative
+        update path and the fused-step builder."""
+        return (self.multi_precision
+                and weight.dtype in (np.float16, jnp.bfloat16)
+                and bool(states) and states[0] is not None
+                and tuple(states[0].shape) == tuple(weight.shape))
+
     # -- the pure update ------------------------------------------------------
     def _update_impl(self, weight, grad, states, lr, wd):
         raise NotImplementedError
@@ -90,10 +100,7 @@ class Optimizer:
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         states = self._state_tuple(state)
-        use_mp = (self.multi_precision
-                  and weight.dtype in (np.float16, jnp.bfloat16)
-                  and states and states[0] is not None
-                  and states[0].shape == weight.shape)
+        use_mp = self.mp_states_active(weight, states)
         if use_mp:
             w32 = states[0]._data
             new_w32, new_sub = self._update_impl(

@@ -223,6 +223,13 @@ def _skip_args(op: str, attrs: dict) -> set:
         skip.add("gamma")
     if op == "RNN" and attrs.get("mode", "lstm") != "lstm":
         skip.add("state_cell")
+    if op == "CTCLoss":
+        if attrs.get("use_data_lengths", False) not in (True, "True",
+                                                        "true", 1):
+            skip.add("data_lengths")
+        if attrs.get("use_label_lengths", False) not in (True, "True",
+                                                         "true", 1):
+            skip.add("label_lengths")
     return skip
 
 

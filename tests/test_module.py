@@ -145,3 +145,109 @@ def test_lr_scheduler_in_fit():
     mod.fit(train, num_epoch=2, optimizer='sgd',
             optimizer_params={'learning_rate': 0.4, 'lr_scheduler': sched})
     assert mod._optimizer._get_lr('fc1_weight') < 0.4
+
+
+def test_amp_bf16_training():
+    """Mixed precision: compute_dtype=bfloat16 trains XOR; master params
+    stay fp32; BN statistics stay fp32 (executor.AMP_FP32_OPS)."""
+    import jax.numpy as jnp
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=16, name='fc1')
+    net = sym.BatchNorm(net, name='bn1')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=2, name='fc2')
+    net = sym.SoftmaxOutput(net, name='softmax')
+    X, Y = _xor_data(200)
+    train = mx.io.NDArrayIter(X, Y, batch_size=50, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype=jnp.bfloat16)
+    mod.fit(train, num_epoch=10,
+            optimizer_params={'learning_rate': 0.5},
+            initializer=mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    assert arg['fc1_weight'].asnumpy().dtype == np.float32
+    assert aux['bn1_moving_mean'].asnumpy().dtype == np.float32
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=50), 'acc')
+    assert score[0][1] > 0.8, score
+
+
+def test_fused_step_donation_semantics():
+    """Donated fused step: params keep updating correctly across steps,
+    and reading gradients after update() raises a clear error."""
+    X, Y = _xor_data(80)
+    train = mx.io.NDArrayIter(X, Y, batch_size=40)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9})
+    batch = next(iter(train))
+    w_prev = mod.get_params()[0]['fc1_weight'].asnumpy().copy()
+    for _ in range(3):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        w = mod.get_params()[0]['fc1_weight'].asnumpy()
+        assert not np.array_equal(w, w_prev)
+        w_prev = w.copy()
+    if mod._fused_donate:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        with pytest.raises(mx.MXNetError):
+            mod._exec.grad_dict['fc1_weight'].asnumpy()
+
+
+def test_fused_vs_unfused_same_trajectory():
+    """MXNET_EXEC_BULK_EXEC_TRAIN=0 (unfused, kvstore path) must produce
+    the same parameter trajectory as the fused donated step."""
+    X, Y = _xor_data(80)
+
+    def run_steps(fused):
+        os.environ['MXNET_EXEC_BULK_EXEC_TRAIN'] = '1' if fused else '0'
+        try:
+            mx.random.seed(7)
+            train = mx.io.NDArrayIter(X, Y, batch_size=40)
+            mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+            mod.bind(data_shapes=train.provide_data,
+                     label_shapes=train.provide_label)
+            mod.init_params(initializer=mx.initializer.Xavier())
+            mod.init_optimizer(optimizer='sgd',
+                               optimizer_params={'learning_rate': 0.1,
+                                                 'momentum': 0.9})
+            batch = next(iter(train))
+            for _ in range(3):
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+            return mod.get_params()[0]['fc1_weight'].asnumpy()
+        finally:
+            os.environ.pop('MXNET_EXEC_BULK_EXEC_TRAIN', None)
+
+    np.testing.assert_allclose(run_steps(True), run_steps(False),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_multi_precision_optimizer_update():
+    """bf16 weight + multi_precision SGD keeps an fp32 master copy
+    (reference: optimizer.py fp16 master-weight Updater)."""
+    import jax.numpy as jnp
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True, rescale_grad=1.0)
+    w = mx.nd.array(np.linspace(-1, 1, 64).astype(np.float32)).astype(
+        jnp.bfloat16)
+    state = opt.create_state_multi_precision(0, w)
+    assert state[0].asnumpy().dtype == np.float32
+    g = mx.nd.array(np.ones(64, dtype=np.float32)).astype(jnp.bfloat16)
+    w32_ref = np.asarray(state[0].asnumpy(), dtype=np.float64)
+    mom = np.zeros(64)
+    for _ in range(5):
+        opt.update(0, w, g, list(state))
+        mom = 0.9 * mom - 0.1 * 1.0
+        w32_ref = w32_ref + mom
+    np.testing.assert_allclose(state[0].asnumpy(), w32_ref, rtol=1e-5)
+    # low-precision view tracks the master copy
+    np.testing.assert_allclose(
+        np.asarray(w.asnumpy(), dtype=np.float32),
+        np.asarray(state[0].asnumpy(), dtype=np.float32), rtol=1e-2)
