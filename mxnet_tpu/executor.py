@@ -169,8 +169,9 @@ class Executor:
         self._last_key = None
         # output handles issued by forward() whose thunks still reference a
         # live snapshot — must be poisoned if a donated step consumes the
-        # snapshot's buffers
-        self._issued_outs: List[NDArray] = []
+        # snapshot's buffers.  Weak refs: the executor must not keep
+        # dropped outputs (and their snapshots) alive.
+        self._issued_outs: List = []
 
         self._jit_fwd = jax.jit(
             lambda a, x, k, t: run(a, x, k, t), static_argnums=(3,))
@@ -285,9 +286,10 @@ class Executor:
         out_avals = self._out_aval_list(is_train)
         out_arrays = [NDArray.__new__(NDArray) for _ in out_avals]
         self._out_arrays = out_arrays
-        self._issued_outs = [a for a in self._issued_outs
-                             if a._thunk is not None]
-        self._issued_outs.extend(out_arrays)
+        import weakref
+        self._issued_outs = [r for r in self._issued_outs
+                             if r() is not None and r()._thunk is not None]
+        self._issued_outs.extend(weakref.ref(a) for a in out_arrays)
 
         def thunk():
             self._materialize(snapshot, out_arrays)

@@ -442,11 +442,19 @@ class Module(BaseModule):
         io_vals = tuple(arg_vals[i] for i in self._fused_io_idx)
         states = tuple(tuple(s._data for s in self._opt_states[n])
                        for n in names)
+        # t is only read by needs_t optimizers (Adam bias correction);
+        # otherwise reuse one cached device scalar instead of a per-step
+        # host→device transfer
+        if getattr(opt, "needs_t", False):
+            t_dev = jnp.asarray(t, jnp.int32)
+        else:
+            t_dev = getattr(self, "_t_const", None)
+            if t_dev is None:
+                t_dev = self._t_const = jnp.asarray(0, jnp.int32)
         from .. import profiler as _prof
         with _prof.scope("fused_train_step", "symbolic"):
             outs, new_aux, new_params, new_states = self._fused_step(
-                pvals, io_vals, aux_vals, key, states, lrs, wds,
-                jnp.asarray(t, jnp.int32))
+                pvals, io_vals, aux_vals, key, states, lrs, wds, t_dev)
         exec_ = self._exec
         if exec_._out_arrays is not None:
             for oa, v in zip(exec_._out_arrays, outs):
@@ -468,8 +476,9 @@ class Module(BaseModule):
             for name, garr in exec_.grad_dict.items():
                 if garr is not None and garr._thunk is not None:
                     poison_stale(garr, "gradient")
-            for oarr in exec_._issued_outs:
-                if oarr._thunk is not None:
+            for ref in exec_._issued_outs:
+                oarr = ref()
+                if oarr is not None and oarr._thunk is not None:
                     poison_stale(oarr, "output")
             exec_._issued_outs = []
         self._pending_backward = False
@@ -534,6 +543,8 @@ class Module(BaseModule):
         """XLA cost-analysis FLOPs of one fused training step (for MFU
         reporting).  Requires a bound, optimizer-initialized module with a
         fresh forward() snapshot (i.e. call right after forward())."""
+        if not self.optimizer_initialized:
+            raise MXNetError("fused_step_flops: call init_optimizer() first")
         names = self._update_names()
         if self._fused_step is None:
             self._fused_step = self._build_fused_step(names)

@@ -527,6 +527,10 @@ def _invoke(op_name: str, inputs, attrs, out=None):
 # creation / free functions (reference: python/mxnet/ndarray/ndarray.py tail)
 # ===========================================================================
 def array(source_array, ctx=None, dtype=None) -> NDArray:
+    if dtype is None and not hasattr(source_array, "dtype"):
+        # reference semantics (ndarray.py array): python lists/scalars
+        # default to float32; arrays keep their dtype
+        dtype = np.float32
     return NDArray(source_array, ctx=ctx or current_context(), dtype=dtype)
 
 

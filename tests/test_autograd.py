@@ -1,0 +1,192 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+RNG = np.random.RandomState(7)
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_chain_rule():
+    x = nd.array(RNG.uniform(0.5, 2, (3, 4)).astype('f'))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.log(x) * 2.0)  # = x^2
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_out_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3.0
+    y.backward(out_grad=nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0], rtol=1e-6)
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req='add')
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2.0).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0], rtol=1e-6)
+
+
+def test_recording_scopes():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            assert not autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert autograd.is_recording()
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_pause_stops_taping():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 5  # not recorded
+        w = y + 1
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # d/dx [const(4) * x] = 4
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 5.0).sum()
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [5.0, 5.0])
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    grads = autograd.grad(y, [x])
+    np.testing.assert_allclose(grads[0].asnumpy(), [27.0], rtol=1e-5)
+
+
+def test_grad_create_graph_second_order():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (gx,) = autograd.grad(y, [x], create_graph=True)
+        z = gx * x  # 3x^3
+    z.backward()
+    # d/dx 3x^3 = 9x^2 = 36
+    np.testing.assert_allclose(x.grad.asnumpy(), [36.0], rtol=1e-5)
+
+
+def test_training_flag_changes_dropout():
+    x = nd.ones((200, 200))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    np.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).mean() > 0.3
+
+
+def test_backward_through_module_ops():
+    x = nd.array(RNG.uniform(-1, 1, (4, 5)).astype('f'))
+    w = nd.array(RNG.uniform(-1, 1, (3, 5)).astype('f'))
+    b = nd.zeros((3,))
+    for arr in (x, w, b):
+        arr.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, b, num_hidden=3)
+        loss = (y * y).sum()
+    loss.backward()
+    yn = x.asnumpy() @ w.asnumpy().T
+    np.testing.assert_allclose(w.grad.asnumpy(), 2 * yn.T @ x.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * yn @ w.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + np.exp(-x.asnumpy()))
+            y = nd.array(y)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(RNG.uniform(-2, 2, (5,)).astype('f'))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+        z = y.sum()
+    z.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    np.testing.assert_allclose(g1, [4.0])
+
+
+def test_inplace_mutation_versioning():
+    """In-place update swaps the version handle; grads flow to the value
+    read at record time (the SURVEY 'core impedance mismatch' case)."""
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    x += 1.0  # mutate AFTER recording
+    y.backward()
+    # gradient must be w.r.t. the recorded value [1, 2], not [2, 3]
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0])

@@ -1,0 +1,207 @@
+"""NDArray API tests (reference: tests/python/unittest/test_ndarray.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RNG = np.random.RandomState(0)
+
+
+def test_creation_and_properties():
+    a = nd.array([[1, 2, 3], [4, 5, 6]])
+    assert a.shape == (2, 3)
+    assert a.size == 6
+    assert a.ndim == 2
+    assert a.dtype == np.float32
+    assert a.context.device_type in ('cpu', 'tpu')
+    b = nd.array(np.arange(4, dtype=np.int64))
+    assert b.dtype == np.int64 or b.dtype == np.int32
+    c = nd.array(a)  # from NDArray
+    np.testing.assert_array_equal(c.asnumpy(), a.asnumpy())
+
+
+def test_zeros_ones_full_like():
+    z = nd.zeros((2, 3))
+    o = nd.ones((2, 3), dtype='float64')
+    np.testing.assert_array_equal(z.asnumpy(), np.zeros((2, 3)))
+    assert o.asnumpy().dtype == np.float64
+    zl = nd.zeros_like(o)
+    assert zl.shape == (2, 3)
+
+
+def test_asscalar_float_int_len():
+    a = nd.array([3.5])
+    assert a.asscalar() == 3.5
+    assert float(a) == 3.5
+    assert int(nd.array([7])) == 7
+    assert len(nd.zeros((4, 2))) == 4
+
+
+def test_arithmetic_operators():
+    a = nd.array(RNG.uniform(1, 2, (3, 4)).astype('f'))
+    b = nd.array(RNG.uniform(1, 2, (3, 4)).astype('f'))
+    an, bn = a.asnumpy(), b.asnumpy()
+    np.testing.assert_allclose((a + b).asnumpy(), an + bn, rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), an - bn, rtol=1e-6)
+    np.testing.assert_allclose((a * b).asnumpy(), an * bn, rtol=1e-6)
+    np.testing.assert_allclose((a / b).asnumpy(), an / bn, rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).asnumpy(), an ** 2, rtol=1e-6)
+    np.testing.assert_allclose((2 + a).asnumpy(), 2 + an, rtol=1e-6)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - an, rtol=1e-6)
+    np.testing.assert_allclose((2 / a).asnumpy(), 2 / an, rtol=1e-6)
+    np.testing.assert_allclose((-a).asnumpy(), -an, rtol=1e-6)
+    np.testing.assert_allclose(abs(-a).asnumpy(), np.abs(an), rtol=1e-6)
+    np.testing.assert_allclose((a @ b.T).asnumpy(), an @ bn.T, rtol=1e-5)
+
+
+def test_inplace_operators():
+    a = nd.array(np.ones((2, 2), 'f'))
+    a += 1
+    np.testing.assert_array_equal(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_array_equal(a.asnumpy(), 6 * np.ones((2, 2)))
+    a -= 2
+    a /= 4
+    np.testing.assert_array_equal(a.asnumpy(), np.ones((2, 2)))
+
+
+def test_comparison_operators():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal((a >= b).asnumpy(), [0, 1, 1])
+    np.testing.assert_array_equal((a < b).asnumpy(), [1, 0, 0])
+    np.testing.assert_array_equal((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal((a != b).asnumpy(), [1, 0, 1])
+
+
+def test_indexing_read():
+    x = RNG.uniform(-1, 1, (4, 5)).astype('f')
+    a = nd.array(x)
+    np.testing.assert_array_equal(a[1].asnumpy(), x[1])
+    np.testing.assert_array_equal(a[1:3].asnumpy(), x[1:3])
+    np.testing.assert_array_equal(a[:, 2].asnumpy(), x[:, 2])
+    np.testing.assert_array_equal(a[1, 2].asnumpy(), x[1, 2])
+    np.testing.assert_array_equal(a[::2, 1:4].asnumpy(), x[::2, 1:4])
+
+
+def test_indexing_write():
+    x = np.zeros((3, 4), np.float32)
+    a = nd.array(x)
+    a[1] = 5.0
+    x[1] = 5.0
+    np.testing.assert_array_equal(a.asnumpy(), x)
+    a[0, 2] = -1.0
+    x[0, 2] = -1.0
+    np.testing.assert_array_equal(a.asnumpy(), x)
+    a[2, 1:3] = nd.array([7.0, 8.0])
+    x[2, 1:3] = [7.0, 8.0]
+    np.testing.assert_array_equal(a.asnumpy(), x)
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype('int32')
+    assert b.asnumpy().dtype == np.int32
+    c = a.copy()
+    c += 1
+    assert a.asnumpy()[0] == 1.5  # copy is deep
+
+
+def test_copyto():
+    a = nd.array([1.0, 2.0])
+    b = nd.zeros((2,))
+    a.copyto(b)
+    np.testing.assert_array_equal(b.asnumpy(), [1, 2])
+    ctx_copy = a.copyto(mx.cpu())
+    np.testing.assert_array_equal(ctx_copy.asnumpy(), [1, 2])
+
+
+def test_reshape_transpose_methods():
+    x = RNG.uniform(-1, 1, (2, 3, 4)).astype('f')
+    a = nd.array(x)
+    np.testing.assert_array_equal(a.reshape(6, 4).asnumpy(), x.reshape(6, 4))
+    np.testing.assert_array_equal(a.reshape((4, 6)).asnumpy(),
+                                  x.reshape(4, 6))
+    np.testing.assert_array_equal(a.reshape(-1).asnumpy(), x.reshape(-1))
+    np.testing.assert_array_equal(a.T.asnumpy(), x.T)
+    np.testing.assert_array_equal(a.transpose(0, 2, 1).asnumpy(),
+                                  x.transpose(0, 2, 1))
+    np.testing.assert_array_equal(a.flatten().asnumpy(), x.reshape(2, 12))
+    np.testing.assert_array_equal(a.expand_dims(0).asnumpy(), x[None])
+    np.testing.assert_array_equal(a.slice_axis(1, 0, 2).asnumpy(), x[:, :2])
+
+
+def test_broadcast_and_iter():
+    a = nd.array([[1.0], [2.0]])
+    b = a.broadcast_to((2, 3))
+    np.testing.assert_array_equal(b.asnumpy(),
+                                  np.broadcast_to(a.asnumpy(), (2, 3)))
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 2
+
+
+def test_wait_and_bool():
+    a = nd.array([1.0])
+    a.wait_to_read()
+    assert bool(a)
+    with pytest.raises(Exception):
+        bool(nd.zeros((2, 2)))  # ambiguous
+
+
+def test_save_load_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        f = os.path.join(d, 'arrs')
+        arrs = [nd.array(RNG.uniform(-1, 1, (3, 2)).astype('f'))
+                for _ in range(3)]
+        nd.save(f, arrs)
+        loaded = nd.load(f)
+        for a, b in zip(arrs, loaded):
+            np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+        named = {'w': arrs[0], 'b': arrs[1]}
+        nd.save(f, named)
+        loaded = nd.load(f)
+        assert set(loaded) == {'w', 'b'}
+        np.testing.assert_array_equal(loaded['w'].asnumpy(),
+                                      arrs[0].asnumpy())
+
+
+def test_dtype_zoo():
+    import jax.numpy as jnp
+    for dt in ('float16', 'float32', 'float64', 'int32', 'int64', 'uint8'):
+        a = nd.zeros((2, 2), dtype=dt)
+        assert str(a.asnumpy().dtype) == dt
+    b = nd.zeros((2, 2), dtype=jnp.bfloat16)
+    assert b.dtype == jnp.bfloat16
+
+
+def test_concat_stack_module_level():
+    a = nd.array([[1.0, 2.0]])
+    b = nd.array([[3.0, 4.0]])
+    np.testing.assert_array_equal(nd.concat(a, b, dim=0).asnumpy(),
+                                  [[1, 2], [3, 4]])
+    np.testing.assert_array_equal(nd.stack(a, b).asnumpy(),
+                                  [[[1, 2]], [[3, 4]]])
+
+
+def test_take_method():
+    x = RNG.uniform(-1, 1, (5, 3)).astype('f')
+    a = nd.array(x)
+    idx = nd.array([0.0, 3.0])
+    np.testing.assert_array_equal(a.take(idx).asnumpy(), x[[0, 3]])
+
+
+def test_asnumpy_is_sync_point():
+    # a chain of lazy ops resolves on asnumpy (engine WaitToRead analog)
+    a = nd.ones((8, 8))
+    for _ in range(5):
+        a = a * 1.5 + 0.1
+    out = a.asnumpy()
+    ref = np.ones((8, 8))
+    for _ in range(5):
+        ref = ref * 1.5 + 0.1
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
