@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# CI entry point (reference: Jenkinsfile:52-99 build+test matrix).
+# Runs the full suite on the virtual 8-device CPU mesh, the multichip
+# dryrun, a CPU bench smoke, and the multi-process dist tests.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== unit + integration suite (8-device CPU mesh via tests/conftest.py)"
+python -m pytest tests/ -q --durations=10
+
+echo "== multichip dryrun (8 virtual devices)"
+JAX_PLATFORMS=cpu python - <<'PY'
+import jax
+from jax._src import xla_bridge as xb
+xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import __graft_entry__ as ge
+ge.dryrun_multichip(8)
+print("dryrun_multichip(8) OK")
+PY
+
+echo "== bench smoke (CPU, tiny config; real numbers come from TPU runs)"
+BENCH_BATCH=8 BENCH_ITERS=2 BENCH_WARMUP=1 python - <<'PY'
+import jax
+from jax._src import xla_bridge as xb
+xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+import bench, sys
+sys.exit(bench.main())
+PY
+
+echo "== CI green"

@@ -41,14 +41,22 @@ class Context:
         return self.devtype2id[self.device_type]
 
     def jax_device(self):
-        """Resolve to the PJRT device backing this context."""
+        """Resolve to the PJRT device backing this context.
+
+        Process-LOCAL devices only: under jax.distributed the global device
+        list includes other hosts' devices, which this process cannot
+        address (multi-host placement is expressed with meshes/shardings,
+        never by binding a Context to a remote device)."""
         import jax
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                devs = jax.local_devices()
             return devs[self.device_id % len(devs)]
         # tpu / gpu-alias: prefer a real accelerator, else fall back to the
         # default backend (virtual CPU devices in tests).
-        devs = jax.devices()
+        devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     def __hash__(self):

@@ -1,0 +1,136 @@
+"""Multi-host bootstrap: the TPU-native replacement for the reference's
+parameter-server bring-up.
+
+The reference boots a cluster with dmlc-tracker: ``tools/launch.py`` spawns
+scheduler + server + worker processes and wires them with ``DMLC_*``
+environment variables (reference: tools/launch.py:64-80,
+python/mxnet/kvstore_server.py:28-75, src/kvstore/kvstore_dist.h:51-61).
+On TPU there are no servers and no scheduler — every process is a worker
+running the same SPMD program; bootstrap is ``jax.distributed.initialize``
+(coordination service + PJRT), and gradient aggregation is an allreduce
+over the global mesh (ICI intra-slice, DCN across slices).
+
+``initialize()`` reads the same env-var shapes the reference's tracker
+sets, so ``tools/launch.py`` here mirrors the reference CLI:
+
+* ``DMLC_PS_ROOT_URI`` / ``DMLC_PS_ROOT_PORT`` → coordinator address
+* ``DMLC_NUM_WORKER``                          → number of processes
+* ``DMLC_WORKER_ID``                           → this process's id
+
+(Native JAX deployments can instead rely on jax.distributed's own
+auto-detection — TPU pods populate these from the metadata server.)
+"""
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Optional
+
+from .base import MXNetError
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None) -> None:
+    """Bootstrap the multi-process runtime (idempotent).
+
+    Arguments default from the DMLC-shaped environment set by
+    ``tools/launch.py`` (or a TPU pod's native metadata — in that case call
+    with no arguments and jax.distributed auto-detects everything).
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    env = os.environ
+    if coordinator_address is None and "DMLC_PS_ROOT_URI" in env:
+        coordinator_address = "%s:%s" % (
+            env["DMLC_PS_ROOT_URI"], env.get("DMLC_PS_ROOT_PORT", "9091"))
+    if num_processes is None and "DMLC_NUM_WORKER" in env:
+        num_processes = int(env["DMLC_NUM_WORKER"])
+    if process_id is None and "DMLC_WORKER_ID" in env:
+        process_id = int(env["DMLC_WORKER_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+    atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def rank() -> int:
+    """This process's id (reference: KVStore::get_rank, kvstore_dist.h:98)."""
+    import jax
+    return jax.process_index()
+
+
+def size() -> int:
+    """Number of processes (reference: get_group_size, kvstore_dist.h:100)."""
+    import jax
+    return jax.process_count()
+
+
+def barrier(name: str = "mxnet_tpu_barrier") -> None:
+    """Block until every process arrives (reference: Postoffice::Barrier)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def allreduce_sum(value):
+    """Sum a per-process host value across all processes; every process
+    gets the total.  The kvstore 'dist_sync' aggregation primitive."""
+    import jax
+    import numpy as np
+    if jax.process_count() == 1:
+        return np.asarray(value)
+    from jax.experimental import multihost_utils
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray(value))).sum(axis=0)
+
+
+def broadcast_from_root(value):
+    """Every process gets rank 0's value (reference: dist kvstore init —
+    the first worker's init value is authoritative,
+    kvstore_dist_server.h DataHandleDefault init path)."""
+    import jax
+    import numpy as np
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+    # process_allgather lands on host in every process; rank 0's slice is
+    # the broadcast value (broadcast_one_to_all returns a global-mesh
+    # jax.Array that host code cannot read directly)
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray(value)))[0]
+
+
+def num_dead_nodes() -> int:
+    """Reference parity: KVStore::get_num_dead_node (kvstore.h:328).
+
+    SPMD has no partial-failure mode: the coordination-service heartbeat
+    turns any process death into a job-wide error, so a running job by
+    definition has zero dead nodes.  Recovery is restart-from-checkpoint
+    (docs/design/failure_recovery.md)."""
+    return 0
+
+
+def shutdown() -> None:
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — already torn down at interpreter exit
+        pass
+    _initialized = False

@@ -288,7 +288,8 @@ class Executor:
         self._out_arrays = out_arrays
         import weakref
         self._issued_outs = [r for r in self._issued_outs
-                             if r() is not None and r()._thunk is not None]
+                             if (a := r()) is not None
+                             and a._thunk is not None]
         self._issued_outs.extend(weakref.ref(a) for a in out_arrays)
 
         def thunk():

@@ -11,11 +11,16 @@ num_workers/barrier) is preserved; the transport is re-imagined:
   values live on different chips of a mesh).
 * ``tpu`` — values that are sharded jax.Arrays over a device mesh are
   reduced with a jitted psum-style sum so gradient aggregation fuses and
-  rides ICI collectives (SURVEY.md §5.8 north star).  ``dist_sync`` over
-  multi-host meshes reuses the same path: under ``jax.distributed`` a
-  global mesh makes the SAME code do cross-host allreduce over DCN — there
-  are no parameter-server processes to run (kvstore_dist_server.h is
-  intentionally not ported; see docs/design/kvstore.md).
+  rides ICI collectives (SURVEY.md §5.8 north star).
+* ``dist_sync`` — multi-process: the locally-reduced value is summed
+  across processes (``distributed.allreduce_sum``, a host-side gather —
+  gloo on CPU test clusters, DCN on pods) and every process applies the
+  identical update.  This is the *compatibility* path giving the
+  reference's exact worker-visible push/pull semantics; the *performance*
+  path for multi-host training is ``Module(..., mesh=...)`` where GSPMD
+  fuses the gradient psum into the jitted step (docs/design/kvstore.md).
+  There are no parameter-server processes (kvstore_dist_server.h is
+  intentionally not ported).
 * ``dist_async`` — unsupported on TPU (documented; raises).
 
 Update-on-kvstore (reference: server-side optimizer, kvstore_dist_server.h
@@ -69,15 +74,30 @@ class KVStore:
         for k, vs in zip(keys, values):
             if k in self._store:
                 raise MXNetError(f"duplicate init of key {k}")
-            self._store[k] = NDArray(vs[0]._data)
+            val = vs[0]._data
+            if self.type.startswith("dist") and self.num_workers > 1:
+                # rank 0's init value is authoritative (reference: first
+                # worker init wins at the server, kvstore_dist_server.h)
+                from . import distributed as _dist
+                val = jnp.asarray(_dist.broadcast_from_root(np.asarray(val)))
+            self._store[k] = NDArray(val)
 
     # -- push/pull ------------------------------------------------------------
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the store; runs updater if installed
-        (reference: KVStoreLocal::PushImpl, kvstore_local.h:149)."""
+        (reference: KVStoreLocal::PushImpl, kvstore_local.h:149).
+
+        dist types additionally sum the locally-reduced value across all
+        processes (the allreduce that replaces the reference's
+        server-side MergeBuf aggregation, kvstore_dist_server.h:175-198);
+        every process then applies the identical update, so the store
+        stays replicated-consistent with no server round trip."""
         keys, values = self._canon(key, value)
         for k, vs in zip(keys, values):
             agg = self._reduce(vs)
+            if self.type.startswith("dist") and self.num_workers > 1:
+                from . import distributed as _dist
+                agg = jnp.asarray(_dist.allreduce_sum(np.asarray(agg)))
             if k not in self._store:
                 raise MXNetError(f"push to uninitialized key {k}")
             if self._updater is not None:
@@ -132,11 +152,9 @@ class KVStore:
 
     # -- coordination ---------------------------------------------------------
     def barrier(self):
-        """Global barrier (reference: Postoffice::Barrier).  Multi-host: an
-        allreduce over a tiny array forces synchronization."""
-        if self.num_workers > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+        """Global barrier (reference: Postoffice::Barrier)."""
+        from . import distributed as _dist
+        _dist.barrier("mxnet_tpu_kvstore_barrier")
 
     def _send_command_to_servers(self, head, body):
         pass  # no server processes exist in the TPU design

@@ -1,0 +1,73 @@
+"""Multi-process data-parallel Module training (reference:
+tests/nightly/dist_lenet.py / dist_device_sync_kvstore semantics).
+
+Each of N processes trains the same MLP on its shard of a toy dataset with
+kvstore='dist_sync'; after each update all ranks must hold bit-identical
+parameters (sync data parallelism), and the model must fit the data.
+
+Run via:  python tools/launch.py -n 2 python tests/dist/dist_device_sync_module.py
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the fused single-process step bypasses the kvstore; dist training uses
+# the kvstore push/pull path like the reference does
+os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "0"
+
+import jax
+from jax._src import xla_bridge as xb
+
+xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import distributed as dist
+from mxnet_tpu import symbol as sym
+
+
+def main():
+    dist.initialize()
+    rank, nworker = dist.rank(), dist.size()
+
+    rng = np.random.RandomState(0)  # same data everywhere; shard below
+    X = rng.randn(400, 2).astype('f')
+    Y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype('f')
+    # each rank trains on its contiguous shard (reference: data iter
+    # part_index/num_parts sharding)
+    n = len(X) // nworker
+    Xs, Ys = X[rank * n:(rank + 1) * n], Y[rank * n:(rank + 1) * n]
+
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=16, name='fc1')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=2, name='fc2')
+    net = sym.SoftmaxOutput(net, name='softmax')
+
+    it = mx.io.NDArrayIter(Xs, Ys, batch_size=25, shuffle=False)
+    kv = mx.kv.create('dist_sync')
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mx.random.seed(7 + rank)
+    mod.fit(it, num_epoch=15, kvstore=kv,
+            optimizer_params={'learning_rate': 0.5},
+            initializer=mx.initializer.Xavier(rnd_type='gaussian',
+                                              magnitude=2.0))
+
+    # all ranks converged to identical parameters
+    w = mod.get_params()[0]['fc1_weight'].asnumpy()
+    mean_w = dist.allreduce_sum(w) / nworker
+    np.testing.assert_allclose(w, mean_w, rtol=1e-6, atol=1e-7)
+
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=50), 'acc')
+    assert score[0][1] > 0.9, "rank %d acc %s" % (rank, score)
+    kv.barrier()
+    print("dist_device_sync_module rank %d/%d OK acc=%.3f"
+          % (rank, nworker, score[0][1]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

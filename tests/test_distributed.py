@@ -1,0 +1,67 @@
+"""Multi-process distributed tests, run as real local process clusters via
+tools/launch.py (reference: tests/nightly/dist_sync_kvstore.py driven by
+``tools/launch.py -n 4``, tests/nightly/test_all.sh:55).
+"""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(n, script, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # each worker is a fresh process: keep it off the single-client TPU
+    # tunnel and give it one CPU device
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(n), sys.executable, os.path.join(ROOT, script)],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=ROOT)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    return out.stdout
+
+
+def test_dist_sync_kvstore_4_workers():
+    stdout = _launch(4, "tests/dist/dist_sync_kvstore.py")
+    for r in range(4):
+        assert "rank %d/4 OK" % r in stdout
+
+
+def test_dist_module_training_2_workers():
+    stdout = _launch(2, "tests/dist/dist_device_sync_module.py")
+    for r in range(2):
+        assert "rank %d/2 OK" % r in stdout
+
+
+def test_distributed_api_single_process():
+    """rank/size/allreduce degrade gracefully without initialize()."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import distributed as dist
+    assert dist.rank() == 0
+    assert dist.size() >= 1
+    assert dist.num_dead_nodes() == 0
+    np.testing.assert_array_equal(dist.allreduce_sum(np.ones(3)), np.ones(3))
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == 0
+
+
+def test_launcher_fail_fast():
+    """A worker dying pre-initialize must kill the whole job quickly, not
+    hang the others in jax.distributed.initialize."""
+    import time
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable, "-c",
+         "import os,sys,time\n"
+         "if os.environ['DMLC_WORKER_ID']=='1': sys.exit(3)\n"
+         "time.sleep(300)"],
+        env=env, capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert out.returncode == 3, (out.returncode, out.stderr[-500:])
+    assert time.time() - t0 < 30

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: tools/launch.py via dmlc-tracker).
+
+Reference semantics: ``launch.py -n W [-s S] cmd...`` starts a tracker
+that spawns scheduler + S servers + W workers with ``DMLC_*`` env vars
+(reference tools/launch.py:64-80).  The TPU-native design has no servers
+or scheduler — every process is an SPMD worker — so this launcher spawns
+W local worker processes wired to a jax.distributed coordination service
+through the same DMLC-shaped env vars (read by
+``mxnet_tpu.distributed.initialize``):
+
+    DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT   coordinator host:port
+    DMLC_NUM_WORKER                        process count
+    DMLC_WORKER_ID                         per-process id
+    DMLC_ROLE=worker                       every process (no 'server')
+
+``-s`` is accepted for CLI compatibility and ignored with a note: server
+processes do not exist in the allreduce design (docs/design/kvstore.md).
+
+Cluster launchers (ssh/mpi/sge/yarn in the reference) are out of scope for
+local mode; on real TPU pods the platform's own process manager starts one
+process per host and `initialize()` auto-detects — see
+docs/design/kvstore.md.
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Launch a local multi-process mxnet_tpu job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI compatibility; "
+                         "ignored (no PS servers in the allreduce design)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local"],
+                    help="only 'local' is supported (reference ssh/mpi/"
+                         "sge/yarn launchers do not apply to TPU pods)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE env for every worker")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run on every worker")
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    if args.num_servers:
+        print("launch.py: note: -s/--num-servers ignored — the TPU design "
+              "replaces parameter servers with allreduce "
+              "(docs/design/kvstore.md)", file=sys.stderr)
+
+    port = _free_port()
+    procs = []
+    for wid in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(e.split("=", 1) for e in args.env)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(wid),
+        })
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    def _kill_all(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _kill_all)
+    signal.signal(signal.SIGTERM, _kill_all)
+
+    # poll ALL workers: the first nonzero exit kills the job immediately
+    # (SPMD semantics — a worker that dies before joining the coordination
+    # service would otherwise leave the rest blocked in initialize())
+    import time
+    rc = 0
+    live = list(procs)
+    while live:
+        for p in list(live):
+            code = p.poll()
+            if code is None:
+                continue
+            live.remove(p)
+            if code != 0 and rc == 0:
+                rc = code
+                _kill_all()
+        time.sleep(0.1)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
