@@ -27,10 +27,11 @@ from .base import MXNetError
 
 class _TapeEntry:
     __slots__ = ("fn", "attrs", "in_handles", "in_values", "in_arrays",
-                 "out_handles", "out_arrays", "rng_key", "n_keep")
+                 "out_handles", "out_arrays", "rng_key", "n_keep",
+                 "op_name")
 
     def __init__(self, fn, attrs, in_handles, in_values, in_arrays,
-                 out_handles, out_arrays, rng_key, n_keep):
+                 out_handles, out_arrays, rng_key, n_keep, op_name=None):
         self.fn = fn                # pure: fn(*in_values, **attrs) -> tuple
         self.attrs = attrs
         self.in_handles = in_handles
@@ -40,6 +41,7 @@ class _TapeEntry:
         self.out_arrays = out_arrays
         self.rng_key = rng_key
         self.n_keep = n_keep        # how many leading fn outputs are visible
+        self.op_name = op_name      # canonical registry name (None: custom)
 
 
 class _State(threading.local):
@@ -119,7 +121,7 @@ def mark_variables(variables, gradients, grad_reqs="write"):
 
 
 def _record(fn, attrs, in_arrays, in_values, out_arrays, rng_key=None,
-            n_keep=None):
+            n_keep=None, op_name=None):
     """Called by the dispatcher for every op executed under record()."""
     entry = _TapeEntry(
         fn=fn, attrs=attrs,
@@ -129,7 +131,8 @@ def _record(fn, attrs, in_arrays, in_values, out_arrays, rng_key=None,
         out_handles=[a._handle for a in out_arrays],
         out_arrays=list(out_arrays),
         rng_key=rng_key,
-        n_keep=n_keep if n_keep is not None else len(out_arrays))
+        n_keep=n_keep if n_keep is not None else len(out_arrays),
+        op_name=op_name)
     _state.tape.append(entry)
 
 
@@ -157,18 +160,74 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     # leaves: marked arrays, keyed by the handle *recorded on the tape* (the
     # version actually used in the graph — an in-place mutation after record
     # must not orphan the gradient; reference analog: engine var versions).
+    #
+    # Leaves whose grad buffer is row_sparse (attach_grad(stype=
+    # 'row_sparse')) are handled on a separate path: they are NOT vjp
+    # leaves (that would materialize the dense (vocab, d) cotangent the
+    # sparse request exists to avoid).  Instead, for each gather that
+    # consumes them (Embedding/take — the reference's sparse-grad ops,
+    # indexing_op.cc FInferStorageType), an auxiliary zero leaf is added
+    # to the gather's output; its cotangent IS the touched-row values,
+    # and the gather's index input supplies the row indices.
+    from .ndarray.sparse import RowSparseNDArray
+
+    def _is_sparse_leaf(a):
+        return (getattr(a, "_grad_req", "null") != "null"
+                and isinstance(getattr(a, "_grad", None), RowSparseNDArray))
+
     leaf_handles: List[object] = []
     leaf_arrays: List["NDArray"] = []
     leaf_values: List[object] = []
     seen = set()
+    sparse_leaf_of: Dict[object, "NDArray"] = {}
     for e in tape:
         for h, a, v in zip(e.in_handles, e.in_arrays, e.in_values):
+            if h in seen:
+                continue
+            if _is_sparse_leaf(a):
+                seen.add(h)
+                sparse_leaf_of[h] = a
+                continue
             if (getattr(a, "_grad_req", "null") != "null"
-                    and a._grad is not None and h not in seen):
+                    and a._grad is not None):
                 seen.add(h)
                 leaf_handles.append(h)
                 leaf_arrays.append(a)
                 leaf_values.append(v)
+
+    # locate the gathers consuming sparse leaves and build their aux leaves
+    aux_handles: List[object] = []
+    aux_values: List[object] = []
+    aux_entries = {}       # id(entry) -> aux handle
+    sparse_contrib = []    # (leaf_array, aux_handle, indices_values)
+    for e in tape:
+        for pos, (h, a) in enumerate(zip(e.in_handles, e.in_arrays)):
+            if h not in sparse_leaf_of:
+                continue
+            if e.op_name == "Embedding" and pos == 1:
+                idx_vals, w_vals = e.in_values[0], e.in_values[1]
+            elif e.op_name == "take" and pos == 0 \
+                    and e.attrs.get("axis", 0) == 0:
+                idx_vals, w_vals = e.in_values[1], e.in_values[0]
+            elif e.op_name is None:
+                raise MXNetError(
+                    "row_sparse gradients are not supported through a "
+                    "hybridized/cached graph (the fused program hides the "
+                    "gather); un-hybridize the block consuming this "
+                    "parameter, or use a dense gradient "
+                    "(grad_stype='default')")
+            else:
+                raise MXNetError(
+                    "row_sparse gradient requested for an array consumed "
+                    f"by op {e.op_name!r}; only Embedding/take(axis=0) "
+                    "produce sparse gradients (reference: indexing_op.cc "
+                    "sparse-grad storage inference)")
+            aux_h = object()
+            out_shape = tuple(idx_vals.shape) + tuple(w_vals.shape[1:])
+            aux_handles.append(aux_h)
+            aux_values.append(jnp.zeros(out_shape, w_vals.dtype))
+            aux_entries[id(e)] = aux_h
+            sparse_contrib.append((sparse_leaf_of[h], aux_h, idx_vals))
     for h in heads:
         if (getattr(h, "_grad_req", "null") != "null" and h._grad is not None
                 and h._handle not in seen):
@@ -176,13 +235,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             leaf_handles.append(h._handle)
             leaf_arrays.append(h)
             leaf_values.append(h._data)
-    if not leaf_handles:
+    if not leaf_handles and not sparse_contrib:
         raise MXNetError("no marked (attach_grad) variables found in graph")
 
     head_handles = [h._handle for h in heads]
+    all_handles = leaf_handles + aux_handles
+    all_values = leaf_values + aux_values
 
     def replay(leaf_vals):
-        env = dict(zip(leaf_handles, leaf_vals))
+        env = dict(zip(all_handles, leaf_vals))
         for e in tape:
             ins = [env.get(h, v) for h, v in zip(e.in_handles, e.in_values)]
             if e.rng_key is not None:
@@ -191,6 +252,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 outs = e.fn(*ins, **e.attrs)
             if not isinstance(outs, (tuple, list)):
                 outs = (outs,)
+            aux_h = aux_entries.get(id(e))
+            if aux_h is not None:
+                outs = (outs[0] + env[aux_h],) + tuple(outs[1:])
             for h, o in zip(e.out_handles, outs[:e.n_keep]):
                 env[h] = o
         missing = [i for i, h in enumerate(head_handles) if h not in env]
@@ -198,7 +262,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             raise MXNetError("head output was not produced by recorded graph")
         return tuple(env[h] for h in head_handles)
 
-    outs, vjp_fn = jax.vjp(lambda *ls: replay(ls), *leaf_values)
+    outs, vjp_fn = jax.vjp(lambda *ls: replay(ls), *all_values)
     if head_grads is None:
         cts = tuple(jnp.ones_like(o) for o in outs)
     else:
@@ -206,6 +270,37 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                     (g._data if isinstance(g, NDArray) else jnp.asarray(g))
                     for o, g in zip(outs, head_grads))
     grads = vjp_fn(cts)
+    # sparse leaves: aux cotangents are the touched-row values; the gather
+    # indices are the row ids.  O(touched rows) end to end.
+    aux_grads = dict(zip(aux_handles, grads[len(leaf_values):]))
+    sp_per_array: Dict[int, list] = {}
+    sp_order: List["NDArray"] = []
+    for a, aux_h, idx_vals in sparse_contrib:
+        if id(a) not in sp_per_array:
+            sp_per_array[id(a)] = []
+            sp_order.append(a)
+        g = aux_grads[aux_h]
+        row_shape = tuple(a.shape[1:])
+        # clip like the forward gather does (jax gather mode=clip): an
+        # out-of-range id accumulates at the clamped row, matching the
+        # dense-grad result for the same graph
+        idx = jnp.clip(jnp.asarray(idx_vals).reshape(-1).astype(jnp.int64),
+                       0, a.shape[0] - 1)
+        sp_per_array[id(a)].append((g.reshape((-1,) + row_shape), idx))
+    for a in sp_order:
+        vals = jnp.concatenate([v for v, _ in sp_per_array[id(a)]], axis=0)
+        idxs = jnp.concatenate([i for _, i in sp_per_array[id(a)]], axis=0)
+        if a._grad_req == "add" and isinstance(a._grad, RowSparseNDArray) \
+                and a._grad.indices.shape[0] > 0:
+            vals = jnp.concatenate([a._grad.data._data, vals], axis=0)
+            idxs = jnp.concatenate(
+                [a._grad.indices._data.astype(jnp.int64), idxs], axis=0)
+        # re-arm the existing grad buffer in place: Parameter/Module hold
+        # a reference to it, exactly like the dense in-place write below
+        RowSparseNDArray.__init__(a._grad, NDArray(vals), NDArray(idxs),
+                                  tuple(a.shape))
+
+    grads = grads[:len(leaf_values)]
     # accumulate per array (the same array may appear under several recorded
     # versions); honor grad_req write/add
     per_array: Dict[int, list] = {}

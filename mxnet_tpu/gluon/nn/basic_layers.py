@@ -206,11 +206,13 @@ class Embedding(HybridBlock):
     def __init__(self, input_dim, output_dim, dtype=np.float32,
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
-        self._kwargs = {'input_dim': input_dim, 'output_dim': output_dim}
+        self._kwargs = {'input_dim': input_dim, 'output_dim': output_dim,
+                        'sparse_grad': sparse_grad}
         with self.name_scope():
             self.weight = self.params.get(
                 'weight', shape=(input_dim, output_dim), dtype=dtype,
-                init=weight_initializer, allow_deferred_init=True)
+                init=weight_initializer, allow_deferred_init=True,
+                grad_stype='row_sparse' if sparse_grad else 'default')
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, **self._kwargs)

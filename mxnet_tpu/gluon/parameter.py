@@ -55,6 +55,7 @@ class Parameter:
         self._deferred_init = None   # (init, ctx, default_init)
         self._trainer = None
         self._stype = stype
+        self._grad_stype = grad_stype
 
     def __repr__(self):
         return (f"Parameter {self.name} (shape={self.shape}, "
@@ -137,7 +138,15 @@ class Parameter:
         self._finish_init(init, ctx, default_init)
 
     def _init_grad(self):
-        self._grad = nd_zeros(self.shape, dtype=self.dtype)
+        if self._grad_stype == 'row_sparse':
+            # sparse gradient: autograd fills values+indices for touched
+            # rows only (reference: parameter.py grad_stype → sparse-grad
+            # Embedding path)
+            from ..ndarray.sparse import zeros as sp_zeros
+            self._grad = sp_zeros('row_sparse', self.shape,
+                                  dtype=self.dtype)
+        else:
+            self._grad = nd_zeros(self.shape, dtype=self.dtype)
         autograd.mark_variables([self._data], [self._grad],
                                 [self._grad_req])
 

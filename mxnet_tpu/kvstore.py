@@ -123,22 +123,37 @@ class KVStore:
                                            o.context.jax_device()))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the rows in row_ids (reference: kvstore.h PullRowSparse).
+        """Pull only the rows in row_ids (reference: kvstore.h
+        PullRowSparse / KVStoreLocal::PullRowSparseImpl,
+        kvstore_local.h:188).
 
-        Dense-backed: gathers rows on device — the sparse storage formats of
-        the reference map to gather/scatter on TPU (see ndarray/sparse.py).
+        O(requested rows): gathers the rows on device.  A RowSparseNDArray
+        ``out`` receives values+indices with NO dense materialization; a
+        dense ``out`` gets the scatter fallback.
         """
+        from .ndarray.sparse import RowSparseNDArray
         assert out is not None and row_ids is not None
         keys, outs = self._canon(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
         for k, os_, rid in zip(keys, outs, row_ids):
             src = self._store[k]
-            idx = rid._data.astype(jnp.int32)
+            # dedup row ids (reference: PullRowSparseImpl dedups before
+            # gathering) — duplicates would double-count in the rsp view
+            idx = jnp.asarray(
+                np.unique(np.asarray(rid.asnumpy(), dtype=np.int64)),
+                dtype=jnp.int32)
             rows = jnp.take(src._data, idx, axis=0)
             for o in os_:
-                # scatter picked rows into a dense out of full shape
-                o._set_data(jnp.zeros_like(src._data).at[idx].set(rows))
+                if isinstance(o, RowSparseNDArray):
+                    # re-arm in place with the gathered rows (O(rows))
+                    RowSparseNDArray.__init__(
+                        o, NDArray(rows), NDArray(idx.astype(jnp.int64)),
+                        tuple(src.shape))
+                else:
+                    # dense out: scatter fallback
+                    o._set_data(
+                        jnp.zeros_like(src._data).at[idx].set(rows))
 
     # -- optimizer ------------------------------------------------------------
     def set_optimizer(self, optimizer):

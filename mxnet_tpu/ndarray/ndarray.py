@@ -188,8 +188,18 @@ class NDArray:
 
     # -- autograd -----------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
-        """reference: ndarray.py attach_grad → MXAutogradMarkVariables."""
-        self._grad = zeros(self.shape, dtype=self._data.dtype)
+        """reference: ndarray.py attach_grad → MXAutogradMarkVariables.
+
+        ``stype='row_sparse'`` requests a row_sparse gradient: autograd
+        will produce values+indices for only the touched rows (supported
+        when this array is consumed via Embedding/take — the reference's
+        sparse-grad ops) instead of a dense (shape) gradient."""
+        if stype == "row_sparse":
+            from .sparse import zeros as sp_zeros
+            self._grad = sp_zeros("row_sparse", self.shape,
+                                  dtype=self._data.dtype)
+        else:
+            self._grad = zeros(self.shape, dtype=self._data.dtype)
         self._grad_req = grad_req
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
@@ -510,8 +520,8 @@ def _invoke(op_name: str, inputs, attrs, out=None):
             if not isinstance(r, (tuple, list)):
                 r = (r,)
             return tuple(r[:len(r) - _n] if _n else r)
-        _ag._record(pure, {}, list(inputs), vals, out_arrays,
-                    rng_key=rng_key, n_keep=keep)
+        _ag._record(pure, dict(attrs), list(inputs), vals, out_arrays,
+                    rng_key=rng_key, n_keep=keep, op_name=opdef.name)
 
     if _naive_mode():
         for oa in out_arrays:

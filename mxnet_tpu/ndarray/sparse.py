@@ -1,28 +1,43 @@
-"""Sparse NDArrays: row_sparse and CSR.
+"""Sparse NDArrays: row_sparse and CSR — O(nnz) TPU-native design.
 
-Scoped TPU-native design (SURVEY.md §7 "Hard parts": XLA has no native
-sparse).  The reference implements storage types dense/row_sparse/CSR at the
-NDArray level (include/mxnet/ndarray.h:58-62) with per-op storage-type
-inference and dense fallback.  Here sparse arrays are explicit wrapper
-classes holding dense component arrays (indices + values), chosen because on
-TPU the only wins worth keeping are:
+The reference implements storage types dense/row_sparse/CSR at the NDArray
+level (include/mxnet/ndarray.h:58-62) with per-op storage-type inference
+and dense fallback.  XLA has no native sparse tensors; what survives on
+TPU — and what the reference actually uses sparse *for* — is:
 
-* row_sparse gradients for embeddings (gather/scatter-add — XLA handles
-  these natively and efficiently),
-* CSR x dense matmul via ``jax.experimental.sparse`` BCSR or segment-sum.
+* **row_sparse gradients for embeddings**: values (nnz_rows, d) + indices,
+  produced by autograd without ever materializing the (vocab, d) dense
+  gradient (autograd.backward sparse-leaf path), consumed by sparse
+  optimizer updates that touch only those rows
+  (reference: src/operator/optimizer_op.cc sparse SGD/Adam).
+* **CSR × dense dot** via gather + scatter-add, O(nnz·k)
+  (reference: src/operator/tensor/dot-inl.h DotCsrDnsDns).
 
-Any op without a sparse-aware path falls back to dense via ``.todense()``,
-mirroring the reference's storage-fallback mechanism
-(src/common/exec_utils.h SetupDefaultBlobsInOut).
+Dense materialization still exists as the universal fallback (mirroring
+the reference's storage fallback, src/common/exec_utils.h
+SetupDefaultBlobsInOut) but it is LAZY: a sparse array densifies only when
+a dense-only code path actually reads it, and ``DENSIFY_COUNT`` records
+every such event so tests can assert hot paths stay sparse.
 """
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from .ndarray import NDArray, _invoke
+from .ndarray import NDArray
+
+# incremented on every lazy dense materialization — tests assert this
+# stays flat across sparse hot paths
+DENSIFY_COUNT = 0
+
+
+def _mark_densified():
+    global DENSIFY_COUNT
+    DENSIFY_COUNT += 1
 
 
 class BaseSparseNDArray(NDArray):
@@ -31,16 +46,31 @@ class BaseSparseNDArray(NDArray):
 
 class RowSparseNDArray(BaseSparseNDArray):
     """values (nnz_rows, *row_shape) + indices (nnz_rows,) — reference:
-    ndarray.h kRowSparseStorage."""
+    ndarray.h kRowSparseStorage.  Dense payload is LAZY (O(nnz) until a
+    dense-only op forces it)."""
 
     def __init__(self, data, indices, shape, dtype=None):
-        self._sp_data = data if isinstance(data, NDArray) else NDArray(data, dtype=dtype)
+        self._sp_data = data if isinstance(data, NDArray) \
+            else NDArray(data, dtype=dtype)
         self._sp_indices = indices if isinstance(indices, NDArray) else \
             NDArray(np.asarray(indices, dtype=np.int64), dtype="int64")
         self._sp_shape = tuple(shape)
-        dense = jnp.zeros(self._sp_shape, self._sp_data._data.dtype).at[
-            self._sp_indices._data.astype(jnp.int32)].set(self._sp_data._data)
-        super().__init__(dense)
+        self._handle = object()
+        self._ctx = None
+        self._grad = None
+        self._grad_req = "null"
+        self._payload = None
+        sp_data, sp_idx = self._sp_data, self._sp_indices
+
+        def densify():
+            _mark_densified()
+            dense = jnp.zeros(self._sp_shape, sp_data._data.dtype).at[
+                sp_idx._data.astype(jnp.int32)].add(
+                    sp_data._data, mode="drop")
+            self._set_data(dense)
+
+        self._set_lazy(densify, aval=jax.ShapeDtypeStruct(
+            self._sp_shape, jnp.dtype(self._sp_data.dtype)))
 
     @property
     def stype(self):
@@ -54,6 +84,16 @@ class RowSparseNDArray(BaseSparseNDArray):
     def indices(self):
         return self._sp_indices
 
+    def retain(self, row_ids):
+        """Keep only rows in row_ids (reference: sparse_retain op)."""
+        rid = np.asarray(row_ids.asnumpy() if isinstance(row_ids, NDArray)
+                         else row_ids).astype(np.int64)
+        mask = np.isin(self._sp_indices.asnumpy(), rid)
+        keep = np.where(mask)[0]
+        return RowSparseNDArray(
+            NDArray(jnp.take(self._sp_data._data, keep, axis=0)),
+            self._sp_indices.asnumpy()[mask], self._sp_shape)
+
     def tostype(self, stype):
         if stype == "row_sparse":
             return self
@@ -64,25 +104,45 @@ class RowSparseNDArray(BaseSparseNDArray):
     def todense(self):
         return NDArray(self._data)
 
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._sp_shape} "
+                f"nnz_rows={self._sp_indices.shape[0]}>")
+
 
 class CSRNDArray(BaseSparseNDArray):
-    """CSR matrix: data/indices/indptr (reference: ndarray.h kCSRStorage)."""
+    """CSR matrix: data/indices/indptr (reference: ndarray.h kCSRStorage).
+    Dense payload is LAZY; dot(csr, dense) runs O(nnz·k)."""
 
     def __init__(self, data, indices, indptr, shape, dtype=None):
-        self._sp_data = data if isinstance(data, NDArray) else NDArray(data, dtype=dtype)
+        self._sp_data = data if isinstance(data, NDArray) \
+            else NDArray(data, dtype=dtype)
         self._sp_indices = indices if isinstance(indices, NDArray) else \
             NDArray(np.asarray(indices, dtype=np.int64), dtype="int64")
         self._sp_indptr = indptr if isinstance(indptr, NDArray) else \
             NDArray(np.asarray(indptr, dtype=np.int64), dtype="int64")
         self._sp_shape = tuple(shape)
-        # dense materialization (fallback path)
-        n_rows = shape[0]
+        # row id per nonzero (host, O(nnz), computed once)
         iptr = np.asarray(self._sp_indptr.asnumpy(), dtype=np.int64)
-        rows = np.repeat(np.arange(n_rows), np.diff(iptr))
-        dense = np.zeros(shape, dtype=np.asarray(self._sp_data.asnumpy()).dtype)
-        dense[rows, self._sp_indices.asnumpy().astype(np.int64)] = \
-            self._sp_data.asnumpy()
-        super().__init__(dense)
+        self._sp_rows = NDArray(
+            np.repeat(np.arange(shape[0], dtype=np.int64), np.diff(iptr)),
+            dtype="int64")
+        self._handle = object()
+        self._ctx = None
+        self._grad = None
+        self._grad_req = "null"
+        self._payload = None
+        sp = self
+
+        def densify():
+            _mark_densified()
+            dense = jnp.zeros(sp._sp_shape, sp._sp_data._data.dtype).at[
+                sp._sp_rows._data.astype(jnp.int32),
+                sp._sp_indices._data.astype(jnp.int32)].add(
+                    sp._sp_data._data)
+            sp._set_data(dense)
+
+        self._set_lazy(densify, aval=jax.ShapeDtypeStruct(
+            self._sp_shape, jnp.dtype(self._sp_data.dtype)))
 
     @property
     def stype(self):
@@ -100,6 +160,15 @@ class CSRNDArray(BaseSparseNDArray):
     def indptr(self):
         return self._sp_indptr
 
+    def dot(self, dense):
+        """CSR × dense → dense, O(nnz·k) gather/scatter-add (reference:
+        tensor/dot-inl.h DotCsrDnsDns)."""
+        d = dense._data if isinstance(dense, NDArray) else jnp.asarray(dense)
+        return NDArray(_csr_dot(self._sp_data._data,
+                                self._sp_rows._data.astype(jnp.int32),
+                                self._sp_indices._data.astype(jnp.int32),
+                                d, self._sp_shape[0]))
+
     def tostype(self, stype):
         if stype == "csr":
             return self
@@ -109,6 +178,41 @@ class CSRNDArray(BaseSparseNDArray):
 
     def todense(self):
         return NDArray(self._data)
+
+    def __repr__(self):
+        return (f"\n<CSRNDArray {self._sp_shape} "
+                f"nnz={self._sp_data.shape[0]}>")
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _csr_dot(data, rows, cols, dense, n_rows):
+    contrib = data[:, None] * dense[cols]              # (nnz, k)
+    return jnp.zeros((n_rows, dense.shape[1]),
+                     contrib.dtype).at[rows].add(contrib)
+
+
+@jax.jit
+def _dedup_rows_jit(vals, idx, oob):
+    order = jnp.argsort(idx)
+    sidx = idx[order]
+    svals = vals[order]
+    first = jnp.concatenate([jnp.array([True]), sidx[1:] != sidx[:-1]])
+    slot = jnp.cumsum(first) - 1                        # unique slot per elt
+    agg = jnp.zeros_like(svals).at[slot].add(svals)
+    out_idx = jnp.full(idx.shape, oob, idx.dtype).at[slot].set(sidx)
+    return agg, out_idx
+
+
+def dedup_rows(values, indices, oob_index):
+    """Aggregate duplicate row indices (jit-safe, static shapes).
+
+    Returns (agg_values, dedup_indices) of the SAME nnz length where each
+    unique row's summed values sit in its first slot and unused slots carry
+    ``oob_index`` (dropped by scatters with mode='drop').  The reference's
+    AddTakeGradRspKernel does the same sort-and-accumulate
+    (src/operator/tensor/indexing_op.h)."""
+    return _dedup_rows_jit(values, indices,
+                           jnp.asarray(oob_index, indices.dtype))
 
 
 def row_sparse_array(arg1, shape=None, dtype=None, ctx=None):
@@ -128,7 +232,8 @@ def csr_matrix(arg1, shape=None, dtype=None, ctx=None):
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
     rows, cols = np.nonzero(dense)
     indptr = np.searchsorted(rows, np.arange(dense.shape[0] + 1))
-    return CSRNDArray(dense[rows, cols], cols, indptr, dense.shape, dtype=dtype)
+    return CSRNDArray(dense[rows, cols], cols, indptr, dense.shape,
+                      dtype=dtype)
 
 
 def cast_storage(arr, stype):
@@ -145,9 +250,11 @@ def cast_storage(arr, stype):
 
 def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "row_sparse":
-        return RowSparseNDArray(np.zeros((0,) + tuple(shape[1:])),
+        return RowSparseNDArray(np.zeros((0,) + tuple(shape[1:]),
+                                         dtype=dtype or np.float32),
                                 np.zeros((0,)), shape, dtype=dtype)
     if stype == "csr":
-        return CSRNDArray(np.zeros((0,)), np.zeros((0,)),
-                          np.zeros(shape[0] + 1), shape, dtype=dtype)
+        return CSRNDArray(np.zeros((0,), dtype=dtype or np.float32),
+                          np.zeros((0,)), np.zeros(shape[0] + 1), shape,
+                          dtype=dtype)
     raise MXNetError(stype)

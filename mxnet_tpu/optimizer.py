@@ -100,7 +100,37 @@ class Optimizer:
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         states = self._state_tuple(state)
+        from .ndarray.sparse import RowSparseNDArray
         use_mp = self.mp_states_active(weight, states)
+        if isinstance(grad, RowSparseNDArray):
+            impl = getattr(self, "_update_impl_rsp", None)
+            if impl is not None and grad.indices.shape[0] > 0:
+                # touch only the gradient's rows (reference: sparse
+                # sgd/adam updates, optimizer_op.cc lazy_update path).
+                # Multi-precision: the sparse update applies to the fp32
+                # master copy (states[0]); the low-precision weight is a
+                # cast-down view of it.
+                if use_mp:
+                    w32 = states[0]._data
+                    new_w32, new_sub = impl(
+                        w32, grad.data._data.astype(jnp.float32),
+                        grad.indices._data,
+                        tuple(s._data for s in states[1:]), lr, wd, index)
+                    states[0]._set_data(new_w32)
+                    weight._set_data(new_w32.astype(weight._data.dtype))
+                    for s, v in zip(states[1:], new_sub):
+                        s._set_data(v)
+                    return
+                new_w, new_states = impl(
+                    weight._data, grad.data._data, grad.indices._data,
+                    tuple(s._data for s in states), lr, wd, index)
+                weight._set_data(new_w)
+                for s, v in zip(states, new_states):
+                    s._set_data(v)
+                return
+            if grad.indices.shape[0] == 0:
+                return  # nothing touched
+            grad = NDArray(grad._data)  # dense fallback (densifies)
         if use_mp:
             w32 = states[0]._data
             new_w32, new_sub = self._update_impl(
@@ -222,6 +252,25 @@ class SGD(Optimizer):
         new_mom = self.momentum * mom - lr * (g + wd * weight)
         return weight + new_mom, (new_mom,)
 
+    def _update_impl_rsp(self, weight, values, indices, states, lr, wd,
+                         index=0):
+        """Row-sparse update touching only the gradient's rows
+        (reference: optimizer_op.cc SGDMomLazyUpdate — momentum/wd apply
+        per TOUCHED row only; duplicates pre-aggregated like
+        AddTakeGradRspKernel)."""
+        from .ndarray.sparse import dedup_rows
+        vals, idx = dedup_rows(values, indices.astype(jnp.int32),
+                               weight.shape[0])
+        g = _clip(vals * self.rescale_grad, self.clip_gradient)
+        rows = jnp.take(weight, idx, axis=0, mode="fill", fill_value=0)
+        if self.momentum == 0.0 or not states:
+            return weight.at[idx].add(-lr * (g + wd * rows), mode="drop"), ()
+        mom = states[0]
+        mom_rows = jnp.take(mom, idx, axis=0, mode="fill", fill_value=0)
+        new_mom_rows = self.momentum * mom_rows - lr * (g + wd * rows)
+        new_mom = mom.at[idx].set(new_mom_rows, mode="drop")
+        return weight.at[idx].add(new_mom_rows, mode="drop"), (new_mom,)
+
 
 @register
 class NAG(Optimizer):
@@ -329,7 +378,33 @@ class Adam(Optimizer):
         v = self.beta2 * var + (1. - self.beta2) * jnp.square(g)
         return weight - lr * m / (jnp.sqrt(v) + self.epsilon), (m, v)
 
+    def _update_impl_rsp(self, weight, values, indices, states, lr, wd,
+                         index=0):
+        """Lazy Adam on touched rows only (reference: optimizer_op.cc
+        AdamUpdateRspRspImpl — mean/var decay applied per touched row)."""
+        from .ndarray.sparse import dedup_rows
+        mean, var = states
+        t = self._index_update_count.get(index, self.num_update) or 1
+        coef1 = 1. - jnp.float32(self.beta1) ** t
+        coef2 = 1. - jnp.float32(self.beta2) ** t
+        lr = lr * jnp.sqrt(coef2) / coef1
+        vals, idx = dedup_rows(values, indices.astype(jnp.int32),
+                               weight.shape[0])
+        rows = jnp.take(weight, idx, axis=0, mode="fill", fill_value=0)
+        g = _clip(vals * self.rescale_grad, self.clip_gradient) + wd * rows
+        m_rows = jnp.take(mean, idx, axis=0, mode="fill", fill_value=0)
+        v_rows = jnp.take(var, idx, axis=0, mode="fill", fill_value=0)
+        new_m = self.beta1 * m_rows + (1. - self.beta1) * g
+        new_v = self.beta2 * v_rows + (1. - self.beta2) * jnp.square(g)
+        upd = -lr * new_m / (jnp.sqrt(new_v) + self.epsilon)
+        return (weight.at[idx].add(upd, mode="drop"),
+                (mean.at[idx].set(new_m, mode="drop"),
+                 var.at[idx].set(new_v, mode="drop")))
+
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            return super().update(index, weight, grad, state)
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
