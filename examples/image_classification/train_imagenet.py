@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Train ImageNet-class CNNs (reference:
+example/image-classification/train_imagenet.py — the north-star entry).
+
+Data comes from RecordIO files produced by tools/im2rec.py
+(--data-train/--data-val), or --benchmark 1 runs on synthetic data — the
+reference script's own throughput-benchmark mode.  --dtype bfloat16
+enables mixed precision (fp32 master weights).
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..'))
+import common  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+class SyntheticIter(mx.io.DataIter):
+    """reference: train_imagenet.py --benchmark synthetic data path."""
+
+    def __init__(self, batch_size, image_shape, num_classes, batches=50):
+        super().__init__(batch_size)
+        rng = np.random.RandomState(0)
+        self._x = mx.nd.array(rng.uniform(
+            -1, 1, (batch_size,) + image_shape).astype('float32'))
+        self._y = mx.nd.array(
+            rng.randint(0, num_classes, (batch_size,)).astype('float32'))
+        self._n = batches
+        self._i = 0
+        self.provide_data = [mx.io.DataDesc(
+            'data', (batch_size,) + image_shape)]
+        self.provide_label = [mx.io.DataDesc(
+            'softmax_label', (batch_size,))]
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self._n:
+            raise StopIteration
+        self._i += 1
+        return mx.io.DataBatch([self._x], [self._y],
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+
+def get_iters(args, image_shape):
+    if args.benchmark:
+        return (SyntheticIter(args.batch_size, image_shape,
+                              args.num_classes, args.benchmark_iters),
+                None)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+        rand_crop=True, resize=256,
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        part_index=0, num_parts=1)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=image_shape,
+            batch_size=args.batch_size, resize=256,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939)
+    return train, val
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    common.add_fit_args(parser)
+    parser.add_argument('--data-train', type=str, default=None)
+    parser.add_argument('--data-val', type=str, default=None)
+    parser.add_argument('--image-shape', type=str, default='3,224,224')
+    parser.add_argument('--num-classes', type=int, default=1000)
+    parser.add_argument('--num-layers', type=int, default=50)
+    parser.add_argument('--benchmark', type=int, default=0)
+    parser.add_argument('--benchmark-iters', type=int, default=50)
+    parser.set_defaults(network='resnet', num_epochs=1, batch_size=256,
+                        lr=0.1, lr_step_epochs='30,60,90',
+                        num_examples=1281167, dtype='bfloat16')
+    args = parser.parse_args()
+    image_shape = tuple(int(x) for x in args.image_shape.split(','))
+    net = models.get_symbol(args.network, num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape=args.image_shape)
+    train, val = get_iters(args, image_shape)
+    common.fit(args, net, train, val)

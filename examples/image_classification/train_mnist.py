@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Train an MLP / LeNet on MNIST (reference:
+example/image-classification/train_mnist.py — BASELINE config 1).
+
+With --synthetic (or when the IDX files are missing) a generated
+MNIST-shaped dataset is used, so the script runs in no-egress CI; point
+--data-dir at real train-images-idx3-ubyte/train-labels-idx1-ubyte files
+for the real thing.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..'))
+import common  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def get_mnist_iters(args):
+    ip = os.path.join(args.data_dir, 'train-images-idx3-ubyte')
+    lp = os.path.join(args.data_dir, 'train-labels-idx1-ubyte')
+    flat = args.network == 'mlp'
+    if not args.synthetic and os.path.exists(ip):
+        train = mx.io.MNISTIter(image=ip, label=lp,
+                                batch_size=args.batch_size, flat=flat)
+        return train, None
+    # synthetic: class = quadrant-mean pattern, learnable by an MLP
+    rng = np.random.RandomState(0)
+    n = min(args.num_examples, 6000)
+    y = rng.randint(0, 10, (n,)).astype('float32')
+    x = rng.rand(n, 1, 28, 28).astype('float32') * 0.1
+    for i in range(n):
+        c = int(y[i])
+        x[i, 0, (c // 5) * 14:(c // 5) * 14 + 14,
+          (c % 5) * 5:(c % 5) * 5 + 5] += 0.8
+    if flat:
+        x = x.reshape(n, 784)
+    split = int(n * 0.9)
+    train = mx.io.NDArrayIter(x[:split], y[:split], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[split:], y[split:], args.batch_size)
+    return train, val
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    common.add_fit_args(parser)
+    parser.add_argument('--data-dir', type=str, default='data/mnist')
+    parser.add_argument('--synthetic', action='store_true')
+    parser.set_defaults(network='mlp', num_epochs=5, batch_size=64,
+                        lr=0.05, num_examples=60000)
+    args = parser.parse_args()
+    if args.network == 'mlp':
+        net = models.mlp(num_classes=10)
+    else:
+        net = models.lenet(num_classes=10)
+    train, val = get_mnist_iters(args)
+    common.fit(args, net, train, val)

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Bucketing LSTM language model (reference: example/rnn/lstm_bucketing.py
+— BASELINE config 4, PTB).
+
+Reads PTB-style text (--data path, one sentence per line, space-separated
+tokens); with --synthetic (or when the file is missing) a generated
+corpus with learnable bigram structure is used so the script runs in
+no-egress CI.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..'))
+import common  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+
+def tokenize(path, vocab=None):
+    """reference: lstm_bucketing.py tokenize_text."""
+    sentences = []
+    vocab = vocab or {'<pad>': 0, '<unk>': 1}
+    with open(path) as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            ids = []
+            for t in toks:
+                if t not in vocab:
+                    vocab[t] = len(vocab)
+                ids.append(vocab[t])
+            sentences.append(ids)
+    return sentences, vocab
+
+
+def synthetic_corpus(n=600, vocab_size=60, seed=0):
+    """Markov-chain corpus: next token = (token * 3 + 1) % V with noise."""
+    rng = np.random.RandomState(seed)
+    sentences = []
+    for _ in range(n):
+        ln = rng.randint(8, 25)
+        s = [int(rng.randint(2, vocab_size))]
+        for _ in range(ln - 1):
+            if rng.rand() < 0.85:
+                s.append((s[-1] * 3 + 1) % (vocab_size - 2) + 2)
+            else:
+                s.append(int(rng.randint(2, vocab_size)))
+        sentences.append(s)
+    return sentences, vocab_size
+
+
+def sym_gen_factory(num_hidden, num_layers, num_embed, vocab_size):
+    """reference: lstm_bucketing.py sym_gen — per-bucket symbol builder."""
+    def sym_gen(seq_len):
+        data = sym.Variable('data')
+        label = sym.Variable('softmax_label')
+        embed = sym.Embedding(data, input_dim=vocab_size,
+                              output_dim=num_embed, name='embed')
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(num_layers):
+            stack.add(mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                      prefix='lstm_l%d_' % i))
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name='pred')
+        lab = sym.Reshape(label, shape=(-1,))
+        out = sym.SoftmaxOutput(data=pred, label=lab, name='softmax')
+        return out, ('data',), ('softmax_label',)
+    return sym_gen
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    common.add_fit_args(parser)
+    parser.add_argument('--data', type=str, default='data/ptb.train.txt')
+    parser.add_argument('--synthetic', action='store_true')
+    parser.add_argument('--num-hidden', type=int, default=200)
+    parser.add_argument('--num-embed', type=int, default=200)
+    parser.add_argument('--num-lstm-layers', type=int, default=2)
+    parser.add_argument('--buckets', type=str, default='10,20,30,40')
+    parser.set_defaults(num_epochs=5, batch_size=32, lr=0.1,
+                        optimizer='sgd')
+    args = parser.parse_args()
+
+    if not args.synthetic and os.path.exists(args.data):
+        sentences, vocab = tokenize(args.data)
+        vocab_size = len(vocab)
+    else:
+        sentences, vocab_size = synthetic_corpus()
+    buckets = [int(b) for b in args.buckets.split(',')]
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=buckets)
+
+    sym_gen = sym_gen_factory(args.num_hidden, args.num_lstm_layers,
+                              args.num_embed, vocab_size)
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key,
+                                 context=mx.tpu(0))
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    mod.fit(train, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            optimizer=args.optimizer,
+            optimizer_params={'learning_rate': args.lr,
+                              'momentum': args.mom, 'wd': args.wd},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches))

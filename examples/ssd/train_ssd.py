@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Train SSD (reference: example/ssd/train.py — BASELINE config 5).
+
+--data-train points at a detection .rec (ImageDetRecordIter format, e.g.
+produced by mxnet_tpu.image.detection.pack_det_dataset or im2rec det
+packing).  With --synthetic a toy squares dataset is generated and the
+small ssd_toy network is used, so the script runs end-to-end in
+no-egress CI; otherwise the VGG16-reduced SSD-300 trains.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..'))
+import common  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+from mxnet_tpu.image.detection import pack_det_dataset  # noqa: E402
+
+
+def synthetic_rec(path, n=64, size=64, seed=0):
+    rng = np.random.RandomState(seed)
+    images, classes, boxes = [], [], []
+    for _ in range(n):
+        im = rng.randint(0, 60, (size, size, 3)).astype(np.uint8)
+        s = rng.randint(size // 4, size // 2)
+        y0 = rng.randint(0, size - s)
+        x0 = rng.randint(0, size - s)
+        im[y0:y0 + s, x0:x0 + s] = 255
+        images.append(im)
+        classes.append([0.0])
+        boxes.append([[x0 / size, y0 / size, (x0 + s) / size,
+                       (y0 + s) / size]])
+    pack_det_dataset(path, images, classes, boxes)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    common.add_fit_args(parser)
+    parser.add_argument('--data-train', type=str, default=None)
+    parser.add_argument('--synthetic', action='store_true')
+    parser.add_argument('--num-classes', type=int, default=20)
+    parser.add_argument('--data-shape', type=int, default=300)
+    parser.set_defaults(num_epochs=3, batch_size=8, lr=0.004,
+                        wd=5e-4)
+    args = parser.parse_args()
+
+    if args.synthetic or not args.data_train:
+        tmp = os.path.join(tempfile.gettempdir(), 'ssd_toy.rec')
+        synthetic_rec(tmp)
+        args.data_train = tmp
+        args.num_classes = 1
+        args.data_shape = 64
+        net = models.ssd_toy(num_classes=1, mode='train')
+    else:
+        net = models.ssd_vgg16(num_classes=args.num_classes, mode='train')
+
+    shape = (3, args.data_shape, args.data_shape)
+    train = mx.io.ImageDetRecordIter(
+        args.data_train, data_shape=shape, batch_size=args.batch_size,
+        max_objects=16, rand_mirror=True, shuffle=True)
+
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    mod = mx.mod.Module(net, context=mx.tpu(0), data_names=('data',),
+                        label_names=('label',))
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    # reference example/ssd/train.py defaults: lr 0.004, wd 5e-4,
+    # gradient clipping for early-training stability
+    mod.init_optimizer(optimizer=args.optimizer,
+                       optimizer_params={'learning_rate': args.lr,
+                                         'momentum': args.mom,
+                                         'wd': args.wd,
+                                         'clip_gradient': 4.0})
+    for epoch in range(args.num_epochs):
+        train.reset()
+        tot, n = 0.0, 0
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            tot += float(mod.get_outputs()[1].asnumpy().sum())
+            n += 1
+            mod.backward()
+            mod.update()
+        logging.info('Epoch[%d] loc_loss=%.4f', epoch, tot / max(n, 1))

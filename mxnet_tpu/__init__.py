@@ -34,6 +34,8 @@ from .io import DataBatch, DataIter, DataDesc, NDArrayIter, ResizeIter, \
     PrefetchingIter, CSVIter
 from .image_record_iter import ImageRecordIter
 io.ImageRecordIter = ImageRecordIter   # reference API: mx.io.ImageRecordIter
+from .image.detection import ImageDetRecordIter
+io.ImageDetRecordIter = ImageDetRecordIter  # reference: src/io/io.cc:581
 from . import recordio
 from . import image
 from . import image as img

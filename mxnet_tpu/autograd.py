@@ -199,7 +199,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     aux_handles: List[object] = []
     aux_values: List[object] = []
     aux_entries = {}       # id(entry) -> aux handle
-    sparse_contrib = []    # (leaf_array, aux_handle, indices_values)
+    sparse_contrib = []    # (leaf_array, aux_handle, indices_values, mode)
     for e in tape:
         for pos, (h, a) in enumerate(zip(e.in_handles, e.in_arrays)):
             if h not in sparse_leaf_of:
@@ -227,7 +227,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             aux_handles.append(aux_h)
             aux_values.append(jnp.zeros(out_shape, w_vals.dtype))
             aux_entries[id(e)] = aux_h
-            sparse_contrib.append((sparse_leaf_of[h], aux_h, idx_vals))
+            sparse_contrib.append((sparse_leaf_of[h], aux_h, idx_vals,
+                                   e.attrs.get("mode", "clip")))
     for h in heads:
         if (getattr(h, "_grad_req", "null") != "null" and h._grad is not None
                 and h._handle not in seen):
@@ -275,17 +276,20 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     aux_grads = dict(zip(aux_handles, grads[len(leaf_values):]))
     sp_per_array: Dict[int, list] = {}
     sp_order: List["NDArray"] = []
-    for a, aux_h, idx_vals in sparse_contrib:
+    for a, aux_h, idx_vals, mode in sparse_contrib:
         if id(a) not in sp_per_array:
             sp_per_array[id(a)] = []
             sp_order.append(a)
         g = aux_grads[aux_h]
         row_shape = tuple(a.shape[1:])
-        # clip like the forward gather does (jax gather mode=clip): an
-        # out-of-range id accumulates at the clamped row, matching the
-        # dense-grad result for the same graph
-        idx = jnp.clip(jnp.asarray(idx_vals).reshape(-1).astype(jnp.int64),
-                       0, a.shape[0] - 1)
+        # normalize indices exactly like the forward gather did (take's
+        # mode attr: clip/wrap — ops/indexing.py _take) so out-of-range
+        # ids credit the same row the forward read
+        idx = jnp.asarray(idx_vals).reshape(-1).astype(jnp.int64)
+        if mode == "wrap":
+            idx = jnp.mod(idx, a.shape[0])
+        else:
+            idx = jnp.clip(idx, 0, a.shape[0] - 1)
         sp_per_array[id(a)].append((g.reshape((-1,) + row_shape), idx))
     for a in sp_order:
         vals = jnp.concatenate([v for v, _ in sp_per_array[id(a)]], axis=0)

@@ -1,0 +1,182 @@
+"""Detection image pipeline: ImageDetRecordIter + det augmenters.
+
+TPU-native equivalent of the reference's ImageDetRecordIter
+(src/io/io.cc:581, src/io/iter_image_det_recordio.cc) and the default
+detection augmenters (src/io/image_det_aug_default.cc).
+
+Record label format (reference: tools/im2rec det packing /
+image_det_aug_default.cc header contract):
+``[header_width, obj_width, <extra header...>, (cls x1 y1 x2 y2 ...)*n]``
+with normalized corner boxes.  The iterator emits labels of shape
+``(batch, max_objects, 5)`` padded with -1 — exactly what MultiBoxTarget
+consumes (ops/detection.py).
+
+Augmentation: resize-to-shape (boxes are normalized, so resize is a
+no-op on them), random horizontal flip with box reflection, and
+RandomDetCrop (crop windows keeping object centers, boxes clipped and
+renormalized).  The reference's full sampler zoo (IOU-constrained crops
+with retries) is subsumed by RandomDetCrop's center-keep rule.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import DataDesc
+from ..image_record_iter import ImageRecordIter
+from .. import recordio
+from .. import native
+
+
+def make_det_label(classes, boxes, header_width=2, obj_width=5):
+    """Build the flat det label for one image: ``[2, 5, cls x1 y1 x2 y2 ...]``
+    (normalized corners)."""
+    classes = np.asarray(classes, np.float32).reshape(-1)
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+    assert len(classes) == len(boxes)
+    objs = np.concatenate([classes[:, None], boxes], axis=1)
+    head = np.array([header_width, obj_width], np.float32)
+    return np.concatenate([head, objs.reshape(-1)])
+
+
+def parse_det_label(flat, max_objects):
+    """Flat record label → (max_objects, 5) padded with -1."""
+    flat = np.asarray(flat, np.float32).reshape(-1)
+    out = np.full((max_objects, 5), -1.0, np.float32)
+    if flat.size < 2:
+        return out
+    hw = int(flat[0])
+    ow = int(flat[1])
+    body = flat[hw:]
+    n = body.size // ow
+    objs = body[:n * ow].reshape(n, ow)[:, :5]
+    n = min(n, max_objects)
+    out[:n] = objs[:n]
+    return out
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """reference: ImageDetRecordIter (src/io/io.cc:581)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 max_objects=16, rand_mirror=False, rand_crop=0.0,
+                 min_crop_scale=0.5, label_name='label', **kwargs):
+        # det-specific state FIRST: super().__init__ starts the prefetch
+        # producer thread, which immediately calls our _load_batch
+        self.max_objects = max_objects
+        self._det_rand_crop_prob = float(rand_crop)
+        self._min_crop_scale = float(min_crop_scale)
+        self._det_mirror = rand_mirror
+        kwargs.pop('label_width', None)
+        super().__init__(path_imgrec, data_shape, batch_size,
+                         label_width=1, rand_mirror=False,
+                         rand_crop=False, label_name=label_name, **kwargs)
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, max_objects, 5))]
+
+    def _load_batch(self, idxs):
+        offs = self._offsets[idxs]
+        if self._native:
+            raws = native.read_records(self.path, offs)
+        else:
+            r = recordio.MXRecordIO(self.path, 'r')
+            raws = []
+            for o in offs:
+                r.seek(int(o))
+                raws.append(r.read())
+            r.close()
+        labels = np.zeros((len(raws), self.max_objects, 5), np.float32)
+        jpegs = []
+        for i, raw in enumerate(raws):
+            header, img = recordio.unpack(raw)
+            labels[i] = parse_det_label(header.label, self.max_objects)
+            jpegs.append(img)
+        c, h, w = self.data_shape
+        if self._native:
+            arr, fails = native.decode_jpeg_batch(jpegs, h, w, c,
+                                                  self.nthreads)
+        else:
+            from . import imdecode
+            from PIL import Image
+            outs = []
+            for b in jpegs:
+                im = np.asarray(imdecode(b, 1 if c == 3 else 0).asnumpy(),
+                                np.uint8)
+                im = np.asarray(Image.fromarray(
+                    im if c == 3 else im[:, :, 0]).resize(
+                        (w, h), Image.BILINEAR), np.uint8)
+                if c == 1:
+                    im = im[:, :, None]
+                outs.append(im)
+            arr = np.stack(outs)
+        arr = arr.transpose(0, 3, 1, 2).astype(np.float32)
+
+        # det augmenters (boxes normalized: resize is box-invariant)
+        if self._det_rand_crop_prob > 0.0:
+            arr, labels = self._rand_det_crop(arr, labels)
+        if self._det_mirror:
+            flip = self._rng.rand(arr.shape[0]) < 0.5
+            arr[flip] = arr[flip, :, :, ::-1]
+            for i in np.where(flip)[0]:
+                valid = labels[i, :, 0] >= 0
+                x1 = labels[i, valid, 1].copy()
+                x2 = labels[i, valid, 3].copy()
+                labels[i, valid, 1] = 1.0 - x2
+                labels[i, valid, 3] = 1.0 - x1
+        if self.mean.any():
+            arr -= self.mean
+        if (self.std != 1.0).any():
+            arr /= self.std
+        return arr, labels
+
+    def _rand_det_crop(self, arr, labels):
+        """Random crop keeping objects whose centers stay inside
+        (reference: image_det_aug_default.cc crop samplers)."""
+        n, c, h, w = arr.shape
+        for i in range(n):
+            if self._rng.rand() >= self._det_rand_crop_prob:
+                continue
+            s = self._rng.uniform(self._min_crop_scale, 1.0)
+            ch, cw = int(h * s), int(w * s)
+            y0 = self._rng.randint(0, h - ch + 1)
+            x0 = self._rng.randint(0, w - cw + 1)
+            # normalized crop window
+            nx0, ny0 = x0 / w, y0 / h
+            nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
+            lab = labels[i]
+            valid = lab[:, 0] >= 0
+            if valid.any():
+                cx = (lab[valid, 1] + lab[valid, 3]) / 2
+                cy = (lab[valid, 2] + lab[valid, 4]) / 2
+                keep = (cx >= nx0) & (cx < nx1) & (cy >= ny0) & (cy < ny1)
+                if not keep.any():
+                    continue  # skip crop rather than drop all objects
+                new = np.full_like(lab, -1.0)
+                kept = lab[valid][keep]
+                # clip to window and renormalize
+                kept[:, 1] = np.clip((kept[:, 1] - nx0) / (nx1 - nx0), 0, 1)
+                kept[:, 3] = np.clip((kept[:, 3] - nx0) / (nx1 - nx0), 0, 1)
+                kept[:, 2] = np.clip((kept[:, 2] - ny0) / (ny1 - ny0), 0, 1)
+                kept[:, 4] = np.clip((kept[:, 4] - ny0) / (ny1 - ny0), 0, 1)
+                new[:len(kept)] = kept
+                labels[i] = new
+            # crop + resize back (nearest neighbour via index grid)
+            crop = arr[i, :, y0:y0 + ch, x0:x0 + cw]
+            yy = (np.arange(h) * ch / h).astype(int)
+            xx = (np.arange(w) * cw / w).astype(int)
+            arr[i] = crop[:, yy][:, :, xx]
+        return arr, labels
+
+def pack_det_dataset(path_rec, images, classes_list, boxes_list,
+                     quality=95):
+    """Write a detection .rec from in-memory images (HWC uint8) + labels —
+    the test/tooling analog of im2rec's det mode."""
+    from PIL import Image
+    import io as _io
+    rec = recordio.MXRecordIO(path_rec, 'w')
+    for i, (im, cls, boxes) in enumerate(zip(images, classes_list,
+                                             boxes_list)):
+        buf = _io.BytesIO()
+        Image.fromarray(im).save(buf, format='JPEG', quality=quality)
+        header = recordio.IRHeader(0, make_det_label(cls, boxes), i, 0)
+        rec.write(recordio.pack(header, buf.getvalue()))
+    rec.close()

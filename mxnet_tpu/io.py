@@ -454,6 +454,150 @@ class CSVIter(DataIter):
         return self._inner.getpad()
 
 
+def _read_idx(path):
+    """Parse an IDX file (the MNIST container format)."""
+    import gzip
+    import struct
+    op = gzip.open if path.endswith('.gz') else open
+    with op(path, 'rb') as f:
+        raw = f.read()
+    zero, dtype_code, ndim = struct.unpack('>HBB', raw[:4])
+    if zero != 0:
+        raise MXNetError(f"{path}: not an IDX file")
+    dims = struct.unpack('>' + 'I' * ndim, raw[4:4 + 4 * ndim])
+    # IDX is big-endian throughout (including the payload)
+    dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype('>i2'),
+              0x0C: np.dtype('>i4'), 0x0D: np.dtype('>f4'),
+              0x0E: np.dtype('>f8')}
+    return np.frombuffer(raw, dtypes[dtype_code],
+                         offset=4 + 4 * ndim).reshape(dims)
+
+
+class MNISTIter(DataIter):
+    """MNIST IDX-file iterator (reference: src/io/io.cc:259 MNISTIter,
+    src/io/iter_mnist.cc — same params: image/label paths, flat,
+    silent, shuffle, part/num_parts sharding)."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128,
+                 shuffle=True, flat=False, silent=False, seed=0,
+                 part_index=0, num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        imgs = _read_idx(image).astype(np.float32) / 255.0
+        labs = _read_idx(label).astype(np.float32)
+        if num_parts > 1:
+            n = len(imgs) // num_parts
+            imgs = imgs[part_index * n:(part_index + 1) * n]
+            labs = labs[part_index * n:(part_index + 1) * n]
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(len(imgs))
+            imgs, labs = imgs[order], labs[order]
+        imgs = imgs.reshape(len(imgs), -1) if flat else \
+            imgs.reshape(len(imgs), 1, imgs.shape[1], imgs.shape[2])
+        if not silent:
+            import logging
+            logging.info("MNISTIter: loaded %d images shape %s",
+                         len(imgs), imgs.shape[1:])
+        self._inner = NDArrayIter(imgs, labs, batch_size=batch_size,
+                                  shuffle=False)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format iterator (reference: src/io/io.cc:200 LibSVMIter,
+    src/io/iter_libsvm.cc).  Features batch as CSRNDArray (O(nnz)); dense
+    consumers call ``.todense()`` / use ``csr.dot`` directly."""
+
+    @staticmethod
+    def _parse_libsvm(path):
+        labels, rows_data, rows_idx = [], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                idx, vals = [], []
+                for tok in parts[1:]:
+                    k, v = tok.split(':')
+                    idx.append(int(k))
+                    vals.append(float(v))
+                rows_idx.append(np.asarray(idx, np.int64))
+                rows_data.append(np.asarray(vals, np.float32))
+        return labels, rows_data, rows_idx
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 part_index=0, num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        ncol = int(np.prod(data_shape))
+        labels, rows_data, rows_idx = self._parse_libsvm(data_libsvm)
+        if label_libsvm is not None:
+            # separate label file: its first column is the label
+            # (reference: iter_libsvm.cc label_libsvm param)
+            labels, _, _ = self._parse_libsvm(label_libsvm)
+        if num_parts > 1:
+            n = len(labels) // num_parts
+            sl = slice(part_index * n, (part_index + 1) * n)
+            labels, rows_data, rows_idx = \
+                labels[sl], rows_data[sl], rows_idx[sl]
+        self._labels = np.asarray(labels, np.float32)
+        self._rows_data = rows_data
+        self._rows_idx = rows_idx
+        self._ncol = ncol
+        self.batch_size = batch_size
+        self.round_batch = round_batch
+        self.provide_data = [DataDesc('data', (batch_size, ncol))]
+        self.provide_label = [DataDesc('label', (batch_size,))]
+        self._cursor = 0
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from .ndarray.sparse import CSRNDArray
+        n = len(self._labels)
+        if self._cursor >= n:
+            raise StopIteration
+        take = list(range(self._cursor,
+                          min(self._cursor + self.batch_size, n)))
+        short = self.batch_size - len(take)
+        if short:
+            if not self.round_batch:
+                raise StopIteration
+            # wrap around to fill the final batch (reference: round_batch)
+            take += list(range(short))
+        self._cursor += self.batch_size
+        rdat = [self._rows_data[i] for i in take]
+        ridx = [self._rows_idx[i] for i in take]
+        data = np.concatenate(rdat) if any(len(r) for r in rdat) else \
+            np.zeros((0,), np.float32)
+        indices = np.concatenate(ridx) if any(len(r) for r in ridx) else \
+            np.zeros((0,), np.int64)
+        indptr = np.zeros(self.batch_size + 1, np.int64)
+        np.cumsum([len(r) for r in ridx], out=indptr[1:])
+        csr = CSRNDArray(data, indices, indptr,
+                         (self.batch_size, self._ncol))
+        from .ndarray.ndarray import array as nd_array
+        return DataBatch([csr], [nd_array(self._labels[take])],
+                         pad=short,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
 class MXDataIter(DataIter):
     """Placeholder for native-backed iterators; the native RecordIO path
     registers its own iterators in mxnet_tpu.image / mxnet_tpu.recordio."""

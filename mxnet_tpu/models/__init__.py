@@ -34,6 +34,8 @@ from .inception_bn import get_symbol as inception_bn
 from .inception_v3 import get_symbol as inception_v3
 from .mobilenet import get_symbol as mobilenet
 from .squeezenet import get_symbol as squeezenet
+from .ssd import ssd_vgg16, ssd_toy
+from . import ssd as _ssd
 
 _REGISTRY = {
     "mlp": _mlp, "lenet": _lenet, "alexnet": _alexnet, "vgg": _vgg,

@@ -1,0 +1,60 @@
+"""The examples tree runs end-to-end (VERDICT r1 item 7: each example
+drives the public API on the CPU mesh in CI)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BOOT = (
+    "import jax, sys, runpy\n"
+    "from jax._src import xla_bridge as xb\n"
+    "xb._backend_factories.pop('axon', None)\n"
+    "jax.config.update('jax_platforms', 'cpu')\n"
+    "script = sys.argv[1]\n"
+    "sys.argv = sys.argv[1:]\n"
+    "runpy.run_path(script, run_name='__main__')\n"
+)
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _BOOT, os.path.join(ROOT, script)]
+        + list(args),
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
+    return out.stderr + out.stdout
+
+
+def test_train_mnist_example():
+    log = _run("examples/image_classification/train_mnist.py",
+               "--synthetic", "--num-epochs", "2", "--batch-size", "64")
+    assert "Validation-accuracy" in log
+
+
+def test_train_imagenet_example_benchmark():
+    log = _run("examples/image_classification/train_imagenet.py",
+               "--benchmark", "1", "--benchmark-iters", "2",
+               "--batch-size", "4", "--num-layers", "18",
+               "--num-classes", "10", "--num-epochs", "1",
+               "--dtype", "bfloat16")
+    assert "Train-accuracy" in log
+
+
+def test_train_ptb_example():
+    log = _run("examples/rnn/train_ptb.py", "--synthetic",
+               "--num-epochs", "1", "--batch-size", "16",
+               "--num-hidden", "32", "--num-embed", "16",
+               "--buckets", "10,25")
+    assert "Train-perplexity" in log
+
+
+def test_train_ssd_example():
+    log = _run("examples/ssd/train_ssd.py", "--synthetic",
+               "--num-epochs", "1", "--batch-size", "4")
+    assert "loc_loss" in log

@@ -132,3 +132,89 @@ def test_serialization_bfloat16(tmp_path):
     np.testing.assert_allclose(
         out['w'].asnumpy().astype('float32'),
         a.asnumpy().astype('float32'))
+
+
+# ---------------------------------------------------------------------------
+# MNISTIter (reference: src/io/io.cc:259) — parity vs a direct numpy reader
+# ---------------------------------------------------------------------------
+
+def _write_idx_images(path, arr):
+    import struct
+    with open(path, 'wb') as f:
+        f.write(struct.pack('>HBB', 0, 0x08, arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack('>I', d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnist_iter_parity(tmp_path):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (50, 28, 28)).astype(np.uint8)
+    labs = rng.randint(0, 10, (50,)).astype(np.uint8)
+    ip = str(tmp_path / 'imgs-idx3-ubyte')
+    lp = str(tmp_path / 'labs-idx1-ubyte')
+    _write_idx_images(ip, imgs)
+    _write_idx_images(lp, labs)
+    it = mx.io.MNISTIter(image=ip, label=lp, batch_size=10, shuffle=False,
+                         silent=True)
+    got_x, got_y = [], []
+    for b in it:
+        got_x.append(b.data[0].asnumpy())
+        got_y.append(b.label[0].asnumpy())
+    got_x = np.concatenate(got_x)
+    got_y = np.concatenate(got_y)
+    np.testing.assert_allclose(
+        got_x, (imgs.astype(np.float32) / 255.0)[:, None], rtol=1e-6)
+    np.testing.assert_array_equal(got_y, labs.astype(np.float32))
+    # flat mode
+    it = mx.io.MNISTIter(image=ip, label=lp, batch_size=10, shuffle=False,
+                         flat=True, silent=True)
+    b = next(iter(it))
+    assert b.data[0].shape == (10, 784)
+
+
+def test_mnist_iter_sharding(tmp_path):
+    imgs = np.arange(40 * 4 * 4, dtype=np.uint8).reshape(40, 4, 4) % 251
+    labs = (np.arange(40) % 10).astype(np.uint8)
+    ip = str(tmp_path / 'i-idx3')
+    lp = str(tmp_path / 'l-idx1')
+    _write_idx_images(ip, imgs)
+    _write_idx_images(lp, labs)
+    part = mx.io.MNISTIter(image=ip, label=lp, batch_size=5, shuffle=False,
+                           silent=True, part_index=1, num_parts=2)
+    ys = np.concatenate([b.label[0].asnumpy() for b in part])
+    np.testing.assert_array_equal(ys, labs[20:].astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# LibSVMIter (reference: src/io/io.cc:200) — parity vs a numpy parser
+# ---------------------------------------------------------------------------
+
+def test_libsvm_iter_parity(tmp_path):
+    rng = np.random.RandomState(1)
+    n, ncol = 20, 30
+    dense = np.zeros((n, ncol), np.float32)
+    labels = rng.randint(0, 2, (n,)).astype(np.float32)
+    lines = []
+    for i in range(n):
+        nnz = rng.randint(1, 6)
+        cols = sorted(rng.choice(ncol, nnz, replace=False))
+        toks = []
+        for c in cols:
+            v = round(float(rng.uniform(-2, 2)), 4)
+            dense[i, c] = v
+            toks.append('%d:%s' % (c, v))
+        lines.append('%g %s' % (labels[i], ' '.join(toks)))
+    p = str(tmp_path / 'data.libsvm')
+    with open(p, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(ncol,), batch_size=5)
+    got_rows, got_labels = [], []
+    for b in it:
+        csr = b.data[0]
+        assert csr.stype == 'csr'
+        got_rows.append(csr.todense().asnumpy())
+        got_labels.append(b.label[0].asnumpy())
+    np.testing.assert_allclose(np.concatenate(got_rows), dense, rtol=1e-5)
+    np.testing.assert_array_equal(np.concatenate(got_labels), labels)
