@@ -9,7 +9,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, timeout=420):
+def _launch(n, script, *args, timeout=420):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # each worker is a fresh process: keep it off the single-client TPU
@@ -17,7 +17,8 @@ def _launch(n, script, timeout=420):
     env.pop("XLA_FLAGS", None)
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
-         "-n", str(n), sys.executable, os.path.join(ROOT, script)],
+         "-n", str(n), sys.executable, os.path.join(ROOT, script)]
+        + list(args),
         env=env, capture_output=True, text=True, timeout=timeout,
         cwd=ROOT)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
@@ -65,3 +66,10 @@ def test_launcher_fail_fast():
         env=env, capture_output=True, text=True, timeout=60, cwd=ROOT)
     assert out.returncode == 3, (out.returncode, out.stderr[-500:])
     assert time.time() - t0 < 30
+
+
+def test_dist_sharded_checkpoint_2_workers(tmp_path):
+    stdout = _launch(2, "tests/dist/dist_sharded_checkpoint.py",
+                     str(tmp_path), timeout=300)
+    for r in range(2):
+        assert "rank %d/2 OK" % r in stdout
