@@ -87,18 +87,24 @@ def save_params_sharded(prefix: str, params: Dict[str, NDArray]) -> None:
         with open(f"{prefix}.index.tmp", "w") as f:
             json.dump({"nprocs": jax.process_count(), "params": index}, f)
         os.replace(f"{prefix}.index.tmp", f"{prefix}.index")
+    if jax.process_count() > 1:
+        # read-after-save: no rank returns before the index is visible
+        from . import distributed as _dist
+        _dist.barrier("mxnet_tpu_checkpoint_index")
 
 
 def load_params_sharded(prefix: str) -> Dict[str, NDArray]:
     """Assemble global arrays from all shard files."""
+    import ml_dtypes  # jax hard-dependency; gives numpy a bfloat16 dtype
+
+    def _npdt(name):
+        return np.dtype(ml_dtypes.bfloat16) if "bfloat16" in name \
+            else np.dtype(name)
+
     with open(f"{prefix}.index") as f:
         index = json.load(f)
-    out_np = {}
-    for name, meta in index["params"].items():
-        out_np[name] = np.zeros(meta["shape"], np.dtype(
-            meta["dtype"].replace("bfloat16", "float32")))
-    bf16 = {name for name, meta in index["params"].items()
-            if "bfloat16" in meta["dtype"]}
+    out_np = {name: np.zeros(meta["shape"], _npdt(meta["dtype"]))
+              for name, meta in index["params"].items()}
     for r in range(index["nprocs"]):
         path = f"{prefix}.shard{r}"
         if not os.path.exists(path):
@@ -110,26 +116,13 @@ def load_params_sharded(prefix: str) -> Dict[str, NDArray]:
             header = json.loads(f.read(hlen).decode())
             blob = f.read()
         for ent in header:
-            dt = ent["dtype"]
-            npdt = np.dtype(dt) if "bfloat16" not in dt else np.dtype("V2")
             shape = [b - a for a, b in ent["index"]]
             count = int(np.prod(shape)) if shape else 1
-            block = np.frombuffer(blob, npdt, count=count,
+            block = np.frombuffer(blob, _npdt(ent["dtype"]), count=count,
                                   offset=ent["offset"]).reshape(shape)
-            if "bfloat16" in dt:
-                block = np.asarray(
-                    block.view(np.uint16).astype(np.uint32) << 16
-                ).view(np.float32)
             sl = tuple(slice(a, b) for a, b in ent["index"])
             out_np[ent["name"]][sl] = block
-    out = {}
-    for name, a in out_np.items():
-        if name in bf16:
-            import jax.numpy as jnp
-            out[name] = NDArray(a, dtype=jnp.bfloat16)
-        else:
-            out[name] = NDArray(a)
-    return out
+    return {name: NDArray(a) for name, a in out_np.items()}
 
 
 def save_checkpoint_sharded(prefix: str, epoch: int, symbol, arg_params,

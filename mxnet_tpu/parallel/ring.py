@@ -77,7 +77,12 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
         acc = jnp.zeros(q.shape, jnp.float32)
         # accumulators are per-shard state: mark them device-varying on
         # every sharded axis so the fori carry types stay consistent
-        m, l, acc = (lax.pvary(x, spec_axes) for x in (m, l, acc))
+        _pcast = getattr(lax, "pcast", None)
+        if _pcast is not None:
+            m, l, acc = (_pcast(x, spec_axes, to="varying")
+                         for x in (m, l, acc))
+        else:  # older jax
+            m, l, acc = (lax.pvary(x, spec_axes) for x in (m, l, acc))
 
         def step(s, carry):
             k_cur, v_cur, m, l, acc = carry
