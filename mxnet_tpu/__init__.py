@@ -58,5 +58,7 @@ from . import models
 from . import parallel
 from . import gluon
 from . import rnn
+from . import contrib
+from . import rtc
 
 from .ndarray import NDArray

@@ -1,0 +1,3 @@
+"""Contrib: experimental / bridge modules (reference: python/mxnet/contrib)."""
+from . import tensorboard
+from . import torch

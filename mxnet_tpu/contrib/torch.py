@@ -1,0 +1,103 @@
+"""Torch bridge (reference: plugin/torch + python/mxnet/torch.py).
+
+The reference bridged Torch7 modules/criterions through a C glue layer so
+MXNet users could run torch layers inline.  The TPU-native analog bridges
+PyTorch (CPU) through numpy/dlpack: ``torch_function`` wraps any torch
+callable as an NDArray op, and ``TorchLoss`` exposes a torch criterion
+with autograd integration via the framework's CustomOp machinery
+(ops/custom.py jax.pure_callback + custom_vjp), so torch computations
+slot into recorded graphs and fused executors alike.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.ndarray import array as nd_array
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("contrib.torch requires pytorch") from e
+
+
+def torch_function(fn, *args, **kwargs):
+    """Apply a torch callable to NDArray inputs eagerly; returns
+    NDArray(s).  (reference: mxnet.th function dispatch)."""
+    torch = _torch()
+    t_args = [torch.from_numpy(np.array(a.asnumpy()))
+              if isinstance(a, NDArray) else a for a in args]
+    out = fn(*t_args, **kwargs)
+    if isinstance(out, (tuple, list)):
+        return [nd_array(o.detach().numpy()) for o in out]
+    return nd_array(out.detach().numpy())
+
+
+class TorchLoss:
+    """A torch criterion as a differentiable framework op.
+
+    ``loss = TorchLoss(torch.nn.functional.mse_loss)(pred, target)``
+    works under autograd.record(): backward runs torch autograd on host
+    (jax.pure_callback) and feeds the gradient into the XLA graph.
+    """
+
+    def __init__(self, criterion, **kwargs):
+        self._criterion = criterion
+        self._kwargs = kwargs
+
+    def __call__(self, pred, target):
+        torch = _torch()
+        import jax
+        import jax.numpy as jnp
+        crit, kw = self._criterion, self._kwargs
+
+        # result aval from a dry run of the criterion on zeros (host math
+        # runs in f32; outputs/grads cast back to the primal dtype so
+        # bf16 compute and reduction='none' both work)
+        probe = crit(torch.zeros(tuple(pred.shape)),
+                     torch.zeros(tuple(target.shape)), **kw)
+        out_shape = tuple(probe.shape)
+        p_dtype = jnp.dtype(pred.dtype)
+
+        def host_fwd(p, t):
+            tp = torch.from_numpy(np.array(p, np.float32))
+            tt = torch.from_numpy(np.array(t, np.float32))
+            return np.asarray(crit(tp, tt, **kw).detach().numpy(),
+                              np.float32)
+
+        def host_grad(p, t, g):
+            tp = torch.from_numpy(np.array(p, np.float32))
+            tp.requires_grad_(True)
+            tt = torch.from_numpy(np.array(t, np.float32))
+            out = crit(tp, tt, **kw)
+            out.backward(torch.from_numpy(np.array(g, np.float32)))
+            return np.asarray(tp.grad.numpy(), np.float32)
+
+        @jax.custom_vjp
+        def op(p, t):
+            r = jax.pure_callback(
+                host_fwd, jax.ShapeDtypeStruct(out_shape, jnp.float32),
+                p.astype(jnp.float32), t.astype(jnp.float32))
+            return r.astype(p_dtype)
+
+        def op_fwd(p, t):
+            return op(p, t), (p, t)
+
+        def op_bwd(res, g):
+            p, t = res
+            dp = jax.pure_callback(
+                host_grad,
+                jax.ShapeDtypeStruct(tuple(p.shape), jnp.float32),
+                p.astype(jnp.float32), t.astype(jnp.float32),
+                g.astype(jnp.float32))
+            return dp.astype(p.dtype), jnp.zeros_like(t)
+
+        op.defvjp(op_fwd, op_bwd)
+
+        from ..ndarray.ndarray import _invoke_fn
+        return _invoke_fn(lambda p, t: op(p, t),
+                          [pred, target], {})

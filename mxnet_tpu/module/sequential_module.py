@@ -82,17 +82,29 @@ class SequentialModule(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        if arg_params is not None and not allow_missing:
-            # each sub-module only sees its own subset, so missing-name
-            # enforcement must happen here across the union
-            wanted = set()
-            for m in self._modules:
-                wanted.update(getattr(m, '_param_names', []))
-            missing = sorted(wanted - set(arg_params))
+        # each sub-module only sees its own subset, so missing/extra-name
+        # enforcement must happen here across the union
+        wanted = set()
+        wanted_aux = set()
+        for m in self._modules:
+            wanted.update(getattr(m, '_param_names', []))
+            wanted_aux.update(getattr(m, '_aux_names', []))
+        if not allow_missing:
+            missing = sorted(wanted - set(arg_params)) \
+                if arg_params is not None else []
+            missing += sorted(wanted_aux - set(aux_params)) \
+                if aux_params is not None else []
             if missing:
                 raise MXNetError(
-                    f"init_params: arg_params missing {missing} "
+                    f"init_params: provided params missing {missing} "
                     f"(pass allow_missing=True to random-init them)")
+        if not allow_extra:
+            extra = sorted(set(arg_params or {}) - wanted) + \
+                sorted(set(aux_params or {}) - wanted_aux)
+            if extra:
+                raise MXNetError(
+                    f"init_params: provided params contain unknown names "
+                    f"{extra} (pass allow_extra=True to ignore them)")
         for m in self._modules:
             m.init_params(initializer=initializer, arg_params=arg_params,
                           aux_params=aux_params,

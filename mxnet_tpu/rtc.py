@@ -1,0 +1,51 @@
+"""Runtime kernel compilation — the Pallas-backed ``mx.rtc`` analog.
+
+The reference's ``mx.rtc`` compiles user CUDA source with NVRTC at
+runtime (src/common/rtc.cc:35-67, python/mxnet/rtc.py).  CUDA source has
+no meaning on TPU; the capability — "write a custom kernel at runtime and
+call it on NDArrays" — maps to Pallas (docs/design/scope.md).  ``CudaModule``
+therefore raises with migration guidance, and :class:`PallasKernel` is
+the supported path: wrap a Pallas kernel function and call it on
+NDArrays, with the same "runtime-compiled device kernel" ergonomics.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray.ndarray import array as nd_array
+
+
+class CudaModule:
+    """reference: rtc.py CudaModule (NVRTC). Unsupported on TPU."""
+
+    def __init__(self, *a, **kw):
+        raise MXNetError(
+            "mx.rtc compiles CUDA source — not available on TPU. Port the "
+            "kernel to Pallas and wrap it with mx.rtc.PallasKernel (see "
+            "mxnet_tpu/ops/attention.py for a full example, "
+            "docs/design/scope.md for the decision)")
+
+
+CudaKernel = CudaModule  # same guidance for the old entry point
+
+
+class PallasKernel:
+    """Wrap a ``pallas_call``-based function as an NDArray op.
+
+    ``fn(*jax_arrays, **attrs) -> jax array(s)`` — typically a closure
+    over ``pl.pallas_call``.  The wrapper handles NDArray <-> jax.Array
+    conversion and (like every registered op) records on the autograd
+    tape, so kernels with a ``jax.custom_vjp`` are trainable.
+    """
+
+    def __init__(self, fn, name=None):
+        if not callable(fn):
+            raise MXNetError("PallasKernel: fn must be callable")
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "pallas_kernel")
+
+    def __call__(self, *args, **attrs):
+        from .ndarray.ndarray import _invoke_fn
+        inputs = [a if isinstance(a, NDArray) else nd_array(a)
+                  for a in args]
+        return _invoke_fn(self._fn, inputs, attrs)

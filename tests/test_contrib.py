@@ -1,0 +1,117 @@
+"""contrib + rtc tests (reference: python/mxnet/contrib/tensorboard.py,
+plugin/torch, python/mxnet/rtc.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _read_tfrecords(path):
+    """Parse TFRecord framing, verifying the masked CRCs."""
+    from mxnet_tpu.contrib.tensorboard import _masked_crc
+    out = []
+    with open(path, 'rb') as f:
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                break
+            (ln,) = struct.unpack('<Q', hdr)
+            (hcrc,) = struct.unpack('<I', f.read(4))
+            assert hcrc == _masked_crc(hdr)
+            payload = f.read(ln)
+            (pcrc,) = struct.unpack('<I', f.read(4))
+            assert pcrc == _masked_crc(payload)
+            out.append(payload)
+    return out
+
+
+def test_tensorboard_scalar_events(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import SummaryWriter
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalar('loss', 1.5, 1)
+    w.add_scalar('loss', 0.5, 2)
+    w.close()
+    files = os.listdir(str(tmp_path))
+    assert any(f.startswith('events.out.tfevents') for f in files)
+    recs = _read_tfrecords(os.path.join(str(tmp_path), files[0]))
+    # file_version + 2 scalar events, CRCs all verified by the parser
+    assert len(recs) == 3
+    assert b'brain.Event:2' in recs[0]
+    assert b'loss' in recs[1]
+    # float 1.5 little-endian appears in the first scalar event
+    assert struct.pack('<f', 1.5) in recs[1]
+    assert struct.pack('<f', 0.5) in recs[2]
+
+
+def test_tensorboard_metrics_callback(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    import mxnet_tpu.callback  # BatchEndParam lives with callbacks
+    cb = LogMetricsCallback(str(tmp_path), prefix='train')
+    metric = mx.metric.Accuracy()
+    metric.update([nd.array([0.0, 1.0])],
+                  [nd.array([[0.9, 0.1], [0.2, 0.8]])])
+
+    class P:
+        eval_metric = metric
+    cb(P())
+    cb.summary_writer.close()
+    files = [f for f in os.listdir(str(tmp_path))]
+    recs = _read_tfrecords(os.path.join(str(tmp_path), files[0]))
+    assert any(b'train-accuracy' in r for r in recs)
+
+
+def test_torch_function_bridge():
+    import torch
+    from mxnet_tpu.contrib.torch import torch_function
+    a = nd.array(np.array([[1.0, -2.0], [3.0, -4.0]], 'f'))
+    out = torch_function(torch.abs, a)
+    np.testing.assert_array_equal(out.asnumpy(), np.abs(a.asnumpy()))
+    outs = torch_function(torch.sort, a)
+    np.testing.assert_array_equal(outs[0].asnumpy(),
+                                  np.sort(a.asnumpy()))
+
+
+def test_torch_loss_autograd():
+    import torch.nn.functional as F
+    from mxnet_tpu.contrib.torch import TorchLoss
+    pred = nd.array(np.array([1.0, 2.0, 3.0], 'f'))
+    target = nd.array(np.array([0.0, 0.0, 0.0], 'f'))
+    pred.attach_grad()
+    loss_fn = TorchLoss(F.mse_loss)
+    with autograd.record():
+        loss = loss_fn(pred, target)
+    loss.backward()
+    np.testing.assert_allclose(float(loss.asnumpy()),
+                               np.mean([1, 4, 9]), rtol=1e-5)
+    # d/dp mean((p-t)^2) = 2(p-t)/n
+    np.testing.assert_allclose(pred.grad.asnumpy(),
+                               2 * np.array([1, 2, 3]) / 3, rtol=1e-5)
+
+
+def test_rtc_cuda_module_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def test_rtc_pallas_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    def doubler(x):
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=jax.default_backend() != 'tpu')(x)
+
+    k = mx.rtc.PallasKernel(doubler)
+    a = nd.array(np.arange(8, dtype='f').reshape(2, 4))
+    out = k(a)
+    np.testing.assert_array_equal(out.asnumpy(), 2 * a.asnumpy())
