@@ -44,6 +44,8 @@ def _masked_crc(data: bytes) -> int:
 
 # -- minimal protobuf wire encoding ---------------------------------------
 def _varint(n: int) -> bytes:
+    # negative int64 → two's-complement ten-byte encoding (protobuf wire)
+    n &= 0xFFFFFFFFFFFFFFFF
     out = b""
     while True:
         b7 = n & 0x7F

@@ -48,31 +48,45 @@ class TorchLoss:
     def __init__(self, criterion, **kwargs):
         self._criterion = criterion
         self._kwargs = kwargs
+        self._op_cache = {}   # (pred sig, target sig) -> custom_vjp op
 
-    def __call__(self, pred, target):
+    @staticmethod
+    def _t_dtype(np_dtype):
+        """Torch dtype preserving float-vs-integer class (integer targets
+        reach the criterion as int64, as torch losses expect)."""
+        import numpy as _np
+        torch = _torch()
+        if _np.issubdtype(_np.dtype(str(np_dtype).replace('bfloat16',
+                                                          'float32')),
+                          _np.floating):
+            return torch.float32
+        return torch.int64
+
+    def _build_op(self, p_shape, p_dtype, t_shape, t_dtype):
         torch = _torch()
         import jax
         import jax.numpy as jnp
         crit, kw = self._criterion, self._kwargs
+        t_torch_dtype = self._t_dtype(t_dtype)
+        t_np_dtype = np.float32 if t_torch_dtype is torch.float32 \
+            else np.int64
 
-        # result aval from a dry run of the criterion on zeros (host math
-        # runs in f32; outputs/grads cast back to the primal dtype so
-        # bf16 compute and reduction='none' both work)
-        probe = crit(torch.zeros(tuple(pred.shape)),
-                     torch.zeros(tuple(target.shape)), **kw)
+        # result aval from ONE dry run of the criterion on zeros
+        probe = crit(torch.zeros(tuple(p_shape)),
+                     torch.zeros(tuple(t_shape), dtype=t_torch_dtype),
+                     **kw)
         out_shape = tuple(probe.shape)
-        p_dtype = jnp.dtype(pred.dtype)
 
         def host_fwd(p, t):
             tp = torch.from_numpy(np.array(p, np.float32))
-            tt = torch.from_numpy(np.array(t, np.float32))
+            tt = torch.from_numpy(np.array(t, t_np_dtype))
             return np.asarray(crit(tp, tt, **kw).detach().numpy(),
                               np.float32)
 
         def host_grad(p, t, g):
             tp = torch.from_numpy(np.array(p, np.float32))
             tp.requires_grad_(True)
-            tt = torch.from_numpy(np.array(t, np.float32))
+            tt = torch.from_numpy(np.array(t, t_np_dtype))
             out = crit(tp, tt, **kw)
             out.backward(torch.from_numpy(np.array(g, np.float32)))
             return np.asarray(tp.grad.numpy(), np.float32)
@@ -81,8 +95,8 @@ class TorchLoss:
         def op(p, t):
             r = jax.pure_callback(
                 host_fwd, jax.ShapeDtypeStruct(out_shape, jnp.float32),
-                p.astype(jnp.float32), t.astype(jnp.float32))
-            return r.astype(p_dtype)
+                p.astype(jnp.float32), t.astype(t_np_dtype))
+            return r.astype(jnp.dtype(p_dtype))
 
         def op_fwd(p, t):
             return op(p, t), (p, t)
@@ -92,12 +106,19 @@ class TorchLoss:
             dp = jax.pure_callback(
                 host_grad,
                 jax.ShapeDtypeStruct(tuple(p.shape), jnp.float32),
-                p.astype(jnp.float32), t.astype(jnp.float32),
+                p.astype(jnp.float32), t.astype(t_np_dtype),
                 g.astype(jnp.float32))
             return dp.astype(p.dtype), jnp.zeros_like(t)
 
         op.defvjp(op_fwd, op_bwd)
+        return op
 
+    def __call__(self, pred, target):
+        sig = (tuple(pred.shape), str(pred.dtype),
+               tuple(target.shape), str(target.dtype))
+        op = self._op_cache.get(sig)
+        if op is None:
+            op = self._op_cache[sig] = self._build_op(
+                pred.shape, pred.dtype, target.shape, target.dtype)
         from ..ndarray.ndarray import _invoke_fn
-        return _invoke_fn(lambda p, t: op(p, t),
-                          [pred, target], {})
+        return _invoke_fn(lambda p, t: op(p, t), [pred, target], {})

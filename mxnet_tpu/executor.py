@@ -46,6 +46,20 @@ AMP_FP32_OPS = frozenset({
 })
 
 
+def maybe_mirror(run):
+    """Wrap an interpreter in jax.checkpoint when
+    MXNET_BACKWARD_DO_MIRROR is set (reference: graph_executor.cc:281
+    mirror-recompute): activations are rematerialized in backward, trading
+    FLOPs for HBM.  Returns a function with the same
+    (args, aux, key, is_train) signature; remat always traces train mode
+    (the only mode with a backward)."""
+    from .base import env as _env
+    if not _env("MXNET_BACKWARD_DO_MIRROR", False):
+        return run
+    remat = jax.checkpoint(lambda av, aux, k: run(av, aux, k, True))
+    return lambda av, aux, k, _t: remat(av, aux, k)
+
+
 def build_interpreter(sym: Symbol, compute_dtype=None):
     """Build ``run(arg_vals, aux_vals, key, is_train) -> (outs, new_aux)``.
 
@@ -392,7 +406,7 @@ class Executor:
     def _fused_fwd_bwd(self, arg_vals, aux_vals, key, cotangents,
                        grad_mask=None):
         """One XLA program: forward + vjp backward (+ aux updates)."""
-        run = self._run
+        run = maybe_mirror(self._run)
 
         def f(av):
             outs, new_aux = run(av, aux_vals, key, True)

@@ -207,10 +207,11 @@ class _CachedGraph:
     jit-compiled interpreter, keyed by input signature
     (reference: cached_op.cc GetForwardGraph :175 per-config caching)."""
 
-    def __init__(self, sym, data_names, param_names):
+    def __init__(self, sym, data_names, param_names, compute_dtype=None):
         from ..executor import build_interpreter
         self.sym = sym
-        run, arg_names, aux_names = build_interpreter(sym)
+        run, arg_names, aux_names = build_interpreter(
+            sym, compute_dtype=compute_dtype)
         self.run = run
         self.arg_names = arg_names
         self.aux_names = aux_names
@@ -334,8 +335,13 @@ class HybridBlock(Block):
         cg = self._cached_graphs.get(sig)
         if cg is None:
             sym, data_names = self._trace_symbol(len(args))
+            # hybridize(compute_dtype=jnp.bfloat16) → mixed-precision
+            # cached program (executor.AMP_FP32_OPS policy), the gluon
+            # analog of Module(compute_dtype=...)
             cg = _CachedGraph(sym, data_names,
-                              [p.name for p in params.values()])
+                              [p.name for p in params.values()],
+                              compute_dtype=self._flags.get(
+                                  'compute_dtype'))
             self._cached_graphs[sig] = cg
         # finish deferred param init from the traced graph's shapes
         # (reference: _build_cache → infer_shape → _finish_deferred_init)

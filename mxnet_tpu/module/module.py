@@ -500,6 +500,9 @@ class Module(BaseModule):
                                        self._opt_states[n])
                   for n in names]
 
+        from ..executor import maybe_mirror
+        run_fwd = maybe_mirror(run)
+
         def step(pvals, io_vals, aux_vals, key, states, lrs, wds, t):
             def f(pv):
                 av = [None] * len(arg_names)
@@ -507,7 +510,7 @@ class Module(BaseModule):
                     av[i] = v
                 for i, v in zip(io_idx, io_vals):
                     av[i] = v
-                outs, new_aux = run(tuple(av), aux_vals, key, True)
+                outs, new_aux = run_fwd(tuple(av), aux_vals, key, True)
                 diff = tuple(o for o in outs
                              if jnp.issubdtype(o.dtype, jnp.inexact))
                 return diff, (outs, new_aux)

@@ -115,3 +115,31 @@ def test_rtc_pallas_kernel():
     a = nd.array(np.arange(8, dtype='f').reshape(2, 4))
     out = k(a)
     np.testing.assert_array_equal(out.asnumpy(), 2 * a.asnumpy())
+
+
+def test_torch_loss_integer_targets():
+    """Class-index criteria (cross_entropy) get int64 targets."""
+    import torch.nn.functional as F
+    from mxnet_tpu.contrib.torch import TorchLoss
+    pred = nd.array(np.array([[2.0, 0.0, 0.0], [0.0, 2.0, 0.0]], 'f'))
+    target = nd.array(np.array([0, 1], np.int64))
+    pred.attach_grad()
+    loss_fn = TorchLoss(F.cross_entropy)
+    with autograd.record():
+        loss = loss_fn(pred, target)
+    loss.backward()
+    import torch
+    ref = F.cross_entropy(torch.tensor(pred.asnumpy()),
+                          torch.tensor([0, 1])).item()
+    np.testing.assert_allclose(float(loss.asnumpy()), ref, rtol=1e-5)
+    assert abs(pred.grad.asnumpy()).sum() > 0
+    # memoized: second call reuses the cached op
+    assert len(loss_fn._op_cache) == 1
+    loss_fn(pred, target)
+    assert len(loss_fn._op_cache) == 1
+
+
+def test_tensorboard_negative_step():
+    from mxnet_tpu.contrib.tensorboard import _varint
+    assert _varint(-1) == b'\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01'
+    assert _varint(300) == b'\xac\x02'

@@ -265,3 +265,33 @@ def test_split_and_load_and_clip():
     assert abs(total - 10.0) < 1e-4
     new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrs))
     np.testing.assert_allclose(new_norm, 1.0, rtol=1e-4)
+
+
+def test_hybridize_compute_dtype_bf16():
+    """hybridize(compute_dtype=bfloat16): mixed-precision cached program
+    trains with fp32 master params (gluon analog of Module
+    compute_dtype)."""
+    import jax.numpy as jnp
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation='relu'), nn.Dense(2))
+    net.initialize()
+    net.hybridize(compute_dtype=jnp.bfloat16)
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.5})
+    X = mx.nd.array(np.random.RandomState(0).randn(64, 2).astype('f'))
+    Y = mx.nd.array(((X.asnumpy()[:, 0] > 0) ^
+                     (X.asnumpy()[:, 1] > 0)).astype('f'))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(120):
+        with autograd.record():
+            out = net(X)
+            loss = loss_fn(out, Y).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    # params stay fp32; training converges
+    for p in net.collect_params().values():
+        assert p.data().asnumpy().dtype == np.float32
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])

@@ -379,3 +379,32 @@ def test_executor_monitor_with_fused_training():
     mod.update()
     stats = mon.toc()
     assert any('fc1' in name for _, name, _ in stats), stats
+
+
+def test_backward_do_mirror_same_numerics():
+    """MXNET_BACKWARD_DO_MIRROR (activation remat via jax.checkpoint)
+    must not change training numerics (reference: graph_executor.cc:281)."""
+    X, Y = _xor_data(80)
+
+    def run(mirror):
+        if mirror:
+            os.environ['MXNET_BACKWARD_DO_MIRROR'] = '1'
+        try:
+            mx.random.seed(5)
+            train = mx.io.NDArrayIter(X, Y, batch_size=40)
+            mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+            mod.bind(data_shapes=train.provide_data,
+                     label_shapes=train.provide_label)
+            mod.init_params(initializer=mx.initializer.Xavier())
+            mod.init_optimizer(optimizer='sgd',
+                               optimizer_params={'learning_rate': 0.1,
+                                                 'momentum': 0.9})
+            batch = next(iter(train))
+            for _ in range(3):
+                mod.forward(batch, is_train=True)
+                mod.update()
+            return mod.get_params()[0]['fc1_weight'].asnumpy()
+        finally:
+            os.environ.pop('MXNET_BACKWARD_DO_MIRROR', None)
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6, atol=1e-7)
