@@ -22,3 +22,4 @@ from . import detection     # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn           # noqa: F401
 from . import linalg        # noqa: F401
+from . import moe           # noqa: F401

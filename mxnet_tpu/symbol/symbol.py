@@ -446,6 +446,9 @@ class Symbol:
             f.write(self.tojson())
 
     # -- arithmetic ---------------------------------------------------------
+    def __abs__(self):
+        return _compose("abs", [self], {}, None)
+
     def _binop(self, other, op, scalar_op, rop=False):
         if isinstance(other, Symbol):
             a, b = (other, self) if rop else (self, other)

@@ -1,0 +1,96 @@
+"""Cross-dtype consistency matrix (reference: tests/python/gpu/
+test_operator_gpu.py — runs every op symbol across (ctx, dtype) configs
+and cross-asserts via test_utils.check_consistency:1203).
+
+No GPU exists here; the matrix dimension that matters on TPU is DTYPE:
+fp64 (reference oracle) vs fp32 vs fp16/bf16 compute must agree within
+per-dtype tolerances on representative compound symbols.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _conv_net():
+    data = sym.Variable('data')
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name='conv1')
+    net = sym.BatchNorm(net, name='bn1', fix_gamma=False)
+    net = sym.Activation(net, act_type='relu')
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=4, name='fc')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def _mlp_net():
+    net = sym.FullyConnected(sym.Variable('data'), num_hidden=16,
+                             name='fc1')
+    net = sym.Activation(net, act_type='tanh')
+    net = sym.FullyConnected(net, num_hidden=3, name='fc2')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def test_consistency_mlp_dtypes():
+    ctx_list = [
+        {'ctx': mx.cpu(), 'data': (4, 10), 'type_dict':
+            {'data': np.float64}},
+        {'ctx': mx.cpu(), 'data': (4, 10), 'type_dict':
+            {'data': np.float32}},
+        {'ctx': mx.cpu(), 'data': (4, 10), 'type_dict':
+            {'data': np.float16}},
+    ]
+    check_consistency(_mlp_net(), ctx_list)
+
+
+def test_consistency_conv_net_dtypes():
+    ctx_list = [
+        {'ctx': mx.cpu(), 'data': (2, 3, 8, 8), 'type_dict':
+            {'data': np.float64}},
+        {'ctx': mx.cpu(), 'data': (2, 3, 8, 8), 'type_dict':
+            {'data': np.float32}},
+    ]
+    check_consistency(_conv_net(), ctx_list)
+
+
+def test_consistency_elemwise_chain():
+    net = sym.Variable('data')
+    net = sym.exp(sym.tanh(net)) * sym.sigmoid(net) + sym.sqrt(abs(net)
+                                                               + 1.0)
+    ctx_list = [
+        {'ctx': mx.cpu(), 'data': (5, 7), 'type_dict':
+            {'data': np.float64}},
+        {'ctx': mx.cpu(), 'data': (5, 7), 'type_dict':
+            {'data': np.float32}},
+        {'ctx': mx.cpu(), 'data': (5, 7), 'type_dict':
+            {'data': np.float16}},
+    ]
+    check_consistency(net, ctx_list)
+
+
+def test_bf16_compute_matches_fp32_forward():
+    """compute_dtype=bf16 inference stays within bf16 tolerance of fp32
+    on a conv net (the AMP policy keeps norm/loss ops exact)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.executor import Executor
+    net = _conv_net()
+    rng = np.random.RandomState(0)
+    shapes = {'data': (2, 3, 8, 8), 'softmax_label': (2,)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype('f'))
+            for n, s in zip(net.list_arguments(), arg_shapes)}
+    aux = {n: (mx.nd.zeros(s) if 'mean' in n else mx.nd.ones(s))
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    outs = {}
+    for cd in (None, jnp.bfloat16):
+        ex = Executor(net, args={k: mx.nd.array(v.asnumpy())
+                                 for k, v in args.items()},
+                      aux_states={k: mx.nd.array(v.asnumpy())
+                                  for k, v in aux.items()},
+                      grad_req='null', compute_dtype=cd)
+        outs[cd] = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(outs[jnp.bfloat16], outs[None],
+                               rtol=5e-2, atol=5e-2)

@@ -1,0 +1,166 @@
+"""Expert (ep) and pipeline (pp) parallelism tests — the two mesh axes
+beyond dp/tp/sp (reference has neither; SURVEY.md §2.5 'new capabilities
+to add natively').
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.ops.moe import moe_ffn
+
+RNG = np.random.RandomState(0)
+
+
+def _moe_params(E, d, f):
+    return (jnp.asarray(RNG.randn(d, E).astype('f') * 0.1),
+            jnp.asarray(RNG.randn(E, d, f).astype('f') * 0.1),
+            jnp.zeros((E, f), jnp.float32),
+            jnp.asarray(RNG.randn(E, f, d).astype('f') * 0.1),
+            jnp.zeros((E, d), jnp.float32))
+
+
+def _moe_dense_reference(x, gate_w, w1, b1, w2, b2, k):
+    """Oracle: per-token loop over its top-k experts (no capacity)."""
+    T, d = x.shape
+    E = gate_w.shape[1]
+    logits = np.asarray(x) @ np.asarray(gate_w)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.zeros((T, d), np.float32)
+    for t in range(T):
+        top = np.argsort(-probs[t])[:k]
+        gsum = probs[t][top].sum()
+        for e in top:
+            h = np.maximum(np.asarray(x)[t] @ np.asarray(w1)[e]
+                           + np.asarray(b1)[e], 0)
+            out[t] += (probs[t][e] / gsum) * \
+                (h @ np.asarray(w2)[e] + np.asarray(b2)[e])
+    return out
+
+
+@pytest.mark.parametrize('k', [1, 2])
+def test_moe_matches_dense_reference(k):
+    E, d, f, T = 4, 8, 16, 12
+    gate_w, w1, b1, w2, b2 = _moe_params(E, d, f)
+    x = jnp.asarray(RNG.randn(T, d).astype('f'))
+    # ample capacity: no token drops, so the oracle matches exactly
+    out = moe_ffn(x, gate_w, w1, b1, w2, b2, E, k=k, capacity_factor=8.0)
+    ref = _moe_dense_reference(x, gate_w, w1, b1, w2, b2, k)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_expert_parallel_sharded():
+    """Expert weights sharded over ep: same numerics, compiled SPMD."""
+    E, d, f, T = 4, 8, 16, 32
+    gate_w, w1, b1, w2, b2 = _moe_params(E, d, f)
+    x = jnp.asarray(RNG.randn(T, d).astype('f'))
+    dense = moe_ffn(x, gate_w, w1, b1, w2, b2, E, k=1,
+                    capacity_factor=8.0)
+    mesh = parallel.make_mesh(ep=4, devices=jax.devices()[:4])
+    shard = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
+    w1s = shard(w1, P('ep', None, None))
+    b1s = shard(b1, P('ep', None))
+    w2s = shard(w2, P('ep', None, None))
+    b2s = shard(b2, P('ep', None))
+    xs = shard(x, P())
+    with mesh:
+        out = jax.jit(lambda *a: moe_ffn(*a, E, 1, 8.0, 'relu'))(
+            xs, shard(gate_w, P()), w1s, b1s, w2s, b2s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_op_and_grad():
+    """Registered op path + tape gradient through gating and experts."""
+    E, d, f = 2, 4, 8
+    gate_w, w1, b1, w2, b2 = _moe_params(E, d, f)
+    arrs = [nd.array(np.asarray(a)) for a in (gate_w, w1, b1, w2, b2)]
+    x = nd.array(RNG.randn(6, d).astype('f'))
+    x.attach_grad()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        out = nd._contrib_MoE(x, *arrs, num_experts=E, k=1,
+                              capacity_factor=8.0)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (6, d)
+    assert abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """Tokens beyond expert capacity contribute zeros (GShard drop)."""
+    E, d, f, T = 2, 4, 8, 16
+    gate_w, w1, b1, w2, b2 = _moe_params(E, d, f)
+    # force all tokens to expert 0
+    gate_w = gate_w.at[:, 0].set(10.0).at[:, 1].set(-10.0)
+    x = jnp.asarray(RNG.randn(T, d).astype('f'))
+    out = moe_ffn(x, gate_w, w1, b1, w2, b2, E, k=1, capacity_factor=0.25)
+    capacity = max(1, int(0.25 * T / E))
+    nz_rows = (np.abs(np.asarray(out)).sum(-1) > 1e-7).sum()
+    assert nz_rows <= capacity * E  # per-expert cap holds
+    assert nz_rows < T              # overflow tokens were dropped
+
+
+# ---------------------------------------------------------------------------
+# pipeline (pp)
+# ---------------------------------------------------------------------------
+
+def _stage_fn(params, h):
+    w, b = params
+    return jnp.tanh(h @ w + b)
+
+
+def _stacked_stage_params(S, d):
+    return (jnp.asarray(RNG.randn(S, d, d).astype('f') * 0.4),
+            jnp.asarray(RNG.randn(S, d).astype('f') * 0.1))
+
+
+def _sequential_reference(params, x):
+    h = np.asarray(x)
+    for i in range(params[0].shape[0]):
+        h = np.tanh(h @ np.asarray(params[0][i]) + np.asarray(params[1][i]))
+    return h
+
+
+@pytest.mark.parametrize('S,M', [(2, 4), (4, 8)])
+def test_pipeline_matches_sequential(S, M):
+    from mxnet_tpu.parallel.pipeline import pipeline_apply
+    d, B = 8, 16
+    params = _stacked_stage_params(S, d)
+    x = jnp.asarray(RNG.randn(B, d).astype('f'))
+    mesh = parallel.make_mesh(pp=S, devices=jax.devices()[:S])
+    out = pipeline_apply(_stage_fn, params, x, mesh, num_microbatches=M)
+    np.testing.assert_allclose(np.asarray(out),
+                               _sequential_reference(params, x),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_differentiable():
+    """The GPipe schedule is one differentiable program: grads through
+    ppermute/scan match the sequential model's grads."""
+    from mxnet_tpu.parallel.pipeline import pipeline_apply
+    S, d, B, M = 2, 4, 8, 4
+    params = _stacked_stage_params(S, d)
+    x = jnp.asarray(RNG.randn(B, d).astype('f'))
+    mesh = parallel.make_mesh(pp=S, devices=jax.devices()[:S])
+
+    def loss_pipe(params):
+        return (pipeline_apply(_stage_fn, params, x, mesh,
+                               num_microbatches=M) ** 2).sum()
+
+    def loss_seq(params):
+        h = x
+        for i in range(S):
+            h = _stage_fn((params[0][i], params[1][i]), h)
+        return (h ** 2).sum()
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)

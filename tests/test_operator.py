@@ -1078,6 +1078,8 @@ _COVERED_ELSEWHERE = {
     '_contrib_MultiBoxDetection': 'tests/test_detection.py',
     'ROIPooling': 'tests/test_detection.py',
     'Custom': 'tests/test_aux.py',
+    '_contrib_MoE': 'tests/test_moe_pipeline.py',
+    'moe_ffn': 'tests/test_moe_pipeline.py',
     'Embedding': 'tests/test_gluon.py',
     'Dropout': 'tests/test_autograd.py',
     'SequenceMask': 'tests/test_rnn.py',
