@@ -164,3 +164,18 @@ _numeric_types = (int, float)
 
 def string_types():
     return (str,)
+
+
+class ContribNamespace:
+    """``mx.nd.contrib.X`` / ``mx.sym.contrib.X`` → registered
+    ``_contrib_X`` op (reference: python/mxnet/{ndarray,symbol}/contrib.py
+    namespaces)."""
+
+    def __init__(self, ns):
+        self._ns = ns
+
+    def __getattr__(self, name):
+        fn = self._ns.get("_contrib_" + name) or self._ns.get(name)
+        if fn is None:
+            raise AttributeError(f"contrib op {name!r} not registered")
+        return fn

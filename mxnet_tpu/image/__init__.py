@@ -8,5 +8,5 @@ from .image import (imdecode, imread, imresize, resize_short, fixed_crop,
                     SaturationJitterAug, ColorJitterAug, LightingAug,
                     ColorNormalizeAug, RandomOrderAug, SequentialAug,
                     CreateAugmenter, ImageIter)
-from .detection import (ImageDetRecordIter, make_det_label,
+from .detection import (ImageDetRecordIter, ImageDetIter, make_det_label,
                         parse_det_label, pack_det_dataset)

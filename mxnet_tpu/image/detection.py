@@ -23,6 +23,7 @@ import numpy as np
 
 from ..io import DataDesc
 from ..image_record_iter import ImageRecordIter
+from .image import ImageIter
 from .. import recordio
 from .. import native
 
@@ -180,3 +181,79 @@ def pack_det_dataset(path_rec, images, classes_list, boxes_list,
         header = recordio.IRHeader(0, make_det_label(cls, boxes), i, 0)
         rec.write(recordio.pack(header, buf.getvalue()))
     rec.close()
+
+
+class ImageDetIter(ImageIter):
+    """Python-side detection iterator over .rec/.lst/in-memory image
+    lists (reference: python/mxnet/image/detection.py ImageDetIter).
+
+    Labels are detection-format (``[header, obj_width, objs...]``, the
+    same contract as ImageDetRecordIter) and batch as
+    ``(batch, max_objects, 5)`` padded with -1.  Augmentation uses the
+    classification augmenter list for pixels (resize/color only — crops
+    would move boxes; use ImageDetRecordIter's box-aware crop for that)
+    plus box-aware random mirror here.
+    """
+
+    def __init__(self, batch_size, data_shape, max_objects=16,
+                 rand_mirror=False, label_name='label', **kwargs):
+        self.max_objects = max_objects
+        self._det_mirror = rand_mirror
+        self._det_rng = np.random.RandomState(kwargs.pop('seed', 0))
+        kwargs.pop('label_width', None)
+        if kwargs.get('aug_list') is None:
+            # classification CreateAugmenter would CROP (CenterCropAug),
+            # silently moving boxes on non-square images; the box-invariant
+            # default is a force resize to (w, h)
+            from .image import ForceResizeAug
+            kwargs['aug_list'] = [
+                ForceResizeAug((data_shape[2], data_shape[1]))]
+            kwargs.pop('resize', None)
+        super().__init__(batch_size, data_shape, label_width=1,
+                         label_name=label_name, **kwargs)
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, max_objects, 5))]
+
+    def next(self):
+        from .image import imdecode, _as_np
+        from ..io import DataBatch
+        from ..ndarray.ndarray import array as nd_array
+        import logging
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), np.float32)
+        batch_label = np.full((batch_size, self.max_objects, 5), -1.0,
+                              np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                try:
+                    data = [imdecode(s, 1 if c == 3 else 0)]
+                except Exception as e:  # noqa: BLE001
+                    logging.debug('Invalid image, skipping: %s', str(e))
+                    continue
+                for aug in self.auglist:
+                    data = [ret for src in data for ret in aug(src)]
+                for d in data:
+                    if i >= batch_size:
+                        break
+                    arr = _as_np(d).astype(np.float32)
+                    lab = parse_det_label(label, self.max_objects)
+                    if self._det_mirror and self._det_rng.rand() < 0.5:
+                        arr = arr[:, ::-1]
+                        valid = lab[:, 0] >= 0
+                        x1 = lab[valid, 1].copy()
+                        x2 = lab[valid, 3].copy()
+                        lab[valid, 1] = 1.0 - x2
+                        lab[valid, 3] = 1.0 - x1
+                    batch_data[i] = arr.transpose(2, 0, 1)
+                    batch_label[i] = lab
+                    i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch([nd_array(batch_data)], [nd_array(batch_label)],
+                         pad=batch_size - i,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -36,6 +36,8 @@ from .mobilenet import get_symbol as mobilenet
 from .squeezenet import get_symbol as squeezenet
 from .ssd import ssd_vgg16, ssd_toy
 from . import ssd as _ssd
+from .transformer import transformer_lm
+from . import transformer as _transformer
 
 _REGISTRY = {
     "mlp": _mlp, "lenet": _lenet, "alexnet": _alexnet, "vgg": _vgg,

@@ -1,0 +1,93 @@
+"""Decoder-only transformer language model — the long-context flagship.
+
+NEW model family relative to the reference (the transformer era postdates
+MXNet 0.12; SURVEY.md §5.7 designates long-context as this framework's
+new-capability track).  TPU-first by construction:
+
+* attention runs the Pallas flash kernel (ops/attention.py — forward AND
+  FA2 backward, O(S) memory), causal;
+* all projections are FullyConnected over (B*S, d) so XLA tiles one big
+  MXU matmul per projection instead of S small ones;
+* pre-norm residual blocks, GELU FFN (optionally MoE via _contrib_MoE for
+  expert parallelism);
+* drops into Module/SoftmaxOutput exactly like every other model here, so
+  the fused donated train step, bf16 compute_dtype, tp/sp sharding rules
+  and ring attention all apply unchanged.
+"""
+from .. import symbol as sym
+
+
+def _attention_block(x, seq_len, d_model, num_heads, name):
+    """x: (B, S, d) → (B, S, d) causal flash attention + projection."""
+    h = num_heads
+    hd = d_model // h
+    flat = sym.Reshape(x, shape=(-1, d_model))
+    qkv = sym.FullyConnected(flat, num_hidden=3 * d_model,
+                             name=f"{name}_qkv")
+    qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3, h, hd))
+    qkv = sym.transpose(qkv, axes=(2, 0, 3, 1, 4))   # (3, B, H, S, hd)
+    q = sym.squeeze(sym.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
+    k = sym.squeeze(sym.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
+    v = sym.squeeze(sym.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
+    attn = sym.contrib.FlashAttention(q, k, v, causal=True,
+                                      name=f"{name}_flash")
+    attn = sym.transpose(attn, axes=(0, 2, 1, 3))     # (B, S, H, hd)
+    attn = sym.Reshape(attn, shape=(-1, d_model))
+    out = sym.FullyConnected(attn, num_hidden=d_model,
+                             name=f"{name}_proj")
+    return sym.Reshape(out, shape=(-1, seq_len, d_model))
+
+
+def _ffn_block(x, seq_len, d_model, d_ff, name, moe_experts=0, moe_k=1):
+    flat = sym.Reshape(x, shape=(-1, d_model))
+    if moe_experts:
+        gate = sym.Variable(f"{name}_gate_weight",
+                            shape=(d_model, moe_experts))
+        w1 = sym.Variable(f"{name}_expert_w1_weight",
+                          shape=(moe_experts, d_model, d_ff))
+        b1 = sym.Variable(f"{name}_expert_b1_bias", shape=(moe_experts, d_ff))
+        w2 = sym.Variable(f"{name}_expert_w2_weight",
+                          shape=(moe_experts, d_ff, d_model))
+        b2 = sym.Variable(f"{name}_expert_b2_bias",
+                          shape=(moe_experts, d_model))
+        out = sym.contrib.MoE(flat, gate, w1, b1, w2, b2,
+                              num_experts=moe_experts, k=moe_k,
+                              activation="gelu", name=f"{name}_moe")
+    else:
+        hdn = sym.FullyConnected(flat, num_hidden=d_ff,
+                                 name=f"{name}_fc1")
+        hdn = hdn * sym.sigmoid(hdn * 1.702)   # gelu (sigmoid approx)
+        out = sym.FullyConnected(hdn, num_hidden=d_model,
+                                 name=f"{name}_fc2")
+    return sym.Reshape(out, shape=(-1, seq_len, d_model))
+
+
+def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
+                   num_heads=4, d_ff=None, moe_experts=0, moe_k=1):
+    """Causal LM train symbol: data (B, S) token ids,
+    softmax_label (B, S) next-token ids."""
+    d_ff = d_ff or 4 * d_model
+    data = sym.Variable("data")
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
+                      name="tok_embed")
+    # named *_weight so default initializers recognize it
+    pos = sym.Variable("pos_embed_weight", shape=(seq_len, d_model))
+    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+    for i in range(num_layers):
+        name = f"layer{i}"
+        a = _attention_block(sym.LayerNorm(x, name=f"{name}_ln1"),
+                             seq_len, d_model, num_heads, name)
+        x = x + a
+        f = _ffn_block(sym.LayerNorm(x, name=f"{name}_ln2"),
+                       seq_len, d_model, d_ff, name,
+                       moe_experts=moe_experts, moe_k=moe_k)
+        x = x + f
+    x = sym.LayerNorm(x, name="final_ln")
+    logits = sym.FullyConnected(sym.Reshape(x, shape=(-1, d_model)),
+                                num_hidden=vocab_size, name="lm_head")
+    label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
+    return sym.SoftmaxOutput(data=logits, label=label, name="softmax")
+
+
+def get_symbol(vocab_size=1000, seq_len=128, **kwargs):
+    return transformer_lm(vocab_size, seq_len, **kwargs)

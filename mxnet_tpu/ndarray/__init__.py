@@ -11,3 +11,7 @@ init_ndarray_module(globals())
 
 # a few reference-API spellings not covered by the registry names
 stack = globals().get("stack")
+
+
+from ..base import ContribNamespace as _ContribNS
+contrib = _ContribNS(globals())

@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from .mesh import mesh_shape
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
@@ -36,7 +37,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
     x: (batch, ...) global input; batch must divide num_microbatches
     Returns stage_{S-1}(...stage_0(x)) exactly, computed GPipe-style.
     """
-    S = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    S = mesh_shape(mesh).get(axis, 1)
     if S <= 1:
         h = x
         for i in range(jax.tree.leaves(stage_params)[0].shape[0]):

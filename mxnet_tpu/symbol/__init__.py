@@ -4,3 +4,7 @@ from .symbol import (Symbol, Node, Variable, var, Group, load, load_json,
 from .register import init_symbol_module
 
 init_symbol_module(globals())
+
+
+from ..base import ContribNamespace as _ContribNS
+contrib = _ContribNS(globals())

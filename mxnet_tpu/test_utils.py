@@ -315,8 +315,7 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req='write',
         # cotangents must match each output's dtype (fp16 configs
         # produce fp16 outputs)
         ex.backward(out_grads=[
-            nd_array(np.ones(o.shape, dtype=np.dtype(str(o.dtype))))
-            for o in outs])
+            nd_array(np.ones(o.shape, dtype=o.dtype)) for o in outs])
         results.append({
             'outputs': [o.asnumpy().astype(np.float64) for o in outs],
             'grads': {k: v.asnumpy().astype(np.float64)

@@ -113,3 +113,47 @@ def test_ssd_detection_output():
     arg_shapes, out_shapes, _ = net.infer_shape(data=(1, 3, 64, 64))
     # (N, A, 6): [cls, score, x1, y1, x2, y2]
     assert out_shapes[0][0] == 1 and out_shapes[0][2] == 6
+
+
+def test_image_det_iter(tmp_path):
+    """ImageDetIter (python-side det iterator, reference
+    image/detection.py) over the same .rec: det labels batch as
+    (B, max_objects, 5) with box-aware mirror."""
+    from mxnet_tpu.image import ImageDetIter
+    images, classes, boxes = _toy_dataset(8)
+    rec = str(tmp_path / "it_det.rec")
+    pack_det_dataset(rec, images, classes, boxes)
+    it = ImageDetIter(batch_size=4, data_shape=(3, 64, 64),
+                      path_imgrec=rec, max_objects=4, rand_mirror=True,
+                      resize=64)
+    n = 0
+    for b in it:
+        assert b.data[0].shape == (4, 3, 64, 64)
+        assert b.label[0].shape == (4, 4, 5)
+        lab = b.label[0].asnumpy()
+        valid = lab[lab[:, :, 0] >= 0]
+        assert (valid[:, 1] <= valid[:, 3]).all()
+        n += 1
+    assert n == 2
+
+
+def test_image_det_iter_non_square_boxes(tmp_path):
+    """Non-square sources must keep boxes consistent (the default
+    classification crop augmenter would silently shift them)."""
+    from mxnet_tpu.image import ImageDetIter
+    # a wide image: white square occupies left half exactly
+    im = np.zeros((64, 128, 3), np.uint8)
+    im[:, :64] = 255
+    rec = str(tmp_path / "wide.rec")
+    pack_det_dataset(rec, [im], [[0.0]], [[[0.0, 0.0, 0.5, 1.0]]])
+    it = ImageDetIter(batch_size=1, data_shape=(3, 64, 64),
+                      path_imgrec=rec, max_objects=2)
+    b = next(iter(it))
+    data = b.data[0].asnumpy()[0]
+    lab = b.label[0].asnumpy()[0]
+    # the force-resize keeps the object in the left half of the pixels
+    left = data[:, :, :32].mean()
+    right = data[:, :, 32:].mean()
+    assert left > 200 and right < 50, (left, right)
+    np.testing.assert_allclose(lab[0], [0.0, 0.0, 0.0, 0.5, 1.0],
+                               atol=1e-6)

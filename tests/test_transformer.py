@@ -1,0 +1,63 @@
+"""Transformer LM tests (flash attention + optional MoE end to end)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def _fit_lm(net, steps=30, lr=3e-3, seq=16, vocab=50, seed=0):
+    rng = np.random.RandomState(seed)
+    # learnable sequence: next = (3*tok + 1) % vocab
+    toks = np.zeros((32, seq + 1), np.float32)
+    toks[:, 0] = rng.randint(1, vocab, 32)
+    for t in range(seq):
+        toks[:, t + 1] = (toks[:, t] * 3 + 1) % vocab
+    it = mx.io.NDArrayIter({'data': toks[:, :-1]},
+                           {'softmax_label': toks[:, 1:]}, batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    np.random.seed(seed)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer='adam',
+                       optimizer_params={'learning_rate': lr})
+    b = next(iter(it))
+    nlls = []
+    for _ in range(steps):
+        mod.forward(b, is_train=True)
+        probs = mod.get_outputs()[0].asnumpy()
+        lab = b.label[0].asnumpy().reshape(-1).astype(int)
+        nlls.append(-np.log(np.maximum(
+            probs[np.arange(len(lab)), lab], 1e-9)).mean())
+        mod.update()
+    return nlls
+
+
+def test_transformer_lm_trains():
+    net = models.transformer_lm(vocab_size=50, seq_len=16, num_layers=2,
+                                d_model=32, num_heads=2)
+    nlls = _fit_lm(net)
+    assert nlls[-1] < 0.3 * nlls[0], (nlls[0], nlls[-1])
+
+
+def test_transformer_lm_moe_trains():
+    """MoE FFN variant: expert-parallel-ready layer trains end to end."""
+    net = models.transformer_lm(vocab_size=50, seq_len=16, num_layers=1,
+                                d_model=32, num_heads=2, moe_experts=4,
+                                moe_k=2)
+    assert any('expert_w1_weight' in a for a in net.list_arguments())
+    nlls = _fit_lm(net, steps=40)
+    assert nlls[-1] < 0.5 * nlls[0], (nlls[0], nlls[-1])
+
+
+def test_transformer_shapes_and_save_load(tmp_path):
+    net = models.transformer_lm(vocab_size=30, seq_len=8, num_layers=1,
+                                d_model=16, num_heads=2)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 8),
+                                                softmax_label=(2, 8))
+    assert out_shapes[0] == (16, 30)
+    f = str(tmp_path / 'tf.json')
+    net.save(f)
+    from mxnet_tpu import symbol as sym
+    s2 = sym.load(f)
+    assert s2.list_arguments() == net.list_arguments()
