@@ -1112,3 +1112,91 @@ def test_registry_coverage():
     assert not missing, (
         'ops with no test coverage (add a case here or to '
         '_COVERED_ELSEWHERE): %r' % missing)
+
+
+# ---------------------------------------------------------------------------
+# additional gradient coverage (nn / shape / indexing families)
+# ---------------------------------------------------------------------------
+
+def test_grad_shape_ops():
+    x = RNG.uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    _check_grad('transpose', [x], {'axes': (2, 0, 1)})
+    _check_grad('reshape', [x], {'shape': (6, 4)})
+    _check_grad('slice_axis', [x], {'axis': 1, 'begin': 0, 'end': 2})
+    _check_grad('tile', [x[:, :2, :2]], {'reps': (1, 2, 1)})
+    _check_grad('flip', [x], {'axis': 2})
+    _check_grad('expand_dims', [x], {'axis': 0})
+
+
+def test_grad_concat_take():
+    a = RNG.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    b = RNG.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    vs = [S.Variable('a'), S.Variable('b')]
+    out = _apply('Concat', *vs, dim=1)
+    check_numeric_gradient(out, {'a': a, 'b': b}, numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-2)
+    w = RNG.uniform(0.5, 1.5, (5, 3)).astype(np.float32)
+    idx = np.array([0, 2, 4], np.float32)
+    out = _apply('take', S.Variable('w'), S.Variable('i'))
+    check_numeric_gradient(out, {'w': w, 'i': idx}, grad_nodes=['w'],
+                           numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_grad_norm_layers():
+    x = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    g = RNG.uniform(0.5, 1.5, (4,)).astype(np.float32)
+    b = RNG.uniform(-0.5, 0.5, (4,)).astype(np.float32)
+    vs = [S.Variable(n) for n in ('data', 'gamma', 'beta')]
+    out = _apply('LayerNorm', *vs, eps=1e-4)
+    check_numeric_gradient(out, {'data': x, 'gamma': g, 'beta': b},
+                           numeric_eps=1e-3, rtol=8e-2, atol=2e-2)
+    _check_grad('L2Normalization', [RNG.uniform(0.5, 1.5, (2, 6)
+                                                ).astype(np.float32)],
+                {'mode': 'instance'}, rtol=8e-2, atol=2e-2)
+
+
+def test_grad_pool_and_deconv():
+    x = RNG.uniform(-1, 1, (1, 1, 4, 4)).astype(np.float32)
+    # max pool: kink-free location assumed with distinct values
+    _check_grad('Pooling', [x], {'kernel': (2, 2), 'stride': (2, 2),
+                                 'pool_type': 'max'}, eps=1e-2)
+    w = RNG.uniform(-1, 1, (1, 1, 2, 2)).astype(np.float32)
+    vs = [S.Variable('data'), S.Variable('weight')]
+    out = _apply('Deconvolution', *vs, kernel=(2, 2), num_filter=1,
+                 stride=(2, 2), no_bias=True)
+    check_numeric_gradient(out, {'data': x, 'weight': w},
+                           numeric_eps=1e-2, rtol=6e-2, atol=2e-2)
+
+
+def test_grad_embedding_and_where():
+    w = RNG.uniform(-1, 1, (6, 3)).astype(np.float32)
+    idx = np.array([1, 4], np.float32)
+    vs = [S.Variable('data'), S.Variable('weight')]
+    out = _apply('Embedding', data=vs[0], weight=vs[1], input_dim=6,
+                 output_dim=3)
+    check_numeric_gradient(out, {'data': idx, 'weight': w},
+                           grad_nodes=['weight'], numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-2)
+    cond = (RNG.uniform(-1, 1, (2, 3)) > 0).astype(np.float32)
+    a = RNG.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    b = RNG.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    vs = [S.Variable('c'), S.Variable('a'), S.Variable('b')]
+    out = _apply('where', *vs)
+    check_numeric_gradient(out, {'c': cond, 'a': a, 'b': b},
+                           grad_nodes=['a', 'b'], numeric_eps=1e-3,
+                           rtol=5e-2, atol=1e-2)
+
+
+def test_grad_batchnorm_params():
+    x = RNG.uniform(-1, 1, (4, 3)).astype(np.float32)
+    g = RNG.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    b = RNG.uniform(-0.5, 0.5, (3,)).astype(np.float32)
+    vs = [S.Variable(n) for n in ('data', 'gamma', 'beta')]
+    out = _apply('BatchNorm', data=vs[0], gamma=vs[1], beta=vs[2],
+                 fix_gamma=False, eps=1e-3)
+    aux = {n: (np.zeros(3, np.float32) if 'mean' in n
+               else np.ones(3, np.float32))
+           for n in out.list_auxiliary_states()}
+    check_numeric_gradient(out, {'data': x, 'gamma': g, 'beta': b},
+                           aux_states=aux, grad_nodes=['gamma', 'beta'],
+                           numeric_eps=1e-3, rtol=8e-2, atol=2e-2)
