@@ -63,15 +63,40 @@ def _peak_flops(device_kind: str):
 
 
 def main():
-    # initialize the backend explicitly, with a clear diagnostic on failure
+    batch = BATCH
+    while True:
+        try:
+            return _run(batch)
+        except Exception as e:  # noqa: BLE001
+            if "RESOURCE_EXHAUSTED" in str(e) and batch > 32:
+                _mark("OOM at batch %d — retrying at %d"
+                      % (batch, batch // 2))
+                batch //= 2
+                continue
+            raise
+
+
+def _run(batch):
+    # initialize the backend explicitly, with retries (the single-client
+    # chip tunnel can be transiently held) and a clear diagnostic
     import jax
-    try:
-        dev = jax.devices()[0]
-    except Exception as e:  # noqa: BLE001
+    dev = None
+    err = None
+    for attempt in range(int(os.environ.get("BENCH_INIT_RETRIES", "3"))):
+        try:
+            dev = jax.devices()[0]
+            break
+        except Exception as e:  # noqa: BLE001
+            err = e
+            _mark("backend init attempt %d failed: %s" % (attempt + 1, e))
+            if attempt + 1 < int(os.environ.get("BENCH_INIT_RETRIES",
+                                                "3")):
+                time.sleep(90)
+    if dev is None:
         print(json.dumps({"metric": "resnet50_train_imgs_per_sec",
                           "value": None, "unit": "imgs/sec",
                           "vs_baseline": None,
-                          "error": "backend init failed: %s" % e}))
+                          "error": "backend init failed: %s" % err}))
         return 1
     _mark("backend up: %s" % dev.device_kind)
     import jax.numpy as jnp
@@ -85,9 +110,9 @@ def main():
                         compute_dtype=compute_dtype)
 
     rng = np.random.RandomState(0)
-    x = rng.uniform(-1, 1, (BATCH, 3, 224, 224)).astype(np.float32)
-    y = rng.randint(0, 1000, (BATCH,)).astype(np.float32)
-    it = mx.io.NDArrayIter(data=x, label=y, batch_size=BATCH)
+    x = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.float32)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=batch)
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params(mx.initializer.Xavier(rnd_type="gaussian", magnitude=2.0))
     mod.init_optimizer(optimizer="sgd",
@@ -103,9 +128,9 @@ def main():
         k = jax.random.PRNGKey(seed)
         kx, ky = jax.random.split(k)
         bx = mx.nd.NDArray(jax.random.uniform(
-            kx, (BATCH, 3, 224, 224), jnp.float32, -1.0, 1.0))
+            kx, (batch, 3, 224, 224), jnp.float32, -1.0, 1.0))
         by = mx.nd.NDArray(jax.random.randint(
-            ky, (BATCH,), 0, 1000).astype(jnp.float32))
+            ky, (batch,), 0, 1000).astype(jnp.float32))
         bx.wait_to_read()
         by.wait_to_read()
         batches.append(mx.io.DataBatch(data=[bx], label=[by]))
@@ -132,7 +157,7 @@ def main():
         flops_per_step = None
     if not flops_per_step:
         # analytic fallback: ResNet-50 ≈ 4.1e9 MACs fwd → 3x for training
-        flops_per_step = 2 * 4.1e9 * 3 * BATCH
+        flops_per_step = 2 * 4.1e9 * 3 * batch
         flops_source = "analytic"
     else:
         flops_source = "xla_cost_analysis"
@@ -146,7 +171,7 @@ def main():
     dt = time.perf_counter() - t0
 
     step_s = dt / ITERS
-    imgs_per_sec = BATCH / step_s
+    imgs_per_sec = batch / step_s
     peak = _peak_flops(dev.device_kind)
     mfu = (flops_per_step / step_s / peak) if peak else None
     out = {
@@ -156,7 +181,7 @@ def main():
         "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
         "step_ms": round(step_s * 1e3, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
-        "batch": BATCH,
+        "batch": batch,
         "dtype": str(DTYPE),
         "device": dev.device_kind,
         "flops_per_step": flops_per_step,
