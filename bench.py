@@ -68,11 +68,19 @@ def main():
         try:
             return _run(batch)
         except Exception as e:  # noqa: BLE001
-            if "RESOURCE_EXHAUSTED" in str(e) and batch > 32:
-                _mark("OOM at batch %d — retrying at %d"
-                      % (batch, batch // 2))
-                batch //= 2
-                continue
+            if "RESOURCE_EXHAUSTED" in str(e):
+                if batch > 32:
+                    _mark("OOM at batch %d — retrying at %d"
+                          % (batch, batch // 2))
+                    batch //= 2
+                    continue
+                print(json.dumps({
+                    "metric": "resnet50_train_imgs_per_sec",
+                    "value": None, "unit": "imgs/sec",
+                    "vs_baseline": None,
+                    "error": "OOM even at batch %d: %s" % (batch,
+                                                           str(e)[:300])}))
+                return 1
             raise
 
 
@@ -82,15 +90,15 @@ def _run(batch):
     import jax
     dev = None
     err = None
-    for attempt in range(int(os.environ.get("BENCH_INIT_RETRIES", "3"))):
+    retries = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "3")))
+    for attempt in range(retries):
         try:
             dev = jax.devices()[0]
             break
         except Exception as e:  # noqa: BLE001
             err = e
             _mark("backend init attempt %d failed: %s" % (attempt + 1, e))
-            if attempt + 1 < int(os.environ.get("BENCH_INIT_RETRIES",
-                                                "3")):
+            if attempt + 1 < retries:
                 time.sleep(90)
     if dev is None:
         print(json.dumps({"metric": "resnet50_train_imgs_per_sec",

@@ -236,6 +236,30 @@ def csr_matrix(arg1, shape=None, dtype=None, ctx=None):
                       dtype=dtype)
 
 
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        rng=None):
+    """Random sparse array + its dense numpy twin (reference:
+    test_utils.py:254 — the sparse test-data generator)."""
+    rng = rng or np.random
+    density = 0.2 if density is None else density
+    dtype = np.dtype(dtype or np.float32)
+    if stype == "row_sparse":
+        # density selects ROWS for row_sparse (reference semantics:
+        # test_utils.py rand_sparse_ndarray row-wise generator)
+        row_mask = rng.uniform(0, 1, shape[0]) < density
+        dense = (rng.uniform(-1, 1, shape) *
+                 row_mask.reshape((-1,) + (1,) * (len(shape) - 1))
+                 ).astype(dtype)
+        return row_sparse_array(dense), dense
+    dense = (rng.uniform(-1, 1, shape) *
+             (rng.uniform(0, 1, shape) < density)).astype(dtype)
+    if stype == "csr":
+        if len(shape) != 2:
+            raise MXNetError("csr requires 2-D shape")
+        return csr_matrix(dense), dense
+    raise MXNetError(f"unknown sparse stype {stype!r}")
+
+
 def cast_storage(arr, stype):
     """reference: tensor/cast_storage-inl.h"""
     if stype == "default":

@@ -212,3 +212,15 @@ def test_gluon_sparse_embedding_trains():
         trainer.step(1)
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < 0.05 * losses[0], losses
+
+
+def test_rand_sparse_ndarray():
+    """test_utils sparse generator (reference: test_utils.py:254)."""
+    from mxnet_tpu import test_utils
+    a = test_utils.rand_ndarray((8, 5), stype='row_sparse', density=0.5)
+    assert a.stype == 'row_sparse'
+    assert a.shape == (8, 5)
+    b = test_utils.rand_ndarray((8, 5), stype='csr', density=0.5)
+    assert b.stype == 'csr'
+    sp, dense = sparse.rand_sparse_ndarray((6, 3), 'csr', density=0.4)
+    np.testing.assert_array_equal(sp.todense().asnumpy(), dense)
