@@ -63,15 +63,26 @@ def _ffn_block(x, seq_len, d_model, d_ff, name, moe_experts=0, moe_k=1):
 
 
 def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
-                   num_heads=4, d_ff=None, moe_experts=0, moe_k=1):
+                   num_heads=4, d_ff=None, moe_experts=0, moe_k=1,
+                   max_len=None):
     """Causal LM train symbol: data (B, S) token ids,
-    softmax_label (B, S) next-token ids."""
+    softmax_label (B, S) next-token ids.
+
+    ``max_len`` (default seq_len) sizes the positional embedding; pass
+    the largest bucket when building per-bucket symbols for
+    BucketingModule so all buckets share ONE pos_embed parameter."""
     d_ff = d_ff or 4 * d_model
+    max_len = max_len or seq_len
+    if max_len < seq_len:
+        raise ValueError(
+            f"transformer_lm: max_len ({max_len}) must be >= seq_len "
+            f"({seq_len}) — pass the largest bucket as max_len")
     data = sym.Variable("data")
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
                       name="tok_embed")
     # named *_weight so default initializers recognize it
-    pos = sym.Variable("pos_embed_weight", shape=(seq_len, d_model))
+    pos = sym.Variable("pos_embed_weight", shape=(max_len, d_model))
+    pos = sym.slice_axis(pos, axis=0, begin=0, end=seq_len)
     x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
     for i in range(num_layers):
         name = f"layer{i}"
