@@ -504,6 +504,8 @@ def _invoke(op_name: str, inputs, attrs, out=None):
         outs = outs[:-n_aux_updates]
 
     nvis = getattr(opdef, "num_visible", None)
+    if callable(nvis):  # attr-dependent (reference NumVisibleOutputs)
+        nvis = nvis(attrs)
     keep = len(outs)
     if out is not None:
         out_arrays = [out] if isinstance(out, NDArray) else list(out)
@@ -527,10 +529,9 @@ def _invoke(op_name: str, inputs, attrs, out=None):
         for oa in out_arrays:
             oa._data.block_until_ready()
 
-    ret_single = (len(out_arrays) == 1)
-    if nvis == 1 and len(out_arrays) > 1:
-        return out_arrays[0]
-    return out_arrays[0] if ret_single else out_arrays
+    if nvis is not None and nvis < len(out_arrays):
+        out_arrays = out_arrays[:nvis]
+    return out_arrays[0] if len(out_arrays) == 1 else out_arrays
 
 
 # ===========================================================================

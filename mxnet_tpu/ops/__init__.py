@@ -23,3 +23,5 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn           # noqa: F401
 from . import linalg        # noqa: F401
 from . import moe           # noqa: F401
+from . import spatial       # noqa: F401
+from . import contrib_ops   # noqa: F401

@@ -45,6 +45,12 @@ _UNARY = {
     "reciprocal": jnp.reciprocal,
     "negative": jnp.negative,
     "logical_not": lambda x: (x == 0).astype(x.dtype),
+    # MXNet `round` is round-half-away-from-zero (mshadow_op.h round ->
+    # ::roundf), unlike jnp.round's banker's rounding; lax.round is exact
+    # where floor(x+0.5) emulation breaks (|x| >= 2^23 in f32).  Integer
+    # inputs are identity (lax.round rejects them).
+    "round": lambda x: (x if jnp.issubdtype(x.dtype, jnp.integer)
+                        else lax.round(x, lax.RoundingMethod.AWAY_FROM_ZERO)),
 }
 for _n, _f in _UNARY.items():
     _reg_unary(_n, (lambda f: lambda data, **kw: f(data))(_f))
@@ -81,7 +87,7 @@ def _reg_binary(stem, fn, extra=()):
         lambda lhs, rhs, _f=fn, **kw: _f(lhs, rhs))
 
 
-_reg_binary("add", jnp.add, extra=("_plus",))
+_reg_binary("add", jnp.add, extra=("_plus", "_grad_add"))
 _reg_binary("sub", jnp.subtract, extra=("_minus",))
 _reg_binary("mul", jnp.multiply)
 _reg_binary("div", jnp.divide)
@@ -145,7 +151,12 @@ _SCALAR = {
     "_logical_and_scalar": lambda x, s: ((x != 0) & (s != 0)).astype(x.dtype),
     "_logical_or_scalar": lambda x, s: ((x != 0) | (s != 0)).astype(x.dtype),
     "_logical_xor_scalar": lambda x, s: ((x != 0) ^ (s != 0)).astype(x.dtype),
+    # _scatter_*_scalar / _scatter_elemwise_div are the reference's
+    # sparse-storage-preserving variants (elemwise_scatter_op.cc); dense
+    # semantics are identical, and sparse NDArrays densify through the
+    # standard frontend path (ndarray/sparse.py)
     "_scatter_plus_scalar": lambda x, s: x + s,
+    "_scatter_minus_scalar": lambda x, s: x - s,
     "smooth_l1": lambda x, s: jnp.where(
         jnp.abs(x) < 1.0 / (s * s),
         0.5 * (s * x) ** 2, jnp.abs(x) - 0.5 / (s * s)),
@@ -161,6 +172,17 @@ def _add_n(*args, **kw):
     for a in args[1:]:
         out = out + a
     return out
+
+
+register("_scatter_elemwise_div", arg_names=["lhs", "rhs"])(
+    lambda lhs, rhs, **kw: jnp.divide(lhs, rhs))
+
+
+@register("_identity_with_attr_like_rhs", arg_names=["lhs", "rhs"])
+def _identity_with_attr_like_rhs(lhs, rhs, **kw):
+    """reference: elemwise_unary_op.cc — identity on lhs, storage attrs from
+    rhs (a graph-pass helper for sparse gradients; dense here)."""
+    return jnp.asarray(lhs)
 
 
 @register("where", arg_names=["condition", "x", "y"])

@@ -75,14 +75,61 @@ def _squeeze(data, axis=None, **kw):
         (axis,) if isinstance(axis, int) else axis))
 
 
+def _slice_tuple(begin, end, step=()):
+    step = tuple(step) or (None,) * len(begin)
+    return tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+
+
 @register("slice", arg_names=["data"], aliases=("crop",),
           attr_defaults={"begin": (), "end": (), "step": ()})
 def _slice(data, begin=(), end=(), step=(), **kw):
-    idx = []
-    step = tuple(step) or (None,) * len(begin)
-    for b, e, s in zip(begin, end, step):
-        idx.append(slice(b, e, s))
-    return data[tuple(idx)]
+    return data[_slice_tuple(begin, end, step)]
+
+
+@register("_slice_assign", arg_names=["lhs", "rhs"],
+          aliases=("_crop_assign",),
+          attr_defaults={"begin": (), "end": (), "step": ()})
+def _slice_assign(lhs, rhs, begin=(), end=(), step=(), **kw):
+    """reference: tensor/matrix_op.cc _slice_assign — functional update of
+    lhs[begin:end] = rhs (the TPU-native form of the reference's in-place
+    kernel; XLA turns the copy into an in-place DUS when buffers are
+    donated)."""
+    return lhs.at[_slice_tuple(begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", arg_names=["data"],
+          aliases=("_crop_assign_scalar",),
+          attr_defaults={"scalar": 0.0, "begin": (), "end": (), "step": ()})
+def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=(), **kw):
+    return data.at[_slice_tuple(begin, end, step)].set(scalar)
+
+
+@register("reshape_like", arg_names=["lhs", "rhs"])
+def _reshape_like(lhs, rhs, **kw):
+    """reference: tensor/elemwise_unary_op.cc reshape_like"""
+    return lhs.reshape(rhs.shape)
+
+
+@register("cast_storage", arg_names=["data"],
+          attr_defaults={"stype": "default"})
+def _cast_storage(data, stype="default", **kw):
+    """reference: tensor/cast_storage-inl.h.  At the jax level every array
+    is dense; actual RSP/CSR container conversion happens in the NDArray
+    frontend (ndarray/sparse.py cast_storage), which routes through this op
+    for the dense leg."""
+    return jnp.asarray(data)
+
+
+@register("_sparse_retain", arg_names=["data", "indices"],
+          aliases=("sparse_retain",))
+def _sparse_retain_op(data, indices, **kw):
+    """reference: tensor/sparse_retain.cc — keep the listed rows, zero the
+    rest (dense semantics of the RSP op; RowSparseNDArray.retain keeps the
+    O(rows) container form)."""
+    keep = jnp.zeros((data.shape[0],), jnp.bool_).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros((), data.dtype))
 
 
 @register("slice_axis", arg_names=["data"],

@@ -489,3 +489,54 @@ def _softmax_ce(data, label, **kw):
     logp = jax.nn.log_softmax(data, axis=-1)
     lab = label.astype(jnp.int32)
     return -jnp.sum(jnp.take_along_axis(logp, lab[:, None], axis=-1))
+
+
+# --- legacy _v1 aliases (reference: batch_norm_v1.cc, convolution_v1.cc,
+# pooling_v1.cc — older implementations of the same math, kept for graph
+# compatibility; one registration path here, so they are true aliases) ------
+alias("BatchNorm_v1", "BatchNorm")
+alias("Convolution_v1", "Convolution")
+alias("Pooling_v1", "Pooling")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _klreg_core(data, moving_avg, sparseness_target, penalty):
+    return data
+
+
+def _klreg_fwd(data, moving_avg, sparseness_target, penalty):
+    return data, moving_avg
+
+
+def _klreg_bwd(sparseness_target, penalty, moving_avg, g):
+    rho = sparseness_target
+    pen = penalty * (-rho / moving_avg + (1.0 - rho) / (1.0 - moving_avg))
+    unit_shape = (1,) + pen.shape if g.ndim == pen.ndim + 1 else pen.shape
+    return g + pen.reshape(unit_shape).astype(g.dtype), jnp.zeros_like(moving_avg)
+
+
+_klreg_core.defvjp(_klreg_fwd, _klreg_bwd)
+
+
+@register("IdentityAttachKLSparseReg", arg_names=["data"], num_aux=1,
+          aux_names=["moving_avg"], takes_is_train=True,
+          attr_defaults={"sparseness_target": 0.1, "penalty": 0.001,
+                         "momentum": 0.9})
+def _identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
+                                   penalty=0.001, momentum=0.9,
+                                   is_train=False, **kw):
+    """Identity forward; attaches the KL sparseness penalty grad
+    penalty * (-rho/mu + (1-rho)/(1-mu)) in backward, where mu is the
+    momentum-averaged per-unit mean activation kept as aux state
+    (reference: src/operator/identity_attach_KL_sparse_reg-inl.h:62-110).
+    The reference updates the moving average inside Backward; here it is
+    updated in the training forward (same per-step observable state) so the
+    op stays a pure function with an aux output."""
+    if is_train:
+        flat = data.reshape(data.shape[0], -1)
+        avg = lax.stop_gradient(flat.mean(axis=0).reshape(moving_avg.shape))
+        ma = momentum * moving_avg + (1.0 - momentum) * avg
+        out = _klreg_core(data, ma, float(sparseness_target), float(penalty))
+        return out, ma
+    return _klreg_core(data, moving_avg, float(sparseness_target),
+                       float(penalty))

@@ -164,6 +164,37 @@ def _sample_poisson(key, lam, shape=(), dtype="float32", **kw):
         [lam], shape)
 
 
+@register("_sample_negative_binomial", needs_rng=True, differentiable=False,
+          arg_names=["k", "p"],
+          attr_defaults={"shape": (), "dtype": "float32"})
+def _sample_negative_binomial(key, k, p, shape=(), dtype="float32", **kw):
+    """Per-element NB(k, p) via the gamma-Poisson mixture the reference's
+    sampler uses (random/sample_op.cc NegativeBinomialSampler)."""
+    def fn(kk, ps, sh):
+        k1, k2 = jax.random.split(kk)
+        lam = jax.random.gamma(k1, ps[0]) * (1.0 - ps[1]) / ps[1]
+        return jax.random.poisson(k2, lam, sh).astype(_dt(dtype))
+    return _broadcast_param_sample(key, fn, [k, p], shape)
+
+
+@register("_sample_generalized_negative_binomial", needs_rng=True,
+          differentiable=False, arg_names=["mu", "alpha"],
+          attr_defaults={"shape": (), "dtype": "float32"})
+def _sample_gen_negative_binomial(key, mu, alpha, shape=(), dtype="float32",
+                                  **kw):
+    """GNB(mu, alpha): gamma(1/alpha, scale=mu*alpha)-mixed Poisson
+    (reference: random/sample_op.cc GeneralizedNegativeBinomialSampler)."""
+    def fn(kk, ps, sh):
+        k1, k2 = jax.random.split(kk)
+        mu_, a_ = ps[0], ps[1]
+        lam = jnp.where(
+            a_ > 0,
+            jax.random.gamma(k1, 1.0 / jnp.maximum(a_, 1e-12)) * mu_ * a_,
+            mu_)
+        return jax.random.poisson(k2, lam, sh).astype(_dt(dtype))
+    return _broadcast_param_sample(key, fn, [mu, alpha], shape)
+
+
 @register("_shuffle", needs_rng=True, differentiable=False,
           aliases=("shuffle",), arg_names=["data"], attr_defaults={})
 def _shuffle(key, data, **kw):

@@ -740,3 +740,180 @@ def _cells_pack_weights(cells, args):
     for cell in cells:
         args = cell.pack_weights(args)
     return args
+
+
+# ---------------------------------------------------------------------------
+# Convolutional RNN cells (reference: rnn_cell.py:1090-1425 —
+# BaseConvRNNCell / ConvRNNCell / ConvLSTMCell / ConvGRUCell).
+# States are NCHW feature maps; i2h/h2h are convolutions instead of
+# FullyConnected.  NCHW only (the Convolution op's native layout here).
+# ---------------------------------------------------------------------------
+class BaseConvRNNCell(BaseRNNCell):
+    """Shared conv-cell machinery (reference: rnn_cell.py:1090)."""
+
+    def __init__(self, input_shape, num_hidden,
+                 h2h_kernel=(3, 3), h2h_dilate=(1, 1),
+                 i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1),
+                 activation='tanh', prefix='', params=None,
+                 conv_layout='NCHW'):
+        super().__init__(prefix=prefix, params=params)
+        if conv_layout != 'NCHW':
+            raise MXNetError("conv RNN cells support NCHW only")
+        if h2h_kernel[0] % 2 == 0 or h2h_kernel[1] % 2 == 0:
+            raise MXNetError(
+                f"h2h_kernel must be odd, got {h2h_kernel}")
+        self._h2h_kernel = tuple(h2h_kernel)
+        self._h2h_dilate = tuple(h2h_dilate)
+        self._h2h_pad = (h2h_dilate[0] * (h2h_kernel[0] - 1) // 2,
+                         h2h_dilate[1] * (h2h_kernel[1] - 1) // 2)
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._i2h_stride = tuple(i2h_stride)
+        self._i2h_pad = tuple(i2h_pad)
+        self._i2h_dilate = tuple(i2h_dilate)
+        self._num_hidden = num_hidden
+        self._input_shape = tuple(input_shape)
+        self._activation = activation
+
+        # infer the (0, C, H, W) state shape from one probe convolution
+        probe = sym_mod.Convolution(
+            data=sym_mod.Variable(f'{self._prefix}probe'),
+            num_filter=num_hidden, kernel=self._i2h_kernel,
+            stride=self._i2h_stride, pad=self._i2h_pad,
+            dilate=self._i2h_dilate, no_bias=True)
+        _, out_shapes, _ = probe.infer_shape(
+            **{f'{self._prefix}probe': self._input_shape})
+        self._state_shape = (0,) + tuple(out_shapes[0][1:])
+
+        self._iW = self.params.get('i2h_weight')
+        self._hW = self.params.get('h2h_weight')
+        self._hB = self.params.get('h2h_bias')
+        # _iB is fetched lazily so ConvLSTMCell can attach its forget-bias
+        # initializer before the Variable is created (params.get caches)
+
+    @property
+    def _iB_var(self):
+        return self.params.get('i2h_bias')
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    @property
+    def state_info(self):
+        return [{'shape': self._state_shape, '__layout__': 'NCHW'},
+                {'shape': self._state_shape, '__layout__': 'NCHW'}]
+
+    def _act(self, x, name):
+        # reference conv cells default to LeakyReLU(slope=0.2)
+        # (rnn_cell.py:1224 functools.partial(symbol.LeakyReLU, ...))
+        if self._activation == 'leaky':
+            return sym_mod.LeakyReLU(x, act_type='leaky', slope=0.2,
+                                     name=name)
+        return self._get_activation(x, self._activation, name=name)
+
+    def _conv_forward(self, inputs, states, name):
+        i2h = sym_mod.Convolution(
+            data=inputs, weight=self._iW, bias=self._iB_var,
+            num_filter=self._num_hidden * self._num_gates,
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate,
+            name=f'{name}i2h')
+        h2h = sym_mod.Convolution(
+            data=states[0], weight=self._hW, bias=self._hB,
+            num_filter=self._num_hidden * self._num_gates,
+            kernel=self._h2h_kernel, stride=(1, 1),
+            pad=self._h2h_pad, dilate=self._h2h_dilate,
+            name=f'{name}h2h')
+        return i2h, h2h
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """Vanilla convolutional RNN (reference: rnn_cell.py:1176)."""
+
+    def __init__(self, input_shape, num_hidden, activation='leaky',
+                 prefix='ConvRNN_', **kwargs):
+        super().__init__(input_shape, num_hidden, activation=activation,
+                         prefix=prefix, **kwargs)
+
+    @property
+    def state_info(self):
+        return [{'shape': self._state_shape, '__layout__': 'NCHW'}]
+
+    @property
+    def _gate_names(self):
+        return ('',)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f'{self._prefix}t{self._counter}_'
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        output = self._act(i2h + h2h, name=f'{name}out')
+        return output, [output]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Convolutional LSTM (reference: rnn_cell.py:1253; Shi et al. 2015
+    "Convolutional LSTM Network").  Gate order [i, f, g, o] like LSTMCell."""
+
+    def __init__(self, input_shape, num_hidden, activation='leaky',
+                 prefix='ConvLSTM_', forget_bias=1.0, **kwargs):
+        super().__init__(input_shape, num_hidden, activation=activation,
+                         prefix=prefix, **kwargs)
+        from ..initializer import LSTMBias
+        self.params.get('i2h_bias', init=LSTMBias(forget_bias=forget_bias))
+
+    @property
+    def _gate_names(self):
+        return ['_i', '_f', '_c', '_o']
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f'{self._prefix}t{self._counter}_'
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        gates = i2h + h2h
+        sl = list(sym_mod.SliceChannel(gates, num_outputs=4, axis=1,
+                                       name=f'{name}slice'))
+        in_gate = sym_mod.Activation(sl[0], act_type='sigmoid')
+        forget_gate = sym_mod.Activation(sl[1], act_type='sigmoid')
+        in_transform = self._act(sl[2], name=f'{name}c')
+        out_gate = sym_mod.Activation(sl[3], act_type='sigmoid')
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._act(next_c, name=f'{name}out')
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Convolutional GRU (reference: rnn_cell.py:1348)."""
+
+    def __init__(self, input_shape, num_hidden, activation='leaky',
+                 prefix='ConvGRU_', **kwargs):
+        super().__init__(input_shape, num_hidden, activation=activation,
+                         prefix=prefix, **kwargs)
+
+    @property
+    def state_info(self):
+        return [{'shape': self._state_shape, '__layout__': 'NCHW'}]
+
+    @property
+    def _gate_names(self):
+        return ['_r', '_z', '_o']
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f'{self._prefix}t{self._counter}_'
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        i2h_sl = list(sym_mod.SliceChannel(i2h, num_outputs=3, axis=1,
+                                           name=f'{name}i2h_slice'))
+        h2h_sl = list(sym_mod.SliceChannel(h2h, num_outputs=3, axis=1,
+                                           name=f'{name}h2h_slice'))
+        reset_gate = sym_mod.Activation(i2h_sl[0] + h2h_sl[0],
+                                        act_type='sigmoid',
+                                        name=f'{name}r_act')
+        update_gate = sym_mod.Activation(i2h_sl[1] + h2h_sl[1],
+                                         act_type='sigmoid',
+                                         name=f'{name}z_act')
+        next_h_tmp = self._act(i2h_sl[2] + reset_gate * h2h_sl[2],
+                               name=f'{name}h_act')
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * states[0]
+        return next_h, [next_h]

@@ -58,6 +58,8 @@ def node_num_outputs(node: Node) -> int:
         return 1
     opdef = _reg.get(node.op)
     n = opdef.num_visible if opdef.num_visible is not None else opdef.num_outputs
+    if callable(n):  # attr-dependent (reference NumVisibleOutputs)
+        n = n(node.attrs)
     if n == -1:
         # attr-dependent output count (reference: SliceChannel num_outputs)
         if node.op in ("SliceChannel", "split"):

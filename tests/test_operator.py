@@ -1083,6 +1083,33 @@ _COVERED_ELSEWHERE = {
     'Embedding': 'tests/test_gluon.py',
     'Dropout': 'tests/test_autograd.py',
     'SequenceMask': 'tests/test_rnn.py',
+    # spatial + contrib tail (round 2): tests/test_spatial_contrib.py
+    'GridGenerator': 'tests/test_spatial_contrib.py',
+    'BilinearSampler': 'tests/test_spatial_contrib.py',
+    'SpatialTransformer': 'tests/test_spatial_contrib.py',
+    'Correlation': 'tests/test_spatial_contrib.py',
+    'IdentityAttachKLSparseReg': 'tests/test_spatial_contrib.py',
+    '_contrib_fft': 'tests/test_spatial_contrib.py',
+    '_contrib_ifft': 'tests/test_spatial_contrib.py',
+    '_contrib_count_sketch': 'tests/test_spatial_contrib.py',
+    '_contrib_quantize': 'tests/test_spatial_contrib.py',
+    '_contrib_dequantize': 'tests/test_spatial_contrib.py',
+    '_contrib_Proposal': 'tests/test_spatial_contrib.py',
+    '_contrib_MultiProposal': 'tests/test_spatial_contrib.py',
+    '_contrib_PSROIPooling': 'tests/test_spatial_contrib.py',
+    '_contrib_DeformableConvolution': 'tests/test_spatial_contrib.py',
+    '_contrib_DeformablePSROIPooling': 'tests/test_spatial_contrib.py',
+    '_sample_negative_binomial': 'tests/test_spatial_contrib.py',
+    '_sample_generalized_negative_binomial': 'tests/test_spatial_contrib.py',
+    '_slice_assign': 'tests/test_spatial_contrib.py',
+    '_slice_assign_scalar': 'tests/test_spatial_contrib.py',
+    '_sparse_retain': 'tests/test_spatial_contrib.py',
+    'cast_storage': 'tests/test_spatial_contrib.py',
+    'reshape_like': 'tests/test_spatial_contrib.py',
+    'round': 'tests/test_spatial_contrib.py',
+    '_scatter_minus_scalar': 'tests/test_spatial_contrib.py',
+    '_scatter_elemwise_div': 'tests/test_spatial_contrib.py',
+    '_identity_with_attr_like_rhs': 'tests/test_spatial_contrib.py',
 }
 
 

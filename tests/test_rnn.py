@@ -224,3 +224,58 @@ def test_bucket_iter_empty_bucket():
                                    invalid_label=0)
     batch = next(iter(it))
     assert batch.bucket_key == 4
+
+
+# ---------------------------------------------------------------------------
+# convolutional RNN cells (reference: test_rnn.py test_convrnn/convlstm/
+# convgru)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cls,nstates", [
+    (mx.rnn.ConvRNNCell, 1),
+    (mx.rnn.ConvLSTMCell, 2),
+    (mx.rnn.ConvGRUCell, 1),
+])
+def test_conv_rnn_cell_unroll(cls, nstates):
+    T, N, C, H, W = 3, 2, 4, 8, 8
+    hid = 6
+    cell = cls(input_shape=(N, C, H, W), num_hidden=hid,
+               prefix=cls.__name__ + '_')
+    data = mx.sym.Variable('data')
+    outputs, states = cell.unroll(T, inputs=data, merge_outputs=True,
+                                  layout='NTC')
+    assert len(states) == nstates
+    ex = mx.Executor.simple_bind(outputs, shapes={'data': (N, T, C, H, W)})
+    rng = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        if n != 'data':
+            a._set_data(np.asarray(
+                rng.uniform(-0.1, 0.1, a.shape).astype('float32')))
+    ex.arg_dict['data']._set_data(
+        np.asarray(rng.randn(N, T, C, H, W).astype('float32')))
+    out = ex.forward()[0].asnumpy()
+    # i2h default stride (1,1), pad (1,1), kernel (3,3) preserves H, W
+    assert out.shape == (N, T, hid, H, W)
+    assert np.isfinite(out).all()
+    # state carries across steps: step outputs must differ
+    assert np.abs(out[:, 0] - out[:, 1]).max() > 1e-6
+
+
+def test_conv_lstm_backward_and_forget_bias():
+    N, C, H, W = 2, 3, 6, 6
+    hid = 4
+    cell = mx.rnn.ConvLSTMCell(input_shape=(N, C, H, W), num_hidden=hid,
+                               prefix='clstm_', forget_bias=2.0)
+    data = mx.sym.Variable('data')
+    outputs, _ = cell.unroll(2, inputs=data, merge_outputs=True,
+                             layout='NTC')
+    loss = mx.sym.sum(outputs)
+    ex = mx.Executor.simple_bind(loss, shapes={'data': (N, 2, C, H, W)},
+                                 grad_req='write')
+    rng = np.random.RandomState(1)
+    for n, a in ex.arg_dict.items():
+        a._set_data(np.asarray(
+            rng.uniform(-0.1, 0.1, a.shape).astype('float32')))
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict['clstm_i2h_weight'].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0

@@ -1,0 +1,579 @@
+"""Spatial-sampling + contrib op-tail tests.
+
+Reference models: tests/python/unittest/test_operator.py
+(test_bilinear_sampler, test_grid_generator, test_correlation,
+test_spatial_transformer — numpy-reference forward + numeric gradients) and
+the contrib op tests (fft/ifft, count_sketch, quantize, proposal, psroi,
+deformable ops).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+# --- GridGenerator ---------------------------------------------------------
+
+def test_grid_generator_affine_identity():
+    # identity affine params -> pure normalized meshgrid
+    theta = np.array([[1., 0., 0., 0., 1., 0.]], 'float32')
+    g = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                            target_shape=(4, 5)).asnumpy()
+    assert g.shape == (1, 2, 4, 5)
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 5), atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_grid_generator_affine_translation():
+    theta = np.array([[1., 0., 0.25, 0., 1., -0.5]], 'float32')
+    g = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                            target_shape=(3, 3)).asnumpy()
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 3) + 0.25,
+                               atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 3) - 0.5,
+                               atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = np.zeros((2, 2, 4, 6), 'float32')
+    g = mx.nd.GridGenerator(mx.nd.array(flow),
+                            transform_type="warp").asnumpy()
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 6), atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+# --- BilinearSampler / SpatialTransformer ----------------------------------
+
+def _identity_grid(b, h, w):
+    gx, gy = np.meshgrid(np.linspace(-1, 1, w), np.linspace(-1, 1, h))
+    return np.tile(np.stack([gx, gy])[None], (b, 1, 1, 1)).astype('float32')
+
+
+def test_bilinear_sampler_identity():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 5, 7).astype('float32')
+    grid = _identity_grid(2, 5, 7)
+    out = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sampler_oob_zero():
+    x = np.ones((1, 1, 4, 4), 'float32')
+    grid = np.full((1, 2, 2, 2), -3.0, 'float32')  # far outside
+    out = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_bilinear_sampler_grad():
+    rs = np.random.RandomState(1)
+    data = mx.sym.Variable("data")
+    grid = mx.sym.Variable("grid")
+    sym = mx.sym.BilinearSampler(data=data, grid=grid)
+    loc = {"data": rs.randn(1, 2, 4, 4).astype('float32'),
+           "grid": (rs.rand(1, 2, 3, 3).astype('float32') - 0.5)}
+    tu.check_numeric_gradient(sym, loc, rtol=3e-2, atol=3e-3)
+
+
+def test_spatial_transformer_identity():
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 3, 6, 6).astype('float32')
+    loc = np.tile(np.array([[1., 0., 0., 0., 1., 0.]], 'float32'), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(loc),
+                                   target_shape=(6, 6),
+                                   transform_type="affine",
+                                   sampler_type="bilinear").asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_matches_grid_plus_sampler():
+    rs = np.random.RandomState(3)
+    x = rs.randn(1, 2, 5, 5).astype('float32')
+    theta = np.array([[0.8, 0.1, 0.05, -0.1, 0.9, -0.02]], 'float32')
+    st = mx.nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(theta),
+                                  target_shape=(4, 4),
+                                  transform_type="affine",
+                                  sampler_type="bilinear").asnumpy()
+    g = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                            target_shape=(4, 4))
+    bs = mx.nd.BilinearSampler(mx.nd.array(x), g).asnumpy()
+    np.testing.assert_allclose(st, bs, rtol=1e-5, atol=1e-6)
+
+
+# --- Correlation -----------------------------------------------------------
+
+def _np_correlation(d1, d2, kernel_size, max_d, s1, s2, pad, is_multiply):
+    """Direct port of the reference CUDA forward (correlation.cu:44-104)."""
+    b, c, h, w = d1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_d + kr
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    ho = int(np.ceil((ph - 2 * border) / float(s1)))
+    wo = int(np.ceil((pw - 2 * border) / float(s1)))
+    nd = max_d // s2
+    d = 2 * nd + 1
+    out = np.zeros((b, d * d, ho, wo), 'float32')
+    for bi in range(b):
+        for oy in range(ho):
+            for ox in range(wo):
+                y1 = oy * s1 + max_d
+                x1 = ox * s1 + max_d
+                ci = 0
+                for dy in range(-nd, nd + 1):
+                    for dx in range(-nd, nd + 1):
+                        y2, x2 = y1 + dy * s2, x1 + dx * s2
+                        a = p1[bi, :, y1:y1 + kernel_size,
+                               x1:x1 + kernel_size]
+                        bb = p2[bi, :, y2:y2 + kernel_size,
+                                x2:x2 + kernel_size]
+                        v = (a * bb if is_multiply else np.abs(a - bb)).sum()
+                        out[bi, ci, oy, ox] = v / (kernel_size ** 2 * c)
+                        ci += 1
+    return out
+
+
+@pytest.mark.parametrize("k,md,s1,s2,pad,mult", [
+    (1, 1, 1, 1, 1, True),
+    (3, 2, 2, 1, 2, True),
+    (1, 2, 1, 2, 2, False),
+])
+def test_correlation_vs_numpy(k, md, s1, s2, pad, mult):
+    rs = np.random.RandomState(4)
+    d1 = rs.randn(2, 3, 8, 9).astype('float32')
+    d2 = rs.randn(2, 3, 8, 9).astype('float32')
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                            kernel_size=k, max_displacement=md, stride1=s1,
+                            stride2=s2, pad_size=pad,
+                            is_multiply=mult).asnumpy()
+    ref = _np_correlation(d1, d2, k, md, s1, s2, pad, mult)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# --- contrib: fft / ifft / count_sketch / quantize -------------------------
+
+def test_contrib_fft_matches_numpy():
+    rs = np.random.RandomState(5)
+    x = rs.randn(3, 8).astype('float32')
+    out = mx.nd.contrib.fft(mx.nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(out[:, 0::2], ref.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out[:, 1::2], ref.imag, rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_ifft_roundtrip():
+    rs = np.random.RandomState(6)
+    x = rs.randn(2, 16).astype('float32')
+    f = mx.nd.contrib.fft(mx.nd.array(x))
+    # reference ifft is unnormalized (cuFFT): divide by n manually
+    back = (mx.nd.contrib.ifft(f) / 16.0).asnumpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_count_sketch():
+    rs = np.random.RandomState(7)
+    x = rs.randn(4, 6).astype('float32')
+    h = np.array([0, 2, 1, 2, 0, 1], 'float32')
+    s = np.array([1, -1, 1, 1, -1, 1], 'float32')
+    out = mx.nd.contrib.count_sketch(mx.nd.array(x), mx.nd.array(h),
+                                     mx.nd.array(s), out_dim=3).asnumpy()
+    ref = np.zeros((4, 3), 'float32')
+    for i in range(6):
+        ref[:, int(h[i])] += s[i] * x[:, i]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_contrib_quantize_dequantize_roundtrip():
+    x = np.linspace(-1.0, 2.0, 17).astype('float32')
+    q, qmin, qmax = mx.nd.contrib.quantize(
+        mx.nd.array(x), mx.nd.array([-1.0]), mx.nd.array([2.0]))
+    assert q.asnumpy().dtype == np.uint8
+    assert float(qmin.asnumpy()) == -1.0 and float(qmax.asnumpy()) == 2.0
+    back = mx.nd.contrib.dequantize(
+        q, mx.nd.array([-1.0]), mx.nd.array([2.0])).asnumpy()
+    np.testing.assert_allclose(back, x, atol=3.0 / 255 * 3)
+
+
+# --- contrib: Proposal / MultiProposal -------------------------------------
+
+def _np_nms_keep(dets, thresh, post_n):
+    n = dets.shape[0]
+    area = (dets[:, 2] - dets[:, 0] + 1) * (dets[:, 3] - dets[:, 1] + 1)
+    suppressed = np.zeros(n, bool)
+    keep = []
+    for i in range(n):
+        if len(keep) >= post_n:
+            break
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in range(i + 1, n):
+            if suppressed[j]:
+                continue
+            xx1 = max(dets[i, 0], dets[j, 0])
+            yy1 = max(dets[i, 1], dets[j, 1])
+            xx2 = min(dets[i, 2], dets[j, 2])
+            yy2 = min(dets[i, 3], dets[j, 3])
+            inter = max(xx2 - xx1 + 1, 0) * max(yy2 - yy1 + 1, 0)
+            if inter / (area[i] + area[j] - inter) > thresh:
+                suppressed[j] = True
+    return keep
+
+
+def test_proposal_shapes_and_validity():
+    rs = np.random.RandomState(8)
+    h, w, a = 6, 7, 3
+    cls = rs.rand(1, 2 * a, h, w).astype('float32')
+    bbox = (rs.randn(1, 4 * a, h, w) * 0.1).astype('float32')
+    im_info = np.array([[96., 112., 1.0]], 'float32')
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(im_info),
+        feature_stride=16, scales=(2.,), ratios=(0.5, 1., 2.),
+        rpn_pre_nms_top_n=30, rpn_post_nms_top_n=10,
+        threshold=0.7, rpn_min_size=4).asnumpy()
+    assert rois.shape == (10, 5)
+    assert np.isfinite(rois).all()
+    # boxes clipped to the image
+    assert rois[:, 1].min() >= -4 and rois[:, 3].max() <= 112 + 4
+
+
+def test_proposal_matches_numpy_pipeline():
+    rs = np.random.RandomState(9)
+    h, w = 5, 6
+    scales, ratios, stride = (4.,), (1.,), 8
+    a = len(scales) * len(ratios)
+    cls = rs.rand(1, 2 * a, h, w).astype('float32')
+    bbox = (rs.randn(1, 4 * a, h, w) * 0.2).astype('float32')
+    im_info = np.array([[40., 48., 1.0]], 'float32')
+    pre_n, post_n, thresh, min_size = 20, 8, 0.7, 4
+    rois, = [mx.nd.contrib.Proposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(im_info),
+        feature_stride=stride, scales=scales, ratios=ratios,
+        rpn_pre_nms_top_n=pre_n, rpn_post_nms_top_n=post_n,
+        threshold=thresh, rpn_min_size=min_size)]
+    rois = rois.asnumpy()
+    assert rois.shape == (post_n, 5)
+    np.testing.assert_allclose(rois[:, 0], 0.0)
+
+    # numpy reference of the whole pipeline (proposal.cc flow)
+    base = stride
+    size = float(base * base)
+    sr = np.floor(size / 1.0)
+    nw = np.floor(np.sqrt(sr) + 0.5) * scales[0]
+    nh = np.floor(nw / scales[0] * 1.0 + 0.5) * scales[0]
+    ctr = 0.5 * (base - 1.0)
+    anchor = np.array([ctr - 0.5 * (nw - 1), ctr - 0.5 * (nh - 1),
+                       ctr + 0.5 * (nw - 1), ctr + 0.5 * (nh - 1)])
+    props, scores = [], []
+    for yy in range(h):
+        for xx in range(w):
+            box = anchor + np.array([xx * stride, yy * stride,
+                                     xx * stride, yy * stride])
+            d = bbox[0, :, yy, xx]
+            bw = box[2] - box[0] + 1
+            bh = box[3] - box[1] + 1
+            cx = box[0] + 0.5 * (bw - 1)
+            cy = box[1] + 0.5 * (bh - 1)
+            pcx, pcy = d[0] * bw + cx, d[1] * bh + cy
+            pw_, ph_ = np.exp(d[2]) * bw, np.exp(d[3]) * bh
+            x1 = np.clip(pcx - 0.5 * (pw_ - 1), 0, im_info[0, 1] - 1)
+            y1 = np.clip(pcy - 0.5 * (ph_ - 1), 0, im_info[0, 0] - 1)
+            x2 = np.clip(pcx + 0.5 * (pw_ - 1), 0, im_info[0, 1] - 1)
+            y2 = np.clip(pcy + 0.5 * (ph_ - 1), 0, im_info[0, 0] - 1)
+            sc = cls[0, a + 0, yy, xx]
+            real_h, real_w = im_info[0, 0] / stride, im_info[0, 1] / stride
+            if yy >= real_h or xx >= real_w:
+                sc = -1.0
+            iw = x2 - x1 + 1
+            ih = y2 - y1 + 1
+            if iw < min_size or ih < min_size:
+                x1 -= min_size / 2
+                y1 -= min_size / 2
+                x2 += min_size / 2
+                y2 += min_size / 2
+                sc = -1.0
+            props.append([x1, y1, x2, y2])
+            scores.append(sc)
+    props = np.asarray(props, 'float32')
+    scores = np.asarray(scores, 'float32')
+    order = np.argsort(-scores, kind="stable")[:pre_n]
+    dets = props[order]
+    keep = _np_nms_keep(dets, thresh, post_n)
+    expect = dets[[keep[i % len(keep)] for i in range(post_n)]
+                  if len(keep) < post_n else keep[:post_n]]
+    np.testing.assert_allclose(rois[:, 1:], expect, rtol=1e-4, atol=1e-3)
+
+
+def test_proposal_output_score():
+    rs = np.random.RandomState(15)
+    cls = rs.rand(1, 2, 3, 3).astype('float32')
+    bbox = (rs.randn(1, 4, 3, 3) * 0.1).astype('float32')
+    im_info = np.array([[48., 48., 1.0]], 'float32')
+    kw = dict(feature_stride=16, scales=(4.,), ratios=(1.,),
+              rpn_pre_nms_top_n=9, rpn_post_nms_top_n=4,
+              threshold=0.7, rpn_min_size=1)
+    ret = mx.nd.contrib.Proposal(mx.nd.array(cls), mx.nd.array(bbox),
+                                 mx.nd.array(im_info), output_score=True,
+                                 **kw)
+    assert isinstance(ret, list) and len(ret) == 2
+    rois, scores = ret
+    assert rois.shape == (4, 5) and scores.shape == (4, 1)
+    # NMS keeps in score order; the first row is the best surviving score
+    # (later rows may wrap around when fewer than post_n boxes survive)
+    s = scores.asnumpy().ravel()
+    assert np.isfinite(s).all() and s[0] == s.max()
+    # default hides scores
+    only = mx.nd.contrib.Proposal(mx.nd.array(cls), mx.nd.array(bbox),
+                                  mx.nd.array(im_info), **kw)
+    assert not isinstance(only, list)
+
+
+def test_multi_proposal_batch():
+    rs = np.random.RandomState(10)
+    h, w, a, b = 4, 4, 2, 3
+    cls = rs.rand(b, 2 * a, h, w).astype('float32')
+    bbox = (rs.randn(b, 4 * a, h, w) * 0.1).astype('float32')
+    im_info = np.tile(np.array([[64., 64., 1.0]], 'float32'), (b, 1))
+    rois = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(im_info),
+        feature_stride=16, scales=(4., 8.), ratios=(1.,),
+        rpn_pre_nms_top_n=16, rpn_post_nms_top_n=5,
+        threshold=0.7, rpn_min_size=2).asnumpy()
+    assert rois.shape == (b * 5, 5)
+    np.testing.assert_allclose(rois[:, 0],
+                               np.repeat(np.arange(b), 5).astype('float32'))
+
+
+# --- contrib: PSROIPooling -------------------------------------------------
+
+def _np_psroi(data, rois, scale, od, p, g):
+    # float32 throughout — the reference kernel computes bin edges in
+    # float32, and edge ceil/floor results differ from float64 math
+    r = rois.shape[0]
+    _, c, h, w = data.shape
+    f = np.float32
+    scale = f(scale)
+    out = np.zeros((r, od, p, p), 'float32')
+    for n in range(r):
+        bi = int(rois[n, 0])
+        x1 = f(np.round(rois[n, 1]) * scale)
+        y1 = f(np.round(rois[n, 2]) * scale)
+        x2 = f((np.round(rois[n, 3]) + f(1)) * scale)
+        y2 = f((np.round(rois[n, 4]) + f(1)) * scale)
+        rw = max(f(x2 - x1), f(0.1))
+        rh = max(f(y2 - y1), f(0.1))
+        bh, bw = f(rh / f(p)), f(rw / f(p))
+        for ct in range(od):
+            for ph in range(p):
+                for pw_ in range(p):
+                    hs = min(max(int(np.floor(f(f(ph) * bh + y1))), 0), h)
+                    he = min(max(int(np.ceil(f(f(ph + 1) * bh + y1))), 0), h)
+                    ws = min(max(int(np.floor(f(f(pw_) * bw + x1))), 0), w)
+                    we = min(max(int(np.ceil(f(f(pw_ + 1) * bw + x1))), 0), w)
+                    gh = min(max(ph * g // p, 0), g - 1)
+                    gw = min(max(pw_ * g // p, 0), g - 1)
+                    ch = (ct * g + gh) * g + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    out[n, ct, ph, pw_] = data[bi, ch, hs:he, ws:we].mean()
+    return out
+
+
+def test_psroi_pooling_vs_numpy():
+    rs = np.random.RandomState(11)
+    od, p, g = 2, 3, 3
+    data = rs.randn(2, od * g * g, 9, 9).astype('float32')
+    rois = np.array([[0, 0, 0, 32, 32],
+                     [1, 8, 4, 40, 28],
+                     [0, 16, 16, 47, 47]], 'float32')
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.2,
+        output_dim=od, pooled_size=p, group_size=g).asnumpy()
+    ref = _np_psroi(data, rois, 0.2, od, p, g)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# --- contrib: deformable ops ----------------------------------------------
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rs = np.random.RandomState(12)
+    x = rs.randn(2, 4, 7, 7).astype('float32')
+    wgt = rs.randn(6, 4, 3, 3).astype('float32')
+    bias = rs.randn(6).astype('float32')
+    off = np.zeros((2, 2 * 3 * 3, 5, 5), 'float32')
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(wgt),
+        mx.nd.array(bias), kernel=(3, 3), num_filter=6).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(wgt),
+                            mx.nd.array(bias), kernel=(3, 3),
+                            num_filter=6).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_constant_shift():
+    # offset (+1, +1) on a linear ramp == conv of the shifted image interior
+    x = np.arange(36, dtype='float32').reshape(1, 1, 6, 6)
+    wgt = np.ones((1, 1, 1, 1), 'float32')
+    off = np.ones((1, 2, 6, 6), 'float32')
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(wgt),
+        kernel=(1, 1), num_filter=1, no_bias=True).asnumpy()
+    # sample at (y+1, x+1): interior matches x shifted by one row+col
+    np.testing.assert_allclose(out[0, 0, :5, :5], x[0, 0, 1:, 1:],
+                               rtol=1e-5, atol=1e-5)
+    # bottom/right samples fall outside -> 0
+    np.testing.assert_allclose(out[0, 0, 5, :], 0.0)
+    np.testing.assert_allclose(out[0, 0, :, 5], 0.0)
+
+
+def test_deformable_conv_groups():
+    rs = np.random.RandomState(13)
+    x = rs.randn(1, 4, 5, 5).astype('float32')
+    wgt = rs.randn(4, 2, 3, 3).astype('float32')  # 2 groups
+    off = np.zeros((1, 2 * 9, 3, 3), 'float32')
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(wgt),
+        kernel=(3, 3), num_filter=4, num_group=2, no_bias=True).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(wgt),
+                            kernel=(3, 3), num_filter=4, num_group=2,
+                            no_bias=True).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_psroi_no_trans_constant():
+    # constant input -> every non-empty bin pools to that constant
+    od, p = 2, 3
+    g = p
+    data = np.full((1, od * g * g, 8, 8), 2.5, 'float32')
+    rois = np.array([[0, 4, 4, 28, 28]], 'float32')
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(
+            np.zeros((1, 2, p, p), 'float32')),
+        spatial_scale=0.25, output_dim=od, pooled_size=p, group_size=g,
+        part_size=p, sample_per_part=2, trans_std=0.1).asnumpy()
+    np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+
+def test_deformable_psroi_trans_shifts_window():
+    # ramp image: positive x-translation increases pooled value
+    od, p = 1, 1
+    data = np.tile(np.arange(16, dtype='float32')[None, None, None, :],
+                   (1, 1, 16, 1))
+    rois = np.array([[0, 8, 8, 40, 40]], 'float32')
+    trans0 = np.zeros((1, 2, 1, 1), 'float32')
+    trans1 = np.zeros((1, 2, 1, 1), 'float32')
+    trans1[0, 0] = 1.0  # x shift
+    kw = dict(spatial_scale=0.25, output_dim=od, pooled_size=p,
+              group_size=1, part_size=1, sample_per_part=4, trans_std=0.2)
+    o0 = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans0),
+        **kw).asnumpy()
+    o1 = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans1),
+        **kw).asnumpy()
+    assert o1[0, 0, 0, 0] > o0[0, 0, 0, 0]
+
+
+# --- op tail: round/reshape_like/slice_assign/sparse_retain/samplers -------
+
+def test_round_half_away_from_zero():
+    x = mx.nd.array(np.array([-2.5, -1.5, -0.5, 0.5, 1.5, 2.5], 'float32'))
+    np.testing.assert_allclose(mx.nd.round(x).asnumpy(),
+                               [-3., -2., -1., 1., 2., 3.])
+
+
+def test_reshape_like():
+    a = mx.nd.array(np.arange(6, dtype='float32'))
+    b = mx.nd.array(np.zeros((2, 3), 'float32'))
+    out = mx.nd.reshape_like(a, b)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(out.asnumpy().ravel(), np.arange(6))
+
+
+def test_slice_assign_and_scalar():
+    a = mx.nd.array(np.zeros((4, 4), 'float32'))
+    r = mx.nd.array(np.ones((2, 2), 'float32'))
+    out = mx.nd._slice_assign(a, r, begin=(1, 1), end=(3, 3)).asnumpy()
+    assert out[1:3, 1:3].sum() == 4 and out.sum() == 4
+    out2 = mx.nd._slice_assign_scalar(a, scalar=7.0, begin=(0, 0),
+                                      end=(1, 4)).asnumpy()
+    np.testing.assert_allclose(out2[0], 7.0)
+    np.testing.assert_allclose(out2[1:], 0.0)
+
+
+def test_sparse_retain_op():
+    d = mx.nd.array(np.arange(12, dtype='float32').reshape(4, 3))
+    idx = mx.nd.array(np.array([1, 3], 'float32'))
+    out = mx.nd.sparse_retain(d, idx).asnumpy()
+    np.testing.assert_allclose(out[[1, 3]],
+                               np.arange(12).reshape(4, 3)[[1, 3]])
+    np.testing.assert_allclose(out[[0, 2]], 0.0)
+
+
+def test_sample_negative_binomial_moments():
+    k, p = 5.0, 0.4
+    out = mx.nd._sample_negative_binomial(
+        mx.nd.array(np.full((2,), k, 'float32')),
+        mx.nd.array(np.full((2,), p, 'float32')), shape=(4000,)).asnumpy()
+    assert out.shape == (2, 4000)
+    mean = k * (1 - p) / p
+    assert abs(out.mean() - mean) < 0.25 * mean
+    assert (out >= 0).all() and np.allclose(out, np.round(out))
+
+
+def test_sample_generalized_negative_binomial_moments():
+    mu, alpha = 4.0, 0.25
+    out = mx.nd._sample_generalized_negative_binomial(
+        mx.nd.array(np.full((3,), mu, 'float32')),
+        mx.nd.array(np.full((3,), alpha, 'float32')),
+        shape=(4000,)).asnumpy()
+    assert abs(out.mean() - mu) < 1.0
+    # var = mu + alpha*mu^2 = 8
+    assert 4.0 < out.var() < 14.0
+
+
+def test_identity_attach_kl_sparse_reg_grad():
+    rs = np.random.RandomState(14)
+    x = rs.rand(6, 4).astype('float32') * 0.6 + 0.2  # sigmoid-like range
+    data = mx.nd.array(x)
+    data.attach_grad()
+    moving_avg = mx.nd.zeros((4,))
+    rho, penalty, momentum = 0.1, 0.01, 0.9
+    with mx.autograd.record():
+        y = mx.nd.IdentityAttachKLSparseReg(
+            data, moving_avg, sparseness_target=rho, penalty=penalty,
+            momentum=momentum)
+        loss = y.sum()
+    loss.backward()
+    # forward is identity
+    np.testing.assert_allclose(y.asnumpy(), x, rtol=1e-6)
+    # moving avg after one step from 0 init: (1-momentum) * batch mean
+    mu = (1 - momentum) * x.mean(axis=0)
+    expect = 1.0 + penalty * (-rho / mu + (1 - rho) / (1 - mu))
+    np.testing.assert_allclose(data.grad.asnumpy(),
+                               np.tile(expect, (6, 1)), rtol=1e-4)
+
+
+def test_grad_add_and_scatter_aliases():
+    a = mx.nd.array(np.ones((3,), 'float32'))
+    b = mx.nd.array(np.full((3,), 2.0, 'float32'))
+    np.testing.assert_allclose(mx.nd._grad_add(a, b).asnumpy(), 3.0)
+    np.testing.assert_allclose(
+        mx.nd._scatter_minus_scalar(b, scalar=0.5).asnumpy(), 1.5)
+    np.testing.assert_allclose(
+        mx.nd._scatter_elemwise_div(b, a + 1).asnumpy(), 1.0)
+    np.testing.assert_allclose(
+        mx.nd._identity_with_attr_like_rhs(a, b).asnumpy(), 1.0)
+
+
+def test_cast_storage_op_and_legacy_aliases():
+    d = mx.nd.array(np.eye(3, dtype='float32'))
+    np.testing.assert_allclose(mx.nd.cast_storage(d, stype="csr").asnumpy(),
+                               np.eye(3))
+    # legacy _v1 names resolve
+    for name in ("BatchNorm_v1", "Convolution_v1", "Pooling_v1"):
+        assert hasattr(mx.nd, name)
