@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Fine-tune a pretrained checkpoint on a new dataset (reference:
+example/image-classification/fine-tune.py).
+
+The classifier head is cut at the last flatten layer and replaced with a
+fresh FullyConnected + SoftmaxOutput sized for the new task; all other
+weights start from the checkpoint (``get_fine_tune_model``, like the
+reference's).  With --synthetic a small LeNet is first trained and saved,
+then fine-tuned to a different label space — the whole flow runs without
+any downloads.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..'))
+import common  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name='flatten'):
+    """Replace everything above ``layer_name`` with a fresh classifier
+    (reference: fine-tune.py get_fine_tune_model)."""
+    internals = symbol.get_internals()
+    outputs = [o for o in internals.list_outputs()
+               if o.endswith(layer_name + '_output')
+               or (layer_name in o and o.endswith('_output'))]
+    if not outputs:
+        raise ValueError(
+            f"no internal output matching {layer_name!r}; "
+            f"have {internals.list_outputs()[-10:]}")
+    net = internals[outputs[-1]]
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes,
+                                name='fc_finetune')
+    net = mx.sym.SoftmaxOutput(data=net, name='softmax')
+    keep = set(net.list_arguments())
+    new_args = {k: v for k, v in arg_params.items()
+                if k in keep and not k.startswith('fc_finetune')}
+    return net, new_args
+
+
+def _synthetic_data(num_classes, n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, num_classes, (n,)).astype('float32')
+    x = rng.rand(n, 1, 28, 28).astype('float32') * 0.1
+    for i in range(n):
+        c = int(y[i])
+        x[i, 0, (c % 4) * 7:(c % 4) * 7 + 7, :] += 0.8
+    return x, y
+
+
+if __name__ == '__main__':
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    common.add_fit_args(parser)
+    parser.add_argument('--pretrained-model', type=str, default=None,
+                        help='checkpoint prefix to start from')
+    parser.add_argument('--pretrained-epoch', type=int, default=0)
+    parser.add_argument('--layer-name', type=str, default='flatten')
+    parser.add_argument('--num-classes', type=int, default=4)
+    parser.add_argument('--synthetic', action='store_true')
+    parser.set_defaults(network='lenet', num_epochs=2, batch_size=32,
+                        lr=0.01, num_examples=1024)
+    args = parser.parse_args()
+
+    if args.pretrained_model is None:
+        if not args.synthetic:
+            parser.error('--pretrained-model required without --synthetic')
+        # pretrain a tiny LeNet on a 10-class synthetic task, save it
+        prefix = os.path.join(tempfile.mkdtemp(), 'pretrain')
+        net = models.lenet(num_classes=10)
+        x, y = _synthetic_data(10, args.num_examples, seed=0)
+        it = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+        mod = mx.mod.Module(net, context=mx.tpu(0))
+        mod.fit(it, num_epoch=1,
+                optimizer='sgd',
+                optimizer_params={'learning_rate': 0.05},
+                initializer=mx.initializer.Xavier(),
+                batch_end_callback=mx.callback.Speedometer(
+                    args.batch_size, 50))
+        mod.save_checkpoint(prefix, 1)
+        args.pretrained_model, args.pretrained_epoch = prefix, 1
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.pretrained_epoch)
+    net, new_args = get_fine_tune_model(sym, arg_params, args.num_classes,
+                                        args.layer_name)
+
+    x, y = _synthetic_data(args.num_classes, args.num_examples, seed=1)
+    split = min(int(len(y) * 0.9), len(y) - args.batch_size)
+    train = mx.io.NDArrayIter(x[:split], y[:split], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[split:], y[split:], args.batch_size)
+
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.fit(train, val, num_epoch=args.num_epochs,
+            arg_params=new_args, aux_params=aux_params,
+            allow_missing=True,
+            optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9},
+            initializer=mx.initializer.Xavier(rnd_type='gaussian',
+                                              factor_type='in',
+                                              magnitude=2),
+            eval_metric='acc',
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    print('fine-tune done')

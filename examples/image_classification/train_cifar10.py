@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Train ResNet on CIFAR-10 (reference:
+example/image-classification/train_cifar10.py).
+
+With --synthetic (or when the RecordIO files are missing) a generated
+CIFAR-shaped dataset is used so the script runs in no-egress CI; point
+--data-dir at cifar10_train.rec / cifar10_val.rec for the real thing.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..'))
+import common  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def get_cifar_iters(args):
+    rec = os.path.join(args.data_dir, 'cifar10_train.rec')
+    if not args.synthetic and os.path.exists(rec):
+        train = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 28, 28),
+            batch_size=args.batch_size, rand_crop=True, rand_mirror=True,
+            shuffle=True)
+        vrec = os.path.join(args.data_dir, 'cifar10_val.rec')
+        val = (mx.io.ImageRecordIter(
+            path_imgrec=vrec, data_shape=(3, 28, 28),
+            batch_size=args.batch_size) if os.path.exists(vrec) else None)
+        return train, val
+    # synthetic: class = dominant color/position pattern
+    rng = np.random.RandomState(0)
+    n = min(args.num_examples, 5000)
+    y = rng.randint(0, 10, (n,)).astype('float32')
+    x = rng.rand(n, 3, 28, 28).astype('float32') * 0.2
+    for i in range(n):
+        c = int(y[i])
+        x[i, c % 3, (c // 3) * 7:(c // 3) * 7 + 7, :] += 0.7
+    split = int(n * 0.9)
+    train = mx.io.NDArrayIter(x[:split], y[:split], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[split:], y[split:], args.batch_size)
+    return train, val
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    common.add_fit_args(parser)
+    parser.add_argument('--data-dir', type=str, default='data/cifar10')
+    parser.add_argument('--synthetic', action='store_true')
+    parser.set_defaults(network='resnet', num_layers=20, num_epochs=10,
+                        batch_size=128, lr=0.05, num_examples=50000)
+    args = parser.parse_args()
+    net = models.resnet(num_classes=10,
+                        num_layers=getattr(args, 'num_layers', 20) or 20,
+                        image_shape=(3, 28, 28))
+    train, val = get_cifar_iters(args)
+    common.fit(args, net, train, val)

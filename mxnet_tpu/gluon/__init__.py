@@ -14,5 +14,6 @@ from . import rnn
 from . import loss
 from . import data
 from . import model_zoo
+from . import contrib
 from . import utils
 from .utils import split_and_load

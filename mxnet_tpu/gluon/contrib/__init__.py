@@ -1,0 +1,3 @@
+"""Gluon contrib: experimental blocks
+(reference: python/mxnet/gluon/contrib/)."""
+from . import rnn  # noqa: F401

@@ -58,3 +58,40 @@ def test_train_ssd_example():
     log = _run("examples/ssd/train_ssd.py", "--synthetic",
                "--num-epochs", "1", "--batch-size", "4")
     assert "loc_loss" in log
+
+
+def test_train_cifar10_example():
+    log = _run("examples/image_classification/train_cifar10.py",
+               "--synthetic", "--num-epochs", "2", "--batch-size", "32",
+               "--num-examples", "512")
+    assert "Validation-accuracy" in log
+
+
+def test_fine_tune_example():
+    log = _run("examples/image_classification/fine_tune.py",
+               "--synthetic", "--num-epochs", "2", "--batch-size", "32",
+               "--num-examples", "256")
+    assert "fine-tune done" in log
+    assert "Validation-accuracy" in log
+
+
+def test_parse_log_tool():
+    sample = (
+        "INFO:root:Epoch[0] Batch [50]\tSpeed: 1234.5 samples/sec\t"
+        "accuracy=0.5\n"
+        "INFO:root:Epoch[0] Train-accuracy=0.61\n"
+        "INFO:root:Epoch[0] Time cost=12.3\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.55\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.75\n"
+        "INFO:root:Epoch[1] Validation-accuracy=0.70\n")
+    import tempfile
+    with tempfile.NamedTemporaryFile('w', suffix='.log',
+                                     delete=False) as f:
+        f.write(sample)
+        path = f.name
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools/parse_log.py"), path,
+         "--format", "csv"], capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "0,0.61" in out.stdout and "1,0.75" in out.stdout
+    assert "1234.5" in out.stdout

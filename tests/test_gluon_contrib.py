@@ -1,0 +1,95 @@
+"""Gluon contrib tests (model: tests/python/unittest/test_gluon_contrib.py
+— conv RNN cells across 1/2/3 spatial dims + variational dropout)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import contrib
+
+
+@pytest.mark.parametrize("cls,dims,nstates", [
+    (contrib.rnn.Conv1DRNNCell, 1, 1),
+    (contrib.rnn.Conv2DRNNCell, 2, 1),
+    (contrib.rnn.Conv3DRNNCell, 3, 1),
+    (contrib.rnn.Conv1DLSTMCell, 1, 2),
+    (contrib.rnn.Conv2DLSTMCell, 2, 2),
+    (contrib.rnn.Conv3DLSTMCell, 3, 2),
+    (contrib.rnn.Conv1DGRUCell, 1, 1),
+    (contrib.rnn.Conv2DGRUCell, 2, 1),
+    (contrib.rnn.Conv3DGRUCell, 3, 1),
+])
+def test_gluon_conv_cell_step(cls, dims, nstates):
+    N, C, hid = 2, 3, 5
+    spatial = (7,) * dims
+    cell = cls(input_shape=(C,) + spatial, hidden_channels=hid,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.collect_params().initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randn(N, C, *spatial).astype('float32'))
+    states = cell.begin_state(batch_size=N)
+    out, new_states = cell(x, states)
+    assert out.shape == (N, hid) + spatial
+    assert len(new_states) == nstates
+    assert np.isfinite(out.asnumpy()).all()
+    # stateful: a second step from the new state differs
+    out2, _ = cell(x, new_states)
+    assert np.abs(out2.asnumpy() - out.asnumpy()).max() > 1e-7
+
+
+def test_gluon_conv_lstm_unroll_and_grad():
+    N, C, H, W, hid, T = 2, 2, 6, 6, 4, 3
+    cell = contrib.rnn.Conv2DLSTMCell(input_shape=(C, H, W),
+                                      hidden_channels=hid,
+                                      i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.collect_params().initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(1)
+                    .randn(N, T, C, H, W).astype('float32'))
+    with autograd.record():
+        outputs, _ = cell.unroll(T, x, layout='NTC', merge_outputs=True)
+        loss = (outputs ** 2).sum()
+    loss.backward()
+    g = cell.collect_params()[cell.prefix + 'i2h_weight'].grad()
+    assert np.isfinite(g.asnumpy()).all() and np.abs(g.asnumpy()).max() > 0
+
+
+def test_variational_dropout_mask_constant_across_steps():
+    N, I, hid, T = 3, 8, 6, 5
+    base = mx.gluon.rnn.RNNCell(hid, input_size=I)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                              drop_outputs=0.5)
+    cell.collect_params().initialize()
+    rs = np.random.RandomState(2)
+    x = mx.nd.array(np.ones((N, T, I), 'float32'))
+    with autograd.record():
+        outputs, _ = cell.unroll(T, x, layout='NTC', merge_outputs=False)
+    # the input mask is sampled once: zeroed input columns stay zeroed for
+    # every step -> masked input positions identical across time
+    m_in = cell.drop_inputs_mask.asnumpy()
+    assert set(np.unique(m_in.round(4))) <= {0.0, 2.0}
+    m_out = cell.drop_outputs_mask.asnumpy()
+    assert m_out.shape == (N, hid)
+    outs = np.stack([o.asnumpy() for o in outputs], axis=1)
+    # output positions killed by the (step-constant) output mask are zero
+    # at EVERY step
+    killed = m_out == 0.0
+    assert killed.any()
+    assert np.allclose(outs[:, :, :][np.broadcast_to(
+        killed[:, None, :], outs.shape)], 0.0)
+
+
+def test_variational_dropout_eval_mode_identity():
+    base = mx.gluon.rnn.RNNCell(4, input_size=3)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.9,
+                                              drop_outputs=0.9)
+    cell.collect_params().initialize()
+    x = mx.nd.array(np.random.RandomState(3).randn(2, 4, 3)
+                    .astype('float32'))
+    # no autograd.record -> eval mode -> dropout is identity
+    outputs, _ = cell.unroll(4, x, layout='NTC', merge_outputs=True)
+    base2 = mx.gluon.rnn.RNNCell(4, input_size=3,
+                                 params=base.collect_params())
+    cell.reset()
+    ref, _ = base2.unroll(4, x, layout='NTC', merge_outputs=True)
+    np.testing.assert_allclose(outputs.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference: tools/parse_log.py —
+extracts per-epoch train/validation accuracy and throughput from fit()
+logging output).
+
+Usage: python tools/parse_log.py train.log [--format csv|md]
+"""
+import argparse
+import re
+import sys
+
+_EPOCH = re.compile(r'Epoch\[(\d+)\]')
+_TRAIN = re.compile(r'Epoch\[(\d+)\] Train-([\w-]+)=([\d.eE+-]+)')
+_VAL = re.compile(r'Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)')
+_TIME = re.compile(r'Epoch\[(\d+)\] Time cost=([\d.]+)')
+_SPEED = re.compile(r'Epoch\[(\d+)\].*Speed: ([\d.]+) samples/sec')
+
+
+def parse(lines):
+    rows = {}
+
+    def row(e):
+        return rows.setdefault(int(e), {'epoch': int(e)})
+
+    for ln in lines:
+        m = _TRAIN.search(ln)
+        if m:
+            row(m.group(1))['train-' + m.group(2)] = float(m.group(3))
+        m = _VAL.search(ln)
+        if m:
+            row(m.group(1))['val-' + m.group(2)] = float(m.group(3))
+        m = _TIME.search(ln)
+        if m:
+            row(m.group(1))['time'] = float(m.group(2))
+        m = _SPEED.search(ln)
+        if m:
+            r = row(m.group(1))
+            r.setdefault('speeds', []).append(float(m.group(2)))
+    out = []
+    for e in sorted(rows):
+        r = rows[e]
+        sp = r.pop('speeds', None)
+        if sp:
+            r['speed'] = sum(sp) / len(sp)
+        out.append(r)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('logfile', nargs='?', default='-')
+    ap.add_argument('--format', choices=('csv', 'md'), default='md')
+    args = ap.parse_args(argv)
+    lines = (sys.stdin if args.logfile == '-'
+             else open(args.logfile)).readlines()
+    rows = parse(lines)
+    if not rows:
+        print('no epoch records found', file=sys.stderr)
+        return 1
+    cols = ['epoch']
+    for r in rows:
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+    if args.format == 'csv':
+        print(','.join(cols))
+        for r in rows:
+            print(','.join(str(r.get(c, '')) for c in cols))
+    else:
+        print('| ' + ' | '.join(cols) + ' |')
+        print('|' + '---|' * len(cols))
+        for r in rows:
+            print('| ' + ' | '.join(str(r.get(c, '')) for c in cols) + ' |')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
