@@ -29,8 +29,7 @@ def get_fine_tune_model(symbol, arg_params, num_classes,
     (reference: fine-tune.py get_fine_tune_model)."""
     internals = symbol.get_internals()
     outputs = [o for o in internals.list_outputs()
-               if o.endswith(layer_name + '_output')
-               or (layer_name in o and o.endswith('_output'))]
+               if layer_name in o and o.endswith('_output')]
     if not outputs:
         raise ValueError(
             f"no internal output matching {layer_name!r}; "

@@ -120,6 +120,31 @@ class ImageRecordIter(DataIter):
         # the reference's shorter-edge resize) but never below (h, w)
         dec_h = max(h, self.resize) if self.resize > 0 else h
         dec_w = max(w, self.resize) if self.resize > 0 else w
+        if self._native and hasattr(native.get_lib(),
+                                    "jpeg_decode_augment_batch"):
+            # fused native path: decode+crop+mirror+normalize+NCHW in one
+            # OMP pass (io_native.cc jpeg_decode_augment_batch); augmenter
+            # randomness drawn here so semantics match the split path
+            nimg = len(jpegs)
+            # rng is consumed only when a crop actually happens — the same
+            # condition as the split path, so seeds stay reproducible
+            # across both
+            if (dec_h != h or dec_w != w) and self.rand_crop:
+                y0 = self._rng.randint(0, dec_h - h + 1, nimg)
+                x0 = self._rng.randint(0, dec_w - w + 1, nimg)
+            else:
+                y0 = np.full(nimg, (dec_h - h) // 2, np.int32)
+                x0 = np.full(nimg, (dec_w - w) // 2, np.int32)
+            flips = (self._rng.rand(nimg) < 0.5 if self.rand_mirror
+                     else np.zeros(nimg, bool))
+            arr, fails = native.decode_augment_batch(
+                jpegs, dec_h, dec_w, h, w, y0, x0, flips,
+                self.mean.ravel()[:c], self.std.ravel()[:c], c,
+                self.nthreads)
+            if fails:
+                logging.debug("%d corrupt images zero-filled", fails)
+            labels = labels[:, 0] if self.label_width == 1 else labels
+            return arr, labels
         if self._native:
             arr, fails = native.decode_jpeg_batch(
                 jpegs, dec_h, dec_w, c, self.nthreads)
@@ -139,17 +164,21 @@ class ImageRecordIter(DataIter):
                     im = im[:, :, None]
                 outs.append(im)
             arr = np.stack(outs)
-        # random / center crop to (h, w)
+        # random / center crop to (h, w) — offsets drawn vectorized, the
+        # SAME rng consumption as the fused native path, so a given seed
+        # crops identically whether or not the native lib is present
         if arr.shape[1] != h or arr.shape[2] != w:
             H, W = arr.shape[1], arr.shape[2]
-            out = np.empty((arr.shape[0], h, w, c), arr.dtype)
-            for i in range(arr.shape[0]):
-                if self.rand_crop:
-                    y0 = self._rng.randint(0, H - h + 1)
-                    x0 = self._rng.randint(0, W - w + 1)
-                else:
-                    y0, x0 = (H - h) // 2, (W - w) // 2
-                out[i] = arr[i, y0:y0 + h, x0:x0 + w]
+            nimg = arr.shape[0]
+            if self.rand_crop:
+                y0s = self._rng.randint(0, H - h + 1, nimg)
+                x0s = self._rng.randint(0, W - w + 1, nimg)
+            else:
+                y0s = np.full(nimg, (H - h) // 2, np.int64)
+                x0s = np.full(nimg, (W - w) // 2, np.int64)
+            out = np.empty((nimg, h, w, c), arr.dtype)
+            for i in range(nimg):
+                out[i] = arr[i, y0s[i]:y0s[i] + h, x0s[i]:x0s[i] + w]
             arr = out
         # NHWC uint8 -> NCHW float32, mirror, normalize (vectorized)
         arr = arr.transpose(0, 3, 1, 2).astype(np.float32)

@@ -37,8 +37,13 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
+        src = os.path.join(_DIR, "io_native.cc")
+        stale = (os.path.exists(_LIB_PATH)
+                 and os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))
+        if (not os.path.exists(_LIB_PATH) or stale) and not _build():
+            if not os.path.exists(_LIB_PATH):
+                return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -58,6 +63,23 @@ def get_lib():
             ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int]
+        try:
+            # newer symbol — absent from a stale prebuilt .so kept alive
+            # by the build-failure fallback above; callers feature-test
+            # with hasattr(get_lib(), 'jpeg_decode_augment_batch')
+            lib.jpeg_decode_augment_batch.restype = ctypes.c_int
+            lib.jpeg_decode_augment_batch.argtypes = [
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        except AttributeError:
+            pass
         lib.jpeg_probe.restype = ctypes.c_int
         lib.jpeg_probe.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
@@ -137,4 +159,39 @@ def decode_jpeg_batch(jpeg_buffers, height, width, channels=3,
         ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         height, width, channels, nthreads)
+    return out, failures
+
+
+def decode_augment_batch(jpeg_buffers, dec_h, dec_w, out_h, out_w, y0s,
+                         x0s, flips, mean, std, channels=3, nthreads=0):
+    """Fused decode->crop->mirror->normalize->NCHW float32 (one OMP pass).
+
+    The caller draws crop offsets (``y0s``/``x0s``) and mirror ``flips``
+    so RNG stays with the iterator; ``mean``/``std`` are per-channel.
+    Returns (float32[n, channels, out_h, out_w], n_failed_decodes).
+    """
+    lib = get_lib()
+    n = len(jpeg_buffers)
+    arrs = [np.frombuffer(b, dtype=np.uint8) for b in jpeg_buffers]
+    lens = np.array([a.size for a in arrs], dtype=np.int64)
+    arr_t = ctypes.POINTER(ctypes.c_uint8) * n
+    ptrs = arr_t(*[a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+                   for a in arrs])
+    y0s = np.ascontiguousarray(y0s, dtype=np.int32)
+    x0s = np.ascontiguousarray(x0s, dtype=np.int32)
+    flips = np.ascontiguousarray(flips, dtype=np.uint8)
+    mean = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(mean, np.float32).ravel(), (channels,)))
+    std = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(std, np.float32).ravel(), (channels,)))
+    out = np.empty((n, channels, out_h, out_w), dtype=np.float32)
+    failures = lib.jpeg_decode_augment_batch(
+        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        dec_h, dec_w, out_h, out_w, channels,
+        y0s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        x0s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nthreads)
     return out, failures

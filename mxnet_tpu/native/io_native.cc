@@ -233,6 +233,86 @@ int jpeg_decode_resize_batch(const uint8_t** bufs, const int64_t* lens,
   return failures;
 }
 
+// Fused decode -> crop -> mirror -> normalize -> NCHW float32.
+//
+// The Python side draws the stochastic augmenter parameters (crop offsets
+// y0/x0 per image, mirror flags) so RNG semantics stay with the iterator;
+// this kernel does all the byte work in one OMP pass per image: JPEG
+// decode at (dh, dw), crop (oh, ow) at the given offset, optional
+// horizontal mirror, subtract per-channel mean / divide per-channel std,
+// and write channel-first float32 — replacing a per-image Python crop
+// loop plus three full-batch numpy passes (transpose, mirror, normalize).
+//
+// out: float32[n, channels, oh, ow]; y0/x0/flip: per-image arrays;
+// mean/std: per-channel (std entries must be nonzero).
+// Returns the number of failed decodes (slots zero-filled pre-normalize,
+// i.e. they hold (0-mean)/std like the reference's zeroed corrupt slots).
+int jpeg_decode_augment_batch(const uint8_t** bufs, const int64_t* lens,
+                              long n, float* out, int dh, int dw, int oh,
+                              int ow, int channels, const int* y0s,
+                              const int* x0s, const uint8_t* flips,
+                              const float* mean, const float* stdv,
+                              int nthreads) {
+  if (channels < 1 || channels > 8) return -1;
+  if (oh > dh || ow > dw || oh < 1 || ow < 1) return -2;
+  int failures = 0;
+  size_t dec_size = (size_t)dh * dw * channels;
+  size_t out_size = (size_t)oh * ow * channels;
+  float inv_std[8];
+  float mean_c[8];
+  for (int k = 0; k < channels; ++k) {
+    inv_std[k] = 1.0f / stdv[k];
+    mean_c[k] = mean[k];
+  }
+#ifdef _OPENMP
+  if (nthreads > 0) omp_set_num_threads(nthreads);
+#pragma omp parallel reduction(+ : failures)
+#endif
+  {
+    std::vector<uint8_t> scratch;
+    std::vector<uint8_t> dec(dec_size);
+#ifdef _OPENMP
+#pragma omp for schedule(dynamic)
+#endif
+    for (long i = 0; i < n; ++i) {
+      uint8_t* img = dec.data();
+      if (decode_one(bufs[i], lens[i], img, dh, dw, channels, &scratch)) {
+        memset(img, 0, dec_size);
+        failures += 1;
+      }
+      // clamp high first, then low: with oh <= dh (checked above) the
+      // result is always a valid in-bounds corner
+      int y0 = y0s[i], x0 = x0s[i];
+      if (y0 > dh - oh) y0 = dh - oh;
+      if (x0 > dw - ow) x0 = dw - ow;
+      if (y0 < 0) y0 = 0;
+      if (x0 < 0) x0 = 0;
+      const bool flip = flips[i] != 0;
+      float* dst = out + i * out_size;
+      for (int k = 0; k < channels; ++k) {
+        const float m = mean_c[k];
+        const float is = inv_std[k];
+        float* plane = dst + (size_t)k * oh * ow;
+        for (int y = 0; y < oh; ++y) {
+          const uint8_t* src_row =
+              img + ((size_t)(y0 + y) * dw + x0) * channels + k;
+          float* out_row = plane + (size_t)y * ow;
+          if (flip) {
+            const uint8_t* s = src_row + (size_t)(ow - 1) * channels;
+            for (int x = 0; x < ow; ++x, s -= channels)
+              out_row[x] = ((float)*s - m) * is;
+          } else {
+            const uint8_t* s = src_row;
+            for (int x = 0; x < ow; ++x, s += channels)
+              out_row[x] = ((float)*s - m) * is;
+          }
+        }
+      }
+    }
+  }
+  return failures;
+}
+
 // Probe a JPEG's dimensions without a full decode.
 int jpeg_probe(const uint8_t* buf, int64_t len, int* h, int* w, int* c) {
   jpeg_decompress_struct cinfo;

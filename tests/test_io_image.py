@@ -240,3 +240,57 @@ def test_contrast_jitter_preserves_gray_mean():
     lum = (out * np.array([[[0.299, 0.587, 0.114]]])).sum(2)
     np.testing.assert_allclose(lum.mean(), 100.0 * (0.299+0.587+0.114),
                                rtol=0.05)
+
+
+def test_fused_and_split_augment_paths_agree(tmp_path):
+    """The native fused decode+augment kernel and the split
+    (decode + numpy post-process) path must produce the SAME batches for
+    the same seed — including random crop and mirror draws."""
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu import native, recordio
+
+    if not (native.available()
+            and hasattr(native.get_lib(), "jpeg_decode_augment_batch")):
+        pytest.skip("native fused kernel unavailable")
+
+    rec_path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rs = np.random.RandomState(0)
+    for i in range(32):
+        img = (rs.rand(40, 44, 3) * 255).astype("uint8")
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=92)
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i % 5), i, 0),
+                                buf.getvalue()))
+    rec.close()
+
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 32, 32), batch_size=8,
+              rand_crop=True, rand_mirror=True, resize=36, shuffle=True,
+              seed=11, mean_r=10., mean_g=5., mean_b=2.,
+              std_r=3., std_g=3., std_b=3.)
+    it_fused = mx.io.ImageRecordIter(**kw)
+    fused = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+             for b in it_fused]
+
+    lib = native.get_lib()
+
+    class _NoFused:
+        def __getattr__(self, n):
+            if n == "jpeg_decode_augment_batch":
+                raise AttributeError(n)
+            return getattr(lib, n)
+
+    real = native.get_lib
+    native.get_lib = lambda: _NoFused()
+    try:
+        it_split = mx.io.ImageRecordIter(**kw)
+        split = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                 for b in it_split]
+    finally:
+        native.get_lib = real
+
+    assert len(fused) == len(split)
+    for (df, lf), (ds, ls) in zip(fused, split):
+        np.testing.assert_allclose(lf, ls)
+        np.testing.assert_allclose(df, ds, rtol=1e-5, atol=1e-4)
