@@ -311,27 +311,30 @@ class FusedRNN(Initializer):
         self._bidirectional = bidirectional
         self._forget_bias = forget_bias
 
-    def _init_weight(self, desc, arr):
-        try:
-            from .rnn import rnn_cell
-        except ImportError as e:
-            raise RuntimeError(
-                "FusedRNN initializer requires mxnet_tpu.rnn "
-                f"(import failed: {e})")
+    def __call__(self, desc, arr):
+        # packed names ('lstm_parameters') match no suffix pattern, so the
+        # whole init happens here rather than in _init_weight dispatch
+        from .rnn import rnn_cell
         cell = rnn_cell.FusedRNNCell(self._num_hidden, self._num_layers,
                                      self._mode, self._bidirectional,
-                                     forget_bias=self._forget_bias)
+                                     forget_bias=self._forget_bias,
+                                     prefix='')
         args = cell.unpack_weights({'parameters': arr})
-        for name in args:
-            desc2 = InitDesc(name)
-            # for lstm bias, we use a custom initializer which adds a bias to
-            # the forget gate (reference behavior)
-            if self._mode == 'lstm' and name.endswith("_f_bias"):
-                args[name]._set_data(jnp.full(args[name].shape,
-                                              self._forget_bias))
-            elif self._init is not None:
-                self._init(desc2, args[name])
-        arr._set_data(cell.pack_weights(args)['parameters']._data)
+        # per-piece init: the wrapped initializer, else the surrounding
+        # global initializer, else a default — dispatched through
+        # __call__ so pattern-based initializers (Mixed, Load) work
+        inner = self._init or getattr(desc, 'global_init', None) \
+            or Uniform(0.1)
+        for name, blk in args.items():
+            inner(InitDesc(name), blk)
+            # reference behavior: every *_f_bias block (i2h AND h2h) gets
+            # the forget-gate bias after the base init
+            if self._mode == 'lstm' and name.endswith('_f_bias'):
+                blk._set_data(jnp.full(blk.shape, self._forget_bias,
+                                       blk._data.dtype))
+        arr._set_data(
+            cell.pack_weights(args)['parameters']._data.astype(
+                arr._data.dtype))
 
 
 @register
@@ -387,3 +390,4 @@ class Mixed:
         raise ValueError(
             f'Parameter name {name} did not match any pattern. Consider '
             'adding a ".*" pattern at the end with default Initializer.')
+

@@ -185,7 +185,9 @@ class Module(BaseModule):
                         import json as _json
                         klass, kw = _json.loads(attrs[name]['__init__'])
                         init = init_mod.create(klass, **kw)
-                    init(InitDesc(name), arr)
+                    # global_init lets composite inits (FusedRNN) fall
+                    # back to the caller's initializer per weight piece
+                    init(InitDesc(name, global_init=initializer), arr)
 
         cache_arg = arg_params if arg_params is not None else \
             (self._arg_params if self._arg_params else None)

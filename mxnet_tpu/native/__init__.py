@@ -194,4 +194,9 @@ def decode_augment_batch(jpeg_buffers, dec_h, dec_w, out_h, out_w, y0s,
         flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nthreads)
+    if failures < 0:  # guard rejections: the out buffer was never written
+        raise ValueError(
+            f"jpeg_decode_augment_batch rejected arguments (code "
+            f"{failures}): channels must be 1..8 and crop "
+            f"({out_h}x{out_w}) must fit in decode size ({dec_h}x{dec_w})")
     return out, failures

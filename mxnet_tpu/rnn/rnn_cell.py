@@ -317,7 +317,11 @@ class FusedRNNCell(BaseRNNCell):
         self._dropout = dropout
         self._get_next_state = get_next_state
         self._directions = ['l', 'r'] if bidirectional else ['l']
-        self._parameter = self.params.get('parameters')
+        from ..initializer import FusedRNN as _FusedRNNInit
+        self._parameter = self.params.get(
+            'parameters',
+            init=_FusedRNNInit(None, num_hidden, num_layers, mode,
+                               bidirectional, forget_bias))
 
     @property
     def state_info(self):
@@ -383,7 +387,7 @@ class FusedRNNCell(BaseRNNCell):
         return args
 
     def pack_weights(self, args):
-        from ..ndarray.ndarray import zeros as nd_zeros
+        from ..ndarray.ndarray import NDArray as _ND
         args = dict(args)
         w0 = args[f'{self._prefix}l0_i2h'
                   f'{self._gate_names[0]}_weight']
@@ -391,11 +395,14 @@ class FusedRNNCell(BaseRNNCell):
         total = rnn_param_size(self._num_layers, num_input,
                                self._num_hidden, self._bidirectional,
                                self._mode)
-        arr = nd_zeros((total,), dtype=w0.dtype)
-        for name, block in self._slice_weights(arr, num_input,
-                                               self._num_hidden).items():
-            block[:] = args.pop(name)
-        args[self._parameter.name] = arr
+        # assemble on a numpy buffer: numpy slice views write through,
+        # NDArray slice views do not (immutable jax.Array underneath)
+        flat = np.zeros((total,), dtype=np.dtype(w0.dtype))
+        for name, block in self._slice_weights(
+                flat, num_input, self._num_hidden).items():
+            # np.asarray handles NDArray (via __array__) and plain numpy
+            block[...] = np.asarray(args.pop(name))
+        args[self._parameter.name] = _ND(flat)
         return args
 
     def __call__(self, inputs, states):

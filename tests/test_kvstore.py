@@ -1,0 +1,146 @@
+"""Single-process KVStore API tests
+(model: tests/python/unittest/test_kvstore.py — init/push/pull
+aggregation, list keys, string keys, custom updater, set_optimizer,
+row_sparse_pull)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+STR_KEYS = ['b', 'c', 'd']
+
+
+def _init_kv(keys=None, stype_vals=None):
+    kv = mx.kv.create('local')
+    kv.init(3, mx.nd.zeros(SHAPE))
+    if keys is not None:
+        for k in keys:
+            kv.init(k, mx.nd.zeros(SHAPE))
+    return kv
+
+
+def test_single_kv_pair():
+    """init then pull returns the initialized value (reference:
+    test_kvstore.py test_single_kv_pair)."""
+    for key in (3, 'a'):
+        kv = mx.kv.create('local')
+        kv.init(key, mx.nd.ones(SHAPE))
+        val = mx.nd.zeros(SHAPE)
+        kv.pull(key, out=val)
+        np.testing.assert_allclose(val.asnumpy(), 1.0)
+
+
+def test_push_aggregation():
+    """Pushing a list of values for one key sums them (reference:
+    test_kvstore.py push over device list -> CommCPU reduce)."""
+    kv = _init_kv()
+    vals = [mx.nd.ones(SHAPE) * (i + 1) for i in range(4)]
+    kv.push(3, vals)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1 + 2 + 3 + 4)
+
+
+def test_list_kv_pairs():
+    """List-of-keys push/pull (reference: test_list_kv_pair)."""
+    for keys in (KEYS, STR_KEYS):
+        kv = mx.kv.create('local')
+        for k in keys:
+            kv.init(k, mx.nd.zeros(SHAPE))
+        kv.push(keys, [mx.nd.ones(SHAPE) * 4] * len(keys))
+        outs = [mx.nd.zeros(SHAPE) for _ in keys]
+        kv.pull(keys, out=outs)
+        for o in outs:
+            np.testing.assert_allclose(o.asnumpy(), 4.0)
+
+
+def test_updater_runs_on_push():
+    """A custom updater receives (key, recv, stored) per push (reference:
+    test_updater)."""
+    updates = []
+
+    def updater(key, recv, stored):
+        updates.append(key)
+        stored += recv * 2
+
+    kv = _init_kv()
+    kv._set_updater(updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+    kv.push(3, [mx.nd.ones(SHAPE)] * 3)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0 + 6.0)
+    assert updates and all(k == 3 or k == '3' for k in updates)
+
+
+def test_aggregator_then_default_updater():
+    """Default updater = assignment of the aggregate (ParameterServer
+    semantics with no optimizer)."""
+    kv = _init_kv(KEYS)
+    kv.push(KEYS, [[mx.nd.ones(SHAPE)] * 2] * len(KEYS))
+    outs = [mx.nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 2.0)
+
+
+def test_set_optimizer_applies_update():
+    """set_optimizer installs an sgd updater: pull returns
+    weight - lr * grad (reference: update-on-kvstore,
+    kvstore_dist_server.h:131 set_updater)."""
+    kv = mx.kv.create('local')
+    kv.init('w', mx.nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.push('w', mx.nd.ones(SHAPE))  # grad = 1
+    out = mx.nd.zeros(SHAPE)
+    kv.pull('w', out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.5, rtol=1e-5)
+
+
+def test_row_sparse_pull():
+    """row_sparse_pull returns only requested rows populated (reference:
+    PullRowSparseImpl, kvstore_local.h:188)."""
+    kv = mx.kv.create('local')
+    dense = np.arange(12, dtype='float32').reshape(4, 3)
+    kv.init('rs', mx.nd.array(dense))
+    out = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull('rs', out=out, row_ids=mx.nd.array(
+        np.array([1, 3], 'float32')))
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[[1, 3]], dense[[1, 3]])
+    np.testing.assert_allclose(got[[0, 2]], 0.0)
+    # sparse out container: no dense materialization
+    from mxnet_tpu.ndarray import sparse as sp
+    rsp = sp.row_sparse_array((np.zeros((1, 3), 'float32'),
+                               np.array([0])), shape=(4, 3))
+    kv.row_sparse_pull('rs', out=rsp, row_ids=mx.nd.array(
+        np.array([1, 3], 'float32')))
+    np.testing.assert_allclose(np.asarray(rsp.data.asnumpy()),
+                               dense[[1, 3]])
+
+
+def test_pull_into_out_array():
+    kv = _init_kv()
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_kvstore_type_and_rank():
+    for t in ('local', 'device', 'tpu'):
+        kv = mx.kv.create(t)
+        assert kv.rank == 0 and kv.num_workers == 1
+    with pytest.raises(Exception):
+        mx.kv.create('dist_async')
+
+
+def test_init_duplicate_key_raises():
+    kv = mx.kv.create('local')
+    kv.init(9, mx.nd.zeros(SHAPE))
+    with pytest.raises(Exception):
+        kv.init(9, mx.nd.zeros(SHAPE))
