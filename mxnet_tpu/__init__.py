@@ -59,6 +59,7 @@ from . import parallel
 from . import gluon
 from . import rnn
 from . import contrib
+from . import notebook
 from . import rtc
 
 from .ndarray import NDArray

@@ -1,3 +1,4 @@
 """Contrib: experimental / bridge modules (reference: python/mxnet/contrib)."""
+from . import autograd
 from . import tensorboard
 from . import torch

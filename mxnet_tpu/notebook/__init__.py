@@ -1,0 +1,2 @@
+"""Notebook utilities (reference: python/mxnet/notebook/)."""
+from . import callback  # noqa: F401

@@ -143,3 +143,106 @@ def test_tensorboard_negative_step():
     from mxnet_tpu.contrib.tensorboard import _varint
     assert _varint(-1) == b'\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01'
     assert _varint(300) == b'\xac\x02'
+
+
+# --- contrib.autograd (legacy API, reference: contrib/autograd.py) ---------
+
+def test_contrib_autograd_grad_and_loss():
+    from mxnet_tpu.contrib import autograd as cag
+
+    def f(x):
+        return x * x + 2 * x
+
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], 'float32'))
+    grads, loss = cag.grad_and_loss(f)(x)
+    np.testing.assert_allclose(loss.asnumpy(), [3., 8., 15.])
+    np.testing.assert_allclose(grads[0].asnumpy(), [4., 6., 8.])
+
+
+def test_contrib_autograd_grad_only_and_sections():
+    from mxnet_tpu.contrib import autograd as cag
+
+    def f(x):
+        return mx.nd.sum(x * x)
+
+    x = mx.nd.array(np.array([2.0, -1.0], 'float32'))
+    g = cag.grad(f)(x)
+    np.testing.assert_allclose(g[0].asnumpy(), [4., -2.])
+
+    with cag.train_section():
+        assert mx.autograd.is_training()
+        with cag.test_section():
+            assert not mx.autograd.is_training()
+        assert mx.autograd.is_training()
+
+
+def test_contrib_autograd_compute_gradient():
+    from mxnet_tpu.contrib import autograd as cag
+    x = mx.nd.array(np.array([3.0], 'float32'))
+    g = mx.nd.zeros((1,))
+    cag.mark_variables([x], [g])
+    with mx.autograd.record():
+        y = x * x
+    cag.compute_gradient([y])
+    np.testing.assert_allclose(g.asnumpy(), [6.0])
+
+
+# --- notebook callbacks (reference: notebook/callback.py) ------------------
+
+def test_pandas_logger_collects_metrics():
+    from mxnet_tpu.notebook.callback import PandasLogger
+    logger = PandasLogger(batch_size=8, frequent=1)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=3),
+        name='softmax')
+    x = np.random.RandomState(0).randn(32, 6).astype('float32')
+    y = (np.arange(32) % 3).astype('float32')
+    it = mx.io.NDArrayIter(x, y, 8)
+    val = mx.io.NDArrayIter(x, y, 8)
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.fit(it, val, num_epoch=2, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1},
+            initializer=mx.initializer.Xavier(),
+            eval_metric='acc', **logger.callback_args())
+    tdf = logger.train_df
+    edf = logger.eval_df
+    assert len(tdf) > 0 and len(edf) > 0
+    assert 'accuracy' in tdf.columns and 'elapsed' in tdf.columns
+    assert tdf['epoch'].max() == 1
+
+
+def test_live_learning_curve_accumulates():
+    from mxnet_tpu.notebook.callback import LiveLearningCurve
+    curve = LiveLearningCurve('accuracy', frequent=1)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=2),
+        name='softmax')
+    x = np.random.RandomState(1).randn(16, 4).astype('float32')
+    y = (np.arange(16) % 2).astype('float32')
+    it = mx.io.NDArrayIter(x, y, 8)
+    val = mx.io.NDArrayIter(x, y, 8)
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.fit(it, val, num_epoch=2, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1},
+            initializer=mx.initializer.Xavier(),
+            eval_metric='acc', **curve.callback_args())
+    assert len(curve.train_data) > 0
+    assert len(curve.eval_data) > 0
+
+
+def test_contrib_autograd_set_is_training_records():
+    """Legacy combined semantics: set_is_training(True) enables BOTH
+    recording and train mode, so compute_gradient works without an
+    explicit record() scope (reference: MXAutogradSetIsTraining era)."""
+    from mxnet_tpu.contrib import autograd as cag
+    x = mx.nd.array(np.array([2.0], 'float32'))
+    g = mx.nd.zeros((1,))
+    cag.mark_variables([x], [g])
+    prev = cag.set_is_training(True)
+    try:
+        y = x * x * x
+        cag.compute_gradient([y])
+    finally:
+        cag.set_is_training(prev)
+    np.testing.assert_allclose(g.asnumpy(), [12.0])
+    assert not mx.autograd.is_recording()
