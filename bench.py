@@ -86,25 +86,47 @@ def main():
 
 def _run(batch):
     # initialize the backend explicitly, with retries (the single-client
-    # chip tunnel can be transiently held) and a clear diagnostic
+    # chip tunnel can be transiently held) and a clear diagnostic.  An
+    # unhealthy tunnel makes jax.devices() BLOCK rather than raise, so
+    # each attempt runs in a daemon thread with a deadline — a hang still
+    # produces a parseable error line instead of a silent timeout.
+    import threading
     import jax
     dev = None
     err = None
     retries = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "3")))
+    try:
+        deadline = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "120"))
+    except ValueError:
+        _mark("bad BENCH_INIT_TIMEOUT_S; using 120")
+        deadline = 120.0
+    deadline = max(1.0, deadline)
     for attempt in range(retries):
-        try:
-            dev = jax.devices()[0]
+        box = {}
+
+        def _probe(box=box):
+            try:
+                box["dev"] = jax.devices()[0]
+            except Exception as e:  # noqa: BLE001
+                box["err"] = e
+
+        th = threading.Thread(target=_probe, daemon=True)
+        th.start()
+        th.join(deadline)
+        if "dev" in box:
+            dev = box["dev"]
             break
-        except Exception as e:  # noqa: BLE001
-            err = e
-            _mark("backend init attempt %d failed: %s" % (attempt + 1, e))
-            if attempt + 1 < retries:
-                time.sleep(90)
+        err = box.get("err", "timed out after %.0fs (tunnel hang)"
+                      % deadline)
+        _mark("backend init attempt %d failed: %s" % (attempt + 1, err))
+        if attempt + 1 < retries:
+            time.sleep(90)
     if dev is None:
         print(json.dumps({"metric": "resnet50_train_imgs_per_sec",
                           "value": None, "unit": "imgs/sec",
                           "vs_baseline": None,
-                          "error": "backend init failed: %s" % err}))
+                          "error": "backend init failed: %s" % err}),
+              flush=True)
         return 1
     _mark("backend up: %s" % dev.device_kind)
     import jax.numpy as jnp

@@ -26,18 +26,24 @@ def set_is_training(is_train):
 
 
 class TrainingStateScope:
-    """Scope manager saving/restoring the combined training state
-    (reference: contrib/autograd.py:53)."""
+    """Scope manager for the combined training state
+    (reference: contrib/autograd.py:53).  Saves and restores the modern
+    recording/training flags SEPARATELY, so nesting inside
+    ``autograd.record(train_mode=False)``-style split states restores
+    them exactly."""
 
     def __init__(self, enter_state):
         self._enter_state = enter_state
-        self._prev = None
+        self._prev_rec = None
+        self._prev_train = None
 
     def __enter__(self):
-        self._prev = set_is_training(self._enter_state)
+        self._prev_rec = _ag.set_recording(self._enter_state)
+        self._prev_train = _ag.set_training(self._enter_state)
 
     def __exit__(self, ptype, value, trace):
-        set_is_training(self._prev)
+        _ag.set_recording(self._prev_rec)
+        _ag.set_training(self._prev_train)
 
 
 def train_section():
@@ -79,7 +85,8 @@ def grad_and_loss(func, argnum=None):
         for x in variables:
             assert isinstance(x, NDArray), \
                 "type of autograd input should be NDArray"
-        grads = [NDArray(x._data * 0) for x in variables]
+        from ..ndarray import zeros as nd_zeros
+        grads = [nd_zeros(x.shape, dtype=x.dtype) for x in variables]
         mark_variables(variables, grads)
         with _ag.record():
             outputs = func(*args)

@@ -3,10 +3,10 @@
 
 ``PandasLogger`` collects train/eval metrics into pandas DataFrames for
 notebook analysis.  The reference's live-plot layer (LiveBokehChart /
-LiveLearningCurve) depends on bokeh, which this environment doesn't ship;
-``LiveLearningCurve`` here keeps the same callback contract and metric
-accumulation but renders nothing unless bokeh is importable — a
-documented degradation, not an API hole.
+LiveLearningCurve) depends on bokeh, which isn't shipped here;
+``LiveLearningCurve`` keeps the callback contract and metric
+accumulation but does NOT render (with or without bokeh installed) —
+plot the accumulated ``.train_data`` / ``.eval_data`` with any library.
 """
 from __future__ import annotations
 

@@ -246,3 +246,21 @@ def test_contrib_autograd_set_is_training_records():
         cag.set_is_training(prev)
     np.testing.assert_allclose(g.asnumpy(), [12.0])
     assert not mx.autograd.is_recording()
+
+
+def test_contrib_sections_restore_split_state():
+    """Scopes must restore recording/training independently (regression:
+    exiting test_section inside record(train_mode=False) flipped training
+    on)."""
+    from mxnet_tpu.contrib import autograd as cag
+    with mx.autograd.record(train_mode=False):
+        assert mx.autograd.is_recording() and not mx.autograd.is_training()
+        with cag.test_section():
+            assert not mx.autograd.is_recording()
+            assert not mx.autograd.is_training()
+        assert mx.autograd.is_recording() and not mx.autograd.is_training()
+    with mx.autograd.pause(train_mode=True):
+        assert not mx.autograd.is_recording() and mx.autograd.is_training()
+        with cag.train_section():
+            assert mx.autograd.is_recording() and mx.autograd.is_training()
+        assert not mx.autograd.is_recording() and mx.autograd.is_training()
