@@ -24,8 +24,10 @@ from .mesh import (AXES, make_mesh, current_mesh, use_mesh, mesh_shape,
 from .sharding import (ShardingRules, infer_pspec, shard_params,
                        shard_batch, tp_rules_for_symbol)
 from .ring import ring_attention, shard_seq
+from .ulysses import ulysses_attention
 
 __all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh", "mesh_shape",
            "data_pspec", "replicated", "named_sharding", "ShardingRules",
            "infer_pspec", "shard_params", "shard_batch",
-           "tp_rules_for_symbol", "ring_attention", "shard_seq"]
+           "tp_rules_for_symbol", "ring_attention", "shard_seq",
+           "ulysses_attention"]

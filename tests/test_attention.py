@@ -156,3 +156,62 @@ def test_flash_backward_small_blocks():
     for x, y in zip(got, ref):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=2e-3, atol=2e-3)
+
+
+# --- Ulysses all-to-all sequence parallelism (parallel/ulysses.py) ---------
+
+def _full_attn(q, k, v, causal=False):
+    # same oracle as every other test in this file
+    return np.asarray(_attn_reference(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal, None))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full_attention(causal):
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+    n = 4
+    mesh = par.make_mesh(dp=1, sp=n, devices=jax.devices()[:n])
+    rs = np.random.RandomState(0)
+    B, H, S, D = 2, 8, 32, 16
+    q, k, v = (rs.randn(B, H, S, D).astype('float32') for _ in range(3))
+    qs, ks, vs = (par.shard_seq(np.asarray(x), mesh) for x in (q, k, v))
+    out = np.asarray(ulysses_attention(qs, ks, vs, mesh, causal=causal))
+    ref = _full_attn(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_grad_and_ring_agreement():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+    n = 4
+    mesh = par.make_mesh(dp=1, sp=n, devices=jax.devices()[:n])
+    rs = np.random.RandomState(1)
+    B, H, S, D = 1, 4, 16, 8
+    q, k, v = (rs.randn(B, H, S, D).astype('float32') for _ in range(3))
+    qs, ks, vs = (par.shard_seq(np.asarray(x), mesh) for x in (q, k, v))
+
+    def loss_u(a, b, c):
+        return jnp.sum(ulysses_attention(a, b, c, mesh, causal=True) ** 2)
+
+    def loss_r(a, b, c):
+        return jnp.sum(par.ring_attention(a, b, c, mesh, causal=True) ** 2)
+
+    gu = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(qs, ks, vs)
+    gr = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(qs, ks, vs)
+    for a, b in zip(gu, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_ulysses_head_divisibility_error():
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+    mesh = par.make_mesh(dp=1, sp=4, devices=jax.devices()[:4])
+    q = np.zeros((1, 2, 16, 8), 'float32')  # 2 heads < sp=4
+    with pytest.raises(Exception):
+        ulysses_attention(q, q, q, mesh)

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes (reference:
+tools/kill-mxnet.py — pkill of leftover workers on every host).
+
+Single-host equivalent for the local launcher (tools/launch.py): finds
+python processes whose command line OR environment contains the given
+marker and SIGTERMs them, then SIGKILLs survivors.  The default marker
+'DMLC_ROLE=worker' matches every process tools/launch.py spawns (it
+lives in the worker's environment, launch.py:71), so a bare invocation
+cleans up after a crashed launcher run.
+
+Usage: python tools/kill_mxnet.py [pattern]
+"""
+import os
+import signal
+import sys
+import time
+
+
+def _ancestors():
+    """This process plus its parent chain — never kill targets (the
+    launching shell/timeout wrapper's cmdline can contain the pattern)."""
+    skip = set()
+    pid = os.getpid()
+    while pid > 1:
+        skip.add(pid)
+        try:
+            with open(f'/proc/{pid}/stat') as f:
+                pid = int(f.read().split(')')[-1].split()[1])  # ppid
+        except (OSError, ValueError, IndexError):
+            break
+    return skip
+
+
+def find_procs(pattern):
+    pids = []
+    skip = _ancestors()
+    for pid in os.listdir('/proc'):
+        if not pid.isdigit() or int(pid) in skip:
+            continue
+        try:
+            with open(f'/proc/{pid}/cmdline', 'rb') as f:
+                cmd = f.read().replace(b'\0', b' ').decode(errors='replace')
+            with open(f'/proc/{pid}/environ', 'rb') as f:
+                env = f.read().replace(b'\0', b' ').decode(errors='replace')
+        except OSError:
+            continue
+        if 'python' in cmd and 'kill_mxnet' not in cmd \
+                and (pattern in cmd or pattern in env):
+            pids.append(int(pid))
+    return pids
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    pattern = argv[0] if argv else 'DMLC_ROLE=worker'
+    pids = find_procs(pattern)
+    if not pids:
+        print(f'no processes matching {pattern!r}')
+        return 0
+    for pid in pids:
+        print(f'SIGTERM {pid}')
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    time.sleep(2)
+    for pid in find_procs(pattern):
+        print(f'SIGKILL {pid}')
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
