@@ -38,13 +38,14 @@ from .ssd import ssd_vgg16, ssd_toy
 from . import ssd as _ssd
 from .transformer import transformer_lm
 from . import transformer as _transformer
+from . import densenet as _densenet
 
 _REGISTRY = {
     "mlp": _mlp, "lenet": _lenet, "alexnet": _alexnet, "vgg": _vgg,
     "resnet": _resnet, "resnext": _resnext, "inception-bn": _inception_bn,
     "inception_bn": _inception_bn, "inception-v3": _inception_v3,
     "inception_v3": _inception_v3, "mobilenet": _mobilenet,
-    "squeezenet": _squeezenet,
+    "squeezenet": _squeezenet, "densenet": _densenet,
 }
 
 

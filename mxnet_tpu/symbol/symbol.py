@@ -399,6 +399,11 @@ class Symbol:
                     known[n] = np.dtype(t)
         known.update({k: np.dtype(v) for k, v in kwargs.items()
                       if v is not None})
+        # Variable(dtype=...) attrs seed inference like explicit kwargs
+        for node in self.nodes():
+            if node.is_variable and "__dtype__" in node._user_attrs:
+                known.setdefault(node.name,
+                                 np.dtype(node._user_attrs["__dtype__"]))
         # propagate: any explicitly-known dtype becomes the default for all
         # unspecified inputs (the reference's InferType forward/backward
         # propagation collapses to this for homogeneous-dtype graphs)
@@ -693,8 +698,278 @@ def _parse_attr(v, default=None):
 
 
 # ---------------------------------------------------------------------------
-# graph shape/type inference engine
+# partial (bidirectional) shape inference
 # ---------------------------------------------------------------------------
+# The reference's InferShape pass (src/executor/infer_graph_attr_pass.cc:368)
+# iterates forward AND backward so a 0 ("unknown") dim anywhere can be pinned
+# by constraints elsewhere (tests/python/unittest/test_infer_shape.py).  The
+# main engine below is forward abstract interpretation; this fixpoint
+# pre-pass resolves unknown dims for the structural ops where backward
+# propagation matters (elementwise/broadcast binaries, FullyConnected,
+# Convolution, Concat, SliceChannel, shape-preserving unaries), then hands
+# fully-resolved variable shapes to the forward engine.
+
+_SHAPE_PRESERVING_OPS = frozenset({
+    "Activation", "relu", "sigmoid", "tanh", "softsign", "exp", "log",
+    "negative", "abs", "square", "sqrt", "BlockGrad", "stop_gradient",
+    "_copy", "identity", "make_loss", "zeros_like", "ones_like",
+    "LeakyReLU", "softmax", "log_softmax", "Dropout", "BatchNorm",
+    "InstanceNorm", "L2Normalization", "Cast", "cast",
+})
+# strict same-shape binaries: inputs and output all unify dim-wise
+_ELEMWISE_BINARY_OPS = frozenset({
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "elemwise_mod", "_identity_with_attr_like_rhs", "_grad_add",
+})
+# numpy-broadcast binaries: right-aligned, 1s broadcast; unknown input
+# dims fill OPTIMISTICALLY from the output (assume no broadcast), the
+# same call the reference's BinaryBroadcastShape makes
+_BROADCAST_BINARY_OPS = frozenset({
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_mod", "broadcast_power", "broadcast_maximum",
+    "broadcast_minimum", "broadcast_hypot",
+})
+
+
+def _unify_dims(a, b, where=""):
+    """Dim-wise merge of two patterns (None/0 = unknown)."""
+    if a is None:
+        return list(b) if b is not None else None
+    if b is None:
+        return list(a)
+    if len(a) != len(b):
+        raise MXNetError(f"infer_shape: rank mismatch {a} vs {b} {where}")
+    out = []
+    for x, y in zip(a, b):
+        x = None if not x else x
+        y = None if not y else y
+        if x is not None and y is not None and x != y:
+            raise MXNetError(
+                f"infer_shape: inconsistent dims {a} vs {b} {where}")
+        out.append(x if x is not None else y)
+    return out
+
+
+def _partial_prepass(nodes, var_pat, generic_eval=True):
+    """Fixpoint bidirectional dim propagation.  ``var_pat``: id(node) ->
+    list pattern (None = unknown) for variables; mutated in place.
+    ``generic_eval=False`` skips abstract-eval of unhandled ops (used on
+    the fully-specified path, where the main engine traces them anyway —
+    the special-cased rules still run for constraint VALIDATION)."""
+    pat: Dict[Tuple[int, int], list] = {}
+    for n in nodes:
+        if n.is_variable and var_pat.get(id(n)) is not None:
+            pat[(id(n), 0)] = list(var_pat[id(n)])
+
+    def get(src, idx):
+        return pat.get((id(src), idx))
+
+    def put(src, idx, p, where):
+        if p is None:
+            return False
+        merged = _unify_dims(get(src, idx), p, where)
+        if merged != get(src, idx):
+            pat[(id(src), idx)] = merged
+            if src.is_variable:
+                var_pat[id(src)] = merged
+            return True
+        return False
+
+    def complete(p):
+        return p is not None and all(d for d in p)
+
+    for _ in range(3 * len(nodes) + 8):
+        changed = False
+        for n in nodes:
+            if n.is_variable:
+                continue
+            ins = [get(s, i) for s, i in n.inputs]
+            out0 = get(n, 0)
+            op = n.op
+            w = f"at {n.name!r} ({op})"
+            try:
+                if op in _ELEMWISE_BINARY_OPS and len(n.inputs) == 2:
+                    m = _unify_dims(_unify_dims(ins[0], ins[1], w), out0, w)
+                    changed |= put(*n.inputs[0], m, w)
+                    changed |= put(*n.inputs[1], m, w)
+                    changed |= put(n, 0, m, w)
+                elif op in _BROADCAST_BINARY_OPS and len(n.inputs) == 2:
+                    # output rank = max input rank — only deducible when
+                    # both input ranks are known, or pinned by the output
+                    if out0 is not None:
+                        r = len(out0)
+                    elif ins[0] is not None and ins[1] is not None:
+                        r = max(len(ins[0]), len(ins[1]))
+                    else:
+                        continue
+
+                    def aligned(p):
+                        # right-align; absent leading dims behave as 1
+                        if p is None:
+                            return [None] * r
+                        return [1] * (r - len(p)) + list(p)
+
+                    a, b, o = aligned(ins[0]), aligned(ins[1]), \
+                        aligned(out0)
+                    new_a, new_b, new_o = list(a), list(b), list(o)
+                    for d in range(r):
+                        cand = {v for v in (a[d], b[d]) if v and v != 1}
+                        if len(cand) > 1:
+                            raise MXNetError(
+                                f"infer_shape: broadcast mismatch "
+                                f"{ins[0]} vs {ins[1]} {w}")
+                        if cand:
+                            new_o[d] = _unify_dims([o[d]],
+                                                   [cand.pop()], w)[0]
+                        elif a[d] == 1 and b[d] == 1:
+                            new_o[d] = _unify_dims([o[d]], [1], w)[0]
+                        # optimistic backward fill: unknown input dim
+                        # takes the output dim (assume non-broadcast)
+                        if new_o[d]:
+                            if a[d] is None:
+                                new_a[d] = new_o[d]
+                            if b[d] is None:
+                                new_b[d] = new_o[d]
+                    if ins[0] is not None:
+                        changed |= put(*n.inputs[0],
+                                       new_a[r - len(ins[0]):], w)
+                    if ins[1] is not None:
+                        changed |= put(*n.inputs[1],
+                                       new_b[r - len(ins[1]):], w)
+                    changed |= put(n, 0, new_o, w)
+                elif op in _SHAPE_PRESERVING_OPS and n.inputs:
+                    m = _unify_dims(ins[0], out0, w)
+                    changed |= put(*n.inputs[0], m, w)
+                    changed |= put(n, 0, m, w)
+                elif op == "FullyConnected" and \
+                        n.attrs.get("flatten", True) in (True, "True", 1):
+                    # flatten=False keeps leading dims — rank unknown
+                    # here, so that variant stays with the forward engine
+                    nh = int(n.attrs.get("num_hidden", 0))
+                    data = ins[0]
+                    o = _unify_dims(out0, [None, nh], w)
+                    if data is not None and len(data) == 2:
+                        o = _unify_dims(o, [data[0], nh], w)
+                        changed |= put(*n.inputs[0], [o[0], data[1]], w)
+                        if len(n.inputs) > 1 and data[1]:
+                            changed |= put(*n.inputs[1], [nh, data[1]], w)
+                    changed |= put(n, 0, o, w)
+                elif op == "Convolution":
+                    kern = tuple(n.attrs.get("kernel", ()) or ())
+                    rank = len(kern)
+                    if rank and ins[0] is not None \
+                            and len(ins[0]) == rank + 2:
+                        stride = tuple(n.attrs.get("stride", ()) or
+                                       (1,) * rank)
+                        pad = tuple(n.attrs.get("pad", ()) or (0,) * rank)
+                        dil = tuple(n.attrs.get("dilate", ()) or
+                                    (1,) * rank)
+                        nf = int(n.attrs.get("num_filter", 0))
+                        data = list(ins[0])
+                        o = out0 or [None] * (rank + 2)
+                        o = _unify_dims(o, [data[0], nf] + [None] * rank, w)
+                        for d in range(rank):
+                            ke = dil[d] * (kern[d] - 1) + 1
+                            if data[2 + d]:
+                                o[2 + d] = (data[2 + d] + 2 * pad[d]
+                                            - ke) // stride[d] + 1
+                            elif o[2 + d]:
+                                data[2 + d] = ((o[2 + d] - 1) * stride[d]
+                                               - 2 * pad[d] + ke)
+                        data[0] = o[0]
+                        changed |= put(*n.inputs[0], data, w)
+                        changed |= put(n, 0, o, w)
+                elif op in ("Concat", "concat"):
+                    dim = int(n.attrs.get("dim", 1))
+                    parts = [get(s, i) for s, i in n.inputs]
+                    rank = next((len(p) for p in parts + [out0]
+                                 if p is not None), None)
+                    if rank is not None:
+                        dim %= rank
+                        # unify non-concat dims across all parts + output
+                        base = [None] * rank
+                        for p in parts + [out0]:
+                            if p is None:
+                                continue
+                            for d in range(rank):
+                                if d != dim and p[d]:
+                                    base[d] = _unify_dims(
+                                        [base[d]], [p[d]], w)[0]
+                        tot = 0
+                        missing = []
+                        for j, p in enumerate(parts):
+                            if p is not None and p[dim]:
+                                tot += p[dim]
+                            else:
+                                missing.append(j)
+                        o = list(base)
+                        o[dim] = tot if not missing else (
+                            out0[dim] if out0 and out0[dim] else None)
+                        changed |= put(n, 0, o, w)
+                        if out0 and out0[dim] and len(missing) == 1:
+                            j = missing[0]
+                            fill = list(base)
+                            fill[dim] = out0[dim] - tot
+                            changed |= put(*n.inputs[j], fill, w)
+                        for j, p in enumerate(parts):
+                            fill = list(base)
+                            fill[dim] = p[dim] if p and p[dim] else None
+                            changed |= put(*n.inputs[j], fill, w)
+                elif op in ("SliceChannel", "split"):
+                    num = int(n.attrs.get("num_outputs", 1))
+                    axis = int(n.attrs.get("axis", 1))
+                    squeeze = bool(n.attrs.get("squeeze_axis", False))
+                    data = ins[0]
+                    nouts = node_num_outputs(n)
+                    if axis < 0:
+                        # normalize against the INPUT rank (outputs are one
+                        # dim shorter when squeezing)
+                        in_rank = len(data) if data is not None else next(
+                            (len(get(n, i)) + (1 if squeeze else 0)
+                             for i in range(nouts)
+                             if get(n, i) is not None), None)
+                        if in_rank is None:
+                            continue
+                        axis %= in_rank
+                    for i in range(nouts):
+                        oi = get(n, i)
+                        if oi is None and data is None:
+                            continue
+                        if data is not None:
+                            exp = list(data)
+                            exp[axis] = (data[axis] // num
+                                         if data[axis] else None)
+                            if squeeze:
+                                exp = exp[:axis] + exp[axis + 1:]
+                            changed |= put(n, i, exp, w)
+                        if oi is not None:
+                            if squeeze:
+                                back = (list(oi[:axis]) + [num]
+                                        + list(oi[axis:]))
+                            else:
+                                back = list(oi)
+                                back[axis] = (oi[axis] * num
+                                              if oi[axis] else None)
+                            changed |= put(*n.inputs[0], back, w)
+                else:
+                    # generic forward: all inputs complete -> exact eval
+                    if generic_eval and ins and not complete(out0) and \
+                            all(complete(p) for p in ins):
+                        opdef = _reg.get(op)
+                        specs = [jax.ShapeDtypeStruct(tuple(p),
+                                                      np.float32)
+                                 for p in ins]
+                        outs = _eval_node_shape(n, opdef, specs)
+                        for i, sds in enumerate(outs):
+                            changed |= put(n, i, list(sds.shape), w)
+            except MXNetError:
+                raise
+            except Exception:
+                continue
+        if not changed:
+            break
+
+
 def _infer_graph_shapes(sym: Symbol, known_shapes: Dict[str, tuple],
                         known_dtypes: Dict[str, np.dtype],
                         shapes_optional=False, dummy_shapes=False):
@@ -709,6 +984,8 @@ def _infer_graph_shapes(sym: Symbol, known_shapes: Dict[str, tuple],
     var_dtype: Dict[int, np.dtype] = {}
     val: Dict[Tuple[int, int], jax.ShapeDtypeStruct] = {}
 
+    partial_pat: Dict[int, list] = {}
+    has_partial = False
     for n in nodes:
         if n.is_variable:
             shp = known_shapes.get(n.name)
@@ -716,11 +993,33 @@ def _infer_graph_shapes(sym: Symbol, known_shapes: Dict[str, tuple],
                 shp = _parse_attr(n._user_attrs["__shape__"])
             if shp is None and dummy_shapes:
                 shp = (1,)  # dtype-only inference: shapes are throwaway
+            if shp is not None and any(not d for d in shp):
+                # 0 = unknown dim (MXNet convention): resolve via the
+                # bidirectional pre-pass below, not as a literal 0-size
+                partial_pat[id(n)] = [d if d else None for d in shp]
+                shp = None
+                has_partial = True
+            elif shp is not None:
+                partial_pat[id(n)] = list(shp)
             var_shape[id(n)] = tuple(shp) if shp else None
             dt = known_dtypes.get(n.name)
             if dt is None and "__dtype__" in n._user_attrs:
                 dt = np.dtype(n._user_attrs["__dtype__"])
             var_dtype[id(n)] = dt or default_dtype
+
+    if not dummy_shapes:
+        # always: resolves 0-dim unknowns bidirectionally AND validates
+        # caller-supplied shapes against op constraints (the reference's
+        # InferShape CHECKs, e.g. FC weight vs num_hidden)
+        _partial_prepass(nodes, partial_pat, generic_eval=has_partial)
+        # adopt anything the bidirectional pass fully resolved — including
+        # variables that had NO shape hint at all (e.g. an FC weight pinned
+        # purely by backward constraints)
+        for n in nodes:
+            if n.is_variable and var_shape.get(id(n)) is None:
+                p = partial_pat.get(id(n))
+                if p is not None and all(d for d in p):
+                    var_shape[id(n)] = tuple(p)
 
     for n in nodes:
         if n.is_variable:

@@ -19,10 +19,11 @@ _BOOT = (
 )
 
 
-def _run(script, *args, timeout=420):
+def _run(script, *args, timeout=420, env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
     out = subprocess.run(
         [sys.executable, "-c", _BOOT, os.path.join(ROOT, script)]
         + list(args),
@@ -95,3 +96,14 @@ def test_parse_log_tool():
     assert out.returncode == 0
     assert "0,0.61" in out.stdout and "1,0.75" in out.stdout
     assert "1234.5" in out.stdout
+
+
+def test_model_parallel_example():
+    log = _run("examples/model_parallel/train_model_parallel.py",
+               "--synthetic", "--tp", "2", "--num-epochs", "2",
+               "--num-examples", "128", "--batch-size", "16",
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "model-parallel training done" in log
+    # decoder weight (vocab=64, hidden) sharded over tp=2 -> rows halved
+    assert "(32," in log

@@ -1,0 +1,159 @@
+"""Bidirectional / partial shape inference
+(model: tests/python/unittest/test_infer_shape.py — 0 dims are unknowns
+resolved by constraints anywhere in the graph)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp2():
+    data = mx.sym.Variable('data')
+    out = mx.sym.FullyConnected(data=data, name='fc1', num_hidden=1000)
+    out = mx.sym.Activation(data=out, act_type='relu')
+    out = mx.sym.FullyConnected(data=out, name='fc2', num_hidden=10)
+    return out
+
+
+def test_mlp2_infer_shape():
+    out = _mlp2()
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 100))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert out_shapes == [(100, 10)]
+    assert d['fc1_weight'] == (1000, 100)
+    assert d['fc1_bias'] == (1000,)
+    assert d['fc2_weight'] == (10, 1000)
+    assert d['fc2_bias'] == (10,)
+
+
+def test_mlp2_infer_error():
+    out = _mlp2()
+    with pytest.raises(MXNetError):
+        out.infer_shape(data=(100, 100), fc1_weight=(1, 100))
+
+
+def test_backward_infer():
+    """Unknown weight pinned through _identity_with_attr_like_rhs + FC
+    (reference: test_infer_shape.py:48)."""
+    w = mx.sym.Variable("weight")
+    wshift = mx.sym.Variable("wshift", shape=(1,))
+    data = mx.sym.Variable("data")
+    wt = mx.sym.broadcast_add(w, wshift)
+    wt = mx.sym._identity_with_attr_like_rhs(wt, w)
+    net = mx.sym.FullyConnected(data=data, weight=wt, num_hidden=11,
+                                no_bias=True)
+    arg_shapes, _, _ = net.infer_shape(data=(7, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d['weight'] == (11, 100)
+
+
+def test_incomplete_infer_elewise():
+    a = mx.sym.Variable('a', shape=(0, 10))
+    b = mx.sym.Variable('b', shape=(12, 0))
+    c = a + b
+    arg_shapes, _, _ = c.infer_shape()
+    d = dict(zip(c.list_arguments(), arg_shapes))
+    assert d['a'] == (12, 10)
+    assert d['b'] == (12, 10)
+
+
+def test_incomplete_infer_mlp():
+    a = mx.sym.Variable('a', shape=(0, 10))
+    b = mx.sym.FullyConnected(data=a, num_hidden=21)
+    c = mx.sym.Variable('c', shape=(5, 0))
+    d = b + c
+    arg_shapes, _, _ = d.infer_shape()
+    sh = dict(zip(d.list_arguments(), arg_shapes))
+    assert sh['a'] == (5, 10)
+    assert sh['c'] == (5, 21)
+
+
+def test_incomplete_infer_slicechannel():
+    a = mx.sym.Variable('a', shape=(0, 10))
+    b = mx.sym.SliceChannel(data=a, num_outputs=10, axis=1,
+                            squeeze_axis=True)
+    c = mx.sym.Variable('c', shape=(5,))
+    d = b[1] + c
+    arg_shapes, _, _ = d.infer_shape()
+    sh = dict(zip(d.list_arguments(), arg_shapes))
+    assert sh['a'] == (5, 10)
+
+    a = mx.sym.Variable('a', shape=(0, 15, 0))
+    b = mx.sym.SliceChannel(data=a, num_outputs=3, squeeze_axis=False)
+    c = mx.sym.Variable('c', shape=(3, 5, 2))
+    d = b[1] + c
+    arg_shapes, _, _ = d.infer_shape()
+    sh = dict(zip(d.list_arguments(), arg_shapes))
+    assert sh['a'] == (3, 15, 2)
+
+
+def test_incomplete_infer_convolution():
+    a = mx.sym.Variable('a', shape=(0, 10, 0, 0))
+    b = mx.sym.Convolution(data=a, num_filter=21, kernel=(3, 3),
+                           dilate=(1, 1), pad=(1, 1))
+    c = mx.sym.Variable('c', shape=(5, 21, 32, 32))
+    d = b + c
+    arg_shapes, _, _ = d.infer_shape()
+    sh = dict(zip(d.list_arguments(), arg_shapes))
+    assert sh['a'] == (5, 10, 32, 32)
+
+
+def test_incomplete_infer_concat():
+    a = mx.sym.Variable('a', shape=(0, 10))
+    b = mx.sym.Variable('b', shape=(0, 5))
+    c = mx.sym.Concat(a, b, num_args=2, dim=1)
+    d = mx.sym.Variable('d', shape=(2, 0))
+    d = d + c
+    arg_shapes, _, _ = d.infer_shape()
+    sh = dict(zip(d.list_arguments(), arg_shapes))
+    assert sh['a'] == (2, 10)
+    assert sh['b'] == (2, 5)
+    assert sh['d'] == (2, 15)
+
+
+def test_fc_infer_type():
+    data = mx.sym.Variable('data', dtype='float16')
+    out = mx.sym.FullyConnected(data=data, name='fc1', num_hidden=10)
+    arg_types, out_types, _ = out.infer_type()
+    d = dict(zip(out.list_arguments(), arg_types))
+    assert np.dtype(d['data']) == np.float16
+    assert np.dtype(out_types[0]) == np.float16
+
+
+def test_partial_then_executor():
+    """A partially-specified graph resolves and then binds/executes."""
+    a = mx.sym.Variable('a', shape=(0, 6))
+    b = mx.sym.FullyConnected(data=a, num_hidden=4)
+    c = mx.sym.Variable('c', shape=(3, 0))
+    d = b + c
+    arg_shapes, out_shapes, _ = d.infer_shape()
+    assert out_shapes == [(3, 4)]
+    ex = mx.Executor.simple_bind(d, shapes={'a': (3, 6), 'c': (3, 4)})
+    assert ex.forward()[0].shape == (3, 4)
+
+
+def test_fc_flatten_false_higher_rank():
+    """flatten=False FC keeps leading dims (regression: the prepass
+    hard-coded rank-2 output)."""
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=4, flatten=False)
+    out = mx.sym.elemwise_add(fc, mx.sym.Variable('c', shape=(2, 3, 4)))
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(2, 3, 5))
+    assert out_shapes == [(2, 3, 4)]
+
+
+def test_concat_negative_dim():
+    a = mx.sym.Variable('a')
+    b = mx.sym.Variable('b')
+    c = mx.sym.Concat(a, b, num_args=2, dim=-1)
+    _, out_shapes, _ = c.infer_shape(a=(2, 10), b=(2, 5))
+    assert out_shapes == [(2, 15)]
+
+
+def test_slicechannel_negative_axis_squeeze():
+    x = mx.sym.Variable('x')
+    s = mx.sym.SliceChannel(x, num_outputs=2, axis=-1, squeeze_axis=True)
+    d = s[0] + mx.sym.Variable('y', shape=(3, 5))
+    arg_shapes, out_shapes, _ = d.infer_shape(x=(3, 5, 2))
+    assert out_shapes == [(3, 5)]

@@ -23,6 +23,7 @@ SMALL = [
 
 LARGE = [
     ("alexnet", dict(num_classes=1000), (1, 3, 224, 224)),
+    ("densenet", dict(num_layers=121, num_classes=1000), (1, 3, 224, 224)),
     ("vgg", dict(num_layers=11, num_classes=1000), (1, 3, 224, 224)),
     ("inception-bn", dict(num_classes=1000), (1, 3, 224, 224)),
     ("inception-v3", dict(num_classes=1000), (1, 3, 299, 299)),

@@ -117,7 +117,12 @@ def test_unary_forward(op):
 @pytest.mark.parametrize('op', sorted(n for n in UNARY if UNARY[n][3]))
 def test_unary_grad(op):
     fn, lo, hi, _ = UNARY[op]
-    x = RNG.uniform(lo, hi, (2, 3)).astype(np.float32)
+    # per-op deterministic sample: the shared RNG's state depends on test
+    # collection order, which made large-gradient ops (degrees: d/dx =
+    # 57.3) flake on unlucky draws near finite-difference noise
+    import zlib
+    rs = np.random.RandomState(zlib.crc32(op.encode()) % (2 ** 31))
+    x = rs.uniform(lo, hi, (2, 3)).astype(np.float32)
     _check_grad(op, [x])
 
 
