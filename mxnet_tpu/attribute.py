@@ -21,12 +21,15 @@ class AttrScope:
         self._old_scope = None
 
     def get(self, attr):
+        """Merge the scope's attrs with ``attr`` — ALWAYS a fresh dict
+        (callers mutate the result; aliasing the input would leak node
+        attrs like __is_aux__ back into user dictionaries)."""
         if self._attr:
             ret = self._attr.copy()
             if attr:
                 ret.update(attr)
             return ret
-        return attr if attr else {}
+        return dict(attr) if attr else {}
 
     def __enter__(self):
         if not hasattr(AttrScope._current, "value"):

@@ -15,7 +15,7 @@ from .symbol import Symbol, _compose, _skip_args
 def make_sym_func(opdef: _reg.OpDef, name: str):
     def sym_func(*args, **kwargs):
         sym_name = kwargs.pop("name", None)
-        kwargs.pop("attr", None)
+        user_attr = kwargs.pop("attr", None)
         if len(args) == 1 and isinstance(args[0], (list, tuple)) and opdef.variadic:
             args = tuple(args[0])
         if opdef.variadic:
@@ -23,7 +23,8 @@ def make_sym_func(opdef: _reg.OpDef, name: str):
             attrs = {k: v for k, v in kwargs.items()
                      if not isinstance(v, Symbol)}
             inputs += [v for v in kwargs.values() if isinstance(v, Symbol)]
-            return _compose(opdef.name, inputs, attrs, sym_name)
+            return _compose(opdef.name, inputs, attrs, sym_name,
+                            user_attr=user_attr)
         arg_names = list(opdef.arg_names or [])
         aux_names = list(opdef.aux_names or [])
         attrs = {}
@@ -45,7 +46,8 @@ def make_sym_func(opdef: _reg.OpDef, name: str):
             else:
                 break  # remaining become auto-created variables in _compose
         inputs.extend(pos)
-        return _compose(opdef.name, inputs, attrs, sym_name)
+        return _compose(opdef.name, inputs, attrs, sym_name,
+                        user_attr=user_attr)
 
     sym_func.__name__ = name
     sym_func.__doc__ = (opdef.doc or "") + \

@@ -399,15 +399,26 @@ class Symbol:
                     known[n] = np.dtype(t)
         known.update({k: np.dtype(v) for k, v in kwargs.items()
                       if v is not None})
-        # Variable(dtype=...) attrs seed inference like explicit kwargs
+        # Variable(dtype=...) attrs pin that variable; FLOAT attr dtypes
+        # also join the default election below (a float16 data input
+        # retypes the whole homogeneous graph, the reference InferType
+        # behavior) — INTEGER pins do not (an int32 index input must not
+        # retype every untyped parameter)
+        float_attr_dtypes = []
         for node in self.nodes():
             if node.is_variable and "__dtype__" in node._user_attrs:
-                known.setdefault(node.name,
-                                 np.dtype(node._user_attrs["__dtype__"]))
-        # propagate: any explicitly-known dtype becomes the default for all
-        # unspecified inputs (the reference's InferType forward/backward
-        # propagation collapses to this for homogeneous-dtype graphs)
-        default = next(iter(known.values()), np.dtype("float32"))
+                dt = np.dtype(node._user_attrs["__dtype__"])
+                known.setdefault(node.name, dt)
+                if np.issubdtype(dt, np.floating):
+                    float_attr_dtypes.append(dt)
+        # propagate: any explicitly-passed dtype becomes the default for
+        # all unspecified inputs (the reference's InferType propagation
+        # collapses to this for homogeneous-dtype graphs)
+        explicit = [v for k, v in known.items()]
+        float_explicit = [v for v in explicit
+                          if np.issubdtype(v, np.floating)]
+        default = next(iter(float_explicit + float_attr_dtypes),
+                       np.dtype("float32"))
         all_known = dict(known)
         for n in arg_names + self.list_auxiliary_states():
             all_known.setdefault(n, default)
@@ -569,12 +580,14 @@ def _attr_to_str(v):
 # c_api_symbolic.cc)
 # ---------------------------------------------------------------------------
 def _compose(op_name: str, inputs: List[Symbol], attrs: dict,
-             name: Optional[str]) -> Symbol:
+             name: Optional[str], user_attr: Optional[dict] = None) -> Symbol:
     opdef = _reg.get(op_name)
     attrs = {k: v for k, v in attrs.items() if v is not None}
     hint = op_name.lower().lstrip("_")
     name = _name.current().get(name, hint)
-    user_attrs = _attribute.current().get(None)
+    # explicit attr= dict merges over the ambient AttrScope (reference:
+    # atomic-symbol attrs, test_attr.py test_list_attr/test_attr_dict)
+    user_attrs = _attribute.current().get(user_attr)
 
     heads: List[Tuple[Node, int]] = []
     for s in inputs:
@@ -582,7 +595,8 @@ def _compose(op_name: str, inputs: List[Symbol], attrs: dict,
         heads.extend(hs)
 
     if not opdef.variadic:
-        # auto-create missing parameter/aux variables
+        # auto-create missing parameter/aux variables; they inherit the
+        # op's attr dict like the reference's Compose does
         arg_names = list(opdef.arg_names or [])
         aux_names = list(opdef.aux_names or [])
         skip = _skip_args(op_name, attrs)
@@ -591,7 +605,7 @@ def _compose(op_name: str, inputs: List[Symbol], attrs: dict,
         if n_missing > 0:
             for extra in wanted[len(heads):]:
                 is_aux = extra in aux_names
-                v = Variable(f"{name}_{extra}",
+                v = Variable(f"{name}_{extra}", attr=user_attr,
                              __is_aux__="1" if is_aux else None)
                 heads.extend(v._expanded_heads())
 
@@ -908,8 +922,14 @@ def _partial_prepass(nodes, var_pat, generic_eval=True):
                         changed |= put(n, 0, o, w)
                         if out0 and out0[dim] and len(missing) == 1:
                             j = missing[0]
+                            rem = out0[dim] - tot
+                            if rem <= 0:
+                                raise MXNetError(
+                                    f"infer_shape: concat parts sum to "
+                                    f"{tot} but output dim is "
+                                    f"{out0[dim]} {w}")
                             fill = list(base)
-                            fill[dim] = out0[dim] - tot
+                            fill[dim] = rem
                             changed |= put(*n.inputs[j], fill, w)
                         for j, p in enumerate(parts):
                             fill = list(base)

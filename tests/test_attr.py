@@ -1,0 +1,91 @@
+"""Symbol attribute system
+(model: tests/python/unittest/test_attr.py — AttrScope stacking, operator
+attr propagation to weights, pickle round-trip)."""
+import pickle as pkl
+
+import pytest
+
+import mxnet_tpu as mx
+
+
+def contain(x, y):
+    for k, v in x.items():
+        if k not in y:
+            return False
+        if isinstance(y[k], dict):
+            if not isinstance(v, dict) and not contain(v, y[k]):
+                return False
+        elif y[k] != v:
+            return False
+    return True
+
+
+def test_attr_basic():
+    with mx.AttrScope(group='4', data='great'):
+        data = mx.sym.Variable('data',
+                               attr={'dtype': 'data', 'group': '1'})
+        gdata = mx.sym.Variable('data2')
+    assert gdata.attr('group') == '4'
+    assert data.attr('group') == '1'  # explicit beats scope
+    data2 = pkl.loads(pkl.dumps(data))
+    assert data.attr('dtype') == data2.attr('dtype')
+
+
+def test_operator_attr_propagation():
+    data = mx.sym.Variable('data')
+    with mx.AttrScope(__group__='4', __data__='great'):
+        fc1 = mx.sym.Activation(data, act_type='relu')
+        with mx.AttrScope(__init_bias__='0.0'):
+            fc2 = mx.sym.FullyConnected(fc1, num_hidden=10, name='fc2')
+    assert fc1.attr('__data__') == 'great'
+    assert fc2.attr('__data__') == 'great'
+    assert fc2.attr('__init_bias__') == '0.0'
+    fc2copy = pkl.loads(pkl.dumps(fc2))
+    assert fc2copy.tojson() == fc2.tojson()
+    # auto-created weights are reachable through internals
+    assert 'fc2_weight' in fc2.get_internals().list_outputs() \
+        or 'fc2_weight_output' in fc2.get_internals().list_outputs()
+
+
+def test_list_attr():
+    op = mx.sym.Convolution(data=mx.sym.Variable('data'), name='conv',
+                            kernel=(1, 1), num_filter=1,
+                            attr={'__mood__': 'so so'})
+    la = op.list_attr()
+    assert la.get('__mood__') == 'so so'
+
+
+def test_attr_dict():
+    data = mx.sym.Variable('data', attr={'mood': 'angry'})
+    op = mx.sym.Convolution(data=data, name='conv', kernel=(1, 1),
+                            num_filter=1, attr={'__mood__': 'so so'})
+    ad = op.attr_dict()
+    assert ad.get('data', {}).get('mood') == 'angry'
+    assert ad.get('conv', {}).get('__mood__') == 'so so'
+
+
+def test_attr_scope_is_stack():
+    with mx.AttrScope(a='1'):
+        with mx.AttrScope(b='2'):
+            v = mx.sym.Variable('v')
+        w = mx.sym.Variable('w')
+    u = mx.sym.Variable('u')
+    assert v.attr('a') == '1' and v.attr('b') == '2'
+    assert w.attr('a') == '1' and w.attr('b') is None
+    assert u.attr('a') is None
+
+
+def test_attr_dict_not_mutated_and_no_leak():
+    """Regression: op attr= dicts must not be mutated by auto-created aux
+    variables, and __is_aux__ must not leak onto the op node."""
+    d = {'__lr_mult__': '2'}
+    data = mx.sym.Variable('data')
+    bn = mx.sym.BatchNorm(data, name='bn', attr=d)
+    assert d == {'__lr_mult__': '2'}  # untouched
+    assert bn.attr('__is_aux__') is None
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name='fc', attr=d)
+    assert 'fc_weight' in fc.list_arguments()
+    assert 'fc_weight' not in fc.list_auxiliary_states()
+    # aux classification of BN stats still works
+    assert set(bn.list_auxiliary_states()) == {'bn_moving_mean',
+                                               'bn_moving_var'}

@@ -157,3 +157,26 @@ def test_slicechannel_negative_axis_squeeze():
     d = s[0] + mx.sym.Variable('y', shape=(3, 5))
     arg_shapes, out_shapes, _ = d.infer_shape(x=(3, 5, 2))
     assert out_shapes == [(3, 5)]
+
+
+def test_concat_inconsistent_dim_raises():
+    """Regression: an impossible concat split must error, not produce a
+    negative inferred dim."""
+    a = mx.sym.Variable('a', shape=(2, 7))
+    b = mx.sym.Variable('b')
+    c = mx.sym.Concat(a, b, num_args=2, dim=1)
+    d = c + mx.sym.Variable('e', shape=(2, 5))
+    with pytest.raises(MXNetError):
+        d.infer_shape()
+
+
+def test_int_variable_dtype_does_not_poison_defaults():
+    """An int32 index input pins itself but untyped params stay float32."""
+    idx = mx.sym.Variable('idx', dtype='int32')
+    emb = mx.sym.Embedding(idx, input_dim=10, output_dim=4, name='emb')
+    fc = mx.sym.FullyConnected(emb, num_hidden=3, name='fc')
+    arg_types, out_types, _ = fc.infer_type()
+    d = dict(zip(fc.list_arguments(), arg_types))
+    assert np.dtype(d['idx']) == np.int32
+    assert np.dtype(d['emb_weight']) == np.float32
+    assert np.dtype(d['fc_weight']) == np.float32
