@@ -256,6 +256,26 @@ class Module(BaseModule):
             self._exec.copy_params_from(arg, aux, allow_extra_params=True)
             self.params_initialized = True
 
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind for new input shapes, keeping parameters, grad_req and
+        optimizer state (reference: module.py:444 Module.reshape —
+        batch-size or image-size switch without re-initialization).
+
+        Delegates to Executor.reshape — the same path forward() uses for
+        implicit shape changes — which carries params/aux/grad_req/
+        shardings over; each shape gets its own jit program and
+        re-reshaping to a previous shape reuses XLA's compile cache."""
+        assert self.binded and self.params_initialized
+        self._data_shapes, self._label_shapes = _parse_data_desc(
+            self.data_names, self.label_names, data_shapes, label_shapes)
+        new = {d.name: tuple(d.shape) for d in self._data_shapes}
+        if self._label_shapes:
+            new.update({l.name: tuple(l.shape)
+                        for l in self._label_shapes})
+        self._exec = self._exec.reshape(**new)
+        self._apply_shardings()
+        self._fused_step = None
+
     def _reset_bind(self):
         self.binded = False
         self._exec = None
@@ -626,6 +646,33 @@ class Module(BaseModule):
         self._updater = shared_module._updater
         self._opt_states = shared_module._opt_states
         self.optimizer_initialized = True
+
+    def get_states(self, merge_multi_context=True):
+        """Current values of the state inputs, as COPIES (reference:
+        module.py get_states returns merged copies — a later set_states
+        must not mutate what the caller saved, e.g. TBPTT save/restore)."""
+        assert self.binded and self.params_initialized
+        from ..ndarray import NDArray as _ND
+        return [_ND(jnp.asarray(self._exec.arg_dict[n]._data))
+                for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        """Set state inputs from arrays or a scalar fill (reference:
+        module.py set_states)."""
+        assert self.binded and self.params_initialized
+        assert (states is None) != (value is None), \
+            "provide exactly one of states/value"
+        if value is not None:
+            for n in self._state_names:
+                arr = self._exec.arg_dict[n]
+                arr._set_data(jnp.full(arr.shape, value,
+                                       np.dtype(arr.dtype)))
+            return
+        assert len(states) == len(self._state_names), \
+            (len(states), self._state_names)
+        for n, s in zip(self._state_names, states):
+            src = s[0] if isinstance(s, (list, tuple)) else s
+            self._exec.arg_dict[n]._set_data(jnp.asarray(src._data))
 
     def install_monitor(self, mon):
         assert self.binded

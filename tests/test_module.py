@@ -408,3 +408,103 @@ def test_backward_do_mirror_same_numerics():
             os.environ.pop('MXNET_BACKWARD_DO_MIRROR', None)
 
     np.testing.assert_allclose(run(False), run(True), rtol=1e-6, atol=1e-7)
+
+
+def test_module_reshape():
+    """reference: test_module.py test_module_reshape — batch-size switch
+    keeps params and optimizer state."""
+    data = mx.sym.Variable('data')
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name='fc'),
+        name='softmax')
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.bind(data_shapes=[('data', (8, 6))],
+             label_shapes=[('softmax_label', (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9})
+    rs = np.random.RandomState(0)
+    b8 = mx.io.DataBatch([mx.nd.array(rs.randn(8, 6).astype('float32'))],
+                         [mx.nd.array((np.arange(8) % 4)
+                                      .astype('float32'))])
+    mod.forward(b8, is_train=True)
+    mod.update()
+    w_before = mod._exec.arg_dict['fc_weight'].asnumpy().copy()
+    mom_before = np.asarray(
+        [np.asarray(s.asnumpy()) for s in mod._opt_states['fc_weight']][-1])
+    assert np.abs(mom_before).max() > 0  # momentum accumulated in step 1
+
+    # reshape to batch 2: params, grad_req and optimizer state survive
+    mod.reshape(data_shapes=[('data', (2, 6))],
+                label_shapes=[('softmax_label', (2,))])
+    np.testing.assert_allclose(
+        mod._exec.arg_dict['fc_weight'].asnumpy(), w_before)
+    mom_after_reshape = np.asarray(
+        [np.asarray(s.asnumpy()) for s in mod._opt_states['fc_weight']][-1])
+    np.testing.assert_allclose(mom_after_reshape, mom_before)
+    b2 = mx.io.DataBatch([mx.nd.array(rs.randn(2, 6).astype('float32'))],
+                         [mx.nd.array(np.array([0., 1.], 'float32'))])
+    mod.forward(b2, is_train=True)
+    assert mod.get_outputs()[0].shape == (2, 4)
+    mod.update()
+    w_after = mod._exec.arg_dict['fc_weight'].asnumpy()
+    assert np.abs(w_after - w_before).max() > 0
+
+    # and back up to batch 8
+    mod.reshape(data_shapes=[('data', (8, 6))],
+                label_shapes=[('softmax_label', (8,))])
+    mod.forward(b8, is_train=True)
+    assert mod.get_outputs()[0].shape == (8, 4)
+
+
+def test_module_states():
+    """reference: test_module.py test_module_states — RNN hidden state
+    carried across batches via state_names + get/set_states."""
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(2):
+        stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix='lstm_l%d_' % i))
+    begin_state = stack.begin_state(func=mx.sym.Variable)
+    _, states = stack.unroll(5, begin_state=begin_state,
+                             inputs=mx.sym.Variable('data'))
+    state_names = [i.name for i in begin_state]
+    mod = mx.mod.Module(mx.sym.Group(states), context=mx.cpu(0),
+                        label_names=None, state_names=state_names)
+    mod.bind(data_shapes=[('data', (4, 5, 6))], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    batch = mx.io.DataBatch(data=[mx.nd.zeros((4, 5, 6))], label=[])
+
+    mod.set_states(value=1)
+    st = mod.get_states()
+    assert len(st) == len(state_names)
+    np.testing.assert_allclose(st[0].asnumpy(), 1.0)
+    mod.forward(batch)
+    out1 = [o.asnumpy() for o in mod.get_outputs()]
+
+    # feed the outputs back as states: results must differ from the
+    # all-ones state run
+    mod.set_states(states=mod.get_outputs())
+    mod.forward(batch)
+    out2 = [o.asnumpy() for o in mod.get_outputs()]
+    assert any(np.abs(a - b).max() > 1e-4 for a, b in zip(out1, out2))
+
+
+def test_get_states_returns_copies():
+    """Regression: get_states must copy — set_states(value=0) after a
+    save must not zero the saved arrays (TBPTT save/restore)."""
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix='l_')
+    begin = cell.begin_state(func=mx.sym.Variable)
+    outs, states = cell.unroll(2, inputs=mx.sym.Variable('data'),
+                               begin_state=begin, merge_outputs=True)
+    mod = mx.mod.Module(mx.sym.Group([outs] + states), context=mx.cpu(0),
+                        label_names=None,
+                        state_names=[s.name for s in begin])
+    mod.bind(data_shapes=[('data', (2, 2, 3))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    mod.set_states(value=7)
+    saved = mod.get_states()
+    mod.set_states(value=0)
+    np.testing.assert_allclose(saved[0].asnumpy(), 7.0)  # copy survived
+    mod.set_states(states=saved)
+    np.testing.assert_allclose(mod.get_states()[0].asnumpy(), 7.0)
