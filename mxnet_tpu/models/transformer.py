@@ -17,19 +17,33 @@ new-capability track).  TPU-first by construction:
 from .. import symbol as sym
 
 
-def _attention_block(x, seq_len, d_model, num_heads, name):
-    """x: (B, S, d) → (B, S, d) causal flash attention + projection."""
+def _attention_block(x, seq_len, d_model, num_heads, name,
+                     num_kv_heads=None):
+    """x: (B, S, d) → (B, S, d) causal flash attention + projection.
+
+    ``num_kv_heads < num_heads`` = grouped-query attention (num_kv_heads=1
+    is MQA): the QKV projection emits only num_kv_heads K/V heads and the
+    flash kernel shares them per query-head group without materializing
+    repeats — smaller KV projection params and KV cache."""
     h = num_heads
+    hk = h if num_kv_heads is None else num_kv_heads
+    if hk < 1 or h % hk:
+        raise ValueError(f"num_heads {h} not divisible by kv heads {hk}")
     hd = d_model // h
     flat = sym.Reshape(x, shape=(-1, d_model))
-    qkv = sym.FullyConnected(flat, num_hidden=3 * d_model,
+    qkv = sym.FullyConnected(flat, num_hidden=(h + 2 * hk) * hd,
                              name=f"{name}_qkv")
-    qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3, h, hd))
-    qkv = sym.transpose(qkv, axes=(2, 0, 3, 1, 4))   # (3, B, H, S, hd)
-    q = sym.squeeze(sym.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
-    k = sym.squeeze(sym.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
-    v = sym.squeeze(sym.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
-    attn = sym.contrib.FlashAttention(q, k, v, causal=True,
+    q = sym.slice_axis(qkv, axis=1, begin=0, end=h * hd)
+    k = sym.slice_axis(qkv, axis=1, begin=h * hd, end=(h + hk) * hd)
+    v = sym.slice_axis(qkv, axis=1, begin=(h + hk) * hd,
+                       end=(h + 2 * hk) * hd)
+
+    def heads(t, nh):
+        t = sym.Reshape(t, shape=(-1, seq_len, nh, hd))
+        return sym.transpose(t, axes=(0, 2, 1, 3))    # (B, nh, S, hd)
+
+    attn = sym.contrib.FlashAttention(heads(q, h), heads(k, hk),
+                                      heads(v, hk), causal=True,
                                       name=f"{name}_flash")
     attn = sym.transpose(attn, axes=(0, 2, 1, 3))     # (B, S, H, hd)
     attn = sym.Reshape(attn, shape=(-1, d_model))
@@ -63,8 +77,8 @@ def _ffn_block(x, seq_len, d_model, d_ff, name, moe_experts=0, moe_k=1):
 
 
 def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
-                   num_heads=4, d_ff=None, moe_experts=0, moe_k=1,
-                   max_len=None):
+                   num_heads=4, num_kv_heads=None, d_ff=None,
+                   moe_experts=0, moe_k=1, max_len=None):
     """Causal LM train symbol: data (B, S) token ids,
     softmax_label (B, S) next-token ids.
 
@@ -87,7 +101,8 @@ def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
     for i in range(num_layers):
         name = f"layer{i}"
         a = _attention_block(sym.LayerNorm(x, name=f"{name}_ln1"),
-                             seq_len, d_model, num_heads, name)
+                             seq_len, d_model, num_heads, name,
+                             num_kv_heads=num_kv_heads)
         x = x + a
         f = _ffn_block(sym.LayerNorm(x, name=f"{name}_ln2"),
                        seq_len, d_model, d_ff, name,

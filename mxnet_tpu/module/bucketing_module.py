@@ -88,6 +88,16 @@ class BucketingModule(BaseModule):
         assert self.binded and self.params_initialized
         return self._curr_module.get_params()
 
+    def get_states(self, merge_multi_context=True):
+        """reference: bucketing_module.py get_states — delegates to the
+        current bucket's module (states are shared via shared_module)."""
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        self._curr_module.set_states(states=states, value=value)
+
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         if not allow_missing:

@@ -34,11 +34,18 @@ def _pick_interpret():
                                              "return_lse"))
 def _flash_fwd(q, k, v, causal=False, scale=None, block_q=128,
                block_k=128, interpret=None, return_lse=False):
-    """q: (B, H, Sq, D); k/v: (B, H, Sk, D) → (B, H, Sq, D)
+    """q: (B, H, Sq, D); k/v: (B, Hk, Sk, D) with Hk dividing H (GQA/MQA:
+    each group of H/Hk query heads shares one KV head — the kernel maps
+    query-head programs onto the shared KV block, so grouped KV is NEVER
+    materialized at H heads) → (B, H, Sq, D)
     [, lse (B, H, Sq) when return_lse — consumed by the Pallas backward]."""
     from jax.experimental import pallas as pl
 
     B, H, Sq, D = q.shape
+    Hk = k.shape[1]
+    if H % Hk:
+        raise ValueError(f"q heads {H} not divisible by kv heads {Hk}")
+    G = H // Hk
     Sk = k.shape[2]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -107,13 +114,14 @@ def _flash_fwd(q, k, v, causal=False, scale=None, block_q=128,
             lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
     qr = qp.reshape(B * H, Sqp, Dp)
-    kr = kp.reshape(B * H, Skp, Dp)
-    vr = vp.reshape(B * H, Skp, Dp)
+    kr = kp.reshape(B * Hk, Skp, Dp)
+    vr = vp.reshape(B * Hk, Skp, Dp)
 
+    # program b walks q heads; its KV head is b // G (GQA sharing)
     in_specs = [
         pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, Skp, Dp), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, Skp, Dp), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, Skp, Dp), lambda b, i: (b // G, 0, 0)),
+        pl.BlockSpec((1, Skp, Dp), lambda b, i: (b // G, 0, 0)),
     ]
     if return_lse:
         out, lse = pl.pallas_call(
@@ -144,10 +152,15 @@ def _flash_fwd(q, k, v, causal=False, scale=None, block_q=128,
 
 
 def _attn_reference(q, k, v, causal, scale):
-    """Plain-XLA attention used by the recompute backward."""
+    """Plain-XLA attention oracle (supports GQA: kv heads dividing q
+    heads are broadcast per group)."""
     D = q.shape[-1]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    if k.shape[1] != q.shape[1]:
+        g = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -171,6 +184,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal=False, scale=None,
     from jax.experimental import pallas as pl
 
     B, H, Sq, D = q.shape
+    Hk = k.shape[1]
+    G = H // Hk  # GQA group size (validated in the forward)
     Sk = k.shape[2]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -190,10 +205,10 @@ def _flash_bwd(q, k, v, out, lse, g, causal=False, scale=None,
 
     def padp(x, pad_s):
         return jnp.pad(x, ((0, 0), (0, 0), (0, pad_s), (0, Dp - D))) \
-            .reshape(B * H, -1, Dp)
+            .reshape(-1, x.shape[2] + pad_s, Dp)
 
     qr, gr = padp(q, pad_q), padp(g, pad_q)
-    kr, vr = padp(k, pad_k), padp(v, pad_k)
+    kr, vr = padp(k, pad_k), padp(v, pad_k)  # (B*Hk, Skp, Dp)
     # pad lse with +inf-ish so padded rows give p = exp(-inf) = 0
     lser = jnp.pad(lse.astype(f32), ((0, 0), (0, 0), (0, pad_q)),
                    constant_values=1e30).reshape(B * H, Sqp)
@@ -240,8 +255,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal=False, scale=None,
         grid=(B * H, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Skp, Dp), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Skp, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Skp, Dp), lambda b, i: (b // G, 0, 0)),
+            pl.BlockSpec((1, Skp, Dp), lambda b, i: (b // G, 0, 0)),
             pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
@@ -292,13 +307,16 @@ def _flash_bwd(q, k, v, out, lse, g, causal=False, scale=None,
         dk_ref[0] = dk_acc.astype(dk_ref.dtype)
         dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
+    # dk/dv come out PER QUERY HEAD (grid over B*H, KV indexed b//G); the
+    # GQA reduction over each group's G query heads happens outside the
+    # kernel — a (B, Hk, G, S, D) sum XLA fuses with the reshape
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, nk),
         in_specs=[
             pl.BlockSpec((1, Sqp, Dp), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b // G, i, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b // G, i, 0)),
             pl.BlockSpec((1, Sqp, Dp), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sqp), lambda b, i: (b, 0)),
             pl.BlockSpec((1, Sqp), lambda b, i: (b, 0)),
@@ -308,21 +326,25 @@ def _flash_bwd(q, k, v, out, lse, g, causal=False, scale=None,
             pl.BlockSpec((1, block_k, Dp), lambda b, i: (b, i, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((B * H, Skp, Dp), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Skp, Dp), v.dtype),
+            jax.ShapeDtypeStruct((B * H, Skp, Dp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Skp, Dp), jnp.float32),
         ),
         interpret=interpret,
     )(qr, kr, vr, gr, lser, deltar)
 
     dq = dq.reshape(B, H, Sqp, Dp)[:, :, :Sq, :D]
-    dk = dk.reshape(B, H, Skp, Dp)[:, :, :Sk, :D]
-    dv = dv.reshape(B, H, Skp, Dp)[:, :, :Sk, :D]
+    dk = dk.reshape(B, Hk, G, Skp, Dp).sum(axis=2)[:, :, :Sk, :D] \
+        .astype(k.dtype)
+    dv = dv.reshape(B, Hk, G, Skp, Dp).sum(axis=2)[:, :, :Sk, :D] \
+        .astype(v.dtype)
     return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal=False, scale=None):
-    """Blocked online-softmax attention.  q/k/v: (B, H, S, D)."""
+    """Blocked online-softmax attention.  q: (B, H, S, D); k/v:
+    (B, Hk, S, D) with Hk dividing H — Hk < H is grouped-query /
+    multi-query attention with the shared KV never materialized."""
     return _flash_fwd(q, k, v, causal=causal, scale=scale)
 
 

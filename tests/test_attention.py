@@ -215,3 +215,56 @@ def test_ulysses_head_divisibility_error():
     q = np.zeros((1, 2, 16, 8), 'float32')  # 2 heads < sp=4
     with pytest.raises(Exception):
         ulysses_attention(q, q, q, mesh)
+
+
+# --- grouped-query / multi-query attention (GQA) ---------------------------
+
+@pytest.mark.parametrize("hk,causal", [(2, False), (2, True), (1, True)])
+def test_flash_gqa_matches_repeated_kv(hk, causal):
+    """flash_attention with Hk kv heads == full attention with the kv
+    heads explicitly repeated per group (Hk=1 is MQA)."""
+    rs = np.random.RandomState(0)
+    B, H, S, D = 2, 4, 48, 16
+    q = rs.randn(B, H, S, D).astype('float32')
+    k = rs.randn(B, hk, S, D).astype('float32')
+    v = rs.randn(B, hk, S, D).astype('float32')
+    out = np.asarray(flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal, None))
+    g = H // hk
+    ref = np.asarray(_attn_reference(
+        jnp.asarray(q), jnp.asarray(np.repeat(k, g, axis=1)),
+        jnp.asarray(np.repeat(v, g, axis=1)), causal, None))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_gqa_gradients():
+    """GQA backward: dq/dk/dv match autodiff through the repeated-KV
+    reference (dk/dv sum over the group's query heads)."""
+    rs = np.random.RandomState(1)
+    B, H, Hk, S, D = 1, 4, 2, 32, 8
+    q = jnp.asarray(rs.randn(B, H, S, D).astype('float32'))
+    k = jnp.asarray(rs.randn(B, Hk, S, D).astype('float32'))
+    v = jnp.asarray(rs.randn(B, Hk, S, D).astype('float32'))
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, True, None) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        g = H // Hk
+        return jnp.sum(_attn_reference(
+            q_, jnp.repeat(k_, g, axis=1), jnp.repeat(v_, g, axis=1),
+            True, None) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_flash_gqa_bad_heads_raises():
+    q = jnp.zeros((1, 4, 16, 8))
+    k = jnp.zeros((1, 3, 16, 8))
+    with pytest.raises(ValueError):
+        flash_attention(q, k, k, False, None)

@@ -508,3 +508,12 @@ def test_get_states_returns_copies():
     np.testing.assert_allclose(saved[0].asnumpy(), 7.0)  # copy survived
     mod.set_states(states=saved)
     np.testing.assert_allclose(mod.get_states()[0].asnumpy(), 7.0)
+
+
+def test_reshape_requires_labels_when_bound_with_labels():
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[('data', (8, 2))],
+             label_shapes=[('softmax_label', (8,))])
+    mod.init_params()
+    with pytest.raises(mx.base.MXNetError):
+        mod.reshape(data_shapes=[('data', (2, 2))])

@@ -134,3 +134,38 @@ def test_transformer_bucketing_variable_seqlen():
             eval_metric=mx.metric.Perplexity(ignore_label=None))
     # both bucket executors were created and trained
     assert len(mod._buckets) >= 2
+
+
+def test_transformer_gqa_trains():
+    """GQA flagship config: 2 kv heads shared across 4 query heads; loss
+    decreases and the QKV projection is smaller than full MHA."""
+    V, S = 40, 16
+    net = models.transformer_lm(V, S, num_layers=1, d_model=32, num_heads=4,
+                         num_kv_heads=2)
+    rs = np.random.RandomState(0)
+    first = rs.randint(0, V, (64, 1))
+    seq = (first + np.arange(S + 1)) % V
+    x = seq[:, :S].astype('float32')
+    y = seq[:, 1:].astype('float32')
+    it = mx.io.NDArrayIter(x, y, 16)
+    mod = mx.mod.Module(net, context=mx.cpu(0),
+                        data_names=('data',),
+                        label_names=('softmax_label',))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    # GQA qkv projection: (h + 2*hk) * hd = (4+4)*8 = 64 < 3*32
+    assert mod._exec.arg_dict['layer0_qkv_weight'].shape[0] == 64
+    mod.init_optimizer(optimizer='adam',
+                       optimizer_params={'learning_rate': 3e-3})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    ppls = []
+    for epoch in range(8):
+        it.reset()
+        metric.reset()
+        for b in it:
+            mod.forward(b, is_train=True)
+            mod.update_metric(metric, b.label)
+            mod.backward()
+            mod.update()
+        ppls.append(dict(metric.get_name_value())['perplexity'])
+    assert ppls[-1] < ppls[0] / 1.5, ppls

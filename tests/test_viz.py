@@ -1,0 +1,49 @@
+"""Visualization utilities (model: tests/python/unittest/test_viz.py)."""
+import io
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _net():
+    data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    net = mx.sym.Activation(net, act_type='relu', name='relu1')
+    net = mx.sym.BatchNorm(net, name='bn1')
+    net = mx.sym.FullyConnected(net, num_hidden=4, name='fc2')
+    return mx.sym.SoftmaxOutput(net, name='softmax')
+
+
+def test_print_summary_with_shapes(capsys):
+    mx.visualization.print_summary(_net(), shape={'data': (2, 8)})
+    out = capsys.readouterr().out
+    # every layer appears with its output shape and param count
+    assert 'fc1' in out and 'fc2' in out and 'bn1' in out
+    assert '16' in out
+    # fc1: 8*16 weights + 16 bias = 144
+    assert '144' in out
+    assert 'Total params' in out
+
+
+def test_print_summary_without_shapes(capsys):
+    mx.visualization.print_summary(_net())
+    out = capsys.readouterr().out
+    assert 'softmax' in out
+
+
+def test_print_summary_type_error():
+    with pytest.raises(TypeError):
+        mx.visualization.print_summary("not a symbol")
+
+
+def test_plot_network():
+    try:
+        import graphviz  # noqa: F401
+    except ImportError:
+        pytest.skip("graphviz not installed")
+    g = mx.visualization.plot_network(_net(), shape={'data': (2, 8)})
+    src = g.source
+    assert 'fc1' in src and 'softmax' in src
