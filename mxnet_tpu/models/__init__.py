@@ -36,7 +36,7 @@ from .mobilenet import get_symbol as mobilenet
 from .squeezenet import get_symbol as squeezenet
 from .ssd import ssd_vgg16, ssd_toy
 from . import ssd as _ssd
-from .transformer import transformer_lm
+from .transformer import transformer_lm, transformer_decode_step
 from . import transformer as _transformer
 from . import densenet as _densenet
 

@@ -117,3 +117,106 @@ def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
 
 def get_symbol(vocab_size=1000, seq_len=128, **kwargs):
     return transformer_lm(vocab_size, seq_len, **kwargs)
+
+
+def transformer_decode_step(vocab_size, max_len, batch_size,
+                            num_layers=2, d_model=128,
+                            num_heads=4, num_kv_heads=None, d_ff=None):
+    """One autoregressive decode step with a rolled KV cache.
+
+    Parameter names match ``transformer_lm`` exactly, so weights trained
+    with the LM symbol load straight into this one.  The cache is carried
+    through Module state_names (set_states/get_states): per layer
+    ``layer{i}_k_cache``/``layer{i}_v_cache`` of shape
+    (batch_size, kv_heads, max_len, head_dim), plus ``cur_pos`` — the cache
+    ROLLS left one slot per step (static shapes; validity is a mask
+    computed from cur_pos, so jit never sees a dynamic shape).
+
+    Generation length is bounded by ``max_len``: absolute positions feed
+    the positional-embedding lookup, so decoding past max_len steps would
+    silently clamp to the last position — keep prompt+generated tokens
+    within max_len (generate_lm.py enforces this).
+
+    Inputs: data (B,) current token ids.  Outputs:
+    [logits (B, vocab)] + [new k/v caches per layer] + [cur_pos + 1].
+    """
+    d_ff = d_ff or 4 * d_model
+    h = num_heads
+    hk = h if num_kv_heads is None else num_kv_heads
+    if hk < 1 or h % hk:
+        raise ValueError(f"num_heads {h} not divisible by kv heads {hk}")
+    hd = d_model // h
+    g = h // hk
+
+    B = int(batch_size)  # decode graphs pin the batch (standard for
+    # KV-cache inference: the cache shape IS the signature)
+    data = sym.Variable("data")            # (B,) token ids
+    pos = sym.Variable("cur_pos", shape=(B,))   # float position index
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
+                      name="tok_embed")    # (B, d)
+    pos_w = sym.Variable("pos_embed_weight", shape=(max_len, d_model))
+    pv = sym.Embedding(pos, weight=pos_w, input_dim=max_len,
+                       output_dim=d_model, name="pos_lookup")
+    x = x + pv
+
+    # cache slot i holds the token at absolute position cur_pos-(L-1-i);
+    # slot valid iff i >= max_len - 1 - cur_pos
+    slot = sym.Reshape(sym.arange(start=0, stop=max_len),
+                       shape=(1, max_len))
+    valid = sym.broadcast_greater_equal(
+        slot, sym.Reshape(float(max_len) - 1.0 - pos, shape=(-1, 1)))
+    # (B, max_len) 1.0 where the cache slot is a real token (the current
+    # token lands in the LAST slot this step)
+    new_states = []
+    scale = 1.0 / (hd ** 0.5)
+    for i in range(num_layers):
+        name = f"layer{i}"
+        xin = sym.LayerNorm(x, name=f"{name}_ln1")
+        qkv = sym.FullyConnected(xin, num_hidden=(h + 2 * hk) * hd,
+                                 name=f"{name}_qkv")
+        q = sym.Reshape(sym.slice_axis(qkv, axis=1, begin=0, end=h * hd),
+                        shape=(-1, h, 1, hd))
+        kn = sym.Reshape(sym.slice_axis(qkv, axis=1, begin=h * hd,
+                                        end=(h + hk) * hd),
+                         shape=(-1, hk, 1, hd))
+        vn = sym.Reshape(sym.slice_axis(qkv, axis=1, begin=(h + hk) * hd,
+                                        end=(h + 2 * hk) * hd),
+                         shape=(-1, hk, 1, hd))
+        kc = sym.Variable(f"{name}_k_cache",
+                          shape=(B, hk, max_len, hd))
+        vc = sym.Variable(f"{name}_v_cache",
+                          shape=(B, hk, max_len, hd))
+        kc2 = sym.Concat(sym.slice_axis(kc, axis=2, begin=1, end=None),
+                         kn, dim=2, name=f"{name}_kroll")
+        vc2 = sym.Concat(sym.slice_axis(vc, axis=2, begin=1, end=None),
+                         vn, dim=2, name=f"{name}_vroll")
+        new_states += [kc2, vc2]
+        # GQA: repeat cached kv heads per query group for the score matmul
+        kr = sym.repeat(kc2, repeats=g, axis=1) if g > 1 else kc2
+        vr = sym.repeat(vc2, repeats=g, axis=1) if g > 1 else vc2
+        # scores (B, h, 1, max_len) = q · k^T
+        qf = sym.Reshape(q, shape=(-3, 1, hd))        # (B*h, 1, hd)
+        kf = sym.Reshape(kr, shape=(-3, max_len, hd))
+        s = sym.batch_dot(qf, sym.swapaxes(kf, dim1=1, dim2=2)) * scale
+        s = sym.Reshape(s, shape=(-4, -1, h, max_len))  # (B, h, max_len)
+        # additive mask: valid is 1.0/0.0, so (valid-1)*1e30 is 0 on real
+        # slots and -1e30 on empty cache slots
+        mask = sym.Reshape((valid - 1.0) * 1e30,
+                           shape=(-4, -1, 1, max_len))
+        s = sym.broadcast_add(s, mask)
+        p = sym.softmax(s, axis=-1)
+        pf = sym.Reshape(p, shape=(-3, 1, max_len))   # (B*h, 1, L)
+        vf = sym.Reshape(vr, shape=(-3, max_len, hd))
+        o = sym.batch_dot(pf, vf)                     # (B*h, 1, hd)
+        o = sym.Reshape(o, shape=(-4, -1, h, hd))
+        o = sym.Reshape(o, shape=(-1, d_model))
+        a = sym.FullyConnected(o, num_hidden=d_model, name=f"{name}_proj")
+        x = x + a
+        f = _ffn_block(sym.expand_dims(
+            sym.LayerNorm(x, name=f"{name}_ln2"), axis=1),
+            1, d_model, d_ff, name)
+        x = x + sym.Reshape(f, shape=(-1, d_model))
+    x = sym.LayerNorm(x, name="final_ln")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")
+    new_states.append(pos + 1.0)
+    return sym.Group([logits] + new_states)

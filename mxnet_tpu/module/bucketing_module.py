@@ -90,7 +90,10 @@ class BucketingModule(BaseModule):
 
     def get_states(self, merge_multi_context=True):
         """reference: bucketing_module.py get_states — delegates to the
-        current bucket's module (states are shared via shared_module)."""
+        current bucket's module.  Bucket executors hold independent state
+        arrays (shared_module shares parameters only); switch_bucket
+        copies the live states across, so the current bucket is always
+        authoritative."""
         assert self.binded and self.params_initialized
         return self._curr_module.get_states(merge_multi_context)
 
@@ -189,8 +192,18 @@ class BucketingModule(BaseModule):
                 module.borrow_optimizer(
                     self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
+        prev = self._curr_module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
+        # carry RNN states across the switch: bucket executors are
+        # separate programs (shared_module shares only params), so the
+        # previous bucket's state arrays are copied into the new one —
+        # state shapes are batch-sized, not bucket-sized, so they match
+        if self._state_names and prev is not None \
+                and prev is not self._curr_module \
+                and prev.binded and prev.params_initialized \
+                and self._curr_module.params_initialized:
+            self._curr_module.set_states(states=prev.get_states())
 
     def _share_params(self, module):
         """Alias the default bucket's param arrays into `module` so all

@@ -267,15 +267,18 @@ class Module(BaseModule):
         re-reshaping to a previous shape reuses XLA's compile cache."""
         assert self.binded and self.params_initialized
         had_labels = bool(self._label_shapes)
-        self._data_shapes, self._label_shapes = _parse_data_desc(
+        new_data, new_labels = _parse_data_desc(
             self.data_names, self.label_names, data_shapes, label_shapes)
-        if had_labels and not self._label_shapes:
+        if had_labels and not new_labels:
             # the executor would keep the label at the OLD batch size and
             # the next training step would fail deep inside the jit
+            # (checked BEFORE mutating module metadata, so a caught error
+            # leaves the module consistent)
             raise MXNetError(
                 "reshape: this module was bound with label_shapes — pass "
                 "matching label_shapes (the label batch must move with "
                 "the data batch)")
+        self._data_shapes, self._label_shapes = new_data, new_labels
         new = {d.name: tuple(d.shape) for d in self._data_shapes}
         if self._label_shapes:
             new.update({l.name: tuple(l.shape)

@@ -107,3 +107,11 @@ def test_model_parallel_example():
     assert "model-parallel training done" in log
     # decoder weight (vocab=64, hidden) sharded over tp=2 -> rows halved
     assert "(32," in log
+
+
+def test_generate_lm_example():
+    log = _run("examples/rnn/generate_lm.py", "--synthetic",
+               "--num-epochs", "12", "--num-layers", "1",
+               "--d-model", "32", "--seq-len", "12", "--vocab", "30")
+    assert "generation done" in log
+    assert "generated:" in log
