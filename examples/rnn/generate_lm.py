@@ -45,11 +45,13 @@ if __name__ == '__main__':
     ap.add_argument('--synthetic', action='store_true')
     args = ap.parse_args()
 
-    if args.gen_len + 1 > args.seq_len:
+    if args.gen_len > args.seq_len:
+        # gen_len steps consume positions 0..gen_len-1, which must fit
+        # the trained positional embedding (clamping would silently
+        # degrade generations — see transformer_decode_step docs)
         raise SystemExit(
-            f"--gen-len {args.gen_len} must stay below --seq-len "
-            f"{args.seq_len}: positions beyond the trained positional "
-            "embedding would clamp (see transformer_decode_step docs)")
+            f"--gen-len {args.gen_len} must not exceed --seq-len "
+            f"{args.seq_len}")
     kw = dict(num_layers=args.num_layers, d_model=args.d_model,
               num_heads=args.num_heads, num_kv_heads=args.num_kv_heads)
     net = models.transformer_lm(args.vocab, args.seq_len, **kw)

@@ -121,11 +121,14 @@ def get_symbol(vocab_size=1000, seq_len=128, **kwargs):
 
 def transformer_decode_step(vocab_size, max_len, batch_size,
                             num_layers=2, d_model=128,
-                            num_heads=4, num_kv_heads=None, d_ff=None):
+                            num_heads=4, num_kv_heads=None, d_ff=None,
+                            moe_experts=0, moe_k=1):
     """One autoregressive decode step with a rolled KV cache.
 
-    Parameter names match ``transformer_lm`` exactly, so weights trained
-    with the LM symbol load straight into this one.  The cache is carried
+    Parameter names match ``transformer_lm`` exactly (pass the SAME
+    moe_experts/moe_k used in training — MoE checkpoints carry expert
+    params, dense ones carry fc1/fc2), so trained weights load straight
+    into this one.  The cache is carried
     through Module state_names (set_states/get_states): per layer
     ``layer{i}_k_cache``/``layer{i}_v_cache`` of shape
     (batch_size, kv_heads, max_len, head_dim), plus ``cur_pos`` — the cache
@@ -214,7 +217,8 @@ def transformer_decode_step(vocab_size, max_len, batch_size,
         x = x + a
         f = _ffn_block(sym.expand_dims(
             sym.LayerNorm(x, name=f"{name}_ln2"), axis=1),
-            1, d_model, d_ff, name)
+            1, d_model, d_ff, name,
+            moe_experts=moe_experts, moe_k=moe_k)
         x = x + sym.Reshape(f, shape=(-1, d_model))
     x = sym.LayerNorm(x, name="final_ln")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")

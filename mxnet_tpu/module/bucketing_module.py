@@ -203,7 +203,18 @@ class BucketingModule(BaseModule):
                 and prev is not self._curr_module \
                 and prev.binded and prev.params_initialized \
                 and self._curr_module.params_initialized:
-            self._curr_module.set_states(states=prev.get_states())
+            states = prev.get_states()
+            cur = self._curr_module.get_states()
+            if all(tuple(a.shape) == tuple(b.shape)
+                   for a, b in zip(states, cur)):
+                self._curr_module.set_states(states=states)
+            else:
+                # bucket-dependent state shapes: each bucket keeps its
+                # own states (the pre-carry behavior); copying would
+                # fail deep inside jit with an opaque trace error
+                self.logger.debug(
+                    'switch_bucket: state shapes differ across buckets; '
+                    'not carrying states')
 
     def _share_params(self, module):
         """Alias the default bucket's param arrays into `module` so all

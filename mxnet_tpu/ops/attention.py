@@ -369,3 +369,18 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 def _flash_attention_op(query, key, value, causal=False, scale=None, **kw):
     """Registry entry point: usable from mx.nd / mx.sym / gluon."""
     return flash_attention(query, key, value, bool(causal), scale)
+
+
+def gqa_repeat_kv(q, k, v):
+    """Validate GQA head counts and materialize KV at full head count.
+
+    The flash kernel shares KV without this; sequence-parallel paths call
+    it only when their collective layout cannot keep the compact form.
+    """
+    H, Hk = q.shape[1], k.shape[1]
+    if Hk == H:
+        return k, v
+    if H % Hk:
+        raise ValueError(f"q heads {H} not divisible by kv heads {Hk}")
+    g = H // Hk
+    return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)

@@ -57,6 +57,13 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
         raise MXNetError(f"mesh has no axis {axis_name!r}")
     n = mesh_shape(mesh)[axis_name]
     B, H, S, D = q.shape
+    Hk = k.shape[1]
+    if Hk != H and H % Hk:
+        raise MXNetError(
+            f"q heads {H} not divisible by kv heads {Hk}")
+    gqa = H // Hk  # GQA group size: handled by FOLDING each group's query
+    # heads into the query length (attention rows are independent), so the
+    # ring rotates the compact Hk-head K/V — no repeated-KV traffic
     if S % n:
         raise MXNetError(f"seq len {S} not divisible by {axis_name}={n}")
     if scale is None:
@@ -69,9 +76,16 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
                                  else entry)})
 
     def local(q, k, v):
-        # q/k/v: (B, H, S/n, D) — this device's shard
+        # q: (B, H, S/n, D); k/v: (B, Hk, S/n, D) — this device's shard.
+        # GQA fold: group query heads into the row dimension so the
+        # blockwise step runs at Hk heads against the compact K/V
+        if gqa > 1:
+            # q.shape[0] = LOCAL batch (dp shards it inside shard_map)
+            q = q.reshape(q.shape[0], Hk, gqa * chunk, D)
         idx = lax.axis_index(axis_name)
         q_pos = idx * chunk + jnp.arange(chunk)
+        if gqa > 1:
+            q_pos = jnp.tile(q_pos, gqa)  # row r is position q_pos[r%chunk]
         m = jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32)
         l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
         acc = jnp.zeros(q.shape, jnp.float32)
@@ -99,7 +113,10 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
 
         k_cur, v_cur, m, l, acc = lax.fori_loop(
             0, n, step, (k, v, m, l, acc))
-        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        if gqa > 1:
+            out = out.reshape(out.shape[0], H, chunk, D)  # unfold groups
+        return out
 
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec)

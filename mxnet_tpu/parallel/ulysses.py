@@ -42,6 +42,19 @@ def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
     B, H, S, D = q.shape
     if S % n:
         raise MXNetError(f"seq len {S} not divisible by {axis_name}={n}")
+    Hk = k.shape[1]
+    if Hk != H:
+        # GQA inputs.  When the kv heads themselves split evenly over the
+        # group (Hk % n == 0), the all-to-all moves the COMPACT kv form:
+        # contiguous head-block splits keep the q-head -> kv-head (h // g)
+        # pairing aligned per device, and the local oracle handles grouped
+        # heads natively.  Otherwise fall back to repeating kv up to H.
+        if H % Hk:
+            raise MXNetError(
+                f"q heads {H} not divisible by kv heads {Hk}")
+        if Hk % n:
+            from ..ops.attention import gqa_repeat_kv
+            k, v = gqa_repeat_kv(q, k, v)
     if H % n:
         raise MXNetError(
             f"ulysses needs heads ({H}) divisible by {axis_name}={n}; "

@@ -268,3 +268,50 @@ def test_flash_gqa_bad_heads_raises():
     k = jnp.zeros((1, 3, 16, 8))
     with pytest.raises(ValueError):
         flash_attention(q, k, k, False, None)
+
+
+def test_ring_and_ulysses_accept_gqa_inputs():
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+    n = 4
+    mesh = par.make_mesh(dp=1, sp=n, devices=jax.devices()[:n])
+    rs = np.random.RandomState(5)
+    B, H, Hk, S, D = 1, 8, 2, 32, 16
+    q = rs.randn(B, H, S, D).astype('float32')
+    k = rs.randn(B, Hk, S, D).astype('float32')
+    v = rs.randn(B, Hk, S, D).astype('float32')
+    ref = _full_attn(q, np.repeat(k, H // Hk, 1), np.repeat(v, H // Hk, 1),
+                     causal=True)
+    qs = par.shard_seq(np.asarray(q), mesh)
+    ks = par.shard_seq(np.asarray(k), mesh)
+    vs = par.shard_seq(np.asarray(v), mesh)
+    out_r = np.asarray(par.ring_attention(qs, ks, vs, mesh, causal=True))
+    out_u = np.asarray(ulysses_attention(qs, ks, vs, mesh, causal=True))
+    np.testing.assert_allclose(out_r, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(out_u, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_gqa_compact_path_and_ring_dp_fold():
+    """Hk divisible by the group size: Ulysses moves the COMPACT kv form
+    through the all-to-all; ring's query-group fold works under dp
+    sharding (local batch differs from global)."""
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rs = np.random.RandomState(6)
+    B, H, Hk, S, D = 4, 8, 4, 32, 16
+    q = rs.randn(B, H, S, D).astype('float32')
+    k = rs.randn(B, Hk, S, D).astype('float32')
+    v = rs.randn(B, Hk, S, D).astype('float32')
+    ref = _full_attn(q, np.repeat(k, H // Hk, 1),
+                     np.repeat(v, H // Hk, 1), causal=True)
+
+    mesh = par.make_mesh(dp=2, sp=4)
+    sh = NamedSharding(mesh, P('dp', None, 'sp', None))
+    qs, ks, vs = (jax.device_put(np.asarray(x), sh) for x in (q, k, v))
+    out_u = np.asarray(ulysses_attention(qs, ks, vs, mesh, causal=True))
+    np.testing.assert_allclose(out_u, ref, rtol=2e-4, atol=2e-5)
+    out_r = np.asarray(par.ring_attention(qs, ks, vs, mesh, causal=True))
+    np.testing.assert_allclose(out_r, ref, rtol=2e-4, atol=2e-5)
