@@ -38,12 +38,21 @@ from .symbol.symbol import Symbol, node_num_outputs, _topo_sort
 # training recipe (example train scripts cast data to fp16 but cuDNN
 # BatchNorm keeps fp32 statistics, and SoftmaxOutput runs on an fp32 cast).
 AMP_FP32_OPS = frozenset({
-    "BatchNorm", "InstanceNorm", "L2Normalization", "LRN", "norm",
+    "InstanceNorm", "L2Normalization", "LRN", "norm",
     "SoftmaxOutput", "SoftmaxActivation", "softmax", "log_softmax",
     "log_softmax_mx", "LinearRegressionOutput", "LogisticRegressionOutput",
     "MAERegressionOutput", "MakeLoss", "SVMOutput", "CTCLoss",
     "softmax_cross_entropy",
 })
+
+# Ops with a SPLIT precision contract: the listed input indices are cast to
+# the compute dtype (the big activation tensors), everything else keeps its
+# master precision (small per-channel params / statistics).  BatchNorm
+# accumulates its stats in fp32 internally (ops/nn.py _batch_norm), so the
+# (N,C,H,W) activation never round-trips HBM in fp32 — the TPU equivalent of
+# the reference's fused cuDNN BN (cudnn_batch_norm-inl.h keeps fp32 stats
+# over an fp16 data path).
+AMP_SPLIT_OPS = {"BatchNorm": (0,)}
 
 
 def maybe_mirror(run):
@@ -86,6 +95,13 @@ def build_interpreter(sym: Symbol, compute_dtype=None):
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
     def _amp_cast(ins, op):
+        split = AMP_SPLIT_OPS.get(op)
+        if split is not None:
+            return [v.astype(cd)
+                    if (i in split and hasattr(v, "dtype")
+                        and jnp.issubdtype(v.dtype, jnp.floating)
+                        and v.dtype != cd) else v
+                    for i, v in enumerate(ins)]
         want = jnp.float32 if op in AMP_FP32_OPS else cd
         return [v.astype(want)
                 if (hasattr(v, "dtype")
@@ -106,6 +122,7 @@ def build_interpreter(sym: Symbol, compute_dtype=None):
                     env[(id(n), 0)] = aux_vals[aux_pos[n.name]]
                 continue
             opdef = _reg.get(n.op)
+            _reg.record_execution(n.op)
             ins = [env[(id(src), i)] for src, i in n.inputs]
             if cd is not None:
                 ins = _amp_cast(ins, n.op)
@@ -349,7 +366,16 @@ class Executor:
         cur = getattr(val, "sharding", None)
         if cur is not None and cur == sh:
             return val
-        return jax.device_put(val, sh)
+        if sh.is_fully_addressable:
+            return jax.device_put(val, sh)
+        # mesh spans processes (multi-host SPMD): device_put cannot target
+        # non-addressable shardings.  Every process feeds the same global
+        # host value (the SPMD data contract — dist scripts use identical
+        # seeds/batches), so build the global array from the shards THIS
+        # process addresses.
+        arr = np.asarray(val)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx: arr[idx])
 
     def _arg_vals(self):
         if self._arg_shardings is None:

@@ -135,7 +135,16 @@ class NDArray:
 
     # -- conversions --------------------------------------------------------
     def asnumpy(self) -> np.ndarray:
-        return np.asarray(self._data)
+        data = self._data
+        if (hasattr(data, "sharding")
+                and not getattr(data, "is_fully_addressable", True)):
+            # global array from a multi-process SPMD mesh: gather the
+            # non-addressable shards over the coordination backend (the
+            # analog of the reference's kvstore pull to host)
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(data, tiled=True))
+        return np.asarray(data)
 
     def asscalar(self):
         if self.size != 1:
@@ -472,6 +481,7 @@ def _invoke_fn(fn, inputs: Sequence[NDArray], attrs, n_out: Optional[int] = None
 def _invoke(op_name: str, inputs, attrs, out=None):
     """Dispatch a registered op imperatively (handles rng/aux/is_train)."""
     opdef = _reg.get(op_name)
+    _reg.record_execution(op_name)
     inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
     attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis",)}
     kwargs = dict(attrs)

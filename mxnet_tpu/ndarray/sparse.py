@@ -272,6 +272,43 @@ def cast_storage(arr, stype):
     raise MXNetError(stype)
 
 
+def square_sum(arr, axis=None, keepdims=False):
+    """O(nnz) sum-of-squares over a RowSparseNDArray (reference:
+    src/operator/tensor/square_sum-inl.h — `_square_sum` FComputeEx on
+    kRowSparseStorage).  Only the stored rows are touched; zero rows
+    contribute nothing by construction.
+
+    axis=1 returns a RowSparseNDArray sharing the input's row indices (the
+    reference emits row_sparse output for the axis=1 case); axis=None or
+    axis=0 returns a dense NDArray.
+    """
+    if not isinstance(arr, RowSparseNDArray):
+        from . import _invoke
+        return _invoke("_square_sum", [arr],
+                       {"axis": axis, "keepdims": keepdims})
+    vals = arr.data._data
+    if isinstance(axis, (tuple, list)):
+        axis = axis[0] if len(axis) == 1 else None
+    if axis is None:
+        return NDArray(jnp.sum(jnp.square(vals)))
+    if len(arr._sp_shape) != 2:
+        raise MXNetError(
+            "square_sum with an axis supports 2-D row_sparse only "
+            f"(got shape {arr._sp_shape}); use axis=None or densify")
+    axis = axis % len(arr._sp_shape)
+    if axis == 0:
+        out = jnp.sum(jnp.square(vals), axis=0)
+        if keepdims:
+            out = out[None]
+        return NDArray(out)
+    # axis == 1: per-row sum over the stored rows only
+    row = jnp.sum(jnp.square(vals.reshape(vals.shape[0], -1)), axis=1)
+    out_shape = ((arr._sp_shape[0], 1) if keepdims
+                 else (arr._sp_shape[0],))
+    rvals = row[:, None] if keepdims else row
+    return RowSparseNDArray(NDArray(rvals), arr.indices, out_shape)
+
+
 def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "row_sparse":
         return RowSparseNDArray(np.zeros((0,) + tuple(shape[1:]),

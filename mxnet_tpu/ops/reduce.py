@@ -43,6 +43,21 @@ _reg_reduce("max", jnp.max, aliases=("max_axis",))
 _reg_reduce("min", jnp.min, aliases=("min_axis",))
 
 
+@register("_square_sum", arg_names=["data"],
+          attr_defaults={"axis": None, "keepdims": False, "exclude": False})
+def _square_sum(data, axis=None, keepdims=False, exclude=False, **kw):
+    """Sum of squares along axis (reference:
+    src/operator/tensor/square_sum-inl.h — the fused square+sum used by the
+    sparse-support surface, e.g. group-lasso style regularizers over
+    row_sparse weights).  Dense path; the O(nnz) row_sparse path lives in
+    ndarray.sparse.square_sum."""
+    ax = _norm_axis(axis)
+    if exclude and ax is not None:
+        ax = tuple(i for i in range(data.ndim) if i not in
+                   tuple(a % data.ndim for a in ax))
+    return jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+
+
 @register("norm", arg_names=["data"],
           attr_defaults={"ord": 2, "axis": None, "keepdims": False})
 def _norm(data, ord=2, axis=None, keepdims=False, **kw):

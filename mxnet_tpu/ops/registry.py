@@ -30,6 +30,17 @@ from ..base import MXNetError
 
 _OP_REGISTRY: Dict[str, "OpDef"] = {}
 
+# Names of ops that have actually executed (imperative dispatch or symbolic
+# trace) in this process.  Consumed by the test suite's registry-coverage
+# gate: an op counts as covered only if it genuinely ran, not if its name
+# merely appears in a test file (the reference enforces coverage the same
+# way — by running tests/python/unittest/test_operator.py over every op).
+EXECUTED_OPS: set = set()
+
+
+def record_execution(name: str) -> None:
+    EXECUTED_OPS.add(name)
+
 
 @dataclass
 class OpDef:

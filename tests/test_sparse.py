@@ -224,3 +224,29 @@ def test_rand_sparse_ndarray():
     assert b.stype == 'csr'
     sp, dense = sparse.rand_sparse_ndarray((6, 3), 'csr', density=0.4)
     np.testing.assert_array_equal(sp.todense().asnumpy(), dense)
+
+
+def test_square_sum_row_sparse():
+    """O(nnz) square_sum over row_sparse, no densify (reference:
+    src/operator/tensor/square_sum-inl.h FComputeEx on kRowSparseStorage)."""
+    sp, dense = sparse.rand_sparse_ndarray((50, 6), 'row_sparse',
+                                           density=0.2, rng=RNG)
+    with _densify_delta() as d:
+        total = sparse.square_sum(sp)
+        np.testing.assert_allclose(total.asnumpy(),
+                                   np.sum(dense * dense), rtol=1e-5)
+        ax0 = sparse.square_sum(sp, axis=0)
+        np.testing.assert_allclose(ax0.asnumpy(),
+                                   np.sum(dense * dense, axis=0), rtol=1e-5)
+        ax1 = sparse.square_sum(sp, axis=1)
+        assert isinstance(ax1, sparse.RowSparseNDArray)
+    assert d.delta == 0, 'square_sum densified the input'
+    np.testing.assert_allclose(ax1.todense().asnumpy(),
+                               np.sum(dense * dense, axis=1), rtol=1e-5)
+    # keepdims row_sparse output keeps the row-index structure
+    ax1k = sparse.square_sum(sp, axis=1, keepdims=True)
+    assert ax1k.shape == (50, 1)
+    # dense input falls through to the registered op
+    d = sparse.square_sum(nd.array(dense), axis=1)
+    np.testing.assert_allclose(d.asnumpy(), np.sum(dense * dense, axis=1),
+                               rtol=1e-5)
