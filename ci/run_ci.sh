@@ -6,17 +6,13 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== unit + integration suite (8-device CPU mesh via tests/conftest.py)"
-python -m pytest tests/ -q --durations=10
+# -m "" overrides pytest.ini's default "not slow": CI runs everything
+python -m pytest tests/ -q --durations=10 -m ""
 
 echo "== multichip dryrun (8 virtual devices)"
 JAX_PLATFORMS=cpu python - <<'PY'
-import jax
-from jax._src import xla_bridge as xb
-xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-import os
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
-    " --xla_force_host_platform_device_count=8"
+import cpu_pin
+cpu_pin.pin_cpu(8)
 import __graft_entry__ as ge
 ge.dryrun_multichip(8)
 print("dryrun_multichip(8) OK")
@@ -24,10 +20,8 @@ PY
 
 echo "== bench smoke (CPU, tiny config; real numbers come from TPU runs)"
 BENCH_BATCH=8 BENCH_ITERS=2 BENCH_WARMUP=1 python - <<'PY'
-import jax
-from jax._src import xla_bridge as xb
-xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
+import cpu_pin
+cpu_pin.pin_cpu(8)
 import bench, sys
 sys.exit(bench.main())
 PY

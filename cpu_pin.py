@@ -1,0 +1,50 @@
+"""Pin JAX to a virtual multi-device CPU backend, stripping the axon tunnel.
+
+One shared implementation of the backend-pinning dance every CPU-side entry
+point needs (tests, CI, dist worker scripts, the multichip dryrun, ad-hoc
+tools).  Why it exists:
+
+* The axon TPU-tunnel plugin (registered by sitecustomize when
+  ``PALLAS_AXON_POOL_IPS`` is set) admits ONE client at a time; letting a
+  unit-test or dryrun process grab it deadlocks any concurrent benchmark
+  and wastes the single real chip on work designed for virtual devices.
+* ``xla_force_host_platform_device_count=N`` gives N CPU "chips" so
+  sharding/collective paths compile and execute without TPU hardware —
+  the reference's multiple-CPU-contexts test strategy (SURVEY.md §4).
+
+Call :func:`pin_cpu` BEFORE any jax computation runs (import-time is fine:
+XLA_FLAGS is read and the backend-factory table consulted at backend
+*initialization*, which happens on first device use, not at ``import jax``).
+"""
+import os
+
+
+def pin_cpu(n_devices=8, clear_backends=False):
+    """Force the CPU platform with ``n_devices`` virtual devices.
+
+    Returns the ``jax`` module for convenience.  ``clear_backends=True``
+    additionally tears down any already-initialized backend (needed when a
+    process may have touched devices before pinning, e.g. the driver
+    calling ``dryrun_multichip`` after other jax work).
+
+    ``n_devices=None`` leaves XLA_FLAGS untouched (one device per process —
+    what the multi-process dist worker scripts want, where each process is
+    its own "host" in the cluster).
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + " --xla_force_host_platform_device_count=%d" % n_devices
+            ).strip()
+    import jax
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+    if clear_backends:
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:  # noqa: BLE001 — older jax spells this differently
+            pass
+    return jax

@@ -15,14 +15,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # the kvstore push/pull path like the reference does
 os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "0"
 
-import jax
-from jax._src import xla_bridge as xb
-
-xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+# one CPU device per process: each process is its own "host" in the cluster
+pin_cpu(n_devices=None)
 
 import numpy as np
 import mxnet_tpu as mx

@@ -10,14 +10,13 @@ import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-import jax
-from jax._src import xla_bridge as xb
-
-xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+# one CPU device per process: each process is its own "host" in the cluster
+jax = pin_cpu(n_devices=None)
 
 import numpy as np
 import mxnet_tpu as mx

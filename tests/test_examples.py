@@ -6,13 +6,16 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # end-to-end smokes; CI runs them via -m ""
+
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _BOOT = (
-    "import jax, sys, runpy\n"
-    "from jax._src import xla_bridge as xb\n"
-    "xb._backend_factories.pop('axon', None)\n"
-    "jax.config.update('jax_platforms', 'cpu')\n"
+    "import sys, runpy\n"
+    "sys.path.insert(0, %r)\n" % ROOT +
+    "from cpu_pin import pin_cpu\n"
+    "pin_cpu(n_devices=None)\n"
     "script = sys.argv[1]\n"
     "sys.argv = sys.argv[1:]\n"
     "runpy.run_path(script, run_name='__main__')\n"

@@ -11,21 +11,24 @@ import os
 import subprocess
 import sys
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 _CHECK = """
-import jax
+import sys
+sys.path.insert(0, @ROOT@)
 from jax._src import xla_bridge
 # Strip any TPU-tunnel plugin and pin CPU *before* importing the framework:
 # on regression (an eager dispatch at import) the CPU backend initializes and
 # the assert below fails fast, instead of the subprocess hanging on the
 # single-client TPU tunnel until the timeout.
-xla_bridge._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
+from cpu_pin import pin_cpu
+pin_cpu(n_devices=None)
 import mxnet_tpu
 assert not xla_bridge._backends, (
     "import mxnet_tpu initialized JAX backend(s): %r" %
     list(xla_bridge._backends))
 print("HERMETIC")
-"""
+""".replace("@ROOT@", repr(ROOT))
 
 
 def test_import_is_hermetic():

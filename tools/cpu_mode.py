@@ -3,14 +3,10 @@
 Same strip as tests/conftest.py — see there for why.
 """
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-from jax._src import xla_bridge as _xb  # noqa: E402
+from cpu_pin import pin_cpu  # noqa: E402
 
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(8)
