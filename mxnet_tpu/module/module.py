@@ -659,9 +659,14 @@ class Module(BaseModule):
         self.optimizer_initialized = True
 
     def get_states(self, merge_multi_context=True):
-        """Current values of the state inputs, as COPIES (reference:
-        module.py get_states returns merged copies — a later set_states
-        must not mutate what the caller saved, e.g. TBPTT save/restore)."""
+        """Current values of the state inputs, as immutable snapshots
+        (reference: module.py get_states — a later set_states must not
+        change what the caller saved, e.g. TBPTT save/restore).  The
+        returned NDArrays alias the live executor buffers (jnp.asarray is
+        zero-copy): the snapshot guarantee rests on jax.Array immutability
+        plus set_states REBINDING rather than mutating.  If these buffers
+        are ever fed to a donating computation, switch this to a real copy
+        (jnp.array(..., copy=True))."""
         assert self.binded and self.params_initialized
         from ..ndarray import NDArray as _ND
         return [_ND(jnp.asarray(self._exec.arg_dict[n]._data))

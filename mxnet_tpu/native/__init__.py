@@ -44,6 +44,15 @@ def get_lib():
         if (not os.path.exists(_LIB_PATH) or stale) and not _build():
             if not os.path.exists(_LIB_PATH):
                 return None
+            if stale:
+                # loading the prebuilt .so even though io_native.cc is
+                # newer: behavioral drift in existing symbols would run the
+                # OLD code — make that diagnosable instead of silent
+                import logging
+                logging.getLogger(__name__).warning(
+                    "native: rebuild of %s failed; falling back to STALE "
+                    "%s (source is newer — behavior may not match)",
+                    src, _LIB_PATH)
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -78,6 +87,18 @@ def get_lib():
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_float),
                 ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        except AttributeError:
+            pass
+        try:
+            lib.crop_flip_u8_batch.restype = ctypes.c_int
+            lib.crop_flip_u8_batch.argtypes = [
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_long, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
         except AttributeError:
             pass
         lib.jpeg_probe.restype = ctypes.c_int
@@ -200,3 +221,37 @@ def decode_augment_batch(jpeg_buffers, dec_h, dec_w, out_h, out_w, y0s,
             f"{failures}): channels must be 1..8 and crop "
             f"({out_h}x{out_w}) must fit in decode size ({dec_h}x{dec_w})")
     return out, failures
+
+
+def crop_flip_u8_batch(raw_buffers, dec_h, dec_w, out_h, out_w, y0s, x0s,
+                       flips, channels=3, nthreads=0):
+    """Crop+mirror+NCHW over PRE-DECODED uint8 HWC records — the raw-payload
+    fast path (reference: ImageRecordUInt8Iter, src/io/io.cc:337-758).
+    Pure byte movement; normalization belongs on the device where it fuses
+    into the training step.  Returns uint8[n, channels, out_h, out_w].
+    """
+    lib = get_lib()
+    n = len(raw_buffers)
+    arrs = [np.frombuffer(b, dtype=np.uint8) for b in raw_buffers]
+    want = dec_h * dec_w * channels
+    for a in arrs:
+        if a.size != want:
+            raise ValueError(
+                f"raw record payload {a.size} != {dec_h}x{dec_w}x"
+                f"{channels}={want}; repack or fix stored_shape")
+    arr_t = ctypes.POINTER(ctypes.c_uint8) * n
+    ptrs = arr_t(*[a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+                   for a in arrs])
+    y0s = np.ascontiguousarray(y0s, dtype=np.int32)
+    x0s = np.ascontiguousarray(x0s, dtype=np.int32)
+    flips = np.ascontiguousarray(flips, dtype=np.uint8)
+    out = np.empty((n, channels, out_h, out_w), dtype=np.uint8)
+    rc = lib.crop_flip_u8_batch(
+        ptrs, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        dec_h, dec_w, out_h, out_w, channels,
+        y0s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        x0s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), nthreads)
+    if rc != 0:
+        raise ValueError(f"crop_flip_u8_batch rejected arguments ({rc})")
+    return out

@@ -7,10 +7,13 @@ blocked attention whose QK^T and PV matmuls tile onto the MXU and whose
 working set stays in VMEM — O(S) memory instead of the O(S²) a naive
 softmax(QK^T)V materializes.
 
-The backward pass is a recompute-based vjp expressed in jnp (XLA fuses it
-well); the forward kernel is where the memory win lives.  On non-TPU
-backends the same kernel runs in pallas interpret mode, so unit tests
-cover the identical code path (SURVEY.md §4 device-consistency strategy).
+The backward pass is a dual Pallas kernel in the FA2 style (_flash_bwd
+below): one kernel for dQ, one for dK/dV, both recomputing the attention
+probabilities blockwise from the forward's saved logsumexp — O(S) memory
+end-to-end, with GQA/MQA handled at the block-spec level so repeated KV
+heads are never materialized.  On non-TPU backends the same kernels run
+in pallas interpret mode, so unit tests cover the identical code path
+(SURVEY.md §4 device-consistency strategy).
 """
 from __future__ import annotations
 
