@@ -19,9 +19,15 @@ from .registry import register, alias
                          "sparse_grad": False})
 def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
                sparse_grad=False, **kw):
-    """reference: indexing_op.cc Embedding"""
+    """reference: indexing_op.cc Embedding.
+
+    Out-of-range ids CLAMP to the edge rows (mode="clip") — jax's default
+    take mode is "fill", which yields NaN rows and poisons everything
+    downstream (found by tests/test_transformer.py decode-past-max_len
+    regression).  Clamping matches the reference's take-op default and is
+    what transformer_decode_step documents for positions past max_len."""
     idx = data.astype(jnp.int32)
-    return jnp.take(weight, idx, axis=0)
+    return jnp.take(weight, idx, axis=0, mode="clip")
 
 
 @register("take", arg_names=["a", "indices"],

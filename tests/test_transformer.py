@@ -214,3 +214,34 @@ def test_kv_cache_decode_matches_training():
     gen = np.stack(outs, 1)
     expect = (start[:, None] + np.arange(1, 9)) % V
     assert (gen == expect).mean() > 0.9
+
+
+def test_decode_past_max_len_clamps_not_errors():
+    """Pins the out-of-range behavior transformer_decode_step documents:
+    positions past max_len CLAMP to the last positional embedding
+    (jnp.take's clip mode inside Embedding) — generations degrade, nothing
+    raises.  If Embedding's out-of-range mode ever changes, this fails and
+    the decode-step docstring + generate_lm.py guard must be revisited
+    (ADVICE r2)."""
+    V, L, B = 10, 4, 2
+    dec = models.transformer_decode_step(V, L, B, num_layers=1,
+                                         d_model=16, num_heads=2)
+    dmod = mx.mod.Module(dec, context=mx.cpu(0), data_names=('data',),
+                         label_names=None,
+                         state_names=['layer0_k_cache', 'layer0_v_cache',
+                                      'cur_pos'])
+    dmod.bind(data_shapes=[('data', (B,))], for_training=False)
+    dmod.init_params(mx.initializer.Xavier())
+    dmod.set_states(value=0)
+    tok = np.zeros(B, 'float32')
+    logits = []
+    for _ in range(L + 3):  # decode 3 steps PAST max_len
+        dmod.forward(mx.io.DataBatch([mx.nd.array(tok)], []))
+        res = dmod.get_outputs()
+        dmod.set_states(states=res[1:])
+        logits.append(res[0].asnumpy())
+    assert all(np.isfinite(l).all() for l in logits)
+    # position embedding is clamped => with fixed input token, steps at
+    # pos >= max_len-1 see identical pos-embeddings; the logits stay finite
+    # and the final cur_pos state keeps counting
+    assert float(res[-1].asnumpy()[0]) == L + 3
