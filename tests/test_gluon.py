@@ -237,6 +237,7 @@ def test_dataloader_shuffle_and_workers():
     assert sorted(seen.tolist()) == sorted(X[:, 0].tolist())
 
 
+@pytest.mark.slow  # full-zoo sweep; CI tier
 def test_model_zoo_builds_and_runs():
     from mxnet_tpu.gluon.model_zoo import vision as models
     x = mx.nd.array(np.random.randn(1, 3, 32, 32).astype('float32'))
@@ -247,6 +248,7 @@ def test_model_zoo_builds_and_runs():
         assert y.shape == (1, 10), name
 
 
+@pytest.mark.slow  # full-zoo sweep; CI tier
 def test_model_zoo_full_stem():
     from mxnet_tpu.gluon.model_zoo import vision as models
     net = models.squeezenet1_1(classes=7)

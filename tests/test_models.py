@@ -13,10 +13,12 @@ SMALL = [
     ("lenet", dict(num_classes=10), (2, 1, 28, 28)),
     ("resnet", dict(num_layers=18, num_classes=10,
                     image_shape="3,32,32"), (2, 3, 32, 32)),
-    ("resnet", dict(num_layers=50, num_classes=10,
-                    image_shape="3,64,64"), (1, 3, 64, 64)),
-    ("resnext", dict(num_layers=50, num_classes=10,
-                     image_shape="3,64,64", num_group=4), (1, 3, 64, 64)),
+    pytest.param("resnet", dict(num_layers=50, num_classes=10,
+                                image_shape="3,64,64"), (1, 3, 64, 64),
+                 marks=pytest.mark.slow),  # deep-variant sweep; CI tier
+    pytest.param("resnext", dict(num_layers=50, num_classes=10,
+                                 image_shape="3,64,64", num_group=4),
+                 (1, 3, 64, 64), marks=pytest.mark.slow),
     ("mobilenet", dict(num_classes=10, multiplier=0.25), (1, 3, 64, 64)),
     ("squeezenet", dict(num_classes=10), (1, 3, 64, 64)),
 ]
@@ -70,3 +72,39 @@ def test_resnet50_imagenet_shapes():
 def test_unknown_network():
     with pytest.raises(ValueError):
         models.get_symbol("nonexistent")
+
+
+def test_s2d_stem_equivalent_to_conv7():
+    """stem='s2d' (space-to-depth, MLPerf-TPU trick) computes the SAME
+    function as the reference 7x7/s2 stem once weights are mapped through
+    space_to_depth_stem_weight."""
+    from mxnet_tpu.models.resnet import space_to_depth_stem_weight
+    rs = np.random.RandomState(3)
+    B = 2
+    x = rs.uniform(-1, 1, (B, 3, 64, 64)).astype('f')
+    kw = dict(num_layers=18, num_classes=10, image_shape="3,64,64")
+    ref = models.resnet(stem="conv7", **kw)
+    s2d = models.resnet(stem="s2d", **kw)
+
+    ex1 = ref.simple_bind(mx.cpu(), data=x.shape, softmax_label=(B,),
+                          grad_req='null')
+    for name, arr in ex1.arg_dict.items():
+        if name in ('data', 'softmax_label'):
+            continue
+        arr[:] = rs.uniform(-0.05, 0.05, arr.shape).astype('f')
+    ex2 = s2d.simple_bind(mx.cpu(), data=x.shape, softmax_label=(B,),
+                          grad_req='null')
+    for name, arr in ex2.arg_dict.items():
+        if name in ('data', 'softmax_label'):
+            continue
+        if name == 'conv0_weight':
+            arr[:] = space_to_depth_stem_weight(
+                ex1.arg_dict['conv0_weight'].asnumpy())
+        else:
+            arr[:] = ex1.arg_dict[name].asnumpy()
+
+    ex1.arg_dict['data'][:] = x
+    ex2.arg_dict['data'][:] = x
+    o1 = ex1.forward(is_train=False)[0].asnumpy()
+    o2 = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)

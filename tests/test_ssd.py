@@ -10,6 +10,9 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # end-to-end smokes; CI runs them via -m ""
+
+
 import mxnet_tpu as mx
 from mxnet_tpu import models
 from mxnet_tpu.image.detection import (ImageDetRecordIter, make_det_label,
