@@ -211,22 +211,38 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     Training returns (out, batch_mean, batch_var, new_moving_mean,
     new_moving_var); the trailing pair is written back into the aux arrays by
     the dispatcher (functional replacement for in-kernel aux mutation).
+
+    Mixed-precision contract (the TPU ResNet recipe): the DATA path stays in
+    the compute dtype end-to-end — statistics are accumulated in float32
+    from the low-precision input, folded into per-channel scale/offset in
+    float32, and only those small vectors are cast back, so the (N,C,H,W)
+    activation never round-trips HBM in fp32.  gamma/beta/moving_* are
+    master-precision (fp32) inputs; outputs mean/var/new_moving_* stay fp32.
     """
     ax = axis % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        # integer input (e.g. a raw uint8 batch hitting bn_data): the
+        # scale/offset fold below would truncate to the integer dtype —
+        # promote the data path to fp32 instead
+        data = data.astype(jnp.float32)
     if is_train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
-        out = (data - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
-        out = out * g.reshape(bshape) + beta.reshape(bshape)
+        xf = data.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
+        scale = g * lax.rsqrt(var + eps)          # fp32 per-channel
+        offset = beta - mean * scale
+        out = (data * scale.reshape(bshape).astype(data.dtype)
+               + offset.reshape(bshape).astype(data.dtype))
         new_mm = moving_mean * momentum + mean * (1 - momentum)
         new_mv = moving_var * momentum + var * (1 - momentum)
         return out, mean, var, new_mm, new_mv
-    out = (data - moving_mean.reshape(bshape)) * lax.rsqrt(
-        moving_var.reshape(bshape) + eps)
-    out = out * g.reshape(bshape) + beta.reshape(bshape)
+    scale = g * lax.rsqrt(moving_var + eps)
+    offset = beta - moving_mean * scale
+    out = (data * scale.reshape(bshape).astype(data.dtype)
+           + offset.reshape(bshape).astype(data.dtype))
     return out, moving_mean, moving_var
 
 
