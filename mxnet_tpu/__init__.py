@@ -32,8 +32,9 @@ from . import callback
 from . import io
 from .io import DataBatch, DataIter, DataDesc, NDArrayIter, ResizeIter, \
     PrefetchingIter, CSVIter
-from .image_record_iter import ImageRecordIter
+from .image_record_iter import ImageRecordIter, ImageRecordUInt8Iter
 io.ImageRecordIter = ImageRecordIter   # reference API: mx.io.ImageRecordIter
+io.ImageRecordUInt8Iter = ImageRecordUInt8Iter
 from .image.detection import ImageDetRecordIter
 io.ImageDetRecordIter = ImageDetRecordIter  # reference: src/io/io.cc:581
 from . import recordio

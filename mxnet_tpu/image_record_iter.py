@@ -24,6 +24,13 @@ from . import recordio
 from . import native
 
 
+class _ProducerError:
+    """Exception captured in the prefetch thread, re-raised at next()."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 class ImageRecordIter(DataIter):
     """reference params mirror src/io/image_rec_parser params +
     augmenter params (image_aug_default.cc)."""
@@ -213,6 +220,12 @@ class ImageRecordIter(DataIter):
                                            self.batch_size - rem) % n]])
                 batch = self._load_batch(idxs)
                 out_queue.put(batch + (self.batch_size - rem,))
+        except BaseException as e:  # noqa: BLE001 — crossing a thread
+            # surface the failure on the CONSUMER side: without this, a
+            # corrupt/mis-shaped record would look like a (possibly empty)
+            # end of epoch — silent truncation, and a permanent hang for
+            # any caller double-buffering off this iterator
+            out_queue.put(_ProducerError(e))
         finally:
             out_queue.put(None)
 
@@ -237,18 +250,119 @@ class ImageRecordIter(DataIter):
             daemon=True)
         self._worker.start()
 
-    def next(self):
+    def next_raw(self):
+        """Next batch as HOST numpy arrays (data, label, pad) — no NDArray
+        wrap, no device transfer.  For callers that manage placement
+        themselves (bench.py does ONE uint8 device_put per batch; wrapping
+        through next() would eagerly device_put and cost extra
+        host<->device crossings on a remote-attached chip)."""
         if self._done:
             raise StopIteration
         item = self._queue.get()
         if item is None:
             self._done = True
             raise StopIteration
+        if isinstance(item, _ProducerError):
+            self._done = True
+            raise MXNetError(
+                "ImageRecordIter pipeline failed in the prefetch thread: "
+                "%r" % (item.exc,)) from item.exc
         if len(item) == 3:
             data, label, pad = item
         else:
             data, label = item
             pad = 0
+        return data, label, pad
+
+    def next(self):
+        data, label, pad = self.next_raw()
         return DataBatch([nd_array(data)], [nd_array(label)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """Raw pre-decoded uint8 records: no JPEG decode at training time.
+
+    Reference: ImageRecordUInt8Iter (src/io/io.cc:337-758) — the input-
+    pipeline fast path when the host CPU cannot decode fast enough to feed
+    the accelerator.  Records carry fixed-shape HWC uint8 payloads (pack
+    with ``tools/im2rec.py --pack-raw S``); iteration is pure byte movement
+    (crop + mirror + NCHW in native code, io_native.cc crop_flip_u8_batch).
+    Output batches are uint8 NCHW — normalization belongs ON DEVICE, where
+    it fuses into the training step (e.g. ResNet's bn_data input
+    BatchNorm); mean/std parameters are therefore rejected here, exactly
+    like the reference's uint8 iterator which ignores them.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 stored_shape=None, **kwargs):
+        identity = {"mean_r": 0.0, "mean_g": 0.0, "mean_b": 0.0,
+                    "std_r": 1.0, "std_g": 1.0, "std_b": 1.0}
+        for k, ident in identity.items():
+            v = kwargs.pop(k, None)
+            if v is not None and float(v) != ident:
+                raise MXNetError(
+                    "ImageRecordUInt8Iter outputs raw uint8; apply "
+                    "mean/std on device (it fuses into the step)")
+        self._stored_shape = tuple(stored_shape) if stored_shape else None
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+
+    def _infer_stored_shape(self, payload_len):
+        c = self.data_shape[0]
+        if payload_len % c:
+            raise MXNetError(
+                f"raw record payload {payload_len} not divisible by "
+                f"channels {c}")
+        side = int(round((payload_len // c) ** 0.5))
+        if side * side * c != payload_len:
+            raise MXNetError(
+                f"raw record payload {payload_len} is not square; pass "
+                f"stored_shape=(H, W)")
+        return (side, side)
+
+    def _load_batch(self, idxs):
+        offs = self._offsets[idxs]
+        if self._native:
+            raws = native.read_records(self.path, offs)
+        else:
+            r = recordio.MXRecordIO(self.path, 'r')
+            raws = []
+            for o in offs:
+                r.seek(int(o))
+                raws.append(r.read())
+            r.close()
+        labels = np.zeros((len(raws), self.label_width), np.float32)
+        payloads = []
+        for i, raw in enumerate(raws):
+            header, img = recordio.unpack(raw)
+            lab = np.atleast_1d(np.asarray(header.label, np.float32))
+            labels[i, :min(self.label_width, lab.size)] = \
+                lab[:self.label_width]
+            payloads.append(img)
+        c, h, w = self.data_shape
+        if self._stored_shape is None:
+            self._stored_shape = self._infer_stored_shape(len(payloads[0]))
+        dh, dw = self._stored_shape
+        nimg = len(payloads)
+        if (dh != h or dw != w) and self.rand_crop:
+            y0 = self._rng.randint(0, dh - h + 1, nimg)
+            x0 = self._rng.randint(0, dw - w + 1, nimg)
+        else:
+            y0 = np.full(nimg, (dh - h) // 2, np.int32)
+            x0 = np.full(nimg, (dw - w) // 2, np.int32)
+        flips = (self._rng.rand(nimg) < 0.5 if self.rand_mirror
+                 else np.zeros(nimg, bool))
+        if self._native and hasattr(native.get_lib(), "crop_flip_u8_batch"):
+            arr = native.crop_flip_u8_batch(
+                payloads, dh, dw, h, w, y0, x0, flips, c, self.nthreads)
+        else:  # pure-numpy fallback, same semantics
+            arr = np.empty((nimg, c, h, w), np.uint8)
+            for i, p in enumerate(payloads):
+                im = np.frombuffer(p, np.uint8).reshape(dh, dw, c)
+                crop = im[y0[i]:y0[i] + h, x0[i]:x0[i] + w]
+                if flips[i]:
+                    crop = crop[:, ::-1]
+                arr[i] = crop.transpose(2, 0, 1)
+        labels = labels[:, 0] if self.label_width == 1 else labels
+        return arr, labels

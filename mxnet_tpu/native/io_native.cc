@@ -313,6 +313,51 @@ int jpeg_decode_augment_batch(const uint8_t** bufs, const int64_t* lens,
   return failures;
 }
 
+// Crop -> mirror -> NCHW on PRE-DECODED uint8 records (the raw-payload
+// fast path, reference: ImageRecordUInt8Iter src/io/io.cc:337-758 — decode
+// cost paid ONCE at dataset-pack time).  bufs[i] points at an HWC uint8
+// image of shape (dh, dw, channels); output is uint8[n, channels, oh, ow].
+// Pure byte movement: one pass, no float math — normalization happens on
+// the device where it fuses into the training step.
+int crop_flip_u8_batch(const uint8_t** bufs, long n, uint8_t* out, int dh,
+                       int dw, int oh, int ow, int channels,
+                       const int* y0s, const int* x0s,
+                       const uint8_t* flips, int nthreads) {
+  if (channels < 1 || channels > 8) return -1;
+  if (oh > dh || ow > dw || oh < 1 || ow < 1) return -2;
+  size_t out_size = (size_t)oh * ow * channels;
+#ifdef _OPENMP
+  if (nthreads > 0) omp_set_num_threads(nthreads);
+#pragma omp parallel for schedule(dynamic)
+#endif
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* img = bufs[i];
+    int y0 = y0s[i], x0 = x0s[i];
+    if (y0 > dh - oh) y0 = dh - oh;
+    if (x0 > dw - ow) x0 = dw - ow;
+    if (y0 < 0) y0 = 0;
+    if (x0 < 0) x0 = 0;
+    const bool flip = flips[i] != 0;
+    uint8_t* dst = out + i * out_size;
+    for (int k = 0; k < channels; ++k) {
+      uint8_t* plane = dst + (size_t)k * oh * ow;
+      for (int y = 0; y < oh; ++y) {
+        const uint8_t* src_row =
+            img + ((size_t)(y0 + y) * dw + x0) * channels + k;
+        uint8_t* out_row = plane + (size_t)y * ow;
+        if (flip) {
+          const uint8_t* s = src_row + (size_t)(ow - 1) * channels;
+          for (int x = 0; x < ow; ++x, s -= channels) out_row[x] = *s;
+        } else {
+          const uint8_t* s = src_row;
+          for (int x = 0; x < ow; ++x, s += channels) out_row[x] = *s;
+        }
+      }
+    }
+  }
+  return 0;
+}
+
 // Probe a JPEG's dimensions without a full decode.
 int jpeg_probe(const uint8_t* buf, int64_t len, int* h, int* w, int* c) {
   jpeg_decompress_struct cinfo;

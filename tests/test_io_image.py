@@ -294,3 +294,77 @@ def test_fused_and_split_augment_paths_agree(tmp_path):
     for (df, lf), (ds, ls) in zip(fused, split):
         np.testing.assert_allclose(lf, ls)
         np.testing.assert_allclose(df, ds, rtol=1e-5, atol=1e-4)
+
+
+def test_image_record_uint8_iter(tmp_path):
+    """Raw pre-decoded records (reference: ImageRecordUInt8Iter,
+    src/io/io.cc:337-758): byte-exact crops, no decode, uint8 NCHW out."""
+    import mxnet_tpu as mx
+    path = str(tmp_path / "raw.rec")
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "raw.idx"), path, 'w')
+    rs = np.random.RandomState(5)
+    imgs = rs.randint(0, 256, (6, 40, 40, 3), dtype=np.uint8)
+    for i in range(6):
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), imgs[i].tobytes()))
+    rec.close()
+
+    it = mx.io.ImageRecordUInt8Iter(
+        path_imgrec=path, data_shape=(3, 32, 32), batch_size=3)
+    batches = list(it)
+    assert len(batches) == 2
+    b = batches[0]
+    d = b.data[0].asnumpy()
+    assert d.dtype == np.uint8 and d.shape == (3, 3, 32, 32)
+    # center crop of stored 40x40 -> offset 4
+    want = imgs[0][4:36, 4:36].transpose(2, 0, 1)
+    np.testing.assert_array_equal(d[0], want)
+    np.testing.assert_array_equal(
+        b.label[0].asnumpy(), np.array([0., 1., 2.], np.float32))
+
+    # rand crop+mirror stays in-bounds and preserves dtype
+    it2 = mx.io.ImageRecordUInt8Iter(
+        path_imgrec=path, data_shape=(3, 32, 32), batch_size=3,
+        rand_crop=True, rand_mirror=True, shuffle=True)
+    d2 = next(iter(it2)).data[0].asnumpy()
+    assert d2.dtype == np.uint8 and d2.shape == (3, 3, 32, 32)
+
+    # mean/std rejected: normalization belongs on device
+    with pytest.raises(mx.base.MXNetError, match="on device"):
+        mx.io.ImageRecordUInt8Iter(path_imgrec=path,
+                                   data_shape=(3, 32, 32),
+                                   batch_size=3, mean_r=123.0)
+
+
+def test_im2rec_pack_raw_roundtrip(tmp_path):
+    """tools/im2rec.py --pack-raw S produces records the uint8 iter reads."""
+    import subprocess
+    import sys as _sys
+    from PIL import Image
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rs = np.random.RandomState(9)
+    for cls in range(2):
+        d = root / f"c{cls}"
+        d.mkdir()
+        for i in range(3):
+            Image.fromarray(rs.randint(0, 255, (50, 60, 3), np.uint8)
+                            ).save(d / f"{i}.jpg")
+    prefix = str(tmp_path / "data")
+    ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [_sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+         prefix, str(root), "--list", "--recursive"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    out = subprocess.run(
+        [_sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+         prefix, str(root), "--pack-raw", "36"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    import mxnet_tpu as mx
+    it = mx.io.ImageRecordUInt8Iter(path_imgrec=prefix + ".rec",
+                                    data_shape=(3, 32, 32), batch_size=2)
+    b = next(iter(it))
+    assert b.data[0].asnumpy().shape == (2, 3, 32, 32)
+    assert b.data[0].asnumpy().dtype == np.uint8

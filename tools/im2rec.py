@@ -111,6 +111,17 @@ def image_encode(args, i, item, q_out):
             img = img.resize((args.resize, args.resize * h // w),
                              Image.BICUBIC)
     arr = np.asarray(img, np.uint8)
+    if args.pack_raw:
+        # pre-decoded fixed-shape uint8 payload (reference:
+        # ImageRecordUInt8Iter, src/io/io.cc:337-758): decode cost is paid
+        # ONCE here; training-time iteration is pure byte movement
+        s = args.pack_raw
+        img = Image.fromarray(arr)
+        if img.size != (s, s):
+            img = img.resize((s, s), Image.BICUBIC)
+        q_out.append((i, recordio.pack(
+            header, np.asarray(img, np.uint8).tobytes()), item))
+        return
     q_out.append((i, recordio.pack_img(header, arr, quality=args.quality,
                                        img_fmt=args.encoding), item))
 
@@ -141,6 +152,10 @@ def parse_args():
     rgroup.add_argument('--num-thread', type=int, default=1)
     rgroup.add_argument('--encoding', type=str, default='.jpg',
                         choices=['.jpg', '.png'])
+    rgroup.add_argument('--pack-raw', type=int, default=0, metavar='S',
+                        help='store PRE-DECODED SxSx3 uint8 payloads '
+                        'instead of JPEG (ImageRecordUInt8Iter fast path; '
+                        'larger file, no decode cost at training time)')
     return parser.parse_args()
 
 
