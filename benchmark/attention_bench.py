@@ -1,0 +1,111 @@
+"""Flash-attention microbench on the real chip (VERDICT r2 item 5).
+
+Compares the Pallas flash kernels (fwd and fwd+bwd) against the naive XLA
+attention oracle (softmax(QK^T)V materialized) at S in {1k, 4k, 16k}, bf16,
+GQA on/off.  Prints one JSON line per config plus a markdown table for
+docs/PERF_NOTES.md.  Run directly on a machine with the TPU tunnel:
+
+    python benchmark/attention_bench.py            # full sweep
+    ATTN_SEQS=1024,4096 python benchmark/attention_bench.py
+
+The naive oracle is O(S^2) memory; configs where it OOMs are reported as
+``naive_ms: null`` (the flash kernel still runs — that IS the capability
+gap being demonstrated).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _sync(x):
+    import jax
+    jax.block_until_ready(x)
+
+
+def _time(fn, *args, iters=10, warmup=2):
+    t_best = None
+    for _ in range(warmup):
+        _sync(fn(*args))
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync(out)
+        dt = (time.perf_counter() - t0) / iters
+        t_best = dt if t_best is None else min(t_best, dt)
+    return t_best * 1e3  # ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import flash_attention, _attn_reference
+
+    dev = jax.devices()[0]
+    seqs = [int(s) for s in
+            os.environ.get("ATTN_SEQS", "1024,4096,16384").split(",")]
+    B, H, D = 4, 16, 128
+    rows = []
+    for S in seqs:
+        for gqa in (False, True):
+            Hk = H // 8 if gqa else H
+            key = jax.random.PRNGKey(0)
+            kq, kk, kv = jax.random.split(key, 3)
+            q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+            k = jax.random.normal(kk, (B, Hk, S, D), jnp.bfloat16)
+            v = jax.random.normal(kv, (B, Hk, S, D), jnp.bfloat16)
+
+            flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
+            naive_f = jax.jit(lambda q, k, v: _attn_reference(q, k, v,
+                                                              True, None))
+
+            def loss_flash(q, k, v):
+                return jnp.sum(flash_attention(q, k, v, True)
+                               .astype(jnp.float32))
+
+            def loss_naive(q, k, v):
+                return jnp.sum(_attn_reference(q, k, v, True, None)
+                               .astype(jnp.float32))
+
+            flash_b = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+            naive_b = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
+
+            row = {"S": S, "gqa": gqa, "B": B, "H": H, "Hk": Hk, "D": D,
+                   "device": dev.device_kind}
+            row["flash_fwd_ms"] = round(_time(flash_f, q, k, v), 3)
+            row["flash_bwd_ms"] = round(_time(flash_b, q, k, v), 3)
+            try:
+                row["naive_fwd_ms"] = round(_time(naive_f, q, k, v), 3)
+                row["naive_bwd_ms"] = round(_time(naive_b, q, k, v), 3)
+            except Exception as e:  # noqa: BLE001 — OOM at long S expected
+                row["naive_fwd_ms"] = row["naive_bwd_ms"] = None
+                row["naive_error"] = str(e)[:120]
+            if row["naive_fwd_ms"]:
+                row["fwd_speedup"] = round(
+                    row["naive_fwd_ms"] / row["flash_fwd_ms"], 2)
+                row["bwd_speedup"] = round(
+                    row["naive_bwd_ms"] / row["flash_bwd_ms"], 2)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    print("\n| S | GQA | flash fwd ms | naive fwd ms | flash f+b ms | "
+          "naive f+b ms | fwd speedup | f+b speedup |")
+    print("|---|-----|-----------|-----------|-----------|-----------|"
+          "------|------|")
+    for r in rows:
+        print("| {S} | {gqa} | {flash_fwd_ms} | {naive_fwd_ms} | "
+              "{flash_bwd_ms} | {naive_bwd_ms} | {fs} | {bs} |".format(
+                  fs=r.get("fwd_speedup", "—"), bs=r.get("bwd_speedup", "—"),
+                  **{k: r.get(k) for k in
+                     ("S", "gqa", "flash_fwd_ms", "naive_fwd_ms",
+                      "flash_bwd_ms", "naive_bwd_ms")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

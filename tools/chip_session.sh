@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Everything that needs the real chip, in priority order, one command.
+# Run when the tunnel is alive (tools/bench_watch.sh logs a SUCCESS line).
+# Every bench result is appended to BENCH_LOG.jsonl by bench.py runs here;
+# partial progress survives a mid-session tunnel death.
+set -u
+cd "$(dirname "$0")/.."
+TS() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+LOG=BENCH_LOG.jsonl
+
+run_bench() {  # run_bench <tag> [env overrides...]
+  local tag="$1"; shift
+  echo "== [$(TS)] bench $tag" >&2
+  local out
+  out=$(env "$@" BENCH_INIT_TIMEOUT_S=600 BENCH_INIT_RETRIES=1 \
+        python bench.py 2>chip_session_stderr.log | tail -1)
+  echo "$out"
+  local val
+  val=$(printf '%s' "$out" | python -c \
+    'import json,sys
+try: print(json.loads(sys.stdin.read()).get("value"))
+except Exception: print("None")')
+  if [ "$val" != "None" ] && [ -n "$val" ]; then
+    printf '%s' "$out" | python -c \
+      "import json,sys;d=json.loads(sys.stdin.read());d['ts']='$(TS)';d['tag']='$tag';print(json.dumps(d))" >> "$LOG"
+    echo "== [$(TS)] $tag OK: $val imgs/sec" >&2
+  else
+    echo "== [$(TS)] $tag FAILED (see chip_session_stderr.log)" >&2
+    tail -3 chip_session_stderr.log >&2 || true
+    return 1
+  fi
+}
+
+# 1. baseline config first — the driver-verifiable number (VERDICT item 1)
+run_bench baseline || exit 1
+
+# 2. MFU sweep (VERDICT item 2): batch x stem x remat
+run_bench b512           BENCH_BATCH=512
+run_bench s2d            BENCH_STEM=s2d
+run_bench b512_s2d       BENCH_BATCH=512 BENCH_STEM=s2d
+run_bench b512_s2d_remat BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=1
+run_bench b256_remat     BENCH_REMAT=1
+
+# 3. real-data end-to-end (VERDICT item 3)
+run_bench record         BENCH_DATA=record
+run_bench record_b512    BENCH_DATA=record BENCH_BATCH=512
+
+# 4. flash-attention microbench (VERDICT item 5)
+echo "== [$(TS)] attention microbench" >&2
+python benchmark/attention_bench.py | tee attention_bench_out.txt || true
+
+# 5. real-data convergence artifact (VERDICT item 4)
+echo "== [$(TS)] digits convergence" >&2
+python tools/chip_convergence_run.py || true
+
+echo "== [$(TS)] chip session complete; results in $LOG" >&2
