@@ -40,9 +40,9 @@ class AlexNet(HybridBlock):
         return x
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
+def alexnet(pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_params(get_model_file('alexnet'), ctx=ctx)
+        net.load_params(get_model_file('alexnet', root=root), ctx=ctx)
     return net

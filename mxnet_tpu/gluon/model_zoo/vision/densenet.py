@@ -85,12 +85,12 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def get_densenet(num_layers, pretrained=False, ctx=None, **kwargs):
+def get_densenet(num_layers, pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_params(get_model_file(f'densenet{num_layers}'), ctx=ctx)
+        net.load_params(get_model_file(f'densenet{num_layers}', root=root), ctx=ctx)
     return net
 
 

@@ -147,9 +147,9 @@ class Inception3(HybridBlock):
         return x
 
 
-def inception_v3(pretrained=False, ctx=None, **kwargs):
+def inception_v3(pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_params(get_model_file('inceptionv3'), ctx=ctx)
+        net.load_params(get_model_file('inceptionv3', root=root), ctx=ctx)
     return net

@@ -48,12 +48,12 @@ class MobileNet(HybridBlock):
         return x
 
 
-def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
         version_suffix = f'{multiplier:.2f}'.rstrip('0').rstrip('.')
-        net.load_params(get_model_file(f'mobilenet{version_suffix}'),
+        net.load_params(get_model_file(f'mobilenet{version_suffix}', root=root),
                         ctx=ctx)
     return net
 

@@ -244,7 +244,7 @@ resnet_block_versions = [
 ]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+def get_resnet(version, num_layers, pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     """reference: vision/resnet.py:371."""
     if num_layers not in resnet_spec:
         raise MXNetError(
@@ -258,7 +258,7 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_params(get_model_file(f'resnet{num_layers}_v{version}'),
+        net.load_params(get_model_file(f'resnet{num_layers}_v{version}', root=root),
                         ctx=ctx)
     return net
 

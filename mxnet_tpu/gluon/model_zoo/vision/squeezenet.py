@@ -94,17 +94,17 @@ class SqueezeNet(HybridBlock):
         return x
 
 
-def squeezenet1_0(pretrained=False, ctx=None, **kwargs):
+def squeezenet1_0(pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     net = SqueezeNet('1.0', **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_params(get_model_file('squeezenet1.0'), ctx=ctx)
+        net.load_params(get_model_file('squeezenet1.0', root=root), ctx=ctx)
     return net
 
 
-def squeezenet1_1(pretrained=False, ctx=None, **kwargs):
+def squeezenet1_1(pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     net = SqueezeNet('1.1', **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_params(get_model_file('squeezenet1.1'), ctx=ctx)
+        net.load_params(get_model_file('squeezenet1.1', root=root), ctx=ctx)
     return net

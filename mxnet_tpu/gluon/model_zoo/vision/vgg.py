@@ -47,7 +47,7 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 
-def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
+def get_vgg(num_layers, pretrained=False, ctx=None, root='~/.mxnet/models', **kwargs):
     """reference: vision/vgg.py:82."""
     if num_layers not in vgg_spec:
         raise MXNetError(f"Invalid vgg depth {num_layers}")
@@ -56,7 +56,7 @@ def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
     if pretrained:
         from ..model_store import get_model_file
         bn = '_bn' if kwargs.get('batch_norm') else ''
-        net.load_params(get_model_file(f'vgg{num_layers}{bn}'), ctx=ctx)
+        net.load_params(get_model_file(f'vgg{num_layers}{bn}', root=root), ctx=ctx)
     return net
 
 

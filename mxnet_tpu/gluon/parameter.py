@@ -119,6 +119,37 @@ class Parameter:
         if self._grad_req != 'null':
             self._init_grad()
 
+    def _load_init(self, data):
+        """Initialize directly from a checkpoint value (reference:
+        parameter.py _load_init — load_params on a NEVER-initialized net,
+        the model-zoo ``pretrained=True`` flow, takes shape AND value from
+        the file)."""
+        shape = tuple(data.shape)
+        if self.shape is not None:
+            if len(self.shape) != len(shape):
+                raise MXNetError(
+                    f"loading {self.name!r}: file rank {len(shape)} "
+                    f"({shape}) != declared rank {len(self.shape)} "
+                    f"({self.shape})")
+            for s, t in zip(self.shape, shape):
+                if s not in (0, t):
+                    raise MXNetError(
+                        f"loading {self.name!r}: file shape {shape} "
+                        f"incompatible with declared {self.shape}")
+        self.shape = shape
+        arr = data if isinstance(data, NDArray) else nd_array(data)
+        if self.dtype is not None and str(arr.dtype) != str(self.dtype):
+            # match the declared dtype (reference _load_init casts): the
+            # gradient _init_grad allocates uses self.dtype, and data/grad
+            # dtypes must agree for mark_variables/optimizer updates
+            arr = arr.astype(self.dtype)
+        elif arr is data:
+            arr = data.copy()
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != 'null':
+            self._init_grad()
+
     def _finish_deferred_init(self, shape):
         """Complete deferred init once the input-driven shape is known
         (reference: parameter.py:585)."""
@@ -376,4 +407,10 @@ class ParameterDict:
                 raise MXNetError(
                     f"param {name!r} in file not in ParameterDict; "
                     f"set ignore_extra=True to skip")
-            self._params[name].set_data(v)
+            p = self._params[name]
+            if p._data is None and p._deferred_init is None:
+                # never-initialized net (model-zoo pretrained flow):
+                # shape and value both come from the file
+                p._load_init(v)
+            else:
+                p.set_data(v)
