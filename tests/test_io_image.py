@@ -368,3 +368,37 @@ def test_im2rec_pack_raw_roundtrip(tmp_path):
     b = next(iter(it))
     assert b.data[0].asnumpy().shape == (2, 3, 32, 32)
     assert b.data[0].asnumpy().dtype == np.uint8
+
+
+def test_uint8_iter_identity_mean_std_and_next_raw(tmp_path):
+    import mxnet_tpu as mx
+    path = str(tmp_path / "r.rec")
+    rec = recordio.MXRecordIO(path, 'w')
+    rs = np.random.RandomState(1)
+    for i in range(4):
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                  rs.randint(0, 256, (36, 36, 3), np.uint8).tobytes()))
+    rec.close()
+    # identity mean/std values are accepted (no-op), non-identity rejected
+    it = mx.io.ImageRecordUInt8Iter(path_imgrec=path, data_shape=(3, 32, 32),
+                                    batch_size=2, std_r=1.0, mean_r=0.0)
+    d, lab, pad = it.next_raw()
+    assert d.dtype == np.uint8 and d.shape == (2, 3, 32, 32) and pad == 0
+    with pytest.raises(mx.base.MXNetError, match="on device"):
+        mx.io.ImageRecordUInt8Iter(path_imgrec=path, data_shape=(3, 32, 32),
+                                   batch_size=2, std_r=58.4)
+
+
+def test_prefetch_thread_error_surfaces(tmp_path):
+    """A failure in the producer thread must raise at next(), not silently
+    truncate the epoch (which would also hang double-buffering callers)."""
+    import mxnet_tpu as mx
+    path = str(tmp_path / "bad.rec")
+    rec = recordio.MXRecordIO(path, 'w')
+    rec.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0), b"\0" * 100))
+    rec.write(recordio.pack(recordio.IRHeader(0, 1.0, 1, 0), b"\0" * 99))
+    rec.close()
+    it = mx.io.ImageRecordUInt8Iter(path_imgrec=path, data_shape=(3, 4, 4),
+                                    batch_size=2, stored_shape=(5, 5))
+    with pytest.raises(mx.base.MXNetError, match="prefetch thread"):
+        next(iter(it))
