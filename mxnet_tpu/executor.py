@@ -150,6 +150,10 @@ def build_interpreter(sym: Symbol, compute_dtype=None):
         out_vals = tuple(env[(id(h), i)] for h, i in heads)
         return out_vals, tuple(new_aux)
 
+    # whether the program actually consumes the PRNG key: dispatch uses
+    # this to skip the per-step eager fold_in (a device op — through a
+    # remote-attached chip that is a per-step round-trip for nothing)
+    run.needs_rng = bool(rng_ids)
     return run, arg_names, aux_names
 
 
@@ -293,6 +297,9 @@ class Executor:
             for n, s in zip(arg_names, arg_shapes)]
         return ex
 
+    def _next_key(self):
+        return _rnd.key_for(self._run)
+
     # ------------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         """Lazy forward: argument *values* are captured now; outputs
@@ -308,7 +315,7 @@ class Executor:
             else:
                 self.arg_arrays[pos]._set_data(jnp.asarray(v))
         self._is_train = is_train
-        self._last_key = _rnd.next_key()
+        self._last_key = self._next_key()
         # snapshot the input values: later arg mutation (or a second
         # forward) must not change what THIS forward's outputs resolve to
         snapshot = (self._arg_vals(), self._aux_vals(), self._last_key,
@@ -458,7 +465,7 @@ class Executor:
         else:
             arg_vals, aux_vals = self._arg_vals(), self._aux_vals()
             key = self._last_key if self._last_key is not None \
-                else _rnd.next_key()
+                else self._next_key()
         out_avals = self._out_aval_list(True)
         diff_avals = [o for o in out_avals
                       if jnp.issubdtype(o.dtype, jnp.inexact)]

@@ -226,7 +226,7 @@ class _CachedGraph:
         by_name.update(param_map)
         args = tuple(by_name[n] for n in self.arg_names)
         aux = tuple(aux_map[n] for n in self.aux_names)
-        key = _rnd.next_key()
+        key = _rnd.key_for(self.run)
 
         is_train = bool(is_train)
         if autograd.is_recording():
@@ -448,14 +448,15 @@ class SymbolBlock(HybridBlock):
         if self._cached is None:
             run, arg_names, aux_names = build_interpreter(self._output_sym)
             self._cached = (jax.jit(
-                lambda a, x, k: run(a, x, k, False)), arg_names, aux_names)
-        jfn, arg_names, aux_names = self._cached
+                lambda a, x, k: run(a, x, k, False)), arg_names, aux_names,
+                run)
+        jfn, arg_names, aux_names, run = self._cached
         by_name = dict(zip(self._input_names, (a._data for a in args)))
         for n in arg_names:
             if n not in by_name:
                 by_name[n] = params[n].data()._data
         aux = tuple(params[n].data()._data for n in aux_names)
         outs, _ = jfn(tuple(by_name[n] for n in arg_names), aux,
-                      _rnd.next_key())
+                      _rnd.key_for(run))
         outs = [NDArray(o) for o in outs]
         return outs[0] if len(outs) == 1 else outs

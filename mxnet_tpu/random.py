@@ -39,3 +39,22 @@ def next_key():
 
 def split_key(n: int):
     return jax.random.split(next_key(), n)
+
+
+_dummy_key = None
+
+
+def key_for(run):
+    """Key for one interpreter invocation.
+
+    next_key() is an eager fold_in — a real device dispatch (a per-step
+    round-trip on a remote-attached chip).  Interpreters from
+    build_interpreter carry ``needs_rng``; RNG-free programs (most CNN
+    training steps) share one constant key instead, which also keeps jit
+    cache signatures stable."""
+    global _dummy_key
+    if getattr(run, "needs_rng", True):
+        return next_key()
+    if _dummy_key is None:
+        _dummy_key = jax.random.PRNGKey(0)
+    return _dummy_key
