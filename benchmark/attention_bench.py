@@ -27,11 +27,16 @@ def _sync(x):
     jax.block_until_ready(x)
 
 
-def _time(fn, *args, iters=10, warmup=2):
+ITERS = max(1, int(os.environ.get("ATTN_ITERS", "10")))
+REPEATS = max(1, int(os.environ.get("ATTN_REPEATS", "3")))
+
+
+def _time(fn, *args, iters=None, warmup=2):
+    iters = ITERS if iters is None else iters
     t_best = None
     for _ in range(warmup):
         _sync(fn(*args))
-    for _ in range(3):
+    for _ in range(REPEATS):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn(*args)

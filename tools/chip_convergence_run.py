@@ -37,10 +37,11 @@ def main():
     d = load_digits()
     x = (d.images / 16.0).astype(np.float32)       # (1797, 8, 8) in [0,1]
     y = d.target.astype(np.float32)
-    # upscale 8x8 -> 32x32 (nearest x4) and replicate to 3 channels so the
-    # CIFAR-stem ResNet-20 sees its native input shape
-    x = x.repeat(4, axis=1).repeat(4, axis=2)
-    x = np.stack([x, x, x], axis=1)                # (N, 3, 32, 32)
+    # upscale 8x8 -> 24x24 (nearest x3), pad to 28x28, replicate to 3
+    # channels: the CIFAR-table ResNet-20 (3 stages) takes 28x28 inputs
+    x = x.repeat(3, axis=1).repeat(3, axis=2)
+    x = np.pad(x, ((0, 0), (2, 2), (2, 2)))
+    x = np.stack([x, x, x], axis=1)                # (N, 3, 28, 28)
     rs = np.random.RandomState(0)
     order = rs.permutation(len(x))
     x, y = x[order], y[order]
@@ -53,7 +54,7 @@ def main():
     test = mx.io.NDArrayIter(xte, yte, batch)
 
     net = models.resnet(num_classes=10, num_layers=20,
-                        image_shape=(3, 32, 32))
+                        image_shape=(3, 28, 28))
     import jax.numpy as jnp
     mod = mx.mod.Module(net, context=mx.tpu(0),
                         compute_dtype=jnp.bfloat16)
@@ -62,13 +63,17 @@ def main():
     mx.random.seed(42)
     mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
                                           magnitude=2.0))
+    steps_per_epoch = len(xtr) // batch
+    sched = mx.lr_scheduler.MultiFactorScheduler(
+        step=[15 * steps_per_epoch, 30 * steps_per_epoch], factor=0.1)
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.1,
-                                         "momentum": 0.9, "wd": 1e-4})
+                                         "momentum": 0.9, "wd": 1e-4,
+                                         "lr_scheduler": sched})
     metric = mx.metric.Accuracy()
     curve = []
     t0 = time.time()
-    for epoch in range(30):
+    for epoch in range(40):
         train.reset()
         metric.reset()
         for b in train:
