@@ -40,11 +40,16 @@ DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 # TPU-native stem variant (space-to-depth, mathematically equivalent —
-# models/resnet.py space_to_depth_stem_weight) and rematerialization
+# models/resnet.py space_to_depth_stem_weight) and rematerialization.
+# BENCH_REMAT: 0 (off), 1/full (whole-step recompute), save_matmuls
+# (keep conv/FC outputs, recompute elementwise chains only)
 STEM = os.environ.get("BENCH_STEM", "conv7")
-if os.environ.get("BENCH_REMAT", "0") == "1":
+_REMAT = os.environ.get("BENCH_REMAT", "0")
+if _REMAT != "0":
     # must be set before the Module traces the step (executor.maybe_mirror)
     os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    if _REMAT not in ("1", "full"):
+        os.environ["MXNET_REMAT_POLICY"] = _REMAT
 
 # peak dense bf16 FLOP/s per chip, keyed by jax device_kind substring
 PEAK_BF16 = [
@@ -342,7 +347,11 @@ def _run(batch):
         "flops_source": flops_source,
         "peak_flops": peak,
         "stem": STEM,
-        "remat": os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1",
+        # report from the env the executor actually reads, so an
+        # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
+        "remat": (os.environ.get("MXNET_REMAT_POLICY", "full")
+                  if os.environ.get("MXNET_BACKWARD_DO_MIRROR") == "1"
+                  else False),
         "data_mode": os.environ.get("BENCH_DATA", "synthetic"),
     }
     if real_iter is not None:

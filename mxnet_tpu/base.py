@@ -72,6 +72,10 @@ declare_env("MXNET_EXEC_BULK_EXEC_INFERENCE", bool, True,
             "jit whole forward graphs for inference")
 declare_env("MXNET_BACKWARD_DO_MIRROR", bool, False,
             "rematerialise activations in backward (jax.checkpoint)")
+declare_env("MXNET_REMAT_POLICY", str, "full",
+            "what remat keeps: 'full' recomputes everything; "
+            "'save_matmuls' keeps conv/FC/dot/MoE outputs and recomputes "
+            "only the elementwise chains between them")
 declare_env("MXNET_PROFILER_MODE", str, "symbolic_only", "")
 declare_env("MXNET_PROFILER_AUTOSTART", bool, False, "")
 declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,

@@ -61,11 +61,30 @@ def maybe_mirror(run):
     mirror-recompute): activations are rematerialized in backward, trading
     FLOPs for HBM.  Returns a function with the same
     (args, aux, key, is_train) signature; remat always traces train mode
-    (the only mode with a backward)."""
+    (the only mode with a backward).
+
+    MXNET_REMAT_POLICY selects what backward may keep:
+      * "full" (default) — keep nothing: recompute the whole forward
+        (~33% extra FLOPs, maximum memory relief).
+      * "save_matmuls" — keep conv/FC outputs (tagged with
+        checkpoint_name in ops/nn.py) and recompute only the cheap
+        elementwise/normalization chains between them: most of the
+        memory relief for a few percent of FLOPs — the right trade for
+        batch-512 ResNet on a 16 GB chip.
+    """
     from .base import env as _env
     if not _env("MXNET_BACKWARD_DO_MIRROR", False):
         return run
-    remat = jax.checkpoint(lambda av, aux, k: run(av, aux, k, True))
+    policy_name = _env("MXNET_REMAT_POLICY", "full")
+    kw = {}
+    if policy_name == "save_matmuls":
+        kw["policy"] = jax.checkpoint_policies.save_only_these_names(
+            "conv_out", "matmul_out")
+    elif policy_name != "full":
+        raise MXNetError(
+            f"MXNET_REMAT_POLICY={policy_name!r}: expected 'full' or "
+            f"'save_matmuls'")
+    remat = jax.checkpoint(lambda av, aux, k: run(av, aux, k, True), **kw)
     return lambda av, aux, k, _t: remat(av, aux, k)
 
 

@@ -11,6 +11,8 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
 from .registry import register, alias
 from ..base import MXNetError
 
@@ -185,7 +187,7 @@ def _dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
         lhs = jnp.transpose(lhs)
     if transpose_b:
         rhs = jnp.transpose(rhs)
-    return jnp.tensordot(lhs, rhs, axes=1)
+    return _ckpt_name(jnp.tensordot(lhs, rhs, axes=1), "matmul_out")
 
 
 @register("batch_dot", arg_names=["lhs", "rhs"],
@@ -195,7 +197,7 @@ def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
         lhs = jnp.swapaxes(lhs, -1, -2)
     if transpose_b:
         rhs = jnp.swapaxes(rhs, -1, -2)
-    return jnp.matmul(lhs, rhs)
+    return _ckpt_name(jnp.matmul(lhs, rhs), "matmul_out")
 
 
 @register("tile", arg_names=["data"], attr_defaults={"reps": ()})

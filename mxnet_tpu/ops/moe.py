@@ -17,6 +17,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
 from .registry import register
 
 
@@ -69,12 +71,16 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, num_experts, k=1,
     dispatch, combine = _top_k_gating(logits, k, capacity)
     # route tokens to experts: (E, C, d)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)
-    h = jnp.einsum("ecd,edf->ecf", expert_in, w1) + b1[:, None, :]
+    # tagged so MXNET_REMAT_POLICY=save_matmuls keeps the expensive expert
+    # matmul outputs and recomputes only the activation/bias chains
+    h = _ckpt_name(jnp.einsum("ecd,edf->ecf", expert_in, w1),
+                   "matmul_out") + b1[:, None, :]
     if activation == "relu":
         h = jax.nn.relu(h)
     elif activation == "gelu":
         h = jax.nn.gelu(h)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    expert_out = _ckpt_name(jnp.einsum("ecf,efd->ecd", h, w2),
+                            "matmul_out") + b2[:, None, :]
     out = jnp.einsum("tec,ecd->td", combine, expert_out)   # (T, d)
     return out.reshape(orig_shape)
 

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
 from .registry import register, alias
 
 
@@ -40,7 +42,7 @@ def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
     out = jnp.matmul(data, weight.T)
     if not no_bias and bias is not None:
         out = out + bias
-    return out
+    return _ckpt_name(out, "matmul_out")
 
 
 # --------------------------------------------------------------------------
@@ -75,7 +77,10 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         feature_group_count=num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * rank)
-    return out
+    # identity outside remat; under MXNET_REMAT_POLICY=save_matmuls the
+    # backward keeps conv outputs and recomputes only the cheap
+    # elementwise chains (executor.maybe_mirror)
+    return _ckpt_name(out, "conv_out")
 
 
 @register("Deconvolution", arg_names=["data", "weight", "bias"],

@@ -410,6 +410,51 @@ def test_backward_do_mirror_same_numerics():
     np.testing.assert_allclose(run(False), run(True), rtol=1e-6, atol=1e-7)
 
 
+def test_remat_save_matmuls_policy_same_numerics():
+    """MXNET_REMAT_POLICY=save_matmuls (keep conv/FC outputs, recompute
+    elementwise chains) must match plain training numerics; a conv net
+    exercises the checkpoint_name-tagged conv path too."""
+    rs = np.random.RandomState(2)
+    X = rs.rand(32, 1, 12, 12).astype('f')
+    Y = (X.mean((1, 2, 3)) > X.mean()).astype('f')
+
+    def run(policy):
+        if policy:
+            os.environ['MXNET_BACKWARD_DO_MIRROR'] = '1'
+            os.environ['MXNET_REMAT_POLICY'] = policy
+        try:
+            mx.random.seed(5)
+            data = mx.sym.Variable('data')
+            net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                                     pad=(1, 1), name='c1')
+            net = mx.sym.BatchNorm(net, fix_gamma=False, name='bn1')
+            net = mx.sym.Activation(net, act_type='relu')
+            net = mx.sym.FullyConnected(net, num_hidden=2, name='fc1')
+            net = mx.sym.SoftmaxOutput(net, name='softmax')
+            train = mx.io.NDArrayIter(X, Y, batch_size=16)
+            mod = mx.mod.Module(net, context=mx.cpu())
+            mod.bind(data_shapes=train.provide_data,
+                     label_shapes=train.provide_label)
+            mod.init_params(initializer=mx.initializer.Xavier())
+            mod.init_optimizer(optimizer='sgd',
+                               optimizer_params={'learning_rate': 0.1,
+                                                 'momentum': 0.9})
+            batch = next(iter(train))
+            for _ in range(3):
+                mod.forward(batch, is_train=True)
+                mod.update()
+            return mod.get_params()[0]['c1_weight'].asnumpy()
+        finally:
+            os.environ.pop('MXNET_BACKWARD_DO_MIRROR', None)
+            os.environ.pop('MXNET_REMAT_POLICY', None)
+
+    base = run(None)
+    np.testing.assert_allclose(base, run('save_matmuls'),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(base, run('full'), rtol=1e-6, atol=1e-7)
+
+
+
 def test_module_reshape():
     """reference: test_module.py test_module_reshape — batch-size switch
     keeps params and optimizer state."""
