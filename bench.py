@@ -356,6 +356,13 @@ def _run(batch):
     }
     if real_iter is not None:
         out["host_pipeline_imgs_per_sec"] = round(host_rate, 1)
+    try:
+        stats = dev.memory_stats() or {}
+        peak_bytes = stats.get("peak_bytes_in_use")
+        if peak_bytes:
+            out["peak_hbm_gb"] = round(peak_bytes / 2**30, 2)
+    except Exception:  # noqa: BLE001 — not all backends expose stats
+        pass
     print(json.dumps(out))
     return 0
 

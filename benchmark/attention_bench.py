@@ -54,6 +54,9 @@ def main():
     dev = jax.devices()[0]
     seqs = [int(s) for s in
             os.environ.get("ATTN_SEQS", "1024,4096,16384").split(",")]
+    # kernel tile sweep, e.g. ATTN_BLOCKS=128x128,128x256
+    blocks = [tuple(int(x) for x in spec.split("x")) for spec in
+              os.environ.get("ATTN_BLOCKS", "128x128").split(",")]
     B, H, D = 4, 16, 128
     rows = []
     for S in seqs:
@@ -65,51 +68,70 @@ def main():
             k = jax.random.normal(kk, (B, Hk, S, D), jnp.bfloat16)
             v = jax.random.normal(kv, (B, Hk, S, D), jnp.bfloat16)
 
-            flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
-            naive_f = jax.jit(lambda q, k, v: _attn_reference(q, k, v,
-                                                              True, None))
-
-            def loss_flash(q, k, v):
-                return jnp.sum(flash_attention(q, k, v, True)
-                               .astype(jnp.float32))
+            # the naive oracle is block-independent: time it ONCE per
+            # (S, gqa) — it is the O(S^2), OOM-prone, slowest leg
+            naive_f = jax.jit(lambda q, k, v: _attn_reference(
+                q, k, v, True, None))
 
             def loss_naive(q, k, v):
                 return jnp.sum(_attn_reference(q, k, v, True, None)
                                .astype(jnp.float32))
 
-            flash_b = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
             naive_b = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
-
-            row = {"S": S, "gqa": gqa, "B": B, "H": H, "Hk": Hk, "D": D,
-                   "device": dev.device_kind}
-            row["flash_fwd_ms"] = round(_time(flash_f, q, k, v), 3)
-            row["flash_bwd_ms"] = round(_time(flash_b, q, k, v), 3)
+            naive = {}
             try:
-                row["naive_fwd_ms"] = round(_time(naive_f, q, k, v), 3)
-                row["naive_bwd_ms"] = round(_time(naive_b, q, k, v), 3)
+                naive["fwd"] = round(_time(naive_f, q, k, v), 3)
+                naive["bwd"] = round(_time(naive_b, q, k, v), 3)
             except Exception as e:  # noqa: BLE001 — OOM at long S expected
-                row["naive_fwd_ms"] = row["naive_bwd_ms"] = None
-                row["naive_error"] = str(e)[:120]
-            if row["naive_fwd_ms"]:
-                row["fwd_speedup"] = round(
-                    row["naive_fwd_ms"] / row["flash_fwd_ms"], 2)
-                row["bwd_speedup"] = round(
-                    row["naive_bwd_ms"] / row["flash_bwd_ms"], 2)
-            rows.append(row)
-            print(json.dumps(row), flush=True)
+                naive["error"] = str(e)[:120]
 
-    print("\n| S | GQA | flash fwd ms | naive fwd ms | flash f+b ms | "
-          "naive f+b ms | fwd speedup | f+b speedup |")
-    print("|---|-----|-----------|-----------|-----------|-----------|"
-          "------|------|")
+            for bq, bk in blocks:
+                _bench_flash(rows, dev, S, gqa, bq, bk, B, H, Hk, D,
+                             q, k, v, naive)
+    print("\n| S | GQA | blocks | flash fwd ms | naive fwd ms | "
+          "flash f+b ms | naive f+b ms | fwd speedup | f+b speedup |")
+    print("|---|-----|-----|-----------|-----------|-----------|"
+          "-----------|------|------|")
     for r in rows:
-        print("| {S} | {gqa} | {flash_fwd_ms} | {naive_fwd_ms} | "
-              "{flash_bwd_ms} | {naive_bwd_ms} | {fs} | {bs} |".format(
+        print("| {S} | {gqa} | {blocks} | {flash_fwd_ms} | "
+              "{naive_fwd_ms} | {flash_bwd_ms} | {naive_bwd_ms} | "
+              "{fs} | {bs} |".format(
                   fs=r.get("fwd_speedup", "—"), bs=r.get("bwd_speedup", "—"),
                   **{k: r.get(k) for k in
-                     ("S", "gqa", "flash_fwd_ms", "naive_fwd_ms",
+                     ("S", "gqa", "blocks", "flash_fwd_ms", "naive_fwd_ms",
                       "flash_bwd_ms", "naive_bwd_ms")}))
     return 0
+
+
+def _bench_flash(rows, dev, S, gqa, bq, bk, B, H, Hk, D, q, k, v, naive):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import flash_attention
+
+    flash_f = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, True, None, bq, bk))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None, bq, bk)
+                       .astype(jnp.float32))
+
+    flash_b = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+
+    row = {"S": S, "gqa": gqa, "blocks": "%dx%d" % (bq, bk),
+           "B": B, "H": H, "Hk": Hk, "D": D, "device": dev.device_kind}
+    row["flash_fwd_ms"] = round(_time(flash_f, q, k, v), 3)
+    row["flash_bwd_ms"] = round(_time(flash_b, q, k, v), 3)
+    row["naive_fwd_ms"] = naive.get("fwd")
+    row["naive_bwd_ms"] = naive.get("bwd")
+    if "error" in naive:
+        row["naive_error"] = naive["error"]
+    if row["naive_fwd_ms"]:
+        row["fwd_speedup"] = round(
+            row["naive_fwd_ms"] / row["flash_fwd_ms"], 2)
+        row["bwd_speedup"] = round(
+            row["naive_bwd_ms"] / row["flash_bwd_ms"], 2)
+    rows.append(row)
+    print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

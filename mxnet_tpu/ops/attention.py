@@ -343,23 +343,32 @@ def _flash_bwd(q, k, v, out, lse, g, causal=False, scale=None,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, scale=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=128, block_k=128):
     """Blocked online-softmax attention.  q: (B, H, S, D); k/v:
     (B, Hk, S, D) with Hk dividing H — Hk < H is grouped-query /
-    multi-query attention with the shared KV never materialized."""
-    return _flash_fwd(q, k, v, causal=causal, scale=scale)
+    multi-query attention with the shared KV never materialized.
+
+    block_q/block_k tile the kernel's VMEM working set; 128/128 suits
+    v5e's 128x128 MXU, but long-S or small-D configs can profit from
+    256-wide K blocks — benchmark/attention_bench.py sweeps them via
+    ATTN_BLOCKS."""
+    return _flash_fwd(q, k, v, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k)
 
 
-def _fa_fwd(q, k, v, causal, scale):
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
                           return_lse=True)
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, scale, res, g):
+def _fa_bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal=causal, scale=scale)
+    return _flash_bwd(q, k, v, out, lse, g, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
