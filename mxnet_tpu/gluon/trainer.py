@@ -9,7 +9,11 @@ accepted for API parity and drives update_on_kvstore semantics.
 """
 from __future__ import annotations
 
-from ..base import MXNetError
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, env
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
 from .parameter import ParameterDict, Parameter
@@ -65,11 +69,22 @@ class Trainer:
         self._optimizer.lr = lr
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """reference: trainer.py:148."""
+        """reference: trainer.py:148.
+
+        Dense-gradient params with a pure-jax optimizer go through ONE
+        jitted update over all of them (the gluon analog of Module's fused
+        step — N per-param eager dispatches per step would each be a
+        device round-trip on a remote-attached chip).  Sparse-gradient
+        params and non-pure optimizers keep the per-param eager path.
+        """
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         updater = self._updaters[0]
+        from ..ndarray.sparse import RowSparseNDArray
+        fuse = (env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
+                and getattr(self._optimizer, "pure_update", False))
+        fused_batch = []
         for i, param in enumerate(self._params):
             if param.grad_req == 'null':
                 continue
@@ -78,7 +93,81 @@ class Trainer:
                     raise MXNetError(
                         f"Parameter {param.name!r} was not initialized")
                 continue
-            updater(i, param.grad(), param.data())
+            grad = param.grad()
+            if fuse and not isinstance(grad, RowSparseNDArray):
+                fused_batch.append((i, param, grad))
+            else:
+                updater(i, grad, param.data())
+        if fused_batch:
+            self._fused_update(fused_batch, updater)
+
+    def _fused_update(self, batch, updater):
+        """Apply the optimizer to every (dense) param in ONE jit call
+        (the per-param dispatch lives in Optimizer.apply_fused, shared
+        with Module's fused step).
+
+        Shares per-param state with the eager Updater (same dict), so
+        save_states/load_states and mixing eager/sparse updates stay
+        coherent.  The jit cache is a dict keyed by (param set, mp
+        layout, optimizer hyperparameter signature): changing e.g.
+        momentum or rescale_grad mid-run retraces, and alternating keys
+        (a smaller final batch) each compile once.
+        """
+        opt = self._optimizer
+        for i, param, _g in batch:
+            if i not in updater.states:
+                updater.states[i] = \
+                    opt.create_state_multi_precision(i, param.data())
+                updater.states_synced[i] = True
+            opt._update_count(i)
+        needs_t = getattr(opt, "needs_t", False)
+        states = [opt._state_tuple(updater.states[i]) for i, _p, _g in batch]
+        use_mp = tuple(opt.mp_states_active(p.data(), st)
+                       for (_i, p, _g), st in zip(batch, states))
+        key = (tuple(i for i, _p, _g in batch), use_mp, needs_t,
+               opt.hyperparam_signature())
+        cache = getattr(self, "_fused_cache", None)
+        if cache is None:
+            cache = self._fused_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            def fused(ws, gs, sts, lrs, wds, ts):
+                return opt.apply_fused(ws, gs, sts, lrs, wds, use_mp,
+                                       ts=ts if needs_t else None)
+
+            fn = cache[key] = jax.jit(fused)
+        ws = tuple(p._data._data for _i, p, _g in batch)
+        gs = tuple(g._data for _i, _p, g in batch)
+        sts = tuple(tuple(s._data for s in st) for st in states)
+        # cache lr/wd device scalars while unchanged (per-step host→device
+        # scalar transfers would reintroduce the round-trips this path
+        # removes — same discipline as Module._lrwd_cache)
+        lrs = tuple(np.float32(opt._get_lr(i)) for i, _p, _g in batch)
+        wds = tuple(np.float32(opt._get_wd(i)) for i, _p, _g in batch)
+        lw_cache = getattr(self, "_lrwd_cache", None)
+        if lw_cache is not None and lw_cache[0] == (lrs, wds):
+            lrs, wds = lw_cache[1]
+        else:
+            key_ = (lrs, wds)
+            lrs = tuple(jnp.asarray(v) for v in lrs)
+            wds = tuple(jnp.asarray(v) for v in wds)
+            self._lrwd_cache = (key_, (lrs, wds))
+        if needs_t:
+            # per-param bias-correction counts (a frozen/unfrozen param's
+            # count differs — matching the eager path exactly)
+            ts = tuple(jnp.asarray(opt._index_update_count[i], jnp.int32)
+                       for i, _p, _g in batch)
+        else:
+            ts = getattr(self, "_t_zeros", None)
+            if ts is None or len(ts) != len(batch):
+                ts = self._t_zeros = tuple(
+                    jnp.asarray(0, jnp.int32) for _ in batch)
+        new_ws, new_sts = fn(ws, gs, sts, lrs, wds, ts)
+        for (_i, p, _g), w, st_old, st_new in zip(batch, new_ws, states,
+                                                  new_sts):
+            p._data._set_data(w)
+            for s, v in zip(st_old, st_new):
+                s._set_data(v)
 
     def allreduce_grads(self):
         """No-op on TPU: gradient reduction is fused into backward

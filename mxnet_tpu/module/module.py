@@ -449,8 +449,13 @@ class Module(BaseModule):
             self._pending_backward = False
             return
 
-        if self._fused_step is None:
+        sig = opt.hyperparam_signature()
+        if self._fused_step is None or \
+                getattr(self, "_fused_hparam_sig", None) != sig:
+            # hyperparameters (momentum, betas, rescale_grad...) are baked
+            # into the trace — rebuild if they were mutated mid-run
             self._fused_step = self._build_fused_step(names)
+            self._fused_hparam_sig = sig
         for n in names:
             opt._update_count(n)
         t = opt._index_update_count[names[0]] if names else 1
@@ -551,20 +556,11 @@ class Module(BaseModule):
             diff, vjp_fn, (outs, new_aux) = jax.vjp(f, pvals, has_aux=True)
             cts = tuple(jnp.ones(o.shape, o.dtype) for o in diff)
             grads = vjp_fn(cts)[0]
-            new_params = []
-            new_states = []
-            kw = {"t": t} if needs_t else {}
-            for k, (w, g, st, lr, wd) in enumerate(
-                    zip(pvals, grads, states, lrs, wds)):
-                if use_mp[k]:
-                    nw32, ns = opt._update_impl(
-                        st[0], g.astype(jnp.float32), st[1:], lr, wd, **kw)
-                    new_params.append(nw32.astype(w.dtype))
-                    new_states.append((nw32,) + tuple(ns))
-                else:
-                    nw, ns = opt._update_impl(w, g, st, lr, wd, **kw)
-                    new_params.append(nw)
-                    new_states.append(tuple(ns))
+            # per-param dispatch shared with Trainer (optimizer.apply_fused
+            # owns the multi-precision contract)
+            new_params, new_states = opt.apply_fused(
+                pvals, grads, states, lrs, wds, use_mp,
+                ts=(t,) * len(names) if needs_t else None)
             return outs, new_aux, tuple(new_params), tuple(new_states)
 
         # Donate the buffers the step replaces — params, aux (BN stats),

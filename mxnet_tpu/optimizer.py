@@ -94,6 +94,43 @@ class Optimizer:
     def _update_impl(self, weight, grad, states, lr, wd):
         raise NotImplementedError
 
+    def apply_fused(self, ws, gs, states, lrs, wds, use_mp, ts=None):
+        """Per-param _update_impl dispatch for a fused (traced) step —
+        the single source of the multi-precision contract shared by
+        Module._build_fused_step and Trainer._fused_update: when a param
+        has an fp32 master copy (use_mp), the update runs on states[0]
+        and the low-precision weight is recast from it.
+
+        ``ts``: per-param update counts for needs_t optimizers (Adam bias
+        correction); None when the optimizer ignores t.  Pure in all
+        traced arguments; hyperparameters (betas, momentum, clip...) are
+        read from self at trace time — callers must key their jit cache
+        on them.
+        """
+        new_ws, new_sts = [], []
+        for i, (w, g, st, lr, wd, mp) in enumerate(
+                zip(ws, gs, states, lrs, wds, use_mp)):
+            kw = {"t": ts[i]} if ts is not None else {}
+            if mp:
+                nw32, ns = self._update_impl(
+                    st[0], g.astype(jnp.float32), st[1:], lr, wd, **kw)
+                new_ws.append(nw32.astype(w.dtype))
+                new_sts.append((nw32,) + tuple(ns))
+            else:
+                nw, ns = self._update_impl(w, g, st, lr, wd, **kw)
+                new_ws.append(nw)
+                new_sts.append(tuple(ns))
+        return tuple(new_ws), tuple(new_sts)
+
+    def hyperparam_signature(self):
+        """Scalar hyperparameters baked into a fused-step trace — jit
+        caches must include this so mutating e.g. momentum or
+        rescale_grad mid-run retraces instead of silently using stale
+        values."""
+        return tuple(sorted(
+            (k, v) for k, v in vars(self).items()
+            if isinstance(v, (int, float, bool, str, type(None)))))
+
     # -- imperative API (reference: Optimizer.update) ------------------------
     def update(self, index, weight, grad, state):
         self._update_count(index)
