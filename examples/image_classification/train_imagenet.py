@@ -54,6 +54,20 @@ def get_iters(args, image_shape):
         return (SyntheticIter(args.batch_size, image_shape,
                               args.num_classes, args.benchmark_iters),
                 None)
+    if args.uint8_rec:
+        # raw pre-decoded records (tools/im2rec.py --pack-raw 256): no JPEG
+        # decode at training time; normalization happens on device (the
+        # net's bn_data input BatchNorm) so batches stay uint8 end-to-end
+        train = mx.io.ImageRecordUInt8Iter(
+            path_imgrec=args.data_train, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+            rand_crop=True, part_index=0, num_parts=1)
+        val = None
+        if args.data_val:
+            val = mx.io.ImageRecordUInt8Iter(
+                path_imgrec=args.data_val, data_shape=image_shape,
+                batch_size=args.batch_size)
+        return train, val
     train = mx.io.ImageRecordIter(
         path_imgrec=args.data_train, data_shape=image_shape,
         batch_size=args.batch_size, shuffle=True, rand_mirror=True,
@@ -79,6 +93,9 @@ if __name__ == '__main__':
     parser.add_argument('--num-layers', type=int, default=50)
     parser.add_argument('--benchmark', type=int, default=0)
     parser.add_argument('--benchmark-iters', type=int, default=50)
+    parser.add_argument('--uint8-rec', action='store_true',
+                        help='data-train/-val are raw pre-decoded records '
+                        '(tools/im2rec.py --pack-raw); skips JPEG decode')
     parser.set_defaults(network='resnet', num_epochs=1, batch_size=256,
                         lr=0.1, lr_step_epochs='30,60,90',
                         num_examples=1281167, dtype='bfloat16')
