@@ -76,6 +76,20 @@ declare_env("MXNET_REMAT_POLICY", str, "full",
             "what remat keeps: 'full' recomputes everything; "
             "'save_matmuls' keeps conv/FC/dot/MoE outputs and recomputes "
             "only the elementwise chains between them")
+
+
+def tag_for_remat(x, name):
+    """checkpoint_name, applied ONLY when the save_matmuls remat policy is
+    active (trace-time env check, same read point as executor.maybe_mirror).
+    The name primitive is semantically an identity, but it measurably
+    hinders XLA/GSPMD optimization when present for no reason — a
+    multi-process dp x tp transformer step ran ~50% slower with
+    unconditional tags."""
+    if not env("MXNET_BACKWARD_DO_MIRROR", False) \
+            or os.environ.get("MXNET_REMAT_POLICY") != "save_matmuls":
+        return x
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(x, name)
 declare_env("MXNET_PROFILER_MODE", str, "symbolic_only", "")
 declare_env("MXNET_PROFILER_AUTOSTART", bool, False, "")
 declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,

@@ -11,7 +11,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+from ..base import tag_for_remat as _ckpt_name
 
 from .registry import register, alias
 from ..base import MXNetError

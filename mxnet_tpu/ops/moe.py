@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+from ..base import tag_for_remat as _ckpt_name
 
 from .registry import register
 

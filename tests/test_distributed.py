@@ -21,14 +21,33 @@ def _launch(n, script, *args, timeout=420):
     # each worker is a fresh process: keep it off the single-client TPU
     # tunnel and give it one CPU device
     env.pop("XLA_FLAGS", None)
-    out = subprocess.run(
+    # own session + group kill on timeout: subprocess.run's kill() SIGKILLs
+    # only launch.py, orphaning workers that then hold the output pipes
+    # open (communicate() blocks forever) and burn CPU for the rest of the
+    # suite — observed as a full-suite hang
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
          "-n", str(n), sys.executable, os.path.join(ROOT, script)]
         + list(args),
-        env=env, capture_output=True, text=True, timeout=timeout,
-        cwd=ROOT)
-    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
-    return out.stdout
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=ROOT, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        import signal as _sig
+        import time as _time
+        os.killpg(proc.pid, _sig.SIGTERM)
+        _time.sleep(2)
+        try:
+            os.killpg(proc.pid, _sig.SIGKILL)
+        except ProcessLookupError:
+            pass
+        stdout, stderr = proc.communicate()
+        raise AssertionError(
+            f"{script} timed out after {timeout}s; killed process group. "
+            f"tail: {stdout[-1000:]} {stderr[-1000:]}")
+    assert proc.returncode == 0, (stdout[-2000:], stderr[-2000:])
+    return stdout
 
 
 def test_dist_sync_kvstore_4_workers():
