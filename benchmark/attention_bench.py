@@ -86,8 +86,13 @@ def main():
                 naive["error"] = str(e)[:120]
 
             for bq, bk in blocks:
-                _bench_flash(rows, dev, S, gqa, bq, bk, B, H, Hk, D,
-                             q, k, v, naive)
+                try:
+                    _bench_flash(rows, dev, S, gqa, bq, bk, B, H, Hk, D,
+                                 q, k, v, naive)
+                except Exception as e:  # noqa: BLE001 — keep sweeping
+                    print(json.dumps({"S": S, "gqa": gqa,
+                                      "blocks": "%dx%d" % (bq, bk),
+                                      "error": str(e)[:200]}), flush=True)
     print("\n| S | GQA | blocks | flash fwd ms | naive fwd ms | "
           "flash f+b ms | naive f+b ms | fwd speedup | f+b speedup |")
     print("|---|-----|-----|-----------|-----------|-----------|"
@@ -128,6 +133,7 @@ def _bench_flash(rows, dev, S, gqa, bq, bk, B, H, Hk, D, q, k, v, naive):
     if row["naive_fwd_ms"]:
         row["fwd_speedup"] = round(
             row["naive_fwd_ms"] / row["flash_fwd_ms"], 2)
+    if row["naive_bwd_ms"]:  # naive bwd can OOM even when fwd fit
         row["bwd_speedup"] = round(
             row["naive_bwd_ms"] / row["flash_bwd_ms"], 2)
     rows.append(row)
