@@ -25,12 +25,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-_T0 = time.perf_counter()
+from benchmark._bench_common import (   # noqa: E402
+    make_mark, peak_flops as _peak_flops, guarded_backend_init,
+    make_hard_sync, shrink_iters)
 
-
-def _mark(msg):
-    print("[bench +%.1fs] %s" % (time.perf_counter() - _T0, msg),
-          file=sys.stderr, flush=True)
+_mark = make_mark("bench")
 
 import numpy as np
 
@@ -50,28 +49,6 @@ if _REMAT != "0":
     os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
     if _REMAT not in ("1", "full"):
         os.environ["MXNET_REMAT_POLICY"] = _REMAT
-
-# peak dense bf16 FLOP/s per chip, keyed by jax device_kind substring
-PEAK_BF16 = [
-    ("v5 lite", 197e12),   # v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v6", 918e12),        # Trillium
-    ("trillium", 918e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
-
-
-def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for sub, peak in PEAK_BF16:
-        if sub in kind:
-            return peak
-    return None
-
 
 def _make_record_iter(batch):
     """Raw-uint8 record dataset for real-data mode (built once, cached).
@@ -142,51 +119,12 @@ def main():
 
 
 def _run(batch):
-    # initialize the backend explicitly, with retries (the single-client
-    # chip tunnel can be transiently held) and a clear diagnostic.  An
-    # unhealthy tunnel makes jax.devices() BLOCK rather than raise, so
-    # each attempt runs in a daemon thread with a deadline — a hang still
-    # produces a parseable error line instead of a silent timeout.
+    # initialize the backend explicitly, with a deadline per attempt and
+    # a clear diagnostic (guarded_backend_init: the single-client tunnel
+    # makes jax.devices() BLOCK when unhealthy)
     import threading
     import jax
-    dev = None
-    err = None
-    retries = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "3")))
-    try:
-        deadline = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "120"))
-    except ValueError:
-        _mark("bad BENCH_INIT_TIMEOUT_S; using 120")
-        deadline = 120.0
-    deadline = max(1.0, deadline)
-    for attempt in range(retries):
-        box = {}
-
-        def _probe(box=box):
-            try:
-                box["dev"] = jax.devices()[0]
-            except Exception as e:  # noqa: BLE001
-                box["err"] = e
-
-        th = threading.Thread(target=_probe, daemon=True)
-        th.start()
-        th.join(deadline)
-        if "dev" in box:
-            dev = box["dev"]
-            break
-        if "err" not in box:
-            # TIMED OUT, not raised: jax serializes backend init behind a
-            # global lock, so the stuck probe thread blocks every later
-            # attempt too — retrying can never succeed and only accumulates
-            # stuck threads.  Fail fast with a parseable error instead.
-            err = "timed out after %.0fs (tunnel hang)" % deadline
-            _mark("backend init attempt %d hung; not retrying "
-                  "(init is serialized behind the stuck probe)"
-                  % (attempt + 1))
-            break
-        err = box["err"]
-        _mark("backend init attempt %d failed: %s" % (attempt + 1, err))
-        if attempt + 1 < retries:
-            time.sleep(90)
+    dev, err = guarded_backend_init(_mark)
     if dev is None:
         print(json.dumps({"metric": "resnet50_train_imgs_per_sec",
                           "value": None, "unit": "imgs/sec",
@@ -275,22 +213,10 @@ def _run(batch):
             mod.forward(b, is_train=True)
             mod.update()
 
-    # Synchronization barrier: a jitted scalar reduction over ALL updated
-    # params, fetched to host.  `block_until_ready` on individual donated
-    # param buffers returns early through the tunnel's aliasing semantics
-    # (measured 9x under-reporting); a host readback of a value that
-    # data-depends on every param cannot complete before the final step's
-    # compute actually ran.
-    upd_names = mod._update_names()
-
-    @jax.jit
-    def _psum_all(vals):
-        import jax.numpy as _jnp
-        return sum(_jnp.sum(_jnp.abs(v.astype(_jnp.float32))) for v in vals)
-
-    def hard_sync():
-        vals = tuple(mod._exec.arg_dict[n]._data for n in upd_names)
-        return float(_psum_all(vals))
+    # Synchronization barrier (make_hard_sync: jitted reduction over ALL
+    # updated params fetched to host — see docs/PERF_NOTES.md on why
+    # block_until_ready on one donated buffer under-reports 9x)
+    hard_sync = make_hard_sync(mod)
 
     _mark("device batches ready")
     for i in range(WARMUP):
@@ -330,11 +256,7 @@ def _run(batch):
     step(0)
     hard_sync()
     probe_s = time.perf_counter() - tp
-    iters = ITERS
-    if probe_s * ITERS > 120.0:
-        iters = max(3, int(120.0 / probe_s))
-        _mark("degraded step time %.1fs: reducing iters %d -> %d"
-              % (probe_s, ITERS, iters))
+    iters = shrink_iters(probe_s, ITERS, _mark)
 
     t0 = time.perf_counter()
     for i in range(iters):

@@ -1,0 +1,123 @@
+"""Shared plumbing for the on-chip benchmark scripts (bench.py and
+benchmark/*.py): per-chip peak FLOP table, guarded backend init (the
+single-client tunnel makes ``jax.devices()`` BLOCK when unhealthy — every
+entry point must probe with a deadline), the hard-sync barrier, and the
+degraded-tunnel measurement-loop shrink.  One copy, so a new device kind
+or a fix to the sync discipline lands everywhere at once."""
+import os
+import sys
+import time
+
+
+def make_mark(tag):
+    t0 = time.perf_counter()
+
+    def _mark(msg):
+        print("[%s +%.1fs] %s" % (tag, time.perf_counter() - t0, msg),
+              file=sys.stderr, flush=True)
+    return _mark
+
+
+# peak dense bf16 FLOP/s per chip, keyed by jax device_kind substring
+PEAK_BF16 = [
+    ("v5 lite", 197e12),   # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),        # Trillium
+    ("trillium", 918e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def peak_flops(device_kind):
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def guarded_backend_init(mark, env_prefix="BENCH"):
+    """Initialize the jax backend with a deadline per attempt.
+
+    Returns (device, None) on success or (None, error_string) on failure.
+    An unhealthy tunnel makes ``jax.devices()`` BLOCK rather than raise,
+    so each attempt runs in a daemon thread.  A TIMED-OUT (vs raising)
+    attempt is not retried: jax serializes backend init behind a global
+    lock, so later attempts just block behind the stuck probe.
+
+    Env knobs: {prefix}_INIT_RETRIES (default 3), {prefix}_INIT_TIMEOUT_S
+    (default 120).
+    """
+    import threading
+    import jax
+    retries = max(1, int(os.environ.get(env_prefix + "_INIT_RETRIES", "3")))
+    try:
+        deadline = float(os.environ.get(env_prefix + "_INIT_TIMEOUT_S",
+                                        "120"))
+    except ValueError:
+        mark("bad %s_INIT_TIMEOUT_S; using 120" % env_prefix)
+        deadline = 120.0
+    deadline = max(1.0, deadline)
+    err = None
+    for attempt in range(retries):
+        box = {}
+
+        def _probe(box=box):
+            try:
+                box["dev"] = jax.devices()[0]
+            except Exception as e:  # noqa: BLE001
+                box["err"] = e
+
+        th = threading.Thread(target=_probe, daemon=True)
+        th.start()
+        th.join(deadline)
+        if "dev" in box:
+            return box["dev"], None
+        if "err" not in box:
+            err = "timed out after %.0fs (tunnel hang)" % deadline
+            mark("backend init attempt %d hung; not retrying "
+                 "(init is serialized behind the stuck probe)"
+                 % (attempt + 1))
+            break
+        err = box["err"]
+        mark("backend init attempt %d failed: %s" % (attempt + 1, err))
+        if attempt + 1 < retries:
+            time.sleep(90)
+    return None, str(err)
+
+
+def make_hard_sync(mod):
+    """Synchronization barrier for a fused-step Module: a jitted scalar
+    reduction over ALL updated params, fetched to host.  `block_until_
+    ready` on one donated buffer returns ~9x early through the tunnel's
+    aliasing semantics (measured, docs/PERF_NOTES.md); a host readback of
+    a value that data-depends on every param cannot complete before the
+    final step's compute ran."""
+    import jax
+    import jax.numpy as jnp
+    upd_names = mod._update_names()
+
+    @jax.jit
+    def _psum_all(vals):
+        return sum(jnp.sum(jnp.abs(v.astype(jnp.float32))) for v in vals)
+
+    def hard_sync():
+        vals = tuple(mod._exec.arg_dict[n]._data for n in upd_names)
+        return float(_psum_all(vals))
+
+    return hard_sync
+
+
+def shrink_iters(probe_s, iters, mark, budget_s=120.0):
+    """Shrink the measurement loop when one synced step takes so long
+    (degraded tunnel) that `iters` steps would blow the time budget."""
+    if probe_s * iters > budget_s:
+        new = max(3, int(budget_s / probe_s))
+        mark("degraded step time %.1fs: reducing iters %d -> %d"
+             % (probe_s, iters, new))
+        return new
+    return iters

@@ -571,18 +571,18 @@ class Module(BaseModule):
         donate = (0, 2, 4) if self._fused_donate else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def fused_step_flops(self):
-        """XLA cost-analysis FLOPs of one fused training step (for MFU
-        reporting).  Requires a bound, optimizer-initialized module with a
-        fresh forward() snapshot (i.e. call right after forward())."""
+    def _lower_fused_step(self):
+        """Trace+lower one fused training step (no backend compile).
+        Requires a bound, optimizer-initialized module with a fresh
+        forward() snapshot (i.e. call right after forward())."""
         if not self.optimizer_initialized:
-            raise MXNetError("fused_step_flops: call init_optimizer() first")
+            raise MXNetError("fused step: call init_optimizer() first")
         names = self._update_names()
         if self._fused_step is None:
             self._fused_step = self._build_fused_step(names)
         snapshot = self._exec._snapshot
         if snapshot is None:
-            raise MXNetError("fused_step_flops: call forward() first")
+            raise MXNetError("fused step: call forward() first")
         arg_vals, aux_vals, key, _ = snapshot
         pvals = tuple(arg_vals[i] for i in self._fused_upd_idx)
         io_vals = tuple(arg_vals[i] for i in self._fused_io_idx)
@@ -590,13 +590,25 @@ class Module(BaseModule):
                        for n in names)
         lrs = tuple(np.float32(1e-3) for _ in names)
         wds = tuple(np.float32(0.0) for _ in names)
-        lowered = self._fused_step.lower(
+        return self._fused_step.lower(
             pvals, io_vals, aux_vals, key, states, lrs, wds,
             jnp.asarray(1, jnp.int32))
-        ca = lowered.cost_analysis()
+
+    def fused_step_flops(self):
+        """XLA cost-analysis FLOPs of one fused training step (for MFU
+        reporting)."""
+        ca = self._lower_fused_step().cost_analysis()
         if not ca:
             return None
         return float(ca.get("flops", 0.0)) or None
+
+    def fused_step_hlo(self):
+        """StableHLO text of the fused training step (pre-backend-opt) —
+        the dtype contract is visible here: in bf16 compute_dtype mode
+        every convolution/dot must consume bf16 operands (the AMP split
+        keeps only statistics/loss in fp32).  Used by tests/test_amp_hlo.py
+        to pin the MFU-critical precision layout without a chip."""
+        return self._lower_fused_step().as_text()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -1,0 +1,83 @@
+"""Precision-layout guard on the COMPILED fused train step.
+
+The round-2/3 MFU work moved BatchNorm onto a bf16 data path with fp32
+statistics (docs/PERF_NOTES.md; reference contract:
+src/operator/cudnn_batch_norm-inl.h — fp32 stats over a low-precision
+data path).  These tests pin that contract at the StableHLO level, on
+CPU, so an AMP regression (an op silently upcasting the activation
+stream to fp32 between conv fusions) is caught without chip time:
+
+* every convolution in the lowered step consumes bf16 operands;
+* every large dot/dot_general does too (the fp32 ops that remain are
+  statistics reductions, the softmax/loss head, and the optimizer update
+  on fp32 master weights — all small or param-shaped, not
+  activation-shaped).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def _lowered_resnet_step_hlo(compute_dtype):
+    import jax.numpy as jnp
+    sym = models.resnet(num_classes=10, num_layers=8,
+                        image_shape=(3, 28, 28))
+    mod = mx.mod.Module(sym, compute_dtype=compute_dtype and
+                        jnp.dtype(compute_dtype))
+    batch = 2
+    it = mx.io.NDArrayIter(
+        data=np.random.RandomState(0).uniform(
+            -1, 1, (batch, 3, 28, 28)).astype(np.float32),
+        label=np.zeros((batch,), np.float32), batch_size=batch)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    mod.forward(next(iter(it)), is_train=True)
+    hlo = mod.fused_step_hlo()
+    mod.update()
+    return hlo
+
+
+# one lowering serves both tests (tracing a ResNet step isn't free)
+@pytest.fixture(scope="module")
+def bf16_hlo():
+    return _lowered_resnet_step_hlo("bfloat16")
+
+
+def _op_operand_dtypes(hlo, op):
+    """dtypes of tensor operands for every `op` application in the text."""
+    out = []
+    for m in re.finditer(r"stablehlo\.%s[^\n]*:\s*\(([^)]*)\)" % op, hlo):
+        dts = re.findall(r"tensor<[^>]*?x?([a-z]+[0-9]+)>", m.group(1))
+        out.append(dts)
+    return out
+
+
+def test_bf16_step_has_no_fp32_convolution(bf16_hlo):
+    convs = _op_operand_dtypes(bf16_hlo, "convolution")
+    assert convs, "no convolutions found in lowered step HLO"
+    bad = [dts for dts in convs if "f32" in dts]
+    assert not bad, (
+        "fp32 convolutions in bf16 fused step (AMP regression): %r"
+        % bad[:5])
+
+
+def test_bf16_step_dots_are_bf16(bf16_hlo):
+    dots = _op_operand_dtypes(bf16_hlo, "dot_general")
+    assert dots, "no dot_general found in lowered step HLO"
+    bad = [dts for dts in dots if "f32" in dts]
+    assert not bad, (
+        "fp32 dot_general in bf16 fused step (AMP regression): %r"
+        % bad[:5])
+
+
+def test_fp32_mode_keeps_fp32_convolution():
+    hlo = _lowered_resnet_step_hlo(None)
+    convs = _op_operand_dtypes(hlo, "convolution")
+    assert convs and all("f32" in dts for dts in convs)

@@ -50,6 +50,10 @@ run_bench record_b512    BENCH_DATA=record BENCH_BATCH=512
 echo "== [$(TS)] attention microbench" >&2
 python benchmark/attention_bench.py | tee attention_bench_out.txt || true
 
+# 4b. transformer-LM end-to-end train throughput (tokens/sec + MFU)
+echo "== [$(TS)] transformer LM bench" >&2
+python benchmark/transformer_bench.py || true
+
 # 5. real-data convergence artifact (VERDICT item 4)
 echo "== [$(TS)] digits convergence" >&2
 python tools/chip_convergence_run.py || true

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Cheap TPU-tunnel liveness probe: exit 0 iff jax.devices() answers
+within PROBE_TIMEOUT_S (default 60).  Keeps the connection hold-time
+short — a hung client occupies the single-client relay slot, so probing
+with the full bench's 600 s deadline can itself delay recovery."""
+import os
+import sys
+import threading
+
+
+def main():
+    deadline = float(os.environ.get("PROBE_TIMEOUT_S", "60"))
+    box = {}
+
+    def _probe():
+        try:
+            import jax
+            box["dev"] = jax.devices()[0].device_kind
+        except Exception as e:  # noqa: BLE001
+            box["err"] = str(e)
+
+    th = threading.Thread(target=_probe, daemon=True)
+    th.start()
+    th.join(deadline)
+    if "dev" in box:
+        print("tunnel up: %s" % box["dev"])
+        return 0
+    print("tunnel down: %s" % box.get("err", "init hang (%.0fs)" % deadline),
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
