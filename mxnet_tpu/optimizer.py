@@ -137,6 +137,10 @@ class Optimizer:
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         states = self._state_tuple(state)
+        # per-param update count for needs_t optimizers (Adam/LAMB bias
+        # correction) — a state created at step N must start at t=1
+        tkw = ({"t": self._index_update_count[index]}
+               if getattr(self, "needs_t", False) else {})
         from .ndarray.sparse import RowSparseNDArray
         use_mp = self.mp_states_active(weight, states)
         if isinstance(grad, RowSparseNDArray):
@@ -172,14 +176,15 @@ class Optimizer:
             w32 = states[0]._data
             new_w32, new_sub = self._update_impl(
                 w32, grad._data.astype(jnp.float32),
-                tuple(s._data for s in states[1:]), lr, wd)
+                tuple(s._data for s in states[1:]), lr, wd, **tkw)
             states[0]._set_data(new_w32)
             weight._set_data(new_w32.astype(weight._data.dtype))
             for s, v in zip(states[1:], new_sub):
                 s._set_data(v)
         else:
             new_w, new_states = self._update_impl(
-                weight._data, grad._data, tuple(s._data for s in states), lr, wd)
+                weight._data, grad._data, tuple(s._data for s in states),
+                lr, wd, **tkw)
             weight._set_data(new_w)
             for s, v in zip(states, new_states):
                 s._set_data(v)
@@ -255,6 +260,12 @@ class Optimizer:
 
 register = Optimizer.register
 create = Optimizer.create_optimizer
+
+
+def _l2norm(x):
+    """fp32 L2 norm of a (possibly low-precision) tensor — the layer-wise
+    trust-ratio norms in LARS/LAMB must not accumulate in bf16."""
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
 
 
 def _clip(g, clip_gradient):
@@ -437,22 +448,6 @@ class Adam(Optimizer):
         return (weight.at[idx].add(upd, mode="drop"),
                 (mean.at[idx].set(new_m, mode="drop"),
                  var.at[idx].set(new_v, mode="drop")))
-
-    def update(self, index, weight, grad, state):
-        from .ndarray.sparse import RowSparseNDArray
-        if isinstance(grad, RowSparseNDArray):
-            return super().update(index, weight, grad, state)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
-        states = self._state_tuple(state)
-        new_w, new_states = self._update_impl(
-            weight._data, grad._data, tuple(s._data for s in states), lr, wd,
-            t=t)
-        weight._set_data(new_w)
-        for s, v in zip(states, new_states):
-            s._set_data(v)
 
 
 @register
@@ -670,6 +665,108 @@ class Signum(Optimizer):
         w = (1 - lr * self.wd_lh) * weight + lr * jnp.sign(new_mom) \
             if self.wd_lh else weight + lr * jnp.sign(new_mom)
         return w, (new_mom,)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (You et al. 2017) — the standard
+    large-batch SGD for TPU vision training (MLPerf ResNet-50/TPU trains
+    batch 4k-32k with it).
+
+    NEW capability relative to the reference (the large-batch era
+    postdates MXNet 0.12); pairs with the fused Module step and the
+    batch-512+ ResNet config the MFU work targets.  Per layer:
+
+        local_lr = eta * ||w|| / (||g|| + wd * ||w|| + eps)
+        mom      = momentum * mom + local_lr * (g + wd * w)
+        w       -= lr * mom
+
+    Bias/BatchNorm params (ndim == 1) skip the trust-ratio adaptation
+    and weight decay, per the paper's recipe.
+    """
+
+    pure_update = True
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype=weight.dtype),)
+
+    def _update_impl(self, weight, grad, states, lr, wd):
+        # lr folds INTO the momentum buffer (You et al. Algorithm 1 and
+        # this file's SGD convention): an lr schedule scales only new
+        # contributions, not the accumulated momentum
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        mom = states[0]
+        if weight.ndim <= 1:    # bias / BN gamma-beta: plain momentum SGD
+            new_mom = self.momentum * mom - lr * g
+            return weight + new_mom, (new_mom,)
+        w_norm = _l2norm(weight)
+        g_norm = _l2norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            jnp.float32(1.0)).astype(weight.dtype)
+        new_mom = self.momentum * mom - lr * trust * (g + wd * weight)
+        return weight + new_mom, (new_mom,)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive Adam for large-batch training (You et al.
+    2019 — BERT in 76 minutes).  NEW capability relative to the
+    reference; the large-batch companion of LARS for the transformer
+    track (benchmark/transformer_bench.py).
+
+        m, v   = adam moments (bias-corrected)
+        r      = m_hat / (sqrt(v_hat) + eps) + wd * w
+        ratio  = ||w|| / ||r||   (1 where either norm is 0)
+        w     -= lr * ratio * r
+    """
+
+    pure_update = True
+    needs_t = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype=weight.dtype),
+                nd_zeros(weight.shape, dtype=weight.dtype))
+
+    def _update_impl(self, weight, grad, states, lr, wd, t=None):
+        mean, var = states
+        if t is None:
+            t = self._index_update_count.get(0, self.num_update) or 1
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        m = self.beta1 * mean + (1. - self.beta1) * g
+        v = self.beta2 * var + (1. - self.beta2) * jnp.square(g)
+        # fp32 scalars (not python floats) so ``t`` may be traced
+        m_hat = m / (1. - jnp.float32(self.beta1) ** t)
+        v_hat = v / (1. - jnp.float32(self.beta2) ** t)
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * weight
+        w_norm = _l2norm(weight)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        r_norm = _l2norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0),
+                          w_norm / r_norm,
+                          jnp.float32(1.0)).astype(weight.dtype)
+        return weight - lr * ratio * r, (m, v)
 
 
 @register

@@ -79,7 +79,7 @@ def test_adagrad_matches_numpy():
 
 @pytest.mark.parametrize("name", ['sgd', 'nag', 'adam', 'adagrad', 'rmsprop',
                                   'adadelta', 'ftrl', 'adamax', 'nadam',
-                                  'signum', 'sgld', 'dcasgd'])
+                                  'signum', 'sgld', 'dcasgd', 'lars', 'lamb'])
 def test_all_optimizers_step(name):
     """Every registered optimizer must take a step without error and move
     the weights."""
@@ -147,3 +147,97 @@ def test_adam_preserves_dtype():
                                t=jnp.asarray(1, jnp.int32))
     assert nw.dtype == jnp.float32
     assert all(s.dtype == jnp.float32 for s in nst)
+
+
+def test_lars_matches_numpy():
+    o = opt.create('lars', learning_rate=0.1, momentum=0.9, wd=0.01,
+                   eta=0.001)
+    got = _run_steps(o, W0, GRADS)
+    w = W0.copy()
+    mom = np.zeros_like(w)
+    for g in GRADS:
+        w_norm = np.sqrt((w.astype('float64') ** 2).sum())
+        g_norm = np.sqrt((g.astype('float64') ** 2).sum())
+        trust = 0.001 * w_norm / (g_norm + 0.01 * w_norm + 1e-9)
+        mom = 0.9 * mom - 0.1 * trust * (g + 0.01 * w)
+        w = w + mom
+    np.testing.assert_allclose(got, w, rtol=2e-5)
+
+
+def test_lars_bias_skips_trust_ratio():
+    # 1-D params (bias/BN) take the plain momentum-SGD path, no wd
+    o = opt.create('lars', learning_rate=0.1, momentum=0.9, wd=0.01)
+    b0 = RNG.randn(5).astype('float32')
+    gb = [RNG.randn(5).astype('float32') for _ in range(2)]
+    got = _run_steps(o, b0, gb, nsteps=2)
+    b = b0.copy()
+    mom = np.zeros_like(b)
+    for g in gb:
+        mom = 0.9 * mom - 0.1 * g
+        b = b + mom
+    np.testing.assert_allclose(got, b, rtol=1e-5)
+
+
+def test_lamb_matches_numpy():
+    o = opt.create('lamb', learning_rate=0.01, wd=0.01)
+    got = _run_steps(o, W0, GRADS)
+    w = W0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-6
+    for t, g in enumerate(GRADS, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** t)
+        v_hat = v / (1 - b2 ** t)
+        r = m_hat / (np.sqrt(v_hat) + eps) + 0.01 * w
+        w_norm = np.sqrt((w.astype('float64') ** 2).sum())
+        r_norm = np.sqrt((r.astype('float64') ** 2).sum())
+        ratio = w_norm / r_norm if w_norm > 0 and r_norm > 0 else 1.0
+        w = w - 0.01 * ratio * r
+    np.testing.assert_allclose(got, w, rtol=2e-5)
+
+
+def test_lars_lamb_in_fused_module_step():
+    """Large-batch optimizers must trace inside the fused Module step
+    (pure_update) and train a tiny net without NaNs."""
+    from mxnet_tpu import models
+    for name in ('lars', 'lamb'):
+        sym = models.mlp(num_classes=4, hidden=[8])
+        mod = mx.mod.Module(sym)
+        x = np.random.RandomState(1).uniform(size=(8, 6)).astype('float32')
+        y = (np.arange(8) % 4).astype('float32')
+        it = mx.io.NDArrayIter(x, y, batch_size=8)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer=name,
+                           optimizer_params={'learning_rate': 0.05})
+        for _ in range(3):
+            it.reset()
+            for b in it:
+                mod.forward(b, is_train=True)
+                mod.update()
+        w = next(iter(mod.get_params()[0].values())).asnumpy()
+        assert np.isfinite(w).all(), name
+
+
+def test_lamb_late_state_starts_at_t1():
+    """A param whose LAMB state is created after other params have taken
+    N steps must bias-correct from t=1, not t=N (per-index update count
+    through the base update path)."""
+    o = opt.create('lamb', learning_rate=0.01)
+    w0 = mx.nd.array(W0.copy())
+    s0 = o.create_state(0, w0)
+    for g in GRADS:
+        o.update(0, w0, mx.nd.array(g), s0)
+    # param 7 starts fresh after param 0 took 3 steps
+    w7 = mx.nd.array(W0.copy())
+    s7 = o.create_state(7, w7)
+    o.update(7, w7, mx.nd.array(GRADS[0]), s7)
+    # reference: single LAMB step from zeroed moments at t=1
+    o2 = opt.create('lamb', learning_rate=0.01)
+    wref = mx.nd.array(W0.copy())
+    sref = o2.create_state(0, wref)
+    o2.update(0, wref, mx.nd.array(GRADS[0]), sref)
+    np.testing.assert_allclose(w7.asnumpy(), wref.asnumpy(), rtol=1e-6)
