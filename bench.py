@@ -36,6 +36,7 @@ import numpy as np
 BASELINE_IMGS_PER_SEC = 109.0   # ResNet-50, 1x K80, batch 32
 BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+OPT = os.environ.get("BENCH_OPT", "sgd")
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 # TPU-native stem variant (space-to-depth, mathematically equivalent —
@@ -149,7 +150,9 @@ def _run(batch):
     it = mx.io.NDArrayIter(data=x, label=y, batch_size=batch)
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params(mx.initializer.Xavier(rnd_type="gaussian", magnitude=2.0))
-    mod.init_optimizer(optimizer="sgd",
+    # BENCH_OPT=lars exercises the large-batch trust-ratio recipe (same
+    # lr/momentum/wd knobs; LARS adds per-layer rate adaptation)
+    mod.init_optimizer(optimizer=OPT,
                        optimizer_params={"learning_rate": 0.1,
                                          "momentum": 0.9, "wd": 1e-4})
     _mark("module bound + params initialized")
@@ -282,6 +285,7 @@ def _run(batch):
         "flops_source": flops_source,
         "peak_flops": peak,
         "stem": STEM,
+        "opt": OPT,
         "iters": iters,
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly

@@ -47,11 +47,17 @@ def _time(fn, *args, iters=None, warmup=2):
 
 
 def main():
+    from benchmark._bench_common import make_mark, guarded_backend_init
+    dev, err = guarded_backend_init(make_mark("attn"), env_prefix="ATTN")
+    if dev is None:
+        print(json.dumps({"metric": "flash_attention_microbench",
+                          "error": "backend init failed: %s" % err}),
+              flush=True)
+        return 1
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.ops.attention import flash_attention, _attn_reference
 
-    dev = jax.devices()[0]
     seqs = [int(s) for s in
             os.environ.get("ATTN_SEQS", "1024,4096,16384").split(",")]
     # kernel tile sweep, e.g. ATTN_BLOCKS=128x128,128x256

@@ -122,14 +122,23 @@ class Optimizer:
                 new_sts.append(tuple(ns))
         return tuple(new_ws), tuple(new_sts)
 
+    # attrs that advance every step and are NOT baked into traces (step
+    # counts travel as traced args; lr/wd as runtime args).  Including
+    # them in the signature would invalidate the fused-step jit cache on
+    # EVERY update — a silent full-recompile-per-step regression (seen as
+    # ~0.3 s/step for a toy MLP, ~50 s/step for ResNet-50).
+    _SIG_EXCLUDE = frozenset(("num_update", "begin_num_update", "lr", "wd"))
+
     def hyperparam_signature(self):
         """Scalar hyperparameters baked into a fused-step trace — jit
         caches must include this so mutating e.g. momentum or
         rescale_grad mid-run retraces instead of silently using stale
-        values."""
+        values.  Step counters and lr are excluded: they are passed as
+        runtime arguments, never baked."""
         return tuple(sorted(
             (k, v) for k, v in vars(self).items()
-            if isinstance(v, (int, float, bool, str, type(None)))))
+            if k not in self._SIG_EXCLUDE
+            and isinstance(v, (int, float, bool, str, type(None)))))
 
     # -- imperative API (reference: Optimizer.update) ------------------------
     def update(self, index, weight, grad, state):

@@ -120,7 +120,7 @@ def test_bucketing_module_trains():
     V, E, H = 20, 8, 16
     # predictable sequences: next token = (tok + 1) % V
     sents = []
-    for _ in range(120):
+    for _ in range(64):
         start = rng.randint(1, V)
         ln = rng.randint(3, 10)
         sents.append([(start + k) % (V - 1) + 1 for k in range(ln)])
@@ -149,7 +149,7 @@ def test_bucketing_module_trains():
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params(mx.initializer.Xavier())
     mod.init_optimizer(optimizer='adam',
-                       optimizer_params={'learning_rate': 0.01})
+                       optimizer_params={'learning_rate': 0.02})
     metric = mx.metric.Perplexity(0)
 
     def run_epoch():

@@ -6,7 +6,7 @@ import mxnet_tpu as mx
 from mxnet_tpu import models
 
 
-def _fit_lm(net, steps=30, lr=3e-3, seq=16, vocab=50, seed=0):
+def _fit_lm(net, steps=16, lr=3e-3, seq=16, vocab=50, seed=0):
     rng = np.random.RandomState(seed)
     # learnable sequence: next = (3*tok + 1) % vocab
     toks = np.zeros((32, seq + 1), np.float32)
@@ -46,7 +46,7 @@ def test_transformer_lm_moe_trains():
                                 d_model=32, num_heads=2, moe_experts=4,
                                 moe_k=2)
     assert any('expert_w1_weight' in a for a in net.list_arguments())
-    nlls = _fit_lm(net, steps=40)
+    nlls = _fit_lm(net, steps=20)
     assert nlls[-1] < 0.5 * nlls[0], (nlls[0], nlls[-1])
 
 
@@ -159,7 +159,7 @@ def test_transformer_gqa_trains():
                        optimizer_params={'learning_rate': 3e-3})
     metric = mx.metric.Perplexity(ignore_label=None)
     ppls = []
-    for epoch in range(8):
+    for epoch in range(5):
         it.reset()
         metric.reset()
         for b in it:
@@ -173,25 +173,29 @@ def test_transformer_gqa_trains():
 
 def test_kv_cache_decode_matches_training():
     """transformer_decode_step shares parameter names with transformer_lm:
-    train the LM, load its weights into the decode graph, and greedy
-    generation with the rolled KV cache reproduces the learned sequence
-    pattern (reference analog: predict-path parity, test_forward.py)."""
-    V, S, L = 30, 12, 12
+    the SAME (randomly initialized) weights driven teacher-forced through
+    the train graph and token-by-token through the rolled KV cache must
+    produce identical per-position next-token distributions (reference
+    analog: predict-path parity, test_forward.py).  Exact parity on
+    random weights subsumes the old trained-generation check (training
+    itself is covered by test_transformer_lm_trains) at ~20x less cost."""
+    V, S, L = 30, 8, 8
     kw = dict(num_layers=1, d_model=32, num_heads=4, num_kv_heads=2)
     net = models.transformer_lm(V, S, **kw)
+    B = 4
     rs = np.random.RandomState(0)
-    first = rs.randint(0, V, (128, 1))
-    seq = (first + np.arange(S + 1)) % V
-    it = mx.io.NDArrayIter(seq[:, :S].astype('float32'),
-                           seq[:, 1:].astype('float32'), 32)
+    toks = rs.randint(0, V, (B, S)).astype('float32')
     mod = mx.mod.Module(net, context=mx.cpu(0), data_names=('data',),
                         label_names=('softmax_label',))
-    mod.fit(it, num_epoch=25, optimizer='adam',
-            optimizer_params={'learning_rate': 5e-3},
-            initializer=mx.initializer.Xavier())
+    mod.bind(data_shapes=[('data', (B, S))],
+             label_shapes=[('softmax_label', (B, S))], for_training=False)
+    mx.random.seed(7)
+    mod.init_params(mx.initializer.Xavier())
     arg_params, aux_params = mod.get_params()
+    mod.forward(mx.io.DataBatch([mx.nd.array(toks)], []), is_train=False)
+    # (B, S, V) teacher-forced next-token distributions
+    probs_tf = mod.get_outputs()[0].asnumpy().reshape(B, S, V)
 
-    B = 4
     dec = models.transformer_decode_step(V, L, B, **kw)
     dmod = mx.mod.Module(dec, context=mx.cpu(0), data_names=('data',),
                          label_names=None,
@@ -202,18 +206,15 @@ def test_kv_cache_decode_matches_training():
                      allow_missing=False)
     dmod.set_states(value=0)
 
-    start = np.array([3., 7., 11., 20.], 'float32')
-    tok = start
-    outs = []
-    for _ in range(8):
-        dmod.forward(mx.io.DataBatch([mx.nd.array(tok)], []))
+    for t in range(S):
+        dmod.forward(mx.io.DataBatch([mx.nd.array(toks[:, t])], []))
         res = dmod.get_outputs()
         dmod.set_states(states=res[1:])
-        tok = res[0].asnumpy().argmax(1).astype('float32')
-        outs.append(tok.copy())
-    gen = np.stack(outs, 1)
-    expect = (start[:, None] + np.arange(1, 9)) % V
-    assert (gen == expect).mean() > 0.9
+        logits = res[0].asnumpy()   # decode emits logits; train emits
+        e = np.exp(logits - logits.max(1, keepdims=True))   # softmax here
+        probs_dec = e / e.sum(1, keepdims=True)
+        np.testing.assert_allclose(probs_dec, probs_tf[:, t], atol=2e-5,
+                                   err_msg="decode step %d" % t)
 
 
 def test_decode_past_max_len_clamps_not_errors():

@@ -26,8 +26,12 @@ import numpy as np
 
 
 def main():
+    from benchmark._bench_common import make_mark, guarded_backend_init
+    dev, err = guarded_backend_init(make_mark("digits"), env_prefix="BENCH")
+    if dev is None:
+        print("backend init failed: %s" % err, flush=True)
+        return 1
     import jax
-    dev = jax.devices()[0]
     print("device:", dev.device_kind, flush=True)
 
     import mxnet_tpu as mx

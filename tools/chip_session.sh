@@ -41,6 +41,7 @@ run_bench b512_s2d       BENCH_BATCH=512 BENCH_STEM=s2d
 run_bench b512_s2d_rematm BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=save_matmuls
 run_bench b512_s2d_remat BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=1
 run_bench b768_s2d_rematm BENCH_BATCH=768 BENCH_STEM=s2d BENCH_REMAT=save_matmuls
+run_bench b1024_lars_s2d  BENCH_BATCH=1024 BENCH_STEM=s2d BENCH_REMAT=save_matmuls BENCH_OPT=lars
 
 # 3. real-data end-to-end (VERDICT item 3)
 run_bench record         BENCH_DATA=record
