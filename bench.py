@@ -27,7 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from benchmark._bench_common import (   # noqa: E402
     make_mark, peak_flops as _peak_flops, guarded_backend_init,
-    make_hard_sync, shrink_iters)
+    make_hard_sync, shrink_iters, start_stall_watchdog)
 
 _mark = make_mark("bench")
 
@@ -97,6 +97,10 @@ def _iter_rate(it, max_batches=20):
     return n / dt
 
 
+_ERR_BASE = {"metric": "resnet50_train_imgs_per_sec", "value": None,
+             "unit": "imgs/sec", "vs_baseline": None}
+
+
 def main():
     batch = BATCH
     while True:
@@ -109,12 +113,10 @@ def main():
                           % (batch, batch // 2))
                     batch //= 2
                     continue
-                print(json.dumps({
-                    "metric": "resnet50_train_imgs_per_sec",
-                    "value": None, "unit": "imgs/sec",
-                    "vs_baseline": None,
-                    "error": "OOM even at batch %d: %s" % (batch,
-                                                           str(e)[:300])}))
+                print(json.dumps(dict(
+                    _ERR_BASE,
+                    error="OOM even at batch %d: %s" % (batch,
+                                                        str(e)[:300]))))
                 return 1
             raise
 
@@ -127,13 +129,14 @@ def _run(batch):
     import jax
     dev, err = guarded_backend_init(_mark)
     if dev is None:
-        print(json.dumps({"metric": "resnet50_train_imgs_per_sec",
-                          "value": None, "unit": "imgs/sec",
-                          "vs_baseline": None,
-                          "error": "backend init failed: %s" % err}),
+        print(json.dumps(dict(_ERR_BASE,
+                              error="backend init failed: %s" % err)),
               flush=True)
         return 1
     _mark("backend up: %s" % dev.device_kind)
+    # a lost tunnel RPC blocks forever with zero CPU — self-bound the run
+    # so a parseable error line still lands (BENCH_STALL_DEADLINE_S)
+    start_stall_watchdog(_mark, _ERR_BASE)
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu import models

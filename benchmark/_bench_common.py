@@ -13,9 +13,55 @@ def make_mark(tag):
     t0 = time.perf_counter()
 
     def _mark(msg):
+        _mark.last_progress = time.perf_counter()
         print("[%s +%.1fs] %s" % (tag, time.perf_counter() - t0, msg),
               file=sys.stderr, flush=True)
+    _mark.last_progress = t0
     return _mark
+
+
+def start_stall_watchdog(mark, error_json, env_prefix="BENCH"):
+    """Self-bound the bench: if no progress mark lands for
+    {prefix}_STALL_DEADLINE_S (default 1200 s), print ``error_json`` (a
+    dict; a ``stalled after Ns`` error field is added) on stdout and
+    hard-exit.
+
+    Why self-exit instead of an external ``timeout``: the single-client
+    tunnel wedges when a client is killed mid-RPC (both recorded
+    incidents), but a compile/step RPC that the relay LOST blocks forever
+    with zero local CPU — without a bound, one lost RPC holds the client
+    slot for the rest of the round and starves every later deliverable,
+    including the driver's own bench run.  A controlled exit that first
+    emits the parseable error line is the least-bad disconnect.
+    """
+    import json
+    import threading
+    if getattr(mark, "_watchdog_started", False):
+        return  # idempotent: OOM-retry loops re-enter the run function
+    try:
+        deadline = float(os.environ.get(env_prefix + "_STALL_DEADLINE_S",
+                                        "1200"))
+    except ValueError:
+        mark("bad %s_STALL_DEADLINE_S; using 1200" % env_prefix)
+        deadline = 1200.0
+    if deadline <= 0:  # 0 disables the watchdog
+        return
+    mark._watchdog_started = True
+
+    def _watch():
+        while True:
+            idle = time.perf_counter() - mark.last_progress
+            if idle > deadline:
+                out = dict(error_json)
+                out["error"] = ("stalled: no progress for %.0fs "
+                                "(tunnel RPC lost?)" % idle)
+                print(json.dumps(out), flush=True)
+                mark("STALL watchdog fired after %.0fs idle — exiting"
+                     % idle)
+                os._exit(3)
+            time.sleep(min(30.0, deadline / 4))
+
+    threading.Thread(target=_watch, daemon=True).start()
 
 
 # peak dense bf16 FLOP/s per chip, keyed by jax device_kind substring

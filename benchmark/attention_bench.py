@@ -47,13 +47,17 @@ def _time(fn, *args, iters=None, warmup=2):
 
 
 def main():
-    from benchmark._bench_common import make_mark, guarded_backend_init
-    dev, err = guarded_backend_init(make_mark("attn"), env_prefix="ATTN")
+    from benchmark._bench_common import (make_mark, guarded_backend_init,
+                                         start_stall_watchdog)
+    mark = make_mark("attn")
+    dev, err = guarded_backend_init(mark, env_prefix="ATTN")
     if dev is None:
         print(json.dumps({"metric": "flash_attention_microbench",
                           "error": "backend init failed: %s" % err}),
               flush=True)
         return 1
+    start_stall_watchdog(mark, {"metric": "flash_attention_microbench"},
+                         env_prefix="ATTN")
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.ops.attention import flash_attention, _attn_reference
@@ -85,6 +89,7 @@ def main():
 
             naive_b = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
             naive = {}
+            mark("naive S=%d gqa=%s" % (S, gqa))
             try:
                 naive["fwd"] = round(_time(naive_f, q, k, v), 3)
                 naive["bwd"] = round(_time(naive_b, q, k, v), 3)
@@ -93,6 +98,7 @@ def main():
 
             for bq, bk in blocks:
                 try:
+                    mark("flash S=%d gqa=%s %dx%d" % (S, gqa, bq, bk))
                     _bench_flash(rows, dev, S, gqa, bq, bk, B, H, Hk, D,
                                  q, k, v, naive)
                 except Exception as e:  # noqa: BLE001 — keep sweeping

@@ -29,7 +29,7 @@ import numpy as np
 
 from benchmark._bench_common import (  # noqa: E402
     make_mark, peak_flops, guarded_backend_init, make_hard_sync,
-    shrink_iters)
+    shrink_iters, start_stall_watchdog)
 
 _mark = make_mark("tfb")
 
@@ -48,19 +48,24 @@ VOCAB = _env_int("TFB_VOCAB", 50304)   # 50257 rounded up to a lane multiple
 ITERS = _env_int("TFB_ITERS", 20)
 WARMUP = _env_int("TFB_WARMUP", 3)
 
+_ERR_BASE = {"metric": "transformer_lm_tokens_per_sec", "value": None,
+             "unit": "tokens/sec", "vs_baseline": None}
+
 def main():
     if os.environ.get("TFB_CPU"):     # CPU smoke mode (tests/dev boxes):
         from cpu_pin import pin_cpu   # strip the axon tunnel plugin
         pin_cpu(1)
     dev, err = guarded_backend_init(_mark, env_prefix="TFB")
     if dev is None:
-        print(json.dumps({"metric": "transformer_lm_tokens_per_sec",
-                          "value": None, "unit": "tokens/sec",
-                          "vs_baseline": None,
-                          "error": "backend init failed: %s" % err}),
+        print(json.dumps(dict(_ERR_BASE,
+                              error="backend init failed: %s" % err)),
               flush=True)
         return 1
     _mark("backend up: %s" % dev.device_kind)
+    # no tunnel in CPU smoke mode — a long local compile is not a stall
+    # (arm anyway when the knob is set explicitly, e.g. for testing)
+    if not os.environ.get("TFB_CPU") or os.environ.get("TFB_STALL_DEADLINE_S"):
+        start_stall_watchdog(_mark, _ERR_BASE, env_prefix="TFB")
     import jax
     import jax.numpy as jnp
 
