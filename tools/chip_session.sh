@@ -3,7 +3,7 @@
 # Run when the tunnel is alive (tools/bench_watch.sh logs a SUCCESS line).
 # Every bench result is appended to BENCH_LOG.jsonl by bench.py runs here;
 # partial progress survives a mid-session tunnel death.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 TS() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 LOG=BENCH_LOG.jsonl
@@ -31,32 +31,42 @@ except Exception: print("None")')
   fi
 }
 
+# After a failed run, distinguish "this config failed" (keep going) from
+# "the tunnel is dead" (every further attempt burns its init deadline and
+# each connect attempt is itself a wedge risk): cheap 60s probe, abort the
+# session if it doesn't answer.
+probe_or_die() {
+  echo "== [$(TS)] probing tunnel after failure" >&2
+  PROBE_TIMEOUT_S=60 python tools/tunnel_probe.py >&2 || {
+    echo "== [$(TS)] tunnel dead — aborting session" >&2; exit 1; }
+}
+
 # 1. baseline config first — the driver-verifiable number (VERDICT item 1)
-run_bench baseline || exit 1
+run_bench baseline || probe_or_die
 
 # 2. MFU sweep (VERDICT item 2): batch x stem x remat
-run_bench b512           BENCH_BATCH=512
-run_bench s2d            BENCH_STEM=s2d
-run_bench b512_s2d       BENCH_BATCH=512 BENCH_STEM=s2d
-run_bench b512_s2d_rematm BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=save_matmuls
-run_bench b512_s2d_remat BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=1
-run_bench b768_s2d_rematm BENCH_BATCH=768 BENCH_STEM=s2d BENCH_REMAT=save_matmuls
-run_bench b1024_lars_s2d  BENCH_BATCH=1024 BENCH_STEM=s2d BENCH_REMAT=save_matmuls BENCH_OPT=lars
+run_bench b512           BENCH_BATCH=512 || probe_or_die
+run_bench s2d            BENCH_STEM=s2d || probe_or_die
+run_bench b512_s2d       BENCH_BATCH=512 BENCH_STEM=s2d || probe_or_die
+run_bench b512_s2d_rematm BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=save_matmuls || probe_or_die
+run_bench b512_s2d_remat BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=1 || probe_or_die
+run_bench b768_s2d_rematm BENCH_BATCH=768 BENCH_STEM=s2d BENCH_REMAT=save_matmuls || probe_or_die
+run_bench b1024_lars_s2d  BENCH_BATCH=1024 BENCH_STEM=s2d BENCH_REMAT=save_matmuls BENCH_OPT=lars || probe_or_die
 
 # 3. real-data end-to-end (VERDICT item 3)
-run_bench record         BENCH_DATA=record
-run_bench record_b512    BENCH_DATA=record BENCH_BATCH=512
+run_bench record         BENCH_DATA=record || probe_or_die
+run_bench record_b512    BENCH_DATA=record BENCH_BATCH=512 || probe_or_die
 
 # 4. flash-attention microbench (VERDICT item 5)
 echo "== [$(TS)] attention microbench" >&2
-python benchmark/attention_bench.py | tee attention_bench_out.txt || true
+{ python benchmark/attention_bench.py | tee attention_bench_out.txt; } || probe_or_die
 
 # 4b. transformer-LM end-to-end train throughput (tokens/sec + MFU)
 echo "== [$(TS)] transformer LM bench" >&2
-python benchmark/transformer_bench.py || true
+python benchmark/transformer_bench.py || probe_or_die
 
 # 5. real-data convergence artifact (VERDICT item 4)
 echo "== [$(TS)] digits convergence" >&2
-python tools/chip_convergence_run.py || true
+python tools/chip_convergence_run.py || probe_or_die
 
 echo "== [$(TS)] chip session complete; results in $LOG" >&2

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Wait for the TPU tunnel to answer the cheap 60s probe, then run the full
+# chip session (tools/chip_session.sh).  Used after a relay wedge: probes
+# every WATCH_PROBE_SLEEP seconds (default 300) and launches the session
+# the moment the tunnel is back.  WATCH_ONESHOT=1 skips the loop.
+set -u
+cd "$(dirname "$0")/.."
+SLEEP="${WATCH_PROBE_SLEEP:-300}"
+while true; do
+  if PROBE_TIMEOUT_S=60 python tools/tunnel_probe.py >&2; then
+    echo "[session_watch $(date -u +%H:%M:%SZ)] tunnel up — starting chip session" >&2
+    if bash tools/chip_session.sh; then
+      echo "[session_watch $(date -u +%H:%M:%SZ)] chip session completed" >&2
+      exit 0
+    fi
+    # session aborted (tunnel died mid-run): keep watching so a later
+    # recovery relaunches it — surviving repeated deaths is the point
+    echo "[session_watch $(date -u +%H:%M:%SZ)] chip session aborted; resuming watch" >&2
+  fi
+  if [ "${WATCH_ONESHOT:-0}" = "1" ]; then exit 1; fi
+  echo "[session_watch $(date -u +%H:%M:%SZ)] tunnel down; retry in ${SLEEP}s" >&2
+  sleep "$SLEEP"
+done
