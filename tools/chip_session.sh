@@ -10,10 +10,17 @@ LOG=BENCH_LOG.jsonl
 
 run_bench() {  # run_bench <tag> [env overrides...]
   local tag="$1"; shift
+  # resume, don't repeat: a relaunch after a mid-session tunnel death
+  # skips configs already measured (FORCE_RERUN=1 overrides)
+  if [ "${FORCE_RERUN:-0}" != "1" ] \
+     && grep -q "\"tag\": \"$tag\"" "$LOG" 2>/dev/null; then
+    echo "== [$(TS)] bench $tag already in $LOG — skipping" >&2
+    return 0
+  fi
   echo "== [$(TS)] bench $tag" >&2
   local out
   out=$(env "$@" BENCH_INIT_TIMEOUT_S=600 BENCH_INIT_RETRIES=1 \
-        python bench.py 2>chip_session_stderr.log | tail -1)
+        python bench.py 2>>chip_session_stderr.log | tail -1)
   echo "$out"
   local val
   val=$(printf '%s' "$out" | python -c \
@@ -35,14 +42,25 @@ except Exception: print("None")')
 # "the tunnel is dead" (every further attempt burns its init deadline and
 # each connect attempt is itself a wedge risk): cheap 60s probe, abort the
 # session if it doesn't answer.
+# 90s (not 60): a degraded-but-alive tunnel can answer init in ~90s, and a
+# probe that times out exits with its RPC in flight — the client-killed-
+# mid-RPC condition that has wedged the relay before.  A longer deadline
+# trades detection latency for fewer risky disconnects.
 probe_or_die() {
   echo "== [$(TS)] probing tunnel after failure" >&2
-  PROBE_TIMEOUT_S=60 python tools/tunnel_probe.py >&2 || {
+  PROBE_TIMEOUT_S=90 python tools/tunnel_probe.py >&2 || {
     echo "== [$(TS)] tunnel dead — aborting session" >&2; exit 1; }
 }
 
-# 1. baseline config first — the driver-verifiable number (VERDICT item 1)
-run_bench baseline || probe_or_die
+# 1. baseline config first — the driver-verifiable number (VERDICT item 1).
+# If baseline fails while the tunnel still answers, the failure is
+# systemic (code/config), not infrastructure: running 9 more configs into
+# the same failure wastes the chip session — abort instead.
+run_bench baseline || {
+  probe_or_die
+  echo "== [$(TS)] baseline failed with tunnel UP — systemic failure, aborting" >&2
+  exit 1
+}
 
 # 2. MFU sweep (VERDICT item 2): batch x stem x remat
 run_bench b512           BENCH_BATCH=512 || probe_or_die

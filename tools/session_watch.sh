@@ -6,8 +6,11 @@
 set -u
 cd "$(dirname "$0")/.."
 SLEEP="${WATCH_PROBE_SLEEP:-300}"
+# 90s probe deadline: see the probe_or_die comment in chip_session.sh —
+# a timed-out probe is itself a mid-RPC disconnect (wedge risk), so err
+# toward tolerating a slow-but-alive tunnel.
 while true; do
-  if PROBE_TIMEOUT_S=60 python tools/tunnel_probe.py >&2; then
+  if PROBE_TIMEOUT_S=90 python tools/tunnel_probe.py >&2; then
     echo "[session_watch $(date -u +%H:%M:%SZ)] tunnel up — starting chip session" >&2
     if bash tools/chip_session.sh; then
       echo "[session_watch $(date -u +%H:%M:%SZ)] chip session completed" >&2
