@@ -1,0 +1,99 @@
+/* Pure-C smoke of the embedded runtime: proves the flat C ABI
+ * (mxnet_tpu/native/c_api.h) works from a plain C program with no
+ * Python process around it — the reference's bindings consumed
+ * include/mxnet/c_api.h the same way.  Prints "SMOKE OK" on success. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../mxnet_tpu/native/c_api.h"
+
+#define CHECK(rc, what)                                            \
+  do {                                                             \
+    if ((rc) != 0) {                                               \
+      fprintf(stderr, "%s failed: %s\n", what, MXTGetLastError()); \
+      return 1;                                                    \
+    }                                                              \
+  } while (0)
+
+int main(void) {
+  fprintf(stderr, "[smoke] init...\n");
+  CHECK(MXTInit(NULL), "MXTInit");
+  fprintf(stderr, "[smoke] init done\n");
+
+  float data[4] = {1.0f, -2.0f, 3.0f, -4.0f};
+  int64_t shape[2] = {2, 2};
+  MXTHandle x = 0;
+  fprintf(stderr, "[smoke] from_data (first jax touch)...\n");
+  CHECK(MXTNDArrayFromData(data, shape, 2, "float32", 1, 0, &x),
+        "MXTNDArrayFromData");
+  fprintf(stderr, "[smoke] from_data done\n");
+
+  int ndim = 0;
+  CHECK(MXTNDArrayGetNDim(x, &ndim), "GetNDim");
+  if (ndim != 2) {
+    fprintf(stderr, "ndim %d != 2\n", ndim);
+    return 1;
+  }
+
+  /* relu through the generic op invoke */
+  MXTHandle outs[4];
+  int nout = 4;
+  CHECK(MXTImperativeInvoke("relu", 1, &x, 0, NULL, NULL, &nout, outs),
+        "Invoke relu");
+  if (nout != 1) {
+    fprintf(stderr, "nout %d != 1\n", nout);
+    return 1;
+  }
+  float got[4];
+  CHECK(MXTNDArraySyncCopyToCPU(outs[0], got, sizeof(got)), "CopyToCPU");
+  float want[4] = {1.0f, 0.0f, 3.0f, 0.0f};
+  if (memcmp(got, want, sizeof(want)) != 0) {
+    fprintf(stderr, "relu mismatch: [%g %g %g %g]\n", got[0], got[1],
+            got[2], got[3]);
+    return 1;
+  }
+
+  /* scalar-kwarg op: (x + 10) */
+  const char *keys[1] = {"scalar"};
+  const char *vals[1] = {"10"};
+  MXTHandle out2[1];
+  int nout2 = 1;
+  CHECK(MXTImperativeInvoke("_plus_scalar", 1, &x, 1, keys, vals, &nout2,
+                            out2),
+        "Invoke _plus_scalar");
+  CHECK(MXTNDArraySyncCopyToCPU(out2[0], got, sizeof(got)), "CopyToCPU2");
+  if (got[0] != 11.0f || got[3] != 6.0f) {
+    fprintf(stderr, "_plus_scalar mismatch: [%g %g %g %g]\n", got[0],
+            got[1], got[2], got[3]);
+    return 1;
+  }
+
+  /* op registry is visible */
+  size_t needed = 0;
+  CHECK(MXTListAllOpNames(NULL, 0, &needed), "ListAllOpNames");
+  if (needed < 1000) {
+    fprintf(stderr, "op list suspiciously small: %zu bytes\n", needed);
+    return 1;
+  }
+
+  /* error path: bogus op must fail and set a message */
+  MXTHandle out3[1];
+  int nout3 = 1;
+  if (MXTImperativeInvoke("no_such_op_xyz", 1, &x, 0, NULL, NULL, &nout3,
+                          out3) == 0) {
+    fprintf(stderr, "bogus op unexpectedly succeeded\n");
+    return 1;
+  }
+  if (strlen(MXTGetLastError()) == 0) {
+    fprintf(stderr, "error message empty after failure\n");
+    return 1;
+  }
+
+  CHECK(MXTNDArrayFree(outs[0]), "Free");
+  CHECK(MXTNDArrayFree(out2[0]), "Free2");
+  CHECK(MXTNDArrayFree(x), "FreeX");
+  CHECK(MXTShutdown(), "Shutdown");
+  printf("SMOKE OK\n");
+  return 0;
+}

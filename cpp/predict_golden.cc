@@ -1,0 +1,63 @@
+/* C++ predict-equivalence harness: load a checkpoint (symbol JSON +
+ * .params) through the Predictor API, run forward on a raw float32
+ * input, write raw float32 logits.  Driven by tests/test_c_api.py,
+ * which generates the checkpoint in Python and cross-asserts the C++
+ * output against the Python forward — the reference proved its predict
+ * path the same way (tests/python/gpu/test_forward.py over
+ * c_predict_api consumers).
+ *
+ * usage: predict_golden <symbol.json> <file.params> <input.bin>
+ *                       <N> <C> <H> <W> <out.bin>
+ */
+#include <cstdint>
+#include <cstdio>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu.hpp"
+
+int main(int argc, char **argv) {
+  if (argc != 9) {
+    std::cerr << "usage: predict_golden <symbol.json> <file.params> "
+                 "<input.bin> <N> <C> <H> <W> <out.bin>\n";
+    return 2;
+  }
+  try {
+    std::ifstream sf(argv[1]);
+    std::stringstream ss;
+    ss << sf.rdbuf();
+    const std::string symbol_json = ss.str();
+
+    const int64_t n = std::atoll(argv[4]), c = std::atoll(argv[5]),
+                  h = std::atoll(argv[6]), w = std::atoll(argv[7]);
+    std::vector<float> input(n * c * h * w);
+    std::ifstream in(argv[3], std::ios::binary);
+    in.read(reinterpret_cast<char *>(input.data()),
+            input.size() * sizeof(float));
+    if (!in) {
+      std::cerr << "short read on " << argv[3] << "\n";
+      return 2;
+    }
+
+    mxtpu::Predictor pred(symbol_json, argv[2], {"data"},
+                          {{n, c, h, w}}, mxtpu::Context::cpu());
+    pred.set_input("data", input);
+    pred.forward();
+    std::vector<float> out = pred.get_output(0);
+
+    std::ofstream of(argv[8], std::ios::binary);
+    of.write(reinterpret_cast<const char *>(out.data()),
+             out.size() * sizeof(float));
+    std::vector<int64_t> shape = pred.output_shape(0);
+    std::cout << "output shape:";
+    for (int64_t d : shape) std::cout << " " << d;
+    std::cout << "\n";
+    return 0;
+  } catch (const std::exception &e) {
+    std::cerr << "predict_golden failed: " << e.what() << "\n";
+    return 1;
+  }
+}

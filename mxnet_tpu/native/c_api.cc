@@ -1,0 +1,586 @@
+/*
+ * Implementation of the mxnet_tpu flat C ABI (see c_api.h).
+ *
+ * Embeds CPython, imports mxnet_tpu.capi_impl once, and forwards every
+ * call with only ints/strings/buffer addresses crossing the boundary.
+ * Handles are integers owned by the Python-side registry — this file
+ * never holds PyObject references to user objects, so refcounting
+ * stays entirely Python-side (the reference kept the mirror-image
+ * discipline: its handles were C++ pointers never owned by bindings,
+ * src/c_api/c_api.cc).
+ */
+#include "c_api.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string tls_error;
+
+std::mutex g_init_mu;
+PyObject *g_impl = nullptr;     // mxnet_tpu.capi_impl module
+PyThreadState *g_main_ts = nullptr;
+bool g_we_initialized = false;  // we ran Py_InitializeEx (vs in-process)
+bool g_finalized = false;       // MXTShutdown happened; no reinit
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  tls_error = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) tls_error = c;
+      Py_DECREF(s);
+    }
+  }
+  if (type != nullptr) {
+    PyObject *n = PyObject_GetAttrString(type, "__name__");
+    if (n != nullptr) {
+      const char *c = PyUnicode_AsUTF8(n);
+      if (c != nullptr) tls_error = std::string(c) + ": " + tls_error;
+      Py_DECREF(n);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+/* Directory two levels above this .so (repo root when built in-tree:
+ * <root>/mxnet_tpu/native/libmxtpu_c.so). */
+std::string default_repo_root() {
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void *>(&default_repo_root), &info) == 0 ||
+      info.dli_fname == nullptr) {
+    return ".";
+  }
+  std::string p(info.dli_fname);
+  for (int i = 0; i < 3; ++i) {  // strip .so, native/, mxnet_tpu/
+    size_t pos = p.find_last_of('/');
+    if (pos == std::string::npos) return ".";
+    p.resize(pos);
+  }
+  return p.empty() ? "/" : p;
+}
+
+int ensure_init(const char *repo_root) {
+  std::lock_guard<std::mutex> lk(g_init_mu);
+  if (g_finalized) {
+    tls_error = "MXTShutdown was called; reinitialization is not "
+                "supported (CPython extensions like numpy do not survive "
+                "Py_Finalize + re-init)";
+    return -1;
+  }
+  if (g_impl != nullptr) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string root = repo_root != nullptr ? repo_root : default_repo_root();
+  int rc = -1;
+  PyObject *sys_path = PySys_GetObject("path");  // borrowed
+  PyObject *rootstr = PyUnicode_FromString(root.c_str());
+  if (sys_path != nullptr && rootstr != nullptr &&
+      PyList_Insert(sys_path, 0, rootstr) == 0) {
+    PyObject *mod = PyImport_ImportModule("mxnet_tpu.capi_impl");
+    if (mod != nullptr) {
+      g_impl = mod;  // keep the reference forever
+      rc = 0;
+    } else {
+      set_error_from_python();
+    }
+  } else {
+    set_error_from_python();
+  }
+  Py_XDECREF(rootstr);
+  PyGILState_Release(gil);
+  if (g_main_ts == nullptr && PyGILState_Check()) {
+    // We own the GIL from Py_InitializeEx (first-ever init): release it
+    // so other threads (and our own entry points) can take it normally.
+    // Must happen even when the import FAILED — returning with the GIL
+    // held would deadlock every later call from any thread.
+    g_main_ts = PyEval_SaveThread();
+  }
+  return rc;
+}
+
+/* RAII: init-if-needed + GIL for the duration of one API call. */
+class Gil {
+ public:
+  Gil() {
+    ok_ = ensure_init(nullptr) == 0;
+    if (ok_) gil_ = PyGILState_Ensure();
+  }
+  ~Gil() {
+    if (ok_) PyGILState_Release(gil_);
+  }
+  bool ok() const { return ok_; }
+
+ private:
+  bool ok_ = false;
+  PyGILState_STATE gil_;
+};
+
+/* Call g_impl.<fn>(*args); returns new ref or nullptr (error set). */
+PyObject *call(const char *fn, PyObject *args) {
+  PyObject *f = PyObject_GetAttrString(g_impl, fn);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *r = args != nullptr ? PyObject_CallObject(f, args)
+                                : PyObject_CallNoArgs(f);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+PyObject *shape_tuple(const int64_t *shape, int ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(shape[i]));
+  }
+  return t;
+}
+
+PyObject *str_tuple(const char **strs, int n) {
+  PyObject *t = PyTuple_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyTuple_SET_ITEM(t, i, PyUnicode_FromString(strs[i]));
+  }
+  return t;
+}
+
+PyObject *handle_tuple(const MXTHandle *hs, int n) {
+  PyObject *t = PyTuple_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLongLong(hs[i]));
+  }
+  return t;
+}
+
+/* Copy a Python str into the buf/bufsize/needed protocol. */
+int copy_out_string(PyObject *s, char *buf, size_t bufsize, size_t *needed) {
+  Py_ssize_t len = 0;
+  const char *c = PyUnicode_AsUTF8AndSize(s, &len);
+  if (c == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (needed != nullptr) *needed = static_cast<size_t>(len) + 1;
+  if (buf != nullptr && bufsize > 0) {
+    size_t n = static_cast<size_t>(len) < bufsize - 1
+                   ? static_cast<size_t>(len)
+                   : bufsize - 1;
+    std::memcpy(buf, c, n);
+    buf[n] = '\0';
+  }
+  return 0;
+}
+
+int fail(const char *msg) {
+  tls_error = msg;
+  return -1;
+}
+
+/* Free registry entries for every handle in a Python list/tuple — used
+ * when the C side cannot deliver freshly created handles to the caller
+ * (size-query calls, too-small output arrays): without this the Python
+ * registry would pin those arrays forever. */
+void free_py_handles(PyObject *seq) {
+  Py_ssize_t n = PySequence_Size(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *item = PySequence_GetItem(seq, i);
+    if (item == nullptr) continue;
+    PyObject *r = call("free_handle", Py_BuildValue("(O)", item));
+    Py_XDECREF(r);
+    Py_DECREF(item);
+  }
+  PyErr_Clear();
+}
+
+#define API_ENTER()                                         \
+  Gil gil;                                                  \
+  if (!gil.ok()) return -1;                                 \
+  tls_error.clear()
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTGetLastError(void) { return tls_error.c_str(); }
+
+int MXTInit(const char *repo_root) { return ensure_init(repo_root); }
+
+int MXTShutdown(void) {
+  std::lock_guard<std::mutex> lk(g_init_mu);
+  if (g_impl == nullptr || g_finalized) return 0;
+  if (!g_we_initialized) {
+    // Loaded into an existing Python process (ctypes): finalizing the
+    // host interpreter out from under it would be hostile.  Just drop
+    // our module reference; the host owns interpreter lifetime.
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_CLEAR(g_impl);
+    PyGILState_Release(gil);
+    g_finalized = true;
+    return 0;
+  }
+  if (g_main_ts != nullptr) {
+    PyEval_RestoreThread(g_main_ts);
+    g_main_ts = nullptr;
+  }
+  Py_CLEAR(g_impl);
+  Py_FinalizeEx();
+  g_finalized = true;  // ensure_init will refuse from now on
+  return 0;
+}
+
+/* ------------------------------------------------------------ NDArray */
+
+int MXTNDArrayCreate(const int64_t *shape, int ndim, const char *dtype,
+                     int dev_type, int dev_id, MXTHandle *out) {
+  API_ENTER();
+  PyObject *r = call("ndarray_create",
+                     Py_BuildValue("(Nsii)", shape_tuple(shape, ndim),
+                                   dtype, dev_type, dev_id));
+  if (r == nullptr) return -1;
+  *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayFromData(const void *data, const int64_t *shape, int ndim,
+                       const char *dtype, int dev_type, int dev_id,
+                       MXTHandle *out) {
+  API_ENTER();
+  PyObject *r = call(
+      "ndarray_from_data",
+      Py_BuildValue("(KNsii)", reinterpret_cast<uint64_t>(data),
+                    shape_tuple(shape, ndim), dtype, dev_type, dev_id));
+  if (r == nullptr) return -1;
+  *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayFree(MXTHandle h) {
+  API_ENTER();
+  PyObject *r = call("free_handle", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayGetNDim(MXTHandle h, int *out) {
+  API_ENTER();
+  PyObject *r = call("ndarray_ndim", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayGetShape(MXTHandle h, int64_t *shape) {
+  API_ENTER();
+  PyObject *r = call("ndarray_shape", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayGetDType(MXTHandle h, char *buf, size_t bufsize,
+                       size_t *needed) {
+  API_ENTER();
+  PyObject *r = call("ndarray_dtype", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  int rc = copy_out_string(r, buf, bufsize, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXTNDArrayGetNBytes(MXTHandle h, size_t *out) {
+  API_ENTER();
+  PyObject *r = call("ndarray_nbytes", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  *out = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArraySyncCopyToCPU(MXTHandle h, void *data, size_t nbytes) {
+  API_ENTER();
+  PyObject *r = call("ndarray_copy_to",
+                     Py_BuildValue("(KKK)", h,
+                                   reinterpret_cast<uint64_t>(data),
+                                   static_cast<uint64_t>(nbytes)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayWaitAll(void) {
+  API_ENTER();
+  PyObject *r = call("wait_all", nullptr);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArraySave(const char *path, int num, const MXTHandle *handles,
+                   const char **names) {
+  API_ENTER();
+  PyObject *nm;
+  if (names != nullptr) {
+    nm = str_tuple(names, num);
+  } else {
+    nm = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *r = call("ndarray_save",
+                     Py_BuildValue("(sNN)", path,
+                                   handle_tuple(handles, num), nm));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayLoad(const char *path, int *num_out, MXTHandle *handles,
+                   int handles_cap, char *names_buf, size_t names_bufsize,
+                   size_t *names_needed) {
+  API_ENTER();
+  PyObject *r = call("ndarray_load", Py_BuildValue("(s)", path));
+  if (r == nullptr) return -1;
+  PyObject *names = PyTuple_GET_ITEM(r, 0);
+  PyObject *hs = PyTuple_GET_ITEM(r, 1);
+  Py_ssize_t n = PyList_Size(hs);
+  *num_out = static_cast<int>(n);
+  if (handles == nullptr) {
+    // size-query call: the arrays just created can never reach the
+    // caller — release them (the fetch call recreates fresh ones)
+    free_py_handles(hs);
+  } else {
+    if (handles_cap < n) {
+      free_py_handles(hs);
+      Py_DECREF(r);
+      return fail("handles array too small");
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      handles[i] = PyLong_AsUnsignedLongLong(PyList_GET_ITEM(hs, i));
+    }
+  }
+  int rc = 0;
+  if (names_buf != nullptr || names_needed != nullptr) {
+    PyObject *joined;
+    if (names == Py_None) {
+      joined = PyUnicode_FromString("");
+    } else {
+      PyObject *sep = PyUnicode_FromString("\n");
+      joined = PyUnicode_Join(sep, names);
+      Py_DECREF(sep);
+    }
+    if (joined == nullptr) {
+      set_error_from_python();
+      rc = -1;
+    } else {
+      rc = copy_out_string(joined, names_buf, names_bufsize, names_needed);
+      Py_DECREF(joined);
+    }
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+/* --------------------------------------------------------- imperative */
+
+int MXTImperativeInvoke(const char *op_name, int nin,
+                        const MXTHandle *inputs, int nparams,
+                        const char **keys, const char **vals, int *nout,
+                        MXTHandle *outputs) {
+  API_ENTER();
+  PyObject *r = call("imperative_invoke",
+                     Py_BuildValue("(sNNN)", op_name,
+                                   handle_tuple(inputs, nin),
+                                   str_tuple(keys, nparams),
+                                   str_tuple(vals, nparams)));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  if (n > *nout) {
+    free_py_handles(r);
+    Py_DECREF(r);
+    return fail("outputs array too small");
+  }
+  *nout = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    outputs[i] = PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTListAllOpNames(char *buf, size_t bufsize, size_t *needed) {
+  API_ENTER();
+  PyObject *r = call("list_all_op_names", nullptr);
+  if (r == nullptr) return -1;
+  int rc = copy_out_string(r, buf, bufsize, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXTRandomSeed(int seed) {
+  API_ENTER();
+  PyObject *r = call("random_seed", Py_BuildValue("(i)", seed));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ------------------------------------------------------------- Symbol */
+
+static int symbol_from(const char *fn, const char *arg, MXTHandle *out) {
+  API_ENTER();
+  PyObject *r = call(fn, Py_BuildValue("(s)", arg));
+  if (r == nullptr) return -1;
+  *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTSymbolCreateFromJSON(const char *json, MXTHandle *out) {
+  return symbol_from("symbol_create_from_json", json, out);
+}
+
+int MXTSymbolCreateFromFile(const char *path, MXTHandle *out) {
+  return symbol_from("symbol_create_from_file", path, out);
+}
+
+static int symbol_string(const char *fn, MXTHandle h, char *buf,
+                         size_t bufsize, size_t *needed) {
+  API_ENTER();
+  PyObject *r = call(fn, Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  int rc = copy_out_string(r, buf, bufsize, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXTSymbolSaveToJSON(MXTHandle h, char *buf, size_t bufsize,
+                        size_t *needed) {
+  return symbol_string("symbol_save_json", h, buf, bufsize, needed);
+}
+
+int MXTSymbolListArguments(MXTHandle h, char *buf, size_t bufsize,
+                           size_t *needed) {
+  return symbol_string("symbol_list_arguments", h, buf, bufsize, needed);
+}
+
+int MXTSymbolListOutputs(MXTHandle h, char *buf, size_t bufsize,
+                         size_t *needed) {
+  return symbol_string("symbol_list_outputs", h, buf, bufsize, needed);
+}
+
+int MXTSymbolFree(MXTHandle h) { return MXTNDArrayFree(h); }
+
+/* ---------------------------------------------------------- Predictor */
+
+int MXTPredCreate(const char *symbol_json, const char *param_path,
+                  int dev_type, int dev_id, int num_input,
+                  const char **input_names, const int64_t *shape_indptr,
+                  const int64_t *shape_data, MXTHandle *out) {
+  API_ENTER();
+  PyObject *shapes = PyTuple_New(num_input);
+  for (int i = 0; i < num_input; ++i) {
+    int64_t lo = shape_indptr[i], hi = shape_indptr[i + 1];
+    PyTuple_SET_ITEM(shapes, i,
+                     shape_tuple(shape_data + lo,
+                                 static_cast<int>(hi - lo)));
+  }
+  PyObject *r = call("predictor_create",
+                     Py_BuildValue("(ssiiNN)", symbol_json, param_path,
+                                   dev_type, dev_id,
+                                   str_tuple(input_names, num_input),
+                                   shapes));
+  if (r == nullptr) return -1;
+  *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredSetInput(MXTHandle pred, const char *name, const float *data,
+                    size_t size) {
+  API_ENTER();
+  PyObject *r = call("predictor_set_input",
+                     Py_BuildValue("(KsKK)", pred, name,
+                                   reinterpret_cast<uint64_t>(data),
+                                   static_cast<uint64_t>(size)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredForward(MXTHandle pred) {
+  API_ENTER();
+  PyObject *r = call("predictor_forward", Py_BuildValue("(K)", pred));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredGetNumOutputs(MXTHandle pred, int *out) {
+  API_ENTER();
+  PyObject *r = call("predictor_num_outputs", Py_BuildValue("(K)", pred));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredGetOutputShape(MXTHandle pred, int index, int64_t *shape,
+                          int *ndim) {
+  API_ENTER();
+  PyObject *r = call("predictor_output_shape",
+                     Py_BuildValue("(Ki)", pred, index));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  if (n > *ndim) {
+    Py_DECREF(r);
+    return fail("shape array too small");
+  }
+  *ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredGetOutput(MXTHandle pred, int index, float *data, size_t size) {
+  API_ENTER();
+  PyObject *r = call("predictor_get_output",
+                     Py_BuildValue("(KiKK)", pred, index,
+                                   reinterpret_cast<uint64_t>(data),
+                                   static_cast<uint64_t>(size)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredFree(MXTHandle pred) { return MXTNDArrayFree(pred); }
+
+}  /* extern "C" */

@@ -1,0 +1,129 @@
+/*
+ * mxnet_tpu flat C ABI — TPU-native equivalent of the reference's C API
+ * boundary (reference: include/mxnet/c_api.h, ~152 MX* functions;
+ * include/mxnet/c_predict_api.h, the predict-only deployment surface).
+ *
+ * Design inversion: the reference wraps a C++ core in C for language
+ * bindings; this framework's core is Python-over-XLA, so the C library
+ * (libmxtpu_c.so) embeds CPython and dispatches into
+ * mxnet_tpu/capi_impl.py.  Compute runs through jit/XLA identically to
+ * the Python path — this is a boundary, not a reimplementation.
+ *
+ * Conventions (mirroring the reference's):
+ *  - every function returns 0 on success, -1 on failure;
+ *    MXTGetLastError() returns the failure message (thread-local).
+ *  - objects cross as opaque uint64_t handles (MXTHandle); 0 is invalid.
+ *  - dev_type: 1 = cpu, 2 = tpu (the accelerator slot the reference
+ *    used for gpu).
+ *  - op hyper-parameters cross as parallel key/value string arrays and
+ *    are parsed Python-side (the reference parsed them with
+ *    dmlc::Parameter, c_api_ndarray.cc MXImperativeInvoke).
+ *  - variable-length string results use the buf/bufsize/needed protocol:
+ *    pass bufsize=0 to query the required size (incl. NUL), then call
+ *    again.  List results are '\n'-joined.
+ *
+ * Thread-safety: calls may come from any thread; each entry point takes
+ * the GIL.  The embedded interpreter is initialized lazily on first use
+ * (or explicitly via MXTInit).
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef uint64_t MXTHandle;
+
+/* Last error message for the calling thread ("" if none). */
+const char *MXTGetLastError(void);
+
+/* Initialize the embedded interpreter + framework.  Optional (lazy on
+ * first call otherwise).  `repo_root` may be NULL: the package location
+ * is then derived from this library's own path (../.. of the .so). */
+int MXTInit(const char *repo_root);
+/* Finalize the interpreter.  No MXT* call is valid afterwards. */
+int MXTShutdown(void);
+
+/* ---------------------------------------------------------- NDArray -- */
+/* reference: MXNDArrayCreate / MXNDArraySyncCopyFromCPU /
+ * MXNDArraySyncCopyToCPU / MXNDArrayFree / MXNDArrayGetShape /
+ * MXNDArrayGetDType / MXNDArrayWaitAll (c_api.cc) */
+int MXTNDArrayCreate(const int64_t *shape, int ndim, const char *dtype,
+                     int dev_type, int dev_id, MXTHandle *out);
+int MXTNDArrayFromData(const void *data, const int64_t *shape, int ndim,
+                       const char *dtype, int dev_type, int dev_id,
+                       MXTHandle *out);
+int MXTNDArrayFree(MXTHandle h);
+int MXTNDArrayGetNDim(MXTHandle h, int *out);
+/* `shape` must hold at least ndim elements (query ndim first). */
+int MXTNDArrayGetShape(MXTHandle h, int64_t *shape);
+int MXTNDArrayGetDType(MXTHandle h, char *buf, size_t bufsize,
+                       size_t *needed);
+int MXTNDArrayGetNBytes(MXTHandle h, size_t *out);
+/* Blocking device->host copy; nbytes must equal the array's byte size. */
+int MXTNDArraySyncCopyToCPU(MXTHandle h, void *data, size_t nbytes);
+int MXTNDArrayWaitAll(void);
+/* Save arrays to the framework's format-stable .params container.
+ * `names` may be NULL (positional list). reference: MXNDArraySave. */
+int MXTNDArraySave(const char *path, int num, const MXTHandle *handles,
+                   const char **names);
+/* Load a .params container.  Returns handle/name counts; call the
+ * _Get variants with caller-sized arrays.  reference: MXNDArrayLoad. */
+int MXTNDArrayLoad(const char *path, int *num_out, MXTHandle *handles,
+                   int handles_cap, char *names_buf, size_t names_bufsize,
+                   size_t *names_needed);
+
+/* ------------------------------------------------------- imperative -- */
+/* Invoke any registered op by name (the full ~319-op surface).
+ * `outputs` is a caller array of capacity `*nout`; on return *nout is
+ * the actual output count.  reference: MXImperativeInvoke
+ * (c_api_ndarray.cc:165). */
+int MXTImperativeInvoke(const char *op_name, int nin,
+                        const MXTHandle *inputs, int nparams,
+                        const char **keys, const char **vals, int *nout,
+                        MXTHandle *outputs);
+/* '\n'-joined sorted registry op names. reference: MXListAllOpNames. */
+int MXTListAllOpNames(char *buf, size_t bufsize, size_t *needed);
+int MXTRandomSeed(int seed);
+
+/* ----------------------------------------------------------- Symbol -- */
+/* reference: MXSymbolCreateFromJSON / MXSymbolSaveToJSON /
+ * MXSymbolListArguments / MXSymbolListOutputs (c_api_symbolic.cc) */
+int MXTSymbolCreateFromJSON(const char *json, MXTHandle *out);
+int MXTSymbolCreateFromFile(const char *path, MXTHandle *out);
+int MXTSymbolSaveToJSON(MXTHandle h, char *buf, size_t bufsize,
+                        size_t *needed);
+int MXTSymbolListArguments(MXTHandle h, char *buf, size_t bufsize,
+                           size_t *needed);
+int MXTSymbolListOutputs(MXTHandle h, char *buf, size_t bufsize,
+                         size_t *needed);
+int MXTSymbolFree(MXTHandle h);
+
+/* -------------------------------------------------------- Predictor -- */
+/* Predict-only deployment API. reference: c_predict_api.h MXPredCreate
+ * (shape_indptr/shape_data CSR layout kept), MXPredSetInput,
+ * MXPredForward, MXPredGetOutputShape, MXPredGetOutput, MXPredFree. */
+int MXTPredCreate(const char *symbol_json, const char *param_path,
+                  int dev_type, int dev_id, int num_input,
+                  const char **input_names, const int64_t *shape_indptr,
+                  const int64_t *shape_data, MXTHandle *out);
+/* `size` = number of float32 elements (must match the declared shape). */
+int MXTPredSetInput(MXTHandle pred, const char *name, const float *data,
+                    size_t size);
+int MXTPredForward(MXTHandle pred);
+int MXTPredGetNumOutputs(MXTHandle pred, int *out);
+/* On entry *ndim is the capacity of `shape`; on return the actual rank. */
+int MXTPredGetOutputShape(MXTHandle pred, int index, int64_t *shape,
+                          int *ndim);
+int MXTPredGetOutput(MXTHandle pred, int index, float *data, size_t size);
+int MXTPredFree(MXTHandle pred);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXNET_TPU_C_API_H_ */

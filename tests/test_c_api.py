@@ -1,0 +1,243 @@
+"""Flat C ABI (native/c_api.{h,cc}) + cpp/ consumer tests.
+
+Covers both boundary modes:
+ * in-process: libmxtpu_c.so dlopen'd into this interpreter via ctypes
+   (Py_IsInitialized short-circuits embedding; handles/ops/symbols work
+   against the live runtime) — fast, runs in the default gate.
+ * out-of-process (marked slow): real C/C++ programs embedding CPython —
+   cpp/capi_smoke.c (pure C, the binding-consumer contract) and
+   cpp/predict_golden.cc (C++ Predictor vs Python forward equivalence,
+   the reference's tests/python/gpu/test_forward.py pattern over
+   c_predict_api consumers).
+"""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "mxnet_tpu", "native")
+CPP = os.path.join(ROOT, "cpp")
+LIB = os.path.join(NATIVE, "libmxtpu_c.so")
+
+H = ctypes.c_uint64
+
+
+def _build_lib():
+    # Always invoke make: its dependency graph (which includes c_api.h)
+    # decides staleness — a hand-rolled mtime check here would miss
+    # header edits and silently test a stale ABI.
+    r = subprocess.run(["make", "-C", NATIVE, "libmxtpu_c.so"],
+                       capture_output=True, text=True)
+    if r.returncode != 0 and not os.path.exists(LIB):
+        pytest.skip("cannot build libmxtpu_c.so: %s" % r.stderr[-400:])
+    return LIB
+
+
+@pytest.fixture(scope="module")
+def lib():
+    path = _build_lib()
+    lib = ctypes.CDLL(path)
+    lib.MXTGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _invoke(lib, op, handles, params=None, max_out=8):
+    params = params or {}
+    n = len(params)
+    keys = (ctypes.c_char_p * n)(*[k.encode() for k in params])
+    vals = (ctypes.c_char_p * n)(*[str(v).encode() for v in params.values()])
+    ins = (H * len(handles))(*handles)
+    outs = (H * max_out)()
+    nout = ctypes.c_int(max_out)
+    rc = lib.MXTImperativeInvoke(op.encode(), len(handles), ins, n,
+                                 keys, vals, ctypes.byref(nout), outs)
+    assert rc == 0, lib.MXTGetLastError()
+    return [outs[i] for i in range(nout.value)]
+
+
+def _to_numpy(lib, h):
+    ndim = ctypes.c_int()
+    assert lib.MXTNDArrayGetNDim(H(h), ctypes.byref(ndim)) == 0
+    shape = (ctypes.c_int64 * max(ndim.value, 1))()
+    assert lib.MXTNDArrayGetShape(H(h), shape) == 0
+    shp = tuple(shape[i] for i in range(ndim.value))
+    nbytes = ctypes.c_size_t()
+    assert lib.MXTNDArrayGetNBytes(H(h), ctypes.byref(nbytes)) == 0
+    buf = np.zeros(shp, dtype=np.float32)
+    assert buf.nbytes == nbytes.value
+    rc = lib.MXTNDArraySyncCopyToCPU(
+        H(h), buf.ctypes.data_as(ctypes.c_void_p), nbytes)
+    assert rc == 0, lib.MXTGetLastError()
+    return buf
+
+
+def _from_numpy(lib, arr):
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    h = H()
+    rc = lib.MXTNDArrayFromData(arr.ctypes.data_as(ctypes.c_void_p),
+                                shape, arr.ndim, b"float32", 1, 0,
+                                ctypes.byref(h))
+    assert rc == 0, lib.MXTGetLastError()
+    return h.value
+
+
+def test_ndarray_roundtrip_and_ops(lib):
+    x = np.array([[1, -2], [3, -4]], dtype=np.float32)
+    h = _from_numpy(lib, x)
+    (r,) = _invoke(lib, "relu", [h])
+    np.testing.assert_array_equal(_to_numpy(lib, r), np.maximum(x, 0))
+    (p,) = _invoke(lib, "_plus_scalar", [h], {"scalar": 10})
+    np.testing.assert_array_equal(_to_numpy(lib, p), x + 10)
+    # two-input op
+    (s,) = _invoke(lib, "elemwise_add", [h, h])
+    np.testing.assert_array_equal(_to_numpy(lib, s), x + x)
+    # dtype string protocol
+    need = ctypes.c_size_t()
+    assert lib.MXTNDArrayGetDType(H(h), None, 0, ctypes.byref(need)) == 0
+    buf = ctypes.create_string_buffer(need.value)
+    assert lib.MXTNDArrayGetDType(H(h), buf, need.value,
+                                  ctypes.byref(need)) == 0
+    assert buf.value == b"float32"
+    for hh in (h, r, p, s):
+        assert lib.MXTNDArrayFree(H(hh)) == 0
+
+
+def test_error_handling(lib):
+    x = _from_numpy(lib, np.zeros((2, 2), np.float32))
+    outs = (H * 1)()
+    nout = ctypes.c_int(1)
+    rc = lib.MXTImperativeInvoke(b"no_such_op", 1, (H * 1)(x), 0, None,
+                                 None, ctypes.byref(nout), outs)
+    assert rc == -1
+    assert b"no_such_op" in lib.MXTGetLastError()
+    # freed handle use fails cleanly
+    assert lib.MXTNDArrayFree(H(x)) == 0
+    ndim = ctypes.c_int()
+    assert lib.MXTNDArrayGetNDim(H(x), ctypes.byref(ndim)) == -1
+    assert b"handle" in lib.MXTGetLastError()
+
+
+def test_save_load(lib, tmp_path):
+    x = _from_numpy(lib, np.arange(6, dtype=np.float32).reshape(2, 3))
+    path = str(tmp_path / "arrs.params").encode()
+    names = (ctypes.c_char_p * 1)(b"w")
+    assert lib.MXTNDArraySave(path, 1, (H * 1)(x), names) == 0
+    num = ctypes.c_int()
+    handles = (H * 4)()
+    need = ctypes.c_size_t()
+    nbuf = ctypes.create_string_buffer(256)
+    rc = lib.MXTNDArrayLoad(path, ctypes.byref(num), handles, 4, nbuf,
+                            256, ctypes.byref(need))
+    assert rc == 0, lib.MXTGetLastError()
+    assert num.value == 1 and nbuf.value == b"w"
+    np.testing.assert_array_equal(
+        _to_numpy(lib, handles[0]),
+        np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_symbol_roundtrip(lib):
+    import mxnet_tpu as mx
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    js = net.tojson().encode()
+    h = H()
+    assert lib.MXTSymbolCreateFromJSON(js, ctypes.byref(h)) == 0
+    need = ctypes.c_size_t()
+    assert lib.MXTSymbolListArguments(h, None, 0, ctypes.byref(need)) == 0
+    buf = ctypes.create_string_buffer(need.value)
+    assert lib.MXTSymbolListArguments(h, buf, need.value,
+                                      ctypes.byref(need)) == 0
+    args = buf.value.decode().split("\n")
+    assert args == ["data", "fc_weight", "fc_bias"]
+    # JSON survives the boundary round trip
+    assert lib.MXTSymbolSaveToJSON(h, None, 0, ctypes.byref(need)) == 0
+    jbuf = ctypes.create_string_buffer(need.value)
+    assert lib.MXTSymbolSaveToJSON(h, jbuf, need.value,
+                                   ctypes.byref(need)) == 0
+    import json
+    assert json.loads(jbuf.value.decode())["nodes"]
+    assert lib.MXTSymbolFree(h) == 0
+
+
+def test_list_all_op_names(lib):
+    need = ctypes.c_size_t()
+    assert lib.MXTListAllOpNames(None, 0, ctypes.byref(need)) == 0
+    buf = ctypes.create_string_buffer(need.value)
+    assert lib.MXTListAllOpNames(buf, need.value, ctypes.byref(need)) == 0
+    ops = buf.value.decode().split("\n")
+    assert len(ops) > 300 and "relu" in ops
+
+
+def _build_cpp(target):
+    r = subprocess.run(["make", "-C", CPP, target], capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        pytest.skip("cannot build cpp/%s: %s" % (target, r.stderr[-400:]))
+    return os.path.join(CPP, target)
+
+
+@pytest.mark.slow
+def test_pure_c_embedding_smoke():
+    """A plain C program (no Python process) drives the runtime."""
+    exe = _build_cpp("capi_smoke")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "SMOKE OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_cpp_predictor_matches_python_forward(tmp_path):
+    """C++ Predictor output == Python Module forward on the same
+    checkpoint (reference test_forward.py pattern)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import model as mx_model
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3),
+                             pad=(1, 1), name="conv")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 3, 8, 8))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(99)
+    mod.init_params(mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    arg = {k: v for k, v in arg.items()}
+
+    prefix = str(tmp_path / "tiny")
+    mx_model.save_checkpoint(prefix, 0, net, arg, aux)
+
+    rs = np.random.RandomState(3)
+    x = rs.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    from mxnet_tpu.io import DataBatch
+    mod_inf = mx.mod.Module(net, label_names=("softmax_label",))
+    mod_inf.bind(data_shapes=[("data", (2, 3, 8, 8))],
+                 label_shapes=[("softmax_label", (2,))],
+                 for_training=False)
+    mod_inf.set_params(arg, aux)
+    mod_inf.forward(DataBatch(data=[mx.nd.array(x)],
+                              label=[mx.nd.zeros((2,))]), is_train=False)
+    want = mod_inf.get_outputs()[0].asnumpy()
+
+    exe = _build_cpp("predict_golden")
+    inp = tmp_path / "input.bin"
+    out = tmp_path / "output.bin"
+    x.tofile(str(inp))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0000.params", str(inp),
+         "2", "3", "8", "8", str(out)],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    got = np.fromfile(str(out), dtype=np.float32).reshape(want.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
