@@ -264,6 +264,25 @@ def _run(batch):
     probe_s = time.perf_counter() - tp
     iters = shrink_iters(probe_s, ITERS, _mark)
 
+    # BENCH_PROFILE=1: capture an xplane trace of a few steady-state
+    # steps (AFTER warmup/compile so the capture is pure execution);
+    # summarize offline with tools/xplane_summary.py — this is the
+    # data source for the MFU gap analysis.
+    profile_dir = None
+    if os.environ.get("BENCH_PROFILE", "0") == "1":
+        import jax as _jax
+        profile_dir = os.environ.get(
+            "BENCH_PROFILE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "docs", "artifacts", "xplane_resnet50"))
+        os.makedirs(profile_dir, exist_ok=True)
+        _jax.profiler.start_trace(profile_dir)
+        for i in range(3):
+            step(i)
+        hard_sync()
+        _jax.profiler.stop_trace()
+        _mark("profile captured to %s" % profile_dir)
+
     t0 = time.perf_counter()
     for i in range(iters):
         step(i)

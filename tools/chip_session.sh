@@ -71,6 +71,14 @@ run_bench b512_s2d_remat BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=1 || probe_o
 run_bench b768_s2d_rematm BENCH_BATCH=768 BENCH_STEM=s2d BENCH_REMAT=save_matmuls || probe_or_die
 run_bench b1024_lars_s2d  BENCH_BATCH=1024 BENCH_STEM=s2d BENCH_REMAT=save_matmuls BENCH_OPT=lars || probe_or_die
 
+# 2b. xplane capture of steady-state steps — the data source for the MFU
+# gap analysis (summarized without tensorboard by tools/xplane_summary.py)
+run_bench profile_baseline BENCH_PROFILE=1 || probe_or_die
+if [ -d docs/artifacts/xplane_resnet50 ]; then
+  python tools/xplane_summary.py docs/artifacts/xplane_resnet50 --top 40 \
+    > docs/artifacts/xplane_resnet50_summary.txt 2>&1 || true
+fi
+
 # 3. real-data end-to-end (VERDICT item 3)
 run_bench record         BENCH_DATA=record || probe_or_die
 run_bench record_b512    BENCH_DATA=record BENCH_BATCH=512 || probe_or_die

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler xplane capture into an op-time table.
+
+The MFU gap analysis needs to know where step time actually goes on the
+chip (which convs/fusions dominate, how much is infeed/outfeed or gaps),
+not guesses.  ``jax.profiler.trace`` writes
+``<logdir>/plugins/profile/<run>/<host>.xplane.pb``; this tool parses it
+with the in-image ``tensorflow.tsl`` xplane proto (no tensorboard UI
+needed — the box has no display and no egress) and prints per-op
+self-time aggregated over the device planes.
+
+Usage:
+    python tools/xplane_summary.py <logdir-or-xplane.pb> [--top N]
+
+Reference analog: the reference shipped a chrome-trace profiler dump
+(src/engine/profiler.cc DumpProfile) and nvprof was the deep tool; on
+TPU the xplane capture IS the deep tool, and this is its no-UI reader.
+"""
+import argparse
+import collections
+import glob
+import os
+import sys
+
+
+def find_xplane(path):
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(
+        path, "**", "*.xplane.pb"), recursive=True))
+    if not hits:
+        raise SystemExit("no .xplane.pb under %s" % path)
+    return hits[-1]  # latest run
+
+
+def load(path):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    sp = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        sp.ParseFromString(f.read())
+    return sp
+
+
+def device_planes(space):
+    """TPU device planes (or CPU-host XLA planes when no TPU present)."""
+    tpu = [p for p in space.planes if "/device:TPU" in p.name
+           or p.name.startswith("/device:TPU")]
+    if tpu:
+        return tpu
+    return [p for p in space.planes if "Host Threads" not in p.name
+            and p.lines]
+
+
+def summarize(space, top=30):
+    rows = []
+    for plane in device_planes(space):
+        ev_meta = plane.event_metadata
+        # per-op exclusive time: events on one line can nest; xplane
+        # device planes are flat per-core step traces, so duration sums
+        # are a good self-time proxy per op name
+        agg = collections.defaultdict(lambda: [0, 0])  # name -> [ps, n]
+        line_span = [None, None]
+        for line in plane.lines:
+            for ev in line.events:
+                name = ev_meta[ev.metadata_id].name
+                agg[name][0] += ev.duration_ps
+                agg[name][1] += 1
+                t0 = ev.offset_ps
+                t1 = ev.offset_ps + ev.duration_ps
+                if line_span[0] is None or t0 < line_span[0]:
+                    line_span[0] = t0
+                if line_span[1] is None or t1 > line_span[1]:
+                    line_span[1] = t1
+        total_ps = sum(v[0] for v in agg.values())
+        span_ps = (line_span[1] - line_span[0]) if line_span[0] is not None \
+            else 0
+        rows.append((plane.name, agg, total_ps, span_ps))
+    print_report(rows, top)
+
+
+def print_report(rows, top):
+    for plane_name, agg, total_ps, span_ps in rows:
+        print("== plane: %s" % plane_name)
+        if span_ps:
+            print("   busy %.3f ms of %.3f ms span (%.1f%% occupancy)"
+                  % (total_ps / 1e9, span_ps / 1e9,
+                     100.0 * total_ps / span_ps))
+        items = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+        width = max((len(k) for k, _ in items), default=10)
+        print("   %-*s %12s %8s %7s" % (width, "op", "total_ms", "count",
+                                        "share"))
+        for name, (ps, n) in items:
+            print("   %-*s %12.3f %8d %6.1f%%"
+                  % (width, name, ps / 1e9, n,
+                     100.0 * ps / total_ps if total_ps else 0.0))
+        print()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path")
+    ap.add_argument("--top", type=int, default=30)
+    a = ap.parse_args()
+    summarize(load(find_xplane(a.path)), a.top)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
