@@ -97,6 +97,10 @@ declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19, "")
 declare_env("MXNET_DEFAULT_DTYPE", str, "float32",
             "default real dtype; set bfloat16 for TPU-preferred training")
+declare_env("MXNET_ZERO_STAGE", int, 0,
+            "ZeRO optimizer-state sharding over the dp mesh axis: 0 off, "
+            "1 = shard optimizer states + fp32 master weights (Module "
+            "zero_stage kwarg overrides)")
 
 
 # ---------------------------------------------------------------------------

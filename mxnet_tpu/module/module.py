@@ -38,7 +38,7 @@ class Module(BaseModule):
                  label_names=('softmax_label',), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None, mesh=None, sharding_rules=None,
-                 compute_dtype=None):
+                 compute_dtype=None, zero_stage=None):
         super().__init__(logger=logger)
         if context is None:
             context = [current_context()]
@@ -66,6 +66,23 @@ class Module(BaseModule):
         # TPU-native analog of the reference's --dtype float16 training
         # recipe (example/image-classification/common/fit.py).
         self._compute_dtype = compute_dtype
+        # ZeRO-1 optimizer-state sharding over the dp axis.  The modern
+        # answer to the reference's update-on-kvstore mode (SURVEY §2.5
+        # "gradient aggregation modes" → optimizer-state sharding
+        # decision): instead of an optimizer living in a parameter
+        # server, each dp rank owns a 1/dp shard of every optimizer
+        # state (and fp32 master weight); GSPMD then materializes the
+        # reduce-scatter(grads) → sharded update → all-gather(params)
+        # schedule inside the one fused step.  Opt-in: zero_stage=1 or
+        # MXNET_ZERO_STAGE=1.
+        if zero_stage is None:
+            zero_stage = env("MXNET_ZERO_STAGE", 0)
+        if zero_stage not in (0, 1):
+            raise ValueError("zero_stage must be 0 or 1 (ZeRO-2/3 shard "
+                             "gradients/params too — not implemented; "
+                             "ZeRO-1 covers the optimizer-state memory, "
+                             "which dominates for Adam-family training)")
+        self._zero_stage = int(zero_stage)
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -373,6 +390,7 @@ class Module(BaseModule):
             n: optimizer.create_state_multi_precision(
                 n, self._exec.arg_dict[n])
             for n in self._update_names()}
+        self._shard_opt_states()
 
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
@@ -382,6 +400,38 @@ class Module(BaseModule):
     def _update_names(self):
         return [n for n in self._param_names
                 if self._grad_req.get(n, 'null') != 'null']
+
+    def _zero_pspec(self, arr):
+        """ZeRO-1 partition spec for one optimizer-state array: shard the
+        leading dim over dp when divisible, else replicate (tiny biases
+        aren't worth a ragged shard)."""
+        from jax.sharding import PartitionSpec as P
+        if arr.ndim and arr.shape[0] % self._zero_dp() == 0:
+            return P(*(("dp",) + (None,) * (arr.ndim - 1)))
+        return P()
+
+    def _zero_dp(self):
+        from .. import parallel as _par
+        if self._mesh is None:
+            return 1
+        return _par.mesh_shape(self._mesh).get("dp", 1)
+
+    def _shard_opt_states(self):
+        """Place every optimizer-state array (incl. fp32 master weights)
+        with its ZeRO-1 sharding.  Placement here + GSPMD propagation in
+        the fused jit is the whole mechanism — no collective is written
+        by hand; XLA inserts reduce-scatter/all-gather over ICI."""
+        if self._zero_stage < 1 or self._zero_dp() <= 1:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+        mesh = self._mesh
+        for n, states in self._opt_states.items():
+            for s in states:
+                if s is None:   # e.g. DCASGD momentum=0 slot
+                    continue
+                s._set_data(jax.device_put(
+                    s._data, NamedSharding(mesh, self._zero_pspec(s))))
 
     # -- compute --------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -540,6 +590,17 @@ class Module(BaseModule):
 
         from ..executor import maybe_mirror
         run_fwd = maybe_mirror(run)
+        zero1 = self._zero_stage >= 1 and self._zero_dp() > 1
+        if zero1:
+            from .. import parallel as _par
+            # params leave the step in their RULE sharding (tp weights
+            # stay tp-sharded; replicated params replicated) — an
+            # unconditional P() here would all-gather tensor-parallel
+            # weights onto every chip
+            param_pspecs = [
+                _par.infer_pspec(n, self._exec.arg_dict[n].shape,
+                                 self._mesh, self._sharding_rules)
+                for n in names]
 
         def step(pvals, io_vals, aux_vals, key, states, lrs, wds, t):
             def f(pv):
@@ -561,6 +622,24 @@ class Module(BaseModule):
             new_params, new_states = opt.apply_fused(
                 pvals, grads, states, lrs, wds, use_mp,
                 ts=(t,) * len(names) if needs_t else None)
+            if zero1:
+                # ZeRO-1: pin the schedule — state math stays dp-sharded
+                # (GSPMD reduce-scatters the grads feeding it), params
+                # leave the step in their rule sharding (the dp
+                # all-gather happens HERE, inside the fused program,
+                # overlapped by XLA)
+                from jax.sharding import NamedSharding
+                mesh_ = self._mesh
+                new_params = tuple(
+                    jax.lax.with_sharding_constraint(
+                        w, NamedSharding(mesh_, ps))
+                    for w, ps in zip(new_params, param_pspecs))
+                new_states = tuple(
+                    tuple(s if s is None else
+                          jax.lax.with_sharding_constraint(
+                              s, NamedSharding(mesh_, self._zero_pspec(s)))
+                          for s in st)
+                    for st in new_states)
             return outs, new_aux, tuple(new_params), tuple(new_states)
 
         # Donate the buffers the step replaces — params, aux (BN stats),
@@ -652,7 +731,12 @@ class Module(BaseModule):
             for n, st in states.items():
                 if n in self._opt_states:
                     for s, v in zip(self._opt_states[n], st):
-                        s._set_data(jnp.asarray(v))
+                        if s is not None:
+                            s._set_data(jnp.asarray(v))
+            # restored buffers land unsharded; re-apply ZeRO-1 placement
+            # immediately or the resume step would hold full O(P)
+            # optimizer state per chip — the very peak ZeRO avoids
+            self._shard_opt_states()
 
     def borrow_optimizer(self, shared_module):
         """Share optimizer/updater/state with another Module

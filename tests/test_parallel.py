@@ -119,3 +119,124 @@ def test_indivisible_batch_raises():
                            label=np.zeros((12,), np.float32), batch_size=12)
     with pytest.raises(mx.MXNetError):
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+
+
+def _fit_once_opt(mod, x, y, optimizer, opt_params, nstep=4):
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=x.shape[0])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian", magnitude=2.0))
+    mod.init_optimizer(optimizer=optimizer, optimizer_params=opt_params)
+    batch = next(iter(it))
+    for _ in range(nstep):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_zero1_matches_unsharded():
+    """ZeRO-1 (optimizer state sharded over dp) is a layout change, not a
+    math change: params after N momentum steps must match the replicated
+    run bit-for-bit-ish.  The reference's analog decision was
+    update-on-kvstore vs local update (model.py:57-94) — also two
+    placements of the same optimizer math."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 10).astype(np.float32)
+    y = rng.randint(0, 8, (32,)).astype(np.float32)
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9}
+
+    mx.random.seed(11)
+    mesh = par.make_mesh()  # dp=8
+    ref = _fit_once_opt(mx.mod.Module(_mlp(), mesh=mesh), x, y,
+                        "sgd", opt_params)
+
+    mx.random.seed(11)
+    got = _fit_once_opt(
+        mx.mod.Module(_mlp(), mesh=par.make_mesh(), zero_stage=1), x, y,
+        "sgd", opt_params)
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=2e-6, atol=2e-6)
+
+
+def test_zero1_states_actually_sharded():
+    """The telltale: momentum buffers for dp-divisible leading dims live
+    dp-sharded on the mesh; tiny biases stay replicated."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(16, 10).astype(np.float32)
+    y = rng.randint(0, 8, (16,)).astype(np.float32)
+    mesh = par.make_mesh()  # dp=8
+    # fc2 hidden = 9: its weight (9,16) and bias (9,) are NOT divisible
+    # by dp=8 and must stay replicated
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=9, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(net, mesh=mesh, zero_stage=1)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.update()
+
+    sharded = replicated = 0
+    for name, states in mod._opt_states.items():
+        for s in states:
+            spec = s._data.sharding.spec
+            if s._data.ndim and s._data.shape[0] % 8 == 0:
+                assert tuple(spec)[:1] == ("dp",), (name, spec)
+                sharded += 1
+            else:
+                assert all(p is None for p in tuple(spec)), (name, spec)
+                replicated += 1
+    assert sharded >= 2      # fc1 weight (16,10) + fc1 bias (16,)
+    assert replicated >= 2   # fc2 weight (9,16) + fc2 bias (9,)
+
+
+def test_zero1_rejects_stage2():
+    with pytest.raises(ValueError, match="ZeRO-2/3"):
+        mx.mod.Module(_mlp(), zero_stage=2)
+
+
+def test_zero1_preserves_tp_sharding():
+    """ZeRO-1 + tensor parallelism: after a fused step the tp-sharded
+    weight must STILL be tp-sharded (a replicated constraint on new
+    params would all-gather it onto every chip) and numerics must match
+    the replicated run."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(16, 10).astype(np.float32)
+    y = rng.randint(0, 8, (16,)).astype(np.float32)
+    sym = _mlp()
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9}
+
+    mx.random.seed(13)
+    ref = _fit_once_opt(mx.mod.Module(sym), x, y, "sgd", opt_params)
+
+    mx.random.seed(13)
+    mesh = par.make_mesh(tp=2)  # dp=4 x tp=2
+    rules = par.tp_rules_for_symbol(sym, mesh)
+    mod = mx.mod.Module(sym, mesh=mesh, sharding_rules=rules,
+                        zero_stage=1)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd", optimizer_params=opt_params)
+    batch = next(iter(it))
+    for _ in range(4):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    w = mod._exec.arg_dict["fc1_weight"]._data
+    # still tp-sharded: (16,10) over tp=2 → shards (8,10)
+    assert {s.data.shape for s in w.addressable_shards} == {(8, 10)}
+    args, _ = mod.get_params()
+    for k in ref:
+        np.testing.assert_allclose(args[k].asnumpy(), ref[k],
+                                   rtol=2e-5, atol=2e-5, err_msg=k)
