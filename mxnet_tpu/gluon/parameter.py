@@ -371,6 +371,33 @@ class ParameterDict:
         for v in self._params.values():
             v.zero_grad()
 
+    def place(self, mesh, rules=None):
+        """Place every initialized parameter (and its grad buffer) on
+        ``mesh`` — replicated by default, or per ``rules``
+        (parallel.ShardingRules) for tensor-parallel layouts.
+
+        This is the gluon entry to SPMD training: after
+        ``net.collect_params().place(mesh)`` and dp-sharding the input
+        batch, eager/hybridized compute runs as one GSPMD program — the
+        mesh analog of the reference's one-copy-per-GPU ``reset_ctx``
+        (parameter.py reset_ctx; here placement is a sharding, not a
+        copy).  Combine with ``Trainer(..., mesh=mesh, zero_stage=1)``
+        for dp-sharded optimizer state."""
+        import jax
+        from jax.sharding import NamedSharding
+        from .. import parallel as _par
+        for p in self._params.values():
+            if p._data is None:
+                raise MXNetError(
+                    f"place(): parameter {p.name!r} is not initialized "
+                    "(deferred shapes resolve at the first forward — run "
+                    "one forward, then place)")
+            spec = _par.infer_pspec(p.name, p._data.shape, mesh, rules)
+            sh = NamedSharding(mesh, spec)
+            p._data._set_data(jax.device_put(p._data._data, sh))
+            if p._grad is not None:
+                p._grad._set_data(jax.device_put(p._grad._data, sh))
+
     def setattr(self, name, value):
         for v in self._params.values():
             setattr(v, name, value)

@@ -21,7 +21,8 @@ from .parameter import ParameterDict, Parameter
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
-                 kvstore='device', compression_params=None):
+                 kvstore='device', compression_params=None,
+                 mesh=None, zero_stage=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -35,6 +36,26 @@ class Trainer:
             param._trainer = self
             self._params.append(param)
         self._scale = 1.0
+        # ZeRO-1 over the dp mesh axis — same contract as
+        # Module(zero_stage=1) (docs/design/kvstore.md): optimizer states
+        # (+ fp32 masters) live dp-sharded; GSPMD schedules the
+        # reduce-scatter/all-gather inside the fused update.
+        from .. import parallel as _par
+        if mesh is None:
+            mesh = _par.current_mesh()
+        self._mesh = mesh
+        explicit_zero = zero_stage is not None
+        if zero_stage is None:
+            zero_stage = env("MXNET_ZERO_STAGE", 0)
+        if zero_stage not in (0, 1):
+            raise ValueError("zero_stage must be 0 or 1")
+        if explicit_zero and zero_stage >= 1 and mesh is None:
+            raise MXNetError(
+                "zero_stage=1 needs a device mesh with dp>1 — pass "
+                "mesh= (parallel.make_mesh) or enter a use_mesh scope")
+        self._zero_stage = int(zero_stage)
+        self._zero_dp = (_par.mesh_shape(mesh).get("dp", 1)
+                         if mesh is not None else 1)
         optimizer_params = dict(optimizer_params or {})
         self._init_optimizer(optimizer, optimizer_params)
         self._kv_type = kvstore
@@ -97,9 +118,48 @@ class Trainer:
             if fuse and not isinstance(grad, RowSparseNDArray):
                 fused_batch.append((i, param, grad))
             else:
+                if self._zero_stage >= 1 and self._zero_dp > 1 \
+                        and not getattr(self, "_zero_eager_warned", False):
+                    self._zero_eager_warned = True
+                    import warnings
+                    warnings.warn(
+                        "zero_stage=1 requested but parameter "
+                        f"{param.name!r} updates on the eager path "
+                        "(sparse grad, non-pure optimizer, or bulk-exec "
+                        "disabled) — its optimizer state is NOT sharded",
+                        stacklevel=2)
                 updater(i, grad, param.data())
         if fused_batch:
             self._fused_update(fused_batch, updater)
+
+    def _zero_pspec(self, arr):
+        """Delegates to the shared rule in parallel.sharding (one source
+        of truth with Module)."""
+        from .. import parallel as _par
+        return _par.zero_pspec(arr, self._zero_dp)
+
+    def _zero_shard_state(self, state):
+        import jax
+        from jax.sharding import NamedSharding
+        for s in self._optimizer._state_tuple(state):
+            if s is None:
+                continue
+            s._set_data(jax.device_put(
+                s._data, NamedSharding(self._mesh, self._zero_pspec(s))))
+
+    def _zero_check_placed(self, batch, ws):
+        """ZeRO-1 shards states onto the mesh, so the params must already
+        live there (net.collect_params().place(mesh)) — otherwise jit
+        fails with an opaque 'incompatible devices' error; fail clearly
+        instead."""
+        for (_i, p, _g), w in zip(batch, ws):
+            if getattr(getattr(w, "sharding", None), "mesh", None) \
+                    != self._mesh:
+                raise MXNetError(
+                    f"zero_stage=1: parameter {p.name!r} is not placed "
+                    "on the trainer's mesh — call "
+                    "net.collect_params().place(mesh) (and dp-shard the "
+                    "input batch) before training")
 
     def _fused_update(self, batch, updater):
         """Apply the optimizer to every (dense) param in ONE jit call
@@ -114,31 +174,57 @@ class Trainer:
         (a smaller final batch) each compile once.
         """
         opt = self._optimizer
+        zero1 = self._zero_stage >= 1 and self._zero_dp > 1
         for i, param, _g in batch:
             if i not in updater.states:
                 updater.states[i] = \
                     opt.create_state_multi_precision(i, param.data())
                 updater.states_synced[i] = True
+                if zero1:
+                    self._zero_shard_state(updater.states[i])
             opt._update_count(i)
         needs_t = getattr(opt, "needs_t", False)
         states = [opt._state_tuple(updater.states[i]) for i, _p, _g in batch]
         use_mp = tuple(opt.mp_states_active(p.data(), st)
                        for (_i, p, _g), st in zip(batch, states))
+        ws = tuple(p._data._data for _i, p, _g in batch)
+        gs = tuple(g._data for _i, _p, g in batch)
+        sts = tuple(tuple(s._data for s in st) for st in states)
+        if zero1:
+            self._zero_check_placed(batch, ws)
+            # params keep their CURRENT sharding (captured from the live
+            # arrays — gluon has no rules engine; replicated unless the
+            # user sharded them), states stay dp-sharded.  The specs join
+            # the cache key so a placement change retraces the constraint.
+            from jax.sharding import PartitionSpec as _P
+            param_specs = tuple(
+                getattr(w.sharding, "spec", _P()) for w in ws)
+        else:
+            param_specs = None
         key = (tuple(i for i, _p, _g in batch), use_mp, needs_t,
-               opt.hyperparam_signature())
+               opt.hyperparam_signature(), zero1, param_specs)
         cache = getattr(self, "_fused_cache", None)
         if cache is None:
             cache = self._fused_cache = {}
         fn = cache.get(key)
         if fn is None:
             def fused(ws, gs, sts, lrs, wds, ts):
-                return opt.apply_fused(ws, gs, sts, lrs, wds, use_mp,
-                                       ts=ts if needs_t else None)
+                new_ws, new_sts = opt.apply_fused(
+                    ws, gs, sts, lrs, wds, use_mp,
+                    ts=ts if needs_t else None)
+                if zero1:
+                    from jax.sharding import NamedSharding
+                    from .. import parallel as _par
+                    mesh = self._mesh
+                    new_ws = tuple(
+                        jax.lax.with_sharding_constraint(
+                            w, NamedSharding(mesh, ps))
+                        for w, ps in zip(new_ws, param_specs))
+                    new_sts = _par.constrain_zero_states(
+                        new_sts, mesh, self._zero_dp)
+                return new_ws, new_sts
 
             fn = cache[key] = jax.jit(fused)
-        ws = tuple(p._data._data for _i, p, _g in batch)
-        gs = tuple(g._data for _i, _p, g in batch)
-        sts = tuple(tuple(s._data for s in st) for st in states)
         # cache lr/wd device scalars while unchanged (per-step host→device
         # scalar transfers would reintroduce the round-trips this path
         # removes — same discipline as Module._lrwd_cache)
@@ -185,3 +271,8 @@ class Trainer:
     def load_states(self, fname):
         with open(fname, 'rb') as fin:
             self._updaters[0].set_states(fin.read())
+        if self._zero_stage >= 1 and self._zero_dp > 1:
+            # restored buffers land unsharded — re-apply ZeRO placement
+            # now, not at the first step, to avoid the O(P) peak
+            for st in self._updaters[0].states.values():
+                self._zero_shard_state(st)

@@ -75,6 +75,7 @@ class Module(BaseModule):
         # reduce-scatter(grads) → sharded update → all-gather(params)
         # schedule inside the one fused step.  Opt-in: zero_stage=1 or
         # MXNET_ZERO_STAGE=1.
+        explicit_zero = zero_stage is not None
         if zero_stage is None:
             zero_stage = env("MXNET_ZERO_STAGE", 0)
         if zero_stage not in (0, 1):
@@ -82,6 +83,10 @@ class Module(BaseModule):
                              "gradients/params too — not implemented; "
                              "ZeRO-1 covers the optimizer-state memory, "
                              "which dominates for Adam-family training)")
+        if explicit_zero and zero_stage >= 1 and mesh is None:
+            raise MXNetError(
+                "zero_stage=1 needs a device mesh with dp>1 — pass "
+                "mesh= (parallel.make_mesh) or enter a use_mesh scope")
         self._zero_stage = int(zero_stage)
 
         self._symbol = symbol
@@ -402,13 +407,10 @@ class Module(BaseModule):
                 if self._grad_req.get(n, 'null') != 'null']
 
     def _zero_pspec(self, arr):
-        """ZeRO-1 partition spec for one optimizer-state array: shard the
-        leading dim over dp when divisible, else replicate (tiny biases
-        aren't worth a ragged shard)."""
-        from jax.sharding import PartitionSpec as P
-        if arr.ndim and arr.shape[0] % self._zero_dp() == 0:
-            return P(*(("dp",) + (None,) * (arr.ndim - 1)))
-        return P()
+        """ZeRO-1 partition spec (delegates to the shared rule in
+        parallel.sharding so Module and Trainer cannot diverge)."""
+        from .. import parallel as _par
+        return _par.zero_pspec(arr, self._zero_dp())
 
     def _zero_dp(self):
         from .. import parallel as _par
@@ -634,12 +636,8 @@ class Module(BaseModule):
                     jax.lax.with_sharding_constraint(
                         w, NamedSharding(mesh_, ps))
                     for w, ps in zip(new_params, param_pspecs))
-                new_states = tuple(
-                    tuple(s if s is None else
-                          jax.lax.with_sharding_constraint(
-                              s, NamedSharding(mesh_, self._zero_pspec(s)))
-                          for s in st)
-                    for st in new_states)
+                new_states = _par.constrain_zero_states(
+                    new_states, mesh_, self._zero_dp())
             return outs, new_aux, tuple(new_params), tuple(new_states)
 
         # Donate the buffers the step replaces — params, aux (BN stats),

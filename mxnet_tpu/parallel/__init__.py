@@ -21,7 +21,7 @@ Axes (all always present; unused axes have size 1):
 """
 from .mesh import (AXES, make_mesh, current_mesh, use_mesh, mesh_shape,
                    data_pspec, replicated, named_sharding)
-from .sharding import (ShardingRules, infer_pspec, shard_params,
+from .sharding import (ShardingRules, infer_pspec, shard_params, zero_pspec, constrain_zero_states,
                        shard_batch, tp_rules_for_symbol)
 from .ring import ring_attention, shard_seq
 from .ulysses import ulysses_attention

@@ -116,3 +116,29 @@ def tp_rules_for_symbol(symbol, mesh: Mesh) -> ShardingRules:
                     rules.add(f"^{re.escape(src.name)}$",
                               P("tp", None, None, None))
     return rules
+
+
+def zero_pspec(arr, dp):
+    """ZeRO-1 placement for one optimizer-state array: shard the leading
+    dim over dp when divisible, else replicate (tiny/ragged buffers are
+    not worth a padded shard).  Single source of truth for Module and
+    gluon Trainer — the two fused update paths must never diverge on
+    this rule."""
+    if arr.ndim and arr.shape[0] % dp == 0:
+        return P(*(("dp",) + (None,) * (arr.ndim - 1)))
+    return P()
+
+
+def constrain_zero_states(new_states, mesh, dp):
+    """Inside a fused-update trace: pin every optimizer-state output to
+    its ZeRO-1 sharding (None slots pass through).  GSPMD then schedules
+    reduce-scatter(grads) -> sharded math -> (params' own constraint
+    decides the gather)."""
+    import jax
+    from jax.sharding import NamedSharding
+    return tuple(
+        tuple(s if s is None else
+              jax.lax.with_sharding_constraint(
+                  s, NamedSharding(mesh, zero_pspec(s, dp)))
+              for s in st)
+        for st in new_states)

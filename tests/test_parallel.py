@@ -240,3 +240,85 @@ def test_zero1_preserves_tp_sharding():
     for k in ref:
         np.testing.assert_allclose(args[k].asnumpy(), ref[k],
                                    rtol=2e-5, atol=2e-5, err_msg=k)
+
+
+def test_zero1_gluon_trainer():
+    """Gluon Trainer(zero_stage=1): same numerics as the replicated
+    trainer; Adam moments + fp32 masters live dp-sharded."""
+    from mxnet_tpu import gluon, autograd, nd
+
+    def build():
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(8))
+        net.initialize(mx.initializer.Xavier(rnd_type="gaussian",
+                                             magnitude=2.0))
+        return net
+
+    def run(zero, on_mesh=True):
+        mx.random.seed(21)
+        mesh = par.make_mesh()  # dp=8
+        net = build()
+        rng = np.random.RandomState(4)
+        x = nd.array(rng.randn(32, 10).astype(np.float32))
+        y = nd.array(rng.randint(0, 8, (32,)).astype(np.float32))
+        if on_mesh:
+            import jax
+            from jax.sharding import NamedSharding
+            net(x[:1])  # materialize deferred shapes
+            net.collect_params().place(mesh)
+            x._set_data(jax.device_put(x._data,
+                                       NamedSharding(mesh, P("dp"))))
+            y._set_data(jax.device_put(y._data,
+                                       NamedSharding(mesh, P("dp"))))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-2},
+                           mesh=mesh, zero_stage=zero)
+        for _ in range(4):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(32)
+        # auto-naming increments across instantiations (dense0 vs dense2)
+        # — compare positionally
+        return ([v.data().asnumpy()
+                 for v in net.collect_params().values()], tr)
+
+    ref, _ = run(0, on_mesh=False)
+    got, tr = run(1)
+    assert len(ref) == len(got)
+    for i, (r, g) in enumerate(zip(ref, got)):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5,
+                                   err_msg=str(i))
+    # telltale: at least one adam moment is dp-sharded
+    sharded = 0
+    for st in tr._updaters[0].states.values():
+        for s in tr._optimizer._state_tuple(st):
+            if s is None:
+                continue
+            spec = tuple(s._data.sharding.spec)
+            if spec[:1] == ("dp",):
+                sharded += 1
+    assert sharded >= 2
+
+
+def test_zero1_requires_mesh_and_placement():
+    from mxnet_tpu import gluon
+    # explicit zero_stage without any mesh -> clear error
+    with pytest.raises(mx.MXNetError, match="needs a device mesh"):
+        mx.mod.Module(_mlp(), zero_stage=1)
+    # params not placed on the mesh -> clear error at step, not a
+    # cryptic jit device mismatch
+    mesh = par.make_mesh()
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    from mxnet_tpu import autograd, nd
+    x = nd.array(np.zeros((8, 3), np.float32))
+    with autograd.record():
+        out = net(x)
+    out.backward()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, mesh=mesh, zero_stage=1)
+    with pytest.raises(mx.MXNetError, match="place"):
+        tr.step(8)
