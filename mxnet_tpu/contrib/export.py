@@ -1,0 +1,205 @@
+"""AOT model export — serialized StableHLO deployment artifacts.
+
+The reference shipped "amalgamation": a predict-only runtime concatenated
+into one .cc for phones/JS (amalgamation/README.md) plus the C predict
+API it fed.  The TPU-native deployment story (docs/design/scope.md) is
+ahead-of-time compilation instead: this module freezes a trained
+checkpoint into ONE portable artifact — params baked in as constants,
+graph lowered to versioned StableHLO via ``jax.export`` — loadable and
+runnable anywhere jax runs (CPU server, TPU pod), with no mxnet_tpu, no
+symbol JSON, and no Python graph machinery needed at serve time beyond
+this loader.
+
+Artifact layout (.mxtpu_aot): magic, u32 header length, JSON header
+(input names/shapes/dtypes, platforms, framework version), then the
+``jax.export`` serialization.
+
+    from mxnet_tpu.contrib import export as aot
+    aot.export_checkpoint("model", 10, [("data", (8, 3, 224, 224))],
+                          "resnet.mxtpu_aot")
+    m = aot.load("resnet.mxtpu_aot")
+    logits = m(batch)          # numpy in, numpy out
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from ..base import MXNetError
+
+_MAGIC = b"MXTPUAOT"
+_VERSION = 1
+
+
+def export_symbol(symbol, arg_params, aux_params, data_shapes, path,
+                  platforms=("cpu", "tpu"), compute_dtype=None):
+    """Freeze ``symbol`` + params into a serialized StableHLO artifact.
+
+    ``data_shapes``: list of (name, shape) for the runtime inputs.  Any
+    symbol argument that is neither a runtime input nor in
+    ``arg_params`` and looks like a loss-head label is bound to zeros
+    (same convention as the C-ABI Predictor, capi_impl._Predictor).
+
+    ``platforms``: lowering targets baked into the artifact.  Multi-
+    platform export covers "compile on the serving host, whatever it
+    is"; if a platform's lowering rules reject the graph, it is dropped
+    with a warning (at least one must survive).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    from ..executor import build_interpreter
+
+    run, arg_names, aux_names = build_interpreter(
+        symbol, compute_dtype=compute_dtype)
+    input_names = [n for n, _s in data_shapes]
+    shapes = {n: tuple(int(d) for d in s) for n, s in data_shapes}
+    batch = next(iter(shapes.values()))[0] if shapes else 1
+
+    known = set(input_names) | set(arg_params)
+    fills = {}
+    for n in arg_names:
+        if n not in known:
+            if n.endswith("label"):
+                fills[n] = jnp.zeros((batch,), jnp.float32)
+            else:
+                raise MXNetError(
+                    f"export: symbol argument {n!r} is neither a runtime "
+                    "input nor in arg_params")
+
+    const_args = {n: jnp.asarray(getattr(v, "_data", v))
+                  for n, v in arg_params.items() if n in set(arg_names)}
+    missing_aux = [n for n in aux_names if n not in aux_params]
+    if missing_aux:
+        raise MXNetError(f"export: aux params missing from checkpoint: "
+                         f"{missing_aux}")
+    aux_vals = tuple(jnp.asarray(getattr(aux_params[n], "_data",
+                                         aux_params[n]))
+                     for n in aux_names)
+    key = jax.random.PRNGKey(0)  # inference: RNG ops run in eval mode
+    input_pos = {n: i for i, n in enumerate(input_names)}
+
+    def fn(*inputs):
+        # inputs arrive in data_shapes order (= specs/header order);
+        # map by NAME into symbol-argument order
+        vals = []
+        for n in arg_names:
+            if n in input_pos:
+                vals.append(inputs[input_pos[n]])
+            elif n in const_args:
+                vals.append(const_args[n])
+            else:
+                vals.append(fills[n])
+        outs, _new_aux = run(tuple(vals), aux_vals, key, False)
+        return tuple(outs)
+
+    specs = [jax.ShapeDtypeStruct(shapes[n], jnp.float32)
+             for n in input_names]
+
+    def try_export(cand):
+        return jexport.export(jax.jit(fn), platforms=cand)(*specs)
+
+    try:
+        exp = try_export(list(platforms))
+        plats = list(platforms)
+    except Exception as first_err:  # noqa: BLE001
+        # Per-platform lowering gaps: keep every platform that lowers on
+        # its own, then export once with that subset.  A failure on the
+        # surviving subset (or an empty subset) is a genuine graph
+        # problem — report the ORIGINAL multi-platform error.
+        plats = []
+        for p in platforms:
+            try:
+                try_export([p])
+                plats.append(p)
+            except Exception:  # noqa: BLE001
+                pass
+        if not plats:
+            raise MXNetError(
+                f"export failed for all of {platforms}: {first_err}"
+            ) from first_err
+        try:
+            exp = try_export(plats)
+        except Exception:  # noqa: BLE001
+            raise MXNetError(
+                f"export failed (platforms {list(platforms)}): "
+                f"{first_err}") from first_err
+        import warnings
+        warnings.warn("export: lowered for %s only (requested %s)"
+                      % (plats, list(platforms)), stacklevel=2)
+
+    header = {
+        "version": _VERSION,
+        "inputs": [{"name": n, "shape": list(shapes[n]),
+                    "dtype": "float32"} for n in input_names],
+        "platforms": plats,
+        "num_outputs": len(symbol.list_outputs()),
+        "output_names": symbol.list_outputs(),
+    }
+    blob = exp.serialize()
+    hjson = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(hjson)))
+        f.write(hjson)
+        f.write(blob)
+    return header
+
+
+def export_checkpoint(prefix, epoch, data_shapes, path,
+                      platforms=("cpu", "tpu"), compute_dtype=None):
+    """Checkpoint files (prefix-symbol.json + prefix-NNNN.params) →
+    artifact (reference deployment flow: save_checkpoint → amalgamated
+    predictor; here → StableHLO)."""
+    from .. import model as model_mod
+    symbol, arg_params, aux_params = model_mod.load_checkpoint(prefix,
+                                                               epoch)
+    if symbol is None:
+        raise MXNetError(f"no symbol JSON at {prefix}-symbol.json")
+    return export_symbol(symbol, arg_params, aux_params, data_shapes,
+                         path, platforms=platforms,
+                         compute_dtype=compute_dtype)
+
+
+class ExportedModel:
+    """Loaded artifact: numpy in → numpy out via ``jax.export`` call."""
+
+    def __init__(self, header, exported):
+        self.header = header
+        self._exp = exported
+        self.input_names = [i["name"] for i in header["inputs"]]
+        self.output_names = header.get("output_names")
+        import jax
+        self._call = jax.jit(exported.call)  # jit ONCE; per-call
+        # re-wrapping would miss the jit cache and retrace every request
+
+    def __call__(self, *inputs):
+        want = self.header["inputs"]
+        if len(inputs) != len(want):
+            raise MXNetError("expected %d inputs %r, got %d"
+                             % (len(want), self.input_names, len(inputs)))
+        args = []
+        for spec, v in zip(want, inputs):
+            a = np.asarray(getattr(v, "_data", v), dtype=spec["dtype"])
+            if list(a.shape) != spec["shape"]:
+                raise MXNetError("input %r: shape %r != exported %r"
+                                 % (spec["name"], list(a.shape),
+                                    spec["shape"]))
+            args.append(a)
+        outs = self._call(*args)
+        return [np.asarray(o) for o in outs]
+
+
+def load(path):
+    from jax import export as jexport
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise MXNetError(f"{path}: not a .mxtpu_aot artifact")
+        (hlen,) = struct.unpack("<I", f.read(4))
+        header = json.loads(f.read(hlen).decode())
+        blob = f.read()
+    exp = jexport.deserialize(blob)
+    return ExportedModel(header, exp)

@@ -1,0 +1,117 @@
+"""AOT export (contrib/export.py): StableHLO deployment artifacts.
+
+The TPU-native replacement for the reference's amalgamation predict-only
+build (amalgamation/README.md; docs/design/scope.md records the
+mapping).  Pins: round-trip equivalence vs the live Module forward,
+multi-platform lowering (cpu+tpu from a CPU-only host), label-arg
+auto-fill, and loader validation errors.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import model as mx_model
+from mxnet_tpu.contrib import export as aot
+from mxnet_tpu.io import DataBatch
+
+
+def _tiny_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3),
+                             pad=(1, 1), name="conv")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=5,
+                                name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+@pytest.fixture()
+def checkpoint(tmp_path):
+    net = _tiny_net()
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (2, 3, 8, 8))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(5)
+    mod.init_params(mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "m")
+    mx_model.save_checkpoint(prefix, 3, net, arg, aux)
+    return prefix, mod
+
+
+def test_export_roundtrip_matches_forward(checkpoint, tmp_path):
+    prefix, mod = checkpoint
+    path = str(tmp_path / "m.mxtpu_aot")
+    header = aot.export_checkpoint(prefix, 3, [("data", (2, 3, 8, 8))],
+                                   path)
+    # multi-platform: the artifact must carry a TPU lowering even though
+    # this host exports on CPU — that is the whole deployment story
+    assert "cpu" in header["platforms"] and "tpu" in header["platforms"]
+    assert header["num_outputs"] == 1
+
+    m = aot.load(path)
+    x = np.random.RandomState(1).uniform(-1, 1, (2, 3, 8, 8)) \
+        .astype(np.float32)
+    got = m(x)[0]
+    mod.forward(DataBatch(data=[mx.nd.array(x)],
+                          label=[mx.nd.zeros((2,))]), is_train=False)
+    want = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_artifact_is_self_contained(checkpoint, tmp_path):
+    """Loader needs only the artifact file — delete the checkpoint."""
+    prefix, mod = checkpoint
+    path = str(tmp_path / "m.mxtpu_aot")
+    aot.export_checkpoint(prefix, 3, [("data", (2, 3, 8, 8))], path)
+    x = np.random.RandomState(2).uniform(-1, 1, (2, 3, 8, 8)) \
+        .astype(np.float32)
+    mod.forward(DataBatch(data=[mx.nd.array(x)],
+                          label=[mx.nd.zeros((2,))]), is_train=False)
+    want = mod.get_outputs()[0].asnumpy()
+    for f in os.listdir(os.path.dirname(path)):
+        if not f.endswith(".mxtpu_aot"):
+            os.unlink(os.path.join(os.path.dirname(path), f))
+    got = aot.load(path)(x)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_loader_validates(checkpoint, tmp_path):
+    prefix, _mod = checkpoint
+    path = str(tmp_path / "m.mxtpu_aot")
+    aot.export_checkpoint(prefix, 3, [("data", (2, 3, 8, 8))], path)
+    m = aot.load(path)
+    with pytest.raises(mx.MXNetError, match="shape"):
+        m(np.zeros((1, 3, 8, 8), np.float32))
+    with pytest.raises(mx.MXNetError, match="expected 1"):
+        m(np.zeros((2, 3, 8, 8), np.float32),
+          np.zeros((2,), np.float32))
+    bad = str(tmp_path / "bad.bin")
+    with open(bad, "wb") as f:
+        f.write(b"not an artifact")
+    with pytest.raises(mx.MXNetError, match="not a .mxtpu_aot"):
+        aot.load(bad)
+
+
+def test_export_missing_param_errors(tmp_path):
+    net = _tiny_net()
+    with pytest.raises(mx.MXNetError, match="neither a runtime input"):
+        aot.export_symbol(net, {}, {}, [("data", (2, 3, 8, 8))],
+                          str(tmp_path / "x.mxtpu_aot"))
+
+
+def test_export_multi_input_name_binding(tmp_path):
+    """Inputs bind by NAME: exporting with data_shapes in the reverse of
+    symbol-argument order must still route each tensor to its variable."""
+    a = mx.sym.Variable("in_a")
+    b = mx.sym.Variable("in_b")
+    net = mx.sym.Group([2 * a + b])  # asymmetric: swapping inputs changes it
+    path = str(tmp_path / "mi.mxtpu_aot")
+    aot.export_symbol(net, {}, {}, [("in_b", (4,)), ("in_a", (4,))], path)
+    m = aot.load(path)
+    xb = np.full((4,), 1.0, np.float32)
+    xa = np.full((4,), 10.0, np.float32)
+    (out,) = m(xb, xa)  # artifact order = data_shapes order: in_b, in_a
+    np.testing.assert_allclose(out, 2 * xa + xb)

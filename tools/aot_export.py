@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Checkpoint → AOT deployment artifact (see mxnet_tpu/contrib/export.py).
+
+The deployment-tooling analog of the reference's amalgamation build
+(amalgamation/README.md): one command turns prefix-symbol.json +
+prefix-NNNN.params into a single self-contained .mxtpu_aot file
+(StableHLO, params baked in, cpu+tpu lowerings).
+
+    python tools/aot_export.py --prefix model --epoch 10 \
+        --shape data:8,3,224,224 --out model.mxtpu_aot
+    python tools/aot_export.py --run model.mxtpu_aot   # smoke the artifact
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_shape(s):
+    name, dims = s.split(":")
+    return name, tuple(int(d) for d in dims.split(","))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--shape", action="append", default=[],
+                    help="name:d0,d1,... (repeatable)")
+    ap.add_argument("--out")
+    ap.add_argument("--platforms", default="cpu,tpu")
+    ap.add_argument("--compute-dtype", default=None,
+                    help="e.g. bfloat16 for TPU-preferred inference")
+    ap.add_argument("--run", metavar="ARTIFACT",
+                    help="load an artifact and run zeros through it")
+    a = ap.parse_args()
+
+    if a.run:
+        from cpu_pin import pin_cpu
+        pin_cpu(1)
+        import numpy as np
+        from mxnet_tpu.contrib import export as aot
+        m = aot.load(a.run)
+        xs = [np.zeros(i["shape"], i["dtype"]) for i in m.header["inputs"]]
+        outs = m(*xs)
+        for name, o in zip(m.output_names or [], outs):
+            print(name, o.shape, o.dtype)
+        return 0
+
+    if not (a.prefix and a.shape and a.out):
+        ap.error("--prefix, --shape and --out are required (or --run)")
+    from cpu_pin import pin_cpu
+    pin_cpu(1)
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import export as aot
+    cd = getattr(jnp, a.compute_dtype) if a.compute_dtype else None
+    header = aot.export_checkpoint(
+        a.prefix, a.epoch, [parse_shape(s) for s in a.shape], a.out,
+        platforms=tuple(a.platforms.split(",")), compute_dtype=cd)
+    print("wrote %s (%d bytes, platforms=%s)"
+          % (a.out, os.path.getsize(a.out), header["platforms"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
