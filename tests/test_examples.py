@@ -118,3 +118,10 @@ def test_generate_lm_example():
                "--d-model", "32", "--seq-len", "12", "--vocab", "30")
     assert "generation done" in log
     assert "generated:" in log
+
+
+def test_zero1_example():
+    out = _run("examples/zero1_train.py", "--epochs", "1",
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "per-chip shard" in out and "done" in out
