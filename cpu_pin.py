@@ -48,3 +48,16 @@ def pin_cpu(n_devices=8, clear_backends=False):
         except Exception:  # noqa: BLE001 — older jax spells this differently
             pass
     return jax
+
+
+def pin_if_cpu(n_devices=None):
+    """Apply :func:`pin_cpu` iff the caller's environment selects the CPU
+    platform (JAX_PLATFORMS=cpu[,...]).  The shared guard for every
+    directly-runnable entry point (examples, tools, __graft_entry__,
+    the embedded C ABI): with the axon tunnel plugin registered, backend
+    init can block on a dead relay even when cpu is selected, so the
+    factory must be stripped BEFORE the first jax touch."""
+    import os
+    if os.environ.get("JAX_PLATFORMS",
+                      "").strip().lower().split(",")[0] == "cpu":
+        pin_cpu(n_devices)

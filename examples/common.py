@@ -12,6 +12,10 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 '..'))
 
+from cpu_pin import pin_if_cpu
+pin_if_cpu()  # strip the axon factory before any jax touch when the
+# caller selected CPU — otherwise a dead tunnel hangs backend init
+
 import mxnet_tpu as mx
 
 

@@ -20,13 +20,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 '..'))
-if os.environ.get("JAX_PLATFORMS",
-                  "").strip().lower().split(",")[0] == "cpu":
-    # strip the axon tunnel factory BEFORE any jax touch — with the
-    # plugin registered, backend init can block on a dead relay even
-    # when cpu is selected (same dance as __graft_entry__/conftest)
-    from cpu_pin import pin_cpu  # noqa: E402
-    pin_cpu(n_devices=None)
+from cpu_pin import pin_if_cpu  # noqa: E402
+pin_if_cpu()
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import parallel as par  # noqa: E402
 

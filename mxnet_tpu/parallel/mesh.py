@@ -27,15 +27,74 @@ AXES = ("dp", "pp", "sp", "ep", "tp")
 _state = threading.local()
 
 
+def _topology_device_array(shape: Dict[str, int], devices):
+    """Arrange ``devices`` so mesh axes map onto the physical topology:
+    trailing axes (tp innermost) get ICI-adjacent chips, and on
+    multi-slice systems the dp axis carries the DCN hop.
+
+    The naive ``reshape(jax.devices())`` is only correct when device
+    enumeration order happens to match the torus wiring — on real pods
+    it often doesn't, and a tp ring that hops across the torus (or
+    across DCN!) turns every tensor-parallel matmul into a slow
+    collective.  jax's ``mesh_utils`` owns the physical-topology logic
+    (the T5X/scaling-book recipe); every failure falls back to plain
+    reshape so CPU meshes and exotic backends keep working.
+    """
+    shape_l = [shape[a] for a in AXES]
+    try:
+        from jax.experimental import mesh_utils
+    except ImportError:
+        return np.array(devices).reshape(shape_l)
+    import logging
+    log = logging.getLogger(__name__)
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    nslices = len(slice_ids)
+    if nslices > 1:
+        if shape["dp"] % nslices == 0:
+            # multi-slice (DCN between slices): outermost dp spans
+            # slices, everything else stays inside a slice on ICI
+            dcn = [nslices if a == "dp" else 1 for a in AXES]
+            per = [s // d for s, d in zip(shape_l, dcn)]
+            try:
+                return mesh_utils.create_hybrid_device_mesh(
+                    per, dcn, devices=devices)
+            except Exception as e:  # noqa: BLE001
+                log.warning(
+                    "make_mesh: hybrid DCN/ICI arrangement failed (%s); "
+                    "trying flat topology arrangement", e)
+        else:
+            log.warning(
+                "make_mesh: %d slices but dp=%d not divisible — a "
+                "non-dp axis will span DCN; expect slow inner-axis "
+                "collectives", nslices, shape["dp"])
+    try:
+        return mesh_utils.create_device_mesh(shape_l, devices=devices)
+    except Exception as e:  # noqa: BLE001 — e.g. virtual/mock topologies
+        if nslices > 1:
+            # on a real multi-slice system this is the pathological
+            # layout the arranger exists to avoid — say so loudly
+            log.warning(
+                "make_mesh: topology arrangement failed (%s); falling "
+                "back to enumeration-order reshape — inner mesh axes "
+                "may span DCN", e)
+        return np.array(devices).reshape(shape_l)
+
+
 def make_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1,
               sp: int = 1, ep: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a Mesh over ``devices`` (default: all of them).
+    """Build a Mesh over ``devices`` (default: all, topology-arranged).
 
     ``dp=None`` means "whatever is left over": dp = ndev // (tp*pp*sp*ep).
     Every axis is always present (size-1 axes are free), so PartitionSpecs
     written against :data:`AXES` work on any mesh shape.
+
+    When ``devices`` is omitted the device array is arranged for the
+    physical topology (ICI for inner axes, DCN for dp across slices —
+    see :func:`_topology_device_array`); an explicit ``devices`` list is
+    taken as-is in order (tests and manual layouts rely on that).
     """
+    explicit = devices is not None
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
@@ -50,7 +109,10 @@ def make_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1,
         raise MXNetError(
             f"make_mesh: dp*tp*pp*sp*ep={dp * rest} != num devices {ndev}")
     shape = {"dp": dp, "pp": pp, "sp": sp, "ep": ep, "tp": tp}
-    arr = np.array(devices).reshape([shape[a] for a in AXES])
+    if explicit:
+        arr = np.array(devices).reshape([shape[a] for a in AXES])
+    else:
+        arr = _topology_device_array(shape, devices)
     return Mesh(arr, AXES)
 
 

@@ -322,3 +322,18 @@ def test_zero1_requires_mesh_and_placement():
                        {"learning_rate": 0.1}, mesh=mesh, zero_stage=1)
     with pytest.raises(mx.MXNetError, match="place"):
         tr.step(8)
+
+
+def test_make_mesh_topology_arrangement():
+    """Default make_mesh routes through the topology arranger (all 8
+    devices present exactly once, correct axis sizes); explicit device
+    lists are taken in order."""
+    mesh = par.make_mesh(tp=2)
+    assert par.mesh_shape(mesh) == {"dp": 4, "pp": 1, "sp": 1, "ep": 1,
+                                    "tp": 2}
+    ids = sorted(d.id for d in mesh.devices.flat)
+    assert ids == sorted(d.id for d in jax.devices())
+
+    devs = list(jax.devices())
+    mesh2 = par.make_mesh(dp=8, devices=devs)
+    assert [d.id for d in mesh2.devices.flat] == [d.id for d in devs]
