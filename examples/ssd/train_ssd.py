@@ -17,8 +17,6 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 '..'))
 import common  # noqa: E402
-from cpu_pin import pin_if_cpu  # noqa: E402
-pin_if_cpu()
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import models  # noqa: E402
 from mxnet_tpu.image.detection import pack_det_dataset  # noqa: E402

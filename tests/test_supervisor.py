@@ -1,0 +1,145 @@
+"""tools/train_supervisor.py: crash → relaunch-from-latest-checkpoint.
+
+Extends the in-process kill-and-resume trajectory test
+(tests/test_checkpoint.py) across a real process boundary: the child
+training script crashes mid-run, the supervisor relaunches it with
+--load-epoch <latest>, and the finished run's params match an
+uninterrupted run exactly (the reference's analog was PS recovery mode,
+kvstore_dist.h:55).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A self-contained crashy trainer: 4 epochs, checkpoint every epoch,
+# os._exit(1) right after saving epoch 2 — but only when no checkpoint
+# existed at startup (so the relaunch gets past it).
+_CHILD = """
+import argparse, os, sys
+sys.path.insert(0, %(root)r)
+from cpu_pin import pin_cpu
+pin_cpu(1)
+import numpy as np
+import mxnet_tpu as mx
+
+ap = argparse.ArgumentParser()
+ap.add_argument('--model-prefix', required=True)
+ap.add_argument('--load-epoch', type=int, default=None)
+ap.add_argument('--crash-after-epoch', type=int, default=None)
+a = ap.parse_args()
+
+mx.random.seed(11); np.random.seed(11)
+rs = np.random.RandomState(0)
+X = rs.randn(120, 6).astype(np.float32)
+Y = rs.randint(0, 4, (120,)).astype(np.float32)
+
+net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+    mx.sym.Variable('data'), num_hidden=4, name='fc'), name='softmax')
+mod = mx.mod.Module(net, context=mx.cpu())
+
+arg = aux = None
+begin = 0
+if a.load_epoch is not None:
+    _s, arg, aux = mx.model.load_checkpoint(a.model_prefix, a.load_epoch)
+    begin = a.load_epoch
+
+fresh = a.load_epoch is None
+cbs = [mx.callback.do_checkpoint(a.model_prefix)]
+if a.crash_after_epoch is not None and fresh:
+    # runs AFTER do_checkpoint in the callback list: the checkpoint for
+    # this epoch is already on disk when we die
+    def crash_cb(epoch, symbol, argp, auxp):
+        if epoch + 1 == a.crash_after_epoch:
+            os._exit(1)
+    cbs.append(crash_cb)
+
+it = mx.io.NDArrayIter(X, Y, batch_size=30)
+mod.fit(it, num_epoch=4, begin_epoch=begin,
+        arg_params=arg, aux_params=aux,
+        optimizer='sgd',
+        optimizer_params={'learning_rate': 0.1},
+        initializer=mx.initializer.Xavier(),
+        epoch_end_callback=cbs)
+"""
+
+
+def _run_child_script(tmp_path):
+    p = tmp_path / "crashy_train.py"
+    p.write_text(_CHILD % {"root": ROOT})
+    return str(p)
+
+
+@pytest.mark.slow
+def test_supervisor_resumes_crashed_run(tmp_path):
+    script = _run_child_script(tmp_path)
+    prefix = str(tmp_path / "ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    # supervised crashy run
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools/train_supervisor.py"),
+         "--prefix", prefix, "--max-restarts", "2", "--backoff", "0.2",
+         "--", sys.executable, script, "--model-prefix", prefix,
+         "--crash-after-epoch", "2"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-1200:]
+    assert "restart 1/2" in r.stderr
+    assert os.path.exists(prefix + "-0004.params")
+
+    # uninterrupted reference run
+    prefix2 = str(tmp_path / "ref")
+    r2 = subprocess.run(
+        [sys.executable, script, "--model-prefix", prefix2],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r2.returncode == 0, r2.stderr[-1200:]
+
+    from mxnet_tpu import model as mx_model
+    import mxnet_tpu  # noqa: F401
+    _s, arg_a, _x = mx_model.load_checkpoint(prefix, 4)
+    _s, arg_b, _x = mx_model.load_checkpoint(prefix2, 4)
+    assert set(arg_a) == set(arg_b)
+    for k in arg_a:
+        np.testing.assert_allclose(arg_a[k].asnumpy(),
+                                   arg_b[k].asnumpy(),
+                                   rtol=1e-6, atol=1e-6, err_msg=k)
+
+
+@pytest.mark.slow
+def test_supervisor_gives_up(tmp_path):
+    always_fail = tmp_path / "fail.py"
+    always_fail.write_text("import sys; sys.exit(3)\n")
+    prefix = str(tmp_path / "nope")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools/train_supervisor.py"),
+         "--prefix", prefix, "--max-restarts", "2", "--backoff", "0.1",
+         "--", sys.executable, str(always_fail)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 75
+    assert "giving up" in r.stderr
+
+
+@pytest.mark.slow
+def test_supervisor_signal_stops_without_relaunch(tmp_path):
+    """SIGTERM to the supervisor tears the run down — no relaunch."""
+    import signal as _signal
+    import time
+    sleeper = tmp_path / "sleeper.py"
+    sleeper.write_text("import time\ntime.sleep(60)\n")
+    prefix = str(tmp_path / "sig")
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tools/train_supervisor.py"),
+         "--prefix", prefix, "--max-restarts", "5", "--backoff", "0.1",
+         "--", sys.executable, str(sleeper)],
+        stderr=subprocess.PIPE, text=True)
+    time.sleep(2.0)
+    p.send_signal(_signal.SIGTERM)
+    rc = p.wait(timeout=30)
+    err = p.stderr.read()
+    assert rc == 128 + _signal.SIGTERM, err[-500:]
+    assert "not relaunching" in err
+    assert "restart 1" not in err

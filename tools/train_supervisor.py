@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Auto-resume training supervisor — the SPMD answer to PS recovery mode.
+
+The reference's fault story was parameter-server level: a restarted node
+rejoins via ``ps::Postoffice`` recovery (kvstore_dist.h:55
+``is_recovery``) while server state survives in the PS.  In an SPMD
+world there is no server holding state — recovery is
+restart-from-checkpoint (docs/design/failure_recovery.md).  This tool
+productizes that: it runs a training command under supervision, and on
+a crash relaunches it from the LATEST checkpoint the run had saved,
+up to --max-restarts times.
+
+Convention (examples/common.py and Module.fit follow it):
+  * the child saves ``<prefix>-%04d.params`` per epoch
+    (``mx.callback.do_checkpoint``)
+  * the child accepts ``--model-prefix`` and ``--load-epoch N`` to
+    resume (identical-trajectory resume is pinned by
+    tests/test_checkpoint.py::test_kill_and_resume_identical_trajectory)
+
+Usage:
+  python tools/train_supervisor.py --prefix ck --max-restarts 3 -- \
+      python examples/image_classification/train_mnist.py \
+      --model-prefix ck --num-epochs 20
+
+The supervisor appends ``--load-epoch <latest>`` on every relaunch when
+checkpoints exist.  Exit code: the child's final exit code (0 on
+success), or 75 if restarts were exhausted.
+"""
+import argparse
+import glob
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+
+def latest_epoch(prefix):
+    """Highest N with <prefix>-<digits>.params on disk, or None.
+    (\\d+, not \\d{4}: do_checkpoint's %04d grows past 4 digits at
+    epoch 10000 and a fixed-width match would silently resume stale.)"""
+    best = None
+    for p in glob.glob("%s-*.params" % prefix):
+        m = re.match(r".*-(\d+)\.params$", p)
+        if m:
+            n = int(m.group(1))
+            best = n if best is None else max(best, n)
+    return best
+
+
+def run_once(cmd, prefix):
+    """Returns (rc, stopped): ``stopped`` means WE were signalled — an
+    intentional teardown, never a reason to relaunch."""
+    ep = latest_epoch(prefix)
+    full = list(cmd)
+    if ep is not None:
+        full += ["--load-epoch", str(ep)]
+    print("[supervisor] launch%s: %s"
+          % ("" if ep is None else " (resume from epoch %d)" % ep,
+             " ".join(full)), file=sys.stderr, flush=True)
+    # own process group so a supervisor signal tears down the whole tree
+    child = subprocess.Popen(full, start_new_session=True)
+    got = {"sig": None}
+
+    def forward(signum, _frame):
+        got["sig"] = signum
+        try:
+            os.killpg(child.pid, signum)
+        except ProcessLookupError:
+            pass
+
+    old = {s: signal.signal(s, forward)
+           for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        rc = child.wait()
+    finally:
+        for s, h in old.items():
+            signal.signal(s, h)
+    return rc, got["sig"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefix", required=True,
+                    help="checkpoint prefix the child writes/reads")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--backoff", type=float, default=5.0,
+                    help="seconds between relaunches")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- <training command>")
+    a = ap.parse_args()
+    cmd = a.cmd[1:] if a.cmd and a.cmd[0] == "--" else a.cmd
+    if not cmd:
+        ap.error("training command required after --")
+
+    restarts = 0
+    while True:
+        rc, stop_sig = run_once(cmd, a.prefix)
+        if stop_sig is not None:
+            print("[supervisor] stopped by signal %d — not relaunching"
+                  % stop_sig, file=sys.stderr, flush=True)
+            return 128 + stop_sig
+        if rc == 0:
+            print("[supervisor] run completed (restarts=%d)" % restarts,
+                  file=sys.stderr, flush=True)
+            return 0
+        if restarts >= a.max_restarts:
+            print("[supervisor] giving up: rc=%d after %d restarts"
+                  % (rc, restarts), file=sys.stderr, flush=True)
+            return 75
+        restarts += 1
+        print("[supervisor] child exited rc=%d; restart %d/%d in %.0fs"
+              % (rc, restarts, a.max_restarts, a.backoff),
+              file=sys.stderr, flush=True)
+        time.sleep(a.backoff)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
