@@ -100,6 +100,10 @@ def _iter_rate(it, max_batches=20):
 _ERR_BASE = {"metric": "resnet50_train_imgs_per_sec", "value": None,
              "unit": "imgs/sec", "vs_baseline": None}
 
+# on failure, attach the most recent banked measurement (clearly
+# labeled, value stays null) — shared with the transformer bench
+from benchmark._bench_common import with_last_good as _with_last_good  # noqa: E402,E501
+
 
 def main():
     batch = BATCH
@@ -114,7 +118,7 @@ def main():
                     batch //= 2
                     continue
                 print(json.dumps(dict(
-                    _ERR_BASE,
+                    _with_last_good(_ERR_BASE),
                     error="OOM even at batch %d: %s" % (batch,
                                                         str(e)[:300]))))
                 return 1
@@ -129,14 +133,14 @@ def _run(batch):
     import jax
     dev, err = guarded_backend_init(_mark)
     if dev is None:
-        print(json.dumps(dict(_ERR_BASE,
+        print(json.dumps(dict(_with_last_good(_ERR_BASE),
                               error="backend init failed: %s" % err)),
               flush=True)
         return 1
     _mark("backend up: %s" % dev.device_kind)
     # a lost tunnel RPC blocks forever with zero CPU — self-bound the run
     # so a parseable error line still lands (BENCH_STALL_DEADLINE_S)
-    start_stall_watchdog(_mark, _ERR_BASE)
+    start_stall_watchdog(_mark, _with_last_good(_ERR_BASE))
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu import models

@@ -57,7 +57,8 @@ def main():
         pin_cpu(1)
     dev, err = guarded_backend_init(_mark, env_prefix="TFB")
     if dev is None:
-        print(json.dumps(dict(_ERR_BASE,
+        from benchmark._bench_common import with_last_good
+        print(json.dumps(dict(with_last_good(_ERR_BASE),
                               error="backend init failed: %s" % err)),
               flush=True)
         return 1
@@ -65,7 +66,9 @@ def main():
     # no tunnel in CPU smoke mode — a long local compile is not a stall
     # (arm anyway when the knob is set explicitly, e.g. for testing)
     if not os.environ.get("TFB_CPU") or os.environ.get("TFB_STALL_DEADLINE_S"):
-        start_stall_watchdog(_mark, _ERR_BASE, env_prefix="TFB")
+        from benchmark._bench_common import with_last_good
+        start_stall_watchdog(_mark, with_last_good(_ERR_BASE),
+                             env_prefix="TFB")
     import jax
     import jax.numpy as jnp
 
