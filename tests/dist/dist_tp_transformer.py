@@ -43,9 +43,16 @@ def main():
     net = models.transformer_lm(V, S, num_layers=1, d_model=64,
                                 num_heads=4)
     rules = par.tp_rules_for_symbol(net, mesh)
+    # DIST_ZERO=1: optimizer state shards over dp — which SPANS the
+    # process boundary here, i.e. true multi-host ZeRO-1 (each process
+    # holds only its addressable half of every Adam moment)
+    zero = int(os.environ.get("DIST_ZERO", "0"))
+    # pass 0 explicitly (not None): None would fall back to an ambient
+    # MXNET_ZERO_STAGE and make the baseline variant env-dependent
     mod = mx.mod.Module(net, mesh=mesh, sharding_rules=rules,
                         data_names=('data',),
-                        label_names=('softmax_label',))
+                        label_names=('softmax_label',),
+                        zero_stage=zero)
 
     # identical data + seed on every process: SPMD requires every process
     # to feed the same GLOBAL batch (each holds its addressable dp shard)
@@ -80,9 +87,22 @@ def main():
     w = args['layer0_qkv_weight'].asnumpy()
     mean_w = dist.allreduce_sum(w) / nproc
     np.testing.assert_allclose(w, mean_w, rtol=1e-5, atol=1e-6)
+    if zero:
+        # each process must hold only its dp shard of a sharded state
+        # (dp=nproc: the shard boundary IS the process boundary)
+        emb_states = mod._opt_states['tok_embed_weight']
+        s = emb_states[-1]._data  # adam v moment, shape (V, d_model)
+        local_rows = sum(sh.data.shape[0] for sh in s.addressable_shards)
+        # tp=4 within the process replicates the dp shard over 4 local
+        # devices; rows-per-shard must be the dp split, not the whole
+        assert all(sh.data.shape[0] == s.shape[0] // nproc
+                   for sh in s.addressable_shards), \
+            [sh.data.shape for sh in s.addressable_shards]
+        assert local_rows == 4 * (s.shape[0] // nproc), local_rows
     dist.barrier()
-    print("dist_tp_transformer rank %d/%d OK ppl %.3f -> %.3f"
-          % (rank, nproc, ppls[0], ppls[-1]), flush=True)
+    print("dist_tp_transformer rank %d/%d OK%s ppl %.3f -> %.3f"
+          % (rank, nproc, " (zero1)" if zero else "",
+             ppls[0], ppls[-1]), flush=True)
 
 
 if __name__ == "__main__":

@@ -15,19 +15,25 @@ pytestmark = pytest.mark.slow
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, *args, timeout=420):
+def _launch(n, script, *args, timeout=420, env_flags=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # each worker is a fresh process: keep it off the single-client TPU
     # tunnel and give it one CPU device
     env.pop("XLA_FLAGS", None)
+    # worker-only env goes through the launcher's own --env mechanism —
+    # mutating this process's os.environ would leak into sibling tests
+    env_args = []
+    for kv in env_flags:
+        env_args += ["--env", kv]
     # own session + group kill on timeout: subprocess.run's kill() SIGKILLs
     # only launch.py, orphaning workers that then hold the output pipes
     # open (communicate() blocks forever) and burn CPU for the rest of the
     # suite — observed as a full-suite hang
     proc = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
-         "-n", str(n), sys.executable, os.path.join(ROOT, script)]
+         "-n", str(n)] + env_args
+        + [sys.executable, os.path.join(ROOT, script)]
         + list(args),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, cwd=ROOT, start_new_session=True)
@@ -108,3 +114,14 @@ def test_dist_tp_transformer_2_workers_4_devices():
     stdout = _launch(2, "tests/dist/dist_tp_transformer.py", timeout=600)
     for r in range(2):
         assert "dist_tp_transformer rank %d/2 OK" % r in stdout
+
+
+def test_dist_zero1_tp_transformer_2_workers():
+    """Multi-host ZeRO-1 rehearsal: the same dp×tp transformer with
+    DIST_ZERO=1 — optimizer state shards over the dp axis that SPANS the
+    process boundary, so each process holds only its half of every Adam
+    moment (asserted in the worker)."""
+    stdout = _launch(2, "tests/dist/dist_tp_transformer.py",
+                     env_flags=["DIST_ZERO=1"], timeout=600)
+    for r in range(2):
+        assert "dist_tp_transformer rank %d/2 OK (zero1)" % r in stdout
