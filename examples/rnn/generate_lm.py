@@ -44,6 +44,8 @@ if __name__ == '__main__':
     ap.add_argument('--num-epochs', type=int, default=25)
     ap.add_argument('--batch-size', type=int, default=32)
     ap.add_argument('--gen-len', type=int, default=12)
+    ap.add_argument('--beam', type=int, default=0,
+                    help='beam size (0 = greedy argmax)')
     ap.add_argument('--synthetic', action='store_true')
     args = ap.parse_args()
 
@@ -67,7 +69,8 @@ if __name__ == '__main__':
             eval_metric=mx.metric.Perplexity(ignore_label=None))
     arg_params, aux_params = mod.get_params()
 
-    B = 4
+    prompts = (np.array([3, 7, 11, 20]) % args.vocab).astype('float32')
+    B = len(prompts) * max(args.beam, 1)
     dec = models.transformer_decode_step(args.vocab, args.seq_len, B, **kw)
     state_names = []
     for i in range(args.num_layers):
@@ -79,15 +82,12 @@ if __name__ == '__main__':
     dmod.init_params(arg_params=arg_params, aux_params=aux_params)
     dmod.set_states(value=0)
 
-    tok = np.array([3., 7., 11., 20.], 'float32') % args.vocab
-    rows = [tok.copy()]
-    for _ in range(args.gen_len):
-        dmod.forward(mx.io.DataBatch([mx.nd.array(tok)], []))
-        res = dmod.get_outputs()
-        dmod.set_states(states=res[1:])
-        tok = res[0].asnumpy().argmax(1).astype('float32')
-        rows.append(tok.copy())
-    gen = np.stack(rows, 1)
-    for r in gen:
-        print('generated:', ' '.join(str(int(t)) for t in r))
+    # beam_size=1 IS greedy (pinned by
+    # test_beam_search_beam1_equals_greedy) — one decode path, no drift
+    seqs, scores = models.beam_search(dmod, prompts, max(args.beam, 1),
+                                      args.gen_len)
+    label = 'beam' if args.beam > 1 else 'greedy'
+    for b in range(len(prompts)):
+        print('generated (%s, score %.3f):' % (label, scores[b, 0]),
+              ' '.join(str(int(t)) for t in seqs[b, 0]))
     print('generation done')

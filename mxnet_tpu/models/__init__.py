@@ -37,6 +37,7 @@ from .squeezenet import get_symbol as squeezenet
 from .ssd import ssd_vgg16, ssd_toy
 from . import ssd as _ssd
 from .transformer import transformer_lm, transformer_decode_step
+from .generation import beam_search
 from . import transformer as _transformer
 from . import densenet as _densenet
 

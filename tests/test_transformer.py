@@ -246,3 +246,114 @@ def test_decode_past_max_len_clamps_not_errors():
     # pos >= max_len-1 see identical pos-embeddings; the logits stay finite
     # and the final cur_pos state keeps counting
     assert float(res[-1].asnumpy()[0]) == L + 3
+
+
+def _decode_module(V, L, batch, kw):
+    dec = models.transformer_decode_step(V, L, batch, **kw)
+    dmod = mx.mod.Module(dec, context=mx.cpu(0), data_names=('data',),
+                         label_names=None,
+                         state_names=['layer0_k_cache', 'layer0_v_cache',
+                                      'cur_pos'])
+    dmod.bind(data_shapes=[('data', (batch,))], for_training=False)
+    return dmod
+
+
+def test_beam_search_beam1_equals_greedy():
+    """beam_size=1 must reproduce the greedy argmax rollout exactly."""
+    V, L = 20, 8
+    kw = dict(num_layers=1, d_model=32, num_heads=4, num_kv_heads=2)
+    B = 3
+    mx.random.seed(5)
+    proto = _decode_module(V, L, B, kw)
+    proto.init_params(mx.initializer.Xavier())
+    arg_params, aux_params = proto.get_params()
+
+    prompts = np.array([2, 7, 11])
+    gen = 6
+
+    # greedy rollout
+    proto.set_states(value=0)
+    tok = prompts.astype('float32')
+    greedy = [prompts.copy()]
+    for _ in range(gen):
+        proto.forward(mx.io.DataBatch([mx.nd.array(tok)], []))
+        res = proto.get_outputs()
+        proto.set_states(states=res[1:])
+        tok = res[0].asnumpy().argmax(1).astype('float32')
+        greedy.append(tok.astype(np.int64))
+    greedy = np.stack(greedy, 1)
+
+    dmod = _decode_module(V, L, B * 1, kw)
+    dmod.init_params(arg_params=arg_params, aux_params=aux_params)
+    seqs, scores = models.beam_search(dmod, prompts, beam_size=1,
+                                      gen_len=gen)
+    np.testing.assert_array_equal(seqs[:, 0, :], greedy)
+    assert np.all(np.isfinite(scores))
+
+
+def _seq_logprob(dmod, seq):
+    """Total log-prob of seq[1:] given seq[0] under the decode module
+    (batch of 1 path through a batch-sized module: replicate)."""
+    B = dmod.data_shapes[0].shape[0]
+    dmod.set_states(value=0)
+    tok = np.full((B,), seq[0], 'float32')
+    total = 0.0
+    for t in range(1, len(seq)):
+        dmod.forward(mx.io.DataBatch([mx.nd.array(tok)], []))
+        res = dmod.get_outputs()
+        dmod.set_states(states=res[1:])
+        logits = res[0].asnumpy()[0]
+        m = logits.max()
+        logp = logits - m - np.log(np.exp(logits - m).sum())
+        total += float(logp[int(seq[t])])
+        tok = np.full((B,), seq[t], 'float32')
+    return total
+
+
+def test_beam_search_beats_or_matches_greedy():
+    """beam_size=3's best sequence log-prob >= greedy's (same model)."""
+    V, L = 20, 8
+    kw = dict(num_layers=1, d_model=32, num_heads=4, num_kv_heads=2)
+    mx.random.seed(9)
+    gen = 5
+    prompts = np.array([4])
+
+    proto = _decode_module(V, L, 1, kw)
+    proto.init_params(mx.initializer.Xavier())
+    arg_params, aux_params = proto.get_params()
+
+    g1 = _decode_module(V, L, 1, kw)
+    g1.init_params(arg_params=arg_params, aux_params=aux_params)
+    s1, _ = models.beam_search(g1, prompts, beam_size=1, gen_len=gen)
+
+    b3 = _decode_module(V, L, 3, kw)
+    b3.init_params(arg_params=arg_params, aux_params=aux_params)
+    s3, sc3 = models.beam_search(b3, prompts, beam_size=3, gen_len=gen,
+                                 length_penalty=0.0)
+    # scores sorted best-first
+    assert sc3[0, 0] >= sc3[0, 1] >= sc3[0, 2]
+
+    scorer = _decode_module(V, L, 1, kw)
+    scorer.init_params(arg_params=arg_params, aux_params=aux_params)
+    lp_greedy = _seq_logprob(scorer, s1[0, 0])
+    lp_beam = _seq_logprob(scorer, s3[0, 0])
+    assert lp_beam >= lp_greedy - 1e-4, (lp_beam, lp_greedy)
+    # beam's own score bookkeeping matches an independent rescoring
+    np.testing.assert_allclose(lp_beam, sc3[0, 0], rtol=1e-4, atol=1e-4)
+
+
+def test_beam_search_eos_pins_finished():
+    V, L = 12, 8
+    kw = dict(num_layers=1, d_model=16, num_heads=2, num_kv_heads=2)
+    mx.random.seed(3)
+    dmod = _decode_module(V, L, 2 * 2, kw)
+    dmod.init_params(mx.initializer.Xavier())
+    seqs, scores = models.beam_search(dmod, np.array([1, 2]), beam_size=2,
+                                      gen_len=6, eos=0)
+    # after the first eos in a sequence, everything must be eos
+    for b in range(2):
+        for k in range(2):
+            s = seqs[b, k, 1:]
+            hits = np.where(s == 0)[0]
+            if hits.size:
+                assert np.all(s[hits[0]:] == 0), s
