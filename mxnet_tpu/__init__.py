@@ -35,6 +35,11 @@ from .io import DataBatch, DataIter, DataDesc, NDArrayIter, ResizeIter, \
 from .image_record_iter import ImageRecordIter, ImageRecordUInt8Iter
 io.ImageRecordIter = ImageRecordIter   # reference API: mx.io.ImageRecordIter
 io.ImageRecordUInt8Iter = ImageRecordUInt8Iter
+# reference registers _v1 variants of the record iterators
+# (src/io/io.cc:337-758, the pre-rewrite pipeline kept for compat);
+# here there is one implementation, so _v1 is the same class
+io.ImageRecordIter_v1 = ImageRecordIter
+io.ImageRecordUInt8Iter_v1 = ImageRecordUInt8Iter
 from .image.detection import ImageDetRecordIter
 io.ImageDetRecordIter = ImageDetRecordIter  # reference: src/io/io.cc:581
 from . import recordio

@@ -9,4 +9,7 @@ from .image import (imdecode, imread, imresize, resize_short, fixed_crop,
                     ColorNormalizeAug, RandomOrderAug, SequentialAug,
                     CreateAugmenter, ImageIter)
 from .detection import (ImageDetRecordIter, ImageDetIter, make_det_label,
-                        parse_det_label, pack_det_dataset)
+                        parse_det_label, pack_det_dataset,
+                        DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug,
+                        CreateDetAugmenter)

@@ -118,11 +118,7 @@ class ImageDetRecordIter(ImageRecordIter):
             flip = self._rng.rand(arr.shape[0]) < 0.5
             arr[flip] = arr[flip, :, :, ::-1]
             for i in np.where(flip)[0]:
-                valid = labels[i, :, 0] >= 0
-                x1 = labels[i, valid, 1].copy()
-                x2 = labels[i, valid, 3].copy()
-                labels[i, valid, 1] = 1.0 - x2
-                labels[i, valid, 3] = 1.0 - x1
+                labels[i] = _flip_boxes(labels[i])
         if self.mean.any():
             arr -= self.mean
         if (self.std != 1.0).any():
@@ -196,9 +192,13 @@ class ImageDetIter(ImageIter):
     """
 
     def __init__(self, batch_size, data_shape, max_objects=16,
-                 rand_mirror=False, label_name='label', **kwargs):
+                 rand_mirror=False, label_name='label', det_aug_list=None,
+                 **kwargs):
         self.max_objects = max_objects
         self._det_mirror = rand_mirror
+        # box-aware (src, label) augmenters (CreateDetAugmenter);
+        # supersede the built-in mirror when given
+        self.det_auglist = det_aug_list
         self._det_rng = np.random.RandomState(kwargs.pop('seed', 0))
         kwargs.pop('label_width', None)
         if kwargs.get('aug_list') is None:
@@ -233,6 +233,19 @@ class ImageDetIter(ImageIter):
                 except Exception as e:  # noqa: BLE001
                     logging.debug('Invalid image, skipping: %s', str(e))
                     continue
+                if self.det_auglist is not None:
+                    # box-aware path: augmenters transform (src, label)
+                    # pairs; the trailing force-resize in
+                    # CreateDetAugmenter pins the output size
+                    d = data[0]
+                    lab = parse_det_label(label, self.max_objects)
+                    for aug in self.det_auglist:
+                        d, lab = aug(d, lab)
+                    arr = _as_np(d).astype(np.float32)
+                    batch_data[i] = arr.transpose(2, 0, 1)
+                    batch_label[i] = lab
+                    i += 1
+                    continue
                 for aug in self.auglist:
                     data = [ret for src in data for ret in aug(src)]
                 for d in data:
@@ -242,11 +255,7 @@ class ImageDetIter(ImageIter):
                     lab = parse_det_label(label, self.max_objects)
                     if self._det_mirror and self._det_rng.rand() < 0.5:
                         arr = arr[:, ::-1]
-                        valid = lab[:, 0] >= 0
-                        x1 = lab[valid, 1].copy()
-                        x2 = lab[valid, 3].copy()
-                        lab[valid, 1] = 1.0 - x2
-                        lab[valid, 3] = 1.0 - x1
+                        lab = _flip_boxes(lab)
                     batch_data[i] = arr.transpose(2, 0, 1)
                     batch_label[i] = lab
                     i += 1
@@ -257,3 +266,195 @@ class ImageDetIter(ImageIter):
                          pad=batch_size - i,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenter objects + factory
+# (reference: python/mxnet/image/detection.py — DetBorrowAug,
+#  DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+#  CreateDetAugmenter :482.  Boxes are NORMALIZED [0,1] xyxy in columns
+#  1..4 of a (max_objects, 5) label padded with -1 — the same contract
+#  as ImageDetRecordIter above, so the box math is shared.)
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Callable ``(src_hwc_ndarray, label) -> (src, label)``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a pixel-only classification augmenter; the label rides along
+    (reference: DetBorrowAug — 'borrow standard augmenter')."""
+
+    def __init__(self, augmenter):
+        # store the class name, not dumps(): some augmenters carry numpy
+        # arrays (ColorNormalizeAug mean/std) that json can't serialize
+        super().__init__(augmenter=type(augmenter).__name__)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src)[0], label
+
+
+def _flip_boxes(label):
+    """Reflect normalized xyxy boxes horizontally, in place, rows with
+    class >= 0 only — the ONE copy of the flip-box math (used by the det
+    augmenter, ImageDetIter's built-in mirror, and ImageDetRecordIter)."""
+    valid = label[:, 0] >= 0
+    x1 = label[valid, 1].copy()
+    x2 = label[valid, 3].copy()
+    label[valid, 1] = 1.0 - x2
+    label[valid, 3] = 1.0 - x1
+    return label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image AND boxes with probability p."""
+
+    def __init__(self, p=0.5, seed=0):
+        super().__init__(p=p)
+        self.p = p
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, src, label):
+        if self._rng.rand() < self.p:
+            from .image import _as_np
+            from ..ndarray.ndarray import array as nd_array
+            src = nd_array(_as_np(src)[:, ::-1])
+            label = _flip_boxes(label.copy())
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random scale crop keeping objects whose centers stay inside
+    (the in-tree sampler ImageDetRecordIter._rand_det_crop uses; the
+    reference's constrained samplers express the same center-keep rule,
+    image_det_aug_default.cc)."""
+
+    def __init__(self, p=1.0, min_crop_scale=0.5, seed=0):
+        super().__init__(p=p, min_crop_scale=min_crop_scale)
+        self.p = p
+        self.min_crop_scale = min_crop_scale
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, src, label):
+        if self._rng.rand() >= self.p:
+            return src, label
+        from .image import _as_np
+        from ..ndarray.ndarray import array as nd_array
+        arr = _as_np(src)
+        h, w = arr.shape[:2]
+        s = self._rng.uniform(self.min_crop_scale, 1.0)
+        ch, cw = int(h * s), int(w * s)
+        y0 = self._rng.randint(0, h - ch + 1)
+        x0 = self._rng.randint(0, w - cw + 1)
+        nx0, ny0 = x0 / w, y0 / h
+        nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
+        lab = label.copy()
+        valid = lab[:, 0] >= 0
+        if valid.any():
+            cx = (lab[valid, 1] + lab[valid, 3]) / 2
+            cy = (lab[valid, 2] + lab[valid, 4]) / 2
+            keep = (cx >= nx0) & (cx < nx1) & (cy >= ny0) & (cy < ny1)
+            if not keep.any():
+                return src, label   # keep at least one object: skip crop
+            new = np.full_like(lab, -1.0)
+            kept = lab[valid][keep]
+            kept[:, 1] = np.clip((kept[:, 1] - nx0) / (nx1 - nx0), 0, 1)
+            kept[:, 3] = np.clip((kept[:, 3] - nx0) / (nx1 - nx0), 0, 1)
+            kept[:, 2] = np.clip((kept[:, 2] - ny0) / (ny1 - ny0), 0, 1)
+            kept[:, 4] = np.clip((kept[:, 4] - ny0) / (ny1 - ny0), 0, 1)
+            new[:len(kept)] = kept
+            lab = new
+        return nd_array(arr[y0:y0 + ch, x0:x0 + cw]), lab
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Pad the image into a larger canvas (zoom OUT) and shrink boxes
+    accordingly (reference: DetRandomPadAug)."""
+
+    def __init__(self, p=1.0, max_pad_scale=2.0, pad_val=(127, 127, 127),
+                 seed=0):
+        super().__init__(p=p, max_pad_scale=max_pad_scale, pad_val=pad_val)
+        self.p = p
+        self.max_pad_scale = max_pad_scale
+        self.pad_val = pad_val
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, src, label):
+        if self._rng.rand() >= self.p:
+            return src, label
+        from .image import _as_np
+        from ..ndarray.ndarray import array as nd_array
+        arr = _as_np(src)
+        h, w, c = arr.shape
+        s = self._rng.uniform(1.0, self.max_pad_scale)
+        nh, nw = int(h * s), int(w * s)
+        y0 = self._rng.randint(0, nh - h + 1)
+        x0 = self._rng.randint(0, nw - w + 1)
+        canvas = np.empty((nh, nw, c), arr.dtype)
+        canvas[:] = np.asarray(self.pad_val[:c], arr.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = arr
+        lab = label.copy()
+        valid = lab[:, 0] >= 0
+        lab[valid, 1] = (lab[valid, 1] * w + x0) / nw
+        lab[valid, 3] = (lab[valid, 3] * w + x0) / nw
+        lab[valid, 2] = (lab[valid, 2] * h + y0) / nh
+        lab[valid, 4] = (lab[valid, 4] * h + y0) / nh
+        return nd_array(canvas), lab
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       min_crop_scale=0.5, max_pad_scale=2.0,
+                       pad_val=(127, 127, 127), inter_method=2, seed=0):
+    """Standard detection augmenter list (reference: detection.py
+    CreateDetAugmenter:482).  ``rand_crop``/``rand_pad`` are
+    probabilities; pixel-only steps (resize, color jitter, normalize)
+    ride through DetBorrowAug; geometry steps are box-aware.  The
+    reference's constrained-IoU crop samplers are simplified to the
+    center-keep rule shared with ImageDetRecordIter (documented above).
+    A trailing force-resize pins the output to ``data_shape`` so crops
+    and pads always batch."""
+    from .image import (ResizeAug, ForceResizeAug, CastAug, ColorJitterAug,
+                        ColorNormalizeAug)
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    # distinct streams per geometric augmenter: one shared seed would put
+    # crop/pad in lockstep (same skip/apply decisions and scale draw on
+    # every image), silently collapsing augmentation diversity
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug(p=rand_crop,
+                                        min_crop_scale=min_crop_scale,
+                                        seed=seed))
+    if rand_pad > 0:
+        auglist.append(DetRandomPadAug(p=rand_pad,
+                                       max_pad_scale=max_pad_scale,
+                                       pad_val=pad_val, seed=seed + 1))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5, seed=seed + 2))
+    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2],
+                                                data_shape[1]),
+                                               inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist

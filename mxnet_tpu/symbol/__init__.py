@@ -8,3 +8,6 @@ init_symbol_module(globals())
 
 from ..base import ContribNamespace as _ContribNS
 contrib = _ContribNS(globals())
+
+from . import random    # noqa: E402  mx.sym.random.*
+from . import linalg    # noqa: E402  mx.sym.linalg.*

@@ -160,3 +160,79 @@ def test_image_det_iter_non_square_boxes(tmp_path):
     assert left > 200 and right < 50, (left, right)
     np.testing.assert_allclose(lab[0], [0.0, 0.0, 0.0, 0.5, 1.0],
                                atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenter objects (CreateDetAugmenter, reference
+# detection.py:482) — box math + end-to-end through ImageDetIter
+# ---------------------------------------------------------------------------
+
+def test_det_flip_aug_box_math():
+    from mxnet_tpu.image import DetHorizontalFlipAug
+    from mxnet_tpu import nd
+    img = nd.array(np.arange(4 * 6 * 3).reshape(4, 6, 3).astype('f'))
+    lab = np.full((3, 5), -1.0, np.float32)
+    lab[0] = [1.0, 0.1, 0.2, 0.4, 0.6]
+    aug = DetHorizontalFlipAug(p=1.0)
+    out, lab2 = aug(img, lab)
+    np.testing.assert_allclose(lab2[0], [1.0, 0.6, 0.2, 0.9, 0.6],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  img.asnumpy()[:, ::-1])
+    assert (lab2[1:] == -1).all()
+
+
+def test_det_pad_aug_shrinks_boxes():
+    from mxnet_tpu.image import DetRandomPadAug
+    from mxnet_tpu import nd
+    img = nd.array(np.ones((10, 10, 3), np.float32) * 255)
+    lab = np.full((2, 5), -1.0, np.float32)
+    lab[0] = [0.0, 0.0, 0.0, 1.0, 1.0]
+    aug = DetRandomPadAug(p=1.0, max_pad_scale=2.0, seed=1)
+    out, lab2 = aug(img, lab)
+    oh, ow = out.shape[:2]
+    assert oh >= 10 and ow >= 10
+    # box area shrank by exactly the canvas growth
+    w2 = lab2[0, 3] - lab2[0, 1]
+    h2 = lab2[0, 4] - lab2[0, 2]
+    np.testing.assert_allclose(w2, 10.0 / ow, rtol=1e-6)
+    np.testing.assert_allclose(h2, 10.0 / oh, rtol=1e-6)
+    # padded region carries the fill value
+    assert out.asnumpy().max() == 255.0
+
+
+def test_det_crop_aug_keeps_centers():
+    from mxnet_tpu.image import DetRandomCropAug
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(0)
+    img = nd.array(rng.uniform(0, 255, (32, 32, 3)).astype('f'))
+    lab = np.full((2, 5), -1.0, np.float32)
+    lab[0] = [2.0, 0.4, 0.4, 0.6, 0.6]  # centered box survives any crop
+    aug = DetRandomCropAug(p=1.0, min_crop_scale=0.8, seed=3)
+    out, lab2 = aug(img, lab)
+    assert (lab2[0, 0] == 2.0) and (lab2[0, 1:] >= 0).all() \
+        and (lab2[0, 1:] <= 1).all()
+    assert lab2[0, 1] < lab2[0, 3] and lab2[0, 2] < lab2[0, 4]
+
+
+def test_create_det_augmenter_end_to_end(tmp_path):
+    from mxnet_tpu.image import CreateDetAugmenter, ImageDetIter
+    images, classes, boxes = _toy_dataset(8)
+    rec = str(tmp_path / "aug_det.rec")
+    pack_det_dataset(rec, images, classes, boxes)
+    augs = CreateDetAugmenter((3, 48, 48), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True,
+                              seed=5)
+    assert len(augs) >= 5
+    it = ImageDetIter(batch_size=4, data_shape=(3, 48, 48),
+                      max_objects=4, path_imgrec=rec,
+                      det_aug_list=augs)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 48, 48)
+    assert batch.label[0].shape == (4, 4, 5)
+    lab = batch.label[0].asnumpy()
+    valid = lab[..., 0] >= 0
+    assert valid.any()
+    assert (lab[valid][:, 1:] >= 0).all() and (lab[valid][:, 1:] <= 1).all()
+    # normalization happened: values are standardized, not raw bytes
+    assert abs(batch.data[0].asnumpy()).max() < 50

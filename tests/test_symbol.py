@@ -140,3 +140,52 @@ def test_multi_output_slicechannel():
     ex.arg_dict['data']._set_data(np.arange(12, dtype=np.float32).reshape(2, 6))
     ex.forward()
     assert ex.outputs[0].shape == (2, 2)
+
+
+def test_sym_random_namespace():
+    """mx.sym.random mirrors mx.nd.random (reference symbol/random.py):
+    same registered ops, so a graph draw matches shapes/moments."""
+    import numpy as np
+    s = mx.sym.random.uniform(low=-1.0, high=1.0, shape=(64, 32))
+    ex = s.bind(mx.cpu(), {})
+    mx.random.seed(3)
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (64, 32)
+    assert out.min() >= -1.0 and out.max() <= 1.0
+
+    n = mx.sym.random.normal(loc=2.0, scale=0.5, shape=(2000,))
+    ex = n.bind(mx.cpu(), {})
+    mx.random.seed(4)
+    v = ex.forward()[0].asnumpy()
+    assert abs(v.mean() - 2.0) < 0.1 and abs(v.std() - 0.5) < 0.1
+
+    # tensor-parameter path composes with graph inputs
+    mu = mx.sym.Variable("mu")
+    samp = mx.sym.random.normal(loc=mu, scale=mx.sym.zeros((3,)) + 1e-6)
+    ex = samp.bind(mx.cpu(), {"mu": mx.nd.array([1., 2., 3.])})
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, [1., 2., 3.], atol=1e-3)
+
+
+def test_sym_linalg_namespace():
+    """mx.sym.linalg mirrors mx.nd.linalg through the executor."""
+    import numpy as np
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    g = mx.sym.linalg.gemm2(a, b)
+    rs = np.random.RandomState(0)
+    A = rs.randn(4, 5).astype('f')
+    B = rs.randn(5, 3).astype('f')
+    ex = g.bind(mx.cpu(), {"a": mx.nd.array(A), "b": mx.nd.array(B)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), A @ B,
+                               rtol=1e-5, atol=1e-5)
+    want = mx.nd.linalg.syrk(mx.nd.array(A)).asnumpy()
+    s = mx.sym.linalg.syrk(a)
+    got = s.bind(mx.cpu(), {"a": mx.nd.array(A)}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_record_iter_v1_aliases():
+    import mxnet_tpu as _mx
+    assert _mx.io.ImageRecordIter_v1 is _mx.io.ImageRecordIter
+    assert _mx.io.ImageRecordUInt8Iter_v1 is _mx.io.ImageRecordUInt8Iter
