@@ -56,8 +56,7 @@ def make_op_func(opdef: _reg.OpDef, name: str):
         return _invoke(opdef.name, inputs, attrs, out=out)
 
     op_func.__name__ = name
-    op_func.__doc__ = (opdef.doc or "") + \
-        f"\n\n(auto-generated wrapper for registered op {opdef.name!r})"
+    op_func.__doc__ = _reg.build_op_doc(opdef, name, flavor="nd")
     return op_func
 
 

@@ -115,3 +115,25 @@ def list_ops() -> List[str]:
 
 def op_count() -> int:
     return len({id(v) for v in _OP_REGISTRY.values()})
+
+
+def build_op_doc(opdef, name, flavor="nd"):
+    """Rich docstring for an auto-generated wrapper: synthesized
+    signature (inputs + attrs with defaults) followed by the registered
+    doc (register() takes it from the implementing function's docstring,
+    which carries the reference file:line citations).  The TPU answer to
+    the reference's introspected dmlc-Parameter docs
+    (MXSymbolGetAtomicSymbolInfo → generated Python signatures)."""
+    args = list(opdef.arg_names or []) + list(opdef.aux_names or [])
+    if opdef.variadic:
+        args = ["*args"]
+    parts = args + ["%s=%r" % (k, v)
+                    for k, v in (opdef.attr_defaults or {}).items()]
+    parts.append("out=None" if flavor == "nd" else "name=None")
+    lines = ["%s(%s)" % (name, ", ".join(parts))]
+    body = (opdef.doc or "").strip()
+    if body:
+        lines += ["", body]
+    lines += ["", "Registered op %r (auto-generated %s wrapper)."
+              % (opdef.name, "mx.nd" if flavor == "nd" else "mx.sym")]
+    return "\n".join(lines)

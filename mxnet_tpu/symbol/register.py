@@ -50,8 +50,7 @@ def make_sym_func(opdef: _reg.OpDef, name: str):
                         user_attr=user_attr)
 
     sym_func.__name__ = name
-    sym_func.__doc__ = (opdef.doc or "") + \
-        f"\n\n(auto-generated symbol wrapper for registered op {opdef.name!r})"
+    sym_func.__doc__ = _reg.build_op_doc(opdef, name, flavor="sym")
     return sym_func
 
 
