@@ -34,21 +34,42 @@ _mark = make_mark("bench")
 import numpy as np
 
 BASELINE_IMGS_PER_SEC = 109.0   # ResNet-50, 1x K80, batch 32
-BATCH = int(os.environ.get("BENCH_BATCH", "256"))
-DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
-OPT = os.environ.get("BENCH_OPT", "sgd")
+
+
+def _measured_defaults():
+    """Config defaults promoted from the best MEASURED sweep result
+    (BENCH_DEFAULTS.json, written by tools/chip_session.sh after its MFU
+    sweep).  Env vars still override.  This closes the loop when the
+    operator isn't around: any successful sweep upgrades the next
+    driver-run bench to the winning config automatically."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_DEFAULTS.json")
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except Exception:  # noqa: BLE001 — absent/corrupt file = no defaults
+        return {}
+
+
+_DEF = _measured_defaults()
+BATCH = int(os.environ.get("BENCH_BATCH", _DEF.get("batch", 256)))
+DTYPE = os.environ.get("BENCH_DTYPE", _DEF.get("dtype", "bfloat16"))
+OPT = os.environ.get("BENCH_OPT", _DEF.get("opt", "sgd"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 # TPU-native stem variant (space-to-depth, mathematically equivalent —
 # models/resnet.py space_to_depth_stem_weight) and rematerialization.
 # BENCH_REMAT: 0 (off), 1/full (whole-step recompute), save_matmuls
 # (keep conv/FC outputs, recompute elementwise chains only)
-STEM = os.environ.get("BENCH_STEM", "conv7")
-_REMAT = os.environ.get("BENCH_REMAT", "0")
-if _REMAT != "0":
+STEM = os.environ.get("BENCH_STEM", _DEF.get("stem", "conv7"))
+_REMAT = os.environ.get("BENCH_REMAT", str(_DEF.get("remat", "0")))
+if _REMAT not in ("0", "", "False", "false"):
     # must be set before the Module traces the step (executor.maybe_mirror)
+    # ("False" guards the promoted-defaults path: sweep records log
+    # remat=False for the off case)
     os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
-    if _REMAT not in ("1", "full"):
+    if _REMAT not in ("1", "full", "True", "true"):
         os.environ["MXNET_REMAT_POLICY"] = _REMAT
 
 def _make_record_iter(batch):

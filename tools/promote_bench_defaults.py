@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Promote the best measured sweep config to bench defaults.
+
+Scans BENCH_LOG.jsonl for resnet50 synthetic-data measurements and, when
+the winner beats the CURRENT default config's best measurement by a
+margin (>2%, so noise can't flip defaults back and forth), writes
+BENCH_DEFAULTS.json — which bench.py reads for its BATCH/STEM/REMAT/OPT
+defaults (env still overrides).  Run by tools/chip_session.sh after the
+MFU sweep; safe to run any time (no log → no file → bench keeps built-in
+defaults).
+"""
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "BENCH_LOG.jsonl")
+OUT = os.path.join(ROOT, "BENCH_DEFAULTS.json")
+
+
+def remat_str(v):
+    """Normalize the logged remat field to the BENCH_REMAT string."""
+    if v in (False, None, "0", "", "False", "false"):
+        return "0"
+    if v in (True, "1", "full", "True", "true"):
+        return "1"
+    return str(v)
+
+
+def main():
+    if not os.path.exists(LOG):
+        print("promote: no %s — nothing to do" % LOG)
+        return 0
+    rows = []
+    with open(LOG) as f:
+        for line in f:
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(d, dict):
+                continue
+            if d.get("metric") != "resnet50_train_imgs_per_sec":
+                continue
+            if not d.get("value"):
+                continue
+            if d.get("data_mode", "synthetic") != "synthetic":
+                continue  # defaults stay on the synthetic headline config
+            rows.append(d)
+    if not rows:
+        print("promote: no successful synthetic measurements yet")
+        return 0
+    # only the CURRENT chip's measurements count: a device swap must not
+    # leave stale all-time-max defaults (e.g. a batch the new chip OOMs)
+    device = rows[-1].get("device")
+    rows = [d for d in rows if d.get("device") == device]
+    best = None
+    for d in rows:
+        if best is None or d["value"] > best["value"] or (
+                d["value"] == best["value"]
+                and d.get("tag") and not best.get("tag")):
+            # each successful session run logs twice (bench.py's own
+            # append + run_bench's tagged copy): prefer the tagged
+            # duplicate so provenance survives
+            best = d
+
+    current = {}
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                current = json.load(f)
+        except ValueError:
+            current = {}
+
+    cand = {
+        "batch": int(best.get("batch", 256)),
+        "stem": best.get("stem", "conv7"),
+        "opt": best.get("opt", "sgd"),
+        "dtype": best.get("dtype", "bfloat16"),
+        "remat": remat_str(best.get("remat", "0")),
+        # provenance, for the next reader
+        "promoted_from": {"value": best["value"],
+                          "mfu": best.get("mfu"),
+                          "ts": best.get("ts"),
+                          "tag": best.get("tag"),
+                          "device": best.get("device")},
+    }
+    prev = current.get("promoted_from") or {}
+    prev_val = prev.get("value", 0) or 0
+    same_device = prev.get("device") == best.get("device")
+    if prev_val and same_device and best["value"] < prev_val * 1.02:
+        # >2% hysteresis so noise can't flip defaults; only comparable
+        # on the same device kind — a chip swap always re-promotes
+        print("promote: best %.1f does not beat promoted %.1f by >2%% — "
+              "keeping current defaults" % (best["value"], prev_val))
+        return 0
+    with open(OUT, "w") as f:
+        json.dump(cand, f, indent=1)
+    print("promote: defaults <- %s (%.1f imgs/sec, mfu %s)"
+          % ({k: cand[k] for k in ("batch", "stem", "opt", "remat")},
+             best["value"], best.get("mfu")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
