@@ -129,17 +129,31 @@ def test_supervisor_signal_stops_without_relaunch(tmp_path):
     import signal as _signal
     import time
     sleeper = tmp_path / "sleeper.py"
-    sleeper.write_text("import time\ntime.sleep(60)\n")
+    # the child proves it is RUNNING (not just spawned) by touching a
+    # file — a fixed sleep raced the supervisor's handler installation
+    # under load and the default SIGTERM disposition killed it outright
+    ready = tmp_path / "ready"
+    sleeper.write_text(
+        "import pathlib, time\n"
+        "pathlib.Path(%r).touch()\n" % str(ready) +
+        "time.sleep(120)\n")
     prefix = str(tmp_path / "sig")
+    errfile = open(tmp_path / "err.txt", "w")
     p = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "tools/train_supervisor.py"),
          "--prefix", prefix, "--max-restarts", "5", "--backoff", "0.1",
          "--", sys.executable, str(sleeper)],
-        stderr=subprocess.PIPE, text=True)
-    time.sleep(2.0)
+        stderr=errfile, text=True)
+    deadline = time.time() + 120
+    while not ready.exists():
+        assert time.time() < deadline, "child never started"
+        assert p.poll() is None, "supervisor died early"
+        time.sleep(0.1)
+    time.sleep(0.5)  # let the supervisor reach child.wait()
     p.send_signal(_signal.SIGTERM)
-    rc = p.wait(timeout=30)
-    err = p.stderr.read()
-    assert rc == 128 + _signal.SIGTERM, err[-500:]
+    rc = p.wait(timeout=60)
+    errfile.close()
+    err = (tmp_path / "err.txt").read_text()
+    assert rc == 128 + _signal.SIGTERM, (rc, err[-500:])
     assert "not relaunching" in err
     assert "restart 1" not in err
