@@ -22,16 +22,17 @@ import mxnet_tpu as mx
 from mxnet_tpu import models
 
 
-def _lowered_resnet_step_hlo(compute_dtype):
+def _lowered_resnet_step_hlo(compute_dtype, stem="conv7",
+                             num_layers=8, image_shape=(3, 28, 28)):
     import jax.numpy as jnp
-    sym = models.resnet(num_classes=10, num_layers=8,
-                        image_shape=(3, 28, 28))
+    sym = models.resnet(num_classes=10, num_layers=num_layers,
+                        image_shape=image_shape, stem=stem)
     mod = mx.mod.Module(sym, compute_dtype=compute_dtype and
                         jnp.dtype(compute_dtype))
     batch = 2
     it = mx.io.NDArrayIter(
         data=np.random.RandomState(0).uniform(
-            -1, 1, (batch, 3, 28, 28)).astype(np.float32),
+            -1, 1, (batch,) + tuple(image_shape)).astype(np.float32),
         label=np.zeros((batch,), np.float32), batch_size=batch)
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params(mx.initializer.Xavier())
@@ -81,3 +82,48 @@ def test_fp32_mode_keeps_fp32_convolution():
     hlo = _lowered_resnet_step_hlo(None)
     convs = _op_operand_dtypes(hlo, "convolution")
     assert convs and all("f32" in dts for dts in convs)
+
+
+def _sweep_step_hlo(stem, remat_policy):
+    """Lower the fused step in a sweep configuration (s2d stem and/or
+    remat) — the exact configs tools/chip_session.sh measures; an fp32
+    activation leak in one of them would waste the chip session.
+
+    The stem only exists on the imagenet branch (height > 32,
+    models/resnet.py), so this lowers a 64x64 ResNet-18 — 28x28 would
+    silently test the cifar stem regardless of `stem`.
+    """
+    import os
+    old = {k: os.environ.pop(k, None)
+           for k in ("MXNET_BACKWARD_DO_MIRROR", "MXNET_REMAT_POLICY")}
+    try:
+        if remat_policy:
+            os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+            if remat_policy not in ("1", "full"):
+                os.environ["MXNET_REMAT_POLICY"] = remat_policy
+        return _lowered_resnet_step_hlo("bfloat16", stem=stem,
+                                        num_layers=18,
+                                        image_shape=(3, 64, 64))
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.parametrize("stem,remat", [
+    ("s2d", None),
+    ("s2d", "save_matmuls"),
+    ("conv7", "1"),
+])
+def test_sweep_configs_keep_bf16_convs(stem, remat):
+    hlo = _sweep_step_hlo(stem, remat)
+    if stem == "s2d":
+        # non-vacuous stem check: the s2d conv0 weight is (64, 12, 4, 4)
+        assert "x12x4x4x" in hlo.replace("bf16", "").replace("f32", ""), \
+            "s2d stem not present in lowered HLO"
+    convs = _op_operand_dtypes(hlo, "convolution")
+    assert convs, "no convolutions found in lowered step"
+    for dts in convs:
+        assert all(d == "bf16" for d in dts), (stem, remat, dts)
