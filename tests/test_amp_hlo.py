@@ -115,7 +115,8 @@ def _sweep_step_hlo(stem, remat_policy):
 @pytest.mark.parametrize("stem,remat", [
     ("s2d", None),
     ("s2d", "save_matmuls"),
-    ("conv7", "1"),
+    ("s2d", "1"),       # b512_s2d_remat: the full-remat config the
+                        # session actually measures pairs with s2d
 ])
 def test_sweep_configs_keep_bf16_convs(stem, remat):
     hlo = _sweep_step_hlo(stem, remat)

@@ -8,8 +8,20 @@ cd "$(dirname "$0")/.."
 TS() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 LOG=BENCH_LOG.jsonl
 
+# stop cleanly between steps past WATCH_DEADLINE_EPOCH: the driver's
+# end-of-round bench must find the single-client relay free (resume
+# logic makes a later relaunch skip completed configs)
+deadline_check() {  # deadline_check <label>
+  if [ -n "${WATCH_DEADLINE_EPOCH:-}" ] \
+     && [ "$(date +%s)" -ge "$WATCH_DEADLINE_EPOCH" ]; then
+    echo "== [$(TS)] deadline reached — stopping session before $1" >&2
+    exit 0
+  fi
+}
+
 run_bench() {  # run_bench <tag> [env overrides...]
   local tag="$1"; shift
+  deadline_check "$tag"
   # resume, don't repeat: a relaunch after a mid-session tunnel death
   # skips configs already measured (FORCE_RERUN=1 overrides)
   if [ "${FORCE_RERUN:-0}" != "1" ] \
@@ -108,14 +120,17 @@ run_bench record         BENCH_DATA=record || probe_or_die
 run_bench record_b512    BENCH_DATA=record BENCH_BATCH=512 || probe_or_die
 
 # 4. flash-attention microbench (VERDICT item 5)
+deadline_check "attention microbench"
 echo "== [$(TS)] attention microbench" >&2
 { python benchmark/attention_bench.py | tee attention_bench_out.txt; } || probe_or_die
 
 # 4b. transformer-LM end-to-end train throughput (tokens/sec + MFU)
+deadline_check "transformer LM bench"
 echo "== [$(TS)] transformer LM bench" >&2
 python benchmark/transformer_bench.py || probe_or_die
 
 # 5. real-data convergence artifact (VERDICT item 4)
+deadline_check "digits convergence"
 echo "== [$(TS)] digits convergence" >&2
 python tools/chip_convergence_run.py || probe_or_die
 

@@ -6,10 +6,23 @@
 set -u
 cd "$(dirname "$0")/.."
 SLEEP="${WATCH_PROBE_SLEEP:-300}"
+# WATCH_DEADLINE_EPOCH: absolute unix time after which the watcher exits
+# WITHOUT probing or launching — the relay admits ONE client, so near the
+# round's end the driver's own bench run must find it free (a probe's
+# timed-out RPC can itself wedge the relay; staying silent is the only
+# safe behavior).  Empty = no deadline.
+DEADLINE="${WATCH_DEADLINE_EPOCH:-}"
+past_deadline() {
+  [ -n "$DEADLINE" ] && [ "$(date +%s)" -ge "$DEADLINE" ]
+}
 # 90s probe deadline: see the probe_or_die comment in chip_session.sh —
 # a timed-out probe is itself a mid-RPC disconnect (wedge risk), so err
 # toward tolerating a slow-but-alive tunnel.
 while true; do
+  if past_deadline; then
+    echo "[session_watch $(date -u +%H:%M:%SZ)] deadline reached — exiting to leave the relay free for the driver" >&2
+    exit 0
+  fi
   if PROBE_TIMEOUT_S=90 python tools/tunnel_probe.py >&2; then
     echo "[session_watch $(date -u +%H:%M:%SZ)] tunnel up — starting chip session" >&2
     if bash tools/chip_session.sh; then
