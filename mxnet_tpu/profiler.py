@@ -118,13 +118,25 @@ _profiler = _Profiler()
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json",
-                        continuous_dump=False, **kwargs):
-    """reference: profiler.py:27 profiler_set_config / MXSetProfilerConfig."""
+                        continuous_dump=False, xla_logdir=None, **kwargs):
+    """reference: profiler.py:27 profiler_set_config / MXSetProfilerConfig.
+
+    ``xla_logdir``: directory for the device (xplane) capture that
+    start/stop also drives — the public form of the
+    ``MXNET_PROFILER_XLA_LOGDIR`` env var (None leaves the env-derived
+    setting untouched).  Merge both outputs with tools/trace_merge.py.
+    """
     if mode not in (_MODE_SYMBOLIC, _MODE_ALL):
         raise MXNetError(f"invalid profiler mode {mode!r}")
+    if kwargs:
+        import warnings
+        warnings.warn("profiler_set_config: ignoring unknown options %r"
+                      % sorted(kwargs), stacklevel=2)
     _profiler.mode = mode
     _profiler.filename = filename
     _profiler.continuous_dump = continuous_dump
+    if xla_logdir is not None:
+        _profiler._xla_logdir = xla_logdir
 
 
 set_config = profiler_set_config

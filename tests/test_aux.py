@@ -175,3 +175,45 @@ def test_custom_op_unregistered_raises():
     with pytest.raises(mx.MXNetError):
         mx.nd.Custom(mx.nd.array(np.zeros((2, 2), 'float32')),
                      op_type='no_such_op')
+
+
+def test_trace_merge_tool(tmp_path):
+    """tools/trace_merge.py: host chrome-trace + xplane on one timeline
+    (SURVEY §5.1's merge requirement)."""
+    import subprocess
+    import sys
+
+    logdir = str(tmp_path / "xp")
+    host_json = tmp_path / "host.json"
+    try:
+        mx.profiler.profiler_set_config(filename=str(host_json),
+                                        mode="all", xla_logdir=logdir)
+        mx.profiler.set_state("run")
+        x = mx.nd.array(np.random.RandomState(0).rand(64, 64).astype("f"))
+        mx.nd.dot(x, x).asnumpy()
+        mx.profiler.set_state("stop")
+        mx.profiler.dump_profile()
+    finally:
+        # restore the singleton — a stale xla_logdir would silently turn
+        # every later profiler test into a device capture
+        import mxnet_tpu.profiler as _prof
+        _prof._profiler._xla_logdir = None
+        mx.profiler.profiler_set_config()
+
+    out = tmp_path / "merged.json"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_merge.py"),
+         str(host_json), logdir, "-o", str(out)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+    m = json.loads(out.read_text())
+    evs = m["traceEvents"]
+    cats = {e.get("cat") for e in evs}
+    assert "device" in cats, "no device rows merged"
+    assert any(e.get("ph") == "X" and e.get("cat") != "device"
+               for e in evs), "no host rows merged"
+    assert m["metadata"]["device_events"] > 0
+    # device rows carry process metadata naming the plane
+    assert any(e.get("ph") == "M" and "device:" in
+               str(e.get("args", {}).get("name", "")) for e in evs)

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Merge a host chrome-trace with an xplane device trace on ONE timeline.
+
+Completes the §5.1 profiling story (SURVEY.md: "emit the same
+chrome-trace JSON from the host-side scheduler + merge XLA/TPU profiler
+(xplane) traces"): ``mx.profiler`` dumps host dispatch events as
+chrome://tracing JSON and captures the device xplane; this tool reads
+both and writes a single chrome-trace file where each device plane/line
+appears as its own process/thread row next to the host rows — open in
+chrome://tracing or Perfetto and see dispatch latency above the device
+ops it launched.
+
+Alignment: xplane event offsets are relative to each plane's start;
+chrome ts is absolute µs.  Device rows are placed on the host timeline
+using the xplane's own start timestamp when present, else aligned so the
+first device event starts at the first host event (documented in the
+output metadata, "clock_alignment").
+
+Usage:
+    python tools/trace_merge.py profile.json <xplane-logdir-or-file> \
+        -o merged_trace.json
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.xplane_summary import device_planes, find_xplane, load  # noqa: E402,E501
+
+
+def xplane_events(space, pid_base=1000):
+    """XSpace → chrome trace events; one pid per DEVICE plane (the
+    xplane's own Host Threads plane is excluded — mx.profiler's rows are
+    the host story, duplicating it mislabeled as device time would lie),
+    one tid per line."""
+    events = []
+    meta = []
+    for pi, plane in enumerate(device_planes(space)):
+        if not plane.lines:
+            continue
+        pid = pid_base + pi
+        meta.append({"ph": "M", "pid": pid, "name": "process_name",
+                     "args": {"name": "device: %s" % plane.name}})
+        ev_meta = plane.event_metadata
+        for line in plane.lines:
+            tid = int(line.id) % 100000
+            meta.append({"ph": "M", "pid": pid, "tid": tid,
+                         "name": "thread_name",
+                         "args": {"name": line.name or str(line.id)}})
+            # line.timestamp_ns anchors the line's offsets to a clock
+            base_us = line.timestamp_ns / 1e3
+            for ev in line.events:
+                events.append({
+                    "name": ev_meta[ev.metadata_id].name,
+                    "cat": "device", "ph": "X",
+                    "ts": base_us + ev.offset_ps / 1e6,
+                    "dur": max(ev.duration_ps / 1e6, 0.001),
+                    "pid": pid, "tid": tid,
+                })
+    return events, meta
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("host_trace", help="mx.profiler chrome-trace JSON")
+    ap.add_argument("xplane", help=".xplane.pb file or logdir")
+    ap.add_argument("-o", "--out", default="merged_trace.json")
+    a = ap.parse_args()
+
+    with open(a.host_trace) as f:
+        host = json.load(f)
+    host_events = host.get("traceEvents", host)
+
+    space = load(find_xplane(a.xplane))
+    dev_events, meta = xplane_events(space)
+
+    alignment = "xplane line timestamps"
+    host_ts = [e["ts"] for e in host_events if e.get("ph") == "X"]
+    dev_ts = [e["ts"] for e in dev_events]
+    all_anchored = all(line.timestamp_ns
+                       for plane in device_planes(space)
+                       for line in plane.lines if line.events)
+    if dev_ts and host_ts:
+        # re-anchor whenever the xplane carries no line timestamps (the
+        # offsets are then meaningless on the host clock) or the clocks
+        # live in different epochs — a skew threshold alone misses the
+        # timestamp_ns==0 case on a freshly-booted host
+        if not all_anchored or abs(min(dev_ts) - min(host_ts)) > 3600e6:
+            shift = min(host_ts) - min(dev_ts)
+            for e in dev_events:
+                e["ts"] += shift
+            alignment = ("first-event alignment (device clock shifted "
+                         "%.0f us)" % shift)
+
+    merged = {
+        "traceEvents": meta + list(host_events) + dev_events,
+        "displayTimeUnit": "ms",
+        "metadata": {"clock_alignment": alignment,
+                     "host_events": len(host_events),
+                     "device_events": len(dev_events)},
+    }
+    with open(a.out, "w") as f:
+        json.dump(merged, f)
+    print("wrote %s (%d host + %d device events; %s)"
+          % (a.out, len(host_events), len(dev_events), alignment))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
