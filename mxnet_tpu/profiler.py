@@ -123,8 +123,9 @@ def profiler_set_config(mode="symbolic", filename="profile.json",
 
     ``xla_logdir``: directory for the device (xplane) capture that
     start/stop also drives — the public form of the
-    ``MXNET_PROFILER_XLA_LOGDIR`` env var (None leaves the env-derived
-    setting untouched).  Merge both outputs with tools/trace_merge.py.
+    ``MXNET_PROFILER_XLA_LOGDIR`` env var.  None leaves the current
+    setting untouched; the empty string "" CLEARS it (device capture
+    off).  Merge both outputs with tools/trace_merge.py.
     """
     if mode not in (_MODE_SYMBOLIC, _MODE_ALL):
         raise MXNetError(f"invalid profiler mode {mode!r}")
@@ -136,7 +137,7 @@ def profiler_set_config(mode="symbolic", filename="profile.json",
     _profiler.filename = filename
     _profiler.continuous_dump = continuous_dump
     if xla_logdir is not None:
-        _profiler._xla_logdir = xla_logdir
+        _profiler._xla_logdir = xla_logdir or None  # "" clears
 
 
 set_config = profiler_set_config

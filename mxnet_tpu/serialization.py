@@ -69,6 +69,12 @@ def load_ndarrays(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
     with open(fname, "rb") as f:
         magic = f.read(8)
         if magic != _MAGIC:
+            # reference-written file? (kMXAPINDArrayListMagic container,
+            # ndarray.cc:1022) — migrating users load their existing
+            # checkpoints transparently
+            from . import compat_serialization as compat
+            if compat.is_reference_format(fname):
+                return compat.load_reference_params(fname)
             raise MXNetError(f"{fname}: not an mxnet_tpu NDArray file "
                              f"(bad magic {magic!r})")
         (hlen,) = struct.unpack("<Q", f.read(8))

@@ -178,3 +178,69 @@ def test_sharded_format_golden():
                                        {'w': nd.array(golden)})
     loaded = checkpoint.load_params_sharded(path_prefix)
     np.testing.assert_array_equal(loaded['w'].asnumpy(), golden)
+
+
+# ---------------------------------------------------------------------------
+# Reference binary .params compatibility (compat_serialization.py)
+# ---------------------------------------------------------------------------
+
+def test_load_reference_legacy_v0_fixture():
+    """tests/golden/legacy_ndarray.v0 is REAL bytes the original
+    implementation wrote (mirrored from the reference's own test
+    fixture, tests/python/unittest/test_ndarray.py:272-278 expects six
+    arange(128) arrays) — mx.nd.load reads it transparently."""
+    import mxnet_tpu as mx
+    path = os.path.join(GOLDEN_DIR, 'legacy_ndarray.v0')
+    got = mx.nd.load(path)
+    assert len(got) == 6
+    for a in got:
+        np.testing.assert_array_equal(a.asnumpy(),
+                                      np.arange(128, dtype=np.float32))
+
+
+def test_reference_v2_roundtrip(tmp_path):
+    """save_reference_params writes the V2 container; our reader loads
+    it back bit-exactly (both directions of migration)."""
+    from mxnet_tpu import compat_serialization as compat
+    import mxnet_tpu as mx
+    rs = np.random.RandomState(0)
+    data = {
+        'w': mx.nd.array(rs.randn(4, 5).astype('f')),
+        'b64': mx.nd.array(np.arange(7, dtype=np.int64)),
+        'u8': mx.nd.array(rs.randint(0, 255, (3, 2)).astype(np.uint8)),
+    }
+    path = str(tmp_path / 'ref.params')
+    compat.save_reference_params(path, data)
+    assert compat.is_reference_format(path)
+    back = mx.nd.load(path)    # auto-detected
+    assert set(back) == set(data)
+    for k in data:
+        a, b = data[k].asnumpy(), back[k].asnumpy()
+        assert a.dtype == b.dtype, k
+        np.testing.assert_array_equal(a, b)
+
+
+def test_reference_format_positional_list(tmp_path):
+    from mxnet_tpu import compat_serialization as compat
+    import mxnet_tpu as mx
+    arrs = [mx.nd.array(np.full((2, 2), i, np.float32)) for i in range(3)]
+    path = str(tmp_path / 'ref_list.params')
+    compat.save_reference_params(path, arrs)
+    back = mx.nd.load(path)
+    assert isinstance(back, list) and len(back) == 3
+    np.testing.assert_array_equal(back[2].asnumpy(),
+                                  np.full((2, 2), 2, np.float32))
+
+
+def test_reference_bf16_upcasts_on_save(tmp_path):
+    from mxnet_tpu import compat_serialization as compat
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    a = mx.nd.array(np.arange(4, dtype=np.float32))
+    a._set_data(a._data.astype(jnp.bfloat16))
+    path = str(tmp_path / 'bf16.params')
+    compat.save_reference_params(path, {'x': a})
+    back = mx.nd.load(path)
+    assert back['x'].asnumpy().dtype == np.float32
+    np.testing.assert_array_equal(back['x'].asnumpy(),
+                                  np.arange(4, dtype=np.float32))

@@ -30,11 +30,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.xplane_summary import device_planes, find_xplane, load  # noqa: E402,E501
 
 
-def xplane_events(space, pid_base=1000):
+def xplane_events(space, pid_base):
     """XSpace → chrome trace events; one pid per DEVICE plane (the
     xplane's own Host Threads plane is excluded — mx.profiler's rows are
     the host story, duplicating it mislabeled as device time would lie),
-    one tid per line."""
+    one tid per line.  ``pid_base`` must sit above every host pid so a
+    plane row can never collide with (and relabel) a host process row.
+
+    Each event carries a private ``_anchored`` flag: True when its line
+    had a real ``timestamp_ns`` (offsets live on a host-comparable
+    clock), False when offsets are only line-relative.  The caller
+    aligns unanchored lines and strips the flag before writing."""
     events = []
     meta = []
     for pi, plane in enumerate(device_planes(space)):
@@ -58,6 +64,7 @@ def xplane_events(space, pid_base=1000):
                     "ts": base_us + ev.offset_ps / 1e6,
                     "dur": max(ev.duration_ps / 1e6, 0.001),
                     "pid": pid, "tid": tid,
+                    "_anchored": bool(line.timestamp_ns),
                 })
     return events, meta
 
@@ -73,26 +80,47 @@ def main():
         host = json.load(f)
     host_events = host.get("traceEvents", host)
 
+    host_pids = [e.get("pid", 0) for e in host_events
+                 if isinstance(e, dict)]
+    pid_base = max(host_pids, default=0) + 1000
     space = load(find_xplane(a.xplane))
-    dev_events, meta = xplane_events(space)
+    dev_events, meta = xplane_events(space, pid_base)
 
-    alignment = "xplane line timestamps"
+    notes = []
     host_ts = [e["ts"] for e in host_events if e.get("ph") == "X"]
-    dev_ts = [e["ts"] for e in dev_events]
-    all_anchored = all(line.timestamp_ns
-                       for plane in device_planes(space)
-                       for line in plane.lines if line.events)
-    if dev_ts and host_ts:
-        # re-anchor whenever the xplane carries no line timestamps (the
-        # offsets are then meaningless on the host clock) or the clocks
-        # live in different epochs — a skew threshold alone misses the
-        # timestamp_ns==0 case on a freshly-booted host
-        if not all_anchored or abs(min(dev_ts) - min(host_ts)) > 3600e6:
-            shift = min(host_ts) - min(dev_ts)
-            for e in dev_events:
+    if host_ts and dev_events:
+        host_min = min(host_ts)
+        # unanchored lines (timestamp_ns == 0): offsets are only
+        # line-relative — align each line's first event to the first
+        # host event, PER LINE (one global shift computed from the
+        # minimum would fling correctly anchored lines out of view)
+        groups = {}
+        for e in dev_events:
+            if not e["_anchored"]:
+                key = (e["pid"], e["tid"])
+                groups.setdefault(key, []).append(e)
+        for key, evs in groups.items():
+            shift = host_min - min(e["ts"] for e in evs)
+            for e in evs:
                 e["ts"] += shift
-            alignment = ("first-event alignment (device clock shifted "
-                         "%.0f us)" % shift)
+        if groups:
+            notes.append("%d unanchored line(s) aligned to first host "
+                         "event" % len(groups))
+        # anchored lines whose clock lives in a different epoch than the
+        # host clock (perf_counter vs unix): shift them as one block so
+        # their cross-line relations survive
+        anchored = [e for e in dev_events if e["_anchored"]]
+        if anchored:
+            amin = min(e["ts"] for e in anchored)
+            if abs(amin - host_min) > 3600e6:
+                shift = host_min - amin
+                for e in anchored:
+                    e["ts"] += shift
+                notes.append("anchored planes shifted %.0f us "
+                             "(clock epoch mismatch)" % shift)
+    for e in dev_events:
+        e.pop("_anchored", None)
+    alignment = "; ".join(notes) if notes else "xplane line timestamps"
 
     merged = {
         "traceEvents": meta + list(host_events) + dev_events,
