@@ -655,6 +655,15 @@ def Group(symbols) -> Symbol:
     return Symbol(heads)
 
 
+def _entry(e):
+    """Graph entry → (node_id, output_idx).  nnvm-era JSON writes
+    [node, idx, version] triplets; the reference's pre-nnvm v0.8 format
+    (the checked-in save_000800.json fixture, upgraded there by
+    src/nnvm/legacy_json_util.cc) writes [node, idx] pairs — accept
+    both so reference-written symbol files load unchanged."""
+    return e[0], e[1]
+
+
 def load_json(json_str: str) -> Symbol:
     g = json.loads(json_str)
     nodes: List[Node] = []
@@ -662,6 +671,12 @@ def load_json(json_str: str) -> Symbol:
         attrs = dict(jn.get("attrs", jn.get("param", {})) or {})
         user_attrs = {k: v for k, v in attrs.items()
                       if k.startswith("__") or k in ("ctx_group",)}
+        # ONLY the pre-nnvm v0.8 format (identified by its sibling
+        # "param" dict) keeps USER attrs (lr_mult, ctx_group, ...) in a
+        # separate "attr" dict; nnvm-era files spell op params "attr",
+        # and merging those here would silently strip them from the op
+        if "param" in jn:
+            user_attrs.update(jn.get("attr", {}) or {})
         op = jn["op"]
         if op == "null":
             node = Node(None, jn["name"], {}, [], user_attrs)
@@ -671,10 +686,34 @@ def load_json(json_str: str) -> Symbol:
                 raise MXNetError(f"cannot load graph: unknown op {op!r}")
             op_attrs = {k: _parse_attr(v, opdef.attr_defaults.get(k))
                         for k, v in attrs.items() if not k.startswith("__")}
-            inputs = [(nodes[i], idx) for i, idx, _ in jn["inputs"]]
+            inputs = [(nodes[i], idx)
+                      for i, idx in map(_entry, jn["inputs"])]
+            # pre-nnvm JSON omits implicit inputs (BatchNorm's
+            # moving_mean/var aux states, SoftmaxOutput's label);
+            # synthesize the missing TRAILING ones with composition's
+            # standard names — the reference's legacy upgrade pass
+            # (legacy_json_util.cc) re-ran composition to the same effect
+            # same conditional-arg filter as composition (no_bias drops
+            # bias, non-prelu LeakyReLU drops gamma, ...): without it a
+            # tojson/load round trip would fabricate phantom arguments
+            skip = _skip_args(op, op_attrs)
+            args_w = [a for a in (opdef.arg_names or [])
+                      if a not in skip]
+            aux_w = [a for a in (opdef.aux_names or []) if a not in skip]
+            want = args_w + aux_w
+            if not opdef.variadic and args_w and len(inputs) < len(want):
+                for pos, missing in enumerate(want[len(inputs):],
+                                              start=len(inputs)):
+                    # NOTE: synthesized variables must NOT enter `nodes`
+                    # — the JSON's input indices refer to the original
+                    # node list, and shifting it corrupts later edges
+                    var = Node(None, f"{jn['name']}_{missing}", {}, [],
+                               {"__is_aux__": True}
+                               if pos >= len(args_w) else {})
+                    inputs.append((var, 0))
             node = Node(op, jn["name"], op_attrs, inputs, user_attrs)
         nodes.append(node)
-    heads = [(nodes[i], idx) for i, idx, _ in g["heads"]]
+    heads = [(nodes[i], idx) for i, idx in map(_entry, g["heads"])]
     return Symbol(heads)
 
 

@@ -189,3 +189,47 @@ def test_record_iter_v1_aliases():
     import mxnet_tpu as _mx
     assert _mx.io.ImageRecordIter_v1 is _mx.io.ImageRecordIter
     assert _mx.io.ImageRecordUInt8Iter_v1 is _mx.io.ImageRecordUInt8Iter
+
+
+def test_load_reference_legacy_symbol_json():
+    """tests/golden/reference_save_000800.json is the reference's own
+    checked-in pre-nnvm (v0.8) graph JSON (its test_symbol.py:239 loads
+    it via the legacy_json_util.cc upgrade pass).  Our loader accepts
+    pair-form edges, the separate 'attr'/'param' dicts, and synthesizes
+    the implicit BatchNorm aux inputs — then the graph RUNS."""
+    import os
+    import numpy as np
+    path = os.path.join(os.path.dirname(__file__), "golden",
+                        "reference_save_000800.json")
+    s = mx.sym.load(path)
+    args = s.list_arguments()
+    assert "softmax_label" in args and "fc1_weight" in args
+    assert "batchnorm0_moving_mean" in s.list_auxiliary_states()
+    # user attrs from the legacy 'attr' dicts survive
+    ad = s.attr_dict()
+    assert ad["data"]["lr_mult"] == "0.2"
+    assert ad["data"]["ctx_group"] == "stage1"
+
+    mod = mx.mod.Module(s, label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 10))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.initializer.Xavier())
+    from mxnet_tpu.io import DataBatch
+    mod.forward(DataBatch([mx.nd.array(
+        np.random.RandomState(0).rand(2, 10).astype("f"))],
+        [mx.nd.zeros((2,))]), is_train=False)
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_load_json_roundtrip_no_phantom_args():
+    """tojson+load_json must not fabricate skipped conditional args
+    (no_bias FullyConnected, non-prelu LeakyReLU)."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                no_bias=True, name="fc1")
+    net = mx.sym.LeakyReLU(net, act_type="leaky", name="lrelu")
+    back = mx.sym.load_json(net.tojson())
+    assert back.list_arguments() == net.list_arguments()
+    assert "fc1_bias" not in back.list_arguments()
+    assert "lrelu_gamma" not in back.list_arguments()
