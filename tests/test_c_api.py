@@ -241,3 +241,40 @@ def test_cpp_predictor_matches_python_forward(tmp_path):
     assert r.returncode == 0, r.stderr[-800:]
     got = np.fromfile(str(out), dtype=np.float32).reshape(want.shape)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_threaded_calls(lib):
+    """The header promises 'calls may come from any thread' — hammer the
+    ABI from 8 threads concurrently (create/invoke/copy/free) and check
+    every result."""
+    import threading
+
+    errors = []
+
+    def worker(seed):
+        try:
+            rs = np.random.RandomState(seed)
+            for _ in range(10):
+                a = rs.randn(4, 4).astype(np.float32)
+                h = _from_numpy(lib, a)
+                (r,) = _invoke(lib, "relu", [h])
+                got = _to_numpy(lib, r)
+                np.testing.assert_array_equal(got, np.maximum(a, 0))
+                (s,) = _invoke(lib, "elemwise_add", [h, r])
+                np.testing.assert_allclose(_to_numpy(lib, s),
+                                           a + np.maximum(a, 0),
+                                           rtol=1e-6)
+                for hh in (h, r, s):
+                    assert lib.MXTNDArrayFree(H(hh)) == 0
+        except Exception as e:  # noqa: BLE001
+            errors.append((seed, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    # a deadlocked worker must FAIL the test, not time out silently
+    assert not any(t.is_alive() for t in threads), "worker hung"
+    assert not errors, errors[:3]

@@ -244,3 +244,47 @@ def test_reference_bf16_upcasts_on_save(tmp_path):
     assert back['x'].asnumpy().dtype == np.float32
     np.testing.assert_array_equal(back['x'].asnumpy(),
                                   np.arange(4, dtype=np.float32))
+
+
+def test_full_reference_checkpoint_migration(tmp_path):
+    """End-to-end migration: a checkpoint whose SYMBOL is the
+    reference's own v0.8 JSON fixture and whose PARAMS are written in
+    the reference binary container loads through the standard
+    mx.model.load_checkpoint entry and trains/infers."""
+    import shutil
+    import mxnet_tpu as mx
+    from mxnet_tpu import compat_serialization as compat
+    from mxnet_tpu.io import DataBatch
+
+    prefix = str(tmp_path / 'legacy')
+    shutil.copy(os.path.join(GOLDEN_DIR, 'reference_save_000800.json'),
+                prefix + '-symbol.json')
+
+    # params in the REFERENCE binary format, arg:/aux: prefixed exactly
+    # as the reference's save_checkpoint wrote them (model.py:340)
+    sym = mx.sym.load(prefix + '-symbol.json')
+    mod = mx.mod.Module(sym, label_names=('softmax_label',))
+    mod.bind(data_shapes=[('data', (4, 10))],
+             label_shapes=[('softmax_label', (4,))])
+    mx.random.seed(3)
+    mod.init_params(mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    blob = {('arg:%s' % k): v for k, v in arg.items()}
+    blob.update({('aux:%s' % k): v for k, v in aux.items()})
+    compat.save_reference_params(prefix + '-0007.params', blob)
+
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert sym2 is not None
+    assert set(arg2) == set(arg) and set(aux2) == set(aux)
+
+    mod2 = mx.mod.Module(sym2, label_names=('softmax_label',))
+    mod2.bind(data_shapes=[('data', (4, 10))],
+              label_shapes=[('softmax_label', (4,))])
+    mod2.set_params(arg2, aux2)
+    x = np.random.RandomState(1).rand(4, 10).astype('f')
+    batch = DataBatch([mx.nd.array(x)], [mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod2.get_outputs()[0].asnumpy(),
+                               mod.get_outputs()[0].asnumpy(),
+                               rtol=1e-6, atol=1e-6)
