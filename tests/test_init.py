@@ -1,0 +1,124 @@
+"""Initializer semantics (reference: tests/python/unittest/test_init.py
+plus the per-class contracts in python/mxnet/initializer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+from mxnet_tpu import nd
+
+
+def _one(initializer, shape, name="weight"):
+    arr = nd.zeros(shape)
+    initializer(init.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert (_one(init.Zero(), (3, 4)) == 0).all()
+    assert (_one(init.One(), (3, 4)) == 1).all()
+    assert (_one(init.Constant(2.5), (5,)) == 2.5).all()
+
+
+def test_uniform_normal_moments():
+    mx.random.seed(0)
+    u = _one(init.Uniform(0.3), (200, 200))
+    assert abs(u.mean()) < 0.01 and u.min() >= -0.3 and u.max() <= 0.3
+    n = _one(init.Normal(0.1), (200, 200))
+    assert abs(n.mean()) < 0.01 and abs(n.std() - 0.1) < 0.01
+
+
+def test_xavier_variance_scaling():
+    """Xavier 'avg' uniform: var = 2*magnitude/(fan_in+fan_out)
+    (reference initializer.py Xavier docstring)."""
+    mx.random.seed(1)
+    fan_in, fan_out = 100, 400
+    w = _one(init.Xavier(rnd_type="uniform", factor_type="avg",
+                         magnitude=3), (fan_out, fan_in))
+    expect = np.sqrt(2.0 * 3 / (fan_in + fan_out))
+    got = w.max()
+    assert abs(got - expect) < expect * 0.05
+    assert abs(w.mean()) < expect * 0.02
+
+
+def test_msra_prelu():
+    mx.random.seed(2)
+    w = _one(init.MSRAPrelu(factor_type="in", slope=0.0), (64, 100))
+    # gaussian with var = 2 / fan_in
+    assert abs(w.std() - np.sqrt(2.0 / 100)) < 0.02
+
+
+def test_orthogonal_is_orthogonal():
+    mx.random.seed(3)
+    w = _one(init.Orthogonal(scale=1.0), (32, 32))
+    eye = w @ w.T
+    np.testing.assert_allclose(eye, np.eye(32), atol=1e-4)
+    # default scale stretches uniformly: W W^T = scale^2 I
+    w2 = _one(init.Orthogonal(), (16, 16))
+    np.testing.assert_allclose(w2 @ w2.T, (1.414 ** 2) * np.eye(16),
+                               atol=1e-3)
+
+
+def test_bilinear_upsample_kernel():
+    w = _one(init.Bilinear(), (1, 1, 4, 4))
+    # symmetric, peak at center block, matches the closed form
+    k = w[0, 0]
+    np.testing.assert_allclose(k, k[::-1, :], atol=1e-6)
+    np.testing.assert_allclose(k, k[:, ::-1], atol=1e-6)
+    f = np.ceil(4 / 2.)
+    c = (2 * f - 1 - f % 2) / (2. * f)
+    expect00 = (1 - abs(0 / f - c)) ** 2
+    np.testing.assert_allclose(k[0, 0], expect00, rtol=1e-6)
+
+
+def test_lstmbias_forget_gate():
+    b = _one(init.LSTMBias(forget_bias=1.0), (4 * 8,), name="bias")
+    b = b.reshape(4, 8)
+    assert (b[1] == 1.0).all()            # forget gate slice
+    assert (b[[0, 2, 3]] == 0.0).all()
+
+
+def test_mixed_patterns_and_fallthrough():
+    mixed = init.Mixed([".*bias", ".*"],
+                       [init.Zero(), init.One()])
+    assert (_one(mixed, (4,), name="fc1_bias") == 0).all()
+    assert (_one(mixed, (4,), name="fc1_weight") == 1).all()
+    with pytest.raises(ValueError, match="did not match"):
+        init.Mixed(["only_this"], [init.Zero()])(
+            init.InitDesc("other"), nd.zeros((2,)))
+
+
+def test_load_initializer(tmp_path):
+    from mxnet_tpu.serialization import save_ndarrays
+    path = str(tmp_path / "w.params")
+    save_ndarrays(path, {"arg:weight": nd.array(np.full((2, 2), 7.0,
+                                                        np.float32))})
+
+    ld = init.Load(path, default_init=init.Zero())
+    assert (_one(ld, (2, 2), name="weight") == 7.0).all()
+    # default-init fallback needs a recognized suffix (same contract as
+    # the reference: unknown names raise, guiding users to Variable(init=))
+    assert (_one(ld, (3,), name="other_weight") == 0).all()
+    with pytest.raises(AssertionError, match="Shape mismatch"):
+        _one(ld, (5, 5), name="weight")
+
+
+def test_registry_create_and_dumps_roundtrip():
+    x = init.create("xavier", rnd_type="gaussian", magnitude=2.0)
+    assert isinstance(x, init.Xavier)
+    import json
+    klass, kwargs = json.loads(x.dumps())
+    assert klass.lower() == "xavier" and kwargs["magnitude"] == 2.0
+
+
+def test_init_desc_attrs_override():
+    """InitDesc attrs (__init__ attr on a variable) override the global
+    initializer — the reference's per-variable __init__ mechanism."""
+    net = mx.sym.Variable("myw_weight", init=init.One())
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), weight=net,
+                                num_hidden=2, no_bias=True, name="fc")
+    mod = mx.mod.Module(net, label_names=None)
+    mod.bind(data_shapes=[("data", (1, 2))], for_training=False)
+    mod.init_params(init.Zero())
+    arg, _ = mod.get_params()
+    assert (arg["myw_weight"].asnumpy() == 1).all()
