@@ -38,6 +38,8 @@ from .ssd import ssd_vgg16, ssd_toy
 from . import ssd as _ssd
 from .transformer import transformer_lm, transformer_decode_step
 from .generation import beam_search
+from . import vit as _vit  # module ref BEFORE the function shadows the name
+from .vit import vit
 from . import transformer as _transformer
 from . import densenet as _densenet
 
@@ -47,6 +49,7 @@ _REGISTRY = {
     "inception_bn": _inception_bn, "inception-v3": _inception_v3,
     "inception_v3": _inception_v3, "mobilenet": _mobilenet,
     "squeezenet": _squeezenet, "densenet": _densenet,
+    "vit": _vit,
 }
 
 

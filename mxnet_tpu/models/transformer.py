@@ -18,8 +18,10 @@ from .. import symbol as sym
 
 
 def _attention_block(x, seq_len, d_model, num_heads, name,
-                     num_kv_heads=None):
-    """x: (B, S, d) → (B, S, d) causal flash attention + projection.
+                     num_kv_heads=None, causal=True):
+    """x: (B, S, d) → (B, S, d) flash attention + projection (causal by
+    default — the LM; causal=False gives the bidirectional encoder form
+    ViT uses).
 
     ``num_kv_heads < num_heads`` = grouped-query attention (num_kv_heads=1
     is MQA): the QKV projection emits only num_kv_heads K/V heads and the
@@ -29,6 +31,9 @@ def _attention_block(x, seq_len, d_model, num_heads, name,
     hk = h if num_kv_heads is None else num_kv_heads
     if hk < 1 or h % hk:
         raise ValueError(f"num_heads {h} not divisible by kv heads {hk}")
+    if d_model % h:
+        raise ValueError(
+            f"d_model {d_model} not divisible by num_heads {h}")
     hd = d_model // h
     flat = sym.Reshape(x, shape=(-1, d_model))
     qkv = sym.FullyConnected(flat, num_hidden=(h + 2 * hk) * hd,
@@ -43,7 +48,7 @@ def _attention_block(x, seq_len, d_model, num_heads, name,
         return sym.transpose(t, axes=(0, 2, 1, 3))    # (B, nh, S, hd)
 
     attn = sym.contrib.FlashAttention(heads(q, h), heads(k, hk),
-                                      heads(v, hk), causal=True,
+                                      heads(v, hk), causal=causal,
                                       name=f"{name}_flash")
     attn = sym.transpose(attn, axes=(0, 2, 1, 3))     # (B, S, H, hd)
     attn = sym.Reshape(attn, shape=(-1, d_model))

@@ -1,0 +1,68 @@
+"""Vision Transformer builder — the image-side member of the
+new-capability transformer track.
+
+No reference analog (the ViT postdates the reference by years); built
+from the SAME blocks as the transformer LM (models/transformer.py) with
+``causal=False`` — so the Pallas flash-attention kernel, GQA, AMP bf16
+contract, and tp sharding rules all carry over unchanged.  TPU-first
+choices: patchify is ONE strided Convolution (an MXU matmul over
+unfolded patches, no im2col materialization), global-average-pool head
+instead of a CLS token (static shapes — no batch-dependent concat in
+the jitted graph; the GAP variant is standard and accuracy-equivalent
+at this scale).
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+from .transformer import _attention_block, _ffn_block
+
+
+def vit(num_classes, image_shape=(3, 224, 224), patch_size=16,
+        num_layers=12, d_model=384, num_heads=6, num_kv_heads=None,
+        d_ff=None):
+    """ViT classifier train symbol: data (B, C, H, W),
+    softmax_label (B,).  Defaults ≈ ViT-S/16."""
+    if isinstance(image_shape, str):   # registry convention: "3,224,224"
+        image_shape = tuple(int(x) for x in image_shape.split(","))
+    if d_model % num_heads:
+        raise ValueError(
+            f"vit: d_model {d_model} not divisible by num_heads "
+            f"{num_heads} — head_dim must be integral or attention "
+            "reshapes would straddle token boundaries")
+    c, h, w = image_shape
+    if h % patch_size or w % patch_size:
+        raise ValueError(
+            f"vit: image {h}x{w} not divisible by patch {patch_size}")
+    gh, gw = h // patch_size, w // patch_size
+    seq_len = gh * gw
+    d_ff = d_ff or 4 * d_model
+
+    data = sym.Variable("data")
+    # patch embedding: one strided conv == per-patch linear projection
+    x = sym.Convolution(data, num_filter=d_model,
+                        kernel=(patch_size, patch_size),
+                        stride=(patch_size, patch_size),
+                        no_bias=False, name="patch_embed")
+    x = sym.Reshape(x, shape=(-1, d_model, seq_len))   # (B, d, S)
+    x = sym.transpose(x, axes=(0, 2, 1))               # (B, S, d)
+
+    pos = sym.Variable("pos_embed_weight", shape=(seq_len, d_model))
+    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+
+    for i in range(num_layers):
+        name = f"layer{i}"
+        a = _attention_block(sym.LayerNorm(x, name=f"{name}_ln1"),
+                             seq_len, d_model, num_heads, name,
+                             num_kv_heads=num_kv_heads, causal=False)
+        x = x + a
+        f = _ffn_block(sym.LayerNorm(x, name=f"{name}_ln2"),
+                       seq_len, d_model, d_ff, name)
+        x = x + f
+    x = sym.LayerNorm(x, name="final_ln")
+    x = sym.mean(x, axis=1)                            # GAP over patches
+    logits = sym.FullyConnected(x, num_hidden=num_classes, name="head")
+    return sym.SoftmaxOutput(logits, name="softmax")
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    return vit(num_classes, **kwargs)

@@ -108,3 +108,32 @@ def test_s2d_stem_equivalent_to_conv7():
     o1 = ex1.forward(is_train=False)[0].asnumpy()
     o2 = ex2.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)
+
+
+def test_vit_trains_and_gqa():
+    """ViT builder (models/vit.py): non-causal flash attention blocks,
+    patch conv, GAP head — trains a small net above chance on a linearly
+    separable toy task; GQA variant builds too."""
+    rng = np.random.RandomState(0)
+    n, nc = 64, 4
+    y = rng.randint(0, nc, (n,)).astype('f')
+    # class-dependent mean image: trivially learnable
+    x = rng.randn(n, 3, 16, 16).astype('f') * 0.1
+    for i in range(n):
+        x[i] += int(y[i]) * 0.5
+
+    net = models.vit(nc, image_shape=(3, 16, 16), patch_size=8,
+                     num_layers=1, d_model=32, num_heads=4,
+                     num_kv_heads=2)
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    mx.random.seed(5)
+    mod.fit(it, num_epoch=12, optimizer='adam',
+            optimizer_params={'learning_rate': 3e-3},
+            initializer=mx.initializer.Xavier(),
+            eval_metric='acc')
+    it.reset()
+    metric = mx.metric.Accuracy()
+    mod.score(it, metric)
+    acc = dict(metric.get_name_value())['accuracy']
+    assert acc > 0.7, acc
