@@ -16,9 +16,29 @@ new-capability track).  TPU-first by construction:
 """
 from .. import symbol as sym
 
+import math
+
+
+def _rope_inv_freq(hd, base):
+    """(hd/2,) inverse frequencies base**(-2i/hd), as graph constants."""
+    half = hd // 2
+    idx = sym.arange(start=0, stop=half)
+    return sym.exp(idx * (-2.0 * math.log(base) / hd))
+
+
+def _rope_apply(t, cos, sin, hd):
+    """Rotate (…, hd) pairs (GPT-NeoX half-split form): cos/sin must
+    broadcast against t's leading dims with last dim hd/2."""
+    half = hd // 2
+    t1 = sym.slice_axis(t, axis=3, begin=0, end=half)
+    t2 = sym.slice_axis(t, axis=3, begin=half, end=None)
+    return sym.Concat(
+        sym.broadcast_mul(t1, cos) - sym.broadcast_mul(t2, sin),
+        sym.broadcast_mul(t2, cos) + sym.broadcast_mul(t1, sin), dim=3)
+
 
 def _attention_block(x, seq_len, d_model, num_heads, name,
-                     num_kv_heads=None, causal=True):
+                     num_kv_heads=None, causal=True, rope_cs=None):
     """x: (B, S, d) → (B, S, d) flash attention + projection (causal by
     default — the LM; causal=False gives the bidirectional encoder form
     ViT uses).
@@ -47,7 +67,12 @@ def _attention_block(x, seq_len, d_model, num_heads, name,
         t = sym.Reshape(t, shape=(-1, seq_len, nh, hd))
         return sym.transpose(t, axes=(0, 2, 1, 3))    # (B, nh, S, hd)
 
-    attn = sym.contrib.FlashAttention(heads(q, h), heads(k, hk),
+    qh, kh = heads(q, h), heads(k, hk)
+    if rope_cs is not None:
+        cos, sin = rope_cs
+        qh = _rope_apply(qh, cos, sin, hd)
+        kh = _rope_apply(kh, cos, sin, hd)
+    attn = sym.contrib.FlashAttention(qh, kh,
                                       heads(v, hk), causal=causal,
                                       name=f"{name}_flash")
     attn = sym.transpose(attn, axes=(0, 2, 1, 3))     # (B, S, H, hd)
@@ -83,7 +108,8 @@ def _ffn_block(x, seq_len, d_model, d_ff, name, moe_experts=0, moe_k=1):
 
 def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
                    num_heads=4, num_kv_heads=None, d_ff=None,
-                   moe_experts=0, moe_k=1, max_len=None):
+                   moe_experts=0, moe_k=1, max_len=None,
+                   pos_type="learned", rope_base=10000.0):
     """Causal LM train symbol: data (B, S) token ids,
     softmax_label (B, S) next-token ids.
 
@@ -96,18 +122,35 @@ def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
         raise ValueError(
             f"transformer_lm: max_len ({max_len}) must be >= seq_len "
             f"({seq_len}) — pass the largest bucket as max_len")
+    if pos_type not in ("learned", "rope"):
+        raise ValueError(f"pos_type must be learned|rope, got {pos_type!r}")
     data = sym.Variable("data")
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
                       name="tok_embed")
-    # named *_weight so default initializers recognize it
-    pos = sym.Variable("pos_embed_weight", shape=(max_len, d_model))
-    pos = sym.slice_axis(pos, axis=0, begin=0, end=seq_len)
-    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+    if pos_type == "learned":
+        # named *_weight so default initializers recognize it
+        pos = sym.Variable("pos_embed_weight", shape=(max_len, d_model))
+        pos = sym.slice_axis(pos, axis=0, begin=0, end=seq_len)
+        x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+    rope_cs = None
+    if pos_type == "rope":
+        hd_ = d_model // num_heads
+        if hd_ % 2:
+            raise ValueError(f"rope needs even head_dim, got {hd_}")
+        # ONE angle table shared by every layer (the decode graph does
+        # the same): (1, 1, S, hd/2)
+        ang = sym.broadcast_mul(
+            sym.Reshape(sym.arange(start=0, stop=seq_len),
+                        shape=(1, 1, seq_len, 1)),
+            sym.Reshape(_rope_inv_freq(hd_, rope_base),
+                        shape=(1, 1, 1, hd_ // 2)))
+        rope_cs = (sym.cos(ang), sym.sin(ang))
     for i in range(num_layers):
         name = f"layer{i}"
         a = _attention_block(sym.LayerNorm(x, name=f"{name}_ln1"),
                              seq_len, d_model, num_heads, name,
-                             num_kv_heads=num_kv_heads)
+                             num_kv_heads=num_kv_heads,
+                             rope_cs=rope_cs)
         x = x + a
         f = _ffn_block(sym.LayerNorm(x, name=f"{name}_ln2"),
                        seq_len, d_model, d_ff, name,
@@ -127,7 +170,8 @@ def get_symbol(vocab_size=1000, seq_len=128, **kwargs):
 def transformer_decode_step(vocab_size, max_len, batch_size,
                             num_layers=2, d_model=128,
                             num_heads=4, num_kv_heads=None, d_ff=None,
-                            moe_experts=0, moe_k=1):
+                            moe_experts=0, moe_k=1,
+                            pos_type="learned", rope_base=10000.0):
     """One autoregressive decode step with a rolled KV cache.
 
     Parameter names match ``transformer_lm`` exactly (pass the SAME
@@ -140,10 +184,14 @@ def transformer_decode_step(vocab_size, max_len, batch_size,
     ROLLS left one slot per step (static shapes; validity is a mask
     computed from cur_pos, so jit never sees a dynamic shape).
 
-    Generation length is bounded by ``max_len``: absolute positions feed
-    the positional-embedding lookup, so decoding past max_len steps would
-    silently clamp to the last position — keep prompt+generated tokens
-    within max_len (generate_lm.py enforces this).
+    Generation length is bounded by ``max_len``.  With
+    ``pos_type="learned"`` absolute positions feed the embedding lookup,
+    so decoding past max_len silently clamps to the last position.  With
+    ``pos_type="rope"`` the rolled cache instead becomes a SLIDING
+    window past max_len: the oldest tokens drop out of attention while
+    rotation angles keep growing beyond anything seen in training —
+    different failure mode, same sizing rule: keep prompt+generated
+    tokens within max_len (generate_lm.py enforces this).
 
     Inputs: data (B,) current token ids.  Outputs:
     [logits (B, vocab)] + [new k/v caches per layer] + [cur_pos + 1].
@@ -162,10 +210,25 @@ def transformer_decode_step(vocab_size, max_len, batch_size,
     pos = sym.Variable("cur_pos", shape=(B,))   # float position index
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
                       name="tok_embed")    # (B, d)
-    pos_w = sym.Variable("pos_embed_weight", shape=(max_len, d_model))
-    pv = sym.Embedding(pos, weight=pos_w, input_dim=max_len,
-                       output_dim=d_model, name="pos_lookup")
-    x = x + pv
+    if pos_type == "learned":
+        pos_w = sym.Variable("pos_embed_weight", shape=(max_len, d_model))
+        pv = sym.Embedding(pos, weight=pos_w, input_dim=max_len,
+                           output_dim=d_model, name="pos_lookup")
+        x = x + pv
+    elif pos_type != "rope":
+        raise ValueError(f"pos_type must be learned|rope, got {pos_type!r}")
+    if pos_type == "rope":
+        if hd % 2:
+            raise ValueError(f"rope needs even head_dim, got {hd}")
+        # rotation angles for the CURRENT absolute position, per batch
+        # row: (B, 1, 1, hd/2).  Cached K entries were rotated at THEIR
+        # positions when inserted, so the rolled cache needs no rework —
+        # scores depend only on relative angles.
+        rope_inv = _rope_inv_freq(hd, rope_base)
+        rope_ang = sym.broadcast_mul(
+            sym.Reshape(pos, shape=(-1, 1, 1, 1)),
+            sym.Reshape(rope_inv, shape=(1, 1, 1, hd // 2)))
+        rope_cos, rope_sin = sym.cos(rope_ang), sym.sin(rope_ang)
 
     # cache slot i holds the token at absolute position cur_pos-(L-1-i);
     # slot valid iff i >= max_len - 1 - cur_pos
@@ -190,6 +253,9 @@ def transformer_decode_step(vocab_size, max_len, batch_size,
         vn = sym.Reshape(sym.slice_axis(qkv, axis=1, begin=(h + hk) * hd,
                                         end=(h + 2 * hk) * hd),
                          shape=(-1, hk, 1, hd))
+        if pos_type == "rope":
+            q = _rope_apply(q, rope_cos, rope_sin, hd)
+            kn = _rope_apply(kn, rope_cos, rope_sin, hd)
         kc = sym.Variable(f"{name}_k_cache",
                           shape=(B, hk, max_len, hd))
         vc = sym.Variable(f"{name}_v_cache",
