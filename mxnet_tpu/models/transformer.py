@@ -8,7 +8,7 @@ new-capability track).  TPU-first by construction:
   FA2 backward, O(S) memory), causal;
 * all projections are FullyConnected over (B*S, d) so XLA tiles one big
   MXU matmul per projection instead of S small ones;
-* pre-norm residual blocks, GELU FFN (optionally MoE via _contrib_MoE for
+* pre-norm residual blocks; FFN gelu (default) or SwiGLU (ffn_type='swiglu'); positions learned (default) or rotary (pos_type='rope') (optionally MoE via _contrib_MoE for
   expert parallelism);
 * drops into Module/SoftmaxOutput exactly like every other model here, so
   the fused donated train step, bf16 compute_dtype, tp/sp sharding rules
@@ -82,8 +82,26 @@ def _attention_block(x, seq_len, d_model, num_heads, name,
     return sym.Reshape(out, shape=(-1, seq_len, d_model))
 
 
-def _ffn_block(x, seq_len, d_model, d_ff, name, moe_experts=0, moe_k=1):
+def _ffn_block(x, seq_len, d_model, d_ff, name, moe_experts=0, moe_k=1,
+               ffn_type="gelu"):
     flat = sym.Reshape(x, shape=(-1, d_model))
+    if ffn_type == "swiglu" and moe_experts:
+        raise ValueError(
+            "ffn_type='swiglu' with moe_experts>0 is not supported — "
+            "the MoE expert FFN is gelu; drop one of the two options")
+    if ffn_type == "swiglu":
+        # SwiGLU (Shazeer 2020): silu(xW1) * xW3 -> W2.  One fused
+        # projection emits both halves so the MXU sees a single matmul.
+        both = sym.FullyConnected(flat, num_hidden=2 * d_ff,
+                                  name=f"{name}_fc1")   # [gate | lin]
+        gate = sym.slice_axis(both, axis=1, begin=0, end=d_ff)
+        lin = sym.slice_axis(both, axis=1, begin=d_ff, end=None)
+        hdn = gate * sym.sigmoid(gate) * lin
+        out = sym.FullyConnected(hdn, num_hidden=d_model,
+                                 name=f"{name}_fc2")
+        return sym.Reshape(out, shape=(-1, seq_len, d_model))
+    if ffn_type not in ("gelu", "swiglu"):
+        raise ValueError(f"ffn_type must be gelu|swiglu, got {ffn_type!r}")
     if moe_experts:
         gate = sym.Variable(f"{name}_gate_weight",
                             shape=(d_model, moe_experts))
@@ -109,7 +127,8 @@ def _ffn_block(x, seq_len, d_model, d_ff, name, moe_experts=0, moe_k=1):
 def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
                    num_heads=4, num_kv_heads=None, d_ff=None,
                    moe_experts=0, moe_k=1, max_len=None,
-                   pos_type="learned", rope_base=10000.0):
+                   pos_type="learned", rope_base=10000.0,
+                   ffn_type="gelu"):
     """Causal LM train symbol: data (B, S) token ids,
     softmax_label (B, S) next-token ids.
 
@@ -154,7 +173,8 @@ def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
         x = x + a
         f = _ffn_block(sym.LayerNorm(x, name=f"{name}_ln2"),
                        seq_len, d_model, d_ff, name,
-                       moe_experts=moe_experts, moe_k=moe_k)
+                       moe_experts=moe_experts, moe_k=moe_k,
+                       ffn_type=ffn_type)
         x = x + f
     x = sym.LayerNorm(x, name="final_ln")
     logits = sym.FullyConnected(sym.Reshape(x, shape=(-1, d_model)),
@@ -171,7 +191,8 @@ def transformer_decode_step(vocab_size, max_len, batch_size,
                             num_layers=2, d_model=128,
                             num_heads=4, num_kv_heads=None, d_ff=None,
                             moe_experts=0, moe_k=1,
-                            pos_type="learned", rope_base=10000.0):
+                            pos_type="learned", rope_base=10000.0,
+                            ffn_type="gelu"):
     """One autoregressive decode step with a rolled KV cache.
 
     Parameter names match ``transformer_lm`` exactly (pass the SAME
@@ -289,7 +310,7 @@ def transformer_decode_step(vocab_size, max_len, batch_size,
         f = _ffn_block(sym.expand_dims(
             sym.LayerNorm(x, name=f"{name}_ln2"), axis=1),
             1, d_model, d_ff, name,
-            moe_experts=moe_experts, moe_k=moe_k)
+            moe_experts=moe_experts, moe_k=moe_k, ffn_type=ffn_type)
         x = x + sym.Reshape(f, shape=(-1, d_model))
     x = sym.LayerNorm(x, name="final_ln")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")

@@ -424,3 +424,44 @@ def test_rope_lm_trains():
     mod.score(it, metric)
     ppl = dict(metric.get_name_value())['perplexity']
     assert ppl < 4.0, ppl
+
+
+def test_swiglu_decode_parity_and_training():
+    """ffn_type='swiglu': fused gate|lin projection; train-vs-decode
+    parity (weights shared by name) and convergence."""
+    V, S, L = 24, 8, 8
+    kw = dict(num_layers=1, d_model=32, num_heads=4,
+              pos_type="rope", ffn_type="swiglu")
+    net = models.transformer_lm(V, S, **kw)
+    B = 2
+    rs = np.random.RandomState(6)
+    toks = rs.randint(0, V, (B, S)).astype('float32')
+    mod = mx.mod.Module(net, context=mx.cpu(0), data_names=('data',),
+                        label_names=('softmax_label',))
+    mod.bind(data_shapes=[('data', (B, S))],
+             label_shapes=[('softmax_label', (B, S))], for_training=False)
+    mx.random.seed(23)
+    mod.init_params(mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+    # swiglu fc1 carries both halves
+    assert arg_params['layer0_fc1_weight'].shape[0] == 2 * 4 * 32
+    mod.forward(mx.io.DataBatch([mx.nd.array(toks)], []), is_train=False)
+    probs_tf = mod.get_outputs()[0].asnumpy().reshape(B, S, V)
+
+    dec = models.transformer_decode_step(V, L, B, **kw)
+    dmod = mx.mod.Module(dec, context=mx.cpu(0), data_names=('data',),
+                         label_names=None,
+                         state_names=['layer0_k_cache', 'layer0_v_cache',
+                                      'cur_pos'])
+    dmod.bind(data_shapes=[('data', (B,))], for_training=False)
+    dmod.init_params(arg_params=arg_params, aux_params=aux_params,
+                     allow_missing=False)
+    dmod.set_states(value=0)
+    for t in range(S):
+        dmod.forward(mx.io.DataBatch([mx.nd.array(toks[:, t])], []))
+        res = dmod.get_outputs()
+        dmod.set_states(states=res[1:])
+        logits = res[0].asnumpy()
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        np.testing.assert_allclose(e / e.sum(1, keepdims=True),
+                                   probs_tf[:, t], rtol=2e-4, atol=2e-5)
