@@ -25,6 +25,9 @@ def score(network, batch_size, image_shape, num_classes, dtype, repeat):
     kwargs = {}
     if network == 'resnet':
         kwargs['num_layers'] = 50
+    if network == 'vit':
+        kwargs.update(patch_size=16, num_layers=12, d_model=384,
+                      num_heads=6)   # ViT-S/16
     sym = models.get_symbol(network, num_classes=num_classes,
                             image_shape=','.join(map(str, image_shape)),
                             **kwargs)

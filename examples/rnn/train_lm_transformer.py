@@ -40,6 +40,10 @@ if __name__ == '__main__':
     parser.add_argument('--d-model', type=int, default=128)
     parser.add_argument('--num-heads', type=int, default=4)
     parser.add_argument('--moe-experts', type=int, default=0)
+    parser.add_argument('--pos-type', choices=['learned', 'rope'],
+                        default='learned')
+    parser.add_argument('--ffn-type', choices=['gelu', 'swiglu'],
+                        default='gelu')
     parser.set_defaults(num_epochs=3, batch_size=32, lr=3e-3,
                         optimizer='adam')
     args = parser.parse_args()
@@ -52,7 +56,9 @@ if __name__ == '__main__':
                                 num_layers=args.num_tf_layers,
                                 d_model=args.d_model,
                                 num_heads=args.num_heads,
-                                moe_experts=args.moe_experts)
+                                moe_experts=args.moe_experts,
+                                pos_type=args.pos_type,
+                                ffn_type=args.ffn_type)
     import logging
     logging.basicConfig(level=logging.INFO)
     compute_dtype = None
