@@ -115,3 +115,29 @@ def test_export_multi_input_name_binding(tmp_path):
     xa = np.full((4,), 10.0, np.float32)
     (out,) = m(xb, xa)  # artifact order = data_shapes order: in_b, in_a
     np.testing.assert_allclose(out, 2 * xa + xb)
+
+
+@pytest.mark.slow
+def test_export_resnet18_artifact(tmp_path):
+    """Realistic-size artifact: ResNet-18 (BN aux states, 60+ convs)
+    exports and matches the live forward."""
+    from mxnet_tpu import models, model as mx_model
+    net = models.resnet(num_classes=10, num_layers=18,
+                        image_shape=(3, 64, 64))
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (2, 3, 64, 64))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(0)
+    mod.init_params(mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "r18")
+    mx_model.save_checkpoint(prefix, 0, net, arg, aux)
+    path = str(tmp_path / "r18.mxtpu_aot")
+    aot.export_checkpoint(prefix, 0, [("data", (2, 3, 64, 64))], path)
+    m = aot.load(path)
+    x = np.random.RandomState(2).rand(2, 3, 64, 64).astype("f")
+    got = m(x)[0]
+    mod.forward(DataBatch(data=[mx.nd.array(x)],
+                          label=[mx.nd.zeros((2,))]), is_train=False)
+    want = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
