@@ -228,8 +228,7 @@ class NDArray:
         if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
             v = jnp.broadcast_to(jnp.asarray(value, self._data.dtype),
                                  self.shape)
-            self._set_data(v + jnp.zeros_like(self._data) * 0 if False else
-                           jnp.asarray(v))
+            self._set_data(jnp.asarray(v))
             return
         self._set_data(self._data.at[key].set(
             value if not isinstance(value, np.ndarray) else jnp.asarray(value)))
