@@ -205,3 +205,11 @@ def with_last_good(base):
     except Exception:  # noqa: BLE001 — error path must never throw
         pass
     return out
+
+
+def is_cpu_device(device) -> bool:
+    """True when a measurement's device field names a CPU backend.
+    THE predicate for "not chip evidence" — shared by bench.py's banking
+    gate, the defaults promoter, and the shell watchers' extraction, so
+    the definition can't drift between the writers and the reader."""
+    return "cpu" in str(device or "").lower()

@@ -125,3 +125,38 @@ def test_zero1_example():
                env_extra={"XLA_FLAGS":
                           "--xla_force_host_platform_device_count=8"})
     assert "per-chip shard" in out and "done" in out
+
+
+def test_promote_defaults_ignores_cpu_rows(tmp_path, monkeypatch):
+    """CI's CPU bench smoke must never become the promoted TPU defaults
+    (a cpu row as latest-device once flipped BENCH_DEFAULTS.json to
+    batch 8)."""
+    import json
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "promote", os.path.join(ROOT, "tools",
+                                "promote_bench_defaults.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    log = tmp_path / "BENCH_LOG.jsonl"
+    out = tmp_path / "BENCH_DEFAULTS.json"
+    rows = [
+        {"metric": "resnet50_train_imgs_per_sec", "value": 2000.0,
+         "batch": 512, "stem": "s2d", "opt": "sgd", "dtype": "bfloat16",
+         "remat": "0", "device": "TPU v5 lite", "data_mode": "synthetic"},
+        {"metric": "resnet50_train_imgs_per_sec", "value": 0.7,
+         "batch": 8, "stem": "conv7", "opt": "sgd", "dtype": "bfloat16",
+         "remat": "0", "device": "cpu", "data_mode": "synthetic"},
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    monkeypatch.setattr(mod, "LOG", str(log))
+    monkeypatch.setattr(mod, "OUT", str(out))
+    assert mod.main() == 0
+    d = json.loads(out.read_text())
+    assert d["batch"] == 512 and d["promoted_from"]["device"] == "TPU v5 lite"
+
+    # cpu-only log promotes nothing
+    log.write_text(json.dumps(rows[1]) + "\n")
+    out.unlink()
+    assert mod.main() == 0
+    assert not out.exists()

@@ -22,7 +22,11 @@ while true; do
   line=$(printf '%s' "$out" | tail -1)
   val=$(printf '%s' "$line" | python -c \
     'import json,sys
-try: print(json.loads(sys.stdin.read()).get("value"))
+try:
+    d = json.loads(sys.stdin.read())
+    # cpu fallback runs are not chip evidence: never bank them
+    print("None" if "cpu" in str(d.get("device","")).lower()
+          else d.get("value"))
 except Exception: print("None")')
   if [ "$val" != "None" ] && [ -n "$val" ]; then
     printf '%s\n' "$(printf '%s' "$line" | python -c \

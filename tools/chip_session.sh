@@ -42,7 +42,11 @@ run_bench() {  # run_bench <tag> [env overrides...]
   local val
   val=$(printf '%s' "$out" | python -c \
     'import json,sys
-try: print(json.loads(sys.stdin.read()).get("value"))
+try:
+    d = json.loads(sys.stdin.read())
+    # cpu fallback = the chip session is NOT on the chip: treat as failed
+    print("None" if "cpu" in str(d.get("device","")).lower()
+          else d.get("value"))
 except Exception: print("None")')
   if [ "$val" != "None" ] && [ -n "$val" ]; then
     printf '%s' "$out" | python -c \

@@ -50,6 +50,15 @@ def main():
     if not rows:
         print("promote: no successful synthetic measurements yet")
         return 0
+    # CPU rows never inform TPU defaults (CI smoke runs once polluted
+    # the log before bench.py stopped banking them — filter defensively
+    # for logs written by older bench versions)
+    sys.path.insert(0, ROOT)
+    from benchmark._bench_common import is_cpu_device
+    rows = [d for d in rows if not is_cpu_device(d.get("device"))]
+    if not rows:
+        print("promote: no chip measurements yet")
+        return 0
     # only the CURRENT chip's measurements count: a device swap must not
     # leave stale all-time-max defaults (e.g. a batch the new chip OOMs)
     device = rows[-1].get("device")
