@@ -164,6 +164,17 @@ PyObject *str_tuple(const char **strs, int n) {
   return t;
 }
 
+/* CSR-layout shapes (indptr/data) -> nested Python tuple of tuples */
+PyObject *shapes_tuple(const int64_t *indptr, const int64_t *data, int n) {
+  PyObject *shapes = PyTuple_New(n);
+  for (int i = 0; i < n; ++i) {
+    int64_t lo = indptr[i], hi = indptr[i + 1];
+    PyTuple_SET_ITEM(shapes, i,
+                     shape_tuple(data + lo, static_cast<int>(hi - lo)));
+  }
+  return shapes;
+}
+
 PyObject *handle_tuple(const MXTHandle *hs, int n) {
   PyObject *t = PyTuple_New(n);
   for (int i = 0; i < n; ++i) {
@@ -504,13 +515,7 @@ int MXTPredCreate(const char *symbol_json, const char *param_path,
                   const char **input_names, const int64_t *shape_indptr,
                   const int64_t *shape_data, MXTHandle *out) {
   API_ENTER();
-  PyObject *shapes = PyTuple_New(num_input);
-  for (int i = 0; i < num_input; ++i) {
-    int64_t lo = shape_indptr[i], hi = shape_indptr[i + 1];
-    PyTuple_SET_ITEM(shapes, i,
-                     shape_tuple(shape_data + lo,
-                                 static_cast<int>(hi - lo)));
-  }
+  PyObject *shapes = shapes_tuple(shape_indptr, shape_data, num_input);
   PyObject *r = call("predictor_create",
                      Py_BuildValue("(ssiiNN)", symbol_json, param_path,
                                    dev_type, dev_id,
@@ -518,6 +523,20 @@ int MXTPredCreate(const char *symbol_json, const char *param_path,
                                    shapes));
   if (r == nullptr) return -1;
   *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredReshape(MXTHandle pred, int num_input,
+                   const char **input_names, const int64_t *shape_indptr,
+                   const int64_t *shape_data) {
+  API_ENTER();
+  PyObject *shapes = shapes_tuple(shape_indptr, shape_data, num_input);
+  PyObject *r = call("predictor_reshape",
+                     Py_BuildValue("(KNN)", pred,
+                                   str_tuple(input_names, num_input),
+                                   shapes));
+  if (r == nullptr) return -1;
   Py_DECREF(r);
   return 0;
 }

@@ -111,6 +111,12 @@ int MXTPredCreate(const char *symbol_json, const char *param_path,
                   int dev_type, int dev_id, int num_input,
                   const char **input_names, const int64_t *shape_indptr,
                   const int64_t *shape_data, MXTHandle *out);
+/* New input shapes, parameters kept (reference: MXPredReshape) — names
+ * must match the ones the predictor was created with; pending inputs
+ * are cleared. */
+int MXTPredReshape(MXTHandle pred, int num_input,
+                   const char **input_names, const int64_t *shape_indptr,
+                   const int64_t *shape_data);
 /* `size` = number of float32 elements (must match the declared shape). */
 int MXTPredSetInput(MXTHandle pred, const char *name, const float *data,
                     size_t size);

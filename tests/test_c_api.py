@@ -278,3 +278,62 @@ def test_threaded_calls(lib):
     # a deadlocked worker must FAIL the test, not time out silently
     assert not any(t.is_alive() for t in threads), "worker hung"
     assert not errors, errors[:3]
+
+
+def test_predictor_reshape(lib, tmp_path):
+    """MXTPredReshape: batch switch keeps weights (reference:
+    MXPredReshape, c_predict_api.h)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import model as mx_model
+    from mxnet_tpu.io import DataBatch
+
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=5, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(12)
+    mod.init_params(mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "p")
+    mx_model.save_checkpoint(prefix, 0, net, arg, aux)
+
+    with open(prefix + "-symbol.json", "rb") as f:
+        js = f.read()
+    names = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_int64 * 2)(0, 2)
+    shape2 = (ctypes.c_int64 * 2)(2, 6)
+    pred = H()
+    rc = lib.MXTPredCreate(js, (prefix + "-0000.params").encode(), 1, 0,
+                           1, names, indptr, shape2, ctypes.byref(pred))
+    assert rc == 0, lib.MXTGetLastError()
+
+    # reshape to batch 4 and forward
+    shape4 = (ctypes.c_int64 * 2)(4, 6)
+    assert lib.MXTPredReshape(pred, 1, names, indptr, shape4) == 0, \
+        lib.MXTGetLastError()
+    x = np.random.RandomState(3).rand(4, 6).astype(np.float32)
+    assert lib.MXTPredSetInput(pred, b"data",
+                               x.ctypes.data_as(
+                                   ctypes.POINTER(ctypes.c_float)),
+                               x.size) == 0, lib.MXTGetLastError()
+    assert lib.MXTPredForward(pred) == 0, lib.MXTGetLastError()
+    out = np.zeros((4, 5), np.float32)
+    assert lib.MXTPredGetOutput(pred, 0,
+                                out.ctypes.data_as(
+                                    ctypes.POINTER(ctypes.c_float)),
+                                out.size) == 0, lib.MXTGetLastError()
+
+    mod4 = mx.mod.Module(net, label_names=("softmax_label",))
+    mod4.bind(data_shapes=[("data", (4, 6))],
+              label_shapes=[("softmax_label", (4,))], for_training=False)
+    mod4.set_params(arg, aux)
+    mod4.forward(DataBatch([mx.nd.array(x)], [mx.nd.zeros((4,))]),
+                 is_train=False)
+    want = mod4.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    # wrong names must fail clearly
+    bad = (ctypes.c_char_p * 1)(b"nope")
+    assert lib.MXTPredReshape(pred, 1, bad, indptr, shape4) == -1
+    assert b"must match" in lib.MXTGetLastError()
+    assert lib.MXTPredFree(pred) == 0
