@@ -603,3 +603,76 @@ int MXTPredGetOutput(MXTHandle pred, int index, float *data, size_t size) {
 int MXTPredFree(MXTHandle pred) { return MXTNDArrayFree(pred); }
 
 }  /* extern "C" */
+
+/* ------------------------------------------------------------ autograd */
+
+extern "C" {
+
+int MXTAutogradSetIsRecording(int recording, int *prev) {
+  API_ENTER();
+  PyObject *r = call("autograd_set_recording",
+                     Py_BuildValue("(i)", recording));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTAutogradSetIsTraining(int training, int *prev) {
+  API_ENTER();
+  PyObject *r = call("autograd_set_training",
+                     Py_BuildValue("(i)", training));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTAutogradIsRecording(int *out) {
+  API_ENTER();
+  PyObject *r = call("autograd_is_recording", nullptr);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayAttachGrad(MXTHandle h, const char *grad_req) {
+  API_ENTER();
+  PyObject *r = call("ndarray_attach_grad",
+                     Py_BuildValue("(Ks)", h, grad_req));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayGetGrad(MXTHandle h, MXTHandle *out) {
+  API_ENTER();
+  PyObject *r = call("ndarray_get_grad", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTAutogradBackward(int num_heads, const MXTHandle *heads,
+                        int retain_graph, int train_mode) {
+  API_ENTER();
+  PyObject *r = call("autograd_backward",
+                     Py_BuildValue("(Nii)",
+                                   handle_tuple(heads, num_heads),
+                                   retain_graph, train_mode));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  /* extern "C" */
+
+extern "C" int MXTAutogradClearTape(void) {
+  API_ENTER();
+  PyObject *r = call("autograd_clear_tape", nullptr);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}

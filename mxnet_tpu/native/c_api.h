@@ -103,6 +103,23 @@ int MXTSymbolListOutputs(MXTHandle h, char *buf, size_t bufsize,
                          size_t *needed);
 int MXTSymbolFree(MXTHandle h);
 
+/* ---------------------------------------------------------- autograd -- */
+/* reference: MXAutogradSetIsRecording / MXAutogradSetIsTraining /
+ * MXAutogradIsRecording / MXNDArrayAttachGrad (via autograd
+ * mark_variables) / MXAutogradBackwardEx (c_api_ndarray.cc) */
+int MXTAutogradSetIsRecording(int recording, int *prev);
+int MXTAutogradSetIsTraining(int training, int *prev);
+int MXTAutogradIsRecording(int *out);
+/* grad_req: "write" | "add" */
+int MXTNDArrayAttachGrad(MXTHandle h, const char *grad_req);
+/* New handle to the gradient buffer of `h` (after a backward). */
+int MXTNDArrayGetGrad(MXTHandle h, MXTHandle *out);
+int MXTAutogradBackward(int num_heads, const MXTHandle *heads,
+                        int retain_graph, int train_mode);
+/* Drop recorded state without a backward (abandoned graphs; a FAILED
+ * MXTAutogradBackward clears the tape itself). */
+int MXTAutogradClearTape(void);
+
 /* -------------------------------------------------------- Predictor -- */
 /* Predict-only deployment API. reference: c_predict_api.h MXPredCreate
  * (shape_indptr/shape_data CSR layout kept), MXPredSetInput,

@@ -337,3 +337,44 @@ def test_predictor_reshape(lib, tmp_path):
     assert lib.MXTPredReshape(pred, 1, bad, indptr, shape4) == -1
     assert b"must match" in lib.MXTGetLastError()
     assert lib.MXTPredFree(pred) == 0
+
+
+def test_autograd_through_c_abi(lib):
+    """Record → backward → read gradient, all through the flat C ABI
+    (reference: MXAutogradSetIsRecording/BackwardEx, c_api_ndarray.cc)."""
+    x = np.array([[1.0, -2.0], [3.0, -0.5]], np.float32)
+    hx = _from_numpy(lib, x)
+    assert lib.MXTNDArrayAttachGrad(H(hx), b"write") == 0, \
+        lib.MXTGetLastError()
+
+    prev = ctypes.c_int(-1)
+    assert lib.MXTAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    assert prev.value == 0
+    rec = ctypes.c_int(-1)
+    assert lib.MXTAutogradIsRecording(ctypes.byref(rec)) == 0
+    assert rec.value == 1
+    try:
+        (r,) = _invoke(lib, "relu", [hx])
+        (s,) = _invoke(lib, "sum", [r])
+    finally:
+        assert lib.MXTAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+
+    assert lib.MXTAutogradBackward(1, (H * 1)(s), 0, 1) == 0, \
+        lib.MXTGetLastError()
+    g = H()
+    assert lib.MXTNDArrayGetGrad(H(hx), ctypes.byref(g)) == 0, \
+        lib.MXTGetLastError()
+    grad = _to_numpy(lib, g.value)
+    np.testing.assert_array_equal(grad, (x > 0).astype(np.float32))
+    for hh in (hx, r, s, g.value):
+        assert lib.MXTNDArrayFree(H(hh)) == 0
+
+
+def test_autograd_c_abi_guard_rails(lib):
+    x = _from_numpy(lib, np.ones((2, 2), np.float32))
+    # invalid grad_req must error, not silently become write/null
+    assert lib.MXTNDArrayAttachGrad(H(x), b"nope") == -1
+    assert b"grad_req" in lib.MXTGetLastError()
+    # clear-tape entry exists and succeeds even with nothing recorded
+    assert lib.MXTAutogradClearTape() == 0
+    assert lib.MXTNDArrayFree(H(x)) == 0
