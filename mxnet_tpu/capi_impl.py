@@ -108,6 +108,23 @@ def ndarray_nbytes(h: int) -> int:
     return int(np.prod(a.shape or (1,))) * np.dtype(a.dtype).itemsize
 
 
+def ndarray_copy_from(h: int, addr: int, nbytes: int) -> None:
+    """Synchronous host->device copy INTO an existing handle — in-place
+    value update, version-handle semantics preserved (reference:
+    MXNDArraySyncCopyFromCPU, c_api.cc)."""
+    a = _get(h)
+    want = ndarray_nbytes(h)
+    if want != nbytes:
+        raise ValueError("buffer size %d != array bytes %d"
+                         % (nbytes, want))
+    arr = _np_from_addr(addr, a.shape, np.dtype(a.dtype).name)
+    import jax
+    # keep the handle's placement: jnp.asarray would silently move the
+    # value to the default device (copyto() shows the same pattern)
+    dev = a.context.jax_device()
+    a._set_data(jax.device_put(arr, dev))
+
+
 def ndarray_copy_to(h: int, addr: int, nbytes: int) -> None:
     """Synchronous device->host copy into a caller-owned buffer
     (reference: MXNDArraySyncCopyToCPU, c_api.cc)."""

@@ -346,6 +346,18 @@ int MXTNDArraySyncCopyToCPU(MXTHandle h, void *data, size_t nbytes) {
   return 0;
 }
 
+int MXTNDArraySyncCopyFromCPU(MXTHandle h, const void *data,
+                              size_t nbytes) {
+  API_ENTER();
+  PyObject *r = call("ndarray_copy_from",
+                     Py_BuildValue("(KKK)", h,
+                                   reinterpret_cast<uint64_t>(data),
+                                   static_cast<uint64_t>(nbytes)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int MXTNDArrayWaitAll(void) {
   API_ENTER();
   PyObject *r = call("wait_all", nullptr);

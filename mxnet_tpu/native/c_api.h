@@ -66,6 +66,10 @@ int MXTNDArrayGetDType(MXTHandle h, char *buf, size_t bufsize,
 int MXTNDArrayGetNBytes(MXTHandle h, size_t *out);
 /* Blocking device->host copy; nbytes must equal the array's byte size. */
 int MXTNDArraySyncCopyToCPU(MXTHandle h, void *data, size_t nbytes);
+/* Blocking host->device copy INTO an existing handle (in-place value
+ * update; reference: MXNDArraySyncCopyFromCPU). */
+int MXTNDArraySyncCopyFromCPU(MXTHandle h, const void *data,
+                              size_t nbytes);
 int MXTNDArrayWaitAll(void);
 /* Save arrays to the framework's format-stable .params container.
  * `names` may be NULL (positional list). reference: MXNDArraySave. */

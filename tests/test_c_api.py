@@ -378,3 +378,19 @@ def test_autograd_c_abi_guard_rails(lib):
     # clear-tape entry exists and succeeds even with nothing recorded
     assert lib.MXTAutogradClearTape() == 0
     assert lib.MXTNDArrayFree(H(x)) == 0
+
+
+def test_sync_copy_from_cpu(lib):
+    """In-place host->device update of an existing handle."""
+    h = _from_numpy(lib, np.zeros((2, 3), np.float32))
+    newv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    rc = lib.MXTNDArraySyncCopyFromCPU(
+        H(h), newv.ctypes.data_as(ctypes.c_void_p), newv.nbytes)
+    assert rc == 0, lib.MXTGetLastError()
+    np.testing.assert_array_equal(_to_numpy(lib, h), newv)
+    # size mismatch errors cleanly
+    small = np.zeros(2, np.float32)
+    assert lib.MXTNDArraySyncCopyFromCPU(
+        H(h), small.ctypes.data_as(ctypes.c_void_p), small.nbytes) == -1
+    assert b"buffer size" in lib.MXTGetLastError()
+    assert lib.MXTNDArrayFree(H(h)) == 0
