@@ -151,8 +151,16 @@ def _run(batch):
     # a clear diagnostic (guarded_backend_init: the single-client tunnel
     # makes jax.devices() BLOCK when unhealthy)
     import threading
-    import jax
-    dev, err = guarded_backend_init(_mark)
+    # Builder-vs-driver distinction lives in the ENVIRONMENT, not this
+    # call site: chip_session.sh exports RELAY_GUARD_STRICT=1 so builder
+    # bench runs get every guard layer (timeout-parent refusal + deadline
+    # refusal/hard-exit), while the driver's bare `python bench.py` gets
+    # warn-only and can never be blocked by the guard — even if
+    # RELAY_DEADLINE_EPOCH leaked into its environment.
+    strict = os.environ.get("RELAY_GUARD_STRICT") == "1"
+    dev, err = guarded_backend_init(
+        _mark, error_json=_with_last_good(_ERR_BASE),
+        refuse_timeout_parent=strict, enforce_deadline=strict)
     if dev is None:
         print(json.dumps(dict(_with_last_good(_ERR_BASE),
                               error="backend init failed: %s" % err)),
@@ -162,6 +170,7 @@ def _run(batch):
     # a lost tunnel RPC blocks forever with zero CPU — self-bound the run
     # so a parseable error line still lands (BENCH_STALL_DEADLINE_S)
     start_stall_watchdog(_mark, _with_last_good(_ERR_BASE))
+    import jax  # deliberately AFTER the guard: refusals never load PJRT
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu import models

@@ -65,6 +65,138 @@ def start_stall_watchdog(mark, error_json, env_prefix="BENCH"):
     threading.Thread(target=_watch, daemon=True).start()
 
 
+def external_timeout_ancestor():
+    """Return ``"pid:comm"`` for the nearest ancestor process that is a
+    coreutils-``timeout``-style supervisor, or None.
+
+    Why this exists: both round-2/3 relay wedges were caused by an
+    external ``timeout`` SIGTERM-killing a chip client mid-RPC — the
+    single-client relay then blocks every later backend init for hours
+    (docs/PERF_NOTES.md).  Chip clients must self-bound (stall watchdog +
+    internal deadlines) instead; running one under ``timeout`` is the
+    recorded wedge trigger, so the chokepoint detects it up front."""
+    try:
+        pid = os.getpid()
+        for _ in range(32):  # bounded ancestor walk
+            try:
+                with open("/proc/%d/stat" % pid) as f:
+                    stat = f.read()
+                # comm is parenthesized field 2; ppid is field 4 after it
+                ppid = int(stat.rsplit(")", 1)[1].split()[1])
+            except (OSError, ValueError, IndexError):
+                return None
+            if ppid <= 1:
+                return None
+            try:
+                with open("/proc/%d/comm" % ppid) as f:
+                    comm = f.read().strip()
+            except OSError:
+                comm = ""  # raced-away intermediate: keep walking up
+            if comm in ("timeout", "gtimeout"):
+                return "%d:%s" % (ppid, comm)
+            pid = ppid
+    except Exception:  # noqa: BLE001 — guard must never crash the client
+        return None
+    return None
+
+
+def relay_deadline_epoch():
+    """Absolute unix time after which NO builder chip client may hold the
+    relay (the driver's end-of-round bench must find the single-client
+    slot free).  Sourced from $RELAY_DEADLINE_EPOCH — set by the session
+    tooling, NOT a repo file, so the driver's own ``python bench.py``
+    (which runs after that window opens) is never refused.  None = no
+    deadline."""
+    v = os.environ.get("RELAY_DEADLINE_EPOCH", "")
+    try:
+        return float(v) if v else None
+    except ValueError:
+        return None
+
+
+# structured refusal reasons (exit-code mapping must not hang off
+# human-readable message text)
+GUARD_TIMEOUT_PARENT = "timeout_parent"   # misconfiguration: fail loudly
+GUARD_DEADLINE = "deadline"               # end-of-round: stop cleanly
+
+
+def guard_chip_client(mark, error_json, hold_budget_s=0.0,
+                      refuse_timeout_parent=True, enforce_deadline=True):
+    """THE chokepoint every builder-side chip client passes before backend
+    init (VERDICT r3 item 2) — called from guarded_backend_init, so no
+    chip entry point can forget it.  Layers:
+
+    1. refuses to start under an external ``timeout``-style parent (the
+       recorded wedge trigger; ``refuse_timeout_parent=False`` downgrades
+       to a warning — used ONLY by bench.py, whose invoker may be the
+       driver and must never be blocked by this guard);
+    2. refuses to START if now + hold_budget_s crosses
+       $RELAY_DEADLINE_EPOCH (a probe that would straddle the driver's
+       window is the round-3 six-minutes-too-late failure);
+    3. arms an ABSOLUTE hard-exit at the deadline: even a client that
+       started in time cannot idle into the driver's window (the
+       hard-exit prints ``error_json`` + an ``error`` field first — the
+       controlled-exit rationale in start_stall_watchdog applies).
+
+    ``enforce_deadline=False`` additionally disables layers 2–3 — for
+    clients that never touch the relay (CPU smoke modes) or must never be
+    blocked (the driver's bench), even if $RELAY_DEADLINE_EPOCH leaked
+    into their environment.
+
+    Returns (True, None, None) when the client may proceed, else
+    (False, msg, reason) with reason one of GUARD_TIMEOUT_PARENT /
+    GUARD_DEADLINE; refusals do NOT print — the caller's existing
+    single-parseable-line error path owns stdout.  Callers still arm
+    start_stall_watchdog for the idle-RPC case; this guard covers the
+    wall-clock cases."""
+    import threading
+    anc = external_timeout_ancestor()
+    if anc is not None:
+        msg = ("guard refused: external timeout parent (%s) — killing a "
+               "chip client mid-RPC wedges the single-client relay "
+               "(docs/PERF_NOTES.md); chip clients self-bound instead"
+               % anc)
+        if refuse_timeout_parent:
+            mark("GUARD: " + msg)
+            return False, msg, GUARD_TIMEOUT_PARENT
+        mark("GUARD WARNING: external timeout parent (%s) — relying on "
+             "internal deadlines only" % anc)
+    deadline = relay_deadline_epoch() if enforce_deadline else None
+    if deadline is not None:
+        now = time.time()
+        if now + max(0.0, hold_budget_s) >= deadline:
+            msg = ("guard refused: %.0fs to the relay deadline < hold "
+                   "budget %.0fs — the driver's bench window must find "
+                   "the relay free" % (deadline - now, hold_budget_s))
+            mark("GUARD: " + msg)
+            return False, msg, GUARD_DEADLINE
+        if getattr(guard_chip_client, "_hard_exit_armed", False):
+            # idempotent: OOM-retry loops re-enter init
+            return True, None, None
+        guard_chip_client._hard_exit_armed = True
+        # test hook: lets a pytest process that legitimately armed the
+        # thread disarm it again (no production caller ever should)
+        guard_chip_client._disarm = threading.Event()
+
+        def _hard_exit():
+            while True:
+                if guard_chip_client._disarm.is_set():
+                    return
+                left = deadline - time.time()
+                if left <= 0:
+                    out = dict(error_json)
+                    out["error"] = ("relay deadline reached — "
+                                    "hard-exiting to free the relay for "
+                                    "the driver")
+                    print(json.dumps(out), flush=True)
+                    mark("GUARD: deadline hard-exit")
+                    os._exit(4)
+                time.sleep(min(15.0, max(0.5, left / 2)))
+
+        threading.Thread(target=_hard_exit, daemon=True).start()
+    return True, None, None
+
+
 # peak dense bf16 FLOP/s per chip, keyed by jax device_kind substring
 PEAK_BF16 = [
     ("v5 lite", 197e12),   # v5e
@@ -87,7 +219,9 @@ def peak_flops(device_kind):
     return None
 
 
-def guarded_backend_init(mark, env_prefix="BENCH"):
+def guarded_backend_init(mark, env_prefix="BENCH", error_json=None,
+                         hold_budget_s=None, refuse_timeout_parent=True,
+                         enforce_deadline=True):
     """Initialize the jax backend with a deadline per attempt.
 
     Returns (device, None) on success or (None, error_string) on failure.
@@ -96,11 +230,15 @@ def guarded_backend_init(mark, env_prefix="BENCH"):
     attempt is not retried: jax serializes backend init behind a global
     lock, so later attempts just block behind the stuck probe.
 
+    Relay discipline (guard_chip_client) is enforced HERE so no chip
+    entry point can skip it; ``hold_budget_s`` defaults to the init
+    deadline + the stall-watchdog deadline (the longest this client can
+    plausibly hold the relay before its own bounds fire).
+
     Env knobs: {prefix}_INIT_RETRIES (default 3), {prefix}_INIT_TIMEOUT_S
     (default 120).
     """
     import threading
-    import jax
     retries = max(1, int(os.environ.get(env_prefix + "_INIT_RETRIES", "3")))
     try:
         deadline = float(os.environ.get(env_prefix + "_INIT_TIMEOUT_S",
@@ -109,6 +247,24 @@ def guarded_backend_init(mark, env_prefix="BENCH"):
         mark("bad %s_INIT_TIMEOUT_S; using 120" % env_prefix)
         deadline = 120.0
     deadline = max(1.0, deadline)
+    if hold_budget_s is None:
+        try:
+            stall = float(os.environ.get(env_prefix + "_STALL_DEADLINE_S",
+                                         "1200"))
+        except ValueError:
+            stall = 1200.0
+        # worst real relay hold: ONE timed-out init attempt (a hung
+        # attempt is never retried — see the break below) + the stall
+        # watchdog's idle allowance.  chip_session.sh's STEP_BUDGET
+        # (1900s) is calibrated against exactly this bound.
+        hold_budget_s = deadline + max(0.0, stall)
+    ok, gmsg, _reason = guard_chip_client(
+        mark, error_json or {}, hold_budget_s=hold_budget_s,
+        refuse_timeout_parent=refuse_timeout_parent,
+        enforce_deadline=enforce_deadline)
+    if not ok:
+        return None, gmsg
+    import jax
     err = None
     for attempt in range(retries):
         box = {}

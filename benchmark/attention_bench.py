@@ -50,7 +50,9 @@ def main():
     from benchmark._bench_common import (make_mark, guarded_backend_init,
                                          start_stall_watchdog)
     mark = make_mark("attn")
-    dev, err = guarded_backend_init(mark, env_prefix="ATTN")
+    dev, err = guarded_backend_init(
+        mark, env_prefix="ATTN",
+        error_json={"metric": "flash_attention_microbench"})
     if dev is None:
         print(json.dumps({"metric": "flash_attention_microbench",
                           "error": "backend init failed: %s" % err}),

@@ -29,7 +29,7 @@ import numpy as np
 
 from benchmark._bench_common import (  # noqa: E402
     make_mark, peak_flops, guarded_backend_init, make_hard_sync,
-    shrink_iters, start_stall_watchdog)
+    shrink_iters, start_stall_watchdog, with_last_good)
 
 _mark = make_mark("tfb")
 
@@ -52,12 +52,18 @@ _ERR_BASE = {"metric": "transformer_lm_tokens_per_sec", "value": None,
              "unit": "tokens/sec", "vs_baseline": None}
 
 def main():
-    if os.environ.get("TFB_CPU"):     # CPU smoke mode (tests/dev boxes):
+    # same truthiness as chip_convergence_run's DIGITS_CPU: "0" = chip run
+    cpu_smoke = os.environ.get("TFB_CPU", "") not in ("", "0")
+    if cpu_smoke:                     # CPU smoke mode (tests/dev boxes):
         from cpu_pin import pin_cpu   # strip the axon tunnel plugin
         pin_cpu(1)
-    dev, err = guarded_backend_init(_mark, env_prefix="TFB")
+    # CPU smoke mode runs nowhere near the relay: skip the timeout-parent
+    # refusal AND the deadline layers (chip runs keep every layer)
+    dev, err = guarded_backend_init(
+        _mark, env_prefix="TFB", error_json=with_last_good(_ERR_BASE),
+        refuse_timeout_parent=not cpu_smoke,
+        enforce_deadline=not cpu_smoke)
     if dev is None:
-        from benchmark._bench_common import with_last_good
         print(json.dumps(dict(with_last_good(_ERR_BASE),
                               error="backend init failed: %s" % err)),
               flush=True)
@@ -65,8 +71,7 @@ def main():
     _mark("backend up: %s" % dev.device_kind)
     # no tunnel in CPU smoke mode — a long local compile is not a stall
     # (arm anyway when the knob is set explicitly, e.g. for testing)
-    if not os.environ.get("TFB_CPU") or os.environ.get("TFB_STALL_DEADLINE_S"):
-        from benchmark._bench_common import with_last_good
+    if not cpu_smoke or os.environ.get("TFB_STALL_DEADLINE_S"):
         start_stall_watchdog(_mark, with_last_good(_ERR_BASE),
                              env_prefix="TFB")
     import jax
@@ -176,7 +181,7 @@ def main():
             out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 2**30, 2)
     except Exception:  # noqa: BLE001
         pass
-    if not os.environ.get("TFB_CPU"):  # don't log CPU smoke runs
+    if not cpu_smoke:  # don't log CPU smoke runs
         try:
             with open(os.path.join(os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__))), "BENCH_LOG.jsonl"),

@@ -11,10 +11,21 @@ LOG=BENCH_LOG.jsonl
 # stop cleanly between steps past WATCH_DEADLINE_EPOCH: the driver's
 # end-of-round bench must find the single-client relay free (resume
 # logic makes a later relaunch skip completed configs)
+[ -n "${WATCH_DEADLINE_EPOCH:-}" ] \
+  && export RELAY_DEADLINE_EPOCH="$WATCH_DEADLINE_EPOCH"
+# every chip client below is builder-side: refuse hard under an external
+# timeout parent (bench.py is warn-only without this — the driver's path)
+export RELAY_GUARD_STRICT=1
+# A step started this close to the deadline would straddle it; the python
+# clients also hard-exit AT the deadline (guard_chip_client), this check
+# just avoids wasting a partial run.  Default = a bench run's worst-case
+# relay hold (600s init deadline + 1200s stall watchdog) + teardown slack,
+# so the session stops itself before any child guard has to refuse.
+STEP_BUDGET="${CHIP_STEP_BUDGET_S:-1900}"
 deadline_check() {  # deadline_check <label>
   if [ -n "${WATCH_DEADLINE_EPOCH:-}" ] \
-     && [ "$(date +%s)" -ge "$WATCH_DEADLINE_EPOCH" ]; then
-    echo "== [$(TS)] deadline reached — stopping session before $1" >&2
+     && [ "$(($(date +%s) + STEP_BUDGET))" -ge "$WATCH_DEADLINE_EPOCH" ]; then
+    echo "== [$(TS)] within ${STEP_BUDGET}s of deadline — stopping session before $1" >&2
     exit 0
   fi
 }
@@ -69,8 +80,19 @@ except Exception: print("None")')
 # trades detection latency for fewer risky disconnects.
 probe_or_die() {
   echo "== [$(TS)] probing tunnel after failure" >&2
-  PROBE_TIMEOUT_S=90 python tools/tunnel_probe.py >&2 || {
-    echo "== [$(TS)] tunnel dead — aborting session" >&2; exit 1; }
+  PROBE_TIMEOUT_S=90 python tools/tunnel_probe.py >&2
+  local rc=$?
+  if [ "$rc" -eq 2 ]; then
+    echo "== [$(TS)] probe REFUSED by relay guard (misconfigured invocation, not tunnel health) — aborting session" >&2
+    exit 3
+  elif [ "$rc" -eq 3 ] || [ "$rc" -eq 4 ]; then
+    # 3 = declined before starting; 4 = guard hard-exit at the deadline
+    echo "== [$(TS)] probe stopped at relay deadline (rc $rc) — clean end-of-round stop" >&2
+    exit 0
+  elif [ "$rc" -ne 0 ]; then
+    echo "== [$(TS)] tunnel dead — aborting session" >&2
+    exit 1
+  fi
 }
 
 # 1. baseline config first — the driver-verifiable number (VERDICT item 1).

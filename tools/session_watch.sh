@@ -12,8 +12,16 @@ SLEEP="${WATCH_PROBE_SLEEP:-300}"
 # timed-out RPC can itself wedge the relay; staying silent is the only
 # safe behavior).  Empty = no deadline.
 DEADLINE="${WATCH_DEADLINE_EPOCH:-}"
+# Exported so EVERY descendant chip client (probe, bench, convergence,
+# microbenches) is guarded by guard_chip_client's absolute hard-exit —
+# round 3's failure was a probe started before the deadline that hung
+# PAST it, holding the relay into the driver's bench window.
+[ -n "$DEADLINE" ] && export RELAY_DEADLINE_EPOCH="$DEADLINE"
+# Stop probing PROBE_MARGIN seconds early: a probe holds the relay for up
+# to its 90s deadline + teardown, and must be fully gone at the deadline.
+PROBE_MARGIN="${WATCH_PROBE_MARGIN:-180}"
 past_deadline() {
-  [ -n "$DEADLINE" ] && [ "$(date +%s)" -ge "$DEADLINE" ]
+  [ -n "$DEADLINE" ] && [ "$(($(date +%s) + PROBE_MARGIN))" -ge "$DEADLINE" ]
 }
 # 90s probe deadline: see the probe_or_die comment in chip_session.sh —
 # a timed-out probe is itself a mid-RPC disconnect (wedge risk), so err
@@ -23,7 +31,22 @@ while true; do
     echo "[session_watch $(date -u +%H:%M:%SZ)] deadline reached — exiting to leave the relay free for the driver" >&2
     exit 0
   fi
-  if PROBE_TIMEOUT_S=90 python tools/tunnel_probe.py >&2; then
+  PROBE_TIMEOUT_S=90 python tools/tunnel_probe.py >&2
+  probe_rc=$?
+  if [ "$probe_rc" -eq 2 ]; then
+    # guard refusal (exit 2) is NOT tunnel-down: this watcher itself is
+    # misconfigured (external timeout parent) and re-probing forever
+    # would just mask it — fail loudly instead
+    echo "[session_watch $(date -u +%H:%M:%SZ)] probe REFUSED by relay guard — fix the invocation (no external timeout parent)" >&2
+    exit 3
+  fi
+  if [ "$probe_rc" -eq 3 ] || [ "$probe_rc" -eq 4 ]; then
+    # 3 = declined before starting; 4 = the guard hard-exited a hung
+    # probe AT the deadline — both are the normal end-of-round shape
+    echo "[session_watch $(date -u +%H:%M:%SZ)] probe stopped at relay deadline (rc $probe_rc) — exiting to leave the relay free for the driver" >&2
+    exit 0
+  fi
+  if [ "$probe_rc" -eq 0 ]; then
     echo "[session_watch $(date -u +%H:%M:%SZ)] tunnel up — starting chip session" >&2
     if bash tools/chip_session.sh; then
       echo "[session_watch $(date -u +%H:%M:%SZ)] chip session completed" >&2

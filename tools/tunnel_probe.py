@@ -2,14 +2,36 @@
 """Cheap TPU-tunnel liveness probe: exit 0 iff jax.devices() answers
 within PROBE_TIMEOUT_S (default 60).  Keeps the connection hold-time
 short — a hung client occupies the single-client relay slot, so probing
-with the full bench's 600 s deadline can itself delay recovery."""
+with the full bench's 600 s deadline can itself delay recovery.
+
+Goes through the guard_chip_client chokepoint (benchmark/_bench_common):
+refuses to run under an external ``timeout`` parent, refuses to start a
+probe whose own deadline would straddle $RELAY_DEADLINE_EPOCH (the
+round-3 failure: a stuck probe held the relay into the driver's bench
+window), and hard-exits at the deadline regardless."""
 import os
 import sys
 import threading
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark._bench_common import (  # noqa: E402
+    GUARD_DEADLINE, guard_chip_client, make_mark)
+
 
 def main():
     deadline = float(os.environ.get("PROBE_TIMEOUT_S", "60"))
+    mark = make_mark("probe")
+    # hold budget: the probe thread can block for its full deadline plus
+    # interpreter teardown; 30 s of slack covers the exit path
+    ok, gmsg, reason = guard_chip_client(mark, {"metric": "tunnel_probe"},
+                                         hold_budget_s=deadline + 30.0)
+    if not ok:
+        print("tunnel probe refused: %s" % gmsg, file=sys.stderr)
+        # exit 3 = normal end-of-round deadline proximity (callers stop
+        # cleanly); exit 2 = misconfigured invocation (external timeout
+        # parent — callers fail loudly)
+        return 3 if reason == GUARD_DEADLINE else 2
     box = {}
 
     def _probe():
