@@ -184,8 +184,14 @@ def _parse_scalar(v: str):
 
 def imperative_invoke(op_name: str, in_handles, keys, vals):
     """Invoke any registered op by name — the whole ~319-op surface from C
-    (reference: MXImperativeInvoke, c_api_ndarray.cc:165)."""
+    (reference: MXImperativeInvoke, c_api_ndarray.cc:165).  op_name is
+    validated against the op registry — the same source MXTListAllOpNames
+    reports — so a C caller cannot reach arbitrary module-level callables
+    (save/load/array/...) through the op path."""
     from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.ops import registry
+    if registry.find(op_name) is None:  # O(1), same names list_ops sorts
+        raise ValueError("unknown op: %r" % op_name)
     fn = getattr(nd, op_name, None)
     if fn is None or not callable(fn):
         raise ValueError("unknown op: %r" % op_name)
@@ -241,7 +247,14 @@ class _Predictor:
         from mxnet_tpu.ndarray import load as nd_load
         symbol = sym_mod.load_json(symbol_json)
         arg_params, aux_params = {}, {}
-        for k, v in nd_load(param_path).items():
+        loaded = nd_load(param_path)
+        if not isinstance(loaded, dict):
+            # nd.save of a bare list round-trips as a list — useless here
+            raise ValueError(
+                "predictor needs a NAMED .params file (dict of "
+                "name->array, e.g. saved via Module.save_checkpoint); "
+                "%r contains an unnamed list" % param_path)
+        for k, v in loaded.items():
             if ":" in k:
                 tp, name = k.split(":", 1)
                 (arg_params if tp == "arg" else aux_params)[name] = v

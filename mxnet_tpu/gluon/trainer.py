@@ -9,6 +9,8 @@ accepted for API parity and drives update_on_kvstore semantics.
 """
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -56,6 +58,13 @@ class Trainer:
         self._zero_stage = int(zero_stage)
         self._zero_dp = (_par.mesh_shape(mesh).get("dp", 1)
                          if mesh is not None else 1)
+        if not explicit_zero and zero_stage >= 1 and self._zero_dp <= 1:
+            # mirror Module's warning: env-enabled ZeRO without a dp>1
+            # mesh silently leaves optimizer states replicated
+            logging.warning(
+                "MXNET_ZERO_STAGE=1 ignored: no device mesh with dp>1 "
+                "on this Trainer — optimizer states will be fully "
+                "replicated")
         optimizer_params = dict(optimizer_params or {})
         self._init_optimizer(optimizer, optimizer_params)
         self._kv_type = kvstore

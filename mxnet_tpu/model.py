@@ -91,6 +91,201 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(key, g, w)
 
 
+class FeedForward(object):
+    """Legacy estimator-style trainer (reference: python/mxnet/model.py:408
+    ``class FeedForward``).  Deprecated there in favor of Module, and a
+    thin Module wrapper here for the same reason: the fused SPMD training
+    step lives in Module — this class only adapts the sklearn-flavored
+    numpy-in / numpy-out surface (fit/predict/score/save/load/create)
+    onto it.
+
+    Accepts numpy arrays or any DataIter for ``X``; numpy inputs are
+    wrapped in NDArrayIter with ``numpy_batch_size`` rows per batch
+    (reference model.py:583 ``_init_iter``).
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer='sgd', initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        import warnings
+        warnings.warn('FeedForward is deprecated. Please use Module '
+                      'instead.', DeprecationWarning, stacklevel=2)
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = dict(arg_params) if arg_params else None
+        self.aux_params = dict(aux_params) if aux_params else None
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)  # optimizer hyperparams, as reference
+        if allow_extra_params and self.arg_params is not None:
+            names = set(symbol.list_arguments())
+            self.arg_params = {k: v for k, v in self.arg_params.items()
+                               if k in names}
+        if allow_extra_params and self.aux_params is not None:
+            names = set(symbol.list_auxiliary_states())
+            self.aux_params = {k: v for k, v in self.aux_params.items()
+                               if k in names}
+        self._module = None
+
+    # -- input adaptation (reference model.py:583/608) ------------------
+    def _init_iter(self, X, y, is_train):
+        from .io import DataIter, NDArrayIter
+        if isinstance(X, DataIter):
+            return X
+        X = np.asarray(X)
+        if y is not None:
+            y = np.asarray(y)
+        elif is_train:
+            raise ValueError('y must be specified when X is numpy')
+        else:
+            # inference without labels still flows through the loss-head
+            # symbol: zero labels, as reference model.py:583 _init_iter
+            y = np.zeros(X.shape[0], dtype=np.float32)
+        batch = min(self.numpy_batch_size, X.shape[0])
+        # 'discard' for training keeps every batch full (static shapes —
+        # one XLA program); 'pad' for inference covers every row
+        return NDArrayIter(data=X, label=y, batch_size=batch,
+                           shuffle=bool(is_train),
+                           last_batch_handle='discard' if is_train
+                           else 'pad')
+
+    def _make_module(self, data_iter, for_training):
+        from .module import Module
+        data_names = [d[0] for d in data_iter.provide_data]
+        label_names = [l[0] for l in (data_iter.provide_label or [])] \
+            if for_training else None
+        mod = Module(self.symbol, data_names=data_names,
+                     label_names=label_names, context=self.ctx)
+        return mod
+
+    # -- training (reference model.py:748) ------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric='acc',
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore='local', logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        if self.num_epoch is None:
+            raise ValueError('num_epoch must be set when constructing '
+                             'FeedForward for fit')
+        train = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and not hasattr(eval_data, 'provide_data'):
+            ex, ey = eval_data
+            eval_data = self._init_iter(ex, ey, is_train=False)
+        self._module = self._make_module(train, for_training=True)
+        self._module.fit(
+            train, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=dict(self.kwargs),
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+            initializer=self.initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            allow_missing=self.arg_params is not None,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+            monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    # -- inference (reference model.py:628/697) -------------------------
+    def _pred_module(self, data_iter):
+        """Inference Module: loss-head label args (SoftmaxOutput still on
+        the deployed symbol) get dummy bindings, exactly like the C
+        predictor (capi_impl._Predictor) and the reference's
+        c_predict_api consumers, so label-less numpy predict works."""
+        from .module import Module
+        if self.arg_params is None:
+            raise MXNetError('model has no parameters: fit() it or '
+                             'construct with arg_params')
+        data_names = [d[0] for d in data_iter.provide_data]
+        known = set(data_names) | set(self.arg_params) \
+            | set(self.aux_params or {})
+        labels = [n for n in self.symbol.list_arguments()
+                  if n not in known and n.endswith('label')]
+        provided = {l[0]: tuple(l[1])
+                    for l in (data_iter.provide_label or [])}
+        batch = data_iter.provide_data[0][1][0]
+        label_shapes = [(n, provided.get(n, (batch,))) for n in labels]
+        mod = Module(self.symbol, data_names=data_names,
+                     label_names=labels or None, context=self.ctx)
+        mod.bind(data_shapes=data_iter.provide_data,
+                 label_shapes=label_shapes or None, for_training=False)
+        mod.set_params(self.arg_params, self.aux_params or {},
+                       allow_missing=False, allow_extra=True)
+        return mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data_iter = self._init_iter(X, None, is_train=False)
+        mod = self._pred_module(data_iter)
+        outs = mod.predict(data_iter, num_batch=num_batch, reset=reset,
+                           always_output_list=False)
+        if isinstance(outs, list):
+            result = [o.asnumpy() for o in outs]
+        else:
+            result = outs.asnumpy()
+        if return_data:
+            data_iter.reset()
+            datas, labels = [], []
+            for i, batch in enumerate(data_iter):
+                if num_batch is not None and i >= num_batch:
+                    break
+                # trim the final batch's pad rows so data/label rows stay
+                # aligned with the pad-trimmed predictions
+                real = batch.data[0].shape[0] - (batch.pad or 0)
+                datas.append(batch.data[0].asnumpy()[:real])
+                labels.append(batch.label[0].asnumpy()[:real])
+            return result, np.concatenate(datas), np.concatenate(labels)
+        return result
+
+    def score(self, X, eval_metric='acc', num_batch=None,
+              batch_end_callback=None, reset=True):
+        data_iter = self._init_iter(X, None, is_train=False)
+        mod = self._pred_module(data_iter)
+        res = mod.score(data_iter, eval_metric, num_batch=num_batch,
+                        batch_end_callback=batch_end_callback, reset=reset)
+        return res[0][1] if res else None
+
+    # -- persistence (reference model.py:850/873/904) -------------------
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer='sgd', initializer=None,
+               eval_data=None, eval_metric='acc', epoch_end_callback=None,
+               batch_end_callback=None, kvstore='local', logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """reference: model.py:340 — prefix-symbol.json + prefix-%04d.params."""
     if symbol is not None:

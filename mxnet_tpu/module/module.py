@@ -87,6 +87,19 @@ class Module(BaseModule):
             raise MXNetError(
                 "zero_stage=1 needs a device mesh with dp>1 — pass "
                 "mesh= (parallel.make_mesh) or enter a use_mesh scope")
+        if not explicit_zero and zero_stage >= 1:
+            from .. import parallel as _par
+            dp = (_par.mesh_shape(mesh).get("dp", 1)
+                  if mesh is not None else 1)
+            if dp <= 1:
+                # env-enabled ZeRO silently no-ops without a dp>1 mesh —
+                # the user who exported MXNET_ZERO_STAGE=1 must learn the
+                # states are replicated, not sharded (the explicit-kwarg
+                # path raises instead)
+                logging.warning(
+                    "MXNET_ZERO_STAGE=1 ignored: no device mesh with "
+                    "dp>1 on this Module — optimizer states will be "
+                    "fully replicated")
         self._zero_stage = int(zero_stage)
 
         self._symbol = symbol

@@ -1,0 +1,103 @@
+"""FeedForward legacy trainer (reference: python/mxnet/model.py:408).
+
+The sklearn-flavored numpy-in / numpy-out estimator surface, wrapped over
+Module: fit on raw numpy, predict/score, save/load round-trip, and the
+one-call ``FeedForward.create``.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.model import FeedForward
+
+
+def _xor_data(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 2).astype('float32')
+    Y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype('float32')
+    return X, Y
+
+
+def _mlp_symbol(hidden=16, classes=2):
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=hidden, name='fc1')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=classes, name='fc2')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def _fit_model(num_epoch=25):
+    X, Y = _xor_data()
+    with pytest.warns(DeprecationWarning):
+        model = FeedForward(_mlp_symbol(), ctx=mx.cpu(),
+                            num_epoch=num_epoch, numpy_batch_size=40,
+                            optimizer='sgd', learning_rate=0.5,
+                            initializer=mx.initializer.Xavier())
+    model.fit(X, Y)
+    return model, X, Y
+
+
+def test_feedforward_fit_predict_score_numpy():
+    model, X, Y = _fit_model()
+    # numpy in -> numpy out
+    prob = model.predict(X)
+    assert isinstance(prob, np.ndarray)
+    assert prob.shape == (X.shape[0], 2)
+    # score needs labels: pass a labeled iterator
+    it = mx.io.NDArrayIter(X, Y, batch_size=40)
+    acc = model.score(it, 'acc')
+    assert acc > 0.9, acc
+    # predictions agree with the labels the score saw
+    assert (prob.argmax(axis=1) == Y).mean() > 0.9
+
+
+def test_feedforward_predict_return_data():
+    model, X, Y = _fit_model(num_epoch=2)
+    it = mx.io.NDArrayIter(X, Y, batch_size=40)
+    prob, data, label = model.predict(it, return_data=True)
+    assert prob.shape[0] == data.shape[0] == label.shape[0]
+    np.testing.assert_allclose(data, X, rtol=1e-6)
+
+
+def test_feedforward_save_load_roundtrip(tmp_path):
+    model, X, Y = _fit_model(num_epoch=5)
+    prefix = str(tmp_path / 'ff')
+    model.save(prefix, 5)
+    with pytest.warns(DeprecationWarning):
+        loaded = FeedForward.load(prefix, 5, ctx=mx.cpu())
+    p1 = model.predict(X)
+    p2 = loaded.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_feedforward_create_one_call():
+    X, Y = _xor_data()
+    with pytest.warns(DeprecationWarning):
+        model = FeedForward.create(
+            _mlp_symbol(), X, Y, ctx=mx.cpu(), num_epoch=25,
+            optimizer='sgd', learning_rate=0.5,
+            initializer=mx.initializer.Xavier())
+    it = mx.io.NDArrayIter(X, Y, batch_size=40)
+    assert model.score(it, 'acc') > 0.9
+
+
+def test_feedforward_predict_numpy_no_labels_padded():
+    # 50 rows / batch 40: the pad path — predictions trim pad rows, and
+    # label-less numpy input gets the zero-label fallback
+    model, _, _ = _fit_model(num_epoch=2)
+    rng = np.random.RandomState(3)
+    X = rng.randn(50, 2).astype('float32')
+    prob, data, label = model.predict(X, return_data=True)
+    assert prob.shape[0] == 50
+    assert data.shape[0] == 50 and label.shape[0] == 50
+    np.testing.assert_allclose(data, X, rtol=1e-6)
+    assert (label == 0).all()  # zero-label fallback
+
+
+def test_feedforward_numpy_requires_labels_for_fit():
+    X, _ = _xor_data(40)
+    with pytest.warns(DeprecationWarning):
+        model = FeedForward(_mlp_symbol(), num_epoch=1)
+    with pytest.raises(ValueError):
+        model.fit(X)  # numpy X without y

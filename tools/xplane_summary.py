@@ -60,8 +60,11 @@ def summarize(space, top=30):
         # are a good self-time proxy per op name
         agg = collections.defaultdict(lambda: [0, 0])  # name -> [ps, n]
         line_span = [None, None]
+        active_lines = 0
         for line in plane.lines:
+            had_event = False
             for ev in line.events:
+                had_event = True
                 name = ev_meta[ev.metadata_id].name
                 agg[name][0] += ev.duration_ps
                 agg[name][1] += 1
@@ -71,9 +74,13 @@ def summarize(space, top=30):
                     line_span[0] = t0
                 if line_span[1] is None or t1 > line_span[1]:
                     line_span[1] = t1
+            if had_event:
+                active_lines += 1
         total_ps = sum(v[0] for v in agg.values())
-        span_ps = (line_span[1] - line_span[0]) if line_span[0] is not None \
-            else 0
+        # busy time is summed over ALL lines, so the denominator must be
+        # span x active lines or a multi-line plane reads >100% occupancy
+        span_ps = ((line_span[1] - line_span[0]) * max(1, active_lines)
+                   if line_span[0] is not None else 0)
         rows.append((plane.name, agg, total_ps, span_ps))
     print_report(rows, top)
 
@@ -82,7 +89,7 @@ def print_report(rows, top):
     for plane_name, agg, total_ps, span_ps in rows:
         print("== plane: %s" % plane_name)
         if span_ps:
-            print("   busy %.3f ms of %.3f ms span (%.1f%% occupancy)"
+            print("   busy %.3f ms of %.3f ms line-span (%.1f%% occupancy)"
                   % (total_ps / 1e9, span_ps / 1e9,
                      100.0 * total_ps / span_ps))
         items = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
