@@ -432,3 +432,245 @@ def autograd_clear_tape() -> None:
     scope exit)."""
     from mxnet_tpu import autograd
     autograd._clear_tape()
+
+
+# ----------------------------------------------------- module (training)
+# The reference C API could TRAIN from bindings: MXExecutorSimpleBind +
+# the updater loop (src/c_api/c_api_executor.cc:219, c_api.cc MXKVStore*).
+# Here the training engine is Module's fused forward/backward/update —
+# the same one XLA program Python users run — exposed row by row.
+
+def module_create(sym_h: int, data_names, label_names,
+                  dev_type: int, dev_id: int) -> int:
+    import mxnet_tpu as mx
+    mod = mx.mod.Module(_get(sym_h), data_names=tuple(data_names),
+                        label_names=tuple(label_names) or None,
+                        context=_ctx(dev_type, dev_id))
+    return _new_handle(mod)
+
+
+def module_bind(h: int, data_names, data_shapes, label_names,
+                label_shapes, for_training: int) -> None:
+    _get(h).bind(
+        data_shapes=list(zip(data_names,
+                             [tuple(s) for s in data_shapes])),
+        label_shapes=list(zip(label_names,
+                              [tuple(s) for s in label_shapes])) or None,
+        for_training=bool(for_training))
+
+
+def module_init_params(h: int, initializer: str, keys, vals) -> None:
+    from mxnet_tpu import initializer as init_mod
+    kwargs = {k: _parse_scalar(v) for k, v in zip(keys, vals)}
+    _get(h).init_params(init_mod.create(initializer, **kwargs))
+
+
+def module_init_optimizer(h: int, optimizer: str, keys, vals) -> None:
+    params = {k: _parse_scalar(v) for k, v in zip(keys, vals)}
+    _get(h).init_optimizer(optimizer=optimizer, optimizer_params=params)
+
+
+def module_forward(h: int, data_handles, label_handles,
+                   is_train: int) -> None:
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch(data=[_get(d) for d in data_handles],
+                      label=[_get(l) for l in label_handles] or None)
+    _get(h).forward(batch, is_train=bool(is_train))
+
+
+def module_backward(h: int) -> None:
+    _get(h).backward()
+
+
+def module_update(h: int) -> None:
+    _get(h).update()
+
+
+def module_num_outputs(h: int) -> int:
+    return len(_get(h).get_outputs())
+
+
+def module_get_output(h: int, i: int) -> int:
+    return _new_handle(_get(h).get_outputs()[i])
+
+
+def module_save_checkpoint(h: int, prefix: str, epoch: int) -> None:
+    _get(h).save_checkpoint(prefix, epoch)
+
+
+def module_set_params_from_file(h: int, param_path: str) -> None:
+    """Load a Module.save_checkpoint .params file into a bound module
+    (reference flow: MXNDArrayLoad + ExecutorCopyFromParams)."""
+    from mxnet_tpu.ndarray import load as nd_load
+    loaded = nd_load(param_path)
+    if not isinstance(loaded, dict):
+        raise ValueError("need a named .params file")
+    arg, aux = {}, {}
+    for k, v in loaded.items():
+        if ":" in k:
+            tp, name = k.split(":", 1)
+            (arg if tp == "arg" else aux)[name] = v
+        else:
+            arg[k] = v
+    _get(h).set_params(arg, aux, allow_missing=False, allow_extra=True)
+
+
+# ---------------------------------------------------------------- kvstore
+# reference: MXKVStoreCreate/Init(Ex)/Push(Ex)/Pull(Ex)/SetOptimizer/
+# GetRank/GetGroupSize/GetType/Free (src/c_api/c_api.cc)
+
+def kvstore_create(kvtype: str) -> int:
+    from mxnet_tpu import kvstore as kvs
+    return _new_handle(kvs.create(kvtype))
+
+
+def kvstore_init(h: int, keys, val_handles) -> None:
+    kv = _get(h)
+    for k, vh in zip(keys, val_handles):
+        kv.init(k, _get(vh))
+
+
+def kvstore_push(h: int, keys, val_handles, priority: int) -> None:
+    kv = _get(h)
+    for k, vh in zip(keys, val_handles):
+        kv.push(k, _get(vh), priority=priority)
+
+
+def kvstore_pull(h: int, keys, out_handles, priority: int) -> None:
+    kv = _get(h)
+    for k, oh in zip(keys, out_handles):
+        kv.pull(k, out=_get(oh), priority=priority)
+
+
+def kvstore_set_optimizer(h: int, optimizer: str, keys, vals) -> None:
+    from mxnet_tpu import optimizer as opt_mod
+    params = {k: _parse_scalar(v) for k, v in zip(keys, vals)}
+    _get(h).set_optimizer(opt_mod.create(optimizer, **params))
+
+
+def kvstore_rank(h: int) -> int:
+    return int(_get(h).rank)
+
+
+def kvstore_num_workers(h: int) -> int:
+    return int(_get(h).num_workers)
+
+
+def kvstore_type(h: int) -> str:
+    return str(_get(h).type)
+
+
+# --------------------------------------------------------------- dataiter
+# reference: MXListDataIters/MXDataIterCreateIter (by-name + string
+# kwargs, src/c_api/c_api.cc) and the Next/BeforeFirst/GetData/GetLabel/
+# GetPadNum iteration protocol our DataIter already mirrors (io.py).
+
+def _iter_classes():
+    from mxnet_tpu import io as io_mod
+    from mxnet_tpu.image_record_iter import (ImageRecordIter,
+                                             ImageRecordUInt8Iter)
+    return {
+        "NDArrayIter": io_mod.NDArrayIter,
+        "CSVIter": io_mod.CSVIter,
+        "MNISTIter": io_mod.MNISTIter,
+        "LibSVMIter": io_mod.LibSVMIter,
+        "ImageRecordIter": ImageRecordIter,
+        "ImageRecordUInt8Iter": ImageRecordUInt8Iter,
+    }
+
+
+def list_data_iters() -> str:
+    return "\n".join(sorted(_iter_classes()))
+
+
+def dataiter_create(name: str, keys, vals) -> int:
+    cls = _iter_classes().get(name)
+    if cls is None:
+        raise ValueError("unknown data iter: %r (have: %s)"
+                         % (name, ", ".join(sorted(_iter_classes()))))
+    kwargs = {k: _parse_scalar(v) for k, v in zip(keys, vals)}
+    return _new_handle(cls(**kwargs))
+
+
+def dataiter_from_arrays(data_h: int, label_h: int, batch_size: int,
+                         shuffle: int, last_batch_handle: str) -> int:
+    from mxnet_tpu import io as io_mod
+    label = _get(label_h) if label_h else None
+    return _new_handle(io_mod.NDArrayIter(
+        _get(data_h), label, batch_size=batch_size, shuffle=bool(shuffle),
+        last_batch_handle=last_batch_handle))
+
+
+def dataiter_before_first(h: int) -> None:
+    _get(h).reset()
+
+
+def dataiter_next(h: int) -> int:
+    return 1 if _get(h).iter_next() else 0
+
+
+def dataiter_get_data(h: int) -> int:
+    return _new_handle(_get(h).getdata()[0])
+
+
+def dataiter_get_label(h: int) -> int:
+    lab = _get(h).getlabel()
+    if not lab:
+        raise ValueError("iterator has no labels")
+    return _new_handle(lab[0])
+
+
+def dataiter_get_pad(h: int) -> int:
+    return int(_get(h).getpad() or 0)
+
+
+# --------------------------------------------------------------- recordio
+# reference: MXRecordIOWriterCreate/WriteRecord/Free,
+# MXRecordIOReaderCreate/ReadRecord/Free (src/c_api/c_api.cc over
+# dmlc::RecordIO) — same container format recordio.py implements.
+
+def recordio_writer_create(path: str) -> int:
+    from mxnet_tpu.recordio import MXRecordIO
+    return _new_handle(MXRecordIO(path, "w"))
+
+
+class _RecordReader:
+    """Peeking reader: the C size-query protocol calls ReadRecord twice
+    per record (size, then payload) — a second ``read()`` would consume
+    the NEXT record, so the pending one is cached until delivered."""
+
+    def __init__(self, path):
+        from mxnet_tpu.recordio import MXRecordIO
+        self.rio = MXRecordIO(path, "r")
+        self.pending = None
+
+    def peek(self):
+        if self.pending is None:
+            self.pending = self.rio.read()
+        return self.pending
+
+    def advance(self):
+        self.pending = None
+
+
+def recordio_reader_create(path: str) -> int:
+    return _new_handle(_RecordReader(path))
+
+
+def recordio_write(h: int, addr: int, nbytes: int) -> None:
+    buf = (ctypes.c_char * nbytes).from_address(addr)
+    _get(h).write(bytes(buf))
+
+
+def recordio_peek(h: int):
+    """Bytes of the pending record, or None at end of file."""
+    return _get(h).peek()
+
+
+def recordio_advance(h: int) -> None:
+    _get(h).advance()
+
+
+def recordio_close(h: int) -> None:
+    obj = _get(h)
+    (obj.rio if isinstance(obj, _RecordReader) else obj).close()

@@ -679,6 +679,335 @@ int MXTAutogradBackward(int num_heads, const MXTHandle *heads,
   return 0;
 }
 
+/* ------------------------------------------------------------- Module */
+
+/* Shared helpers: a call returning a fresh handle / returning nothing /
+ * returning an int. */
+static int call_handle_out(const char *fn, PyObject *args, MXTHandle *out) {
+  PyObject *r = call(fn, args);
+  if (r == nullptr) return -1;
+  *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+static int call_void(const char *fn, PyObject *args) {
+  PyObject *r = call(fn, args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int call_int_out(const char *fn, PyObject *args, int *out) {
+  PyObject *r = call(fn, args);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTModuleCreate(MXTHandle symbol, int num_data,
+                    const char **data_names, int num_label,
+                    const char **label_names, int dev_type, int dev_id,
+                    MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out(
+      "module_create",
+      Py_BuildValue("(KNNii)", symbol, str_tuple(data_names, num_data),
+                    str_tuple(label_names, num_label), dev_type, dev_id),
+      out);
+}
+
+int MXTModuleBind(MXTHandle mod, int num_data, const char **data_names,
+                  const int64_t *data_indptr, const int64_t *data_shapes,
+                  int num_label, const char **label_names,
+                  const int64_t *label_indptr,
+                  const int64_t *label_shapes, int for_training) {
+  API_ENTER();
+  return call_void(
+      "module_bind",
+      Py_BuildValue("(KNNNNi)", mod, str_tuple(data_names, num_data),
+                    shapes_tuple(data_indptr, data_shapes, num_data),
+                    str_tuple(label_names, num_label),
+                    shapes_tuple(label_indptr, label_shapes, num_label),
+                    for_training));
+}
+
+int MXTModuleInitParams(MXTHandle mod, const char *initializer,
+                        int nparams, const char **keys,
+                        const char **vals) {
+  API_ENTER();
+  return call_void("module_init_params",
+                   Py_BuildValue("(KsNN)", mod, initializer,
+                                 str_tuple(keys, nparams),
+                                 str_tuple(vals, nparams)));
+}
+
+int MXTModuleInitOptimizer(MXTHandle mod, const char *optimizer,
+                           int nparams, const char **keys,
+                           const char **vals) {
+  API_ENTER();
+  return call_void("module_init_optimizer",
+                   Py_BuildValue("(KsNN)", mod, optimizer,
+                                 str_tuple(keys, nparams),
+                                 str_tuple(vals, nparams)));
+}
+
+int MXTModuleForward(MXTHandle mod, int num_data, const MXTHandle *data,
+                     int num_label, const MXTHandle *label, int is_train) {
+  API_ENTER();
+  return call_void("module_forward",
+                   Py_BuildValue("(KNNi)", mod,
+                                 handle_tuple(data, num_data),
+                                 handle_tuple(label, num_label),
+                                 is_train));
+}
+
+int MXTModuleBackward(MXTHandle mod) {
+  API_ENTER();
+  return call_void("module_backward", Py_BuildValue("(K)", mod));
+}
+
+int MXTModuleUpdate(MXTHandle mod) {
+  API_ENTER();
+  return call_void("module_update", Py_BuildValue("(K)", mod));
+}
+
+int MXTModuleGetNumOutputs(MXTHandle mod, int *out) {
+  API_ENTER();
+  return call_int_out("module_num_outputs", Py_BuildValue("(K)", mod),
+                      out);
+}
+
+int MXTModuleGetOutput(MXTHandle mod, int index, MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out("module_get_output",
+                         Py_BuildValue("(Ki)", mod, index), out);
+}
+
+int MXTModuleSaveCheckpoint(MXTHandle mod, const char *prefix,
+                            int epoch) {
+  API_ENTER();
+  return call_void("module_save_checkpoint",
+                   Py_BuildValue("(Ksi)", mod, prefix, epoch));
+}
+
+int MXTModuleSetParamsFromFile(MXTHandle mod, const char *param_path) {
+  API_ENTER();
+  return call_void("module_set_params_from_file",
+                   Py_BuildValue("(Ks)", mod, param_path));
+}
+
+int MXTModuleFree(MXTHandle mod) {
+  API_ENTER();
+  return call_void("free_handle", Py_BuildValue("(K)", mod));
+}
+
+/* ------------------------------------------------------------ KVStore */
+
+int MXTKVStoreCreate(const char *type, MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out("kvstore_create", Py_BuildValue("(s)", type),
+                         out);
+}
+
+int MXTKVStoreInit(MXTHandle kv, int num, const char **keys,
+                   const MXTHandle *vals) {
+  API_ENTER();
+  return call_void("kvstore_init",
+                   Py_BuildValue("(KNN)", kv, str_tuple(keys, num),
+                                 handle_tuple(vals, num)));
+}
+
+int MXTKVStorePush(MXTHandle kv, int num, const char **keys,
+                   const MXTHandle *vals, int priority) {
+  API_ENTER();
+  return call_void("kvstore_push",
+                   Py_BuildValue("(KNNi)", kv, str_tuple(keys, num),
+                                 handle_tuple(vals, num), priority));
+}
+
+int MXTKVStorePull(MXTHandle kv, int num, const char **keys,
+                   const MXTHandle *outs, int priority) {
+  API_ENTER();
+  return call_void("kvstore_pull",
+                   Py_BuildValue("(KNNi)", kv, str_tuple(keys, num),
+                                 handle_tuple(outs, num), priority));
+}
+
+int MXTKVStoreSetOptimizer(MXTHandle kv, const char *optimizer,
+                           int nparams, const char **keys,
+                           const char **vals) {
+  API_ENTER();
+  return call_void("kvstore_set_optimizer",
+                   Py_BuildValue("(KsNN)", kv, optimizer,
+                                 str_tuple(keys, nparams),
+                                 str_tuple(vals, nparams)));
+}
+
+int MXTKVStoreGetRank(MXTHandle kv, int *out) {
+  API_ENTER();
+  return call_int_out("kvstore_rank", Py_BuildValue("(K)", kv), out);
+}
+
+int MXTKVStoreGetGroupSize(MXTHandle kv, int *out) {
+  API_ENTER();
+  return call_int_out("kvstore_num_workers", Py_BuildValue("(K)", kv),
+                      out);
+}
+
+int MXTKVStoreGetType(MXTHandle kv, char *buf, size_t bufsize,
+                      size_t *needed) {
+  API_ENTER();
+  PyObject *r = call("kvstore_type", Py_BuildValue("(K)", kv));
+  if (r == nullptr) return -1;
+  int rc = copy_out_string(r, buf, bufsize, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXTKVStoreFree(MXTHandle kv) {
+  API_ENTER();
+  return call_void("free_handle", Py_BuildValue("(K)", kv));
+}
+
+/* ----------------------------------------------------------- DataIter */
+
+int MXTListDataIters(char *buf, size_t bufsize, size_t *needed) {
+  API_ENTER();
+  PyObject *r = call("list_data_iters", nullptr);
+  if (r == nullptr) return -1;
+  int rc = copy_out_string(r, buf, bufsize, needed);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXTDataIterCreate(const char *name, int nparams, const char **keys,
+                      const char **vals, MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out("dataiter_create",
+                         Py_BuildValue("(sNN)", name,
+                                       str_tuple(keys, nparams),
+                                       str_tuple(vals, nparams)),
+                         out);
+}
+
+int MXTDataIterCreateFromArrays(MXTHandle data, MXTHandle label,
+                                int batch_size, int shuffle,
+                                const char *last_batch_handle,
+                                MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out(
+      "dataiter_from_arrays",
+      Py_BuildValue("(KKiis)", data, label, batch_size, shuffle,
+                    last_batch_handle),
+      out);
+}
+
+int MXTDataIterBeforeFirst(MXTHandle it) {
+  API_ENTER();
+  return call_void("dataiter_before_first", Py_BuildValue("(K)", it));
+}
+
+int MXTDataIterNext(MXTHandle it, int *out) {
+  API_ENTER();
+  return call_int_out("dataiter_next", Py_BuildValue("(K)", it), out);
+}
+
+int MXTDataIterGetData(MXTHandle it, MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out("dataiter_get_data", Py_BuildValue("(K)", it),
+                         out);
+}
+
+int MXTDataIterGetLabel(MXTHandle it, MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out("dataiter_get_label", Py_BuildValue("(K)", it),
+                         out);
+}
+
+int MXTDataIterGetPadNum(MXTHandle it, int *out) {
+  API_ENTER();
+  return call_int_out("dataiter_get_pad", Py_BuildValue("(K)", it), out);
+}
+
+int MXTDataIterFree(MXTHandle it) {
+  API_ENTER();
+  return call_void("free_handle", Py_BuildValue("(K)", it));
+}
+
+/* ----------------------------------------------------------- RecordIO */
+
+int MXTRecordIOWriterCreate(const char *path, MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out("recordio_writer_create",
+                         Py_BuildValue("(s)", path), out);
+}
+
+int MXTRecordIOWriterWriteRecord(MXTHandle h, const void *buf,
+                                 size_t size) {
+  API_ENTER();
+  return call_void("recordio_write",
+                   Py_BuildValue("(KKn)", h,
+                                 reinterpret_cast<uint64_t>(buf),
+                                 static_cast<Py_ssize_t>(size)));
+}
+
+static int recordio_close_free(MXTHandle h) {
+  PyObject *r = call("recordio_close", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return call_void("free_handle", Py_BuildValue("(K)", h));
+}
+
+int MXTRecordIOWriterFree(MXTHandle h) {
+  API_ENTER();
+  return recordio_close_free(h);
+}
+
+int MXTRecordIOReaderCreate(const char *path, MXTHandle *out) {
+  API_ENTER();
+  return call_handle_out("recordio_reader_create",
+                         Py_BuildValue("(s)", path), out);
+}
+
+int MXTRecordIOReaderReadRecord(MXTHandle h, void *buf, size_t bufsize,
+                                size_t *needed, int *eof) {
+  API_ENTER();
+  PyObject *r = call("recordio_peek", Py_BuildValue("(K)", h));
+  if (r == nullptr) return -1;
+  if (r == Py_None) {  /* end of file */
+    if (needed != nullptr) *needed = 0;
+    if (eof != nullptr) *eof = 1;
+    Py_DECREF(r);
+    return 0;
+  }
+  if (eof != nullptr) *eof = 0;
+  char *data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &len) != 0) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  if (needed != nullptr) *needed = static_cast<size_t>(len);
+  int rc = 0;
+  /* delivery: the caller's buffer holds the whole record — then the
+   * stream advances.  An empty record "fits" even in a bufsize-0 size
+   * query, so it is delivered (eof=0, needed=0) in one call. */
+  if (static_cast<size_t>(len) <= bufsize) {
+    if (len > 0) std::memcpy(buf, data, static_cast<size_t>(len));
+    rc = call_void("recordio_advance", Py_BuildValue("(K)", h));
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXTRecordIOReaderFree(MXTHandle h) {
+  API_ENTER();
+  return recordio_close_free(h);
+}
+
 }  /* extern "C" */
 
 extern "C" int MXTAutogradClearTape(void) {

@@ -124,6 +124,107 @@ int MXTAutogradBackward(int num_heads, const MXTHandle *heads,
  * MXTAutogradBackward clears the tape itself). */
 int MXTAutogradClearTape(void);
 
+/* --------------------------------------------------- Module training -- */
+/* The training surface: where the reference let bindings train via
+ * MXExecutorSimpleBind + the updater loop (c_api_executor.cc:219), this
+ * framework's training engine is Module's fused forward/backward/update
+ * (one XLA program), exposed row by row so a pure-C consumer can run the
+ * same fit Python users get. */
+int MXTModuleCreate(MXTHandle symbol, int num_data,
+                    const char **data_names, int num_label,
+                    const char **label_names, int dev_type, int dev_id,
+                    MXTHandle *out);
+/* Shapes use the predictor's CSR layout (shape_indptr/shape_data). */
+int MXTModuleBind(MXTHandle mod, int num_data, const char **data_names,
+                  const int64_t *data_indptr, const int64_t *data_shapes,
+                  int num_label, const char **label_names,
+                  const int64_t *label_indptr,
+                  const int64_t *label_shapes, int for_training);
+/* `initializer`: registered initializer name (e.g. "xavier",
+ * "uniform"); kwargs cross as key/value strings. */
+int MXTModuleInitParams(MXTHandle mod, const char *initializer,
+                        int nparams, const char **keys,
+                        const char **vals);
+int MXTModuleInitOptimizer(MXTHandle mod, const char *optimizer,
+                           int nparams, const char **keys,
+                           const char **vals);
+int MXTModuleForward(MXTHandle mod, int num_data, const MXTHandle *data,
+                     int num_label, const MXTHandle *label, int is_train);
+int MXTModuleBackward(MXTHandle mod);
+int MXTModuleUpdate(MXTHandle mod);
+int MXTModuleGetNumOutputs(MXTHandle mod, int *out);
+/* New NDArray handle for output `index` (caller frees). */
+int MXTModuleGetOutput(MXTHandle mod, int index, MXTHandle *out);
+/* prefix-symbol.json + prefix-%04d.params, the reference checkpoint
+ * format (model.py save_checkpoint). */
+int MXTModuleSaveCheckpoint(MXTHandle mod, const char *prefix, int epoch);
+/* Load a named .params file into a bound module (arg:/aux: prefixes). */
+int MXTModuleSetParamsFromFile(MXTHandle mod, const char *param_path);
+int MXTModuleFree(MXTHandle mod);
+
+/* ---------------------------------------------------------- KVStore -- */
+/* reference: MXKVStoreCreate / MXKVStoreInitEx / MXKVStorePushEx /
+ * MXKVStorePullEx / MXKVStoreSetOptimizer / MXKVStoreGetRank /
+ * MXKVStoreGetGroupSize / MXKVStoreGetType / MXKVStoreFree (c_api.cc).
+ * String keys only (the reference's *Ex variants — int keys were the
+ * legacy path). */
+int MXTKVStoreCreate(const char *type, MXTHandle *out);
+int MXTKVStoreInit(MXTHandle kv, int num, const char **keys,
+                   const MXTHandle *vals);
+int MXTKVStorePush(MXTHandle kv, int num, const char **keys,
+                   const MXTHandle *vals, int priority);
+/* Pulls INTO existing arrays (in-place, like the reference). */
+int MXTKVStorePull(MXTHandle kv, int num, const char **keys,
+                   const MXTHandle *outs, int priority);
+/* Makes push apply `optimizer` server-side: push(grad) + pull = updated
+ * weight (update-on-kvstore). */
+int MXTKVStoreSetOptimizer(MXTHandle kv, const char *optimizer,
+                           int nparams, const char **keys,
+                           const char **vals);
+int MXTKVStoreGetRank(MXTHandle kv, int *out);
+int MXTKVStoreGetGroupSize(MXTHandle kv, int *out);
+int MXTKVStoreGetType(MXTHandle kv, char *buf, size_t bufsize,
+                      size_t *needed);
+int MXTKVStoreFree(MXTHandle kv);
+
+/* --------------------------------------------------------- DataIter -- */
+/* reference: MXListDataIters / MXDataIterCreateIter (by name + string
+ * kwargs) and the Next/BeforeFirst/GetData/GetLabel/GetPadNum protocol
+ * (c_api.cc).  GetData/GetLabel return fresh handles (caller frees). */
+int MXTListDataIters(char *buf, size_t bufsize, size_t *needed);
+int MXTDataIterCreate(const char *name, int nparams, const char **keys,
+                      const char **vals, MXTHandle *out);
+/* NDArrayIter over existing arrays (label may be 0: no labels).
+ * last_batch_handle: "pad" | "discard" | "roll_over". */
+int MXTDataIterCreateFromArrays(MXTHandle data, MXTHandle label,
+                                int batch_size, int shuffle,
+                                const char *last_batch_handle,
+                                MXTHandle *out);
+int MXTDataIterBeforeFirst(MXTHandle it);
+/* *out = 1 while a batch is available, 0 at end of epoch. */
+int MXTDataIterNext(MXTHandle it, int *out);
+int MXTDataIterGetData(MXTHandle it, MXTHandle *out);
+int MXTDataIterGetLabel(MXTHandle it, MXTHandle *out);
+int MXTDataIterGetPadNum(MXTHandle it, int *out);
+int MXTDataIterFree(MXTHandle it);
+
+/* --------------------------------------------------------- RecordIO -- */
+/* reference: MXRecordIOWriterCreate / MXRecordIOWriterWriteRecord /
+ * MXRecordIOReaderCreate / MXRecordIOReaderReadRecord / *Free
+ * (c_api.cc over dmlc::RecordIO) — same on-disk container format. */
+int MXTRecordIOWriterCreate(const char *path, MXTHandle *out);
+int MXTRecordIOWriterWriteRecord(MXTHandle h, const void *buf,
+                                 size_t size);
+int MXTRecordIOWriterFree(MXTHandle h);
+int MXTRecordIOReaderCreate(const char *path, MXTHandle *out);
+/* Copies the next record into `buf` (size query via the usual
+ * protocol).  *eof = 1 at end of file, else 0 — a separate signal
+ * because zero-LENGTH records are legal and must stay distinguishable
+ * from stream end. */
+int MXTRecordIOReaderReadRecord(MXTHandle h, void *buf, size_t bufsize,
+                                size_t *needed, int *eof);
+int MXTRecordIOReaderFree(MXTHandle h);
+
 /* -------------------------------------------------------- Predictor -- */
 /* Predict-only deployment API. reference: c_predict_api.h MXPredCreate
  * (shape_indptr/shape_data CSR layout kept), MXPredSetInput,
