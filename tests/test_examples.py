@@ -160,3 +160,30 @@ def test_promote_defaults_ignores_cpu_rows(tmp_path, monkeypatch):
     out.unlink()
     assert mod.main() == 0
     assert not out.exists()
+
+
+def test_dcgan_example():
+    """Two-module adversarial loop: D input-grads drive G backward
+    (reference example/gan/dcgan.py pattern)."""
+    log = _run("examples/gan/dcgan_digits.py", "--epochs", "1",
+               "--batch", "32", "--zdim", "16", timeout=600)
+    assert "final d_loss" in log
+    # both losses parsed and finite (a collapsed-but-completed run still
+    # proves the two-module loop mechanics this smoke exists for)
+    import math
+    import re
+    m = re.search(r"final d_loss (-?[\d.]+) g_loss (-?[\d.]+)", log)
+    assert m, log[-500:]
+    assert math.isfinite(float(m.group(1))), m.group(0)
+    assert math.isfinite(float(m.group(2))), m.group(0)
+
+
+def test_sparse_end2end_example():
+    """CSR->row_sparse end-to-end with the densify telltale armed
+    (reference benchmark/python/sparse/sparse_end2end.py pattern)."""
+    log = _run("examples/sparse/linear_classification.py", "--epochs",
+               "4", "--num-features", "2000", timeout=600)
+    import re
+    m = re.search(r"final acc ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 0.75, log[-500:]

@@ -1263,3 +1263,146 @@ def test_autogen_docstrings_carry_signatures():
     # impl docstrings (with reference citations) flow through where
     # present — assert on BODY text the signature line cannot contain
     assert "square_sum-inl.h" in mx.nd._square_sum.__doc__
+
+
+# ---------------------------------------------------------------------------
+# bf16 numerics (VERDICT r4 item 7): the AMP data path's dtype, pinned
+# against the fp32 reference per op.  bf16 has an 8-bit mantissa, so the
+# tolerance is ~1e-2 relative — what matters is that the op RUNS in bf16
+# (no silent upcast crash) and lands within bf16 rounding of fp32.
+# ---------------------------------------------------------------------------
+
+_BF16_CASES = [
+    # (op, arg shapes, attrs)
+    ('relu', [(4, 5)], {}),
+    ('sigmoid', [(4, 5)], {}),
+    ('tanh', [(4, 5)], {}),
+    ('exp', [(4, 5)], {}),
+    ('broadcast_add', [(4, 5), (1, 5)], {}),
+    ('broadcast_mul', [(4, 5), (1, 5)], {}),
+    ('dot', [(4, 6), (6, 3)], {}),
+    ('sum', [(4, 5)], {'axis': 1}),
+    ('transpose', [(4, 5)], {}),
+    ('FullyConnected', [(4, 6), (3, 6), (3,)], {'num_hidden': 3}),
+    ('Convolution', [(1, 2, 5, 5), (3, 2, 3, 3), (3,)],
+     {'kernel': (3, 3), 'num_filter': 3}),
+    ('Pooling', [(1, 2, 4, 4)],
+     {'kernel': (2, 2), 'stride': (2, 2), 'pool_type': 'max'}),
+    ('Activation', [(4, 5)], {'act_type': 'relu'}),
+    ('LayerNorm', [(4, 6), (6,), (6,)], {}),
+    ('softmax', [(4, 5)], {}),
+]
+
+
+@pytest.mark.parametrize('op,shapes,attrs',
+                         _BF16_CASES, ids=[c[0] for c in _BF16_CASES])
+def test_bf16_matches_fp32(op, shapes, attrs):
+    import jax.numpy as jnp
+    rng = np.random.RandomState(11)
+    args32 = [rng.uniform(0.2, 1.0, s).astype(np.float32) for s in shapes]
+    _EXERCISED.add(op)
+    fn = getattr(mx.nd, op)
+    out32 = fn(*[mx.nd.array(a) for a in args32], **attrs)
+    out16 = fn(*[mx.nd.array(a).astype(jnp.bfloat16) for a in args32],
+               **attrs)
+    if isinstance(out32, (list, tuple)):
+        out32, out16 = out32[0], out16[0]
+    assert out16.dtype == jnp.bfloat16, (op, out16.dtype)
+    np.testing.assert_allclose(
+        out16.astype(np.float32).asnumpy(), out32.asnumpy(),
+        rtol=4e-2, atol=4e-2, err_msg=op)
+
+
+def test_bf16_batchnorm_split_contract():
+    """BatchNorm's AMP-split contract (executor.AMP_SPLIT_OPS): bf16 data
+    path, fp32 statistics — output within bf16 rounding of the all-fp32
+    op, moving stats updated in fp32 (the cuDNN-BN recipe,
+    reference: src/operator/cudnn_batch_norm-inl.h)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-2, 2, (8, 3, 4, 4)).astype(np.float32)
+    g = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    b = rng.uniform(-0.5, 0.5, (3,)).astype(np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+
+    from mxnet_tpu import autograd
+
+    def run(dtype):
+        mov_mean = mx.nd.array(mean.copy())
+        mov_var = mx.nd.array(var.copy())
+        args = [mx.nd.array(x).astype(dtype), mx.nd.array(g),
+                mx.nd.array(b), mov_mean, mov_var]
+        with autograd.record():  # train mode: batch stats + EMA writeback
+            out = mx.nd.BatchNorm(*args, fix_gamma=False, eps=1e-4)
+        return out, mov_mean, mov_var
+    _EXERCISED.add('BatchNorm')
+    o32, m32, v32 = run(np.float32)
+    o16, m16, v16 = run(jnp.bfloat16)
+    assert o16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(o16.astype(np.float32).asnumpy(),
+                               o32.asnumpy(), rtol=4e-2, atol=4e-2)
+    # the split contract's other half: statistics stay fp32 and match the
+    # all-fp32 run to fp32 precision (NOT bf16 rounding) — stats are
+    # accumulated in fp32 FROM the bf16 activations
+    for s16, s32, init in ((m16, m32, mean), (v16, v32, var)):
+        assert s16.dtype == np.float32, s16.dtype
+        assert abs(s16.asnumpy() - init).sum() > 0  # writeback happened
+        assert abs(s32.asnumpy() - init).sum() > 0
+        np.testing.assert_allclose(s16.asnumpy(), s32.asnumpy(),
+                                   rtol=5e-3, atol=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# edge shapes (VERDICT r4 item 7): 0-size and 1-element inputs through
+# reductions, indexing, and shape ops — the classic silent-breakage
+# corners (XLA handles them; the wrappers must not mangle them).
+# ---------------------------------------------------------------------------
+
+def test_zero_size_arrays():
+    z = np.zeros((0, 3), np.float32)
+    # reductions over an empty axis follow numpy semantics
+    assert mx.nd.sum(mx.nd.array(z)).asscalar() == 0.0
+    assert mx.nd.sum(mx.nd.array(z), axis=0).shape == (3,)
+    np.testing.assert_array_equal(
+        mx.nd.sum(mx.nd.array(z), axis=0).asnumpy(), np.zeros(3))
+    assert mx.nd.prod(mx.nd.array(z)).asscalar() == 1.0
+    # shape ops preserve emptiness (NB mxnet reshape treats a literal 0
+    # as "copy that dim from the input", so flatten via -1 instead)
+    assert mx.nd.reshape(mx.nd.array(z), shape=(-1,)).shape == (0,)
+    assert mx.nd.transpose(mx.nd.array(z)).shape == (3, 0)
+    assert mx.nd.expand_dims(mx.nd.array(z), axis=0).shape == (1, 0, 3)
+    # slicing TO empty
+    x = mx.nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    s = mx.nd.slice_axis(x, axis=0, begin=1, end=1)
+    assert s.shape == (0, 4)
+    # concat with an empty piece is identity
+    c = mx.nd.concat(s, x, dim=0)
+    np.testing.assert_array_equal(c.asnumpy(), x.asnumpy())
+    # elementwise on empty stays empty
+    assert mx.nd.relu(mx.nd.array(z)).shape == (0, 3)
+    for op in ('sum', 'prod', 'reshape', 'transpose', 'expand_dims',
+               'slice_axis', 'concat', 'relu'):
+        _EXERCISED.add(op)
+
+
+def test_one_element_reductions_and_indexing():
+    one = np.array([[3.5]], np.float32)
+    h = mx.nd.array(one)
+    for op, want in (('sum', 3.5), ('mean', 3.5), ('max', 3.5),
+                     ('min', 3.5), ('prod', 3.5), ('argmax', 0.0),
+                     ('argmin', 0.0)):
+        got = getattr(mx.nd, op)(h).asscalar()
+        assert got == want, (op, got)
+        _EXERCISED.add(op)
+    # keepdims on a single element
+    assert mx.nd.sum(h, axis=1, keepdims=True).shape == (1, 1)
+    # take/gather a single row
+    w = mx.nd.array(np.arange(6).reshape(3, 2).astype(np.float32))
+    got = mx.nd.take(w, mx.nd.array(np.array([1.0], np.float32)))
+    np.testing.assert_array_equal(got.asnumpy(), [[2.0, 3.0]])
+    _EXERCISED.add('take')
+    # scalar (0-d-like) broadcast against 1-element
+    got = mx.nd.broadcast_add(h, mx.nd.array(np.array([[1.0]], np.float32)))
+    assert got.asscalar() == 4.5
+    _EXERCISED.add('broadcast_add')

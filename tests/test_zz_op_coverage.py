@@ -1,0 +1,59 @@
+"""End-of-session op-coverage audit (VERDICT r3 item 7).
+
+``tests/test_operator.py``'s registry gate accepts ``_COVERED_ELSEWHERE``
+— a declarative map op -> dedicated test file — on faith.  This module
+sorts LAST in the suite (zz_), so by the time it runs every other test
+file in a full run has executed and ``registry.EXECUTED_OPS`` holds the
+ground truth of which ops actually dispatched.  Here the map's claims
+are checked against that record: an op claimed "covered elsewhere" whose
+named file no longer executes it fails the suite.
+
+Skips (rather than false-fails) on partial runs — selecting a subset of
+files means the claimed test modules may legitimately not have run.
+"""
+import os
+
+import pytest
+
+
+def test_covered_elsewhere_claims_executed(request):
+    from mxnet_tpu.ops import registry
+    from tests.test_operator import _COVERED_ELSEWHERE
+
+    # partial-run detection: every file named by the map must have been
+    # COLLECTED in this session, else the claim cannot be audited
+    collected_files = {
+        os.path.relpath(str(item.path), str(request.config.rootpath))
+        for item in request.session.items
+    }
+    claimed_files = set(_COVERED_ELSEWHERE.values())
+    missing_files = {f for f in claimed_files
+                     if f not in collected_files}
+    if missing_files:
+        pytest.skip("partial run: claimed modules not collected: %s"
+                    % sorted(missing_files))
+
+    executed = set(registry.EXECUTED_OPS)
+    # alias-aware (same rule as test_operator's gate): executing any
+    # alias of the same OpDef counts for all of them
+    alias_groups = {}
+    for n in registry.list_ops():
+        alias_groups.setdefault(id(registry.get(n)), []).append(n)
+    for aliases in alias_groups.values():
+        if any(a in executed for a in aliases):
+            executed.update(aliases)
+    stale = sorted(op for op in _COVERED_ELSEWHERE if op not in executed)
+    assert not stale, (
+        "_COVERED_ELSEWHERE claims these ops are executed by dedicated "
+        "test modules, but registry.EXECUTED_OPS has no record of them "
+        "this session — the claimed coverage is stale: %r" % stale)
+
+
+def test_claimed_files_exist(request):
+    from tests.test_operator import _COVERED_ELSEWHERE
+    root = str(request.config.rootpath)
+    missing = sorted({f for f in set(_COVERED_ELSEWHERE.values())
+                      if not os.path.exists(os.path.join(root, f))})
+    assert not missing, (
+        "_COVERED_ELSEWHERE names test files that do not exist: %r"
+        % missing)
