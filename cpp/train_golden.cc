@@ -1,0 +1,137 @@
+/*
+ * C++ end-to-end TRAINING through the header-only user API
+ * (mxnet_tpu.hpp Module/DataIter/KVStore over the round-4 C ABI rows) —
+ * the reference cpp-package's train-from-C++ story
+ * (reference: cpp-package/example/mlp.cpp: Symbol -> Executor ->
+ * optimizer loop from C++).
+ *
+ * Trains the same MLP/dataset as cpp/train_smoke.c via the RAII
+ * wrappers, then closes the loop deployment-style: save_checkpoint ->
+ * Predictor over the saved params -> the predictor's probabilities on
+ * the training batch must match the trained module's outputs.
+ *
+ * Prints "TRAIN GOLDEN OK nll=<x>" on success.
+ */
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+#include "mxnet_tpu.hpp"
+
+static const char *kSymbolJson =
+    "{\"nodes\":[{\"op\":\"null\",\"name\":\"data\",\"inputs\":[]},"
+    "{\"op\":\"null\",\"name\":\"fc1_weight\",\"inputs\":[]},"
+    "{\"op\":\"null\",\"name\":\"fc1_bias\",\"inputs\":[]},"
+    "{\"op\":\"FullyConnected\",\"name\":\"fc1\",\"inputs\":[[0,0,0],[1,0,"
+    "0],[2,0,0]],\"attrs\":{\"num_hidden\":\"16\"}},"
+    "{\"op\":\"Activation\",\"name\":\"relu1\",\"inputs\":[[3,0,0]],"
+    "\"attrs\":{\"act_type\":\"relu\"}},"
+    "{\"op\":\"null\",\"name\":\"fc2_weight\",\"inputs\":[]},"
+    "{\"op\":\"null\",\"name\":\"fc2_bias\",\"inputs\":[]},"
+    "{\"op\":\"FullyConnected\",\"name\":\"fc2\",\"inputs\":[[4,0,0],[5,0,"
+    "0],[6,0,0]],\"attrs\":{\"num_hidden\":\"2\"}},"
+    "{\"op\":\"null\",\"name\":\"softmax_label\",\"inputs\":[]},"
+    "{\"op\":\"SoftmaxOutput\",\"name\":\"softmax\",\"inputs\":[[7,0,0],"
+    "[8,0,0]]}],\"arg_nodes\":[0,1,2,5,6,8],"
+    "\"node_row_ptr\":[0,1,2,3,4,5,6,7,8,9,10],\"heads\":[[9,0,0]],"
+    "\"attrs\":{\"mxnet_version\":[\"int\",1200]}}";
+
+static const int N = 256, D = 8, BATCH = 64, EPOCHS = 8;
+
+static unsigned long long lcg_state = 12345;
+static float lcg_uniform() {
+  lcg_state = lcg_state * 6364136223846793005ULL + 1442695040888963407ULL;
+  return static_cast<float>((lcg_state >> 33) / 2147483648.0);
+}
+
+int main() {
+  try {
+    mxtpu::check(MXTInit(nullptr), "MXTInit");
+    mxtpu::check(MXTRandomSeed(7), "RandomSeed");
+
+    // same deterministic blobs as train_smoke.c
+    static float x[N * D];
+    static float y[N];
+    for (int i = 0; i < N; ++i) {
+      int cls = i % 2;
+      y[i] = static_cast<float>(cls);
+      for (int j = 0; j < D; ++j) {
+        float noise = lcg_uniform() - 0.5f;
+        x[i * D + j] =
+            noise + (cls ? 0.9f : -0.9f) * (j % 3 == 0 ? 1.f : .3f);
+      }
+    }
+
+    auto sym = mxtpu::Symbol::from_json(kSymbolJson);
+    auto xa = mxtpu::NDArray::from_data(x, {N, D});
+    auto ya = mxtpu::NDArray::from_data(y, {N});
+    auto it = mxtpu::DataIter::from_arrays(xa, ya, BATCH);
+
+    mxtpu::Module mod(sym, {"data"}, {"softmax_label"});
+    mod.bind({"data"}, {{BATCH, D}}, {"softmax_label"}, {{BATCH}});
+    mod.init_params("xavier",
+                    {{"rnd_type", "gaussian"}, {"magnitude", "2.0"}});
+    mod.init_optimizer("sgd",
+                       {{"learning_rate", "0.2"}, {"momentum", "0.9"}});
+
+    double nll = 0.0;
+    int cnt = 0;
+    for (int epoch = 0; epoch < EPOCHS; ++epoch) {
+      it.before_first();
+      nll = 0.0;
+      cnt = 0;
+      while (it.next()) {
+        auto bx = it.data();
+        auto by = it.label();
+        mod.forward({&bx}, {&by});
+        auto prob = mod.output(0).to_vector();
+        auto lab = by.to_vector();
+        for (int i = 0; i < BATCH; ++i) {
+          float p = prob[i * 2 + static_cast<int>(lab[i])];
+          nll += -std::log(p > 1e-8f ? p : 1e-8f);
+          ++cnt;
+        }
+        mod.backward();
+        mod.update();
+      }
+    }
+    nll /= cnt;
+    if (!(nll < 0.25)) {
+      std::fprintf(stderr, "final nll %.4f did not reach 0.25\n", nll);
+      return 1;
+    }
+
+    // deployment round-trip: checkpoint -> Predictor -> same probs
+    mod.save_checkpoint("/tmp/mxt_train_golden", EPOCHS);
+    it.before_first();
+    it.next();
+    auto bx = it.data();
+    mod.forward({&bx}, {}, /*is_train=*/false);
+    auto want = mod.output(0).to_vector();
+
+    mxtpu::Predictor pred(sym.to_json(),
+                          "/tmp/mxt_train_golden-0008.params", {"data"},
+                          {{BATCH, D}});
+    pred.set_input("data", bx.to_vector());
+    pred.forward();
+    auto got = pred.get_output(0);
+    if (got.size() != want.size()) {
+      std::fprintf(stderr, "predictor size %zu != module %zu\n",
+                   got.size(), want.size());
+      return 1;
+    }
+    for (size_t i = 0; i < got.size(); ++i) {
+      if (std::fabs(got[i] - want[i]) > 1e-4f) {
+        std::fprintf(stderr, "predictor[%zu] %g != %g\n", i, got[i],
+                     want[i]);
+        return 1;
+      }
+    }
+
+    std::printf("TRAIN GOLDEN OK nll=%.6f\n", nll);
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "exception: %s\n", e.what());
+    return 1;
+  }
+}

@@ -133,6 +133,7 @@ class FeedForward(object):
             self.aux_params = {k: v for k, v in self.aux_params.items()
                                if k in names}
         self._module = None
+        self._label_names = []
 
     # -- input adaptation (reference model.py:583/608) ------------------
     def _init_iter(self, X, y, is_train):
@@ -178,6 +179,10 @@ class FeedForward(object):
         if eval_data is not None and not hasattr(eval_data, 'provide_data'):
             ex, ey = eval_data
             eval_data = self._init_iter(ex, ey, is_train=False)
+        # remember the label names: prediction modules must treat them
+        # as dummy-bound labels even when they don't end in "label"
+        # (e.g. the recommender demos' 'score')
+        self._label_names = [l[0] for l in (train.provide_label or [])]
         self._module = self._make_module(train, for_training=True)
         self._module.fit(
             train, eval_data=eval_data, eval_metric=eval_metric,
@@ -208,8 +213,14 @@ class FeedForward(object):
         data_names = [d[0] for d in data_iter.provide_data]
         known = set(data_names) | set(self.arg_params) \
             | set(self.aux_params or {})
+        # a label is: named by the iterator, remembered from fit(), or
+        # (for load()-constructed models fed raw numpy) a loss-head arg
+        # following the *_label naming convention
+        hinted = {l[0] for l in (data_iter.provide_label or [])}
+        hinted.update(getattr(self, '_label_names', []) or [])
         labels = [n for n in self.symbol.list_arguments()
-                  if n not in known and n.endswith('label')]
+                  if n not in known
+                  and (n in hinted or n.endswith('label'))]
         provided = {l[0]: tuple(l[1])
                     for l in (data_iter.provide_label or [])}
         batch = data_iter.provide_data[0][1][0]

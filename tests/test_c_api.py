@@ -565,3 +565,18 @@ def test_recordio_rows_in_process(lib, tmp_path):
         got.append(buf.raw[:need.value])
     assert got == recs
     assert lib.MXTRecordIOReaderFree(rd) == 0
+
+
+@pytest.mark.slow
+def test_cpp_train_golden():
+    """C++ header-API training (Module/DataIter RAII wrappers) +
+    checkpoint->Predictor deployment round-trip, out-of-process."""
+    exe = _build_cpp("train_golden")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RELAY_DEADLINE_EPOCH", None)
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=900)
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-1500:])
+    line = [l for l in r.stdout.splitlines()
+            if l.startswith("TRAIN GOLDEN OK")][-1]
+    assert float(line.split("nll=")[1]) < 0.25

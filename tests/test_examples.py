@@ -187,3 +187,26 @@ def test_sparse_end2end_example():
     m = re.search(r"final acc ([\d.]+)", log)
     assert m, log[-500:]
     assert float(m.group(1)) > 0.75, log[-500:]
+
+
+def test_matrix_fact_recommender_example():
+    """FeedForward-driven MF (reference example/recommenders/
+    matrix_fact.py): two embedding towers, dot score, custom np metric —
+    must reach near the planted noise floor."""
+    log = _run("examples/recommender/matrix_fact.py", "--epochs", "30",
+               timeout=600)
+    import re
+    m = re.search(r"final rmse ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) < 0.2, log[-300:]  # noise floor is 0.1
+
+
+def test_neural_style_example():
+    """Optimization over the INPUT (reference example/neural-style/
+    nstyle.py): grads w.r.t. the image, Gram losses, manual Adam."""
+    log = _run("examples/neural_style/nstyle.py", "--iters", "60",
+               timeout=600)
+    import re
+    m = re.search(r"loss ([\d.]+) -> ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(2)) < 0.5 * float(m.group(1)), m.group(0)

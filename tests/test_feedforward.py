@@ -101,3 +101,24 @@ def test_feedforward_numpy_requires_labels_for_fit():
         model = FeedForward(_mlp_symbol(), num_epoch=1)
     with pytest.raises(ValueError):
         model.fit(X)  # numpy X without y
+
+
+def test_feedforward_nonconventional_label_name():
+    """Labels that don't end in 'label' (the recommender demos' 'score')
+    must still bind as dummy labels at predict/score time."""
+    rng = np.random.RandomState(0)
+    u = rng.randint(0, 10, 200).astype(np.float32)
+    r = (u > 4).astype(np.float32)
+    data = sym.Variable('user')
+    emb = sym.Embedding(data, input_dim=10, output_dim=4, name='emb')
+    pred = sym.Flatten(sym.sum(emb, axis=1))
+    net = sym.LinearRegressionOutput(data=pred,
+                                     label=sym.Variable('score'),
+                                     name='lro')
+    it = mx.io.NDArrayIter({'user': u}, {'score': r}, batch_size=50)
+    with pytest.warns(DeprecationWarning):
+        model = FeedForward(net, ctx=mx.cpu(), num_epoch=4,
+                            optimizer='adam', learning_rate=0.1)
+    model.fit(it)
+    out = model.predict(mx.io.NDArrayIter({'user': u}, batch_size=50))
+    assert out.shape[0] == 200
