@@ -10,6 +10,11 @@ import sys
 import time
 
 
+def env_int(name, default):
+    """Shared int-env knob parser for the bench scripts."""
+    return int(os.environ.get(name, str(default)))
+
+
 def make_mark(tag):
     t0 = time.perf_counter()
 

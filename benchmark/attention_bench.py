@@ -145,8 +145,13 @@ def _write_dispatch_table(rows, dev):
             sp = r["bwd_speedup"]
         else:
             sp = r.get("fwd_speedup") or 0.0
-        if key not in best or sp > best[key][0]:
-            best[key] = (sp, r.get("blocks", "128x128"))
+        # rank: speedup first, then RAW flash time (negated) so that
+        # inf-speedup rows (naive OOMed everywhere) still pick the
+        # FASTEST flash tile config, not the first swept
+        flash_ms = r.get("flash_bwd_ms") or r.get("flash_fwd_ms") or 1e9
+        rank = (sp, -flash_ms)
+        if key not in best or rank > best[key][0]:
+            best[key] = (rank, r.get("blocks", "128x128"))
     # each measured S speaks for its neighborhood: ranges split at the
     # geometric midpoint between adjacent measured lengths.  The winning
     # BLOCK CONFIG ships with the row — dispatch must run the config
@@ -158,7 +163,7 @@ def _write_dispatch_table(rows, dev):
             lo = 0 if i == 0 else int((seqs[i - 1] * s) ** 0.5) + 1
             hi = (1 << 62) if i == len(seqs) - 1 \
                 else int((s * seqs[i + 1]) ** 0.5)
-            sp, blocks = best[(s, gqa)]
+            (sp, _), blocks = best[(s, gqa)]
             table_rows.append(
                 {"min_seq": lo, "max_seq": hi, "gqa": gqa,
                  "measured_seq": s, "blocks": blocks,

@@ -28,14 +28,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 from benchmark._bench_common import (  # noqa: E402
-    make_mark, peak_flops, guarded_backend_init, make_hard_sync,
-    shrink_iters, start_stall_watchdog, with_last_good)
+    env_int as _env_int, make_mark, peak_flops, guarded_backend_init,
+    make_hard_sync, shrink_iters, start_stall_watchdog, with_last_good)
 
 _mark = make_mark("tfb")
-
-
-def _env_int(name, default):
-    return int(os.environ.get(name, str(default)))
 
 
 LAYERS = _env_int("TFB_LAYERS", 12)

@@ -232,6 +232,13 @@ def _skip_args(op: str, attrs: dict) -> set:
         if attrs.get("use_label_lengths", False) not in (True, "True",
                                                          "true", 1):
             skip.add("label_lengths")
+    if op in ("SequenceReverse", "SequenceMask", "SequenceLast"):
+        # the optional length input EXISTS only under
+        # use_sequence_length=True (reference: sequence_reverse-inl.h) —
+        # otherwise it must not auto-materialize as a learnable arg
+        if attrs.get("use_sequence_length", False) not in (True, "True",
+                                                           "true", 1):
+            skip.add("sequence_length")
     return skip
 
 

@@ -210,3 +210,32 @@ def test_neural_style_example():
     m = re.search(r"loss ([\d.]+) -> ([\d.]+)", log)
     assert m, log[-500:]
     assert float(m.group(2)) < 0.5 * float(m.group(1)), m.group(0)
+
+
+def test_kvstore_facade_bench_smoke():
+    """The facade-overhead bench runs end-to-end in CPU smoke mode and
+    reports a sane ratio (both paths train the same model)."""
+    import json
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KVF_CPU="1",
+               KVF_ITERS="2")
+    env.pop("RELAY_DEADLINE_EPOCH", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmark",
+                                      "kvstore_facade_bench.py")],
+        env=env, capture_output=True, text=True, timeout=900, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "kvstore_facade_overhead_ratio"
+    assert row["value"] is not None and row["value"] > 0.2
+
+
+def test_bi_lstm_sort_example():
+    """Bidirectional LSTM seq->seq sort (reference example/bi-lstm-sort):
+    every output position needs BOTH directions' context."""
+    log = _run("examples/rnn/bi_lstm_sort.py", "--epochs", "10",
+               timeout=900)
+    import re
+    m = re.search(r"final sort acc ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 0.9, log[-300:]

@@ -307,3 +307,25 @@ def test_fused_pack_weights_roundtrip_and_init():
     assert np.abs(args['lstm_l1_i2h_c_weight'].asnumpy()).max() > 0
     rt = cell.pack_weights(args)['lstm_parameters'].asnumpy()
     np.testing.assert_allclose(rt, p, rtol=1e-6)
+
+
+def test_sequence_ops_no_phantom_length_arg():
+    """Symbolic Sequence* without use_sequence_length must NOT
+    auto-materialize a sequence_length learnable arg (reference:
+    sequence_reverse-inl.h — the input exists only when the flag is on).
+    Round-4 regression: BidirectionalCell's merged unroll hit this."""
+    import mxnet_tpu as mx
+    d = mx.sym.Variable('d')
+    for op in ('SequenceReverse', 'SequenceMask', 'SequenceLast'):
+        s = getattr(mx.sym, op)(d)
+        assert s.list_arguments() == ['d'], (op, s.list_arguments())
+        s2 = getattr(mx.sym, op)(d, mx.sym.Variable('len'),
+                                 use_sequence_length=True)
+        assert 'len' in s2.list_arguments(), (op, s2.list_arguments())
+    # the bidirectional merged-unroll path binds cleanly now
+    from mxnet_tpu import rnn
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(4, prefix='l_'),
+                                 rnn.LSTMCell(4, prefix='r_'))
+    emb = mx.sym.Variable('data')
+    out, _ = cell.unroll(5, inputs=emb, merge_outputs=True, layout='NTC')
+    assert not any('sequence_length' in a for a in out.list_arguments())

@@ -155,6 +155,11 @@ deadline_check "transformer LM bench"
 echo "== [$(TS)] transformer LM bench" >&2
 python benchmark/transformer_bench.py || probe_or_die
 
+# 4c. kvstore 'tpu' facade overhead vs the fused step (VERDICT r3 weak 5)
+deadline_check "kvstore facade bench"
+echo "== [$(TS)] kvstore facade bench" >&2
+python benchmark/kvstore_facade_bench.py || probe_or_die
+
 # 5. real-data convergence artifact (VERDICT item 4)
 deadline_check "digits convergence"
 echo "== [$(TS)] digits convergence" >&2
