@@ -488,13 +488,47 @@ _make_regression_output("LogisticRegressionOutput", jax.nn.sigmoid,
                         lambda o, l: (o - l))
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, reg, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg, use_linear, res, g):
+    # one-vs-all hinge gradients, the reference's L1_SVM/L2_SVM kernels
+    # (svm_output.cc:30,48) vectorized: true-class margin pushes up,
+    # every other class pushes down; like the other loss heads the seed
+    # gradient is replaced, not chained.
+    data, label = res
+    f32 = data.astype(jnp.float32)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
+                            dtype=jnp.float32)
+    if use_linear:
+        g_true = -(margin > f32).astype(jnp.float32) * reg
+        g_other = (margin > -f32).astype(jnp.float32) * reg
+    else:
+        g_true = -2.0 * reg * (margin - f32) * (margin > f32)
+        g_other = 2.0 * reg * (margin + f32) * (margin > -f32)
+    grad = onehot * g_true + (1.0 - onehot) * g_other
+    return grad.astype(data.dtype), jnp.zeros_like(label)
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
 @register("SVMOutput", arg_names=["data", "label"],
           attr_defaults={"margin": 1.0, "regularization_coefficient": 1.0,
                          "use_linear": False})
 def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                 use_linear=False, **kw):
-    """reference: src/operator/svm_output.cc (forward = identity)."""
-    return data
+    """reference: src/operator/svm_output.cc — forward is identity, the
+    LOSS lives in backward: one-vs-all (squared) hinge on the margins
+    (L2_SVM default, L1_SVM with use_linear)."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient), bool(use_linear))
 
 
 @register("MakeLoss", arg_names=["data"],

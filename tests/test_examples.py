@@ -239,3 +239,26 @@ def test_bi_lstm_sort_example():
     m = re.search(r"final sort acc ([\d.]+)", log)
     assert m, log[-500:]
     assert float(m.group(1)) > 0.9, log[-300:]
+
+
+def test_fgsm_adversary_example():
+    """FGSM (reference example/adversary): input-gradient attack must
+    collapse accuracy at eps=0.15."""
+    log = _run("examples/adversary/fgsm.py", "--epochs", "6",
+               timeout=900)
+    import re
+    m = re.search(r"clean (\d\.\d+) adversarial (\d\.\d+)", log)
+    assert m, log[-500:]
+    clean, adv = float(m.group(1)), float(m.group(2))
+    assert clean > 0.75, clean
+    assert adv < clean - 0.25, (clean, adv)
+
+
+def test_svm_digits_example():
+    """SVMOutput head training (reference example/svm_mnist)."""
+    log = _run("examples/svm/svm_digits.py", "--epochs", "12",
+               timeout=900)
+    import re
+    m = re.search(r"final svm acc ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 0.85, log[-300:]
