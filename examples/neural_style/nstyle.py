@@ -26,6 +26,9 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
+from cpu_pin import pin_if_cpu  # noqa: E402
+pin_if_cpu(None)  # JAX_PLATFORMS=cpu must never touch the tunnel
+
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import autograd, nd  # noqa: E402
 

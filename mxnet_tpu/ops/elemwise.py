@@ -187,4 +187,9 @@ def _identity_with_attr_like_rhs(lhs, rhs, **kw):
 
 @register("where", arg_names=["condition", "x", "y"])
 def _where(condition, x, y, **kw):
-    return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
+    cond = condition != 0 if condition.dtype != jnp.bool_ else condition
+    if cond.ndim == 1 and x.ndim > 1 and cond.shape[0] == x.shape[0]:
+        # 1-D condition selects whole ROWS (reference where_batch,
+        # control_flow_op.h:53: condition sized as x's first dim)
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond, x, y)

@@ -1443,3 +1443,120 @@ def test_svm_output_gradients_match_reference_kernels():
     want_l2 = onehot * l2_true + (1 - onehot) * l2_other
     np.testing.assert_allclose(run(False), want_l2, rtol=1e-6)
     _EXERCISED.add('SVMOutput')
+
+
+# ---------------------------------------------------------------------------
+# broadcast shape sweep + full-grad coverage (VERDICT r3 item 7: many ops
+# were pinned at a single shape; the reference sweeps shape combos —
+# tests/python/unittest/test_operator.py test_broadcast_binary_op)
+# ---------------------------------------------------------------------------
+
+_BCAST_SHAPES = [
+    ((1,), (3,)),                    # scalar-ish vs vector
+    ((3, 1), (1, 4)),                # outer product style
+    ((2, 3, 4), (4,)),               # trailing alignment
+    ((2, 1, 4), (1, 3, 1)),          # interleaved ones
+    ((5, 1, 1), (5, 1, 1)),          # equal with ones
+]
+
+
+@pytest.mark.parametrize('shapes', _BCAST_SHAPES,
+                         ids=[str(s) for s in _BCAST_SHAPES])
+@pytest.mark.parametrize('op', ['broadcast_add', 'broadcast_mul',
+                                'broadcast_maximum', 'broadcast_power'])
+def test_broadcast_shape_sweep(op, shapes):
+    sa, sb = shapes
+    fn = BROADCAST[op]
+    a = RNG.uniform(0.5, 1.5, sa).astype(np.float32)
+    b = RNG.uniform(0.5, 1.5, sb).astype(np.float32)
+    _check_fwd(op, [a, b], fn(a, b), rtol=1e-4)
+
+
+@pytest.mark.parametrize('op', ['broadcast_sub', 'broadcast_maximum',
+                                'broadcast_minimum', 'broadcast_power',
+                                'broadcast_hypot'])
+def test_broadcast_grad_more(op):
+    # gradients reduce correctly over the broadcast axes for the rest of
+    # the differentiable family (add/mul/div were already covered).
+    # max/min are kinked at a==b: build a with a guaranteed margin above
+    # the finite-difference eps so the check can never straddle the kink
+    rng = np.random.RandomState(sum(map(ord, op)))  # stable per-op seed
+    b = rng.uniform(0.6, 1.4, (1, 3)).astype(np.float32)
+    sign = rng.choice([-1.0, 1.0], (2, 3)).astype(np.float32)
+    a = (b + sign * rng.uniform(0.05, 0.4, (2, 3))).astype(np.float32)
+    _check_grad(op, [a, b], eps=1e-3, rtol=6e-2, atol=2e-2)
+
+
+def test_topk_variants():
+    x = np.array([[3., 1., 4., 1.], [5., 9., 2., 6.]], np.float32)
+    # ret_typ value / indices / both, axis choice, k>1
+    v = mx.nd.topk(mx.nd.array(x), k=2, ret_typ='value', axis=1)
+    np.testing.assert_array_equal(v.asnumpy(), [[4., 3.], [9., 6.]])
+    i = mx.nd.topk(mx.nd.array(x), k=2, ret_typ='indices', axis=1)
+    np.testing.assert_array_equal(i.asnumpy(), [[2., 0.], [1., 3.]])
+    both = mx.nd.topk(mx.nd.array(x), k=1, ret_typ='both', axis=0)
+    np.testing.assert_array_equal(both[0].asnumpy(), [[5., 9., 4., 6.]])
+    np.testing.assert_array_equal(both[1].asnumpy(), [[1., 1., 0., 1.]])
+    # k=1 indices on the default axis equals argmax
+    am = mx.nd.topk(mx.nd.array(x), k=1, ret_typ='indices')
+    np.testing.assert_array_equal(
+        am.asnumpy().reshape(-1),
+        np.argmax(x, axis=-1).astype(np.float32))
+    _EXERCISED.add('topk')
+
+
+def test_pick_axes_and_keepdims():
+    x = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    idx = np.array([1, 3, 0], np.float32)
+    got = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1)
+    np.testing.assert_allclose(got.asnumpy(),
+                               x[np.arange(3), idx.astype(int)],
+                               rtol=1e-6)
+    kd = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1,
+                    keepdims=True)
+    assert kd.shape == (3, 1)
+    idx0 = np.array([2, 0, 1, 2], np.float32)
+    got0 = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx0), axis=0)
+    np.testing.assert_allclose(got0.asnumpy(),
+                               x[idx0.astype(int), np.arange(4)],
+                               rtol=1e-6)
+    _EXERCISED.add('pick')
+
+
+def test_clip_gradient_zero_outside_range():
+    from mxnet_tpu import autograd
+    x = mx.nd.array(np.array([-2., -0.5, 0.5, 2.], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.clip(x, a_min=-1.0, a_max=1.0)
+        s = y.sum()
+    s.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), [0., 1., 1., 0.])
+    _EXERCISED.add('clip')
+
+
+def test_cast_dtype_matrix():
+    # in-range values only: float->unsigned of a negative is UB in the
+    # reference's C static_cast and saturates under XLA — don't pin it
+    src = np.array([[1.7, 2.3], [0.0, 250.9]], np.float32)
+    for dtype, want in (
+            ('int32', src.astype(np.int32)),
+            ('uint8', src.astype(np.uint8)),
+            ('float64', src.astype(np.float64)),
+            ('float16', src.astype(np.float16))):
+        got = mx.nd.Cast(mx.nd.array(src), dtype=dtype)
+        assert str(np.dtype(got.dtype)) == dtype, (dtype, got.dtype)
+        np.testing.assert_array_equal(got.asnumpy(),
+                                      want.astype(got.dtype))
+    _EXERCISED.add('Cast')
+
+
+def test_where_broadcast_condition_vector():
+    # reference where supports a (batch,)-shaped condition selecting rows
+    cond = np.array([1., 0., 1.], np.float32)
+    a = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    b = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    got = mx.nd.where(mx.nd.array(cond), mx.nd.array(a), mx.nd.array(b))
+    want = np.where(cond[:, None] != 0, a, b)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6)
+    _EXERCISED.add('where')
