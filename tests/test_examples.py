@@ -262,3 +262,14 @@ def test_svm_digits_example():
     m = re.search(r"final svm acc ([\d.]+)", log)
     assert m, log[-500:]
     assert float(m.group(1)) > 0.85, log[-300:]
+
+
+def test_numpy_ops_custom_softmax_example():
+    """Pure-numpy CustomOp loss head inside symbolic training
+    (reference example/numpy-ops/custom_softmax.py)."""
+    log = _run("examples/numpy_ops/custom_softmax.py", "--epochs", "10",
+               timeout=900)
+    import re
+    m = re.search(r"final custom-op acc ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 0.85, log[-300:]
