@@ -61,7 +61,15 @@ register("_copy", arg_names=["data"], aliases=("identity",))(
     lambda data, **kw: data + 0 if False else jnp.asarray(data))
 register("BlockGrad", arg_names=["data"], aliases=("stop_gradient",))(
     lambda data, **kw: lax.stop_gradient(data))
-register("make_loss", arg_names=["data"])(lambda data, **kw: data)
+def _make_loss_lower(data, **kw):
+    """reference: elemwise_unary_op.cc make_loss — FGradient is
+    ones_like, i.e. the seed is REPLACED (same head contract as
+    MakeLoss with grad_scale=1)."""
+    from .nn import _makeloss_core
+    return _makeloss_core(data, 1.0, 0.0, "null")
+
+
+register("make_loss", arg_names=["data"])(_make_loss_lower)
 register("zeros_like", arg_names=["data"])(lambda data, **kw: jnp.zeros_like(data))
 register("ones_like", arg_names=["data"])(lambda data, **kw: jnp.ones_like(data))
 

@@ -531,12 +531,49 @@ def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                      float(regularization_coefficient), bool(use_linear))
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _makeloss_core(data, grad_scale, valid_thresh, normalization):
+    return data
+
+
+def _makeloss_fwd(data, grad_scale, valid_thresh, normalization):
+    return data, data
+
+
+def _makeloss_bwd(grad_scale, valid_thresh, normalization, data, g):
+    # the head MAKES its output a loss: gradient is the CONSTANT
+    # grad_scale (reference make_loss-inl.h:102-116), normalized by
+    # batch size ('batch') or by the count of elements above
+    # valid_thresh ('valid') — the seed gradient is replaced.
+    if normalization == "batch":
+        scale = grad_scale / data.shape[0]
+        return (jnp.full(data.shape, scale, data.dtype),)
+    if normalization == "valid":
+        valid = jnp.maximum(
+            jnp.sum((data > valid_thresh).astype(jnp.float32)), 1.0)
+        return ((grad_scale / valid).astype(data.dtype)
+                * jnp.ones_like(data),)
+    return (jnp.full(data.shape, grad_scale, data.dtype),)
+
+
+_makeloss_core.defvjp(_makeloss_fwd, _makeloss_bwd)
+
+
 @register("MakeLoss", arg_names=["data"],
           attr_defaults={"grad_scale": 1.0, "valid_thresh": 0.0,
                          "normalization": "null"})
 def _makeloss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null", **kw):
-    """reference: src/operator/make_loss.cc"""
-    return data
+    """reference: src/operator/make_loss.cc — forward is identity, the
+    backward writes grad_scale (normalized per the mode), replacing the
+    seed like the other loss heads."""
+    normalization = str(normalization)
+    if normalization not in ("null", "batch", "valid"):
+        # reference rejects invalid enum values at op creation — a typo
+        # must not silently train with unnormalized gradients
+        raise ValueError("MakeLoss normalization must be one of "
+                         "'null'/'batch'/'valid', got %r" % normalization)
+    return _makeloss_core(data, float(grad_scale), float(valid_thresh),
+                          normalization)
 
 
 @register("softmax_cross_entropy", arg_names=["data", "label"])

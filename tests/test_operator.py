@@ -1560,3 +1560,29 @@ def test_where_broadcast_condition_vector():
     want = np.where(cond[:, None] != 0, a, b)
     np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6)
     _EXERCISED.add('where')
+
+
+def test_makeloss_gradient_semantics():
+    """MakeLoss backward = CONSTANT grad_scale replacing the seed,
+    normalized per mode (reference make_loss-inl.h:102-116).  Round-4
+    regression: it chained the seed and ignored grad_scale entirely."""
+    from mxnet_tpu import autograd
+    x_np = np.array([[1., 2.], [3., 4.]], np.float32)
+
+    def grads(**attrs):
+        x = mx.nd.array(x_np)
+        x.attach_grad()
+        with autograd.record():
+            y = mx.nd.MakeLoss(x * x, **attrs)
+        y.backward()
+        return x.grad.asnumpy()
+
+    np.testing.assert_allclose(grads(grad_scale=2.0), 2.0 * 2 * x_np)
+    np.testing.assert_allclose(grads(grad_scale=2.0,
+                                     normalization='batch'),
+                               (2.0 / 2) * 2 * x_np)
+    # valid: 3 of 4 squared entries exceed the threshold
+    np.testing.assert_allclose(
+        grads(grad_scale=3.0, valid_thresh=2.0, normalization='valid'),
+        (3.0 / 3) * 2 * x_np)
+    _EXERCISED.add('MakeLoss')
