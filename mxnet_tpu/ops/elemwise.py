@@ -58,7 +58,7 @@ for _n, _f in _UNARY.items():
 alias("identity", "abs")  # placeholder replaced below
 # identity / copy family (reference: _copy, BlockGrad, stop_gradient)
 register("_copy", arg_names=["data"], aliases=("identity",))(
-    lambda data, **kw: data + 0 if False else jnp.asarray(data))
+    lambda data, **kw: jnp.asarray(data))
 register("BlockGrad", arg_names=["data"], aliases=("stop_gradient",))(
     lambda data, **kw: lax.stop_gradient(data))
 def _make_loss_lower(data, **kw):

@@ -546,7 +546,9 @@ def _makeloss_bwd(grad_scale, valid_thresh, normalization, data, g):
     # batch size ('batch') or by the count of elements above
     # valid_thresh ('valid') — the seed gradient is replaced.
     if normalization == "batch":
-        scale = grad_scale / data.shape[0]
+        # 0-d data (e.g. x.sum()) counts as batch 1 — the reference's
+        # ndarrays are never 0-d, so its divide-by-shape[0] saw 1 here
+        scale = grad_scale / (data.shape[0] if data.ndim else 1)
         return (jnp.full(data.shape, scale, data.dtype),)
     if normalization == "valid":
         valid = jnp.maximum(

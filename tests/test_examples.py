@@ -273,3 +273,14 @@ def test_numpy_ops_custom_softmax_example():
     m = re.search(r"final custom-op acc ([\d.]+)", log)
     assert m, log[-500:]
     assert float(m.group(1)) > 0.85, log[-300:]
+
+
+def test_stochastic_depth_example():
+    """Custom gluon HybridBlock with train-time random depth
+    (reference example/gluon stochastic-depth pattern)."""
+    log = _run("examples/gluon/stochastic_depth.py", "--epochs", "6",
+               timeout=900)
+    import re
+    m = re.search(r"final stochastic-depth acc ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 0.85, log[-300:]
