@@ -358,3 +358,55 @@ def test_trainer_fused_update_mixes_with_sparse():
     w1 = emb.weight.data().asnumpy()
     touched = np.abs(w1 - w0).sum(axis=1) > 0
     assert set(np.where(touched)[0]) == {1, 4, 7}
+
+
+def test_gluon_loss_numerics_vs_numpy():
+    """Every gluon loss class pinned to an independent numpy computation
+    of its documented formula (reference: tests/python/unittest/
+    test_loss.py — the families beyond L2/SoftmaxCE/BCE were untested)."""
+    rng = np.random.RandomState(9)
+    p = rng.randn(4, 5).astype('f')
+    l = rng.randn(4, 5).astype('f')
+    sign = rng.choice([-1.0, 1.0], (4, 5)).astype('f')
+
+    def got(loss_obj, *args):
+        return loss_obj(*[mx.nd.array(a) for a in args]).asnumpy()
+
+    # L1: mean |p - l| per sample
+    np.testing.assert_allclose(got(gluon.loss.L1Loss(), p, l),
+                               np.abs(p - l).mean(axis=1), rtol=1e-5)
+    # Huber (rho=1): quadratic inside, linear outside
+    d = np.abs(p - l)
+    hub = np.where(d > 1.0, d - 0.5, 0.5 * d * d)
+    np.testing.assert_allclose(got(gluon.loss.HuberLoss(), p, l),
+                               hub.mean(axis=1), rtol=1e-5)
+    # Hinge / SquaredHinge with signed labels
+    hin = np.maximum(0.0, 1.0 - p * sign)
+    np.testing.assert_allclose(got(gluon.loss.HingeLoss(), p, sign),
+                               hin.mean(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(got(gluon.loss.SquaredHingeLoss(), p, sign),
+                               (hin * hin).mean(axis=1), rtol=1e-5)
+    # Logistic (signed labels): softplus(-y*p) in the stable form
+    logi = np.log1p(np.exp(-np.abs(p))) + np.maximum(p, 0) \
+        - p * (sign + 1) / 2
+    np.testing.assert_allclose(got(gluon.loss.LogisticLoss(), p, sign),
+                               logi.mean(axis=1), rtol=1e-5, atol=1e-6)
+    # KLDiv (from_logits): mean over ALL elements of q*(log q - logp)
+    q = np.abs(rng.randn(4, 5).astype('f'))
+    q /= q.sum(axis=1, keepdims=True)
+    logp = p - np.log(np.exp(p).sum(axis=1, keepdims=True))
+    kld = (q * (np.log(q + 1e-12) - logp)).mean(axis=1)
+    np.testing.assert_allclose(
+        got(gluon.loss.KLDivLoss(from_logits=True), logp, q), kld,
+        rtol=1e-5)
+    # Triplet: relu(margin + sum((a-pos)^2 - (a-neg)^2))
+    a, pos, neg = (rng.randn(4, 5).astype('f') for _ in range(3))
+    tri = np.maximum(
+        0.0, 1.0 + (np.square(a - pos) - np.square(a - neg)).sum(axis=1))
+    np.testing.assert_allclose(got(gluon.loss.TripletLoss(), a, pos, neg),
+                               tri, rtol=1e-5)
+    # sample_weight flows through _apply_weighting
+    sw = rng.uniform(0.1, 2.0, (4, 1)).astype('f')
+    np.testing.assert_allclose(
+        got(gluon.loss.L1Loss(), p, l, sw),
+        (np.abs(p - l) * sw).mean(axis=1), rtol=1e-5)
