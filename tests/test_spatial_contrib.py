@@ -577,3 +577,44 @@ def test_cast_storage_op_and_legacy_aliases():
     # legacy _v1 names resolve
     for name in ("BatchNorm_v1", "Convolution_v1", "Pooling_v1"):
         assert hasattr(mx.nd, name)
+
+
+def test_spatial_grad_coverage():
+    """Gradient checks for the differentiable spatial family beyond the
+    single BilinearSampler case: SpatialTransformer end-to-end (grid +
+    sampler + the affine loc-net weights), Correlation, and
+    DeformableConvolution w.r.t. data/weight/offset."""
+    rng = np.random.RandomState(2)
+    # check_numeric_gradient draws its output-projection vectors from
+    # GLOBAL np.random — pin it so the kink-sensitive deformable check
+    # sees the same projections every run
+    np.random.seed(1234)
+    # SpatialTransformer: d(out)/d(data) and d(out)/d(theta)
+    data = rng.uniform(0.2, 1.0, (1, 1, 5, 5)).astype('f')
+    theta = np.array([[0.9, 0.05, 0.02, -0.05, 0.95, -0.01]], 'f')
+    st = mx.sym.SpatialTransformer(
+        mx.sym.Variable('data'), mx.sym.Variable('theta'),
+        target_shape=(4, 4), transform_type='affine',
+        sampler_type='bilinear')
+    tu.check_numeric_gradient(st, {'data': data, 'theta': theta},
+                              numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+    # Correlation: both inputs
+    a = rng.uniform(0.2, 1.0, (1, 2, 5, 5)).astype('f')
+    b = rng.uniform(0.2, 1.0, (1, 2, 5, 5)).astype('f')
+    corr = mx.sym.Correlation(
+        mx.sym.Variable('a'), mx.sym.Variable('b'), kernel_size=1,
+        max_displacement=1, stride1=1, stride2=1, pad_size=1)
+    tu.check_numeric_gradient(corr, {'a': a, 'b': b}, numeric_eps=1e-3,
+                              rtol=5e-2, atol=1e-2)
+    # DeformableConvolution: data, offset, weight all differentiable
+    x = rng.uniform(0.2, 1.0, (1, 1, 5, 5)).astype('f')
+    off = (0.1 * rng.randn(1, 18, 3, 3)).astype('f')
+    w = rng.uniform(-0.5, 0.5, (2, 1, 3, 3)).astype('f')
+    dc = mx.sym.contrib.DeformableConvolution(
+        mx.sym.Variable('x'), mx.sym.Variable('off'),
+        mx.sym.Variable('w'), kernel=(3, 3), num_filter=2, no_bias=True)
+    # offset grads are piecewise (bilinear kinks at integer sample
+    # positions): a finite difference that straddles a cell boundary is
+    # off by the kink, so the tolerance is looser than for smooth args
+    tu.check_numeric_gradient(dc, {'x': x, 'off': off, 'w': w},
+                              numeric_eps=1e-3, rtol=8e-2, atol=4e-2)
