@@ -284,3 +284,15 @@ def test_stochastic_depth_example():
     m = re.search(r"final stochastic-depth acc ([\d.]+)", log)
     assert m, log[-500:]
     assert float(m.group(1)) > 0.85, log[-300:]
+
+
+def test_stacked_autoencoder_example():
+    """Layerwise pretrain -> finetune workflow (reference
+    example/autoencoder): finetuning must IMPROVE on pretrain-only."""
+    log = _run("examples/autoencoder/stacked_ae.py", timeout=900)
+    import re
+    m = re.search(r"final ae mse ([\d.]+) \(pretrain-only ([\d.]+)\)", log)
+    assert m, log[-500:]
+    ft, pre = float(m.group(1)), float(m.group(2))
+    assert ft < pre, (ft, pre)
+    assert ft < 0.05, ft

@@ -586,8 +586,10 @@ def test_spatial_grad_coverage():
     DeformableConvolution w.r.t. data/weight/offset."""
     rng = np.random.RandomState(2)
     # check_numeric_gradient draws its output-projection vectors from
-    # GLOBAL np.random — pin it so the kink-sensitive deformable check
-    # sees the same projections every run
+    # GLOBAL np.random — pin it (and restore after) so the
+    # kink-sensitive deformable check sees the same projections every
+    # run without perturbing later tests' streams
+    _state = np.random.get_state()
     np.random.seed(1234)
     # SpatialTransformer: d(out)/d(data) and d(out)/d(theta)
     data = rng.uniform(0.2, 1.0, (1, 1, 5, 5)).astype('f')
@@ -616,5 +618,8 @@ def test_spatial_grad_coverage():
     # offset grads are piecewise (bilinear kinks at integer sample
     # positions): a finite difference that straddles a cell boundary is
     # off by the kink, so the tolerance is looser than for smooth args
-    tu.check_numeric_gradient(dc, {'x': x, 'off': off, 'w': w},
-                              numeric_eps=1e-3, rtol=8e-2, atol=4e-2)
+    try:
+        tu.check_numeric_gradient(dc, {'x': x, 'off': off, 'w': w},
+                                  numeric_eps=1e-3, rtol=8e-2, atol=4e-2)
+    finally:
+        np.random.set_state(_state)
