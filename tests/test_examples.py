@@ -296,3 +296,14 @@ def test_stacked_autoencoder_example():
     ft, pre = float(m.group(1)), float(m.group(2))
     assert ft < pre, (ft, pre)
     assert ft < 0.05, ft
+
+
+def test_dqn_chain_example():
+    """DQN agent loop (reference example/reinforcement-learning/dqn):
+    must beat the distractor-policy ceiling (3.2/episode) decisively."""
+    log = _run("examples/reinforcement_learning/dqn_chain.py",
+               "--episodes", "250", timeout=900)
+    import re
+    m = re.search(r"final dqn mean return ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 4.0, log[-300:]

@@ -591,6 +591,13 @@ def test_spatial_grad_coverage():
     # run without perturbing later tests' streams
     _state = np.random.get_state()
     np.random.seed(1234)
+    try:
+        _spatial_grad_checks(rng)
+    finally:
+        np.random.set_state(_state)
+
+
+def _spatial_grad_checks(rng):
     # SpatialTransformer: d(out)/d(data) and d(out)/d(theta)
     data = rng.uniform(0.2, 1.0, (1, 1, 5, 5)).astype('f')
     theta = np.array([[0.9, 0.05, 0.02, -0.05, 0.95, -0.01]], 'f')
@@ -618,8 +625,5 @@ def test_spatial_grad_coverage():
     # offset grads are piecewise (bilinear kinks at integer sample
     # positions): a finite difference that straddles a cell boundary is
     # off by the kink, so the tolerance is looser than for smooth args
-    try:
-        tu.check_numeric_gradient(dc, {'x': x, 'off': off, 'w': w},
-                                  numeric_eps=1e-3, rtol=8e-2, atol=4e-2)
-    finally:
-        np.random.set_state(_state)
+    tu.check_numeric_gradient(dc, {'x': x, 'off': off, 'w': w},
+                              numeric_eps=1e-3, rtol=8e-2, atol=4e-2)
