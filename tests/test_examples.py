@@ -307,3 +307,16 @@ def test_dqn_chain_example():
     m = re.search(r"final dqn mean return ([\d.]+)", log)
     assert m, log[-500:]
     assert float(m.group(1)) > 4.0, log[-300:]
+
+
+def test_seq2seq_reverse_example():
+    """Encoder-decoder seq2seq: decoder begin_state = encoder final
+    states, teacher forcing, greedy decode (reverse task — unsolvable
+    without real state transport)."""
+    log = _run("examples/rnn/seq2seq_reverse.py", "--epochs", "15",
+               timeout=900)
+    import re
+    m = re.search(r"final seq2seq token acc ([\d.]+) seq acc ([\d.]+)",
+                  log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 0.9, log[-300:]

@@ -1586,3 +1586,50 @@ def test_makeloss_gradient_semantics():
         grads(grad_scale=3.0, valid_thresh=2.0, normalization='valid'),
         (3.0 / 3) * 2 * x_np)
     _EXERCISED.add('MakeLoss')
+
+
+def test_grad_upsampling_lrn_instancenorm():
+    """Gradient checks for the nn tail that only had forward pins."""
+    x = RNG.uniform(0.3, 1.2, (1, 2, 3, 3)).astype(np.float32)
+    _check_grad('UpSampling', [x], {'scale': 2, 'sample_type': 'nearest',
+                                    'num_args': 1},
+                eps=1e-3, rtol=5e-2, atol=1e-2)
+    x2 = RNG.uniform(0.3, 1.2, (2, 3, 4, 4)).astype(np.float32)
+    _check_grad('LRN', [x2], {'nsize': 3}, eps=1e-3, rtol=6e-2,
+                atol=2e-2)
+    d = RNG.uniform(-1, 1, (2, 3, 5)).astype(np.float32)
+    g = RNG.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    b = RNG.uniform(-0.5, 0.5, (3,)).astype(np.float32)
+    vs = [S.Variable(n) for n in ('data', 'gamma', 'beta')]
+    out = _apply('InstanceNorm', *vs, eps=1e-3)
+    check_numeric_gradient(out, {'data': d, 'gamma': g, 'beta': b},
+                           grad_nodes=['gamma', 'beta'],
+                           numeric_eps=1e-3, rtol=8e-2, atol=2e-2)
+
+
+def test_dropout_train_vs_eval_semantics():
+    """Dropout: identity at eval; at train, survivors scaled by 1/(1-p)
+    and the SAME mask applied in backward (reference dropout-inl.h)."""
+    from mxnet_tpu import autograd
+    x_np = RNG.uniform(0.5, 1.5, (64, 64)).astype(np.float32)
+    x = mx.nd.array(x_np)
+    # eval: exact identity
+    np.testing.assert_array_equal(
+        mx.nd.Dropout(x, p=0.5).asnumpy(), x_np)
+    # train: zeros + scaled survivors, empirical rate near p
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Dropout(x, p=0.5)
+        s = y.sum()
+    out = y.asnumpy()
+    dropped = out == 0
+    rate = dropped.mean()
+    assert 0.35 < rate < 0.65, rate
+    np.testing.assert_allclose(out[~dropped], x_np[~dropped] * 2.0,
+                               rtol=1e-5)
+    # backward uses the same mask: grad is 2 where kept, 0 where dropped
+    s.backward()
+    gr = x.grad.asnumpy()
+    np.testing.assert_allclose(gr[~dropped], 2.0, rtol=1e-5)
+    np.testing.assert_array_equal(gr[dropped], 0.0)
+    _EXERCISED.add('Dropout')
