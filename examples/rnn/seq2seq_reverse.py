@@ -93,6 +93,7 @@ def train(epochs=20, batch=64, seq_len=6, vocab=12, seed=0, log=print):
     vsrc, vtgt = src[n:n + batch], tgt[n:n + batch]
     dec_in = np.zeros_like(vsrc)
     dec_in[:, 0] = GO
+    steps = []
     for t in range(seq_len):
         mod.forward(mx.io.DataBatch(
             data=[mx.nd.array(vsrc), mx.nd.array(dec_in)],
@@ -100,13 +101,10 @@ def train(epochs=20, batch=64, seq_len=6, vocab=12, seed=0, log=print):
         prob = mod.get_outputs()[0].asnumpy().reshape(
             batch, seq_len, vocab)
         step_tok = prob[:, t].argmax(axis=1)
+        steps.append(step_tok)
         if t + 1 < seq_len:
             dec_in[:, t + 1] = step_tok
-        if t == 0:
-            first_tok = step_tok
-    generated = np.concatenate(
-        [first_tok[:, None], dec_in[:, 2:], step_tok[:, None]], axis=1) \
-        if seq_len > 2 else np.stack([first_tok, step_tok], axis=1)
+    generated = np.stack(steps, axis=1)
     token_acc = float((generated == vtgt).mean())
     seq_acc = float((generated == vtgt).all(axis=1).mean())
     log("greedy decode: token acc %.4f, full-sequence acc %.4f"
