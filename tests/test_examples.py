@@ -320,3 +320,14 @@ def test_seq2seq_reverse_example():
                   log)
     assert m, log[-500:]
     assert float(m.group(1)) > 0.9, log[-300:]
+
+
+def test_profiler_example(tmp_path):
+    """Profiler workflow (reference example/profiler): chrome-trace JSON
+    with the bracketed train_step scopes present."""
+    log = _run("examples/profiler/profile_training.py", "--out",
+               str(tmp_path / "trace.json"), timeout=600)
+    import re
+    m = re.search(r"profiler example done: (\d+) events, (\d+) steps", log)
+    assert m, log[-500:]
+    assert int(m.group(2)) >= 8, m.group(0)
