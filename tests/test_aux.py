@@ -217,3 +217,29 @@ def test_trace_merge_tool(tmp_path):
     # device rows carry process metadata naming the plane
     assert any(e.get("ph") == "M" and "device:" in
                str(e.get("args", {}).get("name", "")) for e in evs)
+
+
+def test_xplane_summary_tool(tmp_path):
+    """tools/xplane_summary.py parses a REAL xplane capture and reports
+    per-line-normalized occupancy (can never exceed 100% — the round-3
+    advisor finding)."""
+    import re
+    import subprocess
+    import sys
+    import jax
+    import jax.numpy as jnp
+    logdir = str(tmp_path / "xp")
+    jax.profiler.start_trace(logdir)
+    x = jnp.ones((64, 64))
+    for _ in range(3):
+        x = (x @ x).block_until_ready()
+    jax.profiler.stop_trace()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "xplane_summary.py"),
+         logdir, "--top", "5"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "== plane:" in out.stdout
+    for m in re.finditer(r"\((\d+(?:\.\d+)?)% occupancy\)", out.stdout):
+        assert float(m.group(1)) <= 100.0, out.stdout[:1500]

@@ -61,37 +61,51 @@ def summarize(space, top=30):
         agg = collections.defaultdict(lambda: [0, 0])  # name -> [ps, n]
         line_span = [None, None]
         active_lines = 0
+        busy_ps = 0
         for line in plane.lines:
-            had_event = False
+            intervals = []
             for ev in line.events:
-                had_event = True
                 name = ev_meta[ev.metadata_id].name
                 agg[name][0] += ev.duration_ps
                 agg[name][1] += 1
                 t0 = ev.offset_ps
                 t1 = ev.offset_ps + ev.duration_ps
+                intervals.append((t0, t1))
                 if line_span[0] is None or t0 < line_span[0]:
                     line_span[0] = t0
                 if line_span[1] is None or t1 > line_span[1]:
                     line_span[1] = t1
-            if had_event:
-                active_lines += 1
+            if not intervals:
+                continue
+            active_lines += 1
+            # occupancy busy time is the UNION of this line's event
+            # intervals: events nest (TraceMe scopes, fused-op children),
+            # so raw duration sums double-count and can exceed the span
+            intervals.sort()
+            cur_s, cur_e = intervals[0]
+            for s, e in intervals[1:]:
+                if s > cur_e:
+                    busy_ps += cur_e - cur_s
+                    cur_s, cur_e = s, e
+                else:
+                    cur_e = max(cur_e, e)
+            busy_ps += cur_e - cur_s
         total_ps = sum(v[0] for v in agg.values())
-        # busy time is summed over ALL lines, so the denominator must be
-        # span x active lines or a multi-line plane reads >100% occupancy
+        # denominator: span x active lines (busy is unioned per line, so
+        # occupancy is bounded by 100% by construction)
         span_ps = ((line_span[1] - line_span[0]) * max(1, active_lines)
                    if line_span[0] is not None else 0)
-        rows.append((plane.name, agg, total_ps, span_ps))
+        rows.append((plane.name, agg, total_ps, busy_ps, span_ps))
     print_report(rows, top)
 
 
 def print_report(rows, top):
-    for plane_name, agg, total_ps, span_ps in rows:
+    for plane_name, agg, total_ps, busy_ps, span_ps in rows:
         print("== plane: %s" % plane_name)
         if span_ps:
             print("   busy %.3f ms of %.3f ms line-span (%.1f%% occupancy)"
-                  % (total_ps / 1e9, span_ps / 1e9,
-                     100.0 * total_ps / span_ps))
+                  % (busy_ps / 1e9, span_ps / 1e9,
+                     100.0 * busy_ps / span_ps))
         items = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
         width = max((len(k) for k, _ in items), default=10)
         print("   %-*s %12s %8s %7s" % (width, "op", "total_ms", "count",
