@@ -55,21 +55,27 @@ def summarize(space, top=30):
     rows = []
     for plane in device_planes(space):
         ev_meta = plane.event_metadata
-        # per-op exclusive time: events on one line can nest; xplane
-        # device planes are flat per-core step traces, so duration sums
-        # are a good self-time proxy per op name
+        # Per-op totals are raw duration sums: on TPU DEVICE planes
+        # (flat per-core step traces) that approximates self time, but
+        # where events nest (host planes, fused-op children) an op's
+        # total includes its children — read shares as inclusive-time.
+        # Occupancy below is nesting-proof (per-line interval union).
         agg = collections.defaultdict(lambda: [0, 0])  # name -> [ps, n]
         line_span = [None, None]
         active_lines = 0
         busy_ps = 0
         for line in plane.lines:
+            # event offsets are relative to THIS line's timestamp_ns —
+            # anchor before comparing across lines (trace_merge.py does
+            # the same)
+            base_ps = line.timestamp_ns * 1000
             intervals = []
             for ev in line.events:
                 name = ev_meta[ev.metadata_id].name
                 agg[name][0] += ev.duration_ps
                 agg[name][1] += 1
-                t0 = ev.offset_ps
-                t1 = ev.offset_ps + ev.duration_ps
+                t0 = base_ps + ev.offset_ps
+                t1 = t0 + ev.duration_ps
                 intervals.append((t0, t1))
                 if line_span[0] is None or t0 < line_span[0]:
                     line_span[0] = t0
