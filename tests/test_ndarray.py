@@ -205,3 +205,71 @@ def test_asnumpy_is_sync_point():
     for _ in range(5):
         ref = ref * 1.5 + 0.1
     np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_ndarray_setitem_variants():
+    """reference test_ndarray.py:63 test_ndarray_setitem shapes."""
+    x = mx.nd.zeros((3, 4))
+    x[:] = 2.5                       # scalar fill
+    np.testing.assert_array_equal(x.asnumpy(), np.full((3, 4), 2.5))
+    x[1] = np.arange(4)              # row assign from numpy
+    np.testing.assert_array_equal(x.asnumpy()[1], np.arange(4))
+    x[0:2, 1:3] = 7.0                # rectangular slice
+    want = np.full((3, 4), 2.5)
+    want[1] = np.arange(4)
+    want[0:2, 1:3] = 7.0
+    np.testing.assert_array_equal(x.asnumpy(), want)
+    x[2] = mx.nd.ones((4,)) * 9      # NDArray source
+    want[2] = 9
+    np.testing.assert_array_equal(x.asnumpy(), want)
+
+
+def test_ndarray_pickle_roundtrip():
+    """reference test_ndarray.py:222: NDArrays pickle by value."""
+    import pickle
+    rng = np.random.RandomState(0)
+    a = mx.nd.array(rng.randn(3, 5).astype('f'))
+    b = pickle.loads(pickle.dumps(a))
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    assert b.shape == (3, 5)
+
+
+def test_ndarray_moveaxis_and_negate():
+    x_np = np.arange(24).reshape(2, 3, 4).astype('f')
+    x = mx.nd.array(x_np)
+    np.testing.assert_array_equal(mx.nd.moveaxis(x, 0, 2).asnumpy(),
+                                  np.moveaxis(x_np, 0, 2))
+    np.testing.assert_array_equal((-x).asnumpy(), -x_np)
+
+
+def test_ndarray_arange_corners():
+    """reference test_ndarray.py:490: arange signatures + repeat."""
+    np.testing.assert_array_equal(mx.nd.arange(5).asnumpy(),
+                                  np.arange(5, dtype='f'))
+    np.testing.assert_array_equal(mx.nd.arange(2, 9, 2).asnumpy(),
+                                  np.arange(2, 9, 2, dtype='f'))
+    got = mx.nd.arange(3, step=0.5)
+    np.testing.assert_allclose(got.asnumpy(),
+                               np.arange(0, 3, 0.5, dtype='f'))
+    rep = mx.nd.arange(3, repeat=2)
+    np.testing.assert_array_equal(rep.asnumpy(),
+                                  np.array([0, 0, 1, 1, 2, 2], 'f'))
+
+
+def test_ndarray_fluent_methods():
+    """reference test_ndarray.py:740 test_ndarray_fluent: the method
+    chain spelling of the op surface."""
+    rng = np.random.RandomState(3)
+    x_np = rng.randn(3, 4).astype('f')
+    x = mx.nd.array(x_np)
+    np.testing.assert_allclose(x.abs().sum().asscalar(),
+                               np.abs(x_np).sum(), rtol=1e-5)
+    np.testing.assert_allclose(x.square().mean(axis=1).asnumpy(),
+                               (x_np ** 2).mean(axis=1), rtol=1e-5)
+    np.testing.assert_array_equal(
+        x.reshape((4, 3)).transpose().asnumpy(),
+        x_np.reshape(4, 3).T)
+    np.testing.assert_allclose(x.clip(-0.5, 0.5).asnumpy(),
+                               np.clip(x_np, -0.5, 0.5), rtol=1e-6)
+    np.testing.assert_allclose(x.exp().log().asnumpy(), x_np,
+                               rtol=1e-5, atol=1e-6)
