@@ -107,7 +107,8 @@ class BucketingModule(BaseModule):
             self.init_params(initializer=None, arg_params=arg_params,
                              aux_params=aux_params,
                              allow_missing=allow_missing,
-                             force_init=force_init)
+                             force_init=force_init,
+                             allow_extra=allow_extra)
             return
         assert self.binded and self.params_initialized
         # write to the DEFAULT bucket: it is the sync source of truth that

@@ -228,6 +228,19 @@ class Module(BaseModule):
             (self._arg_params if self._arg_params else None)
         cache_aux = aux_params if aux_params is not None else \
             (self._aux_params if self._aux_params else None)
+        if not allow_extra:
+            # the reference rejects unknown names unless allow_extra=True
+            # (module.py set_params) — silently dropping a typo'd weight
+            # is how a checkpoint loads "successfully" untrained.  Every
+            # symbol argument (params, inputs, labels, STATES) is known.
+            known = set(self._symbol.list_arguments()) \
+                | set(self._aux_names)
+            for cache in (cache_arg, cache_aux):
+                unknown = [n for n in (cache or {}) if n not in known]
+                if unknown:
+                    raise ValueError(
+                        "extra parameters not in the symbol (pass "
+                        "allow_extra=True to ignore): %r" % sorted(unknown))
         for name in self._param_names:
             _impl(name, self._exec.arg_dict[name], cache_arg)
         for name in self._aux_names:

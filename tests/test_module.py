@@ -612,3 +612,61 @@ def test_trainer_fused_cache_stable_across_steps():
         loss.backward()
         tr.step(4)
     assert len(tr._fused_cache) == 1, list(tr._fused_cache)
+
+
+def test_module_set_params_contract():
+    """reference test_module.py:241 — allow_missing / allow_extra raise
+    semantics."""
+    x = sym.Variable('data')
+    x = sym.FullyConnected(x, num_hidden=2, name='fc_0')
+    x = sym.Activation(x, act_type='sigmoid')
+    x = sym.FullyConnected(x, num_hidden=2, name='fc_1')
+    x = sym.LinearRegressionOutput(x, name='softmax')
+    mod = mx.mod.Module(x, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (1, 2))],
+             label_shapes=[('softmax_label', (1, 2))])
+    correct = {'fc_0_weight': mx.nd.array([[.15, .20], [.25, .30]]),
+               'fc_0_bias': mx.nd.array([.35, .35]),
+               'fc_1_weight': mx.nd.array([[.40, .45], [.50, .55]]),
+               'fc_1_bias': mx.nd.array([.60, .60])}
+    missing = {k: v for k, v in correct.items() if k != 'fc_1_bias'}
+    extra = dict(correct, fc_2_weight=mx.nd.array([.6, .6]))
+
+    mod.set_params(correct, {}, force_init=True)
+    mod.set_params(missing, {}, allow_missing=True, force_init=True)
+    with pytest.raises(Exception):
+        mod.set_params(missing, {}, allow_missing=False, force_init=True)
+    mod.set_params(extra, {}, allow_missing=True, allow_extra=True,
+                   force_init=True)
+    with pytest.raises(Exception):
+        mod.set_params(extra, {}, allow_missing=True, allow_extra=False,
+                       force_init=True)
+    # values actually landed
+    args, _ = mod.get_params()
+    np.testing.assert_allclose(args['fc_0_bias'].asnumpy(), [.35, .35])
+
+
+def test_module_forward_reshape():
+    """reference test_module.py:605 test_forward_reshape: forward with
+    changing batch sizes AND feature shapes re-binds transparently and
+    keeps parameters."""
+    x = sym.Variable('data')
+    out = sym.FullyConnected(x, num_hidden=3, name='fc')
+    out = sym.SoftmaxOutput(out, name='softmax')
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (4, 6))],
+             label_shapes=[('softmax_label', (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    w0, _ = mod.get_params()
+    w0 = {k: v.asnumpy() for k, v in w0.items()}
+    rng = np.random.RandomState(0)
+    for batch in (4, 2, 7, 4):
+        db = mx.io.DataBatch(
+            data=[mx.nd.array(rng.randn(batch, 6).astype('f'))],
+            label=[mx.nd.array(np.zeros(batch, 'f'))])
+        mod.forward(db, is_train=False)
+        assert mod.get_outputs()[0].shape == (batch, 3)
+    # params survived every reshape
+    w1, _ = mod.get_params()
+    for k in w0:
+        np.testing.assert_array_equal(w0[k], w1[k].asnumpy())
