@@ -334,10 +334,16 @@ class Symbol:
         return Symbol(heads)
 
     def get_children(self) -> Optional["Symbol"]:
-        node, _ = self._single_head()
-        if not node.inputs:
+        """Inputs of every head, in head order (reference Symbol
+        semantics: on a grouped/multi-output symbol the children of all
+        heads concatenate; leaf variables contribute none).  None when
+        no head has inputs."""
+        heads = []
+        for node, _ in self._heads:
+            heads.extend(node.inputs)
+        if not heads:
             return None
-        return Symbol([(n, i) for n, i in node.inputs])
+        return Symbol(list(heads))
 
     # -- attributes ---------------------------------------------------------
     def attr(self, key):

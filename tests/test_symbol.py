@@ -233,3 +233,47 @@ def test_load_json_roundtrip_no_phantom_args():
     assert back.list_arguments() == net.list_arguments()
     assert "fc1_bias" not in back.list_arguments()
     assert "lrelu_gamma" not in back.list_arguments()
+
+
+def test_symbol_children_semantics():
+    """reference test_symbol.py:69 test_symbol_children — exact child
+    enumeration and leaf behavior."""
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, name='fc1', num_hidden=10)
+    net = mx.sym.FullyConnected(fc1, name='fc2', num_hidden=100)
+    assert net.get_children().list_outputs() == \
+        ['fc1_output', 'fc2_weight', 'fc2_bias']
+    assert net.get_children().get_children().list_outputs() == \
+        ['data', 'fc1_weight', 'fc1_bias']
+    assert net.get_children()['fc2_weight'].list_arguments() == \
+        ['fc2_weight']
+    assert net.get_children()['fc2_weight'].get_children() is None
+    sliced = mx.sym.SliceChannel(data, num_outputs=3, name='slice')
+    concat = mx.sym.Concat(*list(sliced))
+    assert concat.get_children().list_outputs() == \
+        ['slice_output0', 'slice_output1', 'slice_output2']
+    assert sliced.get_children().list_outputs() == ['data']
+
+
+def test_symbol_internal_arguments():
+    """reference test_symbol.py:59: an internal head's arguments are the
+    subgraph's arguments."""
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, name='fc1', num_hidden=10)
+    net = mx.sym.FullyConnected(fc1, name='fc2', num_hidden=100)
+    assert net.list_arguments() == \
+        ['data', 'fc1_weight', 'fc1_bias', 'fc2_weight', 'fc2_bias']
+    internal = net.get_internals()
+    assert internal['fc1_output'].list_arguments() == \
+        fc1.list_arguments()
+
+
+def test_symbol_pickle_roundtrip():
+    """reference test_symbol.py:87: symbols pickle via their JSON."""
+    import pickle
+    data = mx.sym.Variable('data')
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name='c')
+    net = mx.sym.SoftmaxOutput(mx.sym.Flatten(net), name='softmax')
+    clone = pickle.loads(pickle.dumps(net))
+    assert clone.tojson() == net.tojson()
+    assert clone.list_arguments() == net.list_arguments()
