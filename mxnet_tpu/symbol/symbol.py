@@ -339,11 +339,18 @@ class Symbol:
         heads concatenate; leaf variables contribute none).  None when
         no head has inputs."""
         heads = []
+        seen = set()
         for node, _ in self._heads:
+            # reference nnvm GetChildren visits each head NODE once:
+            # three expanded outputs of one SliceChannel contribute its
+            # inputs a single time
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
             heads.extend(node.inputs)
         if not heads:
             return None
-        return Symbol(list(heads))
+        return Symbol(heads)
 
     # -- attributes ---------------------------------------------------------
     def attr(self, key):
