@@ -175,18 +175,31 @@ def guard_chip_client(mark, error_json, hold_budget_s=0.0,
                    "the relay free" % (deadline - now, hold_budget_s))
             mark("GUARD: " + msg)
             return False, msg, GUARD_DEADLINE
-        if getattr(guard_chip_client, "_hard_exit_armed", False):
-            # idempotent: OOM-retry loops re-enter init
+        if (getattr(guard_chip_client, "_hard_exit_armed", False)
+                and getattr(guard_chip_client, "_armed_deadline", None)
+                == deadline
+                and not guard_chip_client._disarm.is_set()):
+            # idempotent: OOM-retry loops re-enter init.  A CHANGED
+            # $RELAY_DEADLINE_EPOCH or a fired _disarm re-arms below — a
+            # later call must never silently run with no armed deadline
+            # (checking the event directly closes the window where the
+            # disarmed thread hasn't yet cleared the flag).
             return True, None, None
         guard_chip_client._hard_exit_armed = True
+        guard_chip_client._armed_deadline = deadline
         # test hook: lets a pytest process that legitimately armed the
-        # thread disarm it again (no production caller ever should)
+        # thread disarm it again (no production caller ever should).
+        # Publish the NEW event before retiring any stale-deadline thread:
+        # the old thread's identity check must already see the new event,
+        # or it could clear the freshly-set armed flag.
+        old = getattr(guard_chip_client, "_disarm", None)
         guard_chip_client._disarm = threading.Event()
+        disarm = guard_chip_client._disarm
+        if old is not None:
+            old.set()
 
         def _hard_exit():
-            while True:
-                if guard_chip_client._disarm.is_set():
-                    return
+            while not disarm.is_set():
                 left = deadline - time.time()
                 if left <= 0:
                     out = dict(error_json)
@@ -196,7 +209,11 @@ def guard_chip_client(mark, error_json, hold_budget_s=0.0,
                     print(json.dumps(out), flush=True)
                     mark("GUARD: deadline hard-exit")
                     os._exit(4)
-                time.sleep(min(15.0, max(0.5, left / 2)))
+                disarm.wait(min(15.0, max(0.5, left / 2)))
+            # disarm fired: leave the flag clear so a later guard call
+            # (e.g. a new deadline in the same pytest process) re-arms
+            if guard_chip_client._disarm is disarm:
+                guard_chip_client._hard_exit_armed = False
 
         threading.Thread(target=_hard_exit, daemon=True).start()
     return True, None, None

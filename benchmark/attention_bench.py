@@ -131,7 +131,7 @@ def _write_dispatch_table(rows, dev):
     from benchmark._bench_common import is_cpu_device
     if is_cpu_device(getattr(dev, "device_kind", "cpu")):
         return
-    best = {}  # (S, gqa) -> (speedup, blocks)
+    best = {}  # (S, gqa) -> (rank, blocks, speedup)
     for r in rows:
         if "flash_fwd_ms" not in r:
             continue
@@ -140,18 +140,20 @@ def _write_dispatch_table(rows, dev):
             # the XLA reference cannot run BACKWARD at this shape (its
             # O(S^2) scores OOMed): flash is the only trainable impl —
             # never let a fwd-only comparison hand the win to xla here
-            sp = float("inf")
+            tier, sp = 2, float("inf")
         elif r.get("bwd_speedup") is not None:
-            sp = r["bwd_speedup"]
+            tier, sp = 1, r["bwd_speedup"]
         else:
-            sp = r.get("fwd_speedup") or 0.0
-        # rank: speedup first, then RAW flash time (negated) so that
+            tier, sp = 0, r.get("fwd_speedup") or 0.0
+        # rank: measurement tier FIRST so bwd-timed rows are never
+        # compared against fwd-only fallback rows (like-for-like within
+        # a key); then speedup; then RAW flash time (negated) so that
         # inf-speedup rows (naive OOMed everywhere) still pick the
         # FASTEST flash tile config, not the first swept
         flash_ms = r.get("flash_bwd_ms") or r.get("flash_fwd_ms") or 1e9
-        rank = (sp, -flash_ms)
+        rank = (tier, sp, -flash_ms)
         if key not in best or rank > best[key][0]:
-            best[key] = (rank, r.get("blocks", "128x128"))
+            best[key] = (rank, r.get("blocks", "128x128"), sp)
     # each measured S speaks for its neighborhood: ranges split at the
     # geometric midpoint between adjacent measured lengths.  The winning
     # BLOCK CONFIG ships with the row — dispatch must run the config
@@ -163,7 +165,7 @@ def _write_dispatch_table(rows, dev):
             lo = 0 if i == 0 else int((seqs[i - 1] * s) ** 0.5) + 1
             hi = (1 << 62) if i == len(seqs) - 1 \
                 else int((s * seqs[i + 1]) ** 0.5)
-            (sp, _), blocks = best[(s, gqa)]
+            _, blocks, sp = best[(s, gqa)]
             table_rows.append(
                 {"min_seq": lo, "max_seq": hi, "gqa": gqa,
                  "measured_seq": s, "blocks": blocks,

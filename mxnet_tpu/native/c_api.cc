@@ -148,6 +148,41 @@ PyObject *call(const char *fn, PyObject *args) {
   return r;
 }
 
+/* PyLong conversions that CONSUME the reference, with an error check: a
+ * non-int return would otherwise yield a garbage value with rc 0 and
+ * leave a pending Python exception to corrupt the next API call. */
+int long_out_u64(PyObject *r, uint64_t *out) {
+  uint64_t v = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  if (v == static_cast<uint64_t>(-1) && PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = v;
+  return 0;
+}
+
+int long_out_int(PyObject *r, int *out) {
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (v == -1 && PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = static_cast<int>(v);
+  return 0;
+}
+
+/* After a loop of PyLong_As* over borrowed container items: surface any
+ * pending conversion error as rc -1 instead of silent garbage. */
+int check_item_errs() {
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
 PyObject *shape_tuple(const int64_t *shape, int ndim) {
   PyObject *t = PyTuple_New(ndim);
   for (int i = 0; i < ndim; ++i) {
@@ -268,9 +303,7 @@ int MXTNDArrayCreate(const int64_t *shape, int ndim, const char *dtype,
                      Py_BuildValue("(Nsii)", shape_tuple(shape, ndim),
                                    dtype, dev_type, dev_id));
   if (r == nullptr) return -1;
-  *out = PyLong_AsUnsignedLongLong(r);
-  Py_DECREF(r);
-  return 0;
+  return long_out_u64(r, out);
 }
 
 int MXTNDArrayFromData(const void *data, const int64_t *shape, int ndim,
@@ -282,9 +315,7 @@ int MXTNDArrayFromData(const void *data, const int64_t *shape, int ndim,
       Py_BuildValue("(KNsii)", reinterpret_cast<uint64_t>(data),
                     shape_tuple(shape, ndim), dtype, dev_type, dev_id));
   if (r == nullptr) return -1;
-  *out = PyLong_AsUnsignedLongLong(r);
-  Py_DECREF(r);
-  return 0;
+  return long_out_u64(r, out);
 }
 
 int MXTNDArrayFree(MXTHandle h) {
@@ -299,9 +330,7 @@ int MXTNDArrayGetNDim(MXTHandle h, int *out) {
   API_ENTER();
   PyObject *r = call("ndarray_ndim", Py_BuildValue("(K)", h));
   if (r == nullptr) return -1;
-  *out = static_cast<int>(PyLong_AsLong(r));
-  Py_DECREF(r);
-  return 0;
+  return long_out_int(r, out);
 }
 
 int MXTNDArrayGetShape(MXTHandle h, int64_t *shape) {
@@ -313,7 +342,7 @@ int MXTNDArrayGetShape(MXTHandle h, int64_t *shape) {
     shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
   }
   Py_DECREF(r);
-  return 0;
+  return check_item_errs();
 }
 
 int MXTNDArrayGetDType(MXTHandle h, char *buf, size_t bufsize,
@@ -330,8 +359,9 @@ int MXTNDArrayGetNBytes(MXTHandle h, size_t *out) {
   API_ENTER();
   PyObject *r = call("ndarray_nbytes", Py_BuildValue("(K)", h));
   if (r == nullptr) return -1;
-  *out = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
-  Py_DECREF(r);
+  uint64_t v = 0;
+  if (long_out_u64(r, &v) != 0) return -1;
+  *out = static_cast<size_t>(v);
   return 0;
 }
 
@@ -407,6 +437,11 @@ int MXTNDArrayLoad(const char *path, int *num_out, MXTHandle *handles,
     for (Py_ssize_t i = 0; i < n; ++i) {
       handles[i] = PyLong_AsUnsignedLongLong(PyList_GET_ITEM(hs, i));
     }
+    if (check_item_errs() != 0) {
+      free_py_handles(hs);
+      Py_DECREF(r);
+      return -1;
+    }
   }
   int rc = 0;
   if (names_buf != nullptr || names_needed != nullptr) {
@@ -453,6 +488,11 @@ int MXTImperativeInvoke(const char *op_name, int nin,
   for (Py_ssize_t i = 0; i < n; ++i) {
     outputs[i] = PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i));
   }
+  if (check_item_errs() != 0) {
+    free_py_handles(r);  // the op's output arrays can't reach the caller
+    Py_DECREF(r);
+    return -1;
+  }
   Py_DECREF(r);
   return 0;
 }
@@ -480,9 +520,7 @@ static int symbol_from(const char *fn, const char *arg, MXTHandle *out) {
   API_ENTER();
   PyObject *r = call(fn, Py_BuildValue("(s)", arg));
   if (r == nullptr) return -1;
-  *out = PyLong_AsUnsignedLongLong(r);
-  Py_DECREF(r);
-  return 0;
+  return long_out_u64(r, out);
 }
 
 int MXTSymbolCreateFromJSON(const char *json, MXTHandle *out) {
@@ -534,9 +572,7 @@ int MXTPredCreate(const char *symbol_json, const char *param_path,
                                    str_tuple(input_names, num_input),
                                    shapes));
   if (r == nullptr) return -1;
-  *out = PyLong_AsUnsignedLongLong(r);
-  Py_DECREF(r);
-  return 0;
+  return long_out_u64(r, out);
 }
 
 int MXTPredReshape(MXTHandle pred, int num_input,
@@ -577,9 +613,7 @@ int MXTPredGetNumOutputs(MXTHandle pred, int *out) {
   API_ENTER();
   PyObject *r = call("predictor_num_outputs", Py_BuildValue("(K)", pred));
   if (r == nullptr) return -1;
-  *out = static_cast<int>(PyLong_AsLong(r));
-  Py_DECREF(r);
-  return 0;
+  return long_out_int(r, out);
 }
 
 int MXTPredGetOutputShape(MXTHandle pred, int index, int64_t *shape,
@@ -598,7 +632,7 @@ int MXTPredGetOutputShape(MXTHandle pred, int index, int64_t *shape,
     shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
   }
   Py_DECREF(r);
-  return 0;
+  return check_item_errs();
 }
 
 int MXTPredGetOutput(MXTHandle pred, int index, float *data, size_t size) {
@@ -625,9 +659,11 @@ int MXTAutogradSetIsRecording(int recording, int *prev) {
   PyObject *r = call("autograd_set_recording",
                      Py_BuildValue("(i)", recording));
   if (r == nullptr) return -1;
-  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
-  Py_DECREF(r);
-  return 0;
+  if (prev == nullptr) {
+    Py_DECREF(r);
+    return 0;
+  }
+  return long_out_int(r, prev);
 }
 
 int MXTAutogradSetIsTraining(int training, int *prev) {
@@ -635,18 +671,18 @@ int MXTAutogradSetIsTraining(int training, int *prev) {
   PyObject *r = call("autograd_set_training",
                      Py_BuildValue("(i)", training));
   if (r == nullptr) return -1;
-  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
-  Py_DECREF(r);
-  return 0;
+  if (prev == nullptr) {
+    Py_DECREF(r);
+    return 0;
+  }
+  return long_out_int(r, prev);
 }
 
 int MXTAutogradIsRecording(int *out) {
   API_ENTER();
   PyObject *r = call("autograd_is_recording", nullptr);
   if (r == nullptr) return -1;
-  *out = static_cast<int>(PyLong_AsLong(r));
-  Py_DECREF(r);
-  return 0;
+  return long_out_int(r, out);
 }
 
 int MXTNDArrayAttachGrad(MXTHandle h, const char *grad_req) {
@@ -662,9 +698,7 @@ int MXTNDArrayGetGrad(MXTHandle h, MXTHandle *out) {
   API_ENTER();
   PyObject *r = call("ndarray_get_grad", Py_BuildValue("(K)", h));
   if (r == nullptr) return -1;
-  *out = PyLong_AsUnsignedLongLong(r);
-  Py_DECREF(r);
-  return 0;
+  return long_out_u64(r, out);
 }
 
 int MXTAutogradBackward(int num_heads, const MXTHandle *heads,
@@ -686,9 +720,7 @@ int MXTAutogradBackward(int num_heads, const MXTHandle *heads,
 static int call_handle_out(const char *fn, PyObject *args, MXTHandle *out) {
   PyObject *r = call(fn, args);
   if (r == nullptr) return -1;
-  *out = PyLong_AsUnsignedLongLong(r);
-  Py_DECREF(r);
-  return 0;
+  return long_out_u64(r, out);
 }
 
 static int call_void(const char *fn, PyObject *args) {
@@ -701,9 +733,7 @@ static int call_void(const char *fn, PyObject *args) {
 static int call_int_out(const char *fn, PyObject *args, int *out) {
   PyObject *r = call(fn, args);
   if (r == nullptr) return -1;
-  *out = static_cast<int>(PyLong_AsLong(r));
-  Py_DECREF(r);
-  return 0;
+  return long_out_int(r, out);
 }
 
 int MXTModuleCreate(MXTHandle symbol, int num_data,

@@ -379,7 +379,9 @@ _DISPATCH_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "docs", "artifacts",
     "attention_dispatch.json")
-_dispatch_table = None
+_dispatch_cache = None  # (mtime_or_None, rows)
+_dispatch_stat_t = 0.0  # last time the file was stat'ed
+_DISPATCH_STAT_PERIOD_S = 2.0
 
 
 def _load_dispatch_table():
@@ -388,16 +390,32 @@ def _load_dispatch_table():
     ``{"min_seq": int, "max_seq": int, "gqa": bool, "winner":
     "flash"|"xla"}``.  Absent file = empty table (flash wins by
     default — it exists because it beats XLA at the long-seq shapes
-    the framework targets)."""
-    global _dispatch_table
-    if _dispatch_table is None:
-        try:
-            import json
-            with open(_DISPATCH_PATH) as f:
-                _dispatch_table = json.load(f)["rows"]
-        except Exception:  # noqa: BLE001 — missing/invalid = default
-            _dispatch_table = []
-    return _dispatch_table
+    the framework targets).  Keyed on file mtime so a table written
+    later in the same process (bench, then immediate use) is seen;
+    the stat is throttled so eager-mode op dispatch doesn't pay a
+    syscall per call."""
+    global _dispatch_cache, _dispatch_stat_t
+    import time as _time
+    now = _time.monotonic()
+    if (_dispatch_cache is not None
+            and now - _dispatch_stat_t < _DISPATCH_STAT_PERIOD_S):
+        return _dispatch_cache[1]
+    _dispatch_stat_t = now
+    try:
+        mtime = os.path.getmtime(_DISPATCH_PATH)
+    except OSError:
+        mtime = None
+    if _dispatch_cache is None or _dispatch_cache[0] != mtime:
+        rows = []
+        if mtime is not None:
+            try:
+                import json
+                with open(_DISPATCH_PATH) as f:
+                    rows = json.load(f)["rows"]
+            except Exception:  # noqa: BLE001 — invalid = default
+                rows = []
+        _dispatch_cache = (mtime, rows)
+    return _dispatch_cache[1]
 
 
 def pick_attention_config(seq_len, gqa):
@@ -410,19 +428,22 @@ def pick_attention_config(seq_len, gqa):
     where the chip sweep shows XLA winning, dispatch follows the
     data)."""
     mode = os.environ.get("MXNET_ATTENTION_IMPL", "auto").lower()
-    if mode in ("flash", "xla"):
-        return mode, 128, 128
+    impl, bq, bk = "flash", 128, 128
     for row in _load_dispatch_table():
         if (row.get("min_seq", 0) <= seq_len <= row.get("max_seq", 1 << 62)
                 and bool(row.get("gqa", False)) == bool(gqa)):
-            bq, bk = 128, 128
             try:
                 bq, bk = (int(x) for x in
                           str(row.get("blocks", "128x128")).split("x"))
             except ValueError:
                 pass
-            return row.get("winner", "flash"), bq, bk
-    return "flash", 128, 128
+            impl = row.get("winner", "flash")
+            break
+    # a forced mode overrides the impl choice only — the shape's measured
+    # tile config still applies (dispatch must run what was measured)
+    if mode in ("flash", "xla"):
+        return mode, bq, bk
+    return impl, bq, bk
 
 
 def pick_attention_impl(seq_len, gqa):

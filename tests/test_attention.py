@@ -2,6 +2,9 @@
 
 Pallas kernel runs in interpret mode on the CPU mesh — same code path as
 TPU (SURVEY.md §4 consistency strategy)."""
+import os
+import time
+
 import numpy as np
 import pytest
 import jax
@@ -346,13 +349,29 @@ def test_attention_impl_dispatch(monkeypatch, tmp_path):
     path = tmp_path / "attention_dispatch.json"
     path.write_text(json.dumps(table))
     monkeypatch.setattr(att, "_DISPATCH_PATH", str(path))
-    monkeypatch.setattr(att, "_dispatch_table", None)  # drop cache
+    monkeypatch.setattr(att, "_dispatch_cache", None)  # drop cache
     assert att.pick_attention_impl(256, False) == "xla"
     assert att.pick_attention_impl(4096, False) == "flash"
     assert att.pick_attention_impl(256, True) == "flash"  # no gqa row
+
     # registry op respects the table (xla branch, numerics identical)
     out = mx.nd.flash_attention(mx.nd.NDArray(q), mx.nd.NDArray(k),
                                 mx.nd.NDArray(v), causal=True)
     np.testing.assert_allclose(out.asnumpy(), np.asarray(out_xla),
                                rtol=1e-5, atol=1e-5)
-    monkeypatch.setattr(att, "_dispatch_table", None)
+
+    # a table REWRITTEN in the same process is observed (mtime cache) —
+    # the bench-then-use flow must not require a restart.  The stat is
+    # throttled (~2s) for eager-op dispatch cost; expire the throttle
+    # instead of sleeping through it.
+    table["rows"][0]["winner"] = "flash"
+    table["rows"][0]["blocks"] = "256x128"
+    path.write_text(json.dumps(table))
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    monkeypatch.setattr(att, "_dispatch_stat_t", 0.0)
+    assert att.pick_attention_config(256, False) == ("flash", 256, 128)
+    # a forced impl still runs the shape's MEASURED tile config
+    monkeypatch.setenv("MXNET_ATTENTION_IMPL", "xla")
+    assert att.pick_attention_config(256, False) == ("xla", 256, 128)
+    monkeypatch.setenv("MXNET_ATTENTION_IMPL", "auto")
+    monkeypatch.setattr(att, "_dispatch_cache", None)

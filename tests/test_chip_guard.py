@@ -151,6 +151,31 @@ def test_hard_exit_frees_relay_at_deadline():
     assert "deadline" in line["error"]
 
 
+def test_guard_rearms_after_disarm_and_deadline_change(disarm_guard):
+    # ADVICE r4: after the test-hook disarm fired, a later guard call with
+    # a CHANGED deadline must re-arm — not silently run unprotected.
+    _skip_if_timeout_ancestor()
+    mark = make_mark("t")
+    os.environ["RELAY_DEADLINE_EPOCH"] = str(time.time() + 3600)
+    try:
+        ok, _, _ = guard_chip_client(mark, {}, hold_budget_s=1.0)
+        assert ok and guard_chip_client._hard_exit_armed
+        ev1 = guard_chip_client._disarm
+        ev1.set()
+        for _ in range(100):  # disarm wakes the thread via Event.wait
+            if not guard_chip_client._hard_exit_armed:
+                break
+            time.sleep(0.05)
+        assert not guard_chip_client._hard_exit_armed
+        os.environ["RELAY_DEADLINE_EPOCH"] = str(time.time() + 7200)
+        ok, _, _ = guard_chip_client(mark, {}, hold_budget_s=1.0)
+        assert ok
+        assert guard_chip_client._hard_exit_armed
+        assert guard_chip_client._disarm is not ev1
+    finally:
+        del os.environ["RELAY_DEADLINE_EPOCH"]
+
+
 def test_guarded_backend_init_bounds_stuck_init(monkeypatch):
     # A hung backend (jax.devices blocks forever) must come back as a
     # clean (None, err) within the init deadline — the stuck-init
