@@ -438,7 +438,11 @@ int MXTNDArrayLoad(const char *path, int *num_out, MXTHandle *handles,
       handles[i] = PyLong_AsUnsignedLongLong(PyList_GET_ITEM(hs, i));
     }
     if (check_item_errs() != 0) {
+      // cleanup may itself fail and clobber tls_error — keep the root
+      // cause for MXTGetLastError
+      std::string cause = tls_error;
       free_py_handles(hs);
+      tls_error = cause;
       Py_DECREF(r);
       return -1;
     }
@@ -489,7 +493,11 @@ int MXTImperativeInvoke(const char *op_name, int nin,
     outputs[i] = PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i));
   }
   if (check_item_errs() != 0) {
-    free_py_handles(r);  // the op's output arrays can't reach the caller
+    // the op's output arrays can't reach the caller — release them, but
+    // keep the conversion error as the reported cause
+    std::string cause = tls_error;
+    free_py_handles(r);
+    tls_error = cause;
     Py_DECREF(r);
     return -1;
   }

@@ -35,6 +35,19 @@ def _run(script, *args, timeout=420, env_extra=None):
     return out.stderr + out.stdout
 
 
+def _run_bench_smoke(script, env_extra):
+    """Run a benchmark/ script in CPU smoke mode; return its JSON line."""
+    import json
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    env.pop("RELAY_DEADLINE_EPOCH", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmark", script)],
+        env=env, capture_output=True, text=True, timeout=900, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def test_train_mnist_example():
     log = _run("examples/image_classification/train_mnist.py",
                "--synthetic", "--num-epochs", "2", "--batch-size", "64")
@@ -215,19 +228,22 @@ def test_neural_style_example():
 def test_kvstore_facade_bench_smoke():
     """The facade-overhead bench runs end-to-end in CPU smoke mode and
     reports a sane ratio (both paths train the same model)."""
-    import json
-    env = dict(os.environ, JAX_PLATFORMS="cpu", KVF_CPU="1",
-               KVF_ITERS="2")
-    env.pop("RELAY_DEADLINE_EPOCH", None)
-    env.pop("XLA_FLAGS", None)
-    out = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "benchmark",
-                                      "kvstore_facade_bench.py")],
-        env=env, capture_output=True, text=True, timeout=900, cwd=ROOT)
-    assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
-    row = json.loads(out.stdout.strip().splitlines()[-1])
+    row = _run_bench_smoke("kvstore_facade_bench.py",
+                           {"KVF_CPU": "1", "KVF_ITERS": "2"})
     assert row["metric"] == "kvstore_facade_overhead_ratio"
     assert row["value"] is not None and row["value"] > 0.2
+
+
+def test_rnn_bench_smoke():
+    """The PTB-LSTM bench (fused RNN op perf story, SURVEY §7) runs
+    end-to-end in CPU smoke mode and reports a sane tokens/sec."""
+    row = _run_bench_smoke("rnn_bench.py", {
+        "RNB_CPU": "1", "RNB_LAYERS": "1", "RNB_HIDDEN": "32",
+        "RNB_EMBED": "32", "RNB_SEQ": "8", "RNB_BATCH": "4",
+        "RNB_VOCAB": "50", "RNB_ITERS": "2", "RNB_WARMUP": "1"})
+    assert row["metric"] == "lstm_ptb_tokens_per_sec"
+    assert row["value"] is not None and row["value"] > 0
+    assert row["device"] == "cpu"  # smoke must never claim chip evidence
 
 
 def test_bi_lstm_sort_example():

@@ -160,6 +160,12 @@ deadline_check "kvstore facade bench"
 echo "== [$(TS)] kvstore facade bench" >&2
 python benchmark/kvstore_facade_bench.py || probe_or_die
 
+# 4d. PTB-LSTM step bench — the fused lax.scan RNN's TPU number
+# (VERDICT r4 item 6: the cuDNN-RNN parity story needs a measurement)
+deadline_check "rnn LSTM bench"
+echo "== [$(TS)] rnn LSTM bench" >&2
+python benchmark/rnn_bench.py || probe_or_die
+
 # 5. real-data convergence artifact (VERDICT item 4)
 deadline_check "digits convergence"
 echo "== [$(TS)] digits convergence" >&2
