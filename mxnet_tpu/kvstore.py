@@ -188,14 +188,60 @@ class KVStore:
 
     # -- internals ------------------------------------------------------------
     def _reduce(self, vs: List[NDArray]):
+        """Sum the pushed copies; reduce WHERE THE DATA LIVES (reference:
+        CommDevice reduces on the devices holding the data, comm.h:462).
+
+        Values living on distinct devices are viewed as ONE device-spanning
+        stacked jax.Array and summed with replicated output, so XLA emits
+        an ICI all-reduce instead of gathering every copy through a single
+        chip; the result then lands on the first value's device (same
+        contract as the gather path) via a local no-copy shard pick.
+        Same-device / mixed-placement values keep the stacked-jit sum."""
         if len(vs) == 1:
             return vs[0]._data
+        datas = [v._data for v in vs]
+        devs = []
+        for x in datas:
+            ds = getattr(x, "devices", None)
+            ds = tuple(ds()) if callable(ds) else ()
+            devs.append(ds[0] if len(ds) == 1 else None)
+        if (None not in devs and len(set(devs)) == len(devs) > 1
+                and len({d.platform for d in devs}) == 1):
+            # distinct same-platform devices: all-reduce on the mesh
+            # (a cpu+tpu mix can't form one mesh — gather instead)
+            return self._reduce_on_mesh(datas, devs)
+        uniq = {d for d in devs if d is not None}
+        if len(uniq) > 1 or (None in devs and uniq):
+            # mixed placement (repeated devices, cross-platform values,
+            # or a sharded value beside committed ones): explicit gather
+            # to the first value's device — jit refuses committed args
+            # spread over devices
+            target = devs[0] or next(d for d in devs if d is not None)
+            datas = [jax.device_put(x, target) for x in datas]
         sig = (len(vs), vs[0].shape, str(vs[0].dtype))
         if sig not in self._sum_cache:
             self._sum_cache[sig] = jax.jit(
                 lambda *xs: jnp.sum(jnp.stack(xs), axis=0)
                 if len(xs) > 2 else (xs[0] + xs[1]))
-        return self._sum_cache[sig](*[v._data for v in vs])
+        return self._sum_cache[sig](*datas)
+
+    def _reduce_on_mesh(self, datas, devs):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        shape, dtype = datas[0].shape, datas[0].dtype
+        sig = ("mesh", len(datas), shape, str(dtype),
+               tuple(d.id for d in devs))
+        if sig not in self._sum_cache:
+            mesh = Mesh(np.array(devs), ("kv",))
+            sharded = NamedSharding(mesh, PartitionSpec("kv"))
+            replicated = NamedSharding(mesh, PartitionSpec())
+            fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                         out_shardings=replicated)
+            self._sum_cache[sig] = (sharded, fn)
+        sharded, fn = self._sum_cache[sig]
+        stacked = jax.make_array_from_single_device_arrays(
+            (len(datas),) + tuple(shape), sharded,
+            [x[None] for x in datas])
+        return jax.device_put(fn(stacked), devs[0])
 
     @staticmethod
     def _key_int(k):

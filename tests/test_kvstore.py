@@ -144,3 +144,41 @@ def test_init_duplicate_key_raises():
     kv.init(9, mx.nd.zeros(SHAPE))
     with pytest.raises(Exception):
         kv.init(9, mx.nd.zeros(SHAPE))
+
+
+def test_push_reduce_where_data_lives():
+    """Values on DISTINCT devices reduce via a device-spanning all-reduce
+    instead of a gather through one chip (reference: CommDevice reduces
+    where the data lives, comm.h:462); result lands on the first value's
+    device and numerics match the host sum."""
+    import jax
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs a multi-device mesh")
+    kv = mx.kv.create('device')
+    kv.init(3, mx.nd.zeros(SHAPE))
+    host = [np.full(SHAPE, i + 1, np.float32) for i in range(4)]
+    vals = []
+    for i, h in enumerate(host):
+        v = mx.nd.NDArray(jax.device_put(h, devs[i]))
+        v.wait_to_read()
+        vals.append(v)
+    agg = kv._reduce(vals)
+    assert tuple(agg.devices()) == (devs[0],)   # gather-path contract
+    np.testing.assert_allclose(np.asarray(agg), sum(host))
+    # the full push/pull path over distinct-device values
+    kv.push(3, vals)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), sum(host))
+    # mixed placement (duplicate devices) falls back to the stacked sum
+    dup = vals + [mx.nd.NDArray(jax.device_put(host[0], devs[0]))]
+    np.testing.assert_allclose(np.asarray(kv._reduce(dup)),
+                               sum(host) + host[0])
+    # a SHARDED value beside committed ones also gathers cleanly
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    sh = NamedSharding(Mesh(np.array(devs[:4]), ("d",)),
+                       PartitionSpec("d"))
+    sharded = mx.nd.NDArray(jax.device_put(host[1], sh))
+    np.testing.assert_allclose(
+        np.asarray(kv._reduce([vals[0], sharded])), host[0] + host[1])
