@@ -3,11 +3,55 @@
 Cells are HybridBlocks: one graph node set per step, composed by
 ``unroll``; under ``hybridize()`` the unrolled loop compiles to one XLA
 program whose per-step matmuls XLA schedules back-to-back on the MXU.
+
+Compatibility contract, deliberately preserved from the reference API:
+parameter names (``i2h_weight`` …), gate order ([i, f, c, o] for LSTM,
+[r, z, o] for GRU), state_info layouts, and cell aliases — these make
+reference checkpoints load into gluon models unchanged.  Within that
+contract the cell bodies share ``_fc_pair`` (both per-step projections,
+all gates batched into one matmul) and the ``_lstm_step``/``_gru_step``
+recurrences.
 """
 from __future__ import annotations
 
 from ..block import Block, HybridBlock
 from ...base import MXNetError
+
+
+def _fc_pair(F, inputs, prev_h, n_units, i2h_weight, h2h_weight,
+             i2h_bias, h2h_bias):
+    """Both per-step projections with every gate batched into one matmul
+    each — the shape all cells share."""
+    i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                           num_hidden=n_units)
+    h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                           num_hidden=n_units)
+    return i2h, h2h
+
+
+def _lstm_step(F, gates, prev_c):
+    """LSTM recurrence over summed pre-activation gates; order
+    [i, f, c, o] is the checkpoint/fused-op contract."""
+    sl = list(F.SliceChannel(gates, num_outputs=4))
+    in_gate = F.Activation(sl[0], act_type='sigmoid')
+    forget_gate = F.Activation(sl[1], act_type='sigmoid')
+    in_transform = F.Activation(sl[2], act_type='tanh')
+    out_gate = F.Activation(sl[3], act_type='sigmoid')
+    next_c = forget_gate * prev_c + in_gate * in_transform
+    next_h = out_gate * F.Activation(next_c, act_type='tanh')
+    return next_h, next_c
+
+
+def _gru_step(F, i2h, h2h, prev_h):
+    """GRU recurrence over the two projection outputs; order [r, z, o],
+    candidate mixes the reset-gated recurrent slice."""
+    i2h_r, i2h_z, i2h_o = list(F.SliceChannel(i2h, num_outputs=3))
+    h2h_r, h2h_z, h2h_o = list(F.SliceChannel(h2h, num_outputs=3))
+    reset_gate = F.Activation(i2h_r + h2h_r, act_type='sigmoid')
+    update_gate = F.Activation(i2h_z + h2h_z, act_type='sigmoid')
+    next_h_tmp = F.Activation(i2h_o + reset_gate * h2h_o,
+                              act_type='tanh')
+    return update_gate * prev_h + (1. - update_gate) * next_h_tmp
 
 
 def _cells_state_info(cells, batch_size):
@@ -208,10 +252,8 @@ class RNNCell(HybridRecurrentCell):
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size)
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size)
+        i2h, h2h = _fc_pair(F, inputs, states[0], self._hidden_size,
+                            i2h_weight, h2h_weight, i2h_bias, h2h_bias)
         output = self._get_activation(F, i2h + h2h, self._activation)
         return output, [output]
 
@@ -251,18 +293,9 @@ class LSTMCell(HybridRecurrentCell):
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=4 * self._hidden_size)
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=4 * self._hidden_size)
-        gates = i2h + h2h
-        slice_gates = list(F.SliceChannel(gates, num_outputs=4))
-        in_gate = F.Activation(slice_gates[0], act_type='sigmoid')
-        forget_gate = F.Activation(slice_gates[1], act_type='sigmoid')
-        in_transform = F.Activation(slice_gates[2], act_type='tanh')
-        out_gate = F.Activation(slice_gates[3], act_type='sigmoid')
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * F.Activation(next_c, act_type='tanh')
+        i2h, h2h = _fc_pair(F, inputs, states[0], 4 * self._hidden_size,
+                            i2h_weight, h2h_weight, i2h_bias, h2h_bias)
+        next_h, next_c = _lstm_step(F, i2h + h2h, states[1])
         return next_h, [next_h, next_c]
 
 
@@ -299,18 +332,9 @@ class GRUCell(HybridRecurrentCell):
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        prev_state_h = states[0]
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=3 * self._hidden_size)
-        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
-                               num_hidden=3 * self._hidden_size)
-        i2h_r, i2h_z, i2h = list(F.SliceChannel(i2h, num_outputs=3))
-        h2h_r, h2h_z, h2h = list(F.SliceChannel(h2h, num_outputs=3))
-        reset_gate = F.Activation(i2h_r + h2h_r, act_type='sigmoid')
-        update_gate = F.Activation(i2h_z + h2h_z, act_type='sigmoid')
-        next_h_tmp = F.Activation(i2h + reset_gate * h2h, act_type='tanh')
-        next_h = update_gate * prev_state_h + \
-            (1. - update_gate) * next_h_tmp
+        i2h, h2h = _fc_pair(F, inputs, states[0], 3 * self._hidden_size,
+                            i2h_weight, h2h_weight, i2h_bias, h2h_bias)
+        next_h = _gru_step(F, i2h, h2h, states[0])
         return next_h, [next_h]
 
 

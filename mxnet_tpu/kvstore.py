@@ -228,8 +228,11 @@ class KVStore:
     def _reduce_on_mesh(self, datas, devs):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
         shape, dtype = datas[0].shape, datas[0].dtype
+        # frozenset: the jitted sum is permutation-invariant and shards
+        # are matched to mesh positions by their DEVICE, so one compiled
+        # reducer serves every arrival order of the same device set
         sig = ("mesh", len(datas), shape, str(dtype),
-               tuple(d.id for d in devs))
+               frozenset(d.id for d in devs))
         if sig not in self._sum_cache:
             mesh = Mesh(np.array(devs), ("kv",))
             sharded = NamedSharding(mesh, PartitionSpec("kv"))

@@ -2,8 +2,17 @@
 
 Unfused cells build per-step graph nodes composed by ``unroll``; the
 ``FusedRNNCell`` emits the single fused ``RNN`` op (ops/rnn.py — the
-lax.scan replacement for cuDNN's persistent kernel).  Weight naming and
-gate order match the reference so pack/unpack round-trips.
+lax.scan replacement for cuDNN's persistent kernel).
+
+Compatibility contract, deliberately preserved from the reference API:
+parameter names (``{prefix}i2h_weight`` …), prefixes, gate order
+([i, f, c, o] for LSTM, [r, z, o] for GRU), state_info layouts, and the
+packed-parameter memory layout — these are what make reference
+checkpoints load and ``pack/unpack_weights`` round-trip.  Within that
+contract the cell bodies are organized around shared building blocks:
+``_fc_forward`` (both per-step projections with every gate batched into
+one matmul — the MXU-friendly shape), and the ``_lstm_step``/``_gru_step``
+recurrences shared by the dense AND convolutional cell variants.
 """
 from __future__ import annotations
 
@@ -67,6 +76,10 @@ class BaseRNNCell:
     @property
     def _gate_names(self):
         return ()
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
 
     def begin_state(self, func=sym_mod.zeros, **kwargs):
         """reference: rnn_cell.py:166."""
@@ -149,6 +162,58 @@ class BaseRNNCell:
             return sym_mod.Activation(inputs, act_type=activation, **kwargs)
         return activation(inputs, **kwargs)
 
+    def _fc_forward(self, inputs, prev_h, name):
+        """The step's two projections (input and recurrent) with ALL
+        gates batched into one matmul each — the shape every dense cell
+        shares; cells differ only in how they combine the slices
+        (conv cells: the analogous ``_conv_forward``)."""
+        i2h = sym_mod.FullyConnected(
+            data=inputs, weight=self._iW, bias=self._iB,
+            num_hidden=self._num_hidden * self._num_gates,
+            name=f'{name}i2h')
+        h2h = sym_mod.FullyConnected(
+            data=prev_h, weight=self._hW, bias=self._hB,
+            num_hidden=self._num_hidden * self._num_gates,
+            name=f'{name}h2h')
+        return i2h, h2h
+
+
+def _sigmoid(x):
+    return sym_mod.Activation(x, act_type='sigmoid')
+
+
+def _lstm_step(gates, prev_c, act, name):
+    """The LSTM recurrence over summed pre-activation gates, shared by
+    LSTMCell and ConvLSTMCell.  Gate order [i, f, c, o] is the fused-op /
+    pack_weights contract; ``act`` is the candidate/output nonlinearity
+    (tanh for dense cells, the configured activation for conv cells)."""
+    sl = list(sym_mod.SliceChannel(gates, num_outputs=4, axis=1,
+                                   name=f'{name}slice'))
+    in_gate, forget_gate = _sigmoid(sl[0]), _sigmoid(sl[1])
+    in_transform = act(sl[2], name=f'{name}c')
+    out_gate = _sigmoid(sl[3])
+    next_c = forget_gate * prev_c + in_gate * in_transform
+    next_h = out_gate * act(next_c, name=f'{name}out')
+    return next_h, next_c
+
+
+def _gru_step(i2h, h2h, prev_h, act, name):
+    """The GRU recurrence over the two projection outputs, shared by
+    GRUCell and ConvGRUCell.  Gate order [r, z, o]; the candidate mixes
+    the reset-gated recurrent slice before ``act``."""
+    i2h_r, i2h_z, i2h_o = list(sym_mod.SliceChannel(
+        i2h, num_outputs=3, axis=1, name=f'{name}i2h_slice'))
+    h2h_r, h2h_z, h2h_o = list(sym_mod.SliceChannel(
+        h2h, num_outputs=3, axis=1, name=f'{name}h2h_slice'))
+    reset_gate = _sigmoid(i2h_r + h2h_r)
+    update_gate = _sigmoid(i2h_z + h2h_z)
+    next_h_tmp = act(i2h_o + reset_gate * h2h_o, name=f'{name}h_act')
+    return update_gate * prev_h + (1.0 - update_gate) * next_h_tmp
+
+
+def _tanh(x, name=None):
+    return sym_mod.Activation(x, act_type='tanh', name=name)
+
 
 def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
     """reference: rnn_cell.py:46 _normalize_sequence."""
@@ -196,14 +261,7 @@ class RNNCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = f'{self._prefix}t{self._counter}_'
-        i2h = sym_mod.FullyConnected(data=inputs, weight=self._iW,
-                                     bias=self._iB,
-                                     num_hidden=self._num_hidden,
-                                     name=f'{name}i2h')
-        h2h = sym_mod.FullyConnected(data=states[0], weight=self._hW,
-                                     bias=self._hB,
-                                     num_hidden=self._num_hidden,
-                                     name=f'{name}h2h')
+        i2h, h2h = self._fc_forward(inputs, states[0], name)
         output = self._get_activation(i2h + h2h, self._activation,
                                       name=f'{name}out')
         return output, [output]
@@ -236,23 +294,8 @@ class LSTMCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = f'{self._prefix}t{self._counter}_'
-        i2h = sym_mod.FullyConnected(data=inputs, weight=self._iW,
-                                     bias=self._iB,
-                                     num_hidden=self._num_hidden * 4,
-                                     name=f'{name}i2h')
-        h2h = sym_mod.FullyConnected(data=states[0], weight=self._hW,
-                                     bias=self._hB,
-                                     num_hidden=self._num_hidden * 4,
-                                     name=f'{name}h2h')
-        gates = i2h + h2h
-        slices = list(sym_mod.SliceChannel(gates, num_outputs=4,
-                                           name=f'{name}slice'))
-        in_gate = sym_mod.Activation(slices[0], act_type='sigmoid')
-        forget_gate = sym_mod.Activation(slices[1], act_type='sigmoid')
-        in_transform = sym_mod.Activation(slices[2], act_type='tanh')
-        out_gate = sym_mod.Activation(slices[3], act_type='sigmoid')
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * sym_mod.Activation(next_c, act_type='tanh')
+        i2h, h2h = self._fc_forward(inputs, states[0], name)
+        next_h, next_c = _lstm_step(i2h + h2h, states[1], _tanh, name)
         return next_h, [next_h, next_c]
 
 
@@ -278,25 +321,8 @@ class GRUCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = f'{self._prefix}t{self._counter}_'
-        prev_state_h = states[0]
-        i2h = sym_mod.FullyConnected(data=inputs, weight=self._iW,
-                                     bias=self._iB,
-                                     num_hidden=self._num_hidden * 3,
-                                     name=f'{name}i2h')
-        h2h = sym_mod.FullyConnected(data=prev_state_h, weight=self._hW,
-                                     bias=self._hB,
-                                     num_hidden=self._num_hidden * 3,
-                                     name=f'{name}h2h')
-        i2h_r, i2h_z, i2h = list(sym_mod.SliceChannel(
-            i2h, num_outputs=3, name=f'{name}i2h_slice'))
-        h2h_r, h2h_z, h2h = list(sym_mod.SliceChannel(
-            h2h, num_outputs=3, name=f'{name}h2h_slice'))
-        reset_gate = sym_mod.Activation(i2h_r + h2h_r, act_type='sigmoid')
-        update_gate = sym_mod.Activation(i2h_z + h2h_z, act_type='sigmoid')
-        next_h_tmp = sym_mod.Activation(i2h + reset_gate * h2h,
-                                        act_type='tanh')
-        next_h = update_gate * prev_state_h + \
-            (1. - update_gate) * next_h_tmp
+        i2h, h2h = self._fc_forward(inputs, states[0], name)
+        next_h = _gru_step(i2h, h2h, states[0], _tanh, name)
         return next_h, [next_h]
 
 
@@ -335,10 +361,6 @@ class FusedRNNCell(BaseRNNCell):
         return {'rnn_relu': [''], 'rnn_tanh': [''],
                 'lstm': ['_i', '_f', '_c', '_o'],
                 'gru': ['_r', '_z', '_o']}[self._mode]
-
-    @property
-    def _num_gates(self):
-        return len(self._gate_names)
 
     def _slice_weights(self, arr, li, lh):
         """Map the flat vector to per-layer views
@@ -803,10 +825,6 @@ class BaseConvRNNCell(BaseRNNCell):
         return self.params.get('i2h_bias')
 
     @property
-    def _num_gates(self):
-        return len(self._gate_names)
-
-    @property
     def state_info(self):
         return [{'shape': self._state_shape, '__layout__': 'NCHW'},
                 {'shape': self._state_shape, '__layout__': 'NCHW'}]
@@ -878,15 +896,7 @@ class ConvLSTMCell(BaseConvRNNCell):
         self._counter += 1
         name = f'{self._prefix}t{self._counter}_'
         i2h, h2h = self._conv_forward(inputs, states, name)
-        gates = i2h + h2h
-        sl = list(sym_mod.SliceChannel(gates, num_outputs=4, axis=1,
-                                       name=f'{name}slice'))
-        in_gate = sym_mod.Activation(sl[0], act_type='sigmoid')
-        forget_gate = sym_mod.Activation(sl[1], act_type='sigmoid')
-        in_transform = self._act(sl[2], name=f'{name}c')
-        out_gate = sym_mod.Activation(sl[3], act_type='sigmoid')
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * self._act(next_c, name=f'{name}out')
+        next_h, next_c = _lstm_step(i2h + h2h, states[1], self._act, name)
         return next_h, [next_h, next_c]
 
 
@@ -910,17 +920,5 @@ class ConvGRUCell(BaseConvRNNCell):
         self._counter += 1
         name = f'{self._prefix}t{self._counter}_'
         i2h, h2h = self._conv_forward(inputs, states, name)
-        i2h_sl = list(sym_mod.SliceChannel(i2h, num_outputs=3, axis=1,
-                                           name=f'{name}i2h_slice'))
-        h2h_sl = list(sym_mod.SliceChannel(h2h, num_outputs=3, axis=1,
-                                           name=f'{name}h2h_slice'))
-        reset_gate = sym_mod.Activation(i2h_sl[0] + h2h_sl[0],
-                                        act_type='sigmoid',
-                                        name=f'{name}r_act')
-        update_gate = sym_mod.Activation(i2h_sl[1] + h2h_sl[1],
-                                         act_type='sigmoid',
-                                         name=f'{name}z_act')
-        next_h_tmp = self._act(i2h_sl[2] + reset_gate * h2h_sl[2],
-                               name=f'{name}h_act')
-        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * states[0]
+        next_h = _gru_step(i2h, h2h, states[0], self._act, name)
         return next_h, [next_h]
