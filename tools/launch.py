@@ -17,13 +17,24 @@ through the same DMLC-shaped env vars (read by
 ``-s`` is accepted for CLI compatibility and ignored with a note: server
 processes do not exist in the allreduce design (docs/design/kvstore.md).
 
-Cluster launchers (ssh/mpi/sge/yarn in the reference) are out of scope for
-local mode; on real TPU pods the platform's own process manager starts one
-process per host and `initialize()` auto-detects — see
-docs/design/kvstore.md.
+Two launchers:
+
+* ``--launcher local`` (default) — W processes on this machine.
+* ``--launcher ssh`` — W processes spread round-robin over the hosts in
+  ``-H/--hostfile`` (reference: tools/launch.py:64-80 ssh mode via
+  dmlc-tracker), each started as ``ssh <host> 'cd <dir> && env DMLC_*=…
+  cmd'``; the coordinator address defaults to this machine's IP so every
+  remote worker dials back to one jax.distributed coordination service.
+  ``--ssh-cmd`` swaps the transport binary (tests inject a local shim;
+  ``ssh -o BatchMode=yes`` style options ride here too).
+
+mpi/sge/yarn launchers are intentionally absent: on TPU pods the
+platform's own process manager starts one process per host and
+``initialize()`` auto-detects — see docs/design/kvstore.md.
 """
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -38,18 +49,109 @@ def _free_port():
     return port
 
 
+def _worker_env(args, coord_uri, port, wid):
+    """The DMLC-shaped contract every worker reads
+    (mxnet_tpu.distributed.initialize)."""
+    env = {}
+    env.update(e.split("=", 1) for e in args.env)
+    env.update({
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": coord_uri,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": "0",
+        "DMLC_WORKER_ID": str(wid),
+    })
+    return env
+
+
+def _spawn_local(args, port):
+    procs = []
+    for wid in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(_worker_env(args, "127.0.0.1", port, wid))
+        procs.append(subprocess.Popen(args.command, env=env))
+    return procs
+
+
+def _parse_hostfile(path):
+    """Hosts with their slot counts.  Lines are ``host [slots=N]``
+    (the dmlc-tracker hostfile shape); blank lines and ``#`` comments —
+    indented or not — are skipped."""
+    hosts = []
+    with open(path) as f:
+        for raw in f:
+            ln = raw.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            parts = ln.split()
+            slots = 1
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
+                    slots = max(1, int(tok.split("=", 1)[1]))
+                else:
+                    raise SystemExit(
+                        f"launch.py: unrecognized hostfile token {tok!r} "
+                        f"on line {raw!r} (expected 'host [slots=N]')")
+            hosts.extend([parts[0]] * slots)
+    return hosts
+
+
+def _spawn_ssh(args, port):
+    """reference: tools/launch.py:64-80 (ssh cluster via dmlc-tracker) —
+    one ssh per worker, workers filling each host's slots in hostfile
+    order (wrapping if -n exceeds total slots); env rides an ``env``
+    prefix inside the remote shell line because ssh does not forward it.
+
+    Worker 0 HOSTS the jax.distributed coordination service, so the
+    coordinator address every worker dials must be worker 0's host —
+    the first hostfile entry — not this launcher machine (which may not
+    be in the cluster at all).  The port is picked here and can in
+    principle collide on that host; rerun on collision."""
+    slots = _parse_hostfile(args.hostfile)
+    if not slots:
+        raise SystemExit(f"launch.py: no hosts in {args.hostfile}")
+    coord = args.coordinator_host or slots[0]
+    wdir = args.remote_dir or os.getcwd()
+    procs = []
+    for wid in range(args.num_workers):
+        host = slots[wid % len(slots)]
+        envs = _worker_env(args, coord, port, wid)
+        env_line = " ".join(f"{k}={shlex.quote(v)}"
+                            for k, v in sorted(envs.items()))
+        cmd_line = " ".join(shlex.quote(c) for c in args.command)
+        remote = f"cd {shlex.quote(wdir)} && env {env_line} {cmd_line}"
+        procs.append(subprocess.Popen(
+            shlex.split(args.ssh_cmd) + [host, remote]))
+    return procs
+
+
 def main():
     ap = argparse.ArgumentParser(
-        description="Launch a local multi-process mxnet_tpu job")
+        description="Launch a multi-process mxnet_tpu job")
     ap.add_argument("-n", "--num-workers", type=int, required=True,
                     help="number of worker processes")
     ap.add_argument("-s", "--num-servers", type=int, default=0,
                     help="accepted for reference-CLI compatibility; "
                          "ignored (no PS servers in the allreduce design)")
     ap.add_argument("--launcher", default="local",
-                    choices=["local"],
-                    help="only 'local' is supported (reference ssh/mpi/"
-                         "sge/yarn launchers do not apply to TPU pods)")
+                    choices=["local", "ssh"],
+                    help="'local' spawns on this machine; 'ssh' spreads "
+                         "workers over -H hosts (reference ssh mode); "
+                         "mpi/sge/yarn do not apply to TPU pods")
+    ap.add_argument("-H", "--hostfile",
+                    help="ssh mode: file with one host per line")
+    ap.add_argument("--ssh-cmd", default="ssh -tt",
+                    help="ssh mode: transport command (options allowed, "
+                         "e.g. 'ssh -tt -o BatchMode=yes'; -tt makes a "
+                         "local terminate() reach the remote worker)")
+    ap.add_argument("--coordinator-host", default=None,
+                    help="ssh mode: coordination-service address every "
+                         "worker dials (default: the FIRST hostfile "
+                         "entry — worker 0 hosts the service)")
+    ap.add_argument("--remote-dir", default=None,
+                    help="ssh mode: working directory on each host "
+                         "(default: this process's cwd)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE env for every worker")
     ap.add_argument("command", nargs=argparse.REMAINDER,
@@ -57,25 +159,16 @@ def main():
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    if args.launcher == "ssh" and not args.hostfile:
+        ap.error("--launcher ssh requires -H/--hostfile")
     if args.num_servers:
         print("launch.py: note: -s/--num-servers ignored — the TPU design "
               "replaces parameter servers with allreduce "
               "(docs/design/kvstore.md)", file=sys.stderr)
 
     port = _free_port()
-    procs = []
-    for wid in range(args.num_workers):
-        env = dict(os.environ)
-        env.update(e.split("=", 1) for e in args.env)
-        env.update({
-            "DMLC_ROLE": "worker",
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(port),
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_NUM_SERVER": "0",
-            "DMLC_WORKER_ID": str(wid),
-        })
-        procs.append(subprocess.Popen(args.command, env=env))
+    procs = _spawn_ssh(args, port) if args.launcher == "ssh" \
+        else _spawn_local(args, port)
 
     def _kill_all(signum=None, frame=None):
         for p in procs:
