@@ -390,8 +390,17 @@ class Executor:
         if sh is None:
             return val
         cur = getattr(val, "sharding", None)
-        if cur is not None and cur == sh:
-            return val
+        if cur is not None:
+            # is_equivalent_to, not ==: XLA normalizes trailing-None
+            # specs (P('tp', None) comes back as P('tp')), and a false
+            # mismatch here would force the host round-trip below, which
+            # cannot work for process-spanning arrays
+            try:
+                same = cur.is_equivalent_to(sh, np.ndim(val))
+            except Exception:  # noqa: BLE001 — foreign sharding types
+                same = cur == sh
+            if same:
+                return val
         if sh.is_fully_addressable:
             return jax.device_put(val, sh)
         # mesh spans processes (multi-host SPMD): device_put cannot target

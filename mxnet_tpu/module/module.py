@@ -619,12 +619,17 @@ class Module(BaseModule):
         from ..executor import maybe_mirror
         run_fwd = maybe_mirror(run)
         zero1 = self._zero_stage >= 1 and self._zero_dp() > 1
-        if zero1:
+        constrain = self._mesh is not None
+        if constrain:
             from .. import parallel as _par
             # params leave the step in their RULE sharding (tp weights
             # stay tp-sharded; replicated params replicated) — an
             # unconditional P() here would all-gather tensor-parallel
-            # weights onto every chip
+            # weights onto every chip.  Pinning is REQUIRED on any mesh,
+            # not just under ZeRO: free GSPMD propagation may emit a
+            # param with a different sharding than the next forward's
+            # declared in_sharding, and on a process-spanning mesh the
+            # executor cannot fall back to a host round-trip to fix it.
             param_pspecs = [
                 _par.infer_pspec(n, self._exec.arg_dict[n].shape,
                                  self._mesh, self._sharding_rules)
@@ -650,20 +655,21 @@ class Module(BaseModule):
             new_params, new_states = opt.apply_fused(
                 pvals, grads, states, lrs, wds, use_mp,
                 ts=(t,) * len(names) if needs_t else None)
-            if zero1:
-                # ZeRO-1: pin the schedule — state math stays dp-sharded
-                # (GSPMD reduce-scatters the grads feeding it), params
-                # leave the step in their rule sharding (the dp
-                # all-gather happens HERE, inside the fused program,
-                # overlapped by XLA)
+            if constrain:
+                # pin the schedule: params leave the step in their rule
+                # sharding (under ZeRO-1 the dp all-gather happens HERE,
+                # inside the fused program, overlapped by XLA)
                 from jax.sharding import NamedSharding
                 mesh_ = self._mesh
                 new_params = tuple(
                     jax.lax.with_sharding_constraint(
                         w, NamedSharding(mesh_, ps))
                     for w, ps in zip(new_params, param_pspecs))
+            if zero1:
+                # state math stays dp-sharded (GSPMD reduce-scatters the
+                # grads feeding it)
                 new_states = _par.constrain_zero_states(
-                    new_states, mesh_, self._zero_dp())
+                    new_states, self._mesh, self._zero_dp())
             return outs, new_aux, tuple(new_params), tuple(new_states)
 
         # Donate the buffers the step replaces — params, aux (BN stats),

@@ -1,0 +1,113 @@
+"""8-process crash → auto-resume rehearsal (reference fault story:
+ps::Postoffice recovery, kvstore_dist.h:55; here restart-from-sharded-
+checkpoint, docs/design/failure_recovery.md).
+
+Topology: 8 processes × 1 virtual CPU device = one GLOBAL 8-device mesh,
+dp=4 × tp=2 — with one device per process EVERY mesh edge crosses a
+process (DCN-shaped) boundary, the harshest layout for the one global
+SPMD program.  Each epoch every rank writes its sharded checkpoint
+piece; on the first run rank 3 SIGKILLs itself right after the epoch-2
+checkpoint barrier.  The launcher's fail-fast kills the rest of the
+cluster, tools/train_supervisor.py relaunches the WHOLE job with
+``--load-epoch 2``, and the resumed run must land on the exact same
+final parameter checksum as an uninterrupted run (momentum-free SGD:
+params-only resume is trajectory-exact).
+
+Run (what the test drives):
+  python tools/train_supervisor.py --prefix <p> -- \
+      python tools/launch.py -n 8 python tests/dist/dist_8proc_resume.py \
+      --model-prefix <p> --crash-after-epoch 2
+"""
+import argparse
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+_NPROC = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+jax = pin_cpu(n_devices=8 // _NPROC)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import checkpoint, distributed as dist  # noqa: E402
+from mxnet_tpu import models, parallel as par  # noqa: E402
+
+EPOCHS = 4
+V, S = 32, 8
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", required=True)
+    ap.add_argument("--load-epoch", type=int, default=None)
+    ap.add_argument("--crash-after-epoch", type=int, default=None)
+    a = ap.parse_args()
+
+    dist.initialize()
+    rank, nproc = dist.rank(), dist.size()
+    devs = jax.devices()
+    assert len(devs) == 8, len(devs)
+    mesh = par.make_mesh(dp=4, tp=2, devices=devs)
+
+    net = models.transformer_lm(V, S, num_layers=1, d_model=32,
+                                num_heads=2)
+    rules = par.tp_rules_for_symbol(net, mesh)
+    mod = mx.mod.Module(net, mesh=mesh, sharding_rules=rules,
+                        data_names=('data',),
+                        label_names=('softmax_label',))
+
+    rs = np.random.RandomState(0)
+    first = rs.randint(0, V, (32, 1))
+    seq = (first + np.arange(S + 1)) % V
+    it = mx.io.NDArrayIter(seq[:, :S].astype('f'), seq[:, 1:].astype('f'),
+                           batch_size=16)
+
+    arg = aux = None
+    begin = 0
+    if a.load_epoch is not None:
+        _s, arg, aux = checkpoint.load_checkpoint_sharded(
+            a.model_prefix, a.load_epoch)
+        begin = a.load_epoch
+    fresh = a.load_epoch is None
+
+    mx.random.seed(11)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier(), arg_params=arg,
+                    aux_params=aux)
+    # momentum-free SGD: no optimizer state, so a params-only resume
+    # replays the identical trajectory
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.05})
+
+    for epoch in range(begin, EPOCHS):
+        it.reset()
+        for b in it:
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+        args_now, aux_now = mod.get_params()
+        checkpoint.save_checkpoint_sharded(
+            a.model_prefix, epoch + 1, net if rank == 0 else None,
+            args_now, aux_now)
+        dist.barrier()  # every shard on disk before anyone may crash
+        if (fresh and a.crash_after_epoch is not None
+                and epoch + 1 == a.crash_after_epoch and rank == 3):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    args_f, _ = mod.get_params()
+    checksum = float(sum(np.abs(v.asnumpy()).sum()
+                         for _, v in sorted(args_f.items())))
+    dist.barrier()
+    print("dist8_resume rank %d/%d OK checksum=%.6f"
+          % (rank, nproc, checksum), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -220,3 +220,62 @@ def test_launcher_hostfile_parse_and_default_coordinator(tmp_path):
         ["HOST=a", "HOST=a", "HOST=b"]
     assert all("DMLC_PS_ROOT_URI=a" in ln for ln in lines)
     assert sum("DMLC_WORKER_ID=0" in ln for ln in lines) == 1
+
+
+def _dist8_checksums(stdout):
+    import re
+    vals = {}
+    for r in range(8):
+        m = re.search(r"dist8_resume rank %d/8 OK checksum=([\d.]+)" % r,
+                      stdout)
+        assert m, stdout[-1500:]
+        vals[r] = float(m.group(1))
+    return vals
+
+
+def test_dist_8proc_crash_resume(tmp_path):
+    """VERDICT r4 item 7: 8 processes on one global dp4xtp2 mesh (every
+    mesh edge crosses a process boundary), mid-run SIGKILL of rank 3
+    after the epoch-2 checkpoint, supervisor auto-resume of the WHOLE
+    cluster, and trajectory equality against an uninterrupted run."""
+    prefix = str(tmp_path / "d8")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    worker = os.path.join(ROOT, "tests", "dist", "dist_8proc_resume.py")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tools/train_supervisor.py"),
+         "--prefix", prefix, "--max-restarts", "2", "--backoff", "0.5",
+         "--", sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "8", sys.executable, worker,
+         "--model-prefix", prefix, "--crash-after-epoch", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=ROOT, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=1200)
+    except subprocess.TimeoutExpired:
+        import signal as _sig
+        import time as _time
+        # SIGTERM first: the supervisor forwards it to the launcher's
+        # detached session (run_once start_new_session=True), which a
+        # straight SIGKILL would orphan — workers would then hold the
+        # pipes open and communicate() below would hang the whole suite
+        os.killpg(proc.pid, _sig.SIGTERM)
+        _time.sleep(3)
+        try:
+            os.killpg(proc.pid, _sig.SIGKILL)
+        except ProcessLookupError:
+            pass
+        stdout, stderr = proc.communicate()
+        raise AssertionError("8proc resume timed out; tail: %s %s"
+                             % (stdout[-1000:], stderr[-1000:]))
+    assert proc.returncode == 0, (stdout[-2000:], stderr[-2000:])
+    assert "restart 1/2" in stderr  # the SIGKILL really happened
+    resumed = _dist8_checksums(stdout)
+    assert len(set(resumed.values())) == 1  # ranks agree
+
+    # uninterrupted reference run, fresh dir
+    ref_prefix = str(tmp_path / "ref")
+    out = _launch(8, "tests/dist/dist_8proc_resume.py",
+                  "--model-prefix", ref_prefix, timeout=1200)
+    ref = _dist8_checksums(out)
+    assert resumed[0] == ref[0], (resumed[0], ref[0])

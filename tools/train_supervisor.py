@@ -37,12 +37,15 @@ import time
 
 
 def latest_epoch(prefix):
-    """Highest N with <prefix>-<digits>.params on disk, or None.
+    """Highest N with <prefix>-<digits>.params (single-file) or
+    <prefix>-<digits>.params.index (sharded, checkpoint.py
+    save_checkpoint_sharded) on disk, or None.
     (\\d+, not \\d{4}: do_checkpoint's %04d grows past 4 digits at
     epoch 10000 and a fixed-width match would silently resume stale.)"""
     best = None
-    for p in glob.glob("%s-*.params" % prefix):
-        m = re.match(r".*-(\d+)\.params$", p)
+    for p in glob.glob("%s-*.params" % prefix) \
+            + glob.glob("%s-*.params.index" % prefix):
+        m = re.match(r".*-(\d+)\.params(\.index)?$", p)
         if m:
             n = int(m.group(1))
             best = n if best is None else max(best, n)
