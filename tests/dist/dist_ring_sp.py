@@ -1,0 +1,76 @@
+"""Process-spanning ring attention (VERDICT r4 weak 6): the sp ring's
+``ppermute`` hops cross REAL process (DCN-shaped) boundaries, and the
+online-softmax result must still be exactly full attention.
+
+Topology: N processes × (8/N) virtual CPU devices = one global 8-device
+mesh, dp=2 × sp=4.  With N≥4 every sp ring of 4 devices spans multiple
+processes (asserted below) — the multi-host analog of the single-process
+ring tests in tests/test_attention.py.
+
+Run: python tools/launch.py -n 4 python tests/dist/dist_ring_sp.py
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+_NPROC = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+jax = pin_cpu(n_devices=8 // _NPROC)
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import distributed as dist, parallel as par  # noqa: E402
+from mxnet_tpu.ops.attention import _attn_reference  # noqa: E402
+
+
+def main():
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
+
+    dist.initialize()
+    rank, nproc = dist.rank(), dist.size()
+    devs = jax.devices()
+    assert len(devs) == 8, len(devs)
+    mesh = par.make_mesh(dp=2, sp=4, devices=devs)
+    if nproc >= 4:
+        # each sp ring (a row of 4 devices at fixed dp index) must span
+        # multiple processes — otherwise this test proves nothing
+        rows = mesh.devices.reshape(2, 4)
+        for row in rows:
+            owners = {d.process_index for d in row}
+            assert len(owners) > 1, owners
+
+    B, H, S, D = 4, 2, 32, 16
+    rs = np.random.RandomState(0)  # identical on every process
+    cases = [("mha", H), ("gqa", 1)]
+    for tag, hk in cases:
+        q = rs.randn(B, H, S, D).astype(np.float32)
+        k = rs.randn(B, hk, S, D).astype(np.float32)
+        v = rs.randn(B, hk, S, D).astype(np.float32)
+        sh = NamedSharding(mesh, P("dp", None, "sp", None))
+        qs, ks, vs = (jax.make_array_from_callback(
+            a.shape, sh, lambda idx, a=a: a[idx]) for a in (q, k, v))
+        out = par.ring_attention(qs, ks, vs, mesh, causal=True)
+        got = multihost_utils.process_allgather(out, tiled=True)
+        if hk != H:
+            k_full = np.repeat(k, H // hk, axis=1)
+            v_full = np.repeat(v, H // hk, axis=1)
+        else:
+            k_full, v_full = k, v
+        ref = np.asarray(_attn_reference(
+            jnp.asarray(q), jnp.asarray(k_full), jnp.asarray(v_full),
+            True, None))
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5,
+                                   err_msg=tag)
+    dist.barrier()
+    print("dist_ring_sp rank %d/%d OK" % (rank, nproc), flush=True)
+
+
+if __name__ == "__main__":
+    main()
