@@ -96,9 +96,15 @@ def _make_record_iter(batch):
                     rs.randint(0, 256, (256, 256, 3),
                                np.uint8).tobytes()))
             rec.close()
+    # NHWC host layout: unflipped rows are single memcpys (~10x the NCHW
+    # gather on one core); the HWC->CHW transpose happens on DEVICE where
+    # it fuses into the uint8->fp32 cast.  BENCH_RECORD_LAYOUT=nchw
+    # re-measures the old host-transpose path.
+    layout = os.environ.get("BENCH_RECORD_LAYOUT", "nhwc").upper()
     return mx.io.ImageRecordUInt8Iter(
         path_imgrec=path, data_shape=(3, 224, 224), batch_size=batch,
-        rand_crop=True, rand_mirror=True, shuffle=True)
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        output_layout=layout)
 
 
 def _iter_rate(it, max_batches=20):
@@ -239,9 +245,13 @@ def _run(batch):
 
         threading.Thread(target=_feeder, daemon=True).start()
 
+        nhwc_feed = real_iter.provide_data[0].shape[-1] == 3
+
         def step(i):
             data, label = feed_q.get()
             dx = jnp.asarray(data)           # uint8, one transfer
+            if nhwc_feed:                    # device-side NHWC->NCHW
+                dx = jnp.transpose(dx, (0, 3, 1, 2))
             bx = mx.nd.NDArray(dx.astype(jnp.float32))   # cast on device
             by = mx.nd.NDArray(jnp.asarray(label))
             mod.forward(mx.io.DataBatch(data=[bx], label=[by]),

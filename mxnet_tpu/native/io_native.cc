@@ -358,6 +358,55 @@ int crop_flip_u8_batch(const uint8_t** bufs, long n, uint8_t* out, int dh,
   return 0;
 }
 
+// NHWC variant: output is uint8[n, oh, ow, channels].  An unflipped row
+// is ONE contiguous memcpy, so the host cost approaches raw memory
+// bandwidth — the HWC->CHW transpose belongs on the DEVICE, where it
+// fuses into the uint8->bf16 cast for free (the reference pays the
+// same transpose inside its GPU copy kernel).
+int crop_flip_u8_nhwc_batch(const uint8_t** bufs, long n, uint8_t* out,
+                            int dh, int dw, int oh, int ow, int channels,
+                            const int* y0s, const int* x0s,
+                            const uint8_t* flips, int nthreads) {
+  if (channels < 1 || channels > 8) return -1;
+  if (oh > dh || ow > dw || oh < 1 || ow < 1) return -2;
+  size_t row_bytes = (size_t)ow * channels;
+  size_t out_size = (size_t)oh * row_bytes;
+#ifdef _OPENMP
+  if (nthreads > 0) omp_set_num_threads(nthreads);
+#pragma omp parallel for schedule(dynamic)
+#endif
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* img = bufs[i];
+    int y0 = y0s[i], x0 = x0s[i];
+    if (y0 > dh - oh) y0 = dh - oh;
+    if (x0 > dw - ow) x0 = dw - ow;
+    if (y0 < 0) y0 = 0;
+    if (x0 < 0) x0 = 0;
+    const bool flip = flips[i] != 0;
+    uint8_t* dst = out + i * out_size;
+    for (int y = 0; y < oh; ++y) {
+      const uint8_t* src_row =
+          img + ((size_t)(y0 + y) * dw + x0) * channels;
+      uint8_t* out_row = dst + (size_t)y * row_bytes;
+      // always memcpy forward (sequential source read); a mirrored row
+      // is then reversed IN PLACE in the output, which is already L1-hot
+      memcpy(out_row, src_row, row_bytes);
+      if (flip) {
+        uint8_t* a = out_row;
+        uint8_t* b = out_row + (size_t)(ow - 1) * channels;
+        for (; a < b; a += channels, b -= channels) {
+          for (int k = 0; k < channels; ++k) {
+            uint8_t t = a[k];
+            a[k] = b[k];
+            b[k] = t;
+          }
+        }
+      }
+    }
+  }
+  return 0;
+}
+
 // Probe a JPEG's dimensions without a full decode.
 int jpeg_probe(const uint8_t* buf, int64_t len, int* h, int* w, int* c) {
   jpeg_decompress_struct cinfo;

@@ -145,7 +145,10 @@ def test_hard_exit_frees_relay_at_deadline():
         env=_clean_env(RELAY_DEADLINE_EPOCH=str(time.time() + 4)))
     elapsed = time.monotonic() - t0
     assert out.returncode == 4, (out.returncode, out.stderr)
-    assert elapsed < 30, elapsed
+    # bound proves "exits AT the deadline, not minutes later"; generous
+    # because the full gate can run this on a heavily contended core
+    # (observed >30s under a concurrent 8-process dist rehearsal)
+    assert elapsed < 90, elapsed
     line = json.loads(out.stdout.strip().splitlines()[-1])
     assert line["metric"] == "m"
     assert "deadline" in line["error"]

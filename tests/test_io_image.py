@@ -79,10 +79,10 @@ def test_native_index_matches_python(rec_dataset):
     r = recordio.MXIndexedRecordIO(idx_path, rec_path, 'r')
     py_offs = [r.idx[k] for k in r.keys]
     np.testing.assert_array_equal(offs, py_offs)
-    # native read returns identical payloads
+    # native read returns identical payloads (as zero-copy uint8 views)
     recs = native.read_records(rec_path, offs[:5])
     for k, data in zip(r.keys[:5], recs):
-        assert data == r.read_idx(k)
+        assert bytes(data) == r.read_idx(k)
     r.close()
 
 
@@ -328,6 +328,33 @@ def test_image_record_uint8_iter(tmp_path):
         rand_crop=True, rand_mirror=True, shuffle=True)
     d2 = next(iter(it2)).data[0].asnumpy()
     assert d2.dtype == np.uint8 and d2.shape == (3, 3, 32, 32)
+
+    # NHWC fast path: memcpy rows on host, transpose on device — byte-
+    # identical to the NCHW output, provide_data reflects the layout
+    it3 = mx.io.ImageRecordUInt8Iter(
+        path_imgrec=path, data_shape=(3, 32, 32), batch_size=3,
+        output_layout="NHWC")
+    assert tuple(it3.provide_data[0].shape) == (3, 32, 32, 3)
+    assert it3.provide_data[0].layout == "NHWC"
+    assert it3.provide_data[0].dtype == np.uint8
+    d3 = next(iter(it3)).data[0].asnumpy()
+    assert d3.dtype == np.uint8 and d3.shape == (3, 32, 32, 3)
+    np.testing.assert_array_equal(d3.transpose(0, 3, 1, 2), d)
+    with pytest.raises(mx.base.MXNetError, match="NCHW or NHWC"):
+        mx.io.ImageRecordUInt8Iter(path_imgrec=path,
+                                   data_shape=(3, 32, 32),
+                                   batch_size=3, output_layout="CHWN")
+
+    # crop + mirror parity: same seed -> NHWC batch is byte-identical to
+    # the NCHW batch transposed (exercises the nhwc in-place row
+    # reversal and crop offsets, not just the memcpy identity case)
+    kw = dict(path_imgrec=path, data_shape=(3, 24, 24), batch_size=3,
+              rand_crop=True, rand_mirror=True, shuffle=True, seed=7)
+    d_nchw = next(iter(mx.io.ImageRecordUInt8Iter(**kw)))\
+        .data[0].asnumpy()
+    d_nhwc = next(iter(mx.io.ImageRecordUInt8Iter(
+        output_layout="NHWC", **kw))).data[0].asnumpy()
+    np.testing.assert_array_equal(d_nhwc.transpose(0, 3, 1, 2), d_nchw)
 
     # mean/std rejected: normalization belongs on device
     with pytest.raises(mx.base.MXNetError, match="on device"):
