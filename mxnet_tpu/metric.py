@@ -193,23 +193,24 @@ class TopKAccuracy(EvalMetric):
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, 'Predictions should be no more than 2 dims'
-            pred_label = numpy.argsort(_np(pred_label).astype('float32'), axis=1)
-            label = _np(label).astype('int32')
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flatten() == label.flatten()).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flatten() ==
-                        label.flatten()).sum()
-            self.num_inst += num_samples
+        for label, pred in zip(labels, preds):
+            assert len(pred.shape) <= 2, \
+                'Predictions should be no more than 2 dims'
+            pred = _np(pred).astype('float32')
+            label = _np(label).astype('int32').ravel()
+            check_label_shapes(label, pred)
+            if pred.ndim == 1:
+                self.sum_metric += int((pred.astype('int32') == label)
+                                       .sum())
+            else:
+                k = min(pred.shape[1], self.top_k)
+                # top-k SET membership: argpartition selects the k
+                # largest in O(n) (no full sort needed — the k columns
+                # are checked as a set anyway)
+                top = numpy.argpartition(pred, -k, axis=1)[:, -k:]
+                self.sum_metric += int(
+                    (top == label[:, None]).any(axis=1).sum())
+            self.num_inst += pred.shape[0]
 
 
 @register
@@ -224,32 +225,21 @@ class F1(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
             pred = _np(pred)
-            label = _np(label).astype('int32')
+            # ravel BEFORE the vectorized compares: an (n,1) label would
+            # broadcast against the (n,) argmax into an (n,n) matrix
+            label = _np(label).astype('int32').ravel()
             pred_label = numpy.argmax(pred, axis=1)
             check_label_shapes(label, pred)
             if len(numpy.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            true_positives, false_positives, false_negatives = 0., 0., 0.
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.
-            self.sum_metric += f1_score
+                raise ValueError(
+                    "F1 currently only supports binary classification.")
+            # vectorized confusion counts; 2*tp/(2*tp+fp+fn) is the
+            # precision/recall harmonic mean with the 0/0 -> 0 convention
+            tp = float(((pred_label == 1) & (label == 1)).sum())
+            fp = float(((pred_label == 1) & (label == 0)).sum())
+            fn = float(((pred_label == 0) & (label == 1)).sum())
+            denom = 2 * tp + fp + fn
+            self.sum_metric += (2 * tp / denom) if denom > 0 else 0.
             self.num_inst += 1
 
 
@@ -294,67 +284,61 @@ class Perplexity(EvalMetric):
         return (self.name, float(numpy.exp(self.sum_metric / self.num_inst)))
 
 
+class _RegressionMetric(EvalMetric):
+    """Shared per-batch regression scoring: subclasses define the batch
+    score over the residual; the mean-of-batch-scores accumulation (one
+    num_inst per batch) is the reference contract for all three."""
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _np(label)
+            pred = _np(pred)
+            if label.ndim == 1:
+                label = label[:, None]
+            if pred.ndim == 1:
+                pred = pred[:, None]
+            self.sum_metric += self._score(label - pred)
+            self.num_inst += 1
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_RegressionMetric):
     """reference: metric.py MAE."""
 
     def __init__(self, name='mae', output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _np(label)
-            pred = _np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _score(err):
+        return numpy.abs(err).mean()
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_RegressionMetric):
     """reference: metric.py MSE."""
 
     def __init__(self, name='mse', output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _np(label)
-            pred = _np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _score(err):
+        return (err ** 2.0).mean()
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_RegressionMetric):
     """reference: metric.py RMSE."""
 
     def __init__(self, name='rmse', output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _np(label)
-            pred = _np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    @staticmethod
+    def _score(err):
+        return numpy.sqrt((err ** 2.0).mean())
 
 
 @register
@@ -380,28 +364,14 @@ class CrossEntropy(EvalMetric):
 
 
 @register
-class NegativeLogLikelihood(EvalMetric):
-    """reference: metric.py NegativeLogLikelihood."""
+class NegativeLogLikelihood(CrossEntropy):
+    """reference: metric.py NegativeLogLikelihood — same per-example
+    -log p[label] accumulation as CrossEntropy, under its NLL name."""
 
     def __init__(self, eps=1e-12, name='nll-loss',
                  output_names=None, label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
+        super().__init__(eps=eps, name=name, output_names=output_names,
                          label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _np(label)
-            pred = _np(pred)
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, \
-                (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
 
 
 @register

@@ -79,3 +79,13 @@ def test_cross_entropy():
     m.update([label], [pred])
     expected = -(np.log(0.8) + np.log(0.6)) / 2
     assert m.get()[1] == pytest.approx(expected, rel=1e-4)
+
+
+def test_f1_accepts_column_labels():
+    """(n,1) labels must not broadcast against the (n,) argmax into an
+    (n,n) confusion count (regression: vectorized F1)."""
+    m = mx.metric.F1()
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+    m.update([mx.nd.array(np.array([[1], [0], [1]], np.float32))],
+             [mx.nd.array(pred)])
+    assert abs(m.get()[1] - 1.0) < 1e-9

@@ -145,10 +145,12 @@ fi
 run_bench record         BENCH_DATA=record || probe_or_die
 run_bench record_b512    BENCH_DATA=record BENCH_BATCH=512 || probe_or_die
 
-# 4. flash-attention microbench (VERDICT item 5)
+# 4. flash-attention microbench (VERDICT item 5) — tile sweep so the
+# dispatch table ships MEASURED winning block configs, not just defaults
 deadline_check "attention microbench"
 echo "== [$(TS)] attention microbench" >&2
-{ python benchmark/attention_bench.py | tee attention_bench_out.txt; } || probe_or_die
+{ ATTN_BLOCKS=128x128,128x256,256x128 \
+  python benchmark/attention_bench.py | tee attention_bench_out.txt; } || probe_or_die
 
 # 4b. transformer-LM end-to-end train throughput (tokens/sec + MFU)
 deadline_check "transformer LM bench"
