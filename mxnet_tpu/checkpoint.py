@@ -29,6 +29,16 @@ from .ndarray import NDArray
 _MAGIC = b"MXTPUSH1"
 
 
+class _MetaOnly:
+    """Shape/dtype stand-in for a value THIS rank will not serialize
+    (fully-replicated params are written by rank 0 only) — lets the async
+    snapshot skip the device copy for buffers it never reads."""
+
+    def __init__(self, v):
+        self.shape = tuple(np.shape(v))
+        self.dtype = v.dtype
+
+
 def _shard_entries(name, arr):
     """Yield (name, index_spec, numpy_block) for the shards THIS process
     is responsible for: exactly one replica (replica_id 0) of every
@@ -36,6 +46,8 @@ def _shard_entries(name, arr):
     not with replication factor or process count."""
     import jax
     v = arr._data if isinstance(arr, NDArray) else arr
+    if isinstance(v, _MetaOnly):
+        return
     if not isinstance(v, jax.Array) or v.is_fully_replicated:
         if jax.process_index() == 0:
             yield name, [[0, s] for s in np.shape(v)], np.asarray(v)
@@ -51,8 +63,12 @@ def _shard_entries(name, arr):
         yield name, spec, np.asarray(sh.data)
 
 
-def save_params_sharded(prefix: str, params: Dict[str, NDArray]) -> None:
-    """Write this process's shards + (rank 0) the global index."""
+def _write_local_shard(prefix: str, params: Dict[str, NDArray],
+                       token=None) -> Dict:
+    """Write THIS process's shard file atomically (tmp + rename); return
+    the global-params index metadata.  ``token`` (async saves) rides the
+    shard header so a rendezvous can tell THIS save's shard from a stale
+    one left at the same path by an earlier save."""
     import jax
     rank = jax.process_index()
     entries = []
@@ -61,6 +77,9 @@ def save_params_sharded(prefix: str, params: Dict[str, NDArray]) -> None:
     index = {}
     for name, arr in params.items():
         v = arr._data if isinstance(arr, NDArray) else arr
+        if isinstance(v, _MetaOnly):
+            index[name] = {"shape": list(v.shape), "dtype": str(v.dtype)}
+            continue
         index[name] = {"shape": list(np.shape(v)), "dtype": str(v.dtype)}
         for nm, spec, block in _shard_entries(name, arr):
             raw = np.ascontiguousarray(block).tobytes()
@@ -69,9 +88,11 @@ def save_params_sharded(prefix: str, params: Dict[str, NDArray]) -> None:
                             "offset": offset, "nbytes": len(raw)})
             bufs.append(raw)
             offset += len(raw)
-    # atomic writes (tmp + rename), index LAST after all shards land: a
-    # kill mid-save never leaves a readable-looking broken checkpoint
-    hjson = json.dumps(entries).encode()
+    # header stays a bare entry list for tokenless (sync) saves — the
+    # on-disk format golden; async saves wrap it with the token
+    header = entries if token is None else {"token": token,
+                                            "entries": entries}
+    hjson = json.dumps(header).encode()
     shard_path = f"{prefix}.shard{rank}"
     with open(shard_path + ".tmp", "wb") as f:
         f.write(_MAGIC)
@@ -80,13 +101,44 @@ def save_params_sharded(prefix: str, params: Dict[str, NDArray]) -> None:
         for raw in bufs:
             f.write(raw)
     os.replace(shard_path + ".tmp", shard_path)
+    return index
+
+
+def _read_shard_header(path):
+    """(header_entries, token, data_offset) from a shard file."""
+    with open(path, "rb") as f:
+        if f.read(8) != _MAGIC:
+            raise MXNetError(f"{path}: bad shard magic")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode())
+    if isinstance(header, dict):
+        return header["entries"], header.get("token"), 16 + hlen
+    return header, None, 16 + hlen
+
+
+def _write_index(prefix: str, index: Dict, token=None) -> None:
+    import jax
+    doc = {"nprocs": jax.process_count(), "params": index}
+    if token is not None:
+        doc["token"] = token
+    with open(f"{prefix}.index.tmp", "w") as f:
+        json.dump(doc, f)
+    os.replace(f"{prefix}.index.tmp", f"{prefix}.index")
+
+
+def save_params_sharded(prefix: str, params: Dict[str, NDArray]) -> None:
+    """Write this process's shards + (rank 0) the global index.
+
+    Atomic by construction: every file is tmp+rename, and the index is
+    written LAST after a barrier confirms all shards landed — a kill
+    mid-save never leaves a readable-looking broken checkpoint."""
+    import jax
+    index = _write_local_shard(prefix, params)
     if jax.process_count() > 1:
         from . import distributed as _dist
         _dist.barrier("mxnet_tpu_checkpoint_save")
-    if rank == 0:
-        with open(f"{prefix}.index.tmp", "w") as f:
-            json.dump({"nprocs": jax.process_count(), "params": index}, f)
-        os.replace(f"{prefix}.index.tmp", f"{prefix}.index")
+    if jax.process_index() == 0:
+        _write_index(prefix, index)
     if jax.process_count() > 1:
         # read-after-save: no rank returns before the index is visible
         from . import distributed as _dist
@@ -109,11 +161,9 @@ def load_params_sharded(prefix: str) -> Dict[str, NDArray]:
         path = f"{prefix}.shard{r}"
         if not os.path.exists(path):
             raise MXNetError(f"missing checkpoint shard file {path}")
+        header, _tok, data_off = _read_shard_header(path)
         with open(path, "rb") as f:
-            if f.read(8) != _MAGIC:
-                raise MXNetError(f"{path}: bad shard magic")
-            (hlen,) = struct.unpack("<Q", f.read(8))
-            header = json.loads(f.read(hlen).decode())
+            f.seek(data_off)
             blob = f.read()
         for ent in header:
             shape = [b - a for a, b in ent["index"]]
@@ -123,6 +173,157 @@ def load_params_sharded(prefix: str) -> Dict[str, NDArray]:
             sl = tuple(slice(a, b) for a, b in ent["index"])
             out_np[ent["name"]][sl] = block
     return {name: NDArray(a) for name, a in out_np.items()}
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint writes with training (the orbax async-save
+    pattern; no reference analog — its PS snapshots were synchronous).
+
+    ``save_params`` snapshots every value with a DEVICE-side copy (HBM to
+    HBM, microseconds) and returns immediately; a background thread then
+    fetches the snapshot to host and writes the shard file.  The copy
+    makes the snapshot immune to the fused train step's buffer DONATION —
+    step N+1 may overwrite the live param buffers while the write is
+    still in flight.
+
+    Multi-process protocol: the background threads must NOT use device
+    collectives (a barrier issued from a side thread would interleave
+    with training collectives in different orders per process and
+    deadlock the mesh).  Rendezvous is on the shared filesystem instead:
+    rank 0's writer polls for every ``<prefix>.shard{r}`` file, then
+    writes the index — the same shards-before-index atomicity as the
+    synchronous path.
+
+    One save in flight at a time: a new ``save_params`` (or ``wait``)
+    joins the previous write first and re-raises any background failure.
+    ``wait()`` returns only after the INDEX is on disk (every rank polls
+    for it), so ``wait()`` → ``load_params_sharded`` is safe on any rank.
+
+    Per-save identity: shards and index carry a token (a per-prefix
+    sequence number), so rank 0 never indexes a stale shard file left at
+    the same path by an earlier save — all ranks must make the same
+    sequence of collective save calls (the SPMD contract).  Reusing a
+    prefix ACROSS runs additionally checks shard mtime against this
+    checkpointer's creation time.
+
+    Re-saving to the SAME prefix overwrites in place (like the sync
+    path): the previous checkpoint stops being readable the moment any
+    rank begins the next save, so multi-process readers must finish (and
+    a barrier must confirm it) before the next save to that prefix — or
+    use per-epoch prefixes (``save_checkpoint``), which never collide.
+    """
+
+    def __init__(self, poll_interval_s: float = 0.1,
+                 timeout_s: float = 600.0):
+        import time as _time
+        self._poll = poll_interval_s
+        self._timeout = timeout_s
+        self._thread = None
+        self._err = None
+        self._born = _time.time()
+        self._seq = {}  # prefix -> saves issued
+
+    @staticmethod
+    def _snapshot(params):
+        import jax
+        import jax.numpy as jnp
+        rank0 = jax.process_index() == 0
+        snap = {}
+        for name, arr in params.items():
+            v = arr._data if isinstance(arr, NDArray) else arr
+            if isinstance(v, jax.Array):
+                if not rank0 and v.is_fully_replicated:
+                    # rank 0 alone writes replicated values — other
+                    # ranks keep only shape/dtype (no transient HBM
+                    # duplicate of buffers they never serialize)
+                    snap[name] = _MetaOnly(v)
+                else:
+                    # device-side copy: a NEW buffer with the same
+                    # sharding, outside any donation set
+                    snap[name] = jnp.copy(v)
+            else:
+                snap[name] = np.array(v, copy=True)
+        return snap
+
+    def _fresh(self, path, token):
+        """True when ``path`` is THIS save's output: right token, written
+        after this checkpointer was born (guards cross-run reuse)."""
+        try:
+            if os.path.getmtime(path) < self._born - 1.0:
+                return False
+            _ents, tok, _off = _read_shard_header(path)
+            return tok == token
+        except (OSError, MXNetError, ValueError, KeyError):
+            return False  # mid-rename / partial — keep polling
+
+    def save_params(self, prefix: str, params: Dict[str, NDArray]) -> None:
+        """Collective: every process must call with the same prefix."""
+        import threading
+        self.wait()
+        self._seq[prefix] = self._seq.get(prefix, -1) + 1
+        token = self._seq[prefix]
+        snap = self._snapshot(params)
+
+        def _write():
+            try:
+                import jax
+                import time as _time
+                index = _write_local_shard(prefix, snap, token=token)
+                deadline = _time.monotonic() + self._timeout
+                if jax.process_index() == 0:
+                    missing = set(range(jax.process_count()))
+                    while missing:
+                        missing = {r for r in missing if not self._fresh(
+                            f"{prefix}.shard{r}", token)}
+                        if not missing:
+                            break
+                        if _time.monotonic() > deadline:
+                            raise MXNetError(
+                                f"async checkpoint {prefix}: shards "
+                                f"{sorted(missing)} not current after "
+                                f"{self._timeout:.0f}s")
+                        _time.sleep(self._poll)
+                    _write_index(prefix, index, token=token)
+                else:
+                    # completion for non-zero ranks = THIS save's index
+                    # is visible (wait() must imply loadability)
+                    while True:
+                        try:
+                            with open(f"{prefix}.index") as f:
+                                if json.load(f).get("token") == token:
+                                    break
+                        except (OSError, ValueError):
+                            pass
+                        if _time.monotonic() > deadline:
+                            raise MXNetError(
+                                f"async checkpoint {prefix}: index not "
+                                f"current after {self._timeout:.0f}s")
+                        _time.sleep(self._poll)
+            except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+                self._err = e
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def save_checkpoint(self, prefix: str, epoch: int, symbol, arg_params,
+                        aux_params) -> None:
+        """Async analog of save_checkpoint_sharded."""
+        import jax
+        if symbol is not None and jax.process_index() == 0:
+            symbol.save(f"{prefix}-symbol.json")
+        merged = dict(arg_params)
+        merged.update({f"aux:{k}": v
+                       for k, v in (aux_params or {}).items()})
+        self.save_params(f"{prefix}-{epoch:04d}.params", merged)
+
+    def wait(self) -> None:
+        """Join the in-flight save; re-raise any background failure."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        err, self._err = self._err, None
+        if err is not None:
+            raise err
 
 
 def save_checkpoint_sharded(prefix: str, epoch: int, symbol, arg_params,

@@ -44,6 +44,29 @@ def main():
     np.testing.assert_array_equal(loaded["w"].asnumpy(), full)
     np.testing.assert_array_equal(loaded["r"].asnumpy(),
                                   np.full((3,), 2.5, "f"))
+
+    # async save: background threads rendezvous on the FILESYSTEM (no
+    # device collectives off the main thread), while the main threads
+    # keep issuing device work.  wait() alone must make the checkpoint
+    # loadable on EVERY rank (each rank's writer polls for the tokened
+    # index) — no barrier before the load.
+    ck = checkpoint.AsyncCheckpointer()
+    ck.save_params(prefix + ".async", params)
+    _ = nd.NDArray(garr * 2).asnumpy()  # device busy during the write
+    ck.wait()
+    aloaded = checkpoint.load_params_sharded(prefix + ".async")
+    np.testing.assert_array_equal(aloaded["w"].asnumpy(), full)
+    # overwriting a prefix DESTROYS the previous checkpoint for anyone
+    # still reading it (in-place overwrite, same as the sync path): all
+    # readers must be done before the next save to that prefix starts
+    dist.barrier()
+    # SAME prefix again with new values: the save-token keeps rank 0
+    # from indexing the previous save's stale shard files
+    params2 = {"w": nd.NDArray(garr * 3)}
+    ck.save_params(prefix + ".async", params2)
+    ck.wait()
+    aloaded2 = checkpoint.load_params_sharded(prefix + ".async")
+    np.testing.assert_array_equal(aloaded2["w"].asnumpy(), full * 3)
     dist.barrier()
     print("dist_sharded_checkpoint rank %d/%d OK" % (rank, n), flush=True)
 

@@ -288,3 +288,76 @@ def test_full_reference_checkpoint_migration(tmp_path):
     np.testing.assert_allclose(mod2.get_outputs()[0].asnumpy(),
                                mod.get_outputs()[0].asnumpy(),
                                rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing (device-side snapshot + background write)
+# ---------------------------------------------------------------------------
+def test_async_checkpoint_snapshot_survives_donation(tmp_path):
+    """The async save snapshots params BEFORE returning; later fused
+    update steps (which DONATE the live param buffers) must not corrupt
+    the bytes being written in the background."""
+    X, Y = _data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=30)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9})
+    b = next(iter(it))
+    mod.forward(b, is_train=True)
+    mod.update()
+    at_save = {k: v.asnumpy().copy()
+               for k, v in mod.get_params()[0].items()}
+
+    ck = checkpoint.AsyncCheckpointer()
+    args, aux = mod._exec.arg_dict, mod._exec.aux_dict
+    upd = {n: args[n] for n in mod._update_names()}
+    ck.save_params(str(tmp_path / "async.params"), upd)
+    for _ in range(5):  # donated steps overwrite the live buffers
+        mod.forward(b, is_train=True)
+        mod.update()
+    ck.wait()
+    loaded = checkpoint.load_params_sharded(str(tmp_path / "async.params"))
+    for k, v in loaded.items():
+        np.testing.assert_array_equal(v.asnumpy(), at_save[k], err_msg=k)
+    # and the training really moved on past the snapshot
+    moved = mod.get_params()[0]
+    assert any(not np.array_equal(moved[k].asnumpy(), at_save[k])
+               for k in at_save)
+
+
+def test_async_checkpoint_serializes_saves_and_reports_errors(tmp_path):
+    ck = checkpoint.AsyncCheckpointer()
+    p1 = {"w": nd.array(np.arange(6, dtype='f').reshape(2, 3))}
+    ck.save_params(str(tmp_path / "a.params"), p1)
+    p2 = {"w": nd.array(np.ones((2, 3), 'f'))}
+    ck.save_params(str(tmp_path / "b.params"), p2)  # waits for a first
+    ck.wait()
+    a = checkpoint.load_params_sharded(str(tmp_path / "a.params"))
+    b = checkpoint.load_params_sharded(str(tmp_path / "b.params"))
+    np.testing.assert_array_equal(a["w"].asnumpy(),
+                                  p1["w"].asnumpy())
+    np.testing.assert_array_equal(b["w"].asnumpy(), 1.0)
+    # a background failure surfaces at wait()
+    ck.save_params(str(tmp_path / "nodir" / "sub" / "x.params"),
+                   p1)
+    with pytest.raises(Exception):
+        ck.wait()
+    # the checkpointer stays usable after the failure
+    ck.save_params(str(tmp_path / "c.params"), p2)
+    ck.wait()
+
+
+def test_async_checkpoint_epoch_api(tmp_path):
+    ck = checkpoint.AsyncCheckpointer()
+    net = _mlp()
+    args = {"fc1_weight": nd.array(np.ones((8, 4), 'f'))}
+    aux = {"bn_mean": nd.array(np.zeros((8,), 'f'))}
+    ck.save_checkpoint(str(tmp_path / "ck"), 3, net, args, aux)
+    ck.wait()
+    s, a, x = checkpoint.load_checkpoint_sharded(str(tmp_path / "ck"), 3)
+    assert s is not None
+    np.testing.assert_array_equal(a["fc1_weight"].asnumpy(), 1.0)
+    np.testing.assert_array_equal(x["bn_mean"].asnumpy(), 0.0)
