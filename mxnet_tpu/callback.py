@@ -16,10 +16,26 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     return _callback
 
 
-def do_checkpoint(prefix, period=1):
-    """reference: callback.py:55 — save symbol+params every `period` epochs."""
+def do_checkpoint(prefix, period=1, sharded_async=False):
+    """reference: callback.py:55 — save symbol+params every `period` epochs.
+
+    ``sharded_async=True`` saves through checkpoint.AsyncCheckpointer
+    (sharded format, per-epoch prefixes): the epoch boundary only pays a
+    device-side snapshot and training continues while the shards write in
+    the background.  The returned callback carries the checkpointer as
+    ``_callback.checkpointer`` — call ``.wait()`` after fit() before
+    reading the final checkpoint."""
     from .model import save_checkpoint
     period = int(max(1, period))
+    if sharded_async:
+        from .checkpoint import AsyncCheckpointer
+        ck = AsyncCheckpointer()
+
+        def _callback(iter_no, sym, arg, aux):
+            if (iter_no + 1) % period == 0:
+                ck.save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        _callback.checkpointer = ck
+        return _callback
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:

@@ -199,12 +199,14 @@ class AsyncCheckpointer:
     ``wait()`` returns only after the INDEX is on disk (every rank polls
     for it), so ``wait()`` → ``load_params_sharded`` is safe on any rank.
 
-    Per-save identity: shards and index carry a token (a per-prefix
-    sequence number), so rank 0 never indexes a stale shard file left at
-    the same path by an earlier save — all ranks must make the same
-    sequence of collective save calls (the SPMD contract).  Reusing a
-    prefix ACROSS runs additionally checks shard mtime against this
-    checkpointer's creation time.
+    Per-save identity: shards and index carry a token
+    ``<run_nonce>:<seq>`` — the nonce is a rank-0 uuid agreed once per
+    run via a broadcast from the MAIN thread (save calls are collective,
+    so this is collective-safe), and seq is a per-prefix counter.  Rank 0
+    never indexes a shard from a different save (not an earlier save this
+    run, not a previous run's leftover at the same path), and every
+    rank's index poll requires the same full token — no wall-clock
+    comparisons across hosts.
 
     Re-saving to the SAME prefix overwrites in place (like the sync
     path): the previous checkpoint stops being readable the moment any
@@ -215,13 +217,25 @@ class AsyncCheckpointer:
 
     def __init__(self, poll_interval_s: float = 0.1,
                  timeout_s: float = 600.0):
-        import time as _time
         self._poll = poll_interval_s
         self._timeout = timeout_s
         self._thread = None
         self._err = None
-        self._born = _time.time()
+        self._nonce = None  # run-unique, rank-agreed; set on first save
         self._seq = {}  # prefix -> saves issued
+
+    def _run_nonce(self):
+        """Rank-agreed uuid for this run (main-thread collective)."""
+        if self._nonce is None:
+            import uuid
+            import jax
+            nonce = np.frombuffer(uuid.uuid4().bytes, np.uint8)
+            if jax.process_count() > 1:
+                from . import distributed as _dist
+                nonce = np.asarray(_dist.broadcast_from_root(nonce),
+                                   np.uint8)
+            self._nonce = bytes(nonce).hex()
+        return self._nonce
 
     @staticmethod
     def _snapshot(params):
@@ -245,12 +259,10 @@ class AsyncCheckpointer:
                 snap[name] = np.array(v, copy=True)
         return snap
 
-    def _fresh(self, path, token):
-        """True when ``path`` is THIS save's output: right token, written
-        after this checkpointer was born (guards cross-run reuse)."""
+    @staticmethod
+    def _fresh(path, token):
+        """True when ``path`` is THIS save's shard (full-token match)."""
         try:
-            if os.path.getmtime(path) < self._born - 1.0:
-                return False
             _ents, tok, _off = _read_shard_header(path)
             return tok == token
         except (OSError, MXNetError, ValueError, KeyError):
@@ -261,7 +273,7 @@ class AsyncCheckpointer:
         import threading
         self.wait()
         self._seq[prefix] = self._seq.get(prefix, -1) + 1
-        token = self._seq[prefix]
+        token = f"{self._run_nonce()}:{self._seq[prefix]}"
         snap = self._snapshot(params)
 
         def _write():
@@ -308,13 +320,8 @@ class AsyncCheckpointer:
     def save_checkpoint(self, prefix: str, epoch: int, symbol, arg_params,
                         aux_params) -> None:
         """Async analog of save_checkpoint_sharded."""
-        import jax
-        if symbol is not None and jax.process_index() == 0:
-            symbol.save(f"{prefix}-symbol.json")
-        merged = dict(arg_params)
-        merged.update({f"aux:{k}": v
-                       for k, v in (aux_params or {}).items()})
-        self.save_params(f"{prefix}-{epoch:04d}.params", merged)
+        path = _checkpoint_prelude(prefix, epoch, symbol)
+        self.save_params(path, _merge_arg_aux(arg_params, aux_params))
 
     def wait(self) -> None:
         """Join the in-flight save; re-raise any background failure."""
@@ -326,14 +333,27 @@ class AsyncCheckpointer:
             raise err
 
 
+def _merge_arg_aux(arg_params, aux_params):
+    """One params dict with the load_checkpoint_sharded aux: contract."""
+    merged = dict(arg_params)
+    merged.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    return merged
+
+
+def _checkpoint_prelude(prefix, epoch, symbol):
+    """Symbol file (rank 0 — shared storage needs one writer) + the
+    epoch-numbered params path, shared by the sync and async savers."""
+    import jax
+    if symbol is not None and jax.process_index() == 0:
+        symbol.save(f"{prefix}-symbol.json")
+    return f"{prefix}-{epoch:04d}.params"
+
+
 def save_checkpoint_sharded(prefix: str, epoch: int, symbol, arg_params,
                             aux_params) -> None:
     """Sharded analog of mx.model.save_checkpoint (model.py:94)."""
-    if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
-    merged = dict(arg_params)
-    merged.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
-    save_params_sharded(f"{prefix}-{epoch:04d}.params", merged)
+    path = _checkpoint_prelude(prefix, epoch, symbol)
+    save_params_sharded(path, _merge_arg_aux(arg_params, aux_params))
 
 
 def load_checkpoint_sharded(prefix: str, epoch: int):

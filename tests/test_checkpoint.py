@@ -361,3 +361,26 @@ def test_async_checkpoint_epoch_api(tmp_path):
     assert s is not None
     np.testing.assert_array_equal(a["fc1_weight"].asnumpy(), 1.0)
     np.testing.assert_array_equal(x["bn_mean"].asnumpy(), 0.0)
+
+
+def test_do_checkpoint_sharded_async_through_fit(tmp_path):
+    """The fit() epoch callback path with sharded_async: epochs only pay
+    the snapshot; shards land in the background; the final epoch loads
+    back bit-exact after wait()."""
+    X, Y = _data()
+    prefix = str(tmp_path / "ack")
+    it = mx.io.NDArrayIter(X, Y, batch_size=30)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    cb = mx.callback.do_checkpoint(prefix, sharded_async=True)
+    mod.fit(it, num_epoch=3, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1},
+            initializer=mx.initializer.Xavier(),
+            epoch_end_callback=cb)
+    cb.checkpointer.wait()
+    final = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    s, arg, aux = checkpoint.load_checkpoint_sharded(prefix, 3)
+    assert s is not None
+    assert set(arg) == set(final)
+    for k in final:
+        np.testing.assert_array_equal(arg[k].asnumpy(), final[k],
+                                      err_msg=k)

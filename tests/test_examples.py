@@ -246,6 +246,18 @@ def test_rnn_bench_smoke():
     assert row["device"] == "cpu"  # smoke must never claim chip evidence
 
 
+def test_decode_bench_smoke():
+    """The KV-cache decode bench runs end-to-end in CPU smoke mode."""
+    row = _run_bench_smoke("decode_bench.py", {
+        "DEC_CPU": "1", "DEC_LAYERS": "2", "DEC_DMODEL": "64",
+        "DEC_HEADS": "2", "DEC_MAXLEN": "32", "DEC_VOCAB": "128",
+        "DEC_STEPS": "4", "DEC_BATCHES": "1,4"})
+    assert row["metric"] == "decode_tokens_per_sec"
+    assert row["value"] is not None and row["value"] > 0
+    assert row["device"] == "cpu"
+    assert [r["batch"] for r in row["per_batch"]] == [1, 4]
+
+
 def test_bi_lstm_sort_example():
     """Bidirectional LSTM seq->seq sort (reference example/bi-lstm-sort):
     every output position needs BOTH directions' context."""

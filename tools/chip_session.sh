@@ -168,6 +168,11 @@ deadline_check "rnn LSTM bench"
 echo "== [$(TS)] rnn LSTM bench" >&2
 python benchmark/rnn_bench.py || probe_or_die
 
+# 4e. KV-cache decode throughput (tokens/sec, batch 1 + 32)
+deadline_check "decode bench"
+echo "== [$(TS)] decode bench" >&2
+python benchmark/decode_bench.py || probe_or_die
+
 # 5. real-data convergence artifact (VERDICT item 4)
 deadline_check "digits convergence"
 echo "== [$(TS)] digits convergence" >&2
