@@ -128,13 +128,20 @@ def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
                    num_heads=4, num_kv_heads=None, d_ff=None,
                    moe_experts=0, moe_k=1, max_len=None,
                    pos_type="learned", rope_base=10000.0,
-                   ffn_type="gelu"):
+                   ffn_type="gelu", loss_type="softmax", ce_chunks=8):
     """Causal LM train symbol: data (B, S) token ids,
     softmax_label (B, S) next-token ids.
 
     ``max_len`` (default seq_len) sizes the positional embedding; pass
     the largest bucket when building per-bucket symbols for
-    BucketingModule so all buckets share ONE pos_embed parameter."""
+    BucketingModule so all buckets share ONE pos_embed parameter.
+
+    ``loss_type="chunked_ce"`` replaces the SoftmaxOutput head with the
+    chunked LM loss (``ce_chunks`` vocab chunks): peak memory for the
+    head drops from O(B*S*V) to O(B*S*V/ce_chunks), the output becomes
+    the scalar mean CE loss (track it with the ``Loss`` metric;
+    perplexity = exp(loss)), and lm_head parameter names are unchanged
+    so checkpoints swap between the two heads."""
     d_ff = d_ff or 4 * d_model
     max_len = max_len or seq_len
     if max_len < seq_len:
@@ -143,6 +150,11 @@ def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
             f"({seq_len}) — pass the largest bucket as max_len")
     if pos_type not in ("learned", "rope"):
         raise ValueError(f"pos_type must be learned|rope, got {pos_type!r}")
+    if loss_type not in ("softmax", "chunked_ce"):
+        raise ValueError(
+            f"loss_type must be softmax|chunked_ce, got {loss_type!r}")
+    if loss_type == "chunked_ce" and int(ce_chunks) < 1:
+        raise ValueError(f"ce_chunks must be >= 1, got {ce_chunks}")
     data = sym.Variable("data")
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
                       name="tok_embed")
@@ -177,9 +189,22 @@ def transformer_lm(vocab_size, seq_len, num_layers=2, d_model=128,
                        ffn_type=ffn_type)
         x = x + f
     x = sym.LayerNorm(x, name="final_ln")
-    logits = sym.FullyConnected(sym.Reshape(x, shape=(-1, d_model)),
-                                num_hidden=vocab_size, name="lm_head")
+    hidden = sym.Reshape(x, shape=(-1, d_model))
     label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
+    if loss_type == "chunked_ce":
+        # memory-lean head for big vocab / long context: the (N, V)
+        # logits never materialize (ops/chunked_loss.py).  Param names
+        # match FullyConnected's, so checkpoints swap between heads.
+        # standard initializers key on the names: *_weight random,
+        # *_bias zero — same as FullyConnected's implicit params
+        w = sym.Variable("lm_head_weight", shape=(vocab_size, d_model))
+        b = sym.Variable("lm_head_bias", shape=(vocab_size,))
+        tok_loss = sym.chunked_lm_loss(hidden, w, b, label,
+                                       num_chunks=ce_chunks)
+        # output IS the mean loss (use the Loss metric; exp(loss) = ppl)
+        return sym.make_loss(sym.mean(tok_loss))
+    logits = sym.FullyConnected(hidden, num_hidden=vocab_size,
+                                name="lm_head")
     return sym.SoftmaxOutput(data=logits, label=label, name="softmax")
 
 

@@ -25,3 +25,4 @@ from . import linalg        # noqa: F401
 from . import moe           # noqa: F401
 from . import spatial       # noqa: F401
 from . import contrib_ops   # noqa: F401
+from . import chunked_loss  # noqa: F401

@@ -1,0 +1,126 @@
+"""Chunked LM-head cross-entropy: the fused lm_head matmul + softmax CE
+without ever materializing the full (N, V) logits.
+
+Long-context LM training's memory wall is often the loss head: at
+B*S=512k tokens and V=50k vocab, fp32 logits are ~100 GB.  This op scans
+the vocabulary in chunks — forward keeps an online logsumexp (the same
+trick flash attention uses along sequence), backward REMATERIALIZES each
+chunk's logits (flash-style) — so peak memory is O(N * V/chunks).
+
+No reference analog (SoftmaxOutput materializes probabilities,
+src/operator/softmax_output.cc); this is the TPU-native capability the
+transformer track needs at real vocab sizes.  Numerics are pinned
+against the naive path in tests/test_chunked_loss.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _pad_chunks(w, b, num_chunks):
+    """(V, D)->(C, Vc, D) and (V,)->(C, Vc), padding V up to C*Vc with
+    -inf bias rows (exp(-inf)=0: padded classes never contribute)."""
+    v, d = w.shape
+    vc = -(-v // num_chunks)
+    pad = num_chunks * vc - v
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad, d), w.dtype)], axis=0)
+        b = jnp.concatenate(
+            [b, jnp.full((pad,), -jnp.inf, b.dtype)], axis=0)
+    return w.reshape(num_chunks, vc, d), b.reshape(num_chunks, vc), vc
+
+
+def _chunk_logits(h, wc, bc):
+    """(N, Vc) fp32 logits for one vocab chunk (MXU matmul in the input
+    dtype, fp32 accumulation)."""
+    return jnp.matmul(h, wc.T,
+                      preferred_element_type=jnp.float32) \
+        + bc.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _chunked_lm_loss(h, w, b, label, num_chunks):
+    loss, _lse = _fwd_scan(h, w, b, label, num_chunks)
+    return loss
+
+
+def _fwd_scan(h, w, b, label, num_chunks):
+    n = h.shape[0]
+    wcs, bcs, vc = _pad_chunks(w, b, num_chunks)
+    lab = label.astype(jnp.int32)
+
+    def step(carry, xs):
+        m, se, ll = carry
+        ci, wc, bc = xs
+        logits = _chunk_logits(h, wc, bc)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        se = se * jnp.exp(m - m_new) \
+            + jnp.exp(logits - m_new[:, None]).sum(axis=-1)
+        idx = lab - ci * vc
+        hit = (idx >= 0) & (idx < vc)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, vc - 1)[:, None], axis=-1)[:, 0]
+        ll = ll + jnp.where(hit, picked, 0.0)
+        return (m_new, se, ll), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, se, ll), _ = lax.scan(
+        step, init, (jnp.arange(num_chunks), wcs, bcs))
+    lse = m + jnp.log(se)
+    return (lse - ll).astype(jnp.float32), lse
+
+
+def _vjp_fwd(h, w, b, label, num_chunks):
+    loss, lse = _fwd_scan(h, w, b, label, num_chunks)
+    return loss, (h, w, b, label, lse)
+
+
+def _vjp_bwd(num_chunks, res, g):
+    h, w, b, label, lse = res
+    v = w.shape[0]
+    wcs, bcs, vc = _pad_chunks(w, b, num_chunks)
+    lab = label.astype(jnp.int32)
+    gf = g.astype(jnp.float32)
+
+    def step(dh, xs):
+        ci, wc, bc = xs
+        # remat this chunk's logits; d loss/d logit = softmax - onehot
+        p = jnp.exp(_chunk_logits(h, wc, bc) - lse[:, None])
+        idx = lab - ci * vc
+        hit = (idx >= 0) & (idx < vc)
+        onehot = (jnp.clip(idx, 0, vc - 1)[:, None]
+                  == jnp.arange(vc)[None, :]) & hit[:, None]
+        dlogits = (p - onehot.astype(p.dtype)) * gf[:, None]
+        dh = dh + jnp.matmul(dlogits, wc.astype(jnp.float32))
+        dwc = jnp.matmul(dlogits.T, h.astype(jnp.float32))
+        dbc = dlogits.sum(axis=0)
+        return dh, (dwc, dbc)
+
+    dh0 = jnp.zeros(h.shape, jnp.float32)
+    dh, (dws, dbs) = lax.scan(
+        step, dh0, (jnp.arange(num_chunks), wcs, bcs))
+    dw = dws.reshape(-1, w.shape[1])[:v]
+    db = dbs.reshape(-1)[:v]
+    return (dh.astype(h.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            jnp.zeros_like(label))
+
+
+_chunked_lm_loss.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@register("_contrib_ChunkedLMLoss",
+          arg_names=["data", "weight", "bias", "label"],
+          attr_defaults={"num_chunks": 8},
+          aliases=("chunked_lm_loss",))
+def _chunked_lm_loss_op(data, weight, bias, label, num_chunks=8, **kw):
+    """Per-token CE loss (N,) for hidden (N, D) against lm-head weight
+    (V, D) / bias (V,) — the full logits never exist."""
+    return _chunked_lm_loss(data, weight, bias, label, int(num_chunks))

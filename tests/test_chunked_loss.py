@@ -1,0 +1,161 @@
+"""Chunked LM-head CE (ops/chunked_loss.py): numerics and grads must
+match the naive logits-materializing path exactly — the chunking is a
+memory layout, never a math change (flash-attention-style contract)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.chunked_loss import _chunked_lm_loss
+
+
+def _naive(h, w, b, label):
+    logits = h.astype(jnp.float32) @ w.astype(jnp.float32).T \
+        + b.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+
+
+@pytest.mark.parametrize("v,chunks", [(64, 8), (61, 8), (50, 1), (7, 16)])
+def test_chunked_matches_naive_fwd_bwd(v, chunks):
+    rs = np.random.RandomState(0)
+    n, d = 12, 16
+    h = jnp.asarray(rs.randn(n, d).astype("f"))
+    w = jnp.asarray(rs.randn(v, d).astype("f"))
+    b = jnp.asarray(rs.randn(v).astype("f"))
+    lab = jnp.asarray(rs.randint(0, v, (n,)).astype("f"))
+
+    loss = _chunked_lm_loss(h, w, b, lab, chunks)
+    ref = _naive(h, w, b, lab)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def f_chunk(h, w, b):
+        return jnp.sum(_chunked_lm_loss(h, w, b, lab, chunks) ** 2)
+
+    def f_naive(h, w, b):
+        return jnp.sum(_naive(h, w, b, lab) ** 2)
+
+    gc = jax.grad(f_chunk, argnums=(0, 1, 2))(h, w, b)
+    gn = jax.grad(f_naive, argnums=(0, 1, 2))(h, w, b)
+    for a, r in zip(gc, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_chunked_bf16_inputs_fp32_math():
+    rs = np.random.RandomState(1)
+    n, d, v = 8, 16, 32
+    h = jnp.asarray(rs.randn(n, d), jnp.bfloat16)
+    w = jnp.asarray(rs.randn(v, d), jnp.bfloat16)
+    b = jnp.asarray(rs.randn(v), jnp.bfloat16)
+    lab = jnp.asarray(rs.randint(0, v, (n,)).astype("f"))
+    loss = _chunked_lm_loss(h, w, b, lab, 4)
+    assert loss.dtype == jnp.float32
+    ref = _naive(h, w, b, lab)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
+    # grads come back in the PARAM dtype (master-precision contract)
+    g = jax.grad(lambda *a: jnp.sum(_chunked_lm_loss(*a, lab, 4)),
+                 argnums=(0, 1, 2))(h, w, b)
+    assert all(x.dtype == jnp.bfloat16 for x in g)
+
+
+def test_registry_op_and_symbolic():
+    rs = np.random.RandomState(2)
+    n, d, v = 6, 8, 20
+    h = rs.randn(n, d).astype("f")
+    w = rs.randn(v, d).astype("f")
+    b = rs.randn(v).astype("f")
+    lab = rs.randint(0, v, (n,)).astype("f")
+    # eager registry entry
+    out = mx.nd.chunked_lm_loss(mx.nd.array(h), mx.nd.array(w),
+                                mx.nd.array(b), mx.nd.array(lab),
+                                num_chunks=4)
+    ref = np.asarray(_naive(jnp.asarray(h), jnp.asarray(w),
+                            jnp.asarray(b), jnp.asarray(lab)))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+    # symbolic: trains through the executor (mean loss via make_loss)
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    wv = mx.sym.Variable("lm_head_weight")
+    bv = mx.sym.Variable("lm_head_bias")
+    loss = mx.sym.make_loss(mx.sym.mean(mx.sym.chunked_lm_loss(
+        data, wv, bv, label, num_chunks=4)))
+    ex = mx.Executor.simple_bind(
+        loss, shapes={"data": (n, d), "softmax_label": (n,),
+                      "lm_head_weight": (v, d), "lm_head_bias": (v,)},
+        grad_req="write")
+    ex.arg_dict["data"][:] = mx.nd.array(h)
+    ex.arg_dict["softmax_label"][:] = mx.nd.array(lab)
+    ex.arg_dict["lm_head_weight"][:] = mx.nd.array(w)
+    ex.arg_dict["lm_head_bias"][:] = mx.nd.array(b)
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.mean(),
+                               rtol=1e-5, atol=1e-5)
+    ex.backward()
+    gw = ex.grad_dict["lm_head_weight"].asnumpy()
+    gw_ref = np.asarray(jax.grad(
+        lambda w_: jnp.mean(_naive(jnp.asarray(h), w_, jnp.asarray(b),
+                                   jnp.asarray(lab))))(jnp.asarray(w)))
+    np.testing.assert_allclose(gw, gw_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_lm_chunked_head_trains_and_swaps_checkpoints():
+    """The chunked head trains end-to-end through Module, its loss falls,
+    and its params load into the SOFTMAX-head symbol (names unchanged)."""
+    from mxnet_tpu import models
+    rs = np.random.RandomState(3)
+    V, S, B = 32, 8, 16
+    first = rs.randint(0, V, (64, 1))
+    seq = (first + np.arange(S + 1)) % V
+    X, Y = seq[:, :S].astype("f"), seq[:, 1:].astype("f")
+    net = models.transformer_lm(V, S, num_layers=1, d_model=32,
+                                num_heads=2, loss_type="chunked_ce",
+                                ce_chunks=4)
+    it = mx.io.NDArrayIter(X, Y, batch_size=B)
+    mod = mx.mod.Module(net, context=mx.cpu(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 5e-3})
+
+    def mean_loss():
+        tot, n = 0.0, 0
+        it.reset()
+        for b in it:
+            mod.forward(b, is_train=False)
+            tot += float(mod.get_outputs()[0].asnumpy())
+            n += 1
+        return tot / n
+
+    before = mean_loss()
+    for _ in range(6):
+        it.reset()
+        for b in it:
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+    after = mean_loss()
+    assert after < 0.7 * before, (before, after)
+
+    # params slide into the softmax-head twin (exact same names)
+    arg, aux = mod.get_params()
+    net_sm = models.transformer_lm(V, S, num_layers=1, d_model=32,
+                                   num_heads=2)
+    mod2 = mx.mod.Module(net_sm, context=mx.cpu(), data_names=("data",),
+                         label_names=("softmax_label",))
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.set_params(arg, aux)
+    it.reset()
+    b0 = next(iter(it))
+    mod2.forward(b0, is_train=False)
+    probs = mod2.get_outputs()[0].asnumpy()
+    lab = b0.label[0].asnumpy().reshape(-1).astype(int)
+    ce = -np.log(np.maximum(probs[np.arange(lab.size), lab], 1e-9)).mean()
+    mod.forward(b0, is_train=False)
+    np.testing.assert_allclose(ce, float(mod.get_outputs()[0].asnumpy()),
+                               rtol=1e-4, atol=1e-4)
