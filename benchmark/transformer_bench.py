@@ -17,6 +17,8 @@ and appends it (timestamped) to BENCH_LOG.jsonl.
 Config knobs (GPT-2-small-shaped defaults):
     TFB_LAYERS=12 TFB_DMODEL=768 TFB_HEADS=12 TFB_KV_HEADS= TFB_SEQ=1024
     TFB_BATCH=8 TFB_VOCAB=50304 TFB_ITERS=20 TFB_WARMUP=3
+    TFB_LOSS=softmax|chunked_ce TFB_CE_CHUNKS=8   (chunked head: the
+    (B*S, V) logits never materialize — ops/chunked_loss.py)
 """
 import json
 import os
@@ -38,6 +40,8 @@ LAYERS = _env_int("TFB_LAYERS", 12)
 DMODEL = _env_int("TFB_DMODEL", 768)
 HEADS = _env_int("TFB_HEADS", 12)
 KV_HEADS = os.environ.get("TFB_KV_HEADS", "")
+LOSS = os.environ.get("TFB_LOSS", "softmax")
+CE_CHUNKS = _env_int("TFB_CE_CHUNKS", 8)
 SEQ = _env_int("TFB_SEQ", 1024)
 BATCH = _env_int("TFB_BATCH", 8)
 VOCAB = _env_int("TFB_VOCAB", 50304)   # 50257 rounded up to a lane multiple
@@ -78,7 +82,8 @@ def main():
 
     kv = int(KV_HEADS) if KV_HEADS else None
     net = transformer_lm(VOCAB, SEQ, num_layers=LAYERS, d_model=DMODEL,
-                         num_heads=HEADS, num_kv_heads=kv)
+                         num_heads=HEADS, num_kv_heads=kv,
+                         loss_type=LOSS, ce_chunks=CE_CHUNKS)
     mod = mx.mod.Module(net, context=mx.tpu(0),
                         compute_dtype=jnp.bfloat16)
     it = mx.io.NDArrayIter(
@@ -164,7 +169,9 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "config": {"layers": LAYERS, "d_model": DMODEL, "heads": HEADS,
                    "kv_heads": kv, "seq": SEQ, "batch": BATCH,
-                   "vocab": VOCAB},
+                   "vocab": VOCAB, "loss": LOSS,
+                   "ce_chunks": CE_CHUNKS if LOSS == "chunked_ce"
+                   else None},
         "n_params": n_params,
         "flops_per_step": flops_per_step,
         "flops_source": flops_source,

@@ -152,10 +152,20 @@ echo "== [$(TS)] attention microbench" >&2
 { ATTN_BLOCKS=128x128,128x256,256x128 \
   python benchmark/attention_bench.py | tee attention_bench_out.txt; } || probe_or_die
 
-# 4b. transformer-LM end-to-end train throughput (tokens/sec + MFU)
+# 4b. transformer-LM end-to-end train throughput (tokens/sec + MFU),
+# then the chunked-CE head variant (logits never materialize — the
+# measured delta IS the loss-head HBM traffic)
 deadline_check "transformer LM bench"
 echo "== [$(TS)] transformer LM bench" >&2
 python benchmark/transformer_bench.py || probe_or_die
+deadline_check "transformer LM bench (chunked head)"
+if [ "${FORCE_RERUN:-0}" != "1" ] \
+   && grep -q '"loss": "chunked_ce"' "$LOG" 2>/dev/null; then
+  echo "== [$(TS)] chunked_ce transformer bench already in $LOG — skipping" >&2
+else
+  echo "== [$(TS)] transformer LM bench (chunked_ce)" >&2
+  TFB_LOSS=chunked_ce python benchmark/transformer_bench.py || probe_or_die
+fi
 
 # 4c. kvstore 'tpu' facade overhead vs the fused step (VERDICT r3 weak 5)
 deadline_check "kvstore facade bench"
