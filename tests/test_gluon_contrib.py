@@ -93,3 +93,57 @@ def test_variational_dropout_eval_mode_identity():
     ref, _ = base2.unroll(4, x, layout='NTC', merge_outputs=True)
     np.testing.assert_allclose(outputs.asnumpy(), ref.asnumpy(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_lm_head_block():
+    from mxnet_tpu import gluon
+    """gluon.contrib.nn.ChunkedLMHead: fused projection+CE (no logits
+    materialization) — matches the op, trains under Trainer, and its
+    weight/bias load into a Dense for full-logits inference."""
+    import jax.numpy as jnp
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ops.chunked_loss import _chunked_lm_loss
+    rs = np.random.RandomState(0)
+    N, D, V = 10, 16, 30
+    head = gluon.contrib.nn.ChunkedLMHead(V, in_units=D, num_chunks=4)
+    head.initialize(mx.initializer.Xavier())
+    h = mx.nd.array(rs.randn(N, D).astype("f"))
+    lab = mx.nd.array(rs.randint(0, V, (N,)).astype("f"))
+    loss = head(h, lab)
+    ref = np.asarray(_chunked_lm_loss(
+        jnp.asarray(h.asnumpy()), jnp.asarray(head.weight.data().asnumpy()),
+        jnp.asarray(head.bias.data().asnumpy()),
+        jnp.asarray(lab.asnumpy()), 4))
+    np.testing.assert_allclose(loss.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+    trainer = gluon.Trainer(head.collect_params(), "adam",
+                            {"learning_rate": 5e-2})
+    first = None
+    for _ in range(20):
+        with autograd.record():
+            out = head(h, lab).mean()
+        out.backward()
+        trainer.step(1)
+        if first is None:
+            first = float(out.asnumpy())
+    assert float(out.asnumpy()) < 0.5 * first
+
+    dense = gluon.nn.Dense(V, in_units=D)
+    dense.initialize()
+    dense.weight.set_data(head.weight.data())
+    dense.bias.set_data(head.bias.data())
+    logits = dense(h).asnumpy()
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    ce = -np.log(np.maximum(
+        p[np.arange(N), lab.asnumpy().astype(int)], 1e-9))
+    np.testing.assert_allclose(head(h, lab).asnumpy(), ce,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_lm_head_requires_known_width():
+    from mxnet_tpu import gluon
+    with pytest.raises(ValueError, match="in_units"):
+        gluon.contrib.nn.ChunkedLMHead(30, in_units=0)
+    with pytest.raises(ValueError, match="num_chunks"):
+        gluon.contrib.nn.ChunkedLMHead(30, in_units=8, num_chunks=0)
