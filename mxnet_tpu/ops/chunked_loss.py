@@ -116,6 +116,14 @@ def _vjp_bwd(num_chunks, res, g):
 _chunked_lm_loss.defvjp(_vjp_fwd, _vjp_bwd)
 
 
+# public jax-level entry point (examples / custom training loops compose
+# it directly inside jit); the registry op below is the nd/sym surface
+def chunked_lm_loss(hidden, weight, bias, label, num_chunks=8):
+    """Per-token CE loss (N,) for hidden (N, D) against lm-head weight
+    (V, D) / bias (V,) — the full (N, V) logits never exist."""
+    return _chunked_lm_loss(hidden, weight, bias, label, int(num_chunks))
+
+
 @register("_contrib_ChunkedLMLoss",
           arg_names=["data", "weight", "bias", "label"],
           attr_defaults={"num_chunks": 8},

@@ -389,3 +389,15 @@ def test_train_lm_transformer_example():
                timeout=900)
     assert "Train-perplexity" in log or "perplexity" in log.lower(), \
         log[-500:]
+
+
+def test_ring_sp_train_example():
+    """Long-context recipe: ring attention over the sp axis + chunked CE
+    in one SPMD step — loss collapses on the learnable shift corpus."""
+    log = _run("examples/model_parallel/ring_sp_train.py",
+               "--steps", "80", timeout=600,
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    # the script itself asserts the convergence ratio before printing
+    # this marker — its presence IS the pass condition
+    assert "ring-sp train: loss" in log, log[-500:]
