@@ -69,3 +69,11 @@ from . import notebook
 from . import rtc
 
 from .ndarray import NDArray
+
+# A process launched with DMLC_ROLE=server becomes a blocking async
+# parameter server the moment it imports this library, and exits when the
+# job stops — so user training scripts run unmodified as server commands
+# (reference: python/mxnet/kvstore_server.py:75 _init_kvstore_server_module
+# called at import; servers started by tools/launch.py -s).
+from . import kvstore_server as _kvstore_server
+_kvstore_server._init_kvstore_server_module()

@@ -21,7 +21,11 @@ num_workers/barrier) is preserved; the transport is re-imagined:
   fuses the gradient psum into the jitted step (docs/design/kvstore.md).
   There are no parameter-server processes (kvstore_dist_server.h is
   intentionally not ported).
-* ``dist_async`` — unsupported on TPU (documented; raises).
+* ``dist_async`` — real async parameter servers (``KVStoreDistAsync``
+  below + ``kvstore_server.py``): host-side server processes apply each
+  push the moment it arrives (reference kvstore_dist_server.h:405-430),
+  workers push through a background channel so device compute never
+  blocks on a collective.  Launch with ``tools/launch.py -n W -s S``.
 
 Update-on-kvstore (reference: server-side optimizer, kvstore_dist_server.h
 :131) is supported: ``set_optimizer`` installs an Updater that runs the
@@ -29,7 +33,11 @@ fused update on the aggregated gradient.
 """
 from __future__ import annotations
 
+import os
 import pickle
+import queue
+import threading
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -39,6 +47,9 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .ndarray import NDArray
 from . import optimizer as opt
+# canonical key coercion lives beside the wire protocol so worker-side
+# and server-side updater indexing can never diverge
+from .kvstore_server import _key_int as _key_int_impl
 
 
 def _key(k):
@@ -246,12 +257,7 @@ class KVStore:
             [x[None] for x in datas])
         return jax.device_put(fn(stacked), devs[0])
 
-    @staticmethod
-    def _key_int(k):
-        try:
-            return int(k)
-        except ValueError:
-            return k
+    _key_int = staticmethod(_key_int_impl)
 
     @staticmethod
     def _canon(key, value):
@@ -265,6 +271,240 @@ class KVStore:
         return [_key(k) for k in keys], values
 
 
+class _ServerConn:
+    """Ordered async channel to one parameter server.
+
+    Operations enqueue; one IO thread per server sends each request and
+    reads its ack in FIFO order.  A ``push`` therefore returns before the
+    server applies it (the async overlap the reference gets by running
+    ``ZPush`` inside an engine async op, kvstore_dist.h:53-80) while a
+    later ``pull`` on the same server is guaranteed to observe every
+    prior push from THIS worker — per-server FIFO is exactly the ordering
+    the reference's per-key engine dependency chain provides.
+    """
+
+    def __init__(self, uri, connect_timeout=60.0):
+        import socket
+        import time
+        host, port = uri.rsplit(":", 1)
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=60)
+                # the connect timeout must NOT linger as a recv timeout:
+                # a barrier reply legitimately blocks until every worker
+                # arrives (unbounded); transport death still surfaces as
+                # ECONNRESET/EOF when the server process dies
+                self._sock.settimeout(None)
+                break
+            except (ConnectionRefusedError, OSError):
+                # the server process is still importing/binding — workers
+                # and servers start simultaneously (tools/launch.py)
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        f"could not reach kvstore server at {uri} "
+                        f"within {connect_timeout}s")
+                time.sleep(0.2)
+        self._q = queue.Queue()
+        self._err = None
+        self._thread = threading.Thread(target=self._io_loop, daemon=True)
+        self._thread.start()
+
+    def _io_loop(self):
+        from .kvstore_server import _send_msg, _recv_msg
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            msg, pending = item
+            try:
+                _send_msg(self._sock, msg)
+                status, payload = _recv_msg(self._sock)
+            except Exception as exc:  # noqa: BLE001 — transport death:
+                self._err = exc       # poison the channel for good
+                if pending is not None:
+                    pending.error = exc
+                    pending.done.set()
+                continue
+            if status != "ok":
+                # application error: the reply was fully read, the socket
+                # is healthy — fail THIS op only.  A failed fire-and-
+                # forget push has no waiter, so it surfaces on the next
+                # call instead (a lost gradient must not pass silently).
+                err = MXNetError(f"kvstore server error: {payload}")
+                if pending is not None:
+                    pending.error = err
+                else:
+                    self._err = err
+            elif pending is not None:
+                pending.value = payload
+            if pending is not None:
+                pending.done.set()
+
+    def request(self, msg):
+        """Enqueue and return the :class:`_Pending` reply handle — lets a
+        caller pipeline many requests before waiting on any."""
+        if self._err is not None:
+            raise MXNetError(f"kvstore server channel failed: {self._err}")
+        pending = _Pending()
+        self._q.put((msg, pending))
+        return pending
+
+    def submit(self, msg, wait=False):
+        """Enqueue; with wait=True block for (and return) the reply."""
+        if not wait:
+            if self._err is not None:
+                raise MXNetError(
+                    f"kvstore server channel failed: {self._err}")
+            self._q.put((msg, None))
+            return None
+        return _await(self.request(msg))
+
+    def flush(self):
+        """Return once every previously-enqueued op has been acked by the
+        server (FIFO: a synchronous no-op command drains the queue)."""
+        self.submit(("command", -1, None), wait=True)
+
+    def close(self):
+        # drain before closing: a still-queued fire-and-forget push must
+        # reach the server, not die with the socket ("a lost gradient
+        # must not pass silently")
+        try:
+            self.flush()
+        except MXNetError:
+            pass  # channel already dead — nothing left to save
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Pending:
+    """Reply rendezvous for one in-flight request."""
+
+    __slots__ = ("done", "value", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.value = None
+        self.error = None
+
+
+def _await(pending):
+    pending.done.wait()
+    if pending.error is not None:
+        raise MXNetError(f"kvstore server request failed: {pending.error}")
+    return pending.value
+
+
+class KVStoreDistAsync(KVStore):
+    """Worker-side kvstore ``dist_async`` (reference: kvstore_dist.h worker
+    + the server's immediate-apply branch, kvstore_dist_server.h:405-430).
+
+    Keys are routed to servers by ``crc32(key) % num_servers`` — the
+    deterministic key→server partition that replaces the reference's
+    ``EncodeKey``/PSKV round-robin (kvstore_dist.h:60).  Big-array
+    striping across servers (MXNET_KVSTORE_BIGARRAY_BOUND) is not
+    implemented: one server owns each whole key (documented departure).
+    """
+
+    def __init__(self):
+        super().__init__("dist_async")
+        uris = os.environ.get("MXT_SERVER_URIS", "")
+        if not uris:
+            raise MXNetError(
+                "kvstore 'dist_async' needs running parameter servers: "
+                "launch with `python tools/launch.py -n W -s S cmd...` "
+                "(MXT_SERVER_URIS is set by the launcher) — see "
+                "docs/design/kvstore.md")
+        self._conns = [_ServerConn(u) for u in uris.split(",")]
+
+    # -- identity (no jax.distributed needed: workers are independent) ------
+    @property
+    def rank(self) -> int:
+        return int(os.environ.get("DMLC_WORKER_ID", "0"))
+
+    @property
+    def num_workers(self) -> int:
+        return int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+    def _conn_of(self, k: str) -> _ServerConn:
+        return self._conns[zlib.crc32(k.encode()) % len(self._conns)]
+
+    # -- kv ops --------------------------------------------------------------
+    def init(self, key, value):
+        """First-arriving init wins at the server (all workers call init;
+        the server keeps one authoritative value)."""
+        keys, values = self._canon(key, value)
+        for k, vs in zip(keys, values):
+            arr = np.asarray(vs[0].asnumpy())
+            self._conn_of(k).submit(("init", k, arr), wait=True)
+
+    def push(self, key, value, priority=0):
+        """Locally reduce, then hand to the channel — returns immediately;
+        the server applies the update when the push arrives (async SGD)."""
+        keys, values = self._canon(key, value)
+        for k, vs in zip(keys, values):
+            agg = np.asarray(self._reduce(vs))
+            self._conn_of(k).submit(("push", k, agg), wait=False)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Fetch the server's CURRENT weight — possibly mid-stream of other
+        workers' pushes; staleness is the async contract.
+
+        All requests are enqueued before any reply is awaited, so an
+        N-key pull over S servers costs ~max-RTT, not N round trips
+        (the reference gets the same overlap from engine-async ZPull)."""
+        import jax.numpy as jnp
+        assert out is not None
+        keys, outs = self._canon(key, out)
+        pendings = [self._conn_of(k).request(("pull", k)) for k in keys]
+        for k, os_, pending in zip(keys, outs, pendings):
+            val = jnp.asarray(_await(pending))
+            for o in os_:
+                o._set_data(val.astype(o._data.dtype)
+                            if o._data.dtype != val.dtype else val)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError(
+            "row_sparse_pull over dist_async is not implemented; use "
+            "dist_sync for row-sparse training (docs/design/kvstore.md)")
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the servers (reference kvstore.py:353:
+        rank 0 pickles it; _send_command_to_servers head=0), then barrier
+        so every worker sees the installed updater before pushing."""
+        self._optimizer = optimizer
+        if self.rank == 0:
+            blob = pickle.dumps(optimizer)
+            from .kvstore_server import K_CONTROLLER
+            for c in self._conns:
+                c.submit(("command", K_CONTROLLER, blob), wait=True)
+        self.barrier()
+
+    def _send_command_to_servers(self, head, body):
+        for c in self._conns:
+            c.submit(("command", head, body), wait=True)
+
+    def barrier(self):
+        """Flush this worker's outstanding pushes, then rendezvous on
+        server 0 (reference: Postoffice::Barrier after engine drain)."""
+        for c in self._conns:
+            c.flush()
+        self._conns[0].submit(("barrier",), wait=True)
+
+    def close(self, stop_servers=False):
+        from .kvstore_server import K_STOP_SERVER
+        if stop_servers:
+            for c in self._conns:
+                c.submit(("command", K_STOP_SERVER, None), wait=True)
+        for c in self._conns:
+            c.close()
+
+
 def create(name="local") -> KVStore:
     """reference: kvstore.py:534 create → KVStore::Create (kvstore.cc:34)."""
     if not isinstance(name, str):
@@ -275,8 +515,5 @@ def create(name="local") -> KVStore:
                 "nccl"):
         return KVStore(name)
     if name == "dist_async":
-        raise MXNetError(
-            "kvstore 'dist_async' is not supported by the TPU design: SPMD "
-            "collectives are synchronous. Use 'dist_sync' (allreduce over "
-            "the global mesh) — see docs/design/kvstore.md")
+        return KVStoreDistAsync()
     raise MXNetError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,268 @@
+"""Async parameter-server process: the backend of kvstore ``dist_async``.
+
+TPU-native re-design of the reference's server stack
+(src/kvstore/kvstore_dist_server.h; bootstrap in
+python/mxnet/kvstore_server.py:28-75).  The reference runs ps-lite
+``KVServer`` processes over ZMQ; async mode applies each worker's push to
+the stored weight the moment it arrives (kvstore_dist_server.h:405-430 —
+``DataHandleDefault``'s non-sync branch runs ``updater_(key, recved,
+&stored)`` immediately, no cross-worker aggregation barrier).  That is
+the one kvstore mode SPMD collectives cannot express — allreduce is
+synchronous by construction — so here the servers come back as plain
+host processes:
+
+* transport: length-prefixed pickled messages over TCP (ps-lite/ZMQ's
+  role; no new dependency).
+* apply: one global store lock — the reference server is ALSO serialized
+  (its single-thread ``Executor`` run loop, kvstore_dist_server.h:50-106),
+  so per-push locking is the faithful concurrency model.
+* placement: servers pin ``JAX_PLATFORMS=cpu`` (set by tools/launch.py);
+  updates are tiny CPU math and a server must never touch a TPU — the
+  accelerators belong to the workers, exactly as the reference gives
+  servers no GPU context.
+
+Process model mirrors the reference exactly: ``tools/launch.py -s S``
+starts S copies of the *same user command* with ``DMLC_ROLE=server``;
+importing :mod:`mxnet_tpu` in such a process enters the blocking server
+loop and exits when the job is torn down, so user training scripts work
+unmodified as server commands (reference kvstore_server.py:75
+``_init_kvstore_server_module``).
+
+Worker-side counterpart: :class:`mxnet_tpu.kvstore.KVStoreDistAsync`.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import sys
+import threading
+
+import numpy as np
+
+# reference command codes (kvstore_dist_server.h:40-45 ``CommandType``):
+# kController=0 carries a pickled optimizer; kStopServer=1 tears down;
+# kSyncMode=2 is meaningless here (this server IS the async mode).
+K_CONTROLLER = 0
+K_STOP_SERVER = 1
+K_SYNC_MODE = 2
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class KVStoreServer:
+    """One async parameter-server shard.
+
+    Holds a slice of the key space (workers route each key to
+    ``crc32(key) % num_servers``); applies the installed optimizer to
+    every arriving gradient immediately (async SGD), or stores the pushed
+    value verbatim when no optimizer is installed (the reference's
+    assign-on-merge semantics, kvstore_local.h:173).
+    """
+
+    def __init__(self, server_id=0, num_workers=1,
+                 host="127.0.0.1", port=0):
+        self.server_id = server_id
+        self.num_workers = num_workers
+        self._store = {}          # key -> NDArray (host CPU)
+        self._updater = None
+        self._lock = threading.Lock()
+        self._barrier_cv = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._stop = threading.Event()
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(0.5)
+        self.port = self._listener.getsockname()[1]
+        self._threads = []
+
+    # -- request handlers ----------------------------------------------------
+    def _apply_push(self, key, arr):
+        """reference kvstore_dist_server.h:405-430: async branch applies the
+        updater right away; a pushed value with no updater replaces the
+        stored one (assign, not add)."""
+        from .ndarray import NDArray
+        import jax.numpy as jnp
+        grad = NDArray(jnp.asarray(arr))
+        with self._lock:
+            stored = self._store.get(key)
+            if stored is None:
+                raise KeyError(f"push to uninitialized key {key!r}")
+            if self._updater is not None:
+                self._updater(_key_int(key), grad, stored)
+            else:
+                stored._set_data(grad._data)
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "init":
+            # first init wins; later inits of the same key are ignored
+            # (reference: the server keeps the first-arriving value,
+            # kvstore_dist_server.h DataHandleDefault init path)
+            _, key, arr = msg
+            from .ndarray import NDArray
+            import jax.numpy as jnp
+            with self._lock:
+                if key not in self._store:
+                    self._store[key] = NDArray(jnp.asarray(arr))
+            return None
+        if op == "push":
+            _, key, arr = msg
+            self._apply_push(key, arr)
+            return None
+        if op == "pull":
+            _, key = msg
+            with self._lock:
+                stored = self._store.get(key)
+                if stored is None:
+                    raise KeyError(f"pull of uninitialized key {key!r}")
+                return np.asarray(stored.asnumpy())
+        if op == "command":
+            _, head, body = msg
+            return self._command(head, body)
+        if op == "barrier":
+            self._barrier()
+            return None
+        raise ValueError(f"unknown op {op!r}")
+
+    def _command(self, head, body):
+        """reference kvstore_dist_server.h:149-162 ``CommandHandle``."""
+        if head == K_CONTROLLER:
+            from . import optimizer as opt
+            with self._lock:
+                self._updater = opt.get_updater(pickle.loads(body))
+            return None
+        if head == K_STOP_SERVER:
+            self._stop.set()
+            with self._barrier_cv:
+                self._barrier_cv.notify_all()
+            return None
+        return None  # kSyncMode etc.: accepted, no-op in the async server
+
+    def _barrier(self):
+        """Count one arrival per worker; release everyone when all
+        ``num_workers`` are in (reference: Postoffice::Barrier)."""
+        with self._barrier_cv:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= self.num_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_cv.notify_all()
+                return
+            while self._barrier_gen == gen and not self._stop.is_set():
+                self._barrier_cv.wait(0.1)
+
+    # -- connection plumbing -------------------------------------------------
+    def _serve_conn(self, conn):
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    try:
+                        msg = _recv_msg(conn)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        _send_msg(conn, ("ok", self._handle(msg)))
+                    except Exception as exc:  # noqa: BLE001 — to the client
+                        _send_msg(conn, ("err", f"{type(exc).__name__}: {exc}"))
+        except Exception:  # noqa: BLE001 — conn died mid-reply
+            pass
+
+    def run(self):
+        """Blocking accept loop; returns after a kStopServer command."""
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        finally:
+            self._listener.close()
+
+    def stop(self):
+        self._stop.set()
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()
+
+    def start_background(self):
+        """Run the accept loop in a daemon thread (in-process tests)."""
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        return t
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _init_kvstore_server_module():
+    """Turn a ``DMLC_ROLE=server`` process into a blocking server, then
+    exit — the reference hook verbatim (python/mxnet/kvstore_server.py:75:
+    importing the library in a server-role process never returns to user
+    code)."""
+    if os.environ.get("DMLC_ROLE") != "server":
+        return
+    # This function blocks INSIDE `import mxnet_tpu`, so the package module
+    # would stay flagged as initializing forever — and any connection
+    # thread that triggers `import mxnet_tpu.*` (pickle.loads of an
+    # optimizer does) would block on the parent module's import lock:
+    # a guaranteed deadlock.  The package body is fully executed at this
+    # point (this hook is its last statement), so clear the flag, and
+    # pre-import everything the request handlers touch.
+    import mxnet_tpu  # noqa: PLC0415 — self, already in sys.modules
+    spec = getattr(mxnet_tpu, "__spec__", None)
+    if spec is not None:
+        spec._initializing = False
+    from . import optimizer as _opt  # noqa: F401 — handler dependency
+    from . import ndarray as _nd     # noqa: F401
+    import jax.numpy as _jnp         # noqa: F401
+    sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    uris = os.environ.get("MXT_SERVER_URIS", "")
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    host, port = "127.0.0.1", 0
+    if uris:
+        my = uris.split(",")[sid]
+        host, port = my.rsplit(":", 1)
+        port = int(port)
+        # loopback-advertised servers (local launcher) bind loopback ONLY
+        # — _recv_msg unpickles from any peer, so never expose the port
+        # beyond what the deployment needs; ssh-mode servers must accept
+        # remote workers and bind all interfaces (trusted-cluster model,
+        # see module docstring)
+        if host not in ("127.0.0.1", "localhost"):
+            host = "0.0.0.0"
+    server = KVStoreServer(server_id=sid, num_workers=num_workers,
+                           host=host, port=port)
+    print(f"kvstore server {sid} listening on port {server.port}",
+          flush=True)
+    server.run()
+    sys.exit(0)

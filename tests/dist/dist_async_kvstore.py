@@ -1,0 +1,107 @@
+"""Real multi-process dist_async kvstore test (reference:
+tests/nightly/dist_sync_kvstore.py pattern, applied to the async server
+path kvstore_dist_server.h:405-430).
+
+Run via:  python tools/launch.py -n 4 -s 2 python tests/dist/dist_async_kvstore.py
+
+Asserts the three properties that DEFINE async PS semantics:
+
+1. **Immediate apply** — one worker's push alone changes the global
+   weight while the other workers never push (a sync server would block
+   aggregation waiting for every worker's contribution).
+2. **Order-independent total** — plain SGD updates commute, so after a
+   barrier the weight equals -lr * (sum of every worker's pushed grads)
+   regardless of arrival interleaving: the only exact assertion an async
+   store admits.
+3. **First-init-wins + per-worker keys** — the server keeps the first
+   init value; a no-updater key stores pushes verbatim (assign).
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == int(os.environ["DMLC_NUM_WORKER"])
+    nserver = int(os.environ["DMLC_NUM_SERVER"])
+    assert len(kv._conns) == nserver, (len(kv._conns), nserver)
+
+    shape = (3, 4)
+
+    # -- 3a. first-init-wins: every worker inits with a different value;
+    # the surviving value must be one of them (exactly which is a race),
+    # and identical across pulls
+    kv.init("w", mx.nd.ones(shape) * (rank + 1))
+    kv.barrier()
+    pulled = mx.nd.zeros(shape)
+    kv.pull("w", out=pulled)
+    first = pulled.asnumpy()
+    assert first.std() == 0 and first.ravel()[0] in range(1, nworker + 1)
+
+    # -- 3b. no-updater assign semantics on a per-worker key (no races:
+    # each worker owns its key).  MUST run before set_optimizer: the
+    # updater is server-process-global, exactly like the reference's
+    # server-side optimizer (kvstore_dist_server.h:131)
+    key = f"mine_{rank}"
+    kv.init(key, mx.nd.zeros(shape))
+    kv.push(key, mx.nd.ones(shape) * (rank + 10))
+    kv.pull(key, out=pulled)
+    np.testing.assert_array_equal(
+        pulled.asnumpy(), np.full(shape, rank + 10, np.float32))
+    # barrier ENFORCES the before-set_optimizer requirement cross-worker:
+    # without it rank 0 could install the server-global updater while a
+    # slower worker's 3b push is still in flight (SGD-applied, not
+    # assigned — flaky failure)
+    kv.barrier()
+
+    # -- 2. order-independent SGD total: updates commute, total is exact
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0,
+                                      momentum=0.0))
+    kv.init("opt_w", mx.nd.zeros(shape))
+    kv.barrier()
+    pushes = 5
+    for _ in range(pushes):
+        kv.push("opt_w", mx.nd.ones(shape) * (rank + 1))
+    kv.barrier()
+    kv.pull("opt_w", out=pulled)
+    total = pushes * sum(r + 1 for r in range(nworker))
+    np.testing.assert_allclose(
+        pulled.asnumpy(), np.full(shape, -0.1 * total, np.float32),
+        rtol=1e-5)
+
+    # -- 1. immediate apply: only worker 0 pushes; every worker observes
+    # the weight move without ever contributing a push of its own.
+    # (A sync server's MergeBuf would wait for nworker pushes forever.)
+    kv.init("solo", mx.nd.zeros(shape))
+    kv.barrier()
+    if rank == 0:
+        kv.push("solo", mx.nd.ones(shape))
+    deadline = time.time() + 60
+    while True:
+        kv.pull("solo", out=pulled)
+        if abs(pulled.asnumpy().ravel()[0] + 0.1) < 1e-6:
+            break
+        assert time.time() < deadline, \
+            "worker 0's solo push never became visible (async broken)"
+        time.sleep(0.05)
+    kv.barrier()
+    kv.close()
+    print("dist_async_kvstore rank %d/%d OK" % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -286,3 +286,13 @@ def test_dist_ring_attention_8proc_pure_ring():
     stdout = _launch(8, "tests/dist/dist_ring_sp.py", timeout=600)
     for r in range(8):
         assert "dist_ring_sp rank %d/8 OK" % r in stdout
+
+
+def test_dist_async_kvstore_4_workers_2_servers():
+    """Async parameter servers end to end: launch.py -s spawns real
+    DMLC_ROLE=server processes (reference: kvstore_dist_server.h async
+    path; server bootstrap kvstore_server.py:28-75)."""
+    stdout = _launch(4, "tests/dist/dist_async_kvstore.py",
+                     launcher_args=("-s", "2"))
+    for r in range(4):
+        assert "rank %d/4 OK" % r in stdout

@@ -182,3 +182,66 @@ def test_push_reduce_where_data_lives():
     sharded = mx.nd.NDArray(jax.device_put(host[1], sh))
     np.testing.assert_allclose(
         np.asarray(kv._reduce([vals[0], sharded])), host[0] + host[1])
+
+
+def test_dist_async_inprocess(monkeypatch):
+    """kvstore 'dist_async' end to end against an in-process server
+    (reference: kvstore_dist_server.h:405-430 immediate-apply semantics;
+    the cluster twin is tests/dist/dist_async_kvstore.py)."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        kv = mx.kv.create('dist_async')
+        assert kv.type == 'dist_async'
+        assert kv.rank == 0 and kv.num_workers == 1
+
+        out = mx.nd.zeros(SHAPE)
+        kv.init('a', mx.nd.ones(SHAPE))
+        kv.pull('a', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+        # no updater installed: push assigns (reference assign-on-merge)
+        kv.push('a', mx.nd.ones(SHAPE) * 3)
+        kv.pull('a', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+        # first init wins: re-init is ignored by the server
+        kv.init('a', mx.nd.ones(SHAPE) * 9)
+        kv.pull('a', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+        # multi-value push locally reduces before the wire
+        kv.push('a', [mx.nd.ones(SHAPE), mx.nd.ones(SHAPE) * 2])
+        kv.pull('a', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+        # server-side optimizer: push applies SGD immediately
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.0,
+                                          wd=0.0, rescale_grad=1.0))
+        kv.init('b', mx.nd.zeros(SHAPE))
+        kv.push('b', mx.nd.ones(SHAPE))
+        kv.pull('b', out=out)
+        np.testing.assert_allclose(out.asnumpy(), -0.5)
+
+        # single-worker barrier returns immediately
+        kv.barrier()
+
+        # application error fails the op but not the channel
+        with pytest.raises(Exception, match="uninitialized"):
+            kv.pull('nope', out=out)
+        kv.pull('b', out=out)
+        np.testing.assert_allclose(out.asnumpy(), -0.5)
+
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_dist_async_without_servers_raises(monkeypatch):
+    monkeypatch.delenv("MXT_SERVER_URIS", raising=False)
+    with pytest.raises(Exception, match="launch"):
+        mx.kv.create('dist_async')

@@ -111,8 +111,10 @@ run_bench s2d            BENCH_STEM=s2d || probe_or_die
 run_bench b512_s2d       BENCH_BATCH=512 BENCH_STEM=s2d || probe_or_die
 run_bench b512_s2d_rematm BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=save_matmuls || probe_or_die
 run_bench b512_s2d_remat BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=1 || probe_or_die
-run_bench b768_s2d_rematm BENCH_BATCH=768 BENCH_STEM=s2d BENCH_REMAT=save_matmuls || probe_or_die
-run_bench b1024_lars_s2d  BENCH_BATCH=1024 BENCH_STEM=s2d BENCH_REMAT=save_matmuls BENCH_OPT=lars || probe_or_die
+# b768/b1024 MEASURED 2026-08-01: HBM OOM on the 16G v5e (bf16[768,1024,
+# 14,14] temp alloc, chip_session_stderr.log) — an OOM'd client is a
+# relay-wedge hazard (the 08:52Z tunnel death followed the b768 OOM), so
+# the configs are retired rather than retried on every session resume.
 
 # 2a. promote the sweep winner to bench defaults (BENCH_DEFAULTS.json):
 # the driver's end-of-round `python bench.py` then runs the best MEASURED

@@ -14,8 +14,13 @@ through the same DMLC-shaped env vars (read by
     DMLC_WORKER_ID                         per-process id
     DMLC_ROLE=worker                       every process (no 'server')
 
-``-s`` is accepted for CLI compatibility and ignored with a note: server
-processes do not exist in the allreduce design (docs/design/kvstore.md).
+``-s S`` starts S async parameter-server processes (kvstore
+``dist_async``): the same command with ``DMLC_ROLE=server`` — importing
+mxnet_tpu in that role enters the blocking server loop (reference:
+python/mxnet/kvstore_server.py:28-75) — pinned to ``JAX_PLATFORMS=cpu``
+so servers never touch an accelerator.  Every process gets
+``MXT_SERVER_URIS`` (comma list of host:port) for worker→server dialing;
+servers are torn down by the launcher once all workers exit.
 
 Two launchers:
 
@@ -59,8 +64,28 @@ def _worker_env(args, coord_uri, port, wid):
         "DMLC_PS_ROOT_URI": coord_uri,
         "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": str(args.num_workers),
-        "DMLC_NUM_SERVER": "0",
+        "DMLC_NUM_SERVER": str(args.num_servers),
         "DMLC_WORKER_ID": str(wid),
+    })
+    if getattr(args, "server_uris", None):
+        env["MXT_SERVER_URIS"] = ",".join(args.server_uris)
+    return env
+
+
+def _server_env(args, sid):
+    """Env for one DMLC_ROLE=server process (kvstore dist_async backend,
+    mxnet_tpu/kvstore_server.py).  JAX is pinned to CPU: a server doing
+    tiny optimizer math must never claim a TPU (the reference gives
+    servers no GPU context either)."""
+    env = {}
+    env.update(e.split("=", 1) for e in args.env)
+    env.update({
+        "DMLC_ROLE": "server",
+        "DMLC_SERVER_ID": str(sid),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "MXT_SERVER_URIS": ",".join(args.server_uris),
+        "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
     })
     return env
 
@@ -71,6 +96,34 @@ def _spawn_local(args, port):
         env = dict(os.environ)
         env.update(_worker_env(args, "127.0.0.1", port, wid))
         procs.append(subprocess.Popen(args.command, env=env))
+    return procs
+
+
+def _spawn_servers_local(args):
+    procs = []
+    for sid in range(args.num_servers):
+        env = dict(os.environ)
+        env.update(_server_env(args, sid))
+        procs.append(subprocess.Popen(args.command, env=env))
+    return procs
+
+
+def _spawn_servers_ssh(args, slots):
+    """Same port caveat as the worker coordinator (_spawn_ssh docstring):
+    each server port is picked free on THIS machine and can in principle
+    collide on the remote host that binds it — the server then dies with
+    EADDRINUSE at import and the launcher fails the job; rerun."""
+    procs = []
+    wdir = args.remote_dir or os.getcwd()
+    for sid in range(args.num_servers):
+        host = slots[sid % len(slots)]
+        envs = _server_env(args, sid)
+        env_line = " ".join(f"{k}={shlex.quote(v)}"
+                            for k, v in sorted(envs.items()))
+        cmd_line = " ".join(shlex.quote(c) for c in args.command)
+        remote = f"cd {shlex.quote(wdir)} && env {env_line} {cmd_line}"
+        procs.append(subprocess.Popen(
+            shlex.split(args.ssh_cmd) + [host, remote]))
     return procs
 
 
@@ -132,8 +185,10 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, required=True,
                     help="number of worker processes")
     ap.add_argument("-s", "--num-servers", type=int, default=0,
-                    help="accepted for reference-CLI compatibility; "
-                         "ignored (no PS servers in the allreduce design)")
+                    help="number of async parameter-server processes "
+                         "(kvstore 'dist_async'): the same command run "
+                         "with DMLC_ROLE=server, pinned to CPU; 0 = "
+                         "allreduce-only job (dist_sync needs no servers)")
     ap.add_argument("--launcher", default="local",
                     choices=["local", "ssh"],
                     help="'local' spawns on this machine; 'ssh' spreads "
@@ -161,17 +216,28 @@ def main():
         ap.error("no command given")
     if args.launcher == "ssh" and not args.hostfile:
         ap.error("--launcher ssh requires -H/--hostfile")
+    # parameter servers (kvstore dist_async): pick their ports up front so
+    # workers AND servers share one MXT_SERVER_URIS view
+    sprocs = []
+    args.server_uris = []
     if args.num_servers:
-        print("launch.py: note: -s/--num-servers ignored — the TPU design "
-              "replaces parameter servers with allreduce "
-              "(docs/design/kvstore.md)", file=sys.stderr)
+        if args.launcher == "ssh":
+            slots = _parse_hostfile(args.hostfile)
+            args.server_uris = [
+                f"{slots[sid % len(slots)]}:{_free_port()}"
+                for sid in range(args.num_servers)]
+            sprocs = _spawn_servers_ssh(args, slots)
+        else:
+            args.server_uris = [f"127.0.0.1:{_free_port()}"
+                                for _ in range(args.num_servers)]
+            sprocs = _spawn_servers_local(args)
 
     port = _free_port()
     procs = _spawn_ssh(args, port) if args.launcher == "ssh" \
         else _spawn_local(args, port)
 
     def _kill_all(signum=None, frame=None):
-        for p in procs:
+        for p in procs + sprocs:
             if p.poll() is None:
                 p.terminate()
 
@@ -180,10 +246,13 @@ def main():
 
     # poll ALL workers: the first nonzero exit kills the job immediately
     # (SPMD semantics — a worker that dies before joining the coordination
-    # service would otherwise leave the rest blocked in initialize())
+    # service would otherwise leave the rest blocked in initialize()).
+    # A server dying while workers live is likewise fatal: every push to
+    # its key shard would stall the workers.
     import time
     rc = 0
     live = list(procs)
+    slive = list(sprocs)
     while live:
         for p in list(live):
             code = p.poll()
@@ -193,7 +262,25 @@ def main():
             if code != 0 and rc == 0:
                 rc = code
                 _kill_all()
+        for p in list(slive):
+            code = p.poll()
+            if code is None:
+                continue
+            slive.remove(p)
+            if rc == 0:
+                rc = code or 1
+                _kill_all()
         time.sleep(0.1)
+    # workers done: tear the servers down (the reference's scheduler sends
+    # kStopServer at job end; here the launcher owns teardown)
+    for p in sprocs:
+        if p.poll() is None:
+            p.terminate()
+    for p in sprocs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
     sys.exit(rc)
 
 
