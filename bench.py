@@ -63,6 +63,11 @@ ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 # BENCH_REMAT: 0 (off), 1/full (whole-step recompute), save_matmuls
 # (keep conv/FC outputs, recompute elementwise chains only)
 STEM = os.environ.get("BENCH_STEM", _DEF.get("stem", "conv7"))
+# activation layout: nchw (MXNet default) or nhwc (channels-last, the
+# MLPerf-TPU ResNet convention; weights stay OIHW either way —
+# models/resnet.py layout kwarg, equality-tested in tests/test_models.py)
+LAYOUT = os.environ.get("BENCH_LAYOUT",
+                        str(_DEF.get("layout", "nchw"))).upper()
 _REMAT = os.environ.get("BENCH_REMAT", str(_DEF.get("remat", "0")))
 if _REMAT not in ("0", "", "False", "false"):
     # must be set before the Module traces the step (executor.maybe_mirror)
@@ -182,7 +187,8 @@ def _run(batch):
     from mxnet_tpu import models
 
     sym = models.resnet(num_classes=1000, num_layers=50,
-                        image_shape=(3, 224, 224), stem=STEM)
+                        image_shape=(3, 224, 224), stem=STEM,
+                        layout=LAYOUT)
     compute_dtype = None if DTYPE in ("float32", "fp32") else jnp.dtype(DTYPE)
     mod = mx.mod.Module(sym, context=mx.tpu(0),
                         compute_dtype=compute_dtype)
@@ -351,6 +357,7 @@ def _run(batch):
         "flops_source": flops_source,
         "peak_flops": peak,
         "stem": STEM,
+        "layout": LAYOUT.lower(),
         "opt": OPT,
         "iters": iters,
         # report from the env the executor actually reads, so an

@@ -498,9 +498,21 @@ class KVStoreDistAsync(KVStore):
 
     def close(self, stop_servers=False):
         from .kvstore_server import K_STOP_SERVER
+        # deliver queued pushes while the servers are still guaranteed up
+        for c in self._conns:
+            try:
+                c.flush()
+            except MXNetError:
+                pass  # channel already dead — nothing left to deliver
         if stop_servers:
+            # best-effort: with several workers closing concurrently,
+            # another worker's kStopServer may tear the connection down
+            # before our own command is acked
             for c in self._conns:
-                c.submit(("command", K_STOP_SERVER, None), wait=True)
+                try:
+                    c.submit(("command", K_STOP_SERVER, None), wait=True)
+                except MXNetError:
+                    pass
         for c in self._conns:
             c.close()
 

@@ -15,53 +15,51 @@ BN_EPS = 2e-5
 
 
 def residual_unit_v2(data, num_filter, stride, dim_match, name,
-                     bottle_neck=True):
+                     bottle_neck=True, layout="NCHW"):
     """Pre-activation residual unit (v2), symbols/resnet.py residual_unit."""
+    bn_ax = 3 if layout == "NHWC" else 1
+
+    def _bn(x, nm):
+        return sym.BatchNorm(data=x, fix_gamma=False, eps=BN_EPS,
+                             momentum=BN_MOM, axis=bn_ax, name=nm)
+
+    def _conv(x, nf, k, s, p, nm):
+        return sym.Convolution(data=x, num_filter=nf, kernel=k, stride=s,
+                               pad=p, no_bias=True, layout=layout, name=nm)
+
     if bottle_neck:
-        bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=BN_EPS,
-                            momentum=BN_MOM, name=name + "_bn1")
+        bn1 = _bn(data, name + "_bn1")
         act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(data=act1, num_filter=num_filter // 4,
-                                kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, name=name + "_conv1")
-        bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=BN_EPS,
-                            momentum=BN_MOM, name=name + "_bn2")
+        conv1 = _conv(act1, num_filter // 4, (1, 1), (1, 1), (0, 0),
+                      name + "_conv1")
+        bn2 = _bn(conv1, name + "_bn2")
         act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(data=act2, num_filter=num_filter // 4,
-                                kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, name=name + "_conv2")
-        bn3 = sym.BatchNorm(data=conv2, fix_gamma=False, eps=BN_EPS,
-                            momentum=BN_MOM, name=name + "_bn3")
+        conv2 = _conv(act2, num_filter // 4, (3, 3), stride, (1, 1),
+                      name + "_conv2")
+        bn3 = _bn(conv2, name + "_bn3")
         act3 = sym.Activation(data=bn3, act_type="relu", name=name + "_relu3")
-        conv3 = sym.Convolution(data=act3, num_filter=num_filter,
-                                kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, name=name + "_conv3")
+        conv3 = _conv(act3, num_filter, (1, 1), (1, 1), (0, 0),
+                      name + "_conv3")
         if dim_match:
             shortcut = data
         else:
-            shortcut = sym.Convolution(data=act1, num_filter=num_filter,
-                                       kernel=(1, 1), stride=stride,
-                                       no_bias=True, name=name + "_sc")
+            shortcut = _conv(act1, num_filter, (1, 1), stride, (0, 0),
+                             name + "_sc")
         return conv3 + shortcut
     else:
-        bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=BN_EPS,
-                            momentum=BN_MOM, name=name + "_bn1")
+        bn1 = _bn(data, name + "_bn1")
         act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(data=act1, num_filter=num_filter,
-                                kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, name=name + "_conv1")
-        bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=BN_EPS,
-                            momentum=BN_MOM, name=name + "_bn2")
+        conv1 = _conv(act1, num_filter, (3, 3), stride, (1, 1),
+                      name + "_conv1")
+        bn2 = _bn(conv1, name + "_bn2")
         act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(data=act2, num_filter=num_filter,
-                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                                no_bias=True, name=name + "_conv2")
+        conv2 = _conv(act2, num_filter, (3, 3), (1, 1), (1, 1),
+                      name + "_conv2")
         if dim_match:
             shortcut = data
         else:
-            shortcut = sym.Convolution(data=act1, num_filter=num_filter,
-                                       kernel=(1, 1), stride=stride,
-                                       no_bias=True, name=name + "_sc")
+            shortcut = _conv(act1, num_filter, (1, 1), stride, (0, 0),
+                             name + "_sc")
         return conv2 + shortcut
 
 
@@ -84,18 +82,31 @@ def space_to_depth_stem_weight(w7):
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, stem="conv7"):
+           bottle_neck=True, stem="conv7", layout="NCHW"):
+    """``layout="NHWC"`` runs the whole activation path channels-last (the
+    MLPerf-TPU convention): the NCHW ``data`` input is transposed ONCE at
+    the graph entry (XLA folds it into the first conv's relayout), every
+    conv/pool runs NHWC, and weights keep their NCHW-identical shapes so
+    checkpoints swap between layouts freely."""
     num_unit = len(units)
     assert num_unit == num_stages
+    layout = (layout or "NCHW").upper()
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError(f"resnet layout must be NCHW or NHWC, got "
+                         f"{layout!r}")
     data = sym.Variable(name="data")
     data = sym.identity(data=data, name="id")
     (nchannel, height, width) = image_shape
+    nhwc = layout == "NHWC"
+    bn_ax = 3 if nhwc else 1
+    if nhwc:
+        data = sym.transpose(data, axes=(0, 2, 3, 1), name="to_nhwc")
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=BN_EPS,
-                         momentum=BN_MOM, name="bn_data")
+                         momentum=BN_MOM, axis=bn_ax, name="bn_data")
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(data=data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name="conv0")
+                               no_bias=True, layout=layout, name="conv0")
     else:  # imagenet stem
         if stem == "s2d":
             # TPU-native stem (MLPerf-ResNet space-to-depth trick): fold
@@ -106,47 +117,56 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
             # space_to_depth_stem_weight; tests/test_models.py asserts
             # forward equality).  conv0 weight shape becomes (64, 12, 4, 4).
             n_, h_, w_ = nchannel, height // 2, width // 2
-            x = sym.Reshape(data, shape=(-1, n_, h_, 2, w_, 2))
-            x = sym.transpose(x, axes=(0, 1, 3, 5, 2, 4))
-            x = sym.Reshape(x, shape=(-1, n_ * 4, h_, w_))
+            if nhwc:
+                # (N,H,W,C) -> (N,h,w,C*4) with channel index c*4+2a+b —
+                # IDENTICAL phase order to the NCHW path, so one stem
+                # weight serves both layouts
+                x = sym.Reshape(data, shape=(-1, h_, 2, w_, 2, n_))
+                x = sym.transpose(x, axes=(0, 1, 3, 5, 2, 4))
+                x = sym.Reshape(x, shape=(-1, h_, w_, n_ * 4))
+            else:
+                x = sym.Reshape(data, shape=(-1, n_, h_, 2, w_, 2))
+                x = sym.transpose(x, axes=(0, 1, 3, 5, 2, 4))
+                x = sym.Reshape(x, shape=(-1, n_ * 4, h_, w_))
             body = sym.Convolution(data=x, num_filter=filter_list[0],
                                    kernel=(4, 4), stride=(1, 1), pad=(2, 2),
-                                   no_bias=True, name="conv0")
+                                   no_bias=True, layout=layout, name="conv0")
             # symmetric pad 2 yields one extra row/col vs the original's
             # effective (4,3) asymmetric padding — drop the trailing edge
-            body = sym.slice_axis(body, axis=2, begin=0, end=h_)
-            body = sym.slice_axis(body, axis=3, begin=0, end=w_)
+            h_ax, w_ax = (1, 2) if nhwc else (2, 3)
+            body = sym.slice_axis(body, axis=h_ax, begin=0, end=h_)
+            body = sym.slice_axis(body, axis=w_ax, begin=0, end=w_)
         else:
             body = sym.Convolution(data=data, num_filter=filter_list[0],
                                    kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                                   no_bias=True, name="conv0")
+                                   no_bias=True, layout=layout, name="conv0")
         body = sym.BatchNorm(data=body, fix_gamma=False, eps=BN_EPS,
-                             momentum=BN_MOM, name="bn0")
+                             momentum=BN_MOM, axis=bn_ax, name="bn0")
         body = sym.Activation(data=body, act_type="relu", name="relu0")
         body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
-                           pad=(1, 1), pool_type="max")
+                           pad=(1, 1), pool_type="max", layout=layout)
 
     for i in range(num_stages):
         stride = (1, 1) if i == 0 else (2, 2)
         body = residual_unit_v2(body, filter_list[i + 1], stride, False,
                                 name="stage%d_unit%d" % (i + 1, 1),
-                                bottle_neck=bottle_neck)
+                                bottle_neck=bottle_neck, layout=layout)
         for j in range(units[i] - 1):
             body = residual_unit_v2(body, filter_list[i + 1], (1, 1), True,
                                     name="stage%d_unit%d" % (i + 1, j + 2),
-                                    bottle_neck=bottle_neck)
+                                    bottle_neck=bottle_neck, layout=layout)
     bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=BN_EPS,
-                        momentum=BN_MOM, name="bn1")
+                        momentum=BN_MOM, axis=bn_ax, name="bn1")
     relu1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
     pool1 = sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7),
-                        pool_type="avg", name="pool1")
+                        pool_type="avg", layout=layout, name="pool1")
     flat = sym.Flatten(data=pool1)
     fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
     return sym.SoftmaxOutput(data=fc1, name="softmax")
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
-               stem="conv7", **kwargs):
+               stem="conv7", layout="NCHW", **kwargs):
     """Depth → unit table from symbols/resnet.py get_symbol."""
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
@@ -186,4 +206,4 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
                   image_shape=image_shape, bottle_neck=bottle_neck,
-                  stem=stem)
+                  stem=stem, layout=layout)

@@ -6,8 +6,11 @@ dropout,deconvolution,lrn,instance_norm,upsampling}.cc plus the cuDNN
 wrappers src/operator/cudnn_*.h).  Where the reference auto-tunes cuDNN
 algorithms (cudnn_algoreg-inl.h), here convs lower to
 ``lax.conv_general_dilated`` and XLA picks the MXU tiling — no algorithm
-registry needed.  All convs keep NCHW user-facing layout (MXNet default);
+registry needed.  Convs default to NCHW user-facing layout (MXNet default);
 XLA's layout assignment transposes internally to the TPU-preferred layout.
+``layout="NHWC"`` (reference: the Convolution/Pooling layout attr) runs the
+activation path channels-last — the MLPerf-TPU ResNet convention — while
+weights stay OIHW so checkpoints are layout-agnostic.
 """
 from __future__ import annotations
 
@@ -54,6 +57,20 @@ _CONV_DN = {  # spatial-rank -> (lhs, rhs, out) dimension_numbers
     2: ("NCHW", "OIHW", "NCHW"),
     3: ("NCDHW", "OIDHW", "NCDHW"),
 }
+# accepted layout attr values per spatial rank (reference: the layout
+# enum on Convolution/Pooling params); anything else must FAIL loudly —
+# a typo silently falling back to channels-first would mislabel every
+# measurement made with it
+_LAYOUTS = {1: {None, "NCW"}, 2: {None, "NCHW", "NHWC"}, 3: {None, "NCDHW"}}
+
+
+def _check_layout(layout, rank):
+    """Validate and return True iff the channels-last (NHWC) path."""
+    if layout not in _LAYOUTS.get(rank, {None}):
+        raise ValueError(
+            f"unsupported layout {layout!r} for {rank}d conv/pool "
+            f"(allowed: {sorted(x for x in _LAYOUTS[rank] if x)})")
+    return layout == "NHWC"
 
 
 @register("Convolution", arg_names=["data", "weight", "bias"],
@@ -68,15 +85,21 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = _pair(stride, rank) if stride else (1,) * rank
     dilate = _pair(dilate, rank) if dilate else (1,) * rank
     pad = _pair(pad, rank) if pad else (0,) * rank
+    nhwc = _check_layout(layout, rank)
+    # NHWC activations (reference: conv layout param, convolution.cc) keep
+    # the WEIGHT in MXNet's OIHW — checkpoints stay layout-agnostic and
+    # XLA relayouts the filter once at compile time
+    dn = ("NHWC", "OIHW", "NHWC") if nhwc else _CONV_DN[rank]
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
         padding=tuple((p, p) for p in pad),
         rhs_dilation=dilate,
-        dimension_numbers=_CONV_DN[rank],
+        dimension_numbers=dn,
         feature_group_count=num_group)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * rank)
+        out = out + (bias if nhwc
+                     else bias.reshape((1, -1) + (1,) * rank))
     # identity outside remat; under MXNET_REMAT_POLICY=save_matmuls the
     # backward keeps conv outputs and recomputes only the cheap
     # elementwise chains (executor.maybe_mirror)
@@ -141,32 +164,37 @@ def _deconv_flip(w):
 @register("Pooling", arg_names=["data"],
           attr_defaults={"kernel": (), "stride": (), "pad": (),
                          "pool_type": "max", "global_pool": False,
-                         "pooling_convention": "valid", "cudnn_off": False})
+                         "pooling_convention": "valid", "cudnn_off": False,
+                         "layout": None})
 def _pooling(data, kernel=(), stride=(), pad=(), pool_type="max",
-             global_pool=False, pooling_convention="valid", **kw):
+             global_pool=False, pooling_convention="valid", layout=None,
+             **kw):
     rank = data.ndim - 2
+    nhwc = _check_layout(layout, rank)
+    sp0 = 1 if nhwc else 2  # first spatial axis
     if global_pool:
-        ax = tuple(range(2, data.ndim))
+        ax = tuple(range(sp0, sp0 + rank))
         if pool_type == "max":
             return jnp.max(data, axis=ax, keepdims=True)
         return jnp.mean(data, axis=ax, keepdims=True)
     kernel = _pair(kernel, rank)
     stride = _pair(stride, rank) if stride else (1,) * rank
     pad = _pair(pad, rank) if pad else (0,) * rank
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    window = (1,) + kernel + (1,) if nhwc else (1, 1) + kernel
+    strides = (1,) + stride + (1,) if nhwc else (1, 1) + stride
 
     if pooling_convention == "full":
         # ceil-mode output: pad right edge enough to cover
-        pads = [(0, 0), (0, 0)]
+        sp_pads = []
         for i in range(rank):
-            in_sz = data.shape[2 + i]
+            in_sz = data.shape[sp0 + i]
             out_sz = int(np.ceil((in_sz + 2 * pad[i] - kernel[i]) / stride[i])) + 1
             need = (out_sz - 1) * stride[i] + kernel[i] - in_sz - pad[i]
-            pads.append((pad[i], max(need, pad[i])))
+            sp_pads.append((pad[i], max(need, pad[i])))
     else:
-        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
-    pads = tuple(pads)
+        sp_pads = [(p, p) for p in pad]
+    pads = tuple([(0, 0)] + sp_pads + [(0, 0)] if nhwc
+                 else [(0, 0), (0, 0)] + sp_pads)
 
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min

@@ -124,7 +124,8 @@ def _conv_param_shapes(attrs, in_shapes):
     kernel = tuple(int(k) for k in attrs.get("kernel", ()))
     nf = int(attrs.get("num_filter", 0))
     ng = int(attrs.get("num_group", 1))
-    cin = data[1]
+    # NHWC activations keep channels last; the weight stays OIHW either way
+    cin = data[-1] if attrs.get("layout") == "NHWC" else data[1]
     out = {"weight": (nf, cin // ng) + kernel}
     if not attrs.get("no_bias", False):
         out["bias"] = (nf,)
@@ -938,17 +939,22 @@ def _partial_prepass(nodes, var_pat, generic_eval=True):
                         dil = tuple(n.attrs.get("dilate", ()) or
                                     (1,) * rank)
                         nf = int(n.attrs.get("num_filter", 0))
+                        # channel/spatial axis positions flip for NHWC
+                        nhwc = n.attrs.get("layout") == "NHWC" and rank == 2
+                        sp0, c_ax = (1, rank + 1) if nhwc else (2, 1)
                         data = list(ins[0])
                         o = out0 or [None] * (rank + 2)
-                        o = _unify_dims(o, [data[0], nf] + [None] * rank, w)
+                        hint = [None] * (rank + 2)
+                        hint[0], hint[c_ax] = data[0], nf
+                        o = _unify_dims(o, hint, w)
                         for d in range(rank):
                             ke = dil[d] * (kern[d] - 1) + 1
-                            if data[2 + d]:
-                                o[2 + d] = (data[2 + d] + 2 * pad[d]
-                                            - ke) // stride[d] + 1
-                            elif o[2 + d]:
-                                data[2 + d] = ((o[2 + d] - 1) * stride[d]
-                                               - 2 * pad[d] + ke)
+                            if data[sp0 + d]:
+                                o[sp0 + d] = (data[sp0 + d] + 2 * pad[d]
+                                              - ke) // stride[d] + 1
+                            elif o[sp0 + d]:
+                                data[sp0 + d] = ((o[sp0 + d] - 1) * stride[d]
+                                                 - 2 * pad[d] + ke)
                         data[0] = o[0]
                         changed |= put(*n.inputs[0], data, w)
                         changed |= put(n, 0, o, w)

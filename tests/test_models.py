@@ -137,3 +137,48 @@ def test_vit_trains_and_gqa():
     mod.score(it, metric)
     acc = dict(metric.get_name_value())['accuracy']
     assert acc > 0.7, acc
+
+
+def test_nhwc_layout_matches_nchw():
+    """layout='NHWC' (channels-last activation path, MLPerf-TPU
+    convention) computes the SAME function and gradients as the default
+    NCHW graph from identical (layout-agnostic OIHW) weights — both
+    stems, forward and backward."""
+    rs = np.random.RandomState(7)
+    B = 2
+    x = rs.uniform(-1, 1, (B, 3, 64, 64)).astype('f')
+    y = rs.randint(0, 10, (B,)).astype('f')
+    for stem in ("conv7", "s2d"):
+        kw = dict(num_layers=18, num_classes=10, image_shape="3,64,64",
+                  stem=stem)
+        nchw = models.resnet(layout="NCHW", **kw)
+        nhwc = models.resnet(layout="NHWC", **kw)
+        ex1 = nchw.simple_bind(mx.cpu(), data=x.shape, softmax_label=(B,),
+                               grad_req='write')
+        for name, arr in ex1.arg_dict.items():
+            if name in ('data', 'softmax_label'):
+                continue
+            arr[:] = rs.uniform(-0.05, 0.05, arr.shape).astype('f')
+        ex2 = nhwc.simple_bind(mx.cpu(), data=x.shape, softmax_label=(B,),
+                               grad_req='write')
+        for name, arr in ex2.arg_dict.items():
+            if name in ('data', 'softmax_label'):
+                continue
+            assert arr.shape == ex1.arg_dict[name].shape, name
+            arr[:] = ex1.arg_dict[name].asnumpy()
+        for ex in (ex1, ex2):
+            ex.arg_dict['data'][:] = x
+            ex.arg_dict['softmax_label'][:] = y
+        o1 = ex1.forward(is_train=True)[0].asnumpy()
+        o2 = ex2.forward(is_train=True)[0].asnumpy()
+        np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)
+        ex1.backward()
+        ex2.backward()
+        for name in ex1.grad_dict:
+            if name in ('data', 'softmax_label'):
+                continue
+            g1 = ex1.grad_dict[name].asnumpy()
+            g2 = ex2.grad_dict[name].asnumpy()
+            np.testing.assert_allclose(
+                g1, g2, rtol=2e-3, atol=2e-5,
+                err_msg=f"{stem} grad mismatch for {name}")

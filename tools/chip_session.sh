@@ -46,7 +46,7 @@ run_bench() {  # run_bench <tag> [env overrides...]
   # after (last env assignment wins): promoted BENCH_DEFAULTS.json must
   # never silently redefine what a tagged sweep run measures
   out=$(env BENCH_BATCH=256 BENCH_STEM=conv7 BENCH_OPT=sgd \
-        BENCH_DTYPE=bfloat16 BENCH_REMAT=0 "$@" \
+        BENCH_DTYPE=bfloat16 BENCH_REMAT=0 BENCH_LAYOUT=nchw "$@" \
         BENCH_INIT_TIMEOUT_S=600 BENCH_INIT_RETRIES=1 \
         python bench.py 2>>chip_session_stderr.log | tail -1)
   echo "$out"
@@ -115,6 +115,16 @@ run_bench b512_s2d_remat BENCH_BATCH=512 BENCH_STEM=s2d BENCH_REMAT=1 || probe_o
 # 14,14] temp alloc, chip_session_stderr.log) — an OOM'd client is a
 # relay-wedge hazard (the 08:52Z tunnel death followed the b768 OOM), so
 # the configs are retired rather than retried on every session resume.
+
+# 2c. NHWC activation layout (MLPerf-TPU convention; landed after the
+# 08:30Z sweep showed every NCHW config flat at ~29% MFU — the remaining
+# gap is structural, and channels-last removes XLA's relayout work
+# around the NCHW convs).  Equality-tested vs NCHW in tests/test_models.
+run_bench nhwc           BENCH_LAYOUT=nhwc || probe_or_die
+run_bench nhwc_b512      BENCH_LAYOUT=nhwc BENCH_BATCH=512 || probe_or_die
+run_bench nhwc_s2d       BENCH_LAYOUT=nhwc BENCH_STEM=s2d || probe_or_die
+# re-promote in case nhwc wins (harmless duplicate of step 2a otherwise)
+python tools/promote_bench_defaults.py || true
 
 # 2a. promote the sweep winner to bench defaults (BENCH_DEFAULTS.json):
 # the driver's end-of-round `python bench.py` then runs the best MEASURED

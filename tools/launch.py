@@ -223,6 +223,8 @@ def main():
     if args.num_servers:
         if args.launcher == "ssh":
             slots = _parse_hostfile(args.hostfile)
+            if not slots:
+                raise SystemExit(f"launch.py: no hosts in {args.hostfile}")
             args.server_uris = [
                 f"{slots[sid % len(slots)]}:{_free_port()}"
                 for sid in range(args.num_servers)]
@@ -267,8 +269,11 @@ def main():
             if code is None:
                 continue
             slive.remove(p)
-            if rc == 0:
-                rc = code or 1
+            # exit 0 = the documented kStopServer shutdown (a worker's
+            # kv.close(stop_servers=True)) — benign; only a CRASHED
+            # server (nonzero) fails the job
+            if code != 0 and rc == 0:
+                rc = code
                 _kill_all()
         time.sleep(0.1)
     # workers done: tear the servers down (the reference's scheduler sends

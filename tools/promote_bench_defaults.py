@@ -84,6 +84,7 @@ def main():
     cand = {
         "batch": int(best.get("batch", 256)),
         "stem": best.get("stem", "conv7"),
+        "layout": best.get("layout", "nchw"),
         "opt": best.get("opt", "sgd"),
         "dtype": best.get("dtype", "bfloat16"),
         "remat": remat_str(best.get("remat", "0")),
