@@ -85,10 +85,37 @@ class Trainer:
 
     def _init_kvstore(self):
         """reference: trainer.py:102 — create the store lazily at first
-        step; on TPU it is a facade over in-program collectives."""
+        step; on TPU it is a facade over in-program collectives, EXCEPT
+        dist_async where the kvstore path IS the mechanism: the optimizer
+        runs server-side and step() becomes push-grad/pull-weight
+        (reference trainer.py:148 update-on-kvstore)."""
         if self._kv_type:
             self._kvstore = kvs_mod.create(self._kv_type) \
                 if isinstance(self._kv_type, str) else self._kv_type
+        self._update_on_kvstore = (
+            self._kvstore is not None
+            and getattr(self._kvstore, "type", "") == "dist_async")
+        if self._update_on_kvstore:
+            # the server applies updates with the optimizer AS PICKLED
+            # here — step() sets rescale_grad before first use so it
+            # rides along (the reference's server shares this pickle-time
+            # snapshot semantics, kvstore.py:353)
+            self._kvstore.set_optimizer(self._optimizer)
+            self._kv_opt_snapshot = (self._optimizer.lr,
+                                     self._optimizer.rescale_grad)
+            self._kv_param_inited = set()
+            inited = [p for p in self._params
+                      if p.grad_req != 'null' and p._data is not None]
+            for param in inited:
+                self._kvstore.init(param.name, param.data())
+                self._kv_param_inited.add(param.name)
+            # pull the AUTHORITATIVE weights back: the server kept the
+            # first-arriving worker's init, and every worker must start
+            # from that same point (reference: model.py:96
+            # _initialize_kvstore pulls after init)
+            if inited:
+                self._kvstore.pull([p.name for p in inited],
+                                   out=[p.data() for p in inited])
         self._kv_initialized = True
 
     @property
@@ -107,9 +134,13 @@ class Trainer:
         device round-trip on a remote-attached chip).  Sparse-gradient
         params and non-pure optimizers keep the per-param eager path.
         """
+        # rescale BEFORE the lazy kvstore init: dist_async pickles the
+        # optimizer to the servers at init and applies THERE
+        self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        if getattr(self, "_update_on_kvstore", False):
+            return self._step_on_kvstore(ignore_stale_grad)
         updater = self._updaters[0]
         from ..ndarray.sparse import RowSparseNDArray
         fuse = (env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
@@ -140,6 +171,46 @@ class Trainer:
                 updater(i, grad, param.data())
         if fused_batch:
             self._fused_update(fused_batch, updater)
+
+    def _step_on_kvstore(self, ignore_stale_grad):
+        """Async-PS step: push every grad (fire-and-forget, overlapping),
+        then ONE batched pull of the server's current weights back
+        (reference: trainer.py:148 _update update-on-kvstore branch;
+        pipelined pull = ~max-RTT, not N round trips).  Per-server FIFO
+        guarantees each pull observes this worker's own pushes."""
+        snap = (self._optimizer.lr, self._optimizer.rescale_grad)
+        if snap != self._kv_opt_snapshot \
+                and not getattr(self, "_kv_opt_drift_warned", False):
+            self._kv_opt_drift_warned = True
+            import warnings
+            warnings.warn(
+                "optimizer hyperparameters changed after the first "
+                "dist_async step (lr/rescale_grad %s -> %s) — the SERVER "
+                "keeps applying its pickle-time snapshot (re-sending the "
+                "optimizer would reset server-side momentum state); "
+                "restart training to change hyperparameters, as with the "
+                "reference's server-side optimizer" %
+                (self._kv_opt_snapshot, snap), stacklevel=3)
+        live = []
+        for param in self._params:
+            if param.grad_req == 'null':
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        f"Parameter {param.name!r} was not initialized")
+                continue
+            if param.name not in self._kv_param_inited:
+                # deferred-init param materialized after the first step:
+                # register it before its first push (first-init-wins
+                # makes a late init safe under concurrent workers)
+                self._kvstore.init(param.name, param.data())
+                self._kv_param_inited.add(param.name)
+            self._kvstore.push(param.name, param.grad())
+            live.append(param)
+        if live:
+            self._kvstore.pull([p.name for p in live],
+                               out=[p.data() for p in live])
 
     def _zero_pspec(self, arr):
         """Delegates to the shared rule in parallel.sharding (one source
@@ -273,11 +344,21 @@ class Trainer:
         self.step(batch_size, ignore_stale_grad)
 
     def save_states(self, fname):
-        """reference: trainer.py save_states."""
+        """reference: trainer.py save_states.  Under dist_async the
+        optimizer states LIVE on the servers — fetch them from there
+        (worker-side updater states would be an empty dict)."""
+        if getattr(self, "_update_on_kvstore", False):
+            self._kvstore.save_optimizer_states(fname)
+            return
         with open(fname, 'wb') as fout:
             fout.write(self._updaters[0].get_states())
 
     def load_states(self, fname):
+        if getattr(self, "_update_on_kvstore", False):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._kvstore.load_optimizer_states(fname)
+            return
         with open(fname, 'rb') as fin:
             self._updaters[0].set_states(fin.read())
         if self._zero_stage >= 1 and self._zero_dp > 1:

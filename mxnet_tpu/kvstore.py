@@ -489,6 +489,28 @@ class KVStoreDistAsync(KVStore):
         for c in self._conns:
             c.submit(("command", head, body), wait=True)
 
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Gather each server shard's {key: state} dict and persist the
+        union (the states LIVE on the servers in this mode — reference:
+        kvstore_dist_server.h:131 server-side optimizer)."""
+        merged = {}
+        for c in self._conns:
+            blob = c.submit(("get_states",), wait=True)
+            if blob is None:
+                raise MXNetError("there is no optimizer installed on the "
+                                 "servers (set_optimizer first)")
+            merged.update(pickle.loads(blob))
+        with open(fname, 'wb') as fout:
+            fout.write(pickle.dumps(merged))
+
+    def load_optimizer_states(self, fname):
+        """Broadcast the saved union to every server; each shard applies
+        all keys and simply never touches the ones it doesn't own."""
+        with open(fname, 'rb') as fin:
+            blob = fin.read()
+        for c in self._conns:
+            c.submit(("set_states", blob), wait=True)
+
     def barrier(self):
         """Flush this worker's outstanding pushes, then rendezvous on
         server 0 (reference: Postoffice::Barrier after engine drain)."""

@@ -136,6 +136,21 @@ class KVStoreServer:
                 if stored is None:
                     raise KeyError(f"pull of uninitialized key {key!r}")
                 return np.asarray(stored.asnumpy())
+        if op == "get_states":
+            # optimizer-state checkpointing: this shard's {key: state}
+            # dict (reference: server-side optimizer states live in the
+            # server, kvstore_dist_server.h:131)
+            with self._lock:
+                return None if self._updater is None \
+                    else self._updater.get_states(dump_optimizer=False)
+        if op == "set_states":
+            _, blob = msg
+            with self._lock:
+                if self._updater is None:
+                    raise RuntimeError(
+                        "set_states before an optimizer was installed")
+                self._updater.set_states(blob)
+            return None
         if op == "command":
             _, head, body = msg
             return self._command(head, body)

@@ -59,6 +59,17 @@ class Optimizer:
         self.set_lr_mult({})
         self.set_wd_mult({})
 
+    def __getstate__(self):
+        """Pickling (kvstore set_optimizer ships the optimizer to the
+        dist_async servers) drops param_dict: it holds live gluon
+        Parameter objects whose _trainer backref reaches the kvstore's
+        sockets, and per-param lr/wd multipliers are a worker-side
+        concern (the reference's __getstate__ does the same,
+        python/mxnet/optimizer.py)."""
+        state = self.__dict__.copy()
+        state["param_dict"] = {}
+        return state
+
     # -- registry (reference: Optimizer.register / create_optimizer) --------
     @staticmethod
     def register(klass):

@@ -296,3 +296,14 @@ def test_dist_async_kvstore_4_workers_2_servers():
                      launcher_args=("-s", "2"))
     for r in range(4):
         assert "rank %d/4 OK" % r in stdout
+
+
+def test_dist_async_mnist_example_cli():
+    """The reference CLI shape end to end: the stock train_mnist example
+    with --kv-store dist_async under launch.py -n 2 -s 1 (reference:
+    example/image-classification trains with --kv-store dist_async via
+    common/fit.py)."""
+    _launch(2, "examples/image_classification/train_mnist.py",
+            "--synthetic", "--kv-store", "dist_async",
+            "--num-epochs", "1", "--num-examples", "2000",
+            launcher_args=("-s", "1"))

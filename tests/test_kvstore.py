@@ -245,3 +245,118 @@ def test_dist_async_without_servers_raises(monkeypatch):
     monkeypatch.delenv("MXT_SERVER_URIS", raising=False)
     with pytest.raises(Exception, match="launch"):
         mx.kv.create('dist_async')
+
+
+def test_gluon_trainer_dist_async(monkeypatch):
+    """gluon Trainer over kvstore dist_async = true update-on-kvstore:
+    the optimizer runs server-side, step() pushes grads and pulls the
+    updated weights (reference trainer.py:148 dist path)."""
+    import mxnet_tpu.gluon as gluon
+    from mxnet_tpu import autograd
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+
+        net = gluon.nn.Dense(1, use_bias=False, in_units=3)
+        net.initialize()
+        x = mx.nd.ones((2, 3))
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        w0 = net.weight.data().asnumpy().copy()
+        g = net.weight.grad().asnumpy().copy()
+
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1, 'momentum': 0.0,
+                            'wd': 0.0}, kvstore='dist_async')
+        tr.step(batch_size=2)
+        assert tr._update_on_kvstore
+        # server applied w -= lr * (grad / batch); pull wrote it back
+        np.testing.assert_allclose(
+            net.weight.data().asnumpy(), w0 - 0.1 * (g / 2), rtol=1e-5)
+
+        # second step keeps flowing through the server
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        w1 = net.weight.data().asnumpy().copy()
+        g1 = net.weight.grad().asnumpy().copy()
+        tr.step(batch_size=2)
+        np.testing.assert_allclose(
+            net.weight.data().asnumpy(), w1 - 0.1 * (g1 / 2), rtol=1e-5)
+        tr._kvstore.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_gluon_trainer_dist_async_states_and_init_pull(monkeypatch):
+    """The server is authoritative: init pulls its weights back before
+    the first step, and optimizer states checkpoint FROM the servers
+    (worker-side updater state is empty in this mode)."""
+    import pickle as _pkl
+    import mxnet_tpu.gluon as gluon
+    from mxnet_tpu import autograd
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+
+        # pre-seed the server: its value must win over the local init
+        kv_seed = mx.kv.create('dist_async')
+        kv_seed.init('dense0_weight', mx.nd.ones((1, 3)) * 7)
+        kv_seed.close()
+
+        net = gluon.nn.Dense(1, use_bias=False, in_units=3,
+                             prefix='dense0_')
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1, 'momentum': 0.9,
+                            'wd': 0.0}, kvstore='dist_async')
+        x = mx.nd.ones((2, 3))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        # grad was computed against the LOCAL init (the pull to the
+        # authoritative server weights happens inside the first step)
+        g = net.weight.grad().asnumpy().copy()
+        tr.step(batch_size=2)
+        # weights came from the server's authoritative 7s, not local
+        # init: first momentum step applies w' = 7 - lr * (g / batch)
+        np.testing.assert_allclose(net.weight.data().asnumpy(),
+                                   7 - 0.1 * (g / 2), rtol=1e-4)
+
+        # states round-trip through the server
+        import tempfile, os as _os
+        fd, fname = tempfile.mkstemp()
+        _os.close(fd)
+        try:
+            tr.save_states(fname)
+            with open(fname, 'rb') as f:
+                states = _pkl.loads(f.read())
+            assert 'dense0_weight' in states  # momentum lives server-side
+            tr.load_states(fname)
+        finally:
+            _os.unlink(fname)
+
+        # hyperparameter drift after the first step warns (pickle-time
+        # snapshot semantics)
+        tr.set_learning_rate(0.01)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        import warnings as _w
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            tr.step(batch_size=2)
+        assert any("pickle-time snapshot" in str(r.message) for r in rec)
+        tr._kvstore.close(stop_servers=True)
+    finally:
+        srv.stop()
