@@ -104,8 +104,11 @@ class Trainer:
             self._kv_opt_snapshot = (self._optimizer.lr,
                                      self._optimizer.rescale_grad)
             self._kv_param_inited = set()
-            inited = [p for p in self._params
-                      if p.grad_req != 'null' and p._data is not None]
+            # ALL materialized params — including frozen (grad_req
+            # 'null') ones — sync to the server-authoritative value, so
+            # every worker trains against the same frozen weights
+            # (reference: _initialize_kvstore registers every param)
+            inited = [p for p in self._params if p._data is not None]
             for param in inited:
                 self._kvstore.init(param.name, param.data())
                 self._kv_param_inited.add(param.name)
@@ -346,17 +349,21 @@ class Trainer:
     def save_states(self, fname):
         """reference: trainer.py save_states.  Under dist_async the
         optimizer states LIVE on the servers — fetch them from there
-        (worker-side updater states would be an empty dict)."""
-        if getattr(self, "_update_on_kvstore", False):
+        (worker-side updater states would be an empty dict).  The store
+        is created here if needed so a pre-first-step call routes
+        correctly (resume-from-checkpoint pattern)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
             return
         with open(fname, 'wb') as fout:
             fout.write(self._updaters[0].get_states())
 
     def load_states(self, fname):
-        if getattr(self, "_update_on_kvstore", False):
-            if not self._kv_initialized:
-                self._init_kvstore()
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
             return
         with open(fname, 'rb') as fin:

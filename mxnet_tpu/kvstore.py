@@ -56,6 +56,23 @@ def _key(k):
     return str(k)
 
 
+def _write_row_sparse_out(outs, rows, idx, full_shape):
+    """Write gathered rows into out array(s): a RowSparseNDArray is
+    re-armed in place with values+indices (no dense materialization), a
+    dense out gets the scatter fallback.  Shared by the local store and
+    the dist_async worker so the out-array semantics can't diverge."""
+    import jax.numpy as jnp
+    from .ndarray.sparse import RowSparseNDArray
+    jidx = jnp.asarray(idx, dtype=jnp.int64)
+    for o in outs:
+        if isinstance(o, RowSparseNDArray):
+            RowSparseNDArray.__init__(
+                o, NDArray(rows), NDArray(jidx), tuple(full_shape))
+        else:
+            o._set_data(jnp.zeros(tuple(full_shape),
+                                  rows.dtype).at[jidx].set(rows))
+
+
 class KVStore:
     """Single-process store (reference: KVStoreLocal, kvstore_local.h)."""
 
@@ -142,7 +159,6 @@ class KVStore:
         ``out`` receives values+indices with NO dense materialization; a
         dense ``out`` gets the scatter fallback.
         """
-        from .ndarray.sparse import RowSparseNDArray
         assert out is not None and row_ids is not None
         keys, outs = self._canon(key, out)
         if isinstance(row_ids, NDArray):
@@ -151,20 +167,10 @@ class KVStore:
             src = self._store[k]
             # dedup row ids (reference: PullRowSparseImpl dedups before
             # gathering) — duplicates would double-count in the rsp view
-            idx = jnp.asarray(
-                np.unique(np.asarray(rid.asnumpy(), dtype=np.int64)),
-                dtype=jnp.int32)
-            rows = jnp.take(src._data, idx, axis=0)
-            for o in os_:
-                if isinstance(o, RowSparseNDArray):
-                    # re-arm in place with the gathered rows (O(rows))
-                    RowSparseNDArray.__init__(
-                        o, NDArray(rows), NDArray(idx.astype(jnp.int64)),
-                        tuple(src.shape))
-                else:
-                    # dense out: scatter fallback
-                    o._set_data(
-                        jnp.zeros_like(src._data).at[idx].set(rows))
+            idx = np.unique(np.asarray(rid.asnumpy(), dtype=np.int64))
+            rows = jnp.take(src._data, jnp.asarray(idx, dtype=jnp.int32),
+                            axis=0)
+            _write_row_sparse_out(os_, rows, idx, src.shape)
 
     # -- optimizer ------------------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -363,8 +369,10 @@ class _ServerConn:
 
     def flush(self):
         """Return once every previously-enqueued op has been acked by the
-        server (FIFO: a synchronous no-op command drains the queue)."""
-        self.submit(("command", -1, None), wait=True)
+        server (FIFO: a synchronous no-op command drains the queue).
+        kSyncMode is the no-op of the async server (kvstore_server.py)."""
+        from .kvstore_server import K_SYNC_MODE
+        self.submit(("command", K_SYNC_MODE, None), wait=True)
 
     def close(self):
         # drain before closing: a still-queued fire-and-forget push must
@@ -469,9 +477,25 @@ class KVStoreDistAsync(KVStore):
                             if o._data.dtype != val.dtype else val)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        raise MXNetError(
-            "row_sparse_pull over dist_async is not implemented; use "
-            "dist_sync for row-sparse training (docs/design/kvstore.md)")
+        """Pull only the requested rows from the owning server — O(rows)
+        on the wire (reference: DataHandleRowSparse,
+        kvstore_dist_server.h:211).  Same out-array semantics as the
+        local store: RowSparseNDArray gets values+indices, dense gets a
+        scatter.  Requests pipeline like pull."""
+        import jax.numpy as jnp
+        assert out is not None and row_ids is not None
+        keys, outs = self._canon(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        reqs = []
+        for k, rid in zip(keys, row_ids):
+            idx = np.unique(np.asarray(rid.asnumpy(), dtype=np.int64))
+            reqs.append((idx,
+                         self._conn_of(k).request(("pull_rows", k, idx))))
+        for (idx, pending), os_ in zip(reqs, outs):
+            rows_np, full_shape = _await(pending)
+            _write_row_sparse_out(os_, jnp.asarray(rows_np), idx,
+                                  full_shape)
 
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the servers (reference kvstore.py:353:
@@ -491,17 +515,24 @@ class KVStoreDistAsync(KVStore):
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         """Gather each server shard's {key: state} dict and persist the
-        union (the states LIVE on the servers in this mode — reference:
-        kvstore_dist_server.h:131 server-side optimizer)."""
-        merged = {}
+        union, with the optimizer itself when dump_optimizer (same blob
+        format as Updater.get_states — the states LIVE on the servers in
+        this mode; reference: kvstore_dist_server.h:131)."""
+        merged, opt_obj = {}, None
         for c in self._conns:
-            blob = c.submit(("get_states",), wait=True)
+            blob = c.submit(("get_states", dump_optimizer), wait=True)
             if blob is None:
                 raise MXNetError("there is no optimizer installed on the "
                                  "servers (set_optimizer first)")
-            merged.update(pickle.loads(blob))
+            loaded = pickle.loads(blob)
+            if dump_optimizer:
+                states, opt_obj = loaded  # identical snapshot per server
+                merged.update(states)
+            else:
+                merged.update(loaded)
         with open(fname, 'wb') as fout:
-            fout.write(pickle.dumps(merged))
+            fout.write(pickle.dumps((merged, opt_obj) if dump_optimizer
+                                    else merged))
 
     def load_optimizer_states(self, fname):
         """Broadcast the saved union to every server; each shard applies

@@ -41,12 +41,15 @@ import threading
 
 import numpy as np
 
-# reference command codes (kvstore_dist_server.h:40-45 ``CommandType``):
-# kController=0 carries a pickled optimizer; kStopServer=1 tears down;
-# kSyncMode=2 is meaningless here (this server IS the async mode).
+# reference command codes (kvstore_dist_server.h:44-45): kStopServer=-1
+# tears down, kSyncMode=-2 switches the reference server to sync
+# aggregation (a documented no-op here — this server IS the async mode,
+# and doubles as the channel-flush sync token), and any head >= 0 routes
+# to the controller (CommandHandle :150-162), where head 0 carries the
+# pickled optimizer (python/mxnet/kvstore.py set_optimizer).
 K_CONTROLLER = 0
-K_STOP_SERVER = 1
-K_SYNC_MODE = 2
+K_STOP_SERVER = -1
+K_SYNC_MODE = -2
 
 
 def _send_msg(sock, obj):
@@ -136,13 +139,26 @@ class KVStoreServer:
                 if stored is None:
                     raise KeyError(f"pull of uninitialized key {key!r}")
                 return np.asarray(stored.asnumpy())
+        if op == "pull_rows":
+            # O(requested rows) row-sparse pull (reference:
+            # DataHandleRowSparse, kvstore_dist_server.h:211 — only the
+            # requested rows travel)
+            _, key, ids = msg
+            with self._lock:
+                stored = self._store.get(key)
+                if stored is None:
+                    raise KeyError(f"pull of uninitialized key {key!r}")
+                full = np.asarray(stored.asnumpy())
+                return full[ids], full.shape
         if op == "get_states":
             # optimizer-state checkpointing: this shard's {key: state}
-            # dict (reference: server-side optimizer states live in the
-            # server, kvstore_dist_server.h:131)
+            # dict, optionally with the optimizer itself (reference:
+            # server-side optimizer states live in the server,
+            # kvstore_dist_server.h:131)
+            dump = bool(msg[1]) if len(msg) > 1 else False
             with self._lock:
                 return None if self._updater is None \
-                    else self._updater.get_states(dump_optimizer=False)
+                    else self._updater.get_states(dump_optimizer=dump)
         if op == "set_states":
             _, blob = msg
             with self._lock:
@@ -161,15 +177,15 @@ class KVStoreServer:
 
     def _command(self, head, body):
         """reference kvstore_dist_server.h:149-162 ``CommandHandle``."""
-        if head == K_CONTROLLER:
-            from . import optimizer as opt
-            with self._lock:
-                self._updater = opt.get_updater(pickle.loads(body))
-            return None
         if head == K_STOP_SERVER:
             self._stop.set()
             with self._barrier_cv:
                 self._barrier_cv.notify_all()
+            return None
+        if head == K_CONTROLLER:
+            from . import optimizer as opt
+            with self._lock:
+                self._updater = opt.get_updater(pickle.loads(body))
             return None
         return None  # kSyncMode etc.: accepted, no-op in the async server
 

@@ -360,3 +360,39 @@ def test_gluon_trainer_dist_async_states_and_init_pull(monkeypatch):
         tr._kvstore.close(stop_servers=True)
     finally:
         srv.stop()
+
+
+def test_dist_async_row_sparse_pull(monkeypatch):
+    """row_sparse_pull over the async server: only the requested rows
+    travel (reference DataHandleRowSparse, kvstore_dist_server.h:211)."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        kv = mx.kv.create('dist_async')
+        full = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init('emb', mx.nd.NDArray(full))
+
+        rid = mx.nd.NDArray(np.array([7, 2, 2, 5], dtype=np.int64))
+        # dense out: scatter of just those rows
+        dense = mx.nd.zeros((10, 4))
+        kv.row_sparse_pull('emb', out=dense, row_ids=rid)
+        want = np.zeros_like(full)
+        for r in (2, 5, 7):
+            want[r] = full[r]
+        np.testing.assert_array_equal(dense.asnumpy(), want)
+
+        # row-sparse out: values+indices, deduped and sorted
+        rsp = mx.nd.sparse.zeros('row_sparse', (10, 4))
+        kv.row_sparse_pull('emb', out=rsp, row_ids=rid)
+        assert isinstance(rsp, RowSparseNDArray)
+        np.testing.assert_array_equal(rsp.indices.asnumpy(), [2, 5, 7])
+        np.testing.assert_array_equal(rsp.data.asnumpy(),
+                                      full[[2, 5, 7]])
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
