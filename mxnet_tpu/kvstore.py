@@ -189,7 +189,8 @@ class KVStore:
         _dist.barrier("mxnet_tpu_kvstore_barrier")
 
     def _send_command_to_servers(self, head, body):
-        pass  # no server processes exist in the TPU design
+        pass  # sync/allreduce types have no server processes
+        # (KVStoreDistAsync overrides this with a real send)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:

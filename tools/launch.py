@@ -3,10 +3,11 @@
 
 Reference semantics: ``launch.py -n W [-s S] cmd...`` starts a tracker
 that spawns scheduler + S servers + W workers with ``DMLC_*`` env vars
-(reference tools/launch.py:64-80).  The TPU-native design has no servers
-or scheduler — every process is an SPMD worker — so this launcher spawns
-W local worker processes wired to a jax.distributed coordination service
-through the same DMLC-shaped env vars (read by
+(reference tools/launch.py:64-80).  Here there is no scheduler — sync
+jobs are pure SPMD workers over a jax.distributed coordination service,
+and ``-s`` (when given) spawns REAL async parameter-server processes for
+kvstore ``dist_async`` (see ``_server_env``).  Workers are wired through
+the same DMLC-shaped env vars (read by
 ``mxnet_tpu.distributed.initialize``):
 
     DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT   coordinator host:port
