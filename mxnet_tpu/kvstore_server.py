@@ -97,6 +97,7 @@ class KVStoreServer:
         self._listener.settimeout(0.5)
         self.port = self._listener.getsockname()[1]
         self._threads = []
+        self._conns = []
 
     # -- request handlers ----------------------------------------------------
     def _apply_push(self, key, arr):
@@ -233,6 +234,7 @@ class KVStoreServer:
                                      daemon=True)
                 t.start()
                 self._threads.append(t)
+                self._conns.append(conn)
         finally:
             self._listener.close()
 
@@ -240,6 +242,16 @@ class KVStoreServer:
         self._stop.set()
         with self._barrier_cv:
             self._barrier_cv.notify_all()
+        # close live connections too: a handler blocked in _recv_msg only
+        # re-checks _stop after servicing a request, so without this a
+        # "stopped" server still answers one more op per connection —
+        # clients must see EOF promptly (and the crash-simulation tests
+        # rely on exactly that)
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def start_background(self):
         """Run the accept loop in a daemon thread (in-process tests)."""

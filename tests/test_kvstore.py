@@ -396,3 +396,34 @@ def test_dist_async_row_sparse_pull(monkeypatch):
         kv.close(stop_servers=True)
     finally:
         srv.stop()
+
+
+def test_dist_async_server_death_surfaces_as_error(monkeypatch):
+    """A dead server must surface as a clear MXNetError on the next op —
+    never a silent hang (the launcher's fail-fast covers the process
+    level; this covers the channel level)."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    from mxnet_tpu.base import MXNetError
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('a', mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('a', out=out)
+        # simulate a server crash: stop() closes the listener's live
+        # connections, so the worker channel sees EOF promptly
+        srv.stop()
+        import time
+        deadline = time.time() + 30
+        with pytest.raises(MXNetError):
+            # the first post-crash pull should already raise (EOF on the
+            # closed conn); the loop only guards scheduler timing
+            while time.time() < deadline:
+                kv.pull('a', out=out)
+        kv.close()
+    finally:
+        srv.stop()
