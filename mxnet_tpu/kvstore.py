@@ -415,9 +415,17 @@ class KVStoreDistAsync(KVStore):
 
     Keys are routed to servers by ``crc32(key) % num_servers`` — the
     deterministic key→server partition that replaces the reference's
-    ``EncodeKey``/PSKV round-robin (kvstore_dist.h:60).  Big-array
-    striping across servers (MXNET_KVSTORE_BIGARRAY_BOUND) is not
-    implemented: one server owns each whole key (documented departure).
+    ``EncodeKey``/PSKV round-robin (kvstore_dist.h:60).
+
+    Arrays above ``MXNET_KVSTORE_BIGARRAY_BOUND`` elements (default
+    1e6, the reference's default, kvstore_dist.h:60) are STRIPED
+    row-wise across all servers: each stripe is its own server-side key
+    (``<key>@s<i>``), so pushes/pulls of big tensors serialize and
+    apply in parallel on every server (reference: PSKV slices big
+    arrays across servers).  Server-side optimizer state is then
+    per-stripe — identical math for elementwise optimizers (SGD/Adam/
+    …); per-LAYER optimizers (LARS/LAMB trust ratios) see per-stripe
+    norms instead, exactly the reference's striping caveat.
     """
 
     def __init__(self):
@@ -430,6 +438,9 @@ class KVStoreDistAsync(KVStore):
                 "(MXT_SERVER_URIS is set by the launcher) — see "
                 "docs/design/kvstore.md")
         self._conns = [_ServerConn(u) for u in uris.split(",")]
+        self._bigarray_bound = int(float(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")))
+        self._stripes: Dict[str, list] = {}  # key -> row boundaries
 
     # -- identity (no jax.distributed needed: workers are independent) ------
     @property
@@ -443,6 +454,31 @@ class KVStoreDistAsync(KVStore):
     def _conn_of(self, k: str) -> _ServerConn:
         return self._conns[zlib.crc32(k.encode()) % len(self._conns)]
 
+    # -- big-array striping --------------------------------------------------
+    def _stripe_plan(self, k: str, shape):
+        """Row boundaries for a striped key, or None.  Deterministic from
+        (key, shape, num_servers), so every worker computes the identical
+        plan with no coordination."""
+        if k in self._stripes:
+            return self._stripes[k]
+        n = len(self._conns)
+        if (n <= 1 or not shape or len(shape) == 0
+                or int(np.prod(shape)) <= self._bigarray_bound
+                or shape[0] < 2):
+            plan = None
+        else:
+            parts = min(n, shape[0])
+            bounds = [shape[0] * i // parts for i in range(parts + 1)]
+            plan = bounds
+        self._stripes[k] = plan
+        return plan
+
+    def _stripe_conn(self, k: str, i: int) -> _ServerConn:
+        # consecutive stripes land on consecutive servers, offset by the
+        # key hash so different big keys don't all start at server 0
+        base = zlib.crc32(k.encode())
+        return self._conns[(base + i) % len(self._conns)]
+
     # -- kv ops --------------------------------------------------------------
     def init(self, key, value):
         """First-arriving init wins at the server (all workers call init;
@@ -450,15 +486,32 @@ class KVStoreDistAsync(KVStore):
         keys, values = self._canon(key, value)
         for k, vs in zip(keys, values):
             arr = np.asarray(vs[0].asnumpy())
-            self._conn_of(k).submit(("init", k, arr), wait=True)
+            plan = self._stripe_plan(k, arr.shape)
+            if plan is None:
+                self._conn_of(k).submit(("init", k, arr), wait=True)
+            else:
+                pendings = [
+                    self._stripe_conn(k, i).request(
+                        ("init", f"{k}@s{i}", arr[plan[i]:plan[i + 1]]))
+                    for i in range(len(plan) - 1)]
+                for p in pendings:
+                    _await(p)
 
     def push(self, key, value, priority=0):
         """Locally reduce, then hand to the channel — returns immediately;
-        the server applies the update when the push arrives (async SGD)."""
+        the server applies the update when the push arrives (async SGD).
+        Striped keys push one row-slice per server, in parallel."""
         keys, values = self._canon(key, value)
         for k, vs in zip(keys, values):
             agg = np.asarray(self._reduce(vs))
-            self._conn_of(k).submit(("push", k, agg), wait=False)
+            plan = self._stripe_plan(k, agg.shape)
+            if plan is None:
+                self._conn_of(k).submit(("push", k, agg), wait=False)
+            else:
+                for i in range(len(plan) - 1):
+                    self._stripe_conn(k, i).submit(
+                        ("push", f"{k}@s{i}", agg[plan[i]:plan[i + 1]]),
+                        wait=False)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Fetch the server's CURRENT weight — possibly mid-stream of other
@@ -466,13 +519,28 @@ class KVStoreDistAsync(KVStore):
 
         All requests are enqueued before any reply is awaited, so an
         N-key pull over S servers costs ~max-RTT, not N round trips
-        (the reference gets the same overlap from engine-async ZPull)."""
+        (the reference gets the same overlap from engine-async ZPull);
+        striped keys fetch every row-slice concurrently."""
         import jax.numpy as jnp
         assert out is not None
         keys, outs = self._canon(key, out)
-        pendings = [self._conn_of(k).request(("pull", k)) for k in keys]
+        pendings = []
+        for k, os_ in zip(keys, outs):
+            # the plan is deterministic from (key, shape): a client that
+            # never init'ed this key derives it from the out array
+            plan = self._stripe_plan(k, tuple(os_[0].shape))
+            if plan is None:
+                pendings.append(self._conn_of(k).request(("pull", k)))
+            else:
+                pendings.append([
+                    self._stripe_conn(k, i).request(("pull", f"{k}@s{i}"))
+                    for i in range(len(plan) - 1)])
         for k, os_, pending in zip(keys, outs, pendings):
-            val = jnp.asarray(_await(pending))
+            if isinstance(pending, list):
+                val = jnp.concatenate(
+                    [jnp.asarray(_await(p)) for p in pending], axis=0)
+            else:
+                val = jnp.asarray(_await(pending))
             for o in os_:
                 o._set_data(val.astype(o._data.dtype)
                             if o._data.dtype != val.dtype else val)
@@ -489,14 +557,44 @@ class KVStoreDistAsync(KVStore):
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
         reqs = []
-        for k, rid in zip(keys, row_ids):
+        for k, os_, rid in zip(keys, outs, row_ids):
             idx = np.unique(np.asarray(rid.asnumpy(), dtype=np.int64))
-            reqs.append((idx,
-                         self._conn_of(k).request(("pull_rows", k, idx))))
+            # out (dense or row-sparse) carries the full logical shape, so
+            # a fresh client derives the stripe plan just like pull()
+            plan = self._stripe_plan(k, tuple(os_[0].shape))
+            if plan is not None and idx.size and (
+                    idx[0] < 0 or idx[-1] >= plan[-1]):
+                raise MXNetError(
+                    f"row id out of range for key {k!r}: ids span "
+                    f"[{idx[0]}, {idx[-1]}], key has {plan[-1]} rows")
+            if plan is None:
+                reqs.append((idx, self._conn_of(k).request(
+                    ("pull_rows", k, idx))))
+            else:
+                # route each global row id to its stripe; stripes are
+                # contiguous and idx is sorted, so concatenating the
+                # per-stripe replies in stripe order realigns with idx
+                stripe_of = np.searchsorted(plan, idx, side="right") - 1
+                parts = []
+                for i in range(len(plan) - 1):
+                    local = idx[stripe_of == i] - plan[i]
+                    if local.size or (i == 0 and not idx.size):
+                        # the empty-idx degenerate still needs one reply
+                        # to learn the row tail shape
+                        parts.append(self._stripe_conn(k, i).request(
+                            ("pull_rows", f"{k}@s{i}", local)))
+                reqs.append((idx, (plan, parts)))
         for (idx, pending), os_ in zip(reqs, outs):
-            rows_np, full_shape = _await(pending)
-            _write_row_sparse_out(os_, jnp.asarray(rows_np), idx,
-                                  full_shape)
+            if isinstance(pending, tuple):
+                plan, parts = pending
+                replies = [_await(p) for p in parts]
+                rows = jnp.concatenate(
+                    [jnp.asarray(r) for r, _shape in replies], axis=0)
+                full_shape = (plan[-1],) + tuple(replies[0][1][1:])
+            else:
+                rows_np, full_shape = _await(pending)
+                rows = jnp.asarray(rows_np)
+            _write_row_sparse_out(os_, rows, idx, full_shape)
 
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the servers (reference kvstore.py:353:

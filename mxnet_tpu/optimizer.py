@@ -254,11 +254,27 @@ class Optimizer:
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
 
+    @staticmethod
+    def _mult_index(index):
+        """Multiplier-lookup key for ``index``.  A kvstore dist_async
+        big-array stripe arrives as ``<key>@s<i>`` (kvstore.py striping)
+        — per-stripe STATE needs the full index, but lr/wd multipliers
+        belong to the underlying parameter, so strip the transport
+        suffix before the lookup."""
+        if isinstance(index, str) and "@s" in index:
+            base = index.rsplit("@s", 1)[0]
+            try:
+                return int(base)
+            except ValueError:
+                return base
+        return index
+
     def _get_lr(self, index):
         if self.lr_scheduler is not None:
             lr = self.lr_scheduler(self.num_update)
         else:
             lr = self.lr
+        index = self._mult_index(index)
         if index in self.param_dict:
             lr *= self.param_dict[index].lr_mult
         elif index in self.lr_mult:
@@ -269,6 +285,7 @@ class Optimizer:
 
     def _get_wd(self, index):
         wd = self.wd
+        index = self._mult_index(index)
         if index in self.param_dict:
             wd *= self.param_dict[index].wd_mult
         elif index in self.wd_mult:

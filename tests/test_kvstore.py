@@ -427,3 +427,89 @@ def test_dist_async_server_death_surfaces_as_error(monkeypatch):
         kv.close()
     finally:
         srv.stop()
+
+
+def test_dist_async_bigarray_striping(monkeypatch):
+    """Arrays above MXNET_KVSTORE_BIGARRAY_BOUND stripe row-wise across
+    ALL servers (reference: PSKV big-array slicing, kvstore_dist.h:60):
+    each stripe is its own server-side key, small keys stay whole."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srvs = [KVStoreServer(server_id=i, num_workers=1) for i in range(2)]
+    for s in srvs:
+        s.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", ",".join(
+            f"127.0.0.1:{s.port}" for s in srvs))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+        kv = mx.kv.create('dist_async')
+
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)  # 40 > 16
+        kv.init('big', mx.nd.NDArray(big))
+        # each server holds exactly one stripe, neither the whole key
+        stripe_counts = [len(s._store) for s in srvs]
+        assert stripe_counts == [1, 1], stripe_counts
+        assert all('@s' in next(iter(s._store)) for s in srvs)
+
+        out = mx.nd.zeros((10, 4))
+        kv.pull('big', out=out)
+        np.testing.assert_array_equal(out.asnumpy(), big)
+
+        # assign-semantics push reassembles exactly
+        kv.push('big', mx.nd.NDArray(big * 3))
+        kv.pull('big', out=out)
+        np.testing.assert_array_equal(out.asnumpy(), big * 3)
+
+        # SGD applies per-stripe with identical elementwise math
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.0,
+                                          wd=0.0, rescale_grad=1.0))
+        kv.push('big', mx.nd.ones((10, 4)))
+        kv.pull('big', out=out)
+        np.testing.assert_allclose(out.asnumpy(), big * 3 - 0.5, rtol=1e-6)
+
+        # row_sparse_pull routes ids to the owning stripes
+        want = big * 3 - 0.5
+        rid = mx.nd.NDArray(np.array([9, 0, 3], dtype=np.int64))
+        rsp = mx.nd.sparse.zeros('row_sparse', (10, 4))
+        kv.row_sparse_pull('big', out=rsp, row_ids=rid)
+        np.testing.assert_array_equal(rsp.indices.asnumpy(), [0, 3, 9])
+        np.testing.assert_allclose(rsp.data.asnumpy(), want[[0, 3, 9]],
+                                   rtol=1e-6)
+
+        # a fresh client that never init'ed derives the plan from out —
+        # for dense pull AND row_sparse_pull
+        kv2 = mx.kv.create('dist_async')
+        out2 = mx.nd.zeros((10, 4))
+        kv2.pull('big', out=out2)
+        np.testing.assert_allclose(out2.asnumpy(), want, rtol=1e-6)
+        rsp2 = mx.nd.sparse.zeros('row_sparse', (10, 4))
+        kv2.row_sparse_pull('big', out=rsp2, row_ids=mx.nd.NDArray(
+            np.array([8], dtype=np.int64)))
+        np.testing.assert_allclose(rsp2.data.asnumpy(), want[[8]],
+                                   rtol=1e-6)
+        kv2.close()
+
+        # out-of-range row ids fail loudly, like the unstriped path
+        from mxnet_tpu.base import MXNetError
+        with pytest.raises(MXNetError, match="out of range"):
+            kv.row_sparse_pull('big', out=mx.nd.zeros((10, 4)),
+                               row_ids=mx.nd.NDArray(
+                                   np.array([0, 10], dtype=np.int64)))
+
+        # per-param lr_mult keys by the BASE key, not the stripe key
+        opt2 = mx.optimizer.SGD(learning_rate=1.0, momentum=0.0, wd=0.0,
+                                rescale_grad=1.0)
+        opt2.set_lr_mult({'big': 0.0})   # freeze via multiplier
+        kv.set_optimizer(opt2)
+        kv.push('big', mx.nd.ones((10, 4)))
+        kv.pull('big', out=out)
+        np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+        # small keys stay whole (below bound)
+        kv.init('small', mx.nd.ones((2, 2)))
+        kv.pull('small', out=mx.nd.zeros((2, 2)))
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
