@@ -58,6 +58,13 @@ DTYPE = os.environ.get("BENCH_DTYPE", _DEF.get("dtype", "bfloat16"))
 OPT = os.environ.get("BENCH_OPT", _DEF.get("opt", "sgd"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+# Steps fused into ONE dispatch via Module.run_steps (lax.scan over the
+# fused step).  K>1 amortizes the ~12 ms/step host dispatch through the
+# tunnel (docs/PERF_NOTES.md) to 1/K per step — the lever that makes the
+# multi-step driver's win measurable on a chip.  1 = classic per-step
+# dispatch (forward+update per batch).
+STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL",
+                                    _DEF.get("steps_per_call", 1)))
 # TPU-native stem variant (space-to-depth, mathematically equivalent —
 # models/resnet.py space_to_depth_stem_weight) and rematerialization.
 # BENCH_REMAT: 0 (off), 1/full (whole-step recompute), save_matmuls
@@ -210,6 +217,7 @@ def _run(batch):
     # (a 256x3x224x224 fp32 batch is 154 MB; pushing it through a
     # remote-attached chip's tunnel would measure the tunnel, not the chip)
     batches = []
+    super_batches = []   # (k, batch, ...) stacks for STEPS_PER_CALL > 1
     if os.environ.get("BENCH_DATA", "synthetic") != "record":
         for seed in (0, 1):
             k = jax.random.PRNGKey(seed)
@@ -221,6 +229,17 @@ def _run(batch):
             bx.wait_to_read()
             by.wait_to_read()
             batches.append(mx.io.DataBatch(data=[bx], label=[by]))
+        if STEPS_PER_CALL > 1:
+            # K distinct per-step batches stacked on device (tiling the
+            # two base batches — rotation inside the scan, like the
+            # K=1 loop rotates across calls)
+            for s in (0, 1):
+                bx = jnp.stack([batches[(s + j) % 2].data[0]._data
+                                for j in range(STEPS_PER_CALL)])
+                by = jnp.stack([batches[(s + j) % 2].label[0]._data
+                                for j in range(STEPS_PER_CALL)])
+                bx.block_until_ready()
+                super_batches.append((bx, by))
 
     # real-data mode (BENCH_DATA=record): batches come from a raw-uint8
     # ImageRecordUInt8Iter on disk through the full host pipeline — read,
@@ -253,16 +272,33 @@ def _run(batch):
 
         nhwc_feed = real_iter.provide_data[0].shape[-1] == 3
 
+        if STEPS_PER_CALL > 1:
+            def step(i):
+                # K host batches -> ONE stacked uint8 transfer -> device
+                # layout/cast -> ONE scanned dispatch for all K steps
+                datas, labels = zip(*[feed_q.get()
+                                      for _ in range(STEPS_PER_CALL)])
+                dx = jnp.asarray(np.stack(datas))    # uint8, one transfer
+                if nhwc_feed:                        # (k,n,H,W,C)->(k,n,C,H,W)
+                    dx = jnp.transpose(dx, (0, 1, 4, 2, 3))
+                mod.run_steps(dx.astype(jnp.float32),
+                              jnp.asarray(np.stack(labels)),
+                              k=STEPS_PER_CALL)
+        else:
+            def step(i):
+                data, label = feed_q.get()
+                dx = jnp.asarray(data)           # uint8, one transfer
+                if nhwc_feed:                    # device-side NHWC->NCHW
+                    dx = jnp.transpose(dx, (0, 3, 1, 2))
+                bx = mx.nd.NDArray(dx.astype(jnp.float32))  # cast on device
+                by = mx.nd.NDArray(jnp.asarray(label))
+                mod.forward(mx.io.DataBatch(data=[bx], label=[by]),
+                            is_train=True)
+                mod.update()
+    elif STEPS_PER_CALL > 1:
         def step(i):
-            data, label = feed_q.get()
-            dx = jnp.asarray(data)           # uint8, one transfer
-            if nhwc_feed:                    # device-side NHWC->NCHW
-                dx = jnp.transpose(dx, (0, 3, 1, 2))
-            bx = mx.nd.NDArray(dx.astype(jnp.float32))   # cast on device
-            by = mx.nd.NDArray(jnp.asarray(label))
-            mod.forward(mx.io.DataBatch(data=[bx], label=[by]),
-                        is_train=True)
-            mod.update()
+            bx, by = super_batches[i % len(super_batches)]
+            mod.run_steps(bx, by, k=STEPS_PER_CALL)
     else:
         def step(i):
             b = batches[i % len(batches)]
@@ -339,7 +375,9 @@ def _run(batch):
     hard_sync()
     dt = time.perf_counter() - t0
 
-    step_s = dt / iters
+    # one step() call runs STEPS_PER_CALL training steps; report per
+    # TRAINING step so K=1 and K=8 rows compare directly
+    step_s = dt / iters / STEPS_PER_CALL
     imgs_per_sec = batch / step_s
     peak = _peak_flops(dev.device_kind)
     mfu = (flops_per_step / step_s / peak) if peak else None
@@ -360,6 +398,7 @@ def _run(batch):
         "layout": LAYOUT.lower(),
         "opt": OPT,
         "iters": iters,
+        "steps_per_call": STEPS_PER_CALL,
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
         "remat": (os.environ.get("MXNET_REMAT_POLICY", "full")

@@ -6,8 +6,20 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== unit + integration suite (8-device CPU mesh via tests/conftest.py)"
-# -m "" overrides pytest.ini's default "not slow": CI runs everything
-python -m pytest tests/ -q --durations=10 -m ""
+# -m "" overrides pytest.ini's default "not slow": CI runs everything.
+# test_run_steps.py is excluded here because the dedicated gate below
+# runs the whole file — double-running the heaviest new file buys no
+# coverage.
+python -m pytest tests/ -q --durations=10 -m "" \
+    --ignore=tests/test_run_steps.py
+
+echo "== tier-1: K-step scan == K eager steps (CPU bit-equivalence gate)"
+# The multi-step driver's correctness is provable WITHOUT a chip: the
+# scanned program must reproduce K eager fused steps bit-for-bit on the
+# CPU backend.  Kept as its own invocation so a pytest.ini / conftest
+# change can't silently drop it from the gate.
+# -m "" so the slow-marked equivalence variants run here too
+JAX_PLATFORMS=cpu python -m pytest tests/test_run_steps.py -q -m ""
 
 echo "== multichip dryrun (8 virtual devices)"
 JAX_PLATFORMS=cpu python - <<'PY'
@@ -19,11 +31,25 @@ print("dryrun_multichip(8) OK")
 PY
 
 echo "== bench smoke (CPU, tiny config; real numbers come from TPU runs)"
-BENCH_BATCH=8 BENCH_ITERS=2 BENCH_WARMUP=1 python - <<'PY'
+# The bench OUTPUT CONTRACT is part of the gate: exactly ONE JSON line on
+# stdout (sweep tooling and BENCH_LOG banking parse it) — a stray print
+# or a config that emits twice breaks every downstream consumer
+# (VERDICT r5 item b).  The K-step scanned dispatch mode
+# (BENCH_STEPS_PER_CALL) is gated separately by tests/test_run_steps.py:
+# compiling the SCANNED ResNet-50@224 program on the CI CPU takes tens
+# of minutes, so the bench smoke stays per-step here and the scan runs
+# on real chips.
+BENCH_BATCH=8 BENCH_ITERS=2 BENCH_WARMUP=1 python - <<'PY' | tee /tmp/_bench_smoke.out
 import cpu_pin
 cpu_pin.pin_cpu(8)
 import bench, sys
 sys.exit(bench.main())
 PY
+json_lines=$(grep -c '^{' /tmp/_bench_smoke.out || true)
+if [ "$json_lines" != "1" ]; then
+  echo "BENCH CONTRACT VIOLATION: expected exactly 1 JSON line on" \
+       "stdout, got $json_lines" >&2
+  exit 1
+fi
 
 echo "== CI green"

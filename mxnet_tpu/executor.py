@@ -17,7 +17,7 @@ segment execution.
 from __future__ import annotations
 
 import functools
-from contextlib import nullcontext as _nullcontext
+from contextlib import contextmanager, nullcontext as _nullcontext
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -174,6 +174,129 @@ def build_interpreter(sym: Symbol, compute_dtype=None):
     # remote-attached chip that is a per-step round-trip for nothing)
     run.needs_rng = bool(rng_ids)
     return run, arg_names, aux_names
+
+
+def build_multi_step(step_body, donate=True):
+    """Compile a single fused training step into a K-step ``lax.scan``
+    program — the multi-step driver shared by ``Module.run_steps`` and
+    ``gluon.Trainer.step_k`` (whole-program TPU execution à la Fischer &
+    Saba, arXiv:1810.09868: the host leaves the training loop entirely,
+    amortizing the per-dispatch host cost over K steps).
+
+    ``step_body(carry, x, const) -> (carry, y)`` is the pure single-step
+    function: ``carry`` holds everything that flows step-to-step (params,
+    aux/BN statistics, optimizer state), ``x`` holds the per-step inputs
+    scanned over their leading K axis (data, labels, per-step lr/wd/t,
+    RNG keys), and ``const`` holds step-invariant inputs (fixed params,
+    state inputs).  Returns a jitted ``fn(carry, xs, const) -> (carry,
+    ys)``; K is the leading dim of ``xs``, so the jit cache is keyed by
+    (K, shapes, carry structure) for free.  With ``donate`` the carry
+    buffers (params/aux/optimizer state) are donated — XLA updates them
+    in place in HBM across all K steps, exactly like the single fused
+    step does for one.
+    """
+    def k_steps(carry, xs, const):
+        def body(c, x):
+            return step_body(c, x, const)
+        return jax.lax.scan(body, carry, xs)
+
+    return jax.jit(k_steps, donate_argnums=(0,) if donate else ())
+
+
+# device buffers of the last schedule per optimizer (weak-keyed so a
+# dropped optimizer frees them): constant-lr training re-sends NOTHING
+# per dispatch — the K-step analog of Module._lrwd_cache's discipline
+# ("per-step host→device scalar transfers would dominate step latency
+# on a remote-attached chip")
+_SCHED_DEV_CACHE: "weakref.WeakKeyDictionary" = None  # lazy-inited
+
+
+def precompute_step_schedules(opt, keys, k):
+    """Advance an optimizer's HOST-side schedule state by K steps and
+    return the per-step hyperparameters as scan inputs — the shared
+    schedule leg of the multi-step driver (one implementation for
+    Module.run_steps and Trainer.step_k, so the two can never
+    de-synchronize).
+
+    For each of the K steps, ``opt._update_count`` advances for every
+    key (exactly as K eager updates would), then lr/wd are sampled —
+    cheap host float math, no device sync.  Returns ``(lrs, wds, ts)``,
+    each a tuple over ``keys`` of ``(k,)`` device arrays (``ts`` is the
+    per-key update count for needs_t optimizers, zeros otherwise).
+    Device buffers are cached per optimizer while the host values are
+    unchanged, so a constant schedule costs zero transfers per call."""
+    global _SCHED_DEV_CACHE
+    needs_t = getattr(opt, "needs_t", False)
+    n = len(keys)
+    lr = np.empty((k, n), np.float32)
+    wd = np.empty((k, n), np.float32)
+    ts = np.zeros((k, n), np.int32)
+    for j in range(k):
+        for col, key in enumerate(keys):
+            opt._update_count(key)
+            if needs_t:
+                ts[j, col] = opt._index_update_count[key]
+        lr[j] = [opt._get_lr(key) for key in keys]
+        wd[j] = [opt._get_wd(key) for key in keys]
+
+    if _SCHED_DEV_CACHE is None:
+        import weakref
+        _SCHED_DEV_CACHE = weakref.WeakKeyDictionary()
+    hkey = (tuple(keys), k, lr.tobytes(), wd.tobytes(), ts.tobytes())
+    cached = _SCHED_DEV_CACHE.get(opt)
+    if cached is not None and cached[0] == hkey:
+        return cached[1]
+
+    def cols(m):
+        return tuple(jnp.asarray(m[:, c]) for c in range(n))
+
+    result = (cols(lr), cols(wd), cols(ts))
+    _SCHED_DEV_CACHE[opt] = (hkey, result)
+    return result
+
+
+@contextmanager
+def schedule_rollback(opt):
+    """Undo an optimizer's host-side schedule advance if the guarded
+    block fails.  precompute_step_schedules moves update counts (and any
+    stateful lr scheduler) K steps ahead BEFORE the scan dispatch runs;
+    if the dispatch then raises (compile OOM, backend loss), the
+    schedules would be K steps ahead of the actual parameter state — and
+    drift further on every retry.  Wrap precompute+dispatch in this to
+    keep host schedule state transactional with the device step."""
+    counts = dict(opt._index_update_count)
+    num_update = opt.num_update
+    sched = opt.lr_scheduler
+    sched_state = dict(vars(sched)) if sched is not None else None
+    try:
+        yield
+    except BaseException:
+        opt._index_update_count = counts
+        opt.num_update = num_update
+        if sched is not None:
+            vars(sched).clear()
+            vars(sched).update(sched_state)
+        raise
+
+
+def make_lazy_outputs(avals, make_thunk):
+    """Allocate lazy output NDArrays fulfilled by ONE shared thunk.
+
+    ``make_thunk(outs)`` receives the fresh (uninitialized) arrays and
+    returns the thunk that will ``_set_data`` all of them on first read.
+    Single home for the NDArray internal-construction sequence shared by
+    Executor.forward and Module.run_steps' last-step outputs."""
+    from .ndarray import NDArray as _ND
+    outs = [_ND.__new__(_ND) for _ in avals]
+    thunk = make_thunk(outs)
+    for oa, av in zip(outs, avals):
+        oa._handle = object()
+        oa._ctx = None
+        oa._grad = None
+        oa._grad_req = "null"
+        oa._payload = None
+        oa._set_lazy(thunk, aval=av)
+    return outs
 
 
 def poison_stale(arr, what):
@@ -341,24 +464,15 @@ class Executor:
                     is_train)
         self._snapshot = snapshot
         out_avals = self._out_aval_list(is_train)
-        out_arrays = [NDArray.__new__(NDArray) for _ in out_avals]
+        out_arrays = make_lazy_outputs(
+            out_avals,
+            lambda outs: lambda: self._materialize(snapshot, outs))
         self._out_arrays = out_arrays
         import weakref
         self._issued_outs = [r for r in self._issued_outs
                              if (a := r()) is not None
                              and a._thunk is not None]
         self._issued_outs.extend(weakref.ref(a) for a in out_arrays)
-
-        def thunk():
-            self._materialize(snapshot, out_arrays)
-
-        for oa, av in zip(out_arrays, out_avals):
-            oa._handle = object()
-            oa._ctx = None
-            oa._grad = None
-            oa._grad_req = "null"
-            oa._payload = None
-            oa._set_lazy(thunk, aval=av)
         if self._monitor_callback is not None:
             self._materialize(snapshot, out_arrays, monitor=True)
         return self._out_arrays
@@ -454,6 +568,7 @@ class Executor:
                     cb(nm, NDArray(o))
         else:
             from . import profiler as _prof
+            _prof.record_dispatch("executor.forward")
             with _prof.scope("executor_forward", "symbolic"):
                 outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
                                               is_train)
@@ -508,6 +623,7 @@ class Executor:
                         if jnp.issubdtype(o.dtype, jnp.inexact)]
             cts = tuple(vals[i] for i in diff_idx)
         from . import profiler as _prof
+        _prof.record_dispatch("executor.fwd_bwd")
         with _prof.scope("executor_fwd_bwd", "symbolic"):
             outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
                                                      key, cts)

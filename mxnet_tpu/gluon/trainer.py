@@ -96,13 +96,15 @@ class Trainer:
             self._kvstore is not None
             and getattr(self._kvstore, "type", "") == "dist_async")
         if self._update_on_kvstore:
-            # the server applies updates with the optimizer AS PICKLED
-            # here — step() sets rescale_grad before first use so it
-            # rides along (the reference's server shares this pickle-time
-            # snapshot semantics, kvstore.py:353)
-            self._kvstore.set_optimizer(self._optimizer)
-            self._kv_opt_snapshot = (self._optimizer.lr,
-                                     self._optimizer.rescale_grad)
+            # the optimizer is NOT shipped here: the server applies
+            # updates with the optimizer AS PICKLED, so sending it from a
+            # pre-first-step path (save_states/load_states resume flow)
+            # would freeze the DEFAULT rescale_grad=1.0 into the servers
+            # and every update would land ~batch_size× too large.
+            # _ensure_kv_optimizer ships it from the first step(), after
+            # rescale_grad is set (ADVICE r5: trainer.py resume path).
+            self._kv_opt_sent = False
+            self._kv_deferred_states = None
             self._kv_param_inited = set()
             # ALL materialized params — including frozen (grad_req
             # 'null') ones — sync to the server-authoritative value, so
@@ -120,6 +122,22 @@ class Trainer:
                 self._kvstore.pull([p.name for p in inited],
                                    out=[p.data() for p in inited])
         self._kv_initialized = True
+
+    def _ensure_kv_optimizer(self):
+        """Ship the optimizer to the dist_async servers once, from the
+        first step() — AFTER rescale_grad is set — then replay any
+        buffered load_states blob.  A pre-first-step save_states/
+        load_states no longer bakes rescale_grad=1.0 into the servers'
+        pickle-time snapshot."""
+        if self._kv_opt_sent:
+            return
+        self._kvstore.set_optimizer(self._optimizer)
+        self._kv_opt_snapshot = (self._optimizer.lr,
+                                 self._optimizer.rescale_grad)
+        self._kv_opt_sent = True
+        if self._kv_deferred_states is not None:
+            blob, self._kv_deferred_states = self._kv_deferred_states, None
+            self._kvstore.load_optimizer_states_blob(blob)
 
     @property
     def learning_rate(self):
@@ -143,6 +161,7 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         if getattr(self, "_update_on_kvstore", False):
+            self._ensure_kv_optimizer()
             return self._step_on_kvstore(ignore_stale_grad)
         updater = self._updaters[0]
         from ..ndarray.sparse import RowSparseNDArray
@@ -338,6 +357,251 @@ class Trainer:
             for s, v in zip(st_old, st_new):
                 s._set_data(v)
 
+    def step_k(self, loss_fn, data, label=None, k=None, batch_size=None):
+        """Run K training steps (forward + backward + update) as ONE
+        scanned XLA program — the gluon analog of ``Module.run_steps``,
+        built on the same ``executor.build_multi_step`` driver: a single
+        host dispatch launches all K steps, amortizing the per-dispatch
+        host cost to 1/K per step.
+
+        ``loss_fn(data, label) -> loss NDArray`` is the user's forward
+        (net + loss); it is traced ONCE into the scan body, with this
+        trainer's parameters functionalized into the scan carry:
+        trainable parameters update via the optimizer each step,
+        non-trainable parameters the forward mutates (BatchNorm
+        running stats) ride the carry too, so their K-step evolution
+        matches K eager steps exactly.  ``data``/``label`` stack the K
+        batches on a leading step axis (a single array or a tuple of
+        arrays, mirrored into loss_fn per step).  Returns the per-step
+        loss values stacked on a leading K axis (ONE host readback reads
+        them all).
+
+        Per-step lr/wd schedules and update counts are precomputed
+        host-side, exactly as K ``step()`` calls would advance them.
+        Falls back to the eager loop (autograd record/backward + step)
+        for K=1, dist_async update-on-kvstore, non-pure optimizers, or
+        ``MXNET_EXEC_BULK_EXEC_TRAIN=0``.  Caveat: ops drawing from the
+        global RNG (Dropout) freeze their trace-time draw — use the
+        eager path (or Module.run_steps, whose interpreter threads keys
+        explicitly) for stochastic-regularization training.
+        """
+        import jax.numpy as jnp
+        data_t = tuple(d._data if hasattr(d, "_data") else jnp.asarray(d)
+                       for d in (data if isinstance(data, (list, tuple))
+                                 else (data,)))
+        label_t = None
+        if label is not None:
+            label_t = tuple(
+                l._data if hasattr(l, "_data") else jnp.asarray(l)
+                for l in (label if isinstance(label, (list, tuple))
+                          else (label,)))
+        ks = {int(a.shape[0]) for a in data_t + (label_t or ())}
+        if len(ks) != 1:
+            raise MXNetError(f"step_k: inconsistent leading (step) dims "
+                             f"{sorted(ks)}")
+        inferred = ks.pop()
+        if inferred == 0:
+            raise MXNetError("step_k: inputs stack ZERO steps (empty "
+                             "leading axis)")
+        if k is None:
+            k = inferred
+        elif k != inferred:
+            raise MXNetError(f"step_k: k={k} but inputs stack {inferred} "
+                             "steps (leading dim)")
+        if batch_size is None:
+            batch_size = int(data_t[0].shape[1]) if data_t[0].ndim > 1 \
+                else 1
+        # rescale BEFORE the lazy kvstore init (same contract as step)
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if not self._kv_initialized:
+            self._init_kvstore()
+        fuse = (k > 1
+                and env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
+                and getattr(self._optimizer, "pure_update", False)
+                and not getattr(self, "_update_on_kvstore", False))
+        if not fuse:
+            return self._step_k_eager(loss_fn, data_t, label_t, k,
+                                      batch_size)
+        return self._step_k_fused(loss_fn, data_t, label_t, k)
+
+    def _step_k_eager(self, loss_fn, data_t, label_t, k, batch_size):
+        """K eager steps: record → backward → step, one dispatch each
+        (the universal fallback; same math as the scanned path)."""
+        from .. import autograd as _ag
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+        def _wrap(vals):
+            nds = tuple(NDArray(v) for v in vals)
+            return nds[0] if len(nds) == 1 else nds
+
+        losses = []
+        for j in range(k):
+            args = [_wrap([a[j] for a in data_t])]
+            if label_t is not None:
+                args.append(_wrap([a[j] for a in label_t]))
+            with _ag.record():
+                loss = loss_fn(*args)
+            loss.backward()
+            self.step(batch_size)
+            losses.append(loss._data)
+        return NDArray(jnp.stack(losses))
+
+    def _step_k_fused(self, loss_fn, data_t, label_t, k):
+        from .. import autograd as _ag
+        from .. import profiler as _prof
+        from ..ndarray import NDArray
+        import jax
+        import jax.numpy as jnp
+        opt = self._optimizer
+        updater = self._updaters[0]
+        zero1 = self._zero_stage >= 1 and self._zero_dp > 1
+        deferred = [p.name for p in self._params
+                    if p._deferred_init is not None]
+        if deferred:
+            # a deferred-init param materializing INSIDE the jit trace
+            # would silently train nothing (it never joins the carry)
+            # and leak tracers into the live Parameter — fail clearly
+            raise MXNetError(
+                "step_k: parameters pending deferred init "
+                f"({deferred[:3]}...) — run one eager forward (e.g. "
+                "net(first_batch)) to materialize shapes before step_k")
+        trainable, idxs = [], []
+        aux_params = []
+        for i, param in enumerate(self._params):
+            if param._data is None:
+                continue
+            if param.grad_req == 'null':
+                # non-trainable but possibly MUTATED by the forward
+                # (BatchNorm running stats): carried through the scan
+                aux_params.append(param)
+            else:
+                trainable.append(param)
+                idxs.append(i)
+        for i, param in zip(idxs, trainable):
+            if i not in updater.states:
+                updater.states[i] = \
+                    opt.create_state_multi_precision(i, param.data())
+                updater.states_synced[i] = True
+                if zero1:
+                    self._zero_shard_state(updater.states[i])
+        needs_t = getattr(opt, "needs_t", False)
+        states = [opt._state_tuple(updater.states[i]) for i in idxs]
+        use_mp = tuple(opt.mp_states_active(p.data(), st)
+                       for p, st in zip(trainable, states))
+        ws = tuple(p._data._data for p in trainable)
+        auxs = tuple(p._data._data for p in aux_params)
+        sts = tuple(tuple(s._data for s in st) for st in states)
+        if zero1:
+            self._zero_check_placed(
+                [(i, p, None) for i, p in zip(idxs, trainable)], ws)
+            from jax.sharding import PartitionSpec as _P
+            param_specs = tuple(
+                getattr(w.sharding, "spec", _P()) for w in ws)
+        else:
+            param_specs = None
+        donate = bool(env("MXNET_FUSED_DONATE", True))
+        # cache key: loss_fn by CODE + bound instance + closure-cell
+        # identities, not object identity — the natural per-iteration
+        # lambda (`tr.step_k(lambda x, y: loss(net(x), y), ...)`) is a
+        # fresh object every call but shares its code and closes over
+        # the same net/loss objects, so it must HIT (identity keying
+        # would retrace + recompile the whole K-step program per call
+        # and pin every stale closure).  __self__ joins the key because
+        # bound methods of two instances share __code__ with an empty
+        # closure; callables without __code__ fall back to identity.
+        pins = (getattr(loss_fn, "__self__", None),) + tuple(
+            c.cell_contents
+            for c in (getattr(loss_fn, "__closure__", None) or ()))
+        fn_key = (getattr(loss_fn, "__code__", loss_fn),
+                  tuple(id(p) for p in pins))
+        key = (fn_key, tuple(idxs), len(aux_params), use_mp, needs_t,
+               opt.hyperparam_signature(), zero1, param_specs,
+               label_t is None, donate)
+        cache = getattr(self, "_step_k_cache", None)
+        if cache is None:
+            cache = self._step_k_cache = {}
+        entry = cache.get(key)
+        # the entry PINS the id()'d objects: without the strong refs, a
+        # GC'd closure object's address could be reused by a NEW object
+        # and false-hit a program traced against the old one
+        fn = entry[0] if entry is not None else None
+        if fn is None:
+            all_params = trainable + aux_params
+
+            def f_loss(ws_, auxs_, data_j, label_j):
+                """Functionalized forward: park traced values in the
+                live Parameters, run the user's loss_fn, harvest the
+                (possibly updated) aux payloads, restore."""
+                old = [(p._data._payload, p._data._thunk)
+                       for p in all_params]
+                try:
+                    for p, w in zip(trainable, ws_):
+                        p._data._set_data(w)
+                    for p, a in zip(aux_params, auxs_):
+                        p._data._set_data(a)
+                    args = [NDArray(data_j[0]) if len(data_j) == 1
+                            else tuple(NDArray(d) for d in data_j)]
+                    if label_j is not None:
+                        args.append(NDArray(label_j[0])
+                                    if len(label_j) == 1 else
+                                    tuple(NDArray(l) for l in label_j))
+                    with _ag.train_mode():
+                        loss = loss_fn(*args)
+                    new_auxs = tuple(p._data._data for p in aux_params)
+                    return loss._data, new_auxs
+                finally:
+                    for p, (payload, thunk) in zip(all_params, old):
+                        p._data._payload = payload
+                        p._data._thunk = thunk
+
+            def scan_body(carry, x, const):
+                ws_, auxs_, sts_ = carry
+                data_j, label_j, lrs, wds, ts = x
+
+                loss_val, vjp_fn, new_auxs = jax.vjp(
+                    lambda w: f_loss(w, auxs_, data_j, label_j),
+                    ws_, has_aux=True)
+                grads = vjp_fn(jnp.ones_like(loss_val))[0]
+                new_ws, new_sts = opt.apply_fused(
+                    ws_, grads, sts_, lrs, wds, use_mp,
+                    ts=ts if needs_t else None)
+                if zero1:
+                    from jax.sharding import NamedSharding
+                    from .. import parallel as _par
+                    mesh = self._mesh
+                    new_ws = tuple(
+                        jax.lax.with_sharding_constraint(
+                            w, NamedSharding(mesh, ps))
+                        for w, ps in zip(new_ws, param_specs))
+                    new_sts = _par.constrain_zero_states(
+                        new_sts, mesh, self._zero_dp)
+                return (new_ws, new_auxs, new_sts), loss_val
+
+            from ..executor import build_multi_step
+            fn = build_multi_step(scan_body, donate=donate)
+            cache[key] = (fn, pins)
+
+        # per-step lr/wd/t advance exactly as K step() calls would
+        # (shared helper with Module.run_steps); rollback keeps the host
+        # schedule transactional with the dispatch — a failed compile
+        # must not leave counts K steps ahead of the params
+        from ..executor import precompute_step_schedules, schedule_rollback
+        with schedule_rollback(opt):
+            lrs, wds, ts = precompute_step_schedules(opt, idxs, k)
+
+            _prof.record_dispatch("step_k.dispatch")
+            with _prof.scope("step_k_scan", "symbolic"):
+                (new_ws, new_auxs, new_sts), losses = fn(
+                    (ws, auxs, sts), (data_t, label_t, lrs, wds, ts), ())
+        for p, w in zip(trainable, new_ws):
+            p._data._set_data(w)
+        for p, a in zip(aux_params, new_auxs):
+            p._data._set_data(a)
+        for st_old, st_new in zip(states, new_sts):
+            for s, v in zip(st_old, st_new):
+                s._set_data(v)
+        return NDArray(losses)
+
     def allreduce_grads(self):
         """No-op on TPU: gradient reduction is fused into backward
         (GSPMD psum) — kept for API parity (reference: trainer.py
@@ -355,6 +619,23 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
+            if not self._kv_opt_sent:
+                # THIS worker never stepped, but another worker may have
+                # shipped the optimizer and trained — gather from the
+                # servers if they answer; never ship the optimizer from
+                # here (that would freeze rescale_grad=1.0 server-side)
+                if self._kv_deferred_states is not None:
+                    with open(fname, 'wb') as fout:
+                        fout.write(self._kv_deferred_states)
+                    return
+                try:
+                    self._kvstore.save_optimizer_states(fname)
+                except MXNetError:
+                    # fresh cluster, no optimizer anywhere: no states
+                    # exist yet — write an empty state dict
+                    with open(fname, 'wb') as fout:
+                        fout.write(self._updaters[0].get_states())
+                return
             self._kvstore.save_optimizer_states(fname)
             return
         with open(fname, 'wb') as fout:
@@ -364,6 +645,18 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
+            if not self._kv_opt_sent:
+                # if another worker already installed the server-side
+                # optimizer, apply NOW (deferring would rewind their
+                # later progress at this worker's first step); on a
+                # fresh cluster buffer until the first step() ships the
+                # optimizer with the REAL rescale_grad
+                try:
+                    self._kvstore.load_optimizer_states(fname)
+                except MXNetError:
+                    with open(fname, 'rb') as fin:
+                        self._kv_deferred_states = fin.read()
+                return
             self._kvstore.load_optimizer_states(fname)
             return
         with open(fname, 'rb') as fin:

@@ -41,8 +41,19 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, rand_crop=False, resize=-1,
                  part_index=0, num_parts=1, round_batch=True,
                  preprocess_threads=None, prefetch_buffer=2, seed=0,
-                 data_name='data', label_name='softmax_label', **kwargs):
+                 data_name='data', label_name='softmax_label',
+                 device_prefetch=False, device=None, **kwargs):
         super().__init__(batch_size)
+        # device_prefetch: keep ONE batch in flight to the device —
+        # next() returns the already-transferring batch t and immediately
+        # starts batch t+1's async jax.device_put, so the host→device
+        # copy overlaps the consumer's compute (the transfer leg of the
+        # reference's ThreadedIter overlap; the decode/augment leg is the
+        # _producer thread below).  Feeds the multi-step driver
+        # (Module.run_steps) without any host work on the hot path.
+        self._device_prefetch = device_prefetch
+        self._device = device
+        self._dev_next = None
         if not os.path.exists(path_imgrec):
             raise MXNetError(f"record file not found: {path_imgrec}")
         self.path = path_imgrec
@@ -241,6 +252,7 @@ class ImageRecordIter(DataIter):
             self._worker.join(timeout=5)
         self._stop = threading.Event()
         self._done = False
+        self._dev_next = None   # drop any in-flight device batch
         order = self._order.copy()
         if self.shuffle:
             self._rng.shuffle(order)
@@ -274,7 +286,29 @@ class ImageRecordIter(DataIter):
             pad = 0
         return data, label, pad
 
+    def _device_batch(self):
+        """Next batch with its async device transfer already started."""
+        import jax
+        data, label, pad = self.next_raw()
+        from .ndarray import NDArray
+        return DataBatch(
+            [NDArray(jax.device_put(data, self._device))],
+            [NDArray(jax.device_put(label, self._device))], pad=pad,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
     def next(self):
+        if self._device_prefetch:
+            cur = self._dev_next
+            if cur is None:
+                cur = self._device_batch()   # first call of the epoch
+            try:
+                # start batch t+1's transfer before handing out batch t:
+                # the copy overlaps the consumer's compute
+                self._dev_next = self._device_batch()
+            except StopIteration:
+                self._dev_next = None
+            return cur
         data, label, pad = self.next_raw()
         return DataBatch([nd_array(data)], [nd_array(label)], pad=pad,
                          provide_data=self.provide_data,

@@ -159,9 +159,20 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Threaded double-buffered prefetch (reference: io.py:343; C++ analog
-    dmlc::ThreadedIter in iter_prefetcher.h)."""
+    dmlc::ThreadedIter in iter_prefetcher.h).
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    ``device_put=True`` adds an async device-transfer stage IN the
+    prefetch thread: batch t+1 starts its host→device transfer (an async
+    ``jax.device_put``) while the consumer's program still computes on
+    batch t — the jax_graft form of the reference's ThreadedIter overlap
+    of IO with compute.  This is the feed stage for the multi-step
+    driver (``Module.run_steps``): with K steps per dispatch and the
+    next superbatch already in flight, the host's only per-dispatch work
+    is the scan launch itself.  ``device`` selects the target jax device
+    (default: jax's default device)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 device_put=False, device=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -170,7 +181,14 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
-        self.batch_size = self.provide_data[0][1][0]
+        self._device_put = device_put
+        self._device = device
+        # prefer the inner iterator's declared batch_size: for a
+        # KBatchIter the provide_data leading dim is the STEP count k,
+        # not the batch size (DataIter's default of 0 falls through to
+        # the legacy shape-derived value)
+        self.batch_size = getattr(iters[0], 'batch_size', 0) or \
+            self.provide_data[0][1][0]
         self.data_ready = [threading.Event() for _ in range(self.n_iter)]
         self.data_taken = [threading.Event() for _ in range(self.n_iter)]
         for e in self.data_taken:
@@ -185,7 +203,10 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    batch = self.iters[i].next()
+                    if self._device_put:
+                        batch = self._transfer(batch)
+                    self.next_batch[i] = batch
                 except StopIteration:
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
@@ -195,6 +216,24 @@ class PrefetchingIter(DataIter):
             for i in range(self.n_iter)]
         for thread in self.prefetch_threads:
             thread.start()
+
+    def _transfer(self, batch):
+        """Start the async host→device transfer of every array in the
+        batch (jax.device_put returns immediately; the copy proceeds in
+        the background while the consumer computes on the previous
+        batch).  Runs in the prefetch thread."""
+        import jax
+
+        def put(arrs):
+            if arrs is None:
+                return None
+            return [NDArray(jax.device_put(a._data, self._device))
+                    for a in arrs]
+
+        return DataBatch(put(batch.data), put(batch.label),
+                         pad=batch.pad, index=batch.index,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
 
     def __del__(self):
         self.started = False
@@ -271,6 +310,83 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class KBatchIter(DataIter):
+    """Stack K consecutive batches of an inner iterator into ONE
+    superbatch with a leading step axis — the feed shape of the
+    multi-step driver (``Module.run_steps`` / ``Trainer.step_k``).
+
+    Each ``next()`` pulls K batches from the inner iterator, stacks
+    host-side (numpy — one contiguous buffer, so the superbatch crosses
+    the host→device link as one transfer), and returns a DataBatch whose
+    arrays are ``(k, batch, ...)``.  A trailing partial group (fewer
+    than K batches left) is dropped by default (``last_group='discard'``)
+    or emitted short (``'keep'``) — run_steps falls back to the eager
+    driver for a short group's different leading dim, so training still
+    consumes every batch.  Compose with ``PrefetchingIter(...,
+    device_put=True)`` to overlap the superbatch transfer with the
+    previous scanned program's compute."""
+
+    def __init__(self, data_iter, k, last_group='discard'):
+        super().__init__()
+        if k < 1:
+            raise MXNetError(f"KBatchIter: k must be >= 1, got {k}")
+        if last_group not in ('discard', 'keep'):
+            raise MXNetError("KBatchIter: last_group must be 'discard' "
+                             "or 'keep'")
+        self.data_iter = data_iter
+        self.k = k
+        self.last_group = last_group
+        self.batch_size = data_iter.batch_size
+        self._k_provide = lambda descs: [
+            DataDesc(d.name, (self.k,) + tuple(d.shape),
+                     getattr(d, 'dtype', np.float32))
+            for d in descs]
+
+    @property
+    def provide_data(self):
+        return self._k_provide(self.data_iter.provide_data)
+
+    @property
+    def provide_label(self):
+        return self._k_provide(self.data_iter.provide_label)
+
+    def reset(self):
+        self.data_iter.reset()
+
+    def next(self):
+        batches = []
+        for _ in range(self.k):
+            try:
+                batches.append(self.data_iter.next())
+            except StopIteration:
+                break
+        if not batches or (len(batches) < self.k
+                           and self.last_group == 'discard'):
+            raise StopIteration
+        data = [nd_array(np.stack([np.asarray(b.data[i].asnumpy())
+                                   for b in batches]))
+                for i in range(len(batches[0].data))]
+        label = None
+        if batches[0].label:
+            label = [nd_array(np.stack([np.asarray(b.label[i].asnumpy())
+                                        for b in batches]))
+                     for i in range(len(batches[0].label))]
+        if len(batches) == self.k:
+            pd, pl = self.provide_data, self.provide_label
+        else:
+            # short tail group ('keep' mode): the attached descs must
+            # state the ACTUAL leading dim, not the nominal k
+            kk = len(batches)
+            pd = [DataDesc(d.name, (kk,) + tuple(d.shape[1:]),
+                           getattr(d, 'dtype', np.float32))
+                  for d in self.provide_data]
+            pl = [DataDesc(d.name, (kk,) + tuple(d.shape[1:]),
+                           getattr(d, 'dtype', np.float32))
+                  for d in self.provide_label]
+        return DataBatch(data, label, pad=batches[-1].pad,
+                         provide_data=pd, provide_label=pl)
 
 
 def _init_data(data, allow_empty, default_name):

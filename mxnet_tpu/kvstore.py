@@ -461,6 +461,15 @@ class KVStoreDistAsync(KVStore):
         plan with no coordination."""
         if k in self._stripes:
             return self._stripes[k]
+        if "@s" in k:
+            # '@s' is the reserved stripe-suffix separator: a user key
+            # 'w@s0' would collide with stripe 0 of key 'w' on the server
+            # and be mangled by Optimizer._mult_index (ADVICE r5).  Every
+            # op (init/push/pull/row_sparse_pull) derives its plan here,
+            # so this one check covers the whole surface.
+            raise MXNetError(
+                f"kvstore dist_async: key {k!r} contains the reserved "
+                "stripe separator '@s' — rename the parameter")
         n = len(self._conns)
         if (n <= 1 or not shape or len(shape) == 0
                 or int(np.prod(shape)) <= self._bigarray_bound
@@ -612,12 +621,32 @@ class KVStoreDistAsync(KVStore):
         for c in self._conns:
             c.submit(("command", head, body), wait=True)
 
+    def _owner_conn(self, wire_key: str) -> _ServerConn:
+        """The connection of the server that OWNS a wire key (stripe
+        suffix respected) — the shard whose copy of that key's optimizer
+        state is authoritative."""
+        if "@s" in wire_key:
+            base, i = wire_key.rsplit("@s", 1)
+            try:
+                return self._stripe_conn(base, int(i))
+            except ValueError:
+                pass  # '@s' from a pre-guard key: fall through
+        return self._conn_of(wire_key)
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         """Gather each server shard's {key: state} dict and persist the
-        union, with the optimizer itself when dump_optimizer (same blob
+        merge, with the optimizer itself when dump_optimizer (same blob
         format as Updater.get_states — the states LIVE on the servers in
-        this mode; reference: kvstore_dist_server.h:131)."""
+        this mode; reference: kvstore_dist_server.h:131).
+
+        Each key's OWNER shard wins the merge: after a
+        load_optimizer_states broadcast, non-owner shards may still hold
+        stale loaded copies of other shards' keys (servers with an empty
+        store return them all — the load→save relay case), and a plain
+        connection-order union would let a stale copy overwrite the
+        owner's fresh state (ADVICE r5)."""
         merged, opt_obj = {}, None
+        per_server = []
         for c in self._conns:
             blob = c.submit(("get_states", dump_optimizer), wait=True)
             if blob is None:
@@ -626,18 +655,36 @@ class KVStoreDistAsync(KVStore):
             loaded = pickle.loads(blob)
             if dump_optimizer:
                 states, opt_obj = loaded  # identical snapshot per server
-                merged.update(states)
             else:
-                merged.update(loaded)
+                states = loaded
+            per_server.append((c, states))
+        for _c, states in per_server:      # any-server fallback first
+            merged.update(states)
+        for c, states in per_server:       # then the owner's copy wins
+            for k, v in states.items():
+                # updater keys round-trip through _key_int (numeric wire
+                # keys become ints) — str() restores the wire key
+                if self._owner_conn(k if isinstance(k, str)
+                                    else str(k)) is c:
+                    merged[k] = v
         with open(fname, 'wb') as fout:
             fout.write(pickle.dumps((merged, opt_obj) if dump_optimizer
                                     else merged))
 
     def load_optimizer_states(self, fname):
         """Broadcast the saved union to every server; each shard applies
-        all keys and simply never touches the ones it doesn't own."""
+        all keys and simply never touches the ones it doesn't own (and a
+        later get_states returns only OWNED keys — kvstore_server.py —
+        so the loaded copies of other shards' keys can never leak back
+        stale into a subsequent save)."""
         with open(fname, 'rb') as fin:
             blob = fin.read()
+        self.load_optimizer_states_blob(blob)
+
+    def load_optimizer_states_blob(self, blob):
+        """Broadcast an already-read optimizer-state blob (the gluon
+        Trainer buffers the file contents when load_states runs before
+        the optimizer has been shipped to the servers)."""
         for c in self._conns:
             c.submit(("set_states", blob), wait=True)
 

@@ -155,11 +155,28 @@ class KVStoreServer:
             # optimizer-state checkpointing: this shard's {key: state}
             # dict, optionally with the optimizer itself (reference:
             # server-side optimizer states live in the server,
-            # kvstore_dist_server.h:131)
+            # kvstore_dist_server.h:131).  Return only keys the shard
+            # OWNS (is in _store): set_states broadcasts the full merged
+            # union to every server, so after further training the
+            # updater also holds stale loaded copies of OTHER shards'
+            # keys — without this filter a save→load→train→save flow
+            # with ≥2 servers lets a stale copy overwrite the owner's
+            # fresh state in the client-side merge (ADVICE r5).
             dump = bool(msg[1]) if len(msg) > 1 else False
             with self._lock:
-                return None if self._updater is None \
-                    else self._updater.get_states(dump_optimizer=dump)
+                if self._updater is None:
+                    return None
+                states = self._updater.states
+                if self._store:
+                    owned = {_key_int(k) for k in self._store}
+                    states = {k: v for k, v in states.items()
+                              if k in owned}
+                # an EMPTY store means this shard never saw an init/push
+                # (pure load→save relay, e.g. checkpoint migration):
+                # return everything — the client-side merge prefers each
+                # key's OWNER, so these can never shadow fresh state
+                return pickle.dumps((states, self._updater.optimizer)
+                                    if dump else states)
         if op == "set_states":
             _, blob = msg
             with self._lock:

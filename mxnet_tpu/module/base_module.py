@@ -62,6 +62,64 @@ def _check_names_match(data_names, data_shapes, name, throw):
         logging.warning(msg)
 
 
+def _canon_step_inputs(names, value, what, k=None):
+    """Canonicalize ``run_steps`` inputs to a list of K-stacked arrays
+    aligned with ``names`` (each element shaped ``(k,) + per_step_shape``).
+
+    Accepts a dict name->array, a list aligned with ``names``, a single
+    array (one input), or — for a single input name — a list of K
+    per-step batches (stacked here).  Returns (arrays, k)."""
+    import jax.numpy as jnp
+
+    def _as_val(v):
+        if isinstance(v, NDArray):
+            return v._data
+        if isinstance(v, (np.ndarray, jnp.ndarray)):
+            return v
+        return np.asarray(v)
+
+    if value is None:
+        if names:
+            raise MXNetError(f"run_steps: {what} is required "
+                             f"(names: {names})")
+        return [], k
+    if isinstance(value, dict):
+        missing = [n for n in names if n not in value]
+        if missing:
+            raise MXNetError(f"run_steps: missing {what}: {missing}")
+        arrays = [_as_val(value[n]) for n in names]
+    elif isinstance(value, (list, tuple)):
+        if len(value) == len(names):
+            arrays = [_as_val(v) for v in value]
+        elif len(names) == 1:
+            # list of K per-step batches for the single input
+            arrays = [np.stack([np.asarray(_as_val(v)) for v in value])]
+        else:
+            raise MXNetError(
+                f"run_steps: expected {len(names)} {what} arrays, "
+                f"got {len(value)}")
+    else:
+        if len(names) != 1:
+            raise MXNetError(
+                f"run_steps: {what} must be a dict/list covering "
+                f"{names}")
+        arrays = [_as_val(value)]
+    ks = {int(a.shape[0]) for a in arrays if a.ndim}
+    if len(ks) != 1:
+        raise MXNetError(f"run_steps: inconsistent leading (step) dims "
+                         f"for {what}: {sorted(ks)}")
+    inferred = ks.pop()
+    if inferred == 0:
+        raise MXNetError(
+            f"run_steps: {what} stacks ZERO steps (empty leading axis) "
+            "— a mis-built superbatch (e.g. a KBatchIter tail)?")
+    if k is not None and k != inferred:
+        raise MXNetError(
+            f"run_steps: k={k} but {what} arrays stack "
+            f"{inferred} steps (leading dim)")
+    return arrays, inferred
+
+
 class BaseModule:
     """reference: base_module.py BaseModule."""
 
@@ -76,6 +134,39 @@ class BaseModule:
         self._total_exec_bytes = 0
 
     # -- high-level ----------------------------------------------------------
+    def run_steps(self, data, label=None, k=None, eval_metric=None):
+        """Run K training steps (forward + backward + optimizer update).
+
+        ``data``/``label`` carry K stacked batches (leading axis = step;
+        see :func:`_canon_step_inputs` for accepted forms).  This base
+        implementation is the EAGER driver — one dispatch per step — and
+        serves as the universal fallback (BucketingModule, K=1, shape
+        changes, non-pure optimizers).  :class:`Module` overrides it with
+        the scanned single-dispatch program.  Returns the per-step
+        outputs stacked on a leading K axis, one NDArray per output."""
+        data_arrays, k = _canon_step_inputs(
+            self.data_names, data, "data", k)
+        label_arrays, k = _canon_step_inputs(
+            getattr(self, "label_names", []), label, "label", k)
+        return self._run_steps_eager(data_arrays, label_arrays, k,
+                                     eval_metric)
+
+    def _run_steps_eager(self, data_arrays, label_arrays, k, eval_metric):
+        import jax.numpy as jnp
+        outs_steps = []
+        for j in range(k):
+            batch = io_mod.DataBatch(
+                data=[NDArray(jnp.asarray(a[j])) for a in data_arrays],
+                label=[NDArray(jnp.asarray(a[j])) for a in label_arrays]
+                if label_arrays else None)
+            self.forward(batch, is_train=True)
+            self.update()
+            if eval_metric is not None:
+                self.update_metric(eval_metric, batch.label)
+            outs_steps.append([o._data for o in self.get_outputs()])
+        return [NDArray(jnp.stack([s[i] for s in outs_steps]))
+                for i in range(len(outs_steps[0]))]
+
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
         self.backward()

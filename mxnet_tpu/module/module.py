@@ -135,6 +135,7 @@ class Module(BaseModule):
         self._grad_req = None
         self._exec: Optional[Executor] = None
         self._fused_step = None
+        self._run_steps_cache: Dict[tuple, object] = {}
         self._opt_states: Dict[str, tuple] = {}
         self._pending_backward = False
 
@@ -292,6 +293,7 @@ class Module(BaseModule):
             compute_dtype=self._compute_dtype)
         self._apply_shardings()
         self._fused_step = None
+        self._run_steps_cache = {}
         if self.params_initialized:
             # params loaded before bind (Module.load) — copy into executor
             # (reference: module.py bind → exec_group.set_params)
@@ -334,11 +336,13 @@ class Module(BaseModule):
         self._exec = self._exec.reshape(**new)
         self._apply_shardings()
         self._fused_step = None
+        self._run_steps_cache = {}
 
     def _reset_bind(self):
         self.binded = False
         self._exec = None
         self._fused_step = None
+        self._run_steps_cache = {}
 
     def _apply_shardings(self):
         """Annotate the executor's args with mesh shardings: inputs batch-
@@ -483,6 +487,7 @@ class Module(BaseModule):
             self._exec = self._exec.reshape(**new)
             self._apply_shardings()
             self._fused_step = None
+            self._run_steps_cache = {}
         self._exec.forward(is_train=is_train, **kwargs)
         self._pending_backward = False
         self._out_grads = None
@@ -568,6 +573,7 @@ class Module(BaseModule):
             if t_dev is None:
                 t_dev = self._t_const = jnp.asarray(0, jnp.int32)
         from .. import profiler as _prof
+        _prof.record_dispatch("fused_step.dispatch")
         with _prof.scope("fused_train_step", "symbolic"):
             outs, new_aux, new_params, new_states = self._fused_step(
                 pvals, io_vals, aux_vals, key, states, lrs, wds, t_dev)
@@ -583,29 +589,48 @@ class Module(BaseModule):
             for s, v in zip(self._opt_states[n], st):
                 s._set_data(v)
         if self._fused_donate:
-            # The step consumed (donated) the old param/aux/state buffers;
-            # the pre-step snapshots and any lazy thunks referencing them
-            # (gradients, outputs from earlier forwards) are no longer
-            # executable — poison them with a clear error.
-            from ..executor import poison_stale
-            exec_._snapshot = None
-            for name, garr in exec_.grad_dict.items():
-                if garr is not None and garr._thunk is not None:
-                    poison_stale(garr, "gradient")
-            for ref in exec_._issued_outs:
-                oarr = ref()
-                if oarr is not None and oarr._thunk is not None:
-                    poison_stale(oarr, "output")
-            exec_._issued_outs = []
+            self._poison_after_donate()
         self._pending_backward = False
 
-    def _build_fused_step(self, names):
+    def _poison_after_donate(self):
+        """A donated step consumed the old param/aux/state buffers; the
+        pre-step snapshots and any lazy thunks referencing them
+        (gradients, outputs from earlier forwards) are no longer
+        executable — poison them with a clear error."""
+        from ..executor import poison_stale
         exec_ = self._exec
-        run = exec_._run
-        arg_names = exec_._arg_names
+        exec_._snapshot = None
+        for name, garr in exec_.grad_dict.items():
+            if garr is not None and garr._thunk is not None:
+                poison_stale(garr, "gradient")
+        for ref in exec_._issued_outs:
+            oarr = ref()
+            if oarr is not None and oarr._thunk is not None:
+                poison_stale(oarr, "output")
+        exec_._issued_outs = []
+
+    def _split_arg_idx(self, names):
+        """Partition executor arg positions into (updated params, io) —
+        the ONE source of truth for the index layout shared by the step
+        body (_make_step_body) and the scan driver's io scatter
+        (_run_steps_fused)."""
+        arg_names = self._exec._arg_names
         upd_idx = [arg_names.index(n) for n in names]
         upd_set = set(upd_idx)
         io_idx = [i for i in range(len(arg_names)) if i not in upd_set]
+        return upd_idx, io_idx
+
+    def _make_step_body(self, names):
+        """Build the PURE single fused-step function
+        ``step(pvals, io_vals, aux_vals, key, states, lrs, wds, t) ->
+        (outs, new_aux, new_params, new_states)`` shared by the per-step
+        jit (update) and the K-step scan (run_steps): both drivers trace
+        the SAME body, so scanned training is bit-equivalent to eager
+        fused steps by construction."""
+        exec_ = self._exec
+        run = exec_._run
+        arg_names = exec_._arg_names
+        upd_idx, io_idx = self._split_arg_idx(names)
         self._fused_upd_idx = upd_idx
         self._fused_io_idx = io_idx
         opt = self._optimizer
@@ -672,13 +697,210 @@ class Module(BaseModule):
                     new_states, self._mesh, self._zero_dp())
             return outs, new_aux, tuple(new_params), tuple(new_states)
 
+        return step
+
+    def _build_fused_step(self, names):
         # Donate the buffers the step replaces — params, aux (BN stats),
         # optimizer state — so XLA updates them in place in HBM (the analog
         # of the reference's in-place engine writes; halves peak param
         # memory and removes copy traffic).
         self._fused_donate = bool(env("MXNET_FUSED_DONATE", True))
         donate = (0, 2, 4) if self._fused_donate else ()
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(self._make_step_body(names), donate_argnums=donate)
+
+    # -- multi-step driver --------------------------------------------------
+    def run_steps(self, data, label=None, k=None, eval_metric=None):
+        """Run K fused training steps as ONE XLA program (`jax.lax.scan`
+        over the fused fwd+bwd+update body): one host dispatch launches
+        all K steps, amortizing the per-dispatch host cost (~12 ms
+        through a remote-attached chip, docs/PERF_NOTES.md) to 1/K per
+        step — the whole-program TPU execution move of Fischer & Saba
+        (arXiv:1810.09868), and the engine-level overlap idea of MXNet
+        taken to its limit: the host leaves the training loop entirely.
+
+        ``data``/``label`` carry the K batches stacked on a leading step
+        axis (array ``(k, batch, ...)``, dict name->array, or a list of
+        per-step batches for a single input).  Parameters, aux states
+        (BatchNorm statistics) and optimizer state flow step-to-step in
+        the scan carry, with their buffers donated (in-place HBM
+        updates); per-step lr/wd schedules and update counts are
+        precomputed host-side so schedules advance exactly as K eager
+        ``update()`` calls would.  Host-visible values (the per-step
+        outputs — loss heads included) accumulate as stacked scan
+        outputs and are read back ONCE per call: pass ``eval_metric`` to
+        fold them into a metric here (single readback), or read the
+        returned stacked outputs yourself.
+
+        The compiled program is cached per (K, shapes, param set,
+        optimizer hyperparameters).  Falls back to the eager per-step
+        driver (BaseModule.run_steps) for K=1, shape changes vs the
+        bound shapes (bucketing / variable shapes), non-pure optimizers,
+        update-on-kvstore, and ``MXNET_EXEC_BULK_EXEC_TRAIN=0`` — same
+        math, K dispatches.
+
+        Returns the per-step outputs stacked on a leading K axis, one
+        NDArray per output; scanned training is bit-equivalent to K
+        eager fused steps because both trace the SAME step body
+        (tests/test_run_steps.py pins this).
+        """
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        from .base_module import _canon_step_inputs
+        data_arrays, k = _canon_step_inputs(
+            self._data_names, data, "data", k)
+        label_arrays, k = _canon_step_inputs(
+            self._label_names, label, "label", k)
+        opt = self._optimizer
+        names = self._update_names()
+        shapes_ok = all(
+            tuple(a.shape[1:]) == tuple(self._exec.arg_dict[n].shape)
+            for n, a in zip(self._data_names + self._label_names,
+                            data_arrays + label_arrays))
+        use_fused = (k > 1 and bool(names) and shapes_ok
+                     and env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
+                     and getattr(opt, "pure_update", False)
+                     and not self._update_on_kvstore)
+        if not use_fused:
+            return self._run_steps_eager(data_arrays, label_arrays, k,
+                                         eval_metric)
+        return self._run_steps_fused(data_arrays, label_arrays, k, names,
+                                     eval_metric)
+
+    def _run_steps_fused(self, data_arrays, label_arrays, k, names,
+                         eval_metric):
+        exec_ = self._exec
+        opt = self._optimizer
+        arg_names = exec_._arg_names
+        upd_idx, io_idx = self._split_arg_idx(names)
+        step_names = set(self._data_names) | set(self._label_names)
+        step_pos = [j for j, i in enumerate(io_idx)
+                    if arg_names[i] in step_names]
+        const_pos = [j for j, i in enumerate(io_idx)
+                     if arg_names[i] not in step_names]
+
+        donate = bool(env("MXNET_FUSED_DONATE", True))
+        sig = opt.hyperparam_signature()
+        cache = self._run_steps_cache
+        cache_key = (tuple(names), sig, donate)
+        fn = cache.get(cache_key)
+        if fn is None:
+            from ..executor import build_multi_step
+            body = self._make_step_body(names)
+
+            def scan_body(carry, x, const):
+                pvals, aux_vals, states = carry
+                step_io, key, lrs, wds, t = x
+                io_vals = [None] * len(io_idx)
+                for j, v in zip(step_pos, step_io):
+                    io_vals[j] = v
+                for j, v in zip(const_pos, const):
+                    io_vals[j] = v
+                outs, new_aux, new_params, new_states = body(
+                    pvals, tuple(io_vals), aux_vals, key, states,
+                    lrs, wds, t)
+                return (new_params, new_aux, new_states), outs
+
+            fn = cache[cache_key] = build_multi_step(scan_body,
+                                                     donate=donate)
+        self._fused_upd_idx = upd_idx
+        self._fused_io_idx = io_idx
+        self._fused_donate = donate
+
+        # per-step lr/wd/t precomputed host-side (shared helper with
+        # Trainer.step_k): schedules advance exactly as K eager update()
+        # calls would, then travel as (k,)-arrays scanned with the data,
+        # so mid-scan lr changes cost nothing.  The step body takes ONE
+        # t per step (all names update together), so ts uses column 0.
+        # schedule_rollback keeps the host schedule state transactional
+        # with the dispatch: a failed compile/launch must not leave
+        # counts K steps ahead of the params.
+        from ..executor import precompute_step_schedules, schedule_rollback
+        from .. import profiler as _prof
+        with schedule_rollback(opt):
+            lrs, wds, tcols = precompute_step_schedules(opt, names, k)
+            ts = tcols[0]
+
+            # per-step RNG keys consume the global counter exactly like
+            # K eager forwards; RNG-free programs share one constant key
+            # (same discipline as random.key_for)
+            run = exec_._run
+            if getattr(run, "needs_rng", False):
+                keys = jnp.stack([_rnd.next_key() for _ in range(k)])
+            else:
+                keys = jnp.stack([_rnd.key_for(run)] * k)
+
+            arg_vals = exec_._arg_vals()
+            aux_vals = exec_._aux_vals()
+            pvals = tuple(arg_vals[i] for i in upd_idx)
+            const = tuple(arg_vals[io_idx[j]] for j in const_pos)
+            step_io = tuple(self._stacked_input(arg_names[io_idx[j]],
+                                                data_arrays, label_arrays)
+                            for j in step_pos)
+            states = tuple(tuple(s._data for s in self._opt_states[n])
+                           for n in names)
+
+            _prof.record_dispatch("run_steps.dispatch")
+            with _prof.scope("run_steps_scan", "symbolic"):
+                (new_pvals, new_aux, new_states), ys = fn(
+                    (pvals, aux_vals, states),
+                    (step_io, keys, lrs, wds, ts), const)
+        self._params_dirty = True
+        for n, w in zip(names, new_pvals):
+            exec_.arg_dict[n]._set_data(w)
+        for a, v in zip(exec_.aux_arrays, new_aux):
+            a._set_data(v)
+        for n, st in zip(names, new_states):
+            for s, v in zip(self._opt_states[n], st):
+                s._set_data(v)
+        if donate:
+            self._poison_after_donate()
+        self._pending_backward = False
+
+        # expose the LAST step's outputs through get_outputs() (lazy: the
+        # slice dispatches only if actually read)
+        from ..executor import make_lazy_outputs
+
+        def last_thunk(outs):
+            def thunk():
+                for oa, y in zip(outs, ys):
+                    oa._set_data(y[-1])
+            return thunk
+
+        exec_._out_arrays = make_lazy_outputs(
+            exec_._out_aval_list(True), last_thunk)
+
+        stacked = [NDArray(y) for y in ys]
+        if eval_metric is not None:
+            self._fold_metric(eval_metric, label_arrays, ys, k)
+        return stacked
+
+    def _stacked_input(self, name, data_arrays, label_arrays):
+        """Device value for one stacked (k, batch, ...) input, with the
+        batch axis (axis 1 of the stack) dp-sharded when a mesh is set."""
+        io_names = self._data_names + self._label_names
+        arr = (data_arrays + label_arrays)[io_names.index(name)]
+        if self._mesh is None:
+            return jnp.asarray(arr)
+        from .. import parallel as _par
+        from jax.sharding import NamedSharding, PartitionSpec
+        per_step = _par.data_pspec(np.ndim(arr) - 1)
+        sh = NamedSharding(self._mesh,
+                           PartitionSpec(None, *tuple(per_step)))
+        return self._exec._sharded(jnp.asarray(arr), sh)
+
+    def _fold_metric(self, eval_metric, label_arrays, ys, k):
+        """ONE host readback for all K steps' outputs, then fold them
+        into the metric per step (labels are already host-side)."""
+        from .. import profiler as _prof
+        host_outs = jax.device_get(ys)
+        _prof.record_dispatch("run_steps.readback")
+        labels_np = [np.asarray(a) for a in label_arrays]
+        for j in range(k):
+            eval_metric.update_dict(
+                {n: NDArray(a[j]) for n, a in
+                 zip(self._label_names, labels_np)},
+                {n: NDArray(o[j]) for n, o in
+                 zip(self._output_names, host_outs)})
 
     def _lower_fused_step(self):
         """Trace+lower one fused training step (no backend compile).

@@ -170,6 +170,34 @@ def record_event(name, start_us, dur_us, category="operator"):
     _profiler.record(name, start_us, dur_us, category)
 
 
+# -- host-dispatch counters --------------------------------------------------
+# One counter per dispatch KIND (fused step launch, K-step scan launch,
+# host readback, eager forward, ...).  This is the test hook behind the
+# multi-step driver's contract — "run_steps(k) is ONE device dispatch and
+# ONE host readback" is asserted by tests/test_run_steps.py against these
+# counts, so a regression that silently reintroduces per-step host
+# round-trips fails loudly instead of only showing up on a chip.
+_dispatch_counts: dict = {}
+_dispatch_lock = threading.Lock()
+
+
+def record_dispatch(kind: str):
+    """Count one host-side dispatch event of ``kind`` (always on — a
+    dict increment is noise next to the device round-trip it marks)."""
+    with _dispatch_lock:
+        _dispatch_counts[kind] = _dispatch_counts.get(kind, 0) + 1
+
+
+def dispatch_counts() -> dict:
+    with _dispatch_lock:
+        return dict(_dispatch_counts)
+
+
+def reset_dispatch_counts():
+    with _dispatch_lock:
+        _dispatch_counts.clear()
+
+
 _NULL = __import__("contextlib").nullcontext()
 
 

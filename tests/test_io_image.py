@@ -429,3 +429,25 @@ def test_prefetch_thread_error_surfaces(tmp_path):
                                     batch_size=2, stored_shape=(5, 5))
     with pytest.raises(mx.base.MXNetError, match="prefetch thread"):
         next(iter(it))
+
+
+def test_image_record_iter_device_prefetch(rec_dataset):
+    """device_prefetch=True keeps one batch in flight to the device:
+    batches, values, epoch boundaries and reset must match the plain
+    path exactly (no dropped or duplicated batch around StopIteration)."""
+    rec_path, _, _ = rec_dataset
+    kwargs = dict(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                  batch_size=8, shuffle=False, preprocess_threads=2)
+    plain = mx.ImageRecordIter(**kwargs)
+    pre = mx.ImageRecordIter(device_prefetch=True, **kwargs)
+    for epoch in range(2):
+        got_plain = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                     for b in plain]
+        got_pre = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                   for b in pre]
+        assert len(got_pre) == len(got_plain) == 4
+        for (pd, pl), (qd, ql) in zip(got_plain, got_pre):
+            np.testing.assert_array_equal(pd, qd)
+            np.testing.assert_array_equal(pl, ql)
+        plain.reset()
+        pre.reset()

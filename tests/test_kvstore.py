@@ -513,3 +513,176 @@ def test_dist_async_bigarray_striping(monkeypatch):
     finally:
         for s in srvs:
             s.stop()
+
+
+def test_dist_async_stale_checkpoint_after_load(monkeypatch):
+    """save→load→train→save with 2 servers: get_states returns only keys
+    the shard OWNS, so the loaded (stale) copies of the other shard's
+    keys cannot overwrite the owner's fresh state in the merged save
+    (ADVICE r5, kvstore.py:629)."""
+    import tempfile, os as _os, pickle as _pkl
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srvs = [KVStoreServer(server_id=i, num_workers=1) for i in range(2)]
+    for s in srvs:
+        s.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", ",".join(
+            f"127.0.0.1:{s.port}" for s in srvs))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        kv = mx.kv.create('dist_async')
+        # find two keys owned by DIFFERENT servers
+        keys, i = [], 0
+        while len(keys) < 2:
+            k = f"w{i}"
+            if not keys or kv._conn_of(k) is not kv._conn_of(keys[0]):
+                keys.append(k)
+            i += 1
+        for k in keys:
+            kv.init(k, mx.nd.zeros((2,)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.9,
+                                          wd=0.0, rescale_grad=1.0))
+        out = mx.nd.zeros((2,))
+        for k in keys:
+            kv.push(k, mx.nd.ones((2,)))
+        kv.pull(keys[0], out=out)   # drain
+
+        fd, fname = tempfile.mkstemp()
+        _os.close(fd)
+        try:
+            kv.save_optimizer_states(fname)   # momentum after 1 step
+            kv.load_optimizer_states(fname)   # broadcast union to BOTH
+            # train further: each owner's momentum moves on
+            for k in keys:
+                kv.push(k, mx.nd.ones((2,)))
+            kv.pull(keys[0], out=out)
+            kv.save_optimizer_states(fname)
+            with open(fname, 'rb') as f:
+                states = _pkl.loads(f.read())
+            # every key's saved momentum is the FRESH 2-step value
+            # (mom2 = 0.9 * (-0.5) - 0.5 = -0.95), not the stale loaded
+            # 1-step copy (-0.5)
+            assert set(states) == set(keys)
+            for k in keys:
+                mom = np.asarray(states[k][0].asnumpy())
+                np.testing.assert_allclose(mom, -0.95, rtol=1e-6,
+                                           err_msg=str(k))
+        finally:
+            _os.unlink(fname)
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_dist_async_rejects_stripe_separator_keys(monkeypatch):
+    """User keys containing the reserved '@s' stripe separator are
+    rejected before they can collide with a stripe key (ADVICE r5,
+    kvstore.py:382)."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    from mxnet_tpu.base import MXNetError
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        kv = mx.kv.create('dist_async')
+        with pytest.raises(MXNetError, match="@s"):
+            kv.init('w@s0', mx.nd.ones((2,)))
+        with pytest.raises(MXNetError, match="@s"):
+            kv.push('w@s1', mx.nd.ones((2,)))
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_gluon_trainer_dist_async_resume_rescale(monkeypatch):
+    """Resume flow: load_states BEFORE the first step must not ship the
+    optimizer with the default rescale_grad=1.0 — the first step ships
+    it with the real 1/batch_size, then replays the buffered states
+    (ADVICE r5, trainer.py:363)."""
+    import tempfile, os as _os
+    import mxnet_tpu.gluon as gluon
+    from mxnet_tpu import autograd
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+
+        net = gluon.nn.Dense(1, use_bias=False, in_units=3,
+                             prefix='rsm_')
+        net.initialize()
+        net.weight.set_data(mx.nd.ones((1, 3)) * 2)
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1, 'momentum': 0.0,
+                            'wd': 0.0}, kvstore='dist_async')
+
+        fd, fname = tempfile.mkstemp()
+        _os.close(fd)
+        try:
+            # the resume pattern that used to poison the servers:
+            # save/load states BEFORE any step
+            tr.save_states(fname)
+            tr.load_states(fname)
+            assert not tr._kv_opt_sent   # optimizer NOT shipped yet
+        finally:
+            _os.unlink(fname)
+
+        x = mx.nd.ones((2, 3))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        w0 = net.weight.data().asnumpy().copy()
+        g = net.weight.grad().asnumpy().copy()
+        tr.step(batch_size=2)
+        # the server applied lr * grad / BATCH_SIZE — not lr * grad:
+        # rescale_grad was set before the optimizer was pickled over
+        np.testing.assert_allclose(
+            net.weight.data().asnumpy(), w0 - 0.1 * (g / 2), rtol=1e-5)
+        tr._kvstore.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_dist_async_load_save_relay_preserves_states(monkeypatch):
+    """Pure load→save relay on a FRESH server cluster (no init/push —
+    checkpoint migration): shards with an empty store return their
+    loaded states and the owner-preference merge keeps every key, so
+    the rewritten checkpoint is not silently emptied."""
+    import tempfile, os as _os, pickle as _pkl
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srvs = [KVStoreServer(server_id=i, num_workers=1) for i in range(2)]
+    for s in srvs:
+        s.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", ",".join(
+            f"127.0.0.1:{s.port}" for s in srvs))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        kv = mx.kv.create('dist_async')
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.9,
+                                          wd=0.0, rescale_grad=1.0))
+        fd, fname = tempfile.mkstemp()
+        _os.close(fd)
+        try:
+            ckpt = {'w0': (mx.nd.ones((2,)) * 3,),
+                    'w1': (mx.nd.ones((2,)) * 5,)}
+            with open(fname, 'wb') as f:
+                f.write(_pkl.dumps(ckpt))
+            kv.load_optimizer_states(fname)
+            kv.save_optimizer_states(fname)   # relay, no training
+            with open(fname, 'rb') as f:
+                relayed = _pkl.loads(f.read())
+            assert set(relayed) == {'w0', 'w1'}, relayed
+            np.testing.assert_allclose(relayed['w0'][0].asnumpy(), 3.0)
+            np.testing.assert_allclose(relayed['w1'][0].asnumpy(), 5.0)
+        finally:
+            _os.unlink(fname)
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
