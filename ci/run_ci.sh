@@ -21,6 +21,16 @@ echo "== tier-1: K-step scan == K eager steps (CPU bit-equivalence gate)"
 # -m "" so the slow-marked equivalence variants run here too
 JAX_PLATFORMS=cpu python -m pytest tests/test_run_steps.py -q -m ""
 
+echo "== fault-injection smoke (dist_async kill-and-recover)"
+# The transport recovery path (reconnect + replay + server dedup,
+# docs/ROBUSTNESS.md) must not rot: sever worker 0's channel mid-push
+# under the real launcher and require the exact post-barrier total —
+# a lost push or a double-applied replay both fail the arithmetic.
+# Time-boxed: a recovery regression typically presents as a HANG.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 \
+    python tests/dist/dist_fault_injection.py
+
 echo "== multichip dryrun (8 virtual devices)"
 JAX_PLATFORMS=cpu python - <<'PY'
 import cpu_pin

@@ -95,6 +95,28 @@ declare_env("MXNET_PROFILER_AUTOSTART", bool, False, "")
 declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
             "host worker threads for the data pipeline")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19, "")
+declare_env("MXNET_KVSTORE_RETRY_MAX", int, 8,
+            "dist_async channel: reconnect attempts per failure episode "
+            "before the channel fails hard")
+declare_env("MXNET_KVSTORE_RETRY_INITIAL_MS", int, 50,
+            "dist_async channel: first reconnect backoff delay")
+declare_env("MXNET_KVSTORE_RETRY_MAX_MS", int, 2000,
+            "dist_async channel: backoff delay cap")
+declare_env("MXNET_KVSTORE_RETRY_BACKOFF", float, 2.0,
+            "dist_async channel: backoff multiplier per attempt")
+declare_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 5.0,
+            "dist_async channel: seconds between liveness pings "
+            "(0 disables the heartbeat)")
+declare_env("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", float, 15.0,
+            "dist_async: silence past this marks a node dead "
+            "(num_dead_nodes; server barrier failure naming the rank)")
+declare_env("MXNET_KVSTORE_DEDUP_WINDOW", int, 8,
+            "server: cached replies per client channel for idempotent "
+            "replay acks after a reconnect (keep >= 2: a zombie "
+            "connection can serve its last request late)")
+declare_env("MXNET_CKPT_RENDEZVOUS_TIMEOUT", float, 600.0,
+            "async checkpoint: seconds rank 0 waits for every rank's "
+            "shard (and ranks wait for the index) before failing")
 declare_env("MXNET_DEFAULT_DTYPE", str, "float32",
             "default real dtype; set bfloat16 for TPU-preferred training")
 declare_env("MXNET_ZERO_STAGE", int, 0,

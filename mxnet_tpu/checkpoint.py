@@ -216,9 +216,14 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, poll_interval_s: float = 0.1,
-                 timeout_s: float = 600.0):
+                 timeout_s: float = None):
+        from .base import env
+        # rendezvous budget: slow shared filesystems (or a straggling
+        # rank) legitimately need more than the default 10 minutes —
+        # tune per deployment without touching code
         self._poll = poll_interval_s
-        self._timeout = timeout_s
+        self._timeout = float(env("MXNET_CKPT_RENDEZVOUS_TIMEOUT", 600.0)
+                              if timeout_s is None else timeout_s)
         self._thread = None
         self._err = None
         self._nonce = None  # run-unique, rank-agreed; set on first save
@@ -291,9 +296,14 @@ class AsyncCheckpointer:
                             break
                         if _time.monotonic() > deadline:
                             raise MXNetError(
-                                f"async checkpoint {prefix}: shards "
-                                f"{sorted(missing)} not current after "
-                                f"{self._timeout:.0f}s")
+                                f"async checkpoint {prefix}: shard "
+                                f"markers from rank(s) {sorted(missing)} "
+                                f"missing after {self._timeout:.0f}s — "
+                                f"those ranks never wrote this save's "
+                                f"shard (crashed rank or slow shared "
+                                f"fs?); raise "
+                                f"MXNET_CKPT_RENDEZVOUS_TIMEOUT if the "
+                                f"fs is just slow")
                         _time.sleep(self._poll)
                     _write_index(prefix, index, token=token)
                 else:
@@ -309,7 +319,12 @@ class AsyncCheckpointer:
                         if _time.monotonic() > deadline:
                             raise MXNetError(
                                 f"async checkpoint {prefix}: index not "
-                                f"current after {self._timeout:.0f}s")
+                                f"current after {self._timeout:.0f}s — "
+                                f"rank 0 never published this save's "
+                                f"index (its shard rendezvous names the "
+                                f"ranks it is missing); raise "
+                                f"MXNET_CKPT_RENDEZVOUS_TIMEOUT if the "
+                                f"shared fs is just slow")
                         _time.sleep(self._poll)
             except BaseException as e:  # noqa: BLE001 — surfaced at wait()
                 self._err = e

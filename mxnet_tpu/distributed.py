@@ -114,14 +114,43 @@ def broadcast_from_root(value):
         multihost_utils.process_allgather(np.asarray(value)))[0]
 
 
+# Liveness sources: objects exposing num_dead_nodes() (dist_async
+# kvstores register their heartbeat monitors here).  Weakrefs — a
+# forgotten store must not pin itself alive or keep reporting.
+_dead_node_sources: list = []
+
+
+def _register_dead_node_source(obj) -> None:
+    import weakref
+    _dead_node_sources.append(weakref.ref(obj))
+
+
 def num_dead_nodes() -> int:
     """Reference parity: KVStore::get_num_dead_node (kvstore.h:328).
 
-    SPMD has no partial-failure mode: the coordination-service heartbeat
-    turns any process death into a job-wide error, so a running job by
-    definition has zero dead nodes.  Recovery is restart-from-checkpoint
-    (docs/design/failure_recovery.md)."""
-    return 0
+    Two failure models meet here.  The SPMD collective path has no
+    partial-failure mode — the coordination-service heartbeat turns any
+    process death into a job-wide error, so that side contributes zero
+    by construction (recovery is restart-from-checkpoint,
+    docs/design/failure_recovery.md).  The ``dist_async`` parameter-
+    server path DOES fail partially: each worker↔server channel runs a
+    low-rate heartbeat, and every open dist_async kvstore registers
+    itself here — a server whose channel has gone silent past
+    ``MXNET_KVSTORE_HEARTBEAT_TIMEOUT`` counts as a dead node."""
+    total = 0
+    for ref in list(_dead_node_sources):
+        obj = ref()
+        if obj is None:
+            try:
+                _dead_node_sources.remove(ref)
+            except ValueError:
+                pass
+            continue
+        try:
+            total += obj.num_dead_nodes()
+        except Exception:  # noqa: BLE001 — a broken source is not a death
+            pass
+    return total
 
 
 def shutdown() -> None:

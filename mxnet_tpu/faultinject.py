@@ -1,0 +1,266 @@
+"""Deterministic fault injection for the dist kvstore transport.
+
+The reference's ps-lite layer survives transient transport faults
+(kvstore_dist.h:55 server-recovery mode); proving the same property here
+needs faults that happen ON DEMAND, at an exact message, every run.  This
+module is that switchboard: the kvstore client transport
+(``kvstore._ServerConn``) and server (``kvstore_server``) call the hooks
+below from ``_send_msg`` / ``_recv_msg`` / the accept loop, and a test —
+or an env-configured worker process — arms a plan:
+
+* **kill the connection** when the Nth data-channel message is about to
+  be sent (``before_send``), has just been sent (``after_send`` — the
+  request reached the server but its ack will be lost, so the replay
+  must be deduped), or while awaiting its ack (``on_recv``);
+* **delay acks** server-side (widens race windows deterministically);
+* **refuse connects** client-side and/or **drop accepts** server-side
+  (exercises connect/reconnect backoff).
+
+Heartbeat channels are exempt (the hooks are only called with
+``fi_role`` set on DATA-channel traffic), so a plan severs exactly the
+request/reply stream the test targets.
+
+Context managers for in-process tests::
+
+    with faultinject.kill_connection_after(3, point="after_send"):
+        kv.push("w", grad)          # 3rd message dies post-send
+        kv.pull("w", out=out)       # reconnect + replay, exactly-once
+
+Env activation for multi-process tests (read once at import; see
+``tests/dist/dist_fault_injection.py``)::
+
+    MXNET_FI_KILL_AFTER=5 MXNET_FI_KILL_POINT=after_send \
+    MXNET_FI_ONLY_RANK=0  python tools/launch.py -n 2 -s 1 ...
+
+All state is process-global and lock-guarded; ``reset()`` disarms
+everything.  No plan armed = every hook is a cheap no-op.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+KILL_POINTS = ("before_send", "after_send", "on_recv")
+
+_lock = threading.RLock()
+
+
+class _Plan:
+    """The armed fault plan + its counters (guarded by _lock)."""
+
+    def __init__(self):
+        self.kill_after = None          # 1-indexed message to kill at
+        self.kill_point = "before_send"
+        self.sent = 0                   # data-channel messages counted
+        self.kills_fired = 0
+        self.delay_ack_s = 0.0
+        self.refuse_connects = 0        # remaining connects to refuse
+        self.connects_refused = 0
+        self.refuse_accepts = 0         # remaining accepts to drop
+        self.accepts_refused = 0
+        self.only_rank = None           # limit the plan to one worker rank
+
+
+_plan = _Plan()
+
+
+def _rank_active():
+    if _plan.only_rank is None:
+        return True
+    return os.environ.get("DMLC_WORKER_ID", "0") == str(_plan.only_rank)
+
+
+def reset():
+    """Disarm every fault and zero the counters."""
+    global _plan
+    with _lock:
+        _plan = _Plan()
+
+
+def stats() -> dict:
+    """Counters for test assertions (kills fired, refusals served)."""
+    with _lock:
+        return {"kills_fired": _plan.kills_fired,
+                "connects_refused": _plan.connects_refused,
+                "accepts_refused": _plan.accepts_refused,
+                "messages_seen": _plan.sent}
+
+
+def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
+              refuse_connects=0, refuse_accepts=0, only_rank=None):
+    """Arm a plan directly (the non-context-manager form; multi-process
+    scripts use this after deciding per-rank what to inject)."""
+    if kill_point not in KILL_POINTS:
+        raise ValueError(f"kill_point must be one of {KILL_POINTS}, "
+                         f"got {kill_point!r}")
+    with _lock:
+        _plan.kill_after = int(kill_after) if kill_after else None
+        _plan.kill_point = kill_point
+        _plan.sent = 0
+        _plan.kills_fired = 0
+        _plan.delay_ack_s = float(delay_ack_s)
+        _plan.refuse_connects = int(refuse_connects)
+        _plan.connects_refused = 0
+        _plan.refuse_accepts = int(refuse_accepts)
+        _plan.accepts_refused = 0
+        _plan.only_rank = only_rank
+
+
+@contextlib.contextmanager
+def kill_connection_after(n, point="before_send"):
+    """Sever the data channel at the Nth message (1-indexed), once."""
+    if point not in KILL_POINTS:
+        raise ValueError(f"point must be one of {KILL_POINTS}, got {point!r}")
+    with _lock:
+        _plan.kill_after = int(n)
+        _plan.kill_point = point
+        _plan.sent = 0
+        _plan.kills_fired = 0
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.kill_after = None
+            _plan.sent = 0
+
+
+@contextlib.contextmanager
+def delay_acks(seconds):
+    """Sleep before every server reply (both sides keep working — this
+    only stretches the ack latency, deterministically)."""
+    with _lock:
+        prev, _plan.delay_ack_s = _plan.delay_ack_s, float(seconds)
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.delay_ack_s = prev
+
+
+@contextlib.contextmanager
+def refuse_connects(m):
+    """Fail the next M client connect attempts with ConnectionRefused."""
+    with _lock:
+        _plan.refuse_connects = int(m)
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.refuse_connects = 0
+
+
+@contextlib.contextmanager
+def refuse_accepts(m):
+    """Close the next M server-accepted connections immediately."""
+    with _lock:
+        _plan.refuse_accepts = int(m)
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.refuse_accepts = 0
+
+
+# -- transport hooks (called by kvstore / kvstore_server) --------------------
+def _sever(sock, point, n):
+    try:
+        sock.close()
+    except OSError:
+        pass
+    raise ConnectionError(
+        f"faultinject: connection killed at {point} of message #{n}")
+
+
+def client_send(sock):
+    """Before a data-channel message is written to the socket."""
+    with _lock:
+        if _plan.kill_after is None or not _rank_active():
+            return
+        _plan.sent += 1
+        if _plan.sent != _plan.kill_after \
+                or _plan.kill_point != "before_send":
+            return
+        _plan.kill_after = None     # fire once
+        _plan.kills_fired += 1
+        n = _plan.sent
+    _sever(sock, "before_send", n)
+
+
+def _client_post_send(sock, point):
+    with _lock:
+        if (_plan.kill_after is None or not _rank_active()
+                or _plan.sent != _plan.kill_after
+                or _plan.kill_point != point):
+            return
+        _plan.kill_after = None     # fire once
+        _plan.kills_fired += 1
+        n = _plan.sent
+    _sever(sock, point, n)
+
+
+def client_sent(sock):
+    """After a data-channel message hit the socket (the ack-loss case:
+    the server will apply the request, the client will never hear)."""
+    _client_post_send(sock, "after_send")
+
+
+def client_recv(sock):
+    """Before blocking on a data-channel reply."""
+    _client_post_send(sock, "on_recv")
+
+
+def client_connect(uri):
+    """Before a data-channel connect/reconnect attempt."""
+    with _lock:
+        if _plan.refuse_connects <= 0 or not _rank_active():
+            return
+        _plan.refuse_connects -= 1
+        _plan.connects_refused += 1
+    raise ConnectionRefusedError(f"faultinject: refused connect to {uri}")
+
+
+def server_accept(conn) -> bool:
+    """Called with every accepted connection; True = injected refusal
+    (the connection is already closed, skip serving it)."""
+    with _lock:
+        if _plan.refuse_accepts <= 0:
+            return False
+        _plan.refuse_accepts -= 1
+        _plan.accepts_refused += 1
+    try:
+        conn.close()
+    except OSError:
+        pass
+    return True
+
+
+def server_reply_delay():
+    """Called before every server reply send."""
+    with _lock:
+        d = _plan.delay_ack_s
+    if d > 0:
+        time.sleep(d)
+
+
+def _arm_from_env():
+    """One-shot env activation (multi-process tests: the launcher can't
+    reach into a worker, but its environment can)."""
+    ka = os.environ.get("MXNET_FI_KILL_AFTER")
+    rc = os.environ.get("MXNET_FI_REFUSE_CONNECTS")
+    ra = os.environ.get("MXNET_FI_REFUSE_ACCEPTS")
+    dl = os.environ.get("MXNET_FI_DELAY_ACK_MS")
+    orank = os.environ.get("MXNET_FI_ONLY_RANK")
+    if not (ka or rc or ra or dl):
+        return
+    configure(
+        kill_after=int(ka) if ka else None,
+        kill_point=os.environ.get("MXNET_FI_KILL_POINT", "before_send"),
+        delay_ack_s=float(dl) / 1000.0 if dl else 0.0,
+        refuse_connects=int(rc) if rc else 0,
+        refuse_accepts=int(ra) if ra else 0,
+        only_rank=int(orank) if orank else None)
+
+
+_arm_from_env()

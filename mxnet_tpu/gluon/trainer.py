@@ -105,6 +105,7 @@ class Trainer:
             # rescale_grad is set (ADVICE r5: trainer.py resume path).
             self._kv_opt_sent = False
             self._kv_deferred_states = None
+            self._kv_replay_states = None
             self._kv_param_inited = set()
             # ALL materialized params — including frozen (grad_req
             # 'null') ones — sync to the server-authoritative value, so
@@ -135,8 +136,13 @@ class Trainer:
         self._kv_opt_snapshot = (self._optimizer.lr,
                                  self._optimizer.rescale_grad)
         self._kv_opt_sent = True
-        if self._kv_deferred_states is not None:
-            blob, self._kv_deferred_states = self._kv_deferred_states, None
+        # replay loaded states AFTER the ship: set_optimizer replaced the
+        # server-side updater, which discarded any states a pre-first-
+        # step load_states applied — without the replay, a resume
+        # against live servers silently restarts the optimizer fresh
+        blob = self._kv_deferred_states or self._kv_replay_states
+        self._kv_deferred_states = self._kv_replay_states = None
+        if blob is not None:
             self._kvstore.load_optimizer_states_blob(blob)
 
     @property
@@ -653,6 +659,15 @@ class Trainer:
                 # optimizer with the REAL rescale_grad
                 try:
                     self._kvstore.load_optimizer_states(fname)
+                    # rank 0's first step RE-SHIPS the optimizer, which
+                    # replaces the server updater and wipes the states
+                    # just applied — keep the blob so the ship replays
+                    # it (tracked separately from _kv_deferred_states:
+                    # a pre-step save_states must keep returning the
+                    # LIVE server states, which other workers may have
+                    # advanced past this blob)
+                    with open(fname, 'rb') as fin:
+                        self._kv_replay_states = fin.read()
                 except MXNetError:
                     with open(fname, 'rb') as fin:
                         self._kv_deferred_states = fin.read()

@@ -18,17 +18,10 @@ import threading
 import numpy as np
 
 from .base import MXNetError, env
-from .io import DataBatch, DataDesc, DataIter
+from .io import DataBatch, DataDesc, DataIter, _ProducerError
 from .ndarray.ndarray import array as nd_array
 from . import recordio
 from . import native
-
-
-class _ProducerError:
-    """Exception captured in the prefetch thread, re-raised at next()."""
-
-    def __init__(self, exc):
-        self.exc = exc
 
 
 class ImageRecordIter(DataIter):
@@ -54,6 +47,7 @@ class ImageRecordIter(DataIter):
         self._device_prefetch = device_prefetch
         self._device = device
         self._dev_next = None
+        self._dev_err = None
         if not os.path.exists(path_imgrec):
             raise MXNetError(f"record file not found: {path_imgrec}")
         self.path = path_imgrec
@@ -250,9 +244,18 @@ class ImageRecordIter(DataIter):
             except queue.Empty:
                 pass
             self._worker.join(timeout=5)
+            if self._worker.is_alive():
+                # a wedged producer can't corrupt the NEW epoch (it holds
+                # the old queue/stop objects), but it is a leaked thread
+                # pinning file handles — say so instead of masking it
+                logging.warning(
+                    "ImageRecordIter.reset: previous prefetch worker did "
+                    "not stop within 5s (stuck in native decode/IO?); "
+                    "leaking the daemon thread")
         self._stop = threading.Event()
         self._done = False
         self._dev_next = None   # drop any in-flight device batch
+        self._dev_err = None    # ...and any parked prefetch failure
         order = self._order.copy()
         if self.shuffle:
             self._rng.shuffle(order)
@@ -270,7 +273,20 @@ class ImageRecordIter(DataIter):
         host<->device crossings on a remote-attached chip)."""
         if self._done:
             raise StopIteration
-        item = self._queue.get()
+        while True:
+            try:
+                item = self._queue.get(timeout=1.0)
+                break
+            except queue.Empty:
+                # the producer posts a sentinel even on failure (its
+                # finally clause) — an empty queue with a DEAD worker
+                # means the thread was killed outright; hanging here
+                # forever would silently wedge training
+                if self._worker is not None and not self._worker.is_alive():
+                    self._done = True
+                    raise MXNetError(
+                        "ImageRecordIter: prefetch worker died without "
+                        "reporting a result — cannot continue the epoch")
         if item is None:
             self._done = True
             raise StopIteration
@@ -299,6 +315,9 @@ class ImageRecordIter(DataIter):
 
     def next(self):
         if self._device_prefetch:
+            if self._dev_err is not None:
+                err, self._dev_err = self._dev_err, None
+                raise err
             cur = self._dev_next
             if cur is None:
                 cur = self._device_batch()   # first call of the epoch
@@ -308,6 +327,12 @@ class ImageRecordIter(DataIter):
                 self._dev_next = self._device_batch()
             except StopIteration:
                 self._dev_next = None
+            except Exception as e:  # noqa: BLE001 — t+1's pipeline died,
+                # but batch t in hand is GOOD: deliver it, raise on the
+                # NEXT call (dropping cur would silently consume a batch
+                # from the record stream without ever training on it)
+                self._dev_next = None
+                self._dev_err = e
             return cur
         data, label, pad = self.next_raw()
         return DataBatch([nd_array(data)], [nd_array(label)], pad=pad,

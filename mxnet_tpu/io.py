@@ -70,6 +70,15 @@ class DataBatch:
                f"label shapes: {label_shapes}"
 
 
+class _ProducerError:
+    """Exception captured in a background prefetch thread, re-raised on
+    the CONSUMER side at the next ``next()`` — a dead worker must fail
+    the epoch loudly, never truncate it silently."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 class DataIter:
     """Iterator protocol (reference: io.py:176 DataIter)."""
 
@@ -209,6 +218,13 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = batch
                 except StopIteration:
                     self.next_batch[i] = None
+                except BaseException as e:  # noqa: BLE001 — crossing a
+                    # thread: park the failure for the consumer.  Without
+                    # this the thread dies before setting data_ready and
+                    # every later next() hangs forever — or, were the
+                    # event set, the epoch would just END early: silent
+                    # truncation of the training set.
+                    self.next_batch[i] = _ProducerError(e)
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
         self.prefetch_threads = [
@@ -273,6 +289,14 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        for batch in self.next_batch:
+            if isinstance(batch, _ProducerError):
+                # leave the error parked (data_ready stays set, taken
+                # stays clear): every subsequent next() re-raises instead
+                # of handing the worker more work
+                raise MXNetError(
+                    "PrefetchingIter: prefetch worker failed: %r"
+                    % (batch.exc,)) from batch.exc
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"

@@ -188,6 +188,14 @@ class KVStore:
         from . import distributed as _dist
         _dist.barrier("mxnet_tpu_kvstore_barrier")
 
+    def num_dead_nodes(self) -> int:
+        """reference: kvstore.h:328 KVStore::get_num_dead_node.  SPMD /
+        local stores have no partial-failure mode of their own; report
+        the job-wide count (dist_async channels register theirs with
+        :func:`distributed.num_dead_nodes`)."""
+        from . import distributed as _dist
+        return _dist.num_dead_nodes()
+
     def _send_command_to_servers(self, head, body):
         pass  # sync/allreduce types have no server processes
         # (KVStoreDistAsync overrides this with a real send)
@@ -288,47 +296,98 @@ class _ServerConn:
     later ``pull`` on the same server is guaranteed to observe every
     prior push from THIS worker — per-server FIFO is exactly the ordering
     the reference's per-key engine dependency chain provides.
+
+    **Fault tolerance** (reference: ps-lite resender + the server-
+    recovery mode, kvstore_dist.h:55).  Every request travels in an
+    envelope ``("req", (rank, nonce), seq, msg)``; on transport death
+    the IO thread reconnects with capped exponential backoff
+    (``MXNET_KVSTORE_RETRY_*``) and REPLAYS the unacked request — the
+    server's per-client dedup window acks an already-applied replay
+    idempotently, so a connection killed between a push's send and its
+    ack still applies that push exactly once.  Retries are bounded:
+    exhausting ``MXNET_KVSTORE_RETRY_MAX`` reconnect attempts surfaces
+    the original transport error as the permanent channel failure.
+
+    **Liveness.**  A low-rate heartbeat thread pings the server on its
+    OWN socket (the data channel legitimately blocks unboundedly in
+    barrier waits); ``is_dead()`` reports silence past
+    ``MXNET_KVSTORE_HEARTBEAT_TIMEOUT`` and feeds ``num_dead_nodes()``.
     """
 
     def __init__(self, uri, connect_timeout=60.0):
+        import time
+        import uuid
+        self._uri = uri
+        host, port = uri.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        # channel identity: (worker_rank, nonce).  The nonce survives
+        # reconnects (so replays dedup) but differs between channel
+        # INSTANCES — two clients of the same rank (relaunch, tests)
+        # must never collide in the server's dedup window.
+        self._client_id = (self._rank, uuid.uuid4().hex[:16])
+        self._next_seq = 0
+        from .base import env as _env
+        self._retry_max = int(_env("MXNET_KVSTORE_RETRY_MAX", 8))
+        self._retry_initial = float(
+            _env("MXNET_KVSTORE_RETRY_INITIAL_MS", 50)) / 1000.0
+        self._retry_cap = float(
+            _env("MXNET_KVSTORE_RETRY_MAX_MS", 2000)) / 1000.0
+        self._retry_backoff = float(_env("MXNET_KVSTORE_RETRY_BACKOFF", 2.0))
+        self._retry_attempts = 0
+        self._closing = threading.Event()
+        self._last_transport_err = None
+        self._sock = self._dial(connect_timeout)
+        self._q = queue.Queue()
+        self._err = None
+        self._thread = threading.Thread(target=self._io_loop, daemon=True)
+        self._thread.start()
+        self._hb_interval = float(
+            _env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 5.0))
+        self._hb_timeout = float(
+            _env("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", 15.0))
+        self._hb_last_ack = time.monotonic()
+        self._hb_thread = None
+        if self._hb_interval > 0:
+            self._hb_thread = threading.Thread(target=self._hb_loop,
+                                               daemon=True)
+            self._hb_thread.start()
+
+    def _dial(self, connect_timeout):
         import socket
         import time
-        host, port = uri.rsplit(":", 1)
+        from . import faultinject
         deadline = time.monotonic() + connect_timeout
         while True:
             try:
-                self._sock = socket.create_connection(
-                    (host, int(port)), timeout=60)
+                faultinject.client_connect(self._uri)
+                sock = socket.create_connection(self._addr, timeout=60)
                 # the connect timeout must NOT linger as a recv timeout:
                 # a barrier reply legitimately blocks until every worker
                 # arrives (unbounded); transport death still surfaces as
                 # ECONNRESET/EOF when the server process dies
-                self._sock.settimeout(None)
-                break
+                sock.settimeout(None)
+                return sock
             except (ConnectionRefusedError, OSError):
                 # the server process is still importing/binding — workers
                 # and servers start simultaneously (tools/launch.py)
                 if time.monotonic() >= deadline:
                     raise MXNetError(
-                        f"could not reach kvstore server at {uri} "
+                        f"could not reach kvstore server at {self._uri} "
                         f"within {connect_timeout}s")
                 time.sleep(0.2)
-        self._q = queue.Queue()
-        self._err = None
-        self._thread = threading.Thread(target=self._io_loop, daemon=True)
-        self._thread.start()
 
     def _io_loop(self):
-        from .kvstore_server import _send_msg, _recv_msg
         while True:
             item = self._q.get()
             if item is None:
                 return
             msg, pending = item
+            envelope = ("req", self._client_id, self._next_seq, msg)
+            self._next_seq += 1
             try:
-                _send_msg(self._sock, msg)
-                status, payload = _recv_msg(self._sock)
-            except Exception as exc:  # noqa: BLE001 — transport death:
+                status, payload = self._rpc(envelope)
+            except Exception as exc:  # noqa: BLE001 — retries exhausted:
                 self._err = exc       # poison the channel for good
                 if pending is not None:
                     pending.error = exc
@@ -348,6 +407,120 @@ class _ServerConn:
                 pending.value = payload
             if pending is not None:
                 pending.done.set()
+
+    def _rpc(self, envelope):
+        """One request → its reply, reconnecting and replaying through
+        transport faults.  The channel is strictly serial (send, await
+        ack, next), so the replay set is exactly the one unacked
+        envelope — FIFO order is preserved across reconnects."""
+        from .kvstore_server import _send_msg, _recv_msg
+        from . import profiler as _prof
+        replaying = False
+        while True:
+            try:
+                if self._sock is None:
+                    raise ConnectionError("channel has no connection")
+                _send_msg(self._sock, envelope, fi_role="client")
+                reply = _recv_msg(self._sock, fi_role="client")
+            except Exception as exc:  # noqa: BLE001 — transport fault
+                if self._closing.is_set():
+                    raise
+                self._last_transport_err = exc
+                self._reconnect(exc)  # raises once retries are exhausted
+                replaying = True
+                _prof.record_channel_event("kvstore.replay")
+                continue
+            # a complete round trip proves the transport healthy again
+            self._retry_attempts = 0
+            if replaying:
+                _prof.record_channel_event("kvstore.replay_acked")
+            return reply
+
+    def _reconnect(self, cause):
+        """Re-establish the data socket with capped exponential backoff.
+        ``_retry_attempts`` persists across calls and only resets on a
+        successful round trip, so a flapping server cannot stretch one
+        failure episode past MXNET_KVSTORE_RETRY_MAX total attempts."""
+        import socket
+        from . import faultinject
+        from . import profiler as _prof
+        try:
+            self._sock.close()
+        except (OSError, AttributeError):
+            pass
+        self._sock = None
+        last = cause
+        while True:
+            if self._retry_attempts >= self._retry_max:
+                _prof.record_channel_event("kvstore.hard_fail")
+                raise MXNetError(
+                    f"kvstore server channel to {self._uri} died "
+                    f"({cause!r}) and could not be re-established after "
+                    f"{self._retry_max} reconnect attempts (last error: "
+                    f"{last!r}); tune MXNET_KVSTORE_RETRY_MAX / "
+                    f"MXNET_KVSTORE_RETRY_INITIAL_MS / "
+                    f"MXNET_KVSTORE_RETRY_MAX_MS") from cause
+            self._retry_attempts += 1
+            _prof.record_channel_event("kvstore.retry")
+            delay = self._retry_initial * (
+                self._retry_backoff ** (self._retry_attempts - 1))
+            if self._closing.wait(min(delay, self._retry_cap)):
+                raise MXNetError(
+                    f"kvstore channel to {self._uri} closed during "
+                    f"reconnect") from cause
+            try:
+                faultinject.client_connect(self._uri)
+                sock = socket.create_connection(self._addr, timeout=60)
+                sock.settimeout(None)
+                self._sock = sock
+                _prof.record_channel_event("kvstore.reconnect")
+                return
+            except (ConnectionRefusedError, OSError) as exc:
+                last = exc
+                continue
+
+    # -- liveness ------------------------------------------------------------
+    def _hb_loop(self):
+        import socket
+        import time
+        from .kvstore_server import _send_msg, _recv_msg
+        from . import profiler as _prof
+        sock = None
+        while not self._closing.is_set():
+            try:
+                if sock is None:
+                    sock = socket.create_connection(
+                        self._addr, timeout=self._hb_timeout)
+                    sock.settimeout(self._hb_timeout)
+                _send_msg(sock, ("ping", self._rank))
+                status, _payload = _recv_msg(sock)
+                if status == "ok":
+                    self._hb_last_ack = time.monotonic()
+                    _prof.record_channel_event("kvstore.heartbeat")
+            except Exception:  # noqa: BLE001 — the miss IS the signal
+                _prof.record_channel_event("kvstore.heartbeat_miss")
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+            self._closing.wait(self._hb_interval)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def is_dead(self) -> bool:
+        """True when the server has not acked a heartbeat within
+        MXNET_KVSTORE_HEARTBEAT_TIMEOUT.  Barrier waits on the data
+        channel stay unbounded by design; SILENCE is what this
+        detects."""
+        import time
+        if self._hb_thread is None or self._closing.is_set():
+            return False
+        return (time.monotonic() - self._hb_last_ack) > self._hb_timeout
 
     def request(self, msg):
         """Enqueue and return the :class:`_Pending` reply handle — lets a
@@ -375,7 +548,15 @@ class _ServerConn:
         from .kvstore_server import K_SYNC_MODE
         self.submit(("command", K_SYNC_MODE, None), wait=True)
 
-    def close(self):
+    def close(self, join_timeout=10.0, retry=True):
+        """Drain, stop the IO + heartbeat threads, close the socket.
+
+        ``retry=False`` skips reconnect attempts during the final drain —
+        the caller KNOWS the server is gone (it just sent kStopServer),
+        so backing off against a deliberately stopped server only delays
+        teardown."""
+        if not retry:
+            self._closing.set()   # _rpc raises instead of reconnecting
         # drain before closing: a still-queued fire-and-forget push must
         # reach the server, not die with the socket ("a lost gradient
         # must not pass silently")
@@ -383,11 +564,22 @@ class _ServerConn:
             self.flush()
         except MXNetError:
             pass  # channel already dead — nothing left to save
+        self._closing.set()       # aborts any in-flight backoff sleep
         self._q.put(None)
-        self._thread.join(timeout=10)
+        self._thread.join(timeout=join_timeout)
+        if self._thread.is_alive():
+            # a silent leak here hid every wedged-channel teardown; name
+            # the channel and its last known failure instead
+            import warnings
+            last = self._err or self._last_transport_err
+            warnings.warn(
+                f"kvstore channel to {self._uri}: IO thread did not stop "
+                f"within {join_timeout:.0f}s — likely blocked awaiting a "
+                f"server reply (last channel error: {last!r}); leaking "
+                f"the daemon thread", RuntimeWarning, stacklevel=2)
         try:
             self._sock.close()
-        except OSError:
+        except (OSError, AttributeError):
             pass
 
 
@@ -441,6 +633,10 @@ class KVStoreDistAsync(KVStore):
         self._bigarray_bound = int(float(os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")))
         self._stripes: Dict[str, list] = {}  # key -> row boundaries
+        self._closed = False
+        # silence on any worker↔server channel becomes visible job-wide
+        from . import distributed as _dist
+        _dist._register_dead_node_source(self)
 
     # -- identity (no jax.distributed needed: workers are independent) ------
     @property
@@ -690,13 +886,24 @@ class KVStoreDistAsync(KVStore):
 
     def barrier(self):
         """Flush this worker's outstanding pushes, then rendezvous on
-        server 0 (reference: Postoffice::Barrier after engine drain)."""
+        server 0 (reference: Postoffice::Barrier after engine drain).
+        The wait is unbounded, but a participant that dies mid-wait is
+        NAMED: the server fails the barrier for everyone else once the
+        missing rank's heartbeat goes silent past the timeout."""
         for c in self._conns:
             c.flush()
         self._conns[0].submit(("barrier",), wait=True)
 
+    def num_dead_nodes(self) -> int:
+        """Number of server channels whose heartbeat has gone silent
+        (reference: kvstore.h:328 get_num_dead_node — finally real)."""
+        if self._closed:
+            return 0
+        return sum(1 for c in self._conns if c.is_dead())
+
     def close(self, stop_servers=False):
         from .kvstore_server import K_STOP_SERVER
+        self._closed = True
         # deliver queued pushes while the servers are still guaranteed up
         for c in self._conns:
             try:
@@ -713,7 +920,10 @@ class KVStoreDistAsync(KVStore):
                 except MXNetError:
                     pass
         for c in self._conns:
-            c.close()
+            # after kStopServer the server is DELIBERATELY gone:
+            # reconnect backoff during the final drain would only stall
+            # teardown (retry=False makes faults fail fast there)
+            c.close(retry=not stop_servers)
 
 
 def create(name="local") -> KVStore:

@@ -38,8 +38,13 @@ import socket
 import struct
 import sys
 import threading
+import time
+from collections import OrderedDict
 
 import numpy as np
+
+from . import faultinject
+from .base import env as _env
 
 # reference command codes (kvstore_dist_server.h:44-45): kStopServer=-1
 # tears down, kSyncMode=-2 switches the reference server to sync
@@ -52,9 +57,19 @@ K_STOP_SERVER = -1
 K_SYNC_MODE = -2
 
 
-def _send_msg(sock, obj):
+def _send_msg(sock, obj, fi_role=None):
+    """Length-prefixed pickle send.  ``fi_role`` tags DATA-channel
+    traffic for the deterministic fault-injection hooks ("client" may be
+    severed at an exact message, "server" may delay acks); untagged
+    sends (heartbeats) are exempt so a plan hits only what it targets."""
+    if fi_role == "client":
+        faultinject.client_send(sock)
+    elif fi_role == "server":
+        faultinject.server_reply_delay()
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(struct.pack(">Q", len(payload)) + payload)
+    if fi_role == "client":
+        faultinject.client_sent(sock)
 
 
 def _recv_exact(sock, n):
@@ -67,7 +82,9 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, fi_role=None):
+    if fi_role == "client":
+        faultinject.client_recv(sock)
     (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
     return pickle.loads(_recv_exact(sock, n))
 
@@ -83,7 +100,7 @@ class KVStoreServer:
     """
 
     def __init__(self, server_id=0, num_workers=1,
-                 host="127.0.0.1", port=0):
+                 host="127.0.0.1", port=0, hb_timeout=None):
         self.server_id = server_id
         self.num_workers = num_workers
         self._store = {}          # key -> NDArray (host CPU)
@@ -92,12 +109,39 @@ class KVStoreServer:
         self._barrier_cv = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
+        self._barrier_ranks = set()   # ranks currently arrived
         self._stop = threading.Event()
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.5)
         self.port = self._listener.getsockname()[1]
         self._threads = []
         self._conns = []
+        # exactly-once: per-client (rank, nonce) dedup window.  A client
+        # that reconnects replays its unacked request with the SAME
+        # (client_id, seq); the cached reply is returned without
+        # re-applying — a replayed push that was already applied is
+        # acked idempotently (reference analog: ps-lite resender).
+        # The channel is serial, so the live replay set is ONE envelope —
+        # but the window must stay >= 2: a zombie connection's handler
+        # can process its final buffered request AFTER the replay (and
+        # the client's next request) completed on the new connection,
+        # and that late duplicate must still hit the cache.  Pull
+        # replies embed whole arrays, so the window is deliberately
+        # small; client windows are LRU-capped too (a relaunched client
+        # arrives under a fresh nonce and must not pin the old one).
+        self._dedup_window = int(_env("MXNET_KVSTORE_DEDUP_WINDOW", 8))
+        self._dedup_clients = 256
+        self._dedup = OrderedDict()   # client_id -> {inflight, replies}
+        self._dedup_cv = threading.Condition()
+        self.dedup_count = 0          # replays served from the window
+        # liveness: last ping (or enveloped request) per worker rank.
+        # Barrier waits stay UNBOUNDED by design — but a rank that was
+        # alive and went silent past hb_timeout turns the wait into an
+        # error naming the missing ranks instead of blocking forever.
+        self._hb_timeout = float(
+            hb_timeout if hb_timeout is not None
+            else _env("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", 15.0))
+        self._hb_seen = {}            # rank -> last monotonic timestamp
 
     # -- request handlers ----------------------------------------------------
     def _apply_push(self, key, arr):
@@ -116,8 +160,14 @@ class KVStoreServer:
             else:
                 stored._set_data(grad._data)
 
-    def _handle(self, msg):
+    def _handle(self, msg, rank=None):
         op = msg[0]
+        if op == "ping":
+            # heartbeat: out-of-band liveness (its own connection — the
+            # data channel may legitimately block in a barrier)
+            if len(msg) > 1:
+                self._note_ping(msg[1])
+            return None
         if op == "init":
             # first init wins; later inits of the same key are ignored
             # (reference: the server keeps the first-arriving value,
@@ -189,9 +239,78 @@ class KVStoreServer:
             _, head, body = msg
             return self._command(head, body)
         if op == "barrier":
-            self._barrier()
+            self._barrier(rank)
             return None
         raise ValueError(f"unknown op {op!r}")
+
+    # -- exactly-once delivery ----------------------------------------------
+    def _exactly_once(self, client_id, seq, inner):
+        """Serve one enveloped request with at-most-once application.
+
+        A replayed (client_id, seq) that already completed returns the
+        CACHED reply (``dedup_count`` ticks); one still in flight on
+        another connection thread (e.g. the original connection died
+        while its handler blocks in a barrier) is WAITED for, never
+        double-entered — the replay then also gets the cached reply."""
+        cid = tuple(client_id) if isinstance(client_id, list) else client_id
+        if isinstance(cid, tuple) and cid:
+            self._note_ping(cid[0])   # any request is liveness evidence
+        with self._dedup_cv:
+            st = self._dedup.get(cid)
+            if st is None:
+                st = self._dedup[cid] = {"inflight": set(),
+                                         "replies": OrderedDict()}
+            self._dedup.move_to_end(cid)
+            while len(self._dedup) > self._dedup_clients:
+                old_cid, old_st = next(iter(self._dedup.items()))
+                if old_st["inflight"]:
+                    break   # never drop a window with work in flight
+                self._dedup.popitem(last=False)
+            while seq in st["inflight"] and not self._stop.is_set():
+                self._dedup_cv.wait(0.1)
+            if seq in st["replies"]:
+                self.dedup_count += 1
+                return st["replies"][seq]
+            st["inflight"].add(seq)
+        rank = cid[0] if isinstance(cid, tuple) and cid else None
+        reply = None
+        try:
+            try:
+                reply = ("ok", self._handle(inner, rank=rank))
+            except Exception as exc:  # noqa: BLE001 — to the client
+                reply = ("err", f"{type(exc).__name__}: {exc}")
+        finally:
+            # cache + un-inflight atomically: a replay racing this exact
+            # moment must see either "in flight" or the cached reply,
+            # never a gap it could re-apply through
+            with self._dedup_cv:
+                st["inflight"].discard(seq)
+                if reply is not None:
+                    st["replies"][seq] = reply
+                    while len(st["replies"]) > self._dedup_window:
+                        st["replies"].popitem(last=False)
+                self._dedup_cv.notify_all()
+        return reply
+
+    # -- liveness ------------------------------------------------------------
+    def _note_ping(self, rank):
+        try:
+            rank = int(rank)
+        except (TypeError, ValueError):
+            return
+        with self._barrier_cv:
+            self._hb_seen[rank] = time.monotonic()
+
+    def _silent_ranks(self):
+        """Worker ranks that HAVE been heard from and then went silent
+        past hb_timeout.  A rank that never pinged is indistinguishable
+        from one that is still starting up — never declared dead.
+        Caller holds _barrier_cv."""
+        if self._hb_timeout <= 0:
+            return set()
+        now = time.monotonic()
+        return {r for r, t in self._hb_seen.items()
+                if r < self.num_workers and now - t > self._hb_timeout}
 
     def _command(self, head, body):
         """reference kvstore_dist_server.h:149-162 ``CommandHandle``."""
@@ -207,19 +326,41 @@ class KVStoreServer:
             return None
         return None  # kSyncMode etc.: accepted, no-op in the async server
 
-    def _barrier(self):
+    def _barrier(self, rank=None):
         """Count one arrival per worker; release everyone when all
-        ``num_workers`` are in (reference: Postoffice::Barrier)."""
+        ``num_workers`` are in (reference: Postoffice::Barrier).
+
+        The wait itself stays UNBOUNDED (a slow worker is legal) — but
+        when the heartbeat registry shows a missing rank went SILENT
+        past hb_timeout, the wait fails naming the dead ranks instead of
+        blocking the surviving workers forever."""
         with self._barrier_cv:
             gen = self._barrier_gen
+            if rank is not None:
+                self._barrier_ranks.add(rank)
             self._barrier_count += 1
             if self._barrier_count >= self.num_workers:
                 self._barrier_count = 0
                 self._barrier_gen += 1
+                self._barrier_ranks = set()
                 self._barrier_cv.notify_all()
                 return
             while self._barrier_gen == gen and not self._stop.is_set():
                 self._barrier_cv.wait(0.1)
+                if self._barrier_gen != gen or self._stop.is_set():
+                    break
+                silent = self._silent_ranks() - self._barrier_ranks
+                if silent:
+                    arrived = sorted(self._barrier_ranks)
+                    # unwind this arrival so a later retry re-enters
+                    # cleanly once the dead rank is replaced
+                    self._barrier_count -= 1
+                    if rank is not None:
+                        self._barrier_ranks.discard(rank)
+                    raise RuntimeError(
+                        "barrier timed out: worker rank(s) %s missing "
+                        "(no heartbeat for > %.1fs); arrived rank(s): %s"
+                        % (sorted(silent), self._hb_timeout, arrived))
 
     # -- connection plumbing -------------------------------------------------
     def _serve_conn(self, conn):
@@ -230,10 +371,31 @@ class KVStoreServer:
                         msg = _recv_msg(conn)
                     except (ConnectionError, OSError):
                         return
+                    if msg and msg[0] == "req":
+                        # client envelope: (op, client_id, seq, inner) —
+                        # the exactly-once path (reconnect + replay)
+                        _, cid, seq, inner = msg
+                        reply = self._exactly_once(cid, seq, inner)
+                        role = "server"
+                    else:
+                        # raw message (heartbeat pings, legacy callers):
+                        # NOT fault-injection targetable — a delay-acks
+                        # plan must never stall the liveness signal
+                        # (faultinject.py's heartbeat-exemption contract)
+                        try:
+                            reply = ("ok", self._handle(msg))
+                        except Exception as exc:  # noqa: BLE001
+                            reply = ("err",
+                                     f"{type(exc).__name__}: {exc}")
+                        role = None
                     try:
-                        _send_msg(conn, ("ok", self._handle(msg)))
-                    except Exception as exc:  # noqa: BLE001 — to the client
-                        _send_msg(conn, ("err", f"{type(exc).__name__}: {exc}"))
+                        _send_msg(conn, reply, fi_role=role)
+                    except (ConnectionError, OSError):
+                        # the client died / reconnected while we worked:
+                        # the reply stays in the dedup window, so the
+                        # replay on the new connection is acked from
+                        # cache — drop this connection only
+                        return
         except Exception:  # noqa: BLE001 — conn died mid-reply
             pass
 
@@ -247,6 +409,8 @@ class KVStoreServer:
                     continue
                 except OSError:
                     break
+                if faultinject.server_accept(conn):
+                    continue   # injected refusal: already closed
                 t = threading.Thread(target=self._serve_conn, args=(conn,),
                                      daemon=True)
                 t.start()

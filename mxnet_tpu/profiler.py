@@ -198,6 +198,34 @@ def reset_dispatch_counts():
         _dispatch_counts.clear()
 
 
+# -- kvstore channel counters ------------------------------------------------
+# One counter per transport-resilience event on the dist kvstore channel
+# (retry, reconnect, replay, replay_acked, hard_fail, heartbeat,
+# heartbeat_miss).  Separate from the dispatch counters on purpose: the
+# multi-step-driver tests assert dispatch_counts() by EXACT equality, and
+# a channel retry must never be able to fail a dispatch-contract test.
+# tests/test_faultinject.py asserts recovery paths against these.
+_channel_counts: dict = {}
+_channel_lock = threading.Lock()
+
+
+def record_channel_event(kind: str):
+    """Count one kvstore transport event of ``kind`` (always on — a dict
+    increment is noise next to the socket round-trip it marks)."""
+    with _channel_lock:
+        _channel_counts[kind] = _channel_counts.get(kind, 0) + 1
+
+
+def channel_counts() -> dict:
+    with _channel_lock:
+        return dict(_channel_counts)
+
+
+def reset_channel_counts():
+    with _channel_lock:
+        _channel_counts.clear()
+
+
 _NULL = __import__("contextlib").nullcontext()
 
 

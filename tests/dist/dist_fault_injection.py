@@ -1,0 +1,78 @@
+"""Multi-process kill-and-recover smoke for the dist_async transport.
+
+Run via:  python tools/launch.py -n 2 -s 1 \
+              python tests/dist/dist_fault_injection.py
+
+Worker 0's channel to the server is DETERMINISTICALLY severed mid-push
+(faultinject kill at an exact message, after the bytes left — the
+ack-loss case).  The channel must reconnect, replay the unacked request,
+and the server's dedup window must ack the replay WITHOUT re-applying.
+Proof is arithmetic: SGD updates commute, so after a barrier the weight
+equals -lr * (sum of every worker's pushes) EXACTLY — a lost push or a
+double-applied replay both break the total.  The in-process twins (and
+the ≥2-kill-point, bit-identical run_steps variant) live in
+tests/test_faultinject.py; this exercises the same path across real
+process and socket boundaries under the real launcher.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# millisecond backoff: CI smoke must recover in test time
+os.environ.setdefault("MXNET_KVSTORE_RETRY_INITIAL_MS", "20")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX_MS", "200")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject, profiler
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    shape = (3, 4)
+
+    kv.init("w", mx.nd.zeros(shape))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0,
+                                      momentum=0.0))
+    kv.barrier()
+
+    if rank == 0:
+        # sever the data channel at the 3rd message from here — inside
+        # the push stream, after the bytes left (ack-loss: the replayed
+        # push must be deduped server-side, not applied twice)
+        faultinject.configure(kill_after=3, kill_point="after_send")
+
+    pushes = 5
+    for _ in range(pushes):
+        kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    kv.barrier()   # flush (forces the replay through) + rendezvous
+
+    if rank == 0:
+        counts = profiler.channel_counts()
+        assert counts.get("kvstore.reconnect", 0) >= 1, \
+            f"rank 0 never reconnected: {counts}"
+        assert faultinject.stats()["kills_fired"] == 1
+
+    pulled = mx.nd.zeros(shape)
+    kv.pull("w", out=pulled)
+    total = pushes * sum(r + 1 for r in range(nworker))
+    np.testing.assert_allclose(
+        pulled.asnumpy(), np.full(shape, -0.1 * total, np.float32),
+        rtol=1e-5, err_msg="push lost or replay double-applied")
+
+    kv.barrier()
+    kv.close()
+    print("dist_fault_injection rank %d/%d OK (kill-and-recover exact)"
+          % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,303 @@
+"""Fault-tolerant kvstore transport: deterministic kill-and-recover.
+
+The dist_async channel must survive a severed worker↔server connection:
+reconnect with capped backoff (``MXNET_KVSTORE_RETRY_*``), replay the
+unacked request, and rely on the server's per-client dedup window so a
+replayed push that was ALREADY applied is acked idempotently — training
+through a connection kill stays bit-identical to an uninterrupted run
+(the transport-level analog of the process-level supervisor story,
+tests/test_supervisor.py; reference: ps-lite resender + server-recovery
+mode, kvstore_dist.h:55).
+
+Faults come from mxnet_tpu.faultinject — env/context-manager driven and
+exact-message deterministic, so every scenario here reproduces.
+"""
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore_server import KVStoreServer, _send_msg, _recv_msg
+
+SHAPE = (2, 3)
+
+K = 6
+BATCH = 4
+NIN = 6
+NCLASS = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_plans():
+    """No fault plan may leak across tests (module-global state)."""
+    faultinject.reset()
+    profiler.reset_channel_counts()
+    yield
+    faultinject.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retry(monkeypatch):
+    """Millisecond backoff so recovery paths run in test time; heartbeat
+    off unless a test opts in (fewer background threads)."""
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "8")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0")
+
+
+def _serve(monkeypatch, num_workers=1, **kw):
+    srv = KVStoreServer(server_id=0, num_workers=num_workers, **kw)
+    srv.start_background()
+    monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+    monkeypatch.setenv("DMLC_NUM_WORKER", str(num_workers))
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    return srv
+
+
+def test_kill_before_send_reconnects_and_replays(monkeypatch):
+    """Connection severed BEFORE the request leaves: reconnect + replay
+    delivers it for the first time — applied once, no dedup needed."""
+    srv = _serve(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        with faultinject.kill_connection_after(1, point="before_send"):
+            kv.push('w', mx.nd.ones(SHAPE) * 3)   # this message dies
+            kv.pull('w', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 3.0)
+        assert srv.dedup_count == 0
+        assert faultinject.stats()["kills_fired"] == 1
+        counts = profiler.channel_counts()
+        assert counts.get("kvstore.reconnect", 0) >= 1
+        assert counts.get("kvstore.replay_acked", 0) >= 1
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+@pytest.mark.parametrize("point", ["after_send", "on_recv"])
+def test_kill_after_send_dedups_replayed_push(monkeypatch, point):
+    """Connection severed AFTER the push reached the server (its ack is
+    lost): the replay must be acked from the dedup window, NOT applied a
+    second time — server-side SGD would otherwise double-step."""
+    srv = _serve(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.ones(SHAPE))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.0,
+                                          wd=0.0, rescale_grad=1.0))
+        out = mx.nd.zeros(SHAPE)
+        with faultinject.kill_connection_after(1, point=point):
+            kv.push('w', mx.nd.ones(SHAPE))       # applied, ack lost
+            kv.pull('w', out=out)
+        # applied exactly once: 1 - 0.5*1 (a double apply would give 0.0)
+        np.testing.assert_allclose(out.asnumpy(), 0.5, rtol=1e-6)
+        assert srv.dedup_count == 1
+        assert faultinject.stats()["kills_fired"] == 1
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def _symbol():
+    data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(data, num_hidden=8, name='fc1')
+    net = mx.sym.Activation(net, act_type='relu', name='relu1')
+    net = mx.sym.FullyConnected(net, num_hidden=NCLASS, name='fc2')
+    return mx.sym.SoftmaxOutput(net, name='softmax')
+
+
+def _train_through_kvstore(monkeypatch, kill=None):
+    """One full dist_async training run (Module + server-side SGD, the
+    update-on-kvstore mode, driven through run_steps' eager-fallback
+    path) against a FRESH server; returns (final params, dedup count)."""
+    srv = _serve(monkeypatch)
+    try:
+        mx.random.seed(7)
+        rs = np.random.RandomState(11)
+        data = rs.uniform(-1, 1, (K, BATCH, NIN)).astype(np.float32)
+        label = rs.randint(0, NCLASS, (K, BATCH)).astype(np.float32)
+        mod = mx.mod.Module(_symbol(), data_names=('data',),
+                            label_names=('softmax_label',))
+        mod.bind(data_shapes=[('data', (BATCH, NIN))],
+                 label_shapes=[('softmax_label', (BATCH,))])
+        mod.init_params(mx.initializer.Xavier(rnd_type='gaussian',
+                                              magnitude=2.0))
+        mod.init_optimizer(kvstore='dist_async', optimizer='sgd',
+                           optimizer_params={'learning_rate': 0.1,
+                                             'momentum': 0.9, 'wd': 0.0})
+        if kill is not None:
+            n, point = kill
+            with faultinject.kill_connection_after(n, point=point):
+                mod.run_steps(data, label, k=K)
+            assert faultinject.stats()["kills_fired"] == 1, \
+                "fault did not fire inside run_steps"
+        else:
+            mod.run_steps(data, label, k=K)
+        arg, _aux = mod.get_params()
+        params = {k: v.asnumpy().copy() for k, v in arg.items()}
+        dedup = srv.dedup_count
+        mod._kvstore.close(stop_servers=True)
+        return params, dedup
+    finally:
+        srv.stop()
+
+
+def test_kill_mid_run_steps_recovers_bit_identical(monkeypatch):
+    """THE acceptance scenario: a worker↔server connection killed inside
+    a run_steps call — at two distinct kill points — recovers via
+    reconnect+replay, and the finished params are BIT-IDENTICAL to an
+    uninterrupted fp32 CPU run.  No duplicate push is applied (dedup
+    counter says exactly how each replay was resolved)."""
+    baseline, dedup0 = _train_through_kvstore(monkeypatch)
+    assert dedup0 == 0
+    # (message index, point): ~12 wire messages per training step, so 10
+    # lands inside step 1 and 17 inside step 2 of the K-step window —
+    # both mid-run_steps.  before_send = request never delivered (replay
+    # IS first delivery, dedup 0); after_send = request applied but the
+    # ack lost (replay must dedup, exactly once).
+    for kill, want_dedup in (((10, "before_send"), 0),
+                             ((17, "after_send"), 1)):
+        got, dedup = _train_through_kvstore(monkeypatch, kill=kill)
+        assert set(got) == set(baseline)
+        for name in baseline:
+            np.testing.assert_array_equal(
+                got[name], baseline[name],
+                err_msg=f"{name} diverged after kill {kill}")
+        assert dedup == want_dedup, (kill, dedup)
+
+
+def test_retry_exhaustion_surfaces_hard_error(monkeypatch):
+    """Retries are BOUNDED: a server that stays gone exhausts
+    MXNET_KVSTORE_RETRY_MAX reconnect attempts and the channel fails
+    hard with the original transport error — then stays poisoned."""
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "3")
+    srv = _serve(monkeypatch)
+    kv = mx.kv.create('dist_async')
+    kv.init('a', mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull('a', out=out)                  # healthy round trip
+    profiler.reset_channel_counts()
+    srv.stop()                             # server gone for good
+    with pytest.raises(MXNetError, match="3 reconnect attempts"):
+        kv.pull('a', out=out)
+    counts = profiler.channel_counts()
+    # bounded: exactly RETRY_MAX attempts were spent (a connect may land
+    # in the dying listener's backlog and count as a reconnect before
+    # the replay fails again — attempts still never exceed the cap)
+    assert counts.get("kvstore.retry") == 3, counts
+    assert counts.get("kvstore.hard_fail") == 1, counts
+    # the existing hard-failure contract: the channel is poisoned
+    with pytest.raises(MXNetError, match="channel failed"):
+        kv.pull('a', out=out)
+    kv.close()
+
+
+def test_refuse_connects_and_accepts(monkeypatch):
+    """Connect-side and accept-side refusals both ride the backoff: the
+    first M dials fail, the channel keeps retrying, work completes."""
+    srv = _serve(monkeypatch)
+    try:
+        with faultinject.refuse_connects(2):
+            kv = mx.kv.create('dist_async')   # initial dial retries
+        assert faultinject.stats()["connects_refused"] == 2
+        kv.init('a', mx.nd.ones(SHAPE))
+        # sever the channel while the server ALSO drops the next accept:
+        # reconnect #1 is accepted-then-closed, reconnect #2 survives
+        with faultinject.refuse_accepts(1):
+            with faultinject.kill_connection_after(1, point="before_send"):
+                kv.push('a', mx.nd.ones(SHAPE) * 5)
+                out = mx.nd.zeros(SHAPE)
+                kv.pull('a', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 5.0)
+        assert faultinject.stats()["accepts_refused"] == 1
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_delayed_acks_keep_fifo_semantics(monkeypatch):
+    """Slow acks stretch latency only: ordering and values unchanged."""
+    srv = _serve(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        with faultinject.delay_acks(0.03):
+            kv.init('a', mx.nd.zeros(SHAPE))
+            kv.push('a', mx.nd.ones(SHAPE) * 2)
+            out = mx.nd.zeros(SHAPE)
+            kv.pull('a', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 2.0)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_feeds_num_dead_nodes(monkeypatch):
+    """Silence detection: barrier waits stay unbounded by design, but a
+    server that stops acking heartbeats becomes a REAL dead node —
+    kvstore-level and job-wide (distributed.num_dead_nodes)."""
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5")
+    srv = _serve(monkeypatch)
+    kv = mx.kv.create('dist_async')
+    kv.init('a', mx.nd.ones(SHAPE))
+    assert kv.num_dead_nodes() == 0
+    srv.stop()
+    deadline = time.time() + 10
+    while kv.num_dead_nodes() == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert kv.num_dead_nodes() == 1
+    from mxnet_tpu import distributed
+    assert distributed.num_dead_nodes() >= 1
+    assert profiler.channel_counts().get("kvstore.heartbeat_miss", 0) >= 1
+    kv.close()
+    # a closed store stops reporting (its channels are gone on purpose)
+    assert kv.num_dead_nodes() == 0
+
+
+def test_barrier_timeout_names_missing_ranks(monkeypatch):
+    """A 2-worker barrier where rank 1 was alive and went silent: the
+    surviving rank's barrier FAILS naming rank 1 instead of blocking
+    forever (the wait itself has no deadline — silence is the trigger)."""
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.6")
+    srv = _serve(monkeypatch, num_workers=2, hb_timeout=0.6)
+    try:
+        # rank 1 says hello once, then dies (socket closed, no more pings)
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        _send_msg(s, ("ping", 1))
+        assert _recv_msg(s)[0] == "ok"
+        s.close()
+        kv = mx.kv.create('dist_async')   # rank 0, heartbeating
+        with pytest.raises(MXNetError) as ei:
+            kv.barrier()
+        msg = str(ei.value)
+        assert "missing" in msg and "[1]" in msg, msg
+        assert "arrived" in msg and "[0]" in msg, msg
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_close_warns_on_stuck_io_thread(monkeypatch):
+    """A close() whose IO thread cannot stop (blocked awaiting a reply
+    that will never come) must WARN with the channel's state instead of
+    silently leaking the thread."""
+    srv = _serve(monkeypatch, num_workers=2)   # barrier never completes
+    try:
+        from mxnet_tpu.kvstore import _ServerConn
+        conn = _ServerConn(f"127.0.0.1:{srv.port}")
+        conn.request(("barrier",))        # parks the IO thread in recv
+        time.sleep(0.3)
+        monkeypatch.setattr(conn, "flush", lambda: None)
+        with pytest.warns(RuntimeWarning, match="did not stop"):
+            conn.close(join_timeout=0.3)
+    finally:
+        srv.stop()

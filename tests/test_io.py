@@ -71,6 +71,46 @@ def test_prefetching_iter():
     assert len(list(it)) == 2
 
 
+class _ExplodingIter(mx.io.DataIter):
+    """Yields n good batches, then raises — a crashing decode/transport
+    stand-in for the prefetch-thread fault path."""
+
+    def __init__(self, inner, explode_after):
+        super().__init__(inner.batch_size)
+        self.inner = inner
+        self.explode_after = explode_after
+        self.count = 0
+        self.provide_data = inner.provide_data
+        self.provide_label = inner.provide_label
+
+    def reset(self):
+        self.inner.reset()
+
+    def next(self):
+        if self.count == self.explode_after:
+            raise ValueError("injected pipeline crash")
+        self.count += 1
+        return self.inner.next()
+
+
+def test_prefetching_iter_propagates_worker_exception():
+    """A crash in the prefetch thread must raise on the consumer's next
+    next() — NOT silently end the epoch (which would truncate training)
+    and NOT hang the double-buffer rendezvous forever."""
+    from mxnet_tpu.base import MXNetError
+    data = np.arange(40).reshape(10, 4).astype('float32')
+    base = mx.io.NDArrayIter(data, np.zeros(10), batch_size=2)
+    it = mx.io.PrefetchingIter(_ExplodingIter(base, explode_after=2))
+    got = [it.next(), it.next()]          # the two good batches
+    np.testing.assert_array_equal(got[0].data[0].asnumpy(), data[:2])
+    with pytest.raises(MXNetError, match="injected pipeline crash"):
+        it.next()
+    # the failure is sticky: later calls keep raising, they never hang
+    # or fabricate an end-of-epoch
+    with pytest.raises(MXNetError, match="injected pipeline crash"):
+        it.next()
+
+
 def test_csv_iter(tmp_path):
     data = np.random.rand(12, 3).astype('float32')
     label = np.arange(12).astype('float32')

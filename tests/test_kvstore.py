@@ -648,6 +648,80 @@ def test_gluon_trainer_dist_async_resume_rescale(monkeypatch):
         srv.stop()
 
 
+def test_gluon_trainer_dist_async_resume_preserves_server_states(
+        monkeypatch):
+    """Resume against LIVE servers (optimizer already installed): the
+    first step()'s optimizer re-ship replaces the server-side updater,
+    so it must REPLAY the states a pre-step load_states applied — a
+    wiped momentum would silently restart the optimizer fresh.  Proof:
+    interrupted (step, save, new Trainer, load, step) equals continuous
+    (step, step)."""
+    import tempfile, os as _os
+    import mxnet_tpu.gluon as gluon
+    from mxnet_tpu import autograd
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    x = mx.nd.array(np.array([[1., 2., 3.], [4., 5., 6.]], np.float32))
+
+    def one_step(net, tr):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(batch_size=2)
+
+    def make(srv):
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        net = gluon.nn.Dense(1, use_bias=False, in_units=3,
+                             prefix='resume_')
+        net.initialize()
+        net.weight.set_data(mx.nd.ones((1, 3)) * 0.5)
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1, 'momentum': 0.9,
+                            'wd': 0.0}, kvstore='dist_async')
+        return net, tr
+
+    # continuous reference: two steps through one trainer
+    srv1 = KVStoreServer(server_id=0, num_workers=1)
+    srv1.start_background()
+    try:
+        net1, tr1 = make(srv1)
+        one_step(net1, tr1)
+        one_step(net1, tr1)
+        want = net1.weight.data().asnumpy().copy()
+        tr1._kvstore.close(stop_servers=True)
+    finally:
+        srv1.stop()
+
+    # interrupted: step, save_states, then a NEW trainer on the SAME
+    # live cluster loads and steps — the crash/resume-without-restart
+    # shape (same param names, server weights authoritative)
+    srv2 = KVStoreServer(server_id=0, num_workers=1)
+    srv2.start_background()
+    try:
+        net2, tr2 = make(srv2)
+        one_step(net2, tr2)
+        fd, fname = tempfile.mkstemp()
+        _os.close(fd)
+        try:
+            tr2.save_states(fname)
+            net3, tr3 = make(srv2)
+            tr3.load_states(fname)   # applied NOW (live optimizer) +
+            #                          buffered for the re-ship replay
+            # load_states' _init_kvstore pulled the authoritative
+            # post-step-1 weights, so step 2's grad matches continuous
+            one_step(net3, tr3)
+            np.testing.assert_allclose(
+                net3.weight.data().asnumpy(), want, rtol=1e-5,
+                err_msg="resume wiped server-side optimizer states")
+        finally:
+            _os.unlink(fname)
+        tr3._kvstore.close(stop_servers=True)
+    finally:
+        srv2.stop()
+
+
 def test_dist_async_load_save_relay_preserves_states(monkeypatch):
     """Pure load→save relay on a FRESH server cluster (no init/push —
     checkpoint migration): shards with an empty store return their

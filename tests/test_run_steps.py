@@ -416,6 +416,107 @@ def test_kbatch_feeds_run_steps():
     _assert_state_equal(m1, m2, exact=True)
 
 
+def test_kbatch_short_superbatch_takes_eager_fallback():
+    """A superbatch cut short mid-epoch ('keep' tail): run_steps must
+    route the short group through the EAGER driver (different leading
+    dim than the compiled scan) and still produce the state a pure eager
+    run over the same batches produces — bit-for-bit."""
+    n_batches = 5           # K=2 -> 2 full groups + 1 short tail
+    x = np.random.RandomState(5).uniform(
+        -1, 1, (n_batches * BATCH, NIN)).astype(np.float32)
+    y = np.random.RandomState(6).randint(
+        0, NCLASS, (n_batches * BATCH,)).astype(np.float32)
+    mx.random.seed(0)
+    m1 = _make_module()
+    mx.random.seed(0)
+    m2 = _make_module()
+    _clone_params(m1, m2)
+    for b in mx.io.NDArrayIter(x, y, batch_size=BATCH):
+        m1.forward(b, is_train=True)
+        m1.update()
+    it = mx.io.KBatchIter(mx.io.NDArrayIter(x, y, batch_size=BATCH),
+                          k=2, last_group='keep')
+    prof.reset_dispatch_counts()
+    for g in it:
+        m2.run_steps(g.data[0], g.label[0])
+    counts = prof.dispatch_counts()
+    # 2 full groups scanned, the short tail ran eagerly (k=1 fallback)
+    assert counts.get("run_steps.dispatch") == 2, counts
+    assert "fused_step.dispatch" in counts, counts
+    _assert_state_equal(m1, m2, exact=True)
+
+
+class _CrashingIter(mx.io.DataIter):
+    """Wraps an iterator; raises mid-epoch after n good batches — the
+    transport/decoder crash stand-in for the fault-path tests."""
+
+    def __init__(self, inner, crash_after):
+        super().__init__(inner.batch_size)
+        self.inner = inner
+        self.crash_after = crash_after
+        self.count = 0
+
+    @property
+    def provide_data(self):
+        return self.inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self.inner.provide_label
+
+    def reset(self):
+        self.inner.reset()
+
+    def next(self):
+        if self.count == self.crash_after:
+            raise RuntimeError("injected iterator crash")
+        self.count += 1
+        return self.inner.next()
+
+
+def test_kbatch_crash_resume_with_run_steps_carry():
+    """Crash/resume across the K-step carry: an inner-iterator crash
+    MID-GROUP must surface (never hand run_steps a silently-partial
+    superbatch), and resuming from the first untrained batch must land
+    on exactly the uninterrupted run's params."""
+    n_batches = 8
+    k = 2
+    x = np.random.RandomState(7).uniform(
+        -1, 1, (n_batches * BATCH, NIN)).astype(np.float32)
+    y = np.random.RandomState(8).randint(
+        0, NCLASS, (n_batches * BATCH,)).astype(np.float32)
+    mx.random.seed(0)
+    m1 = _make_module()
+    mx.random.seed(0)
+    m2 = _make_module()
+    _clone_params(m1, m2)
+    for b in mx.io.NDArrayIter(x, y, batch_size=BATCH):
+        m1.forward(b, is_train=True)
+        m1.update()
+
+    # crash on batch index 3: group 0 (batches 0,1) trains, group 1 dies
+    # after pulling batch 2 — that group must be LOST ENTIRELY, not
+    # emitted short
+    crashy = _CrashingIter(mx.io.NDArrayIter(x, y, batch_size=BATCH),
+                           crash_after=3)
+    it = mx.io.KBatchIter(crashy, k=k)
+    trained_batches = 0
+    with pytest.raises(RuntimeError, match="injected iterator crash"):
+        for g in it:
+            m2.run_steps(g.data[0], g.label[0], k=k)
+            trained_batches += k
+    assert trained_batches == 2   # only group 0 reached the module
+
+    # resume: re-feed from the first UNTRAINED batch (2), tail included
+    resume = mx.io.KBatchIter(
+        mx.io.NDArrayIter(x[trained_batches * BATCH:],
+                          y[trained_batches * BATCH:], batch_size=BATCH),
+        k=k, last_group='keep')
+    for g in resume:
+        m2.run_steps(g.data[0], g.label[0])
+    _assert_state_equal(m1, m2, exact=True)
+
+
 def test_prefetching_iter_device_put_stage():
     """device_put=True transfers batches in the prefetch thread; values
     are unchanged and arrays are device-resident."""
