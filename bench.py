@@ -369,11 +369,18 @@ def _run(batch):
         _jax.profiler.stop_trace()
         _mark("profile captured to %s" % profile_dir)
 
+    # transport byte counters around the measured loop: with a dist
+    # kvstore in the step this is the per-step wire cost (and the direct
+    # evidence for the gradient-compression win); 0 in single-process
+    # configs.  See profiler.channel_bytes / docs/PERF_NOTES.md.
+    from mxnet_tpu import profiler as _mx_prof
+    wire0 = sum(_mx_prof.channel_bytes().values())
     t0 = time.perf_counter()
     for i in range(iters):
         step(i)
     hard_sync()
     dt = time.perf_counter() - t0
+    wire_bytes = sum(_mx_prof.channel_bytes().values()) - wire0
 
     # one step() call runs STEPS_PER_CALL training steps; report per
     # TRAINING step so K=1 and K=8 rows compare directly
@@ -399,6 +406,8 @@ def _run(batch):
         "opt": OPT,
         "iters": iters,
         "steps_per_call": STEPS_PER_CALL,
+        "wire_bytes_per_step": round(
+            wire_bytes / iters / STEPS_PER_CALL, 1),
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
         "remat": (os.environ.get("MXNET_REMAT_POLICY", "full")

@@ -31,6 +31,19 @@ JAX_PLATFORMS=cpu timeout -k 10 240 \
     python tools/launch.py -n 2 -s 1 \
     python tests/dist/dist_fault_injection.py
 
+echo "== fault-injection smoke: pipelined window + 2-bit compression"
+# Same kill-and-recover arithmetic, now over the PIPELINED wire: 8
+# envelopes in flight and every push 2-bit quantized (the smoke script
+# simulates the deterministic quantizer to compute the exact expected
+# total).  A replay that loses an envelope, double-applies one, or
+# corrupts the compressed frame breaks the exact number.  Time-boxed:
+# a window-replay regression typically presents as a HANG.
+JAX_PLATFORMS=cpu MXNET_KVSTORE_WINDOW=8 \
+    MXNET_KVSTORE_COMPRESSION=2bit \
+    MXNET_KVSTORE_COMPRESSION_THRESHOLD=1.0 timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 \
+    python tests/dist/dist_fault_injection.py
+
 echo "== multichip dryrun (8 virtual devices)"
 JAX_PLATFORMS=cpu python - <<'PY'
 import cpu_pin

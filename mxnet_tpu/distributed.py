@@ -94,8 +94,13 @@ def allreduce_sum(value):
     if jax.process_count() == 1:
         return np.asarray(value)
     from jax.experimental import multihost_utils
+    from . import profiler as _prof
+    arr = np.asarray(value)
+    # per-process contribution to the gather — the host-collective twin
+    # of the socket transport's sent/recv byte counters
+    _prof.record_channel_bytes("allgather", int(arr.nbytes))
     return np.asarray(
-        multihost_utils.process_allgather(np.asarray(value))).sum(axis=0)
+        multihost_utils.process_allgather(arr)).sum(axis=0)
 
 
 def broadcast_from_root(value):
@@ -107,11 +112,14 @@ def broadcast_from_root(value):
     if jax.process_count() == 1:
         return value
     from jax.experimental import multihost_utils
+    from . import profiler as _prof
+    arr = np.asarray(value)
+    _prof.record_channel_bytes("allgather", int(arr.nbytes))
     # process_allgather lands on host in every process; rank 0's slice is
     # the broadcast value (broadcast_one_to_all returns a global-mesh
     # jax.Array that host code cannot read directly)
     return np.asarray(
-        multihost_utils.process_allgather(np.asarray(value)))[0]
+        multihost_utils.process_allgather(arr))[0]
 
 
 # Liveness sources: objects exposing num_dead_nodes() (dist_async

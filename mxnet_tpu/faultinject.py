@@ -53,6 +53,7 @@ class _Plan:
     def __init__(self):
         self.kill_after = None          # 1-indexed message to kill at
         self.kill_point = "before_send"
+        self.kill_unacked = None        # sever when k envelopes in flight
         self.sent = 0                   # data-channel messages counted
         self.kills_fired = 0
         self.delay_ack_s = 0.0
@@ -89,7 +90,8 @@ def stats() -> dict:
 
 
 def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
-              refuse_connects=0, refuse_accepts=0, only_rank=None):
+              refuse_connects=0, refuse_accepts=0, only_rank=None,
+              kill_unacked=None):
     """Arm a plan directly (the non-context-manager form; multi-process
     scripts use this after deciding per-rank what to inject)."""
     if kill_point not in KILL_POINTS:
@@ -98,6 +100,7 @@ def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
     with _lock:
         _plan.kill_after = int(kill_after) if kill_after else None
         _plan.kill_point = kill_point
+        _plan.kill_unacked = int(kill_unacked) if kill_unacked else None
         _plan.sent = 0
         _plan.kills_fired = 0
         _plan.delay_ack_s = float(delay_ack_s)
@@ -124,6 +127,21 @@ def kill_connection_after(n, point="before_send"):
         with _lock:
             _plan.kill_after = None
             _plan.sent = 0
+
+
+@contextlib.contextmanager
+def kill_when_unacked(k):
+    """Sever the data channel the first time ``k`` envelopes are in
+    flight (sent, unacked) at once — the mid-WINDOW kill for the
+    pipelined transport: the reconnect must replay all ``k`` in seq
+    order, exactly-once."""
+    with _lock:
+        _plan.kill_unacked = int(k)
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.kill_unacked = None
 
 
 @contextlib.contextmanager
@@ -211,6 +229,19 @@ def client_recv(sock):
     _client_post_send(sock, "on_recv")
 
 
+def client_window(sock, unacked):
+    """After a data-channel send, with the count of unacked envelopes
+    currently in flight (the sliding-window depth)."""
+    with _lock:
+        if (_plan.kill_unacked is None or not _rank_active()
+                or unacked < _plan.kill_unacked):
+            return
+        _plan.kill_unacked = None   # fire once
+        _plan.kills_fired += 1
+        n = _plan.sent
+    _sever(sock, f"window_unacked[{unacked}]", n)
+
+
 def client_connect(uri):
     """Before a data-channel connect/reconnect attempt."""
     with _lock:
@@ -248,15 +279,17 @@ def _arm_from_env():
     """One-shot env activation (multi-process tests: the launcher can't
     reach into a worker, but its environment can)."""
     ka = os.environ.get("MXNET_FI_KILL_AFTER")
+    ku = os.environ.get("MXNET_FI_KILL_UNACKED")
     rc = os.environ.get("MXNET_FI_REFUSE_CONNECTS")
     ra = os.environ.get("MXNET_FI_REFUSE_ACCEPTS")
     dl = os.environ.get("MXNET_FI_DELAY_ACK_MS")
     orank = os.environ.get("MXNET_FI_ONLY_RANK")
-    if not (ka or rc or ra or dl):
+    if not (ka or ku or rc or ra or dl):
         return
     configure(
         kill_after=int(ka) if ka else None,
         kill_point=os.environ.get("MXNET_FI_KILL_POINT", "before_send"),
+        kill_unacked=int(ku) if ku else None,
         delay_ack_s=float(dl) / 1000.0 if dl else 0.0,
         refuse_connects=int(rc) if rc else 0,
         refuse_accepts=int(ra) if ra else 0,

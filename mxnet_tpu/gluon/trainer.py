@@ -70,6 +70,15 @@ class Trainer:
         self._kv_type = kvstore
         self._kvstore = None
         self._kv_initialized = False
+        # on-the-wire gradient compression (reference: Trainer
+        # compression_params -> kvstore.set_gradient_compression).
+        # Validated eagerly so a typo'd config fails at construction,
+        # then shipped to the store at the lazy kvstore init.
+        self._compression_params = None
+        if compression_params:
+            from ..compression import GradientCompression
+            GradientCompression(compression_params)   # validate now
+            self._compression_params = dict(compression_params)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -92,6 +101,12 @@ class Trainer:
         if self._kv_type:
             self._kvstore = kvs_mod.create(self._kv_type) \
                 if isinstance(self._kv_type, str) else self._kv_type
+        if self._kvstore is not None and self._compression_params:
+            # before any push: the first gradient must already ride the
+            # compressed wire (dist_async; a no-wire store records the
+            # setting and compresses nothing)
+            self._kvstore.set_gradient_compression(
+                self._compression_params)
         self._update_on_kvstore = (
             self._kvstore is not None
             and getattr(self._kvstore, "type", "") == "dist_async")

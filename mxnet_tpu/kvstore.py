@@ -82,6 +82,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._barrier_count = 0
+        self._gcompress = None
         # jitted multi-value reducer cache keyed by (n_values, shape, dtype)
         self._sum_cache = {}
 
@@ -181,6 +182,23 @@ class KVStore:
 
     def _set_updater(self, updater):
         self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """reference: kvstore.py set_gradient_compression (MXNet 0.12,
+        2-bit gradient compression).  ``{'type': '2bit', 'threshold':
+        t}`` or ``{'type': 'fp16'}``; supported for device/dist stores
+        only, like the reference.  Compression changes the WIRE
+        representation of pushes — for store types with no wire (local
+        aggregation, SPMD allreduce) the setting is validated and
+        recorded but has no effect; ``dist_async`` compresses each push
+        payload worker-side with error feedback
+        (:mod:`mxnet_tpu.compression`), and pull stays full precision."""
+        from .compression import GradientCompression
+        if self.type.startswith("local"):
+            raise MXNetError(
+                "gradient compression is not supported for kvstore type "
+                f"{self.type!r} (reference: local stores don't compress)")
+        self._gcompress = GradientCompression(compression_params)
 
     # -- coordination ---------------------------------------------------------
     def barrier(self):
@@ -289,24 +307,31 @@ class KVStore:
 class _ServerConn:
     """Ordered async channel to one parameter server.
 
-    Operations enqueue; one IO thread per server sends each request and
-    reads its ack in FIFO order.  A ``push`` therefore returns before the
-    server applies it (the async overlap the reference gets by running
-    ``ZPush`` inside an engine async op, kvstore_dist.h:53-80) while a
-    later ``pull`` on the same server is guaranteed to observe every
-    prior push from THIS worker — per-server FIFO is exactly the ordering
-    the reference's per-key engine dependency chain provides.
+    Operations enqueue; one IO thread per server runs a SLIDING-WINDOW
+    pipeline: up to ``MXNET_KVSTORE_WINDOW`` (default 8) envelopes are
+    in flight at once, acks are consumed from the head of a FIFO of
+    pending slots.  A ``push`` therefore returns before the server
+    applies it (the async overlap the reference gets by running
+    ``ZPush`` inside an engine async op, kvstore_dist.h:53-80) and a
+    burst of N requests costs ~1 RTT instead of N — the pipelined
+    ZPush/ZPull behavior of ps-lite, where the old loop was
+    stop-and-wait.  Per-server FIFO ordering is preserved exactly
+    (requests are sent in enqueue order, acks arrive in that order on
+    one TCP stream), so a later ``pull`` still observes every prior
+    push from THIS worker; ``MXNET_KVSTORE_WINDOW=1`` degrades to the
+    old send-one-await-one behavior bit for bit.
 
     **Fault tolerance** (reference: ps-lite resender + the server-
     recovery mode, kvstore_dist.h:55).  Every request travels in an
     envelope ``("req", (rank, nonce), seq, msg)``; on transport death
     the IO thread reconnects with capped exponential backoff
-    (``MXNET_KVSTORE_RETRY_*``) and REPLAYS the unacked request — the
-    server's per-client dedup window acks an already-applied replay
-    idempotently, so a connection killed between a push's send and its
-    ack still applies that push exactly once.  Retries are bounded:
-    exhausting ``MXNET_KVSTORE_RETRY_MAX`` reconnect attempts surfaces
-    the original transport error as the permanent channel failure.
+    (``MXNET_KVSTORE_RETRY_*``) and REPLAYS the ENTIRE unacked window
+    in seq order — the server's per-client dedup window acks
+    already-applied replays idempotently, so a connection killed with
+    k envelopes in flight still applies each exactly once.  Retries
+    are bounded: exhausting ``MXNET_KVSTORE_RETRY_MAX`` reconnect
+    attempts surfaces the original transport error as the permanent
+    channel failure, failing every in-flight request.
 
     **Liveness.**  A low-rate heartbeat thread pings the server on its
     OWN socket (the data channel legitimately blocks unboundedly in
@@ -315,6 +340,8 @@ class _ServerConn:
     """
 
     def __init__(self, uri, connect_timeout=60.0):
+        import collections
+        import socket as _socket
         import time
         import uuid
         self._uri = uri
@@ -340,6 +367,15 @@ class _ServerConn:
         self._sock = self._dial(connect_timeout)
         self._q = queue.Queue()
         self._err = None
+        # sliding window: entries are [envelope, pending, replayed] in
+        # seq order; head = oldest unacked
+        self._window = max(1, int(_env("MXNET_KVSTORE_WINDOW", 8)))
+        self._inflight = collections.deque()
+        # wakeup pair: lets the IO thread wait on "ack readable" AND
+        # "new request enqueued" at once (select) without polling
+        self._wake_r, self._wake_w = _socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
         self._thread = threading.Thread(target=self._io_loop, daemon=True)
         self._thread.start()
         self._hb_interval = float(
@@ -377,64 +413,178 @@ class _ServerConn:
                         f"within {connect_timeout}s")
                 time.sleep(0.2)
 
-    def _io_loop(self):
-        while True:
-            item = self._q.get()
-            if item is None:
-                return
-            msg, pending = item
-            envelope = ("req", self._client_id, self._next_seq, msg)
-            self._next_seq += 1
-            try:
-                status, payload = self._rpc(envelope)
-            except Exception as exc:  # noqa: BLE001 — retries exhausted:
-                self._err = exc       # poison the channel for good
-                if pending is not None:
-                    pending.error = exc
-                    pending.done.set()
-                continue
-            if status != "ok":
-                # application error: the reply was fully read, the socket
-                # is healthy — fail THIS op only.  A failed fire-and-
-                # forget push has no waiter, so it surfaces on the next
-                # call instead (a lost gradient must not pass silently).
-                err = MXNetError(f"kvstore server error: {payload}")
-                if pending is not None:
-                    pending.error = err
-                else:
-                    self._err = err
-            elif pending is not None:
-                pending.value = payload
-            if pending is not None:
-                pending.done.set()
+    def _enqueue(self, item):
+        """Queue a request and poke the IO thread's select()."""
+        self._q.put(item)
+        try:
+            self._wake_w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass  # buffer full / closed: the thread is awake regardless
 
-    def _rpc(self, envelope):
-        """One request → its reply, reconnecting and replaying through
-        transport faults.  The channel is strictly serial (send, await
-        ack, next), so the replay set is exactly the one unacked
-        envelope — FIFO order is preserved across reconnects."""
-        from .kvstore_server import _send_msg, _recv_msg
+    def _io_loop(self):
+        """The sliding-window pump.  Fill the window from the queue,
+        then wait for whichever comes first: an ack (completes the head
+        slot) or a wakeup byte (new work while acks are outstanding).
+        With MXNET_KVSTORE_WINDOW=1 this is exactly the old
+        send-one-await-one loop."""
+        import select
+        stopping = False
+        while True:
+            while not stopping and len(self._inflight) < self._window:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    if self._inflight:
+                        break
+                    item = self._q.get()   # idle: block until work/close
+                if item is None:
+                    stopping = True
+                    break
+                self._send_request(item)
+                self._drain_ready_acks(select)
+            if not self._inflight:
+                if stopping:
+                    return
+                continue
+            try:
+                ready, _, _ = select.select(
+                    [self._sock, self._wake_r], [], [])
+            except (OSError, ValueError, TypeError):
+                # socket torn down under us (close() path): surface it
+                # through the ordinary recv-failure machinery
+                ready = [self._sock]
+            if self._wake_r in ready:
+                try:
+                    while self._wake_r.recv(4096):
+                        pass
+                except (BlockingIOError, OSError):
+                    pass
+            if self._sock in ready:
+                self._recv_ack()
+
+    def _drain_ready_acks(self, select):
+        """Between sends of a burst, consume any acks already on the
+        wire (zero-timeout poll).  Frees window slots early and keeps
+        the peer's (tiny) ack sends flowing while we stream — blocking
+        sendall with a peer that is also mid-sendall is the one mutual-
+        stall shape pipelining could otherwise create.  NOTE the public
+        ops can't reach that shape anyway (pull/row_sparse_pull await
+        their large replies before returning, so big replies never
+        overlap big sends on one conn); only a caller hand-pipelining
+        ``request()`` of large pulls between large pushes could."""
+        while self._inflight and self._sock is not None:
+            try:
+                ready, _, _ = select.select([self._sock], [], [], 0)
+            except (OSError, ValueError, TypeError):
+                return
+            if not ready:
+                return
+            self._recv_ack()
+
+    def _send_request(self, item):
+        """Assign the next seq, enter the window, send.  The entry joins
+        the window BEFORE the send so a mid-send transport fault replays
+        it with its original (client_id, seq)."""
+        from .kvstore_server import _send_msg
+        from . import faultinject
+        msg, pending = item
+        if self._err is not None and self._sock is None:
+            # hard transport poison: the channel is gone for good — fail
+            # queued work instead of sending into nothing.  An
+            # APPLICATION-error poison (server said "err" to a fire-and-
+            # forget push; the socket is healthy) must NOT drop
+            # already-queued requests: they keep flowing, exactly like
+            # the pre-window serial loop ("a lost gradient must not
+            # pass silently" — only NEW enqueues are refused).
+            self._fail_pending(pending, self._err)
+            return
+        envelope = ("req", self._client_id, self._next_seq, msg)
+        self._next_seq += 1
+        self._inflight.append([envelope, pending, False])
+        try:
+            if self._sock is None:
+                raise ConnectionError("channel has no connection")
+            _send_msg(self._sock, envelope, fi_role="client")
+            faultinject.client_window(self._sock, len(self._inflight))
+        except Exception as exc:  # noqa: BLE001 — transport fault
+            self._recover_or_fail(exc)
+
+    def _recv_ack(self):
+        """Consume ONE ack for the head of the window (acks arrive in
+        seq order on the single TCP stream)."""
+        from .kvstore_server import _recv_msg
         from . import profiler as _prof
-        replaying = False
+        try:
+            reply = _recv_msg(self._sock, fi_role="client")
+        except Exception as exc:  # noqa: BLE001 — transport fault
+            self._recover_or_fail(exc)
+            return
+        # a complete round trip proves the transport healthy again
+        self._retry_attempts = 0
+        envelope, pending, replayed = self._inflight.popleft()
+        if replayed:
+            _prof.record_channel_event("kvstore.replay_acked")
+        status, payload = reply
+        if status != "ok":
+            # application error: the reply was fully read, the socket
+            # is healthy — fail THIS op only.  A failed fire-and-
+            # forget push has no waiter, so it surfaces on the next
+            # call instead (a lost gradient must not pass silently).
+            err = MXNetError(f"kvstore server error: {payload}")
+            if pending is not None:
+                pending.error = err
+            else:
+                self._err = err
+        elif pending is not None:
+            pending.value = payload
+        if pending is not None:
+            pending.done.set()
+
+    def _recover_or_fail(self, exc):
+        """Transport fault: reconnect and replay the whole unacked
+        window, or — once retries are exhausted (or during close) —
+        poison the channel and fail every in-flight request."""
+        try:
+            if self._closing.is_set():
+                raise exc
+            self._last_transport_err = exc
+            self._reconnect(exc)   # raises once retries are exhausted
+            self._replay_window()
+        except Exception as hard:  # noqa: BLE001 — poison for good
+            self._channel_failed(hard)
+
+    def _replay_window(self):
+        """Resend every unacked envelope in seq order on the fresh
+        connection.  The server's per-client dedup window acks the
+        already-applied ones idempotently; a fault mid-replay reconnects
+        and restarts the whole window (same idempotence argument)."""
+        from .kvstore_server import _send_msg
+        from . import profiler as _prof
         while True:
             try:
-                if self._sock is None:
-                    raise ConnectionError("channel has no connection")
-                _send_msg(self._sock, envelope, fi_role="client")
-                reply = _recv_msg(self._sock, fi_role="client")
-            except Exception as exc:  # noqa: BLE001 — transport fault
+                for entry in self._inflight:
+                    _prof.record_channel_event("kvstore.replay")
+                    entry[2] = True
+                    _send_msg(self._sock, entry[0], fi_role="client")
+                return
+            except Exception as exc:  # noqa: BLE001 — fault mid-replay
                 if self._closing.is_set():
                     raise
                 self._last_transport_err = exc
-                self._reconnect(exc)  # raises once retries are exhausted
-                replaying = True
-                _prof.record_channel_event("kvstore.replay")
-                continue
-            # a complete round trip proves the transport healthy again
-            self._retry_attempts = 0
-            if replaying:
-                _prof.record_channel_event("kvstore.replay_acked")
-            return reply
+                self._reconnect(exc)   # raises once retries exhausted
+
+    def _channel_failed(self, exc):
+        """Permanent failure: record the poison, fail the whole window."""
+        self._err = exc
+        while self._inflight:
+            _envelope, pending, _replayed = self._inflight.popleft()
+            self._fail_pending(pending, exc)
+
+    @staticmethod
+    def _fail_pending(pending, exc):
+        if pending is not None:
+            pending.error = exc
+            pending.done.set()
 
     def _reconnect(self, cause):
         """Re-establish the data socket with capped exponential backoff.
@@ -528,7 +678,7 @@ class _ServerConn:
         if self._err is not None:
             raise MXNetError(f"kvstore server channel failed: {self._err}")
         pending = _Pending()
-        self._q.put((msg, pending))
+        self._enqueue((msg, pending))
         return pending
 
     def submit(self, msg, wait=False):
@@ -537,7 +687,7 @@ class _ServerConn:
             if self._err is not None:
                 raise MXNetError(
                     f"kvstore server channel failed: {self._err}")
-            self._q.put((msg, None))
+            self._enqueue((msg, None))
             return None
         return _await(self.request(msg))
 
@@ -556,7 +706,7 @@ class _ServerConn:
         so backing off against a deliberately stopped server only delays
         teardown."""
         if not retry:
-            self._closing.set()   # _rpc raises instead of reconnecting
+            self._closing.set()   # recovery raises instead of reconnecting
         # drain before closing: a still-queued fire-and-forget push must
         # reach the server, not die with the socket ("a lost gradient
         # must not pass silently")
@@ -565,7 +715,7 @@ class _ServerConn:
         except MXNetError:
             pass  # channel already dead — nothing left to save
         self._closing.set()       # aborts any in-flight backoff sleep
-        self._q.put(None)
+        self._enqueue(None)
         self._thread.join(timeout=join_timeout)
         if self._thread.is_alive():
             # a silent leak here hid every wedged-channel teardown; name
@@ -581,6 +731,11 @@ class _ServerConn:
             self._sock.close()
         except (OSError, AttributeError):
             pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class _Pending:
@@ -634,6 +789,21 @@ class KVStoreDistAsync(KVStore):
             "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")))
         self._stripes: Dict[str, list] = {}  # key -> row boundaries
         self._closed = False
+        # wire compression: error-feedback residuals live worker-side,
+        # one per WIRE key (stripes quantize independently).  Env
+        # activation mirrors the launcher's env-propagation model, so a
+        # whole job flips compression on without touching user code.
+        self._gc_residual: Dict[str, np.ndarray] = {}
+        ctype = os.environ.get("MXNET_KVSTORE_COMPRESSION", "")
+        if ctype and ctype != "none":
+            self.set_gradient_compression({
+                "type": ctype,
+                "threshold": float(os.environ.get(
+                    "MXNET_KVSTORE_COMPRESSION_THRESHOLD", "0.5"))})
+        # pushes at or below this many payload bytes coalesce into one
+        # multi-key envelope per server when pushed as a key list
+        self._coalesce_bytes = int(float(os.environ.get(
+            "MXNET_KVSTORE_COALESCE_BYTES", "16384")))
         # silence on any worker↔server channel becomes visible job-wide
         from . import distributed as _dist
         _dist._register_dead_node_source(self)
@@ -702,21 +872,60 @@ class KVStoreDistAsync(KVStore):
                 for p in pendings:
                     _await(p)
 
+    def _wire_push_payload(self, wire_key, arr):
+        """Compress one push payload when compression is on (2bit keeps
+        its error-feedback residual here, keyed by WIRE key so stripes
+        quantize independently); otherwise the raw array."""
+        gc = self._gcompress
+        if gc is None or not gc.active:
+            return arr
+        return gc.compress(wire_key, arr, self._gc_residual)
+
+    @staticmethod
+    def _payload_nbytes(payload) -> int:
+        from .compression import WirePayload
+        data = payload.data if isinstance(payload, WirePayload) \
+            else payload
+        return int(data.nbytes)
+
     def push(self, key, value, priority=0):
         """Locally reduce, then hand to the channel — returns immediately;
         the server applies the update when the push arrives (async SGD).
-        Striped keys push one row-slice per server, in parallel."""
+        Striped keys push one row-slice per server, in parallel.
+
+        A LIST push coalesces small keys bound for the same server into
+        ONE multi-key envelope (``MXNET_KVSTORE_COALESCE_BYTES`` per-key
+        bound) — small tensors stop paying a whole frame+ack each, the
+        comms analog of the reference's per-key engine-op batching."""
         keys, values = self._canon(key, value)
+        small: Dict[int, list] = {}   # conn index -> [(wire_key, payload)]
         for k, vs in zip(keys, values):
             agg = np.asarray(self._reduce(vs))
             plan = self._stripe_plan(k, agg.shape)
             if plan is None:
-                self._conn_of(k).submit(("push", k, agg), wait=False)
+                payload = self._wire_push_payload(k, agg)
+                conn = self._conn_of(k)
+                if (len(keys) > 1
+                        and self._payload_nbytes(payload)
+                        <= self._coalesce_bytes):
+                    small.setdefault(self._conns.index(conn), []).append(
+                        (k, payload))
+                else:
+                    conn.submit(("push", k, payload), wait=False)
             else:
                 for i in range(len(plan) - 1):
+                    wk = f"{k}@s{i}"
                     self._stripe_conn(k, i).submit(
-                        ("push", f"{k}@s{i}", agg[plan[i]:plan[i + 1]]),
+                        ("push", wk, self._wire_push_payload(
+                            wk, agg[plan[i]:plan[i + 1]])),
                         wait=False)
+        for ci, entries in small.items():
+            if len(entries) == 1:
+                self._conns[ci].submit(
+                    ("push", entries[0][0], entries[0][1]), wait=False)
+            else:
+                self._conns[ci].submit(("push_multi", entries),
+                                       wait=False)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Fetch the server's CURRENT weight — possibly mid-stream of other
@@ -848,7 +1057,10 @@ class KVStoreDistAsync(KVStore):
             if blob is None:
                 raise MXNetError("there is no optimizer installed on the "
                                  "servers (set_optimizer first)")
-            loaded = pickle.loads(blob)
+            # server-returned blob: decode through the transport
+            # allowlist, like every other peer-supplied pickle
+            from .kvstore_server import _restricted_loads
+            loaded = _restricted_loads(blob)
             if dump_optimizer:
                 states, opt_obj = loaded  # identical snapshot per server
             else:

@@ -44,7 +44,9 @@ from collections import OrderedDict
 import numpy as np
 
 from . import faultinject
+from . import profiler as _prof
 from .base import env as _env
+from .compression import WirePayload, decompress as _decompress
 
 # reference command codes (kvstore_dist_server.h:44-45): kStopServer=-1
 # tears down, kSyncMode=-2 switches the reference server to sync
@@ -57,17 +59,205 @@ K_STOP_SERVER = -1
 K_SYNC_MODE = -2
 
 
+# -- wire frame ---------------------------------------------------------------
+# A message is ONE frame:
+#
+#     >Q  total length of everything after this field
+#     >I  skeleton length S
+#     S bytes   pickled SKELETON: the message with every ndarray replaced
+#               by a _Buf(index, dtype, shape) placeholder
+#     ...       the raw tensor buffers, concatenated in index order
+#
+# Tensors therefore never pass through pickle: the sender writes each
+# array's memoryview straight to the socket (no tobytes() copy) and the
+# receiver maps np.frombuffer views over one contiguous read.  The
+# skeleton — the only remaining pickled bytes from a peer — is decoded
+# through a class-allowlisted Unpickler (below).
+
+
+class _Buf:
+    """Skeleton placeholder for a raw tensor buffer riding after it."""
+
+    __slots__ = ("i", "dtype", "shape")
+
+    def __init__(self, i, dtype, shape):
+        self.i = i
+        self.dtype = dtype
+        self.shape = tuple(shape)
+
+    def __reduce__(self):
+        return (_Buf, (self.i, self.dtype, self.shape))
+
+    @property
+    def nbytes(self):
+        return (int(np.prod(self.shape, dtype=np.int64))
+                * np.dtype(self.dtype).itemsize)
+
+
+def _pack(obj, bufs):
+    """Replace every ndarray in ``obj`` with a _Buf placeholder,
+    appending the (contiguous) array to ``bufs``.  Object-dtype arrays
+    cannot ride a raw buffer and stay in the skeleton."""
+    if isinstance(obj, np.ndarray) and not obj.dtype.hasobject:
+        # NOTE: ascontiguousarray promotes 0-d to 1-d — keep the
+        # logical shape from the original array
+        arr = np.ascontiguousarray(obj)
+        ref = _Buf(len(bufs), arr.dtype.str, obj.shape)
+        bufs.append(arr)
+        return ref
+    if isinstance(obj, tuple):
+        return tuple(_pack(x, bufs) for x in obj)
+    if isinstance(obj, list):
+        return [_pack(x, bufs) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _pack(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, WirePayload):
+        return WirePayload(obj.kind, obj.shape, obj.threshold,
+                           _pack(obj.data, bufs))
+    return obj
+
+
+def _unpack(obj, body, offsets):
+    if isinstance(obj, _Buf):
+        return np.frombuffer(
+            body, dtype=np.dtype(obj.dtype),
+            count=int(np.prod(obj.shape, dtype=np.int64)),
+            offset=offsets[obj.i]).reshape(obj.shape)
+    if isinstance(obj, tuple):
+        return tuple(_unpack(x, body, offsets) for x in obj)
+    if isinstance(obj, list):
+        return [_unpack(x, body, offsets) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _unpack(v, body, offsets) for k, v in obj.items()}
+    if isinstance(obj, WirePayload):
+        return WirePayload(obj.kind, obj.shape, obj.threshold,
+                           _unpack(obj.data, body, offsets))
+    return obj
+
+
+def _collect_bufs(obj, refs):
+    if isinstance(obj, _Buf):
+        refs.append(obj)
+    elif isinstance(obj, (tuple, list)):
+        for x in obj:
+            _collect_bufs(x, refs)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _collect_bufs(v, refs)
+    elif isinstance(obj, WirePayload):
+        _collect_bufs(obj.data, refs)
+
+
+# -- restricted deserialization ----------------------------------------------
+# _recv_msg decodes bytes from ANY connected peer; a stock pickle.loads
+# would let that peer name arbitrary importable callables (os.system,
+# ...).  With tensors moved to raw-buffer frames, the remaining pickled
+# skeletons/blobs only ever reference our own classes plus a handful of
+# numpy/jax reconstruction helpers — so find_class admits mxnet_tpu
+# classes (the reference semantics ship user optimizer/updater classes)
+# plus an EXPLICIT (module, name) set.  Whole-root allowances for
+# numpy/jax would re-open the door: numpy alone ships importable
+# command/exec helpers (numpy.testing.runstring, distutils exec_command)
+# that a REDUCE opcode could call with attacker arguments.
+_SAFE_BUILTINS = frozenset({
+    "complex", "frozenset", "set", "slice", "range", "bytearray",
+    "object", "tuple", "list", "dict",
+})
+_SAFE_GLOBALS = frozenset({
+    ("collections", "OrderedDict"),
+    ("numpy", "dtype"),
+    ("numpy", "ndarray"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "_reconstruct"),   # older numpy pickles
+    ("numpy.core.multiarray", "scalar"),
+    ("jax._src.array", "_reconstruct_array"),
+    # the wire marker classes, by NAME: their home modules also hold
+    # classes with side-effecting constructors (KVStoreServer binds a
+    # listening socket) that must stay out of REDUCE reach
+    ("mxnet_tpu.kvstore_server", "_Buf"),
+    ("mxnet_tpu.compression", "WirePayload"),
+})
+# Only CLASSES from these modules — the pickle surface the reference
+# semantics actually ship (optimizer/updater/scheduler objects, NDArray
+# states).  A whole-package allowance would admit module-level
+# callables and classes with side-effecting constructors (recordio/
+# checkpoint file writers, server sockets) as REDUCE gadgets.
+_SAFE_MXT_MODULES = (
+    "mxnet_tpu.optimizer", "mxnet_tpu.lr_scheduler",
+    "mxnet_tpu.ndarray", "mxnet_tpu.initializer",
+    "mxnet_tpu.gluon.parameter",
+    # Module.init_optimizer ships optimizers carrying sym/idx2name
+    # context (reference: optimizer.py Optimizer attributes)
+    "mxnet_tpu.symbol", "mxnet_tpu.attribute", "mxnet_tpu.name",
+)
+
+
+def _env_allowlist():
+    """Operator-extensible trust: MXNET_KVSTORE_PICKLE_ALLOWLIST is a
+    comma-separated list of ``module`` or ``module:name`` entries (a
+    bare module admits every name in it).  This is the escape hatch for
+    the reference's custom-optimizer flow — a user-defined optimizer
+    class living in ``__main__``/their own package can be shipped to
+    the servers by explicitly naming its module in the job env (the
+    launcher propagates env to every role)."""
+    raw = os.environ.get("MXNET_KVSTORE_PICKLE_ALLOWLIST", "")
+    entries = []
+    for item in raw.split(","):
+        item = item.strip()
+        if item:
+            mod, _, name = item.partition(":")
+            entries.append((mod, name or None))
+    return entries
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        if (module, name) in _SAFE_GLOBALS:
+            return super().find_class(module, name)
+        if any(module == m or module.startswith(m + ".")
+               for m in _SAFE_MXT_MODULES):
+            import inspect
+            obj = super().find_class(module, name)
+            if inspect.isclass(obj):
+                return obj
+        for mod, ename in _env_allowlist():
+            if module == mod and (ename is None or name == ename):
+                return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"kvstore wire: refusing to unpickle {module}.{name} "
+            "(not in the transport allowlist; for custom optimizer/"
+            "updater classes set MXNET_KVSTORE_PICKLE_ALLOWLIST="
+            f"{module}:{name} on every job role)")
+
+
+def _restricted_loads(data):
+    """pickle.loads through the transport allowlist — for wire skeletons
+    and peer-supplied control blobs (shipped optimizers, state blobs)."""
+    import io
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
 def _send_msg(sock, obj, fi_role=None):
-    """Length-prefixed pickle send.  ``fi_role`` tags DATA-channel
-    traffic for the deterministic fault-injection hooks ("client" may be
-    severed at an exact message, "server" may delay acks); untagged
-    sends (heartbeats) are exempt so a plan hits only what it targets."""
+    """Zero-copy framed send (skeleton pickle + raw tensor buffers).
+    ``fi_role`` tags DATA-channel traffic for the deterministic fault-
+    injection hooks ("client" may be severed at an exact message,
+    "server" may delay acks); untagged sends (heartbeats) are exempt so
+    a plan hits only what it targets."""
     if fi_role == "client":
         faultinject.client_send(sock)
     elif fi_role == "server":
         faultinject.server_reply_delay()
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+    bufs = []
+    skel = pickle.dumps(_pack(obj, bufs),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    total = 4 + len(skel) + sum(a.nbytes for a in bufs)
+    _prof.record_channel_bytes("sent", 8 + total)
+    sock.sendall(struct.pack(">QI", total, len(skel)) + skel)
+    for arr in bufs:
+        sock.sendall(memoryview(arr).cast("B"))
     if fi_role == "client":
         faultinject.client_sent(sock)
 
@@ -85,8 +275,19 @@ def _recv_exact(sock, n):
 def _recv_msg(sock, fi_role=None):
     if fi_role == "client":
         faultinject.client_recv(sock)
-    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    total, skel_len = struct.unpack(">QI", _recv_exact(sock, 12))
+    skel = _restricted_loads(_recv_exact(sock, skel_len))
+    body = _recv_exact(sock, total - 4 - skel_len)
+    _prof.record_channel_bytes("recv", 8 + total)
+    refs = []
+    _collect_bufs(skel, refs)
+    if not refs:
+        return skel
+    offsets, off = {}, 0
+    for ref in sorted(refs, key=lambda r: r.i):
+        offsets[ref.i] = off
+        off += ref.nbytes
+    return _unpack(skel, body, offsets)
 
 
 class KVStoreServer:
@@ -129,7 +330,14 @@ class KVStoreServer:
         # replies embed whole arrays, so the window is deliberately
         # small; client windows are LRU-capped too (a relaunched client
         # arrives under a fresh nonce and must not pin the old one).
-        self._dedup_window = int(_env("MXNET_KVSTORE_DEDUP_WINDOW", 8))
+        # With the PIPELINED client (MXNET_KVSTORE_WINDOW envelopes in
+        # flight) a reconnect replays the whole window, so the reply
+        # cache must cover it: default 2x the client window (plus the
+        # zombie-duplicate slack), read from the same env the launcher
+        # exports to every role.
+        self._dedup_window = int(_env(
+            "MXNET_KVSTORE_DEDUP_WINDOW",
+            max(8, 2 * int(_env("MXNET_KVSTORE_WINDOW", 8)))))
         self._dedup_clients = 256
         self._dedup = OrderedDict()   # client_id -> {inflight, replies}
         self._dedup_cv = threading.Condition()
@@ -147,9 +355,12 @@ class KVStoreServer:
     def _apply_push(self, key, arr):
         """reference kvstore_dist_server.h:405-430: async branch applies the
         updater right away; a pushed value with no updater replaces the
-        stored one (assign, not add)."""
+        stored one (assign, not add).  A compressed payload (2bit/fp16
+        wire mode) is dequantized here — the stored weight stays fp32."""
         from .ndarray import NDArray
         import jax.numpy as jnp
+        if isinstance(arr, WirePayload):
+            arr = _decompress(arr)
         grad = NDArray(jnp.asarray(arr))
         with self._lock:
             stored = self._store.get(key)
@@ -182,6 +393,14 @@ class KVStoreServer:
         if op == "push":
             _, key, arr = msg
             self._apply_push(key, arr)
+            return None
+        if op == "push_multi":
+            # coalesced small-key push: one envelope, applied in order
+            # (the worker groups sub-threshold keys bound for this shard
+            # into a single frame — one RTT instead of K)
+            _, entries = msg
+            for key, arr in entries:
+                self._apply_push(key, arr)
             return None
         if op == "pull":
             _, key = msg
@@ -233,7 +452,9 @@ class KVStoreServer:
                 if self._updater is None:
                     raise RuntimeError(
                         "set_states before an optimizer was installed")
-                self._updater.set_states(blob)
+                # decode the peer-supplied blob through the transport
+                # allowlist (Updater.set_states accepts the loaded dict)
+                self._updater.set_states(_restricted_loads(blob))
             return None
         if op == "command":
             _, head, body = msg
@@ -322,7 +543,9 @@ class KVStoreServer:
         if head == K_CONTROLLER:
             from . import optimizer as opt
             with self._lock:
-                self._updater = opt.get_updater(pickle.loads(body))
+                # peer-supplied blob: decode through the transport
+                # allowlist, never stock pickle
+                self._updater = opt.get_updater(_restricted_loads(body))
             return None
         return None  # kSyncMode etc.: accepted, no-op in the async server
 

@@ -853,7 +853,11 @@ class Updater:
         return state
 
     def set_states(self, states):
-        states = pickle.loads(states)
+        # bytes = a trusted local blob (checkpoint file); an already-
+        # loaded object comes from the kvstore server, which decodes
+        # peer blobs through its restricted unpickler first
+        if isinstance(states, (bytes, bytearray)):
+            states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2:
             self.states, self.optimizer = states
         else:

@@ -226,6 +226,32 @@ def reset_channel_counts():
         _channel_counts.clear()
 
 
+# -- kvstore channel byte counters -------------------------------------------
+# Bytes moved per transport DIRECTION ("sent"/"recv" for the socket wire,
+# "allgather" for host collectives).  Separate from the event counters:
+# events prove a recovery path RAN, bytes prove a wire optimization is
+# real — the 2-bit compression acceptance asserts its >=8x push-byte
+# reduction against these, and bench.py surfaces wire_bytes_per_step.
+_channel_bytes: dict = {}
+
+
+def record_channel_bytes(kind: str, n: int):
+    """Add ``n`` bytes to the transport byte counter ``kind`` (always on
+    — two dict ops are noise next to the socket write they measure)."""
+    with _channel_lock:
+        _channel_bytes[kind] = _channel_bytes.get(kind, 0) + int(n)
+
+
+def channel_bytes() -> dict:
+    with _channel_lock:
+        return dict(_channel_bytes)
+
+
+def reset_channel_bytes():
+    with _channel_lock:
+        _channel_bytes.clear()
+
+
 _NULL = __import__("contextlib").nullcontext()
 
 

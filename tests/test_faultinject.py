@@ -44,11 +44,16 @@ def _clean_plans():
 @pytest.fixture(autouse=True)
 def _fast_retry(monkeypatch):
     """Millisecond backoff so recovery paths run in test time; heartbeat
-    off unless a test opts in (fewer background threads)."""
+    off unless a test opts in (fewer background threads).  The legacy
+    kill-point tests pin MXNET_KVSTORE_WINDOW=1 — their exact-message
+    kill indices and dedup counts assume the stop-and-wait channel,
+    which window=1 reproduces bit for bit; the windowed pipeline has its
+    own deterministic kill point (kill_when_unacked) and tests below."""
     monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "8")
     monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
     monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
     monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0")
+    monkeypatch.setenv("MXNET_KVSTORE_WINDOW", "1")
 
 
 def _serve(monkeypatch, num_workers=1, **kw):
@@ -106,6 +111,9 @@ def test_kill_after_send_dedups_replayed_push(monkeypatch, point):
         srv.stop()
 
 
+_BASELINE_CACHE: dict = {}
+
+
 def _symbol():
     data = mx.sym.Variable('data')
     net = mx.sym.FullyConnected(data, num_hidden=8, name='fc1')
@@ -114,12 +122,29 @@ def _symbol():
     return mx.sym.SoftmaxOutput(net, name='softmax')
 
 
-def _train_through_kvstore(monkeypatch, kill=None):
+def _train_through_kvstore(monkeypatch, kill=None, window=None,
+                           kill_unacked=None, delay_ack=0.0):
     """One full dist_async training run (Module + server-side SGD, the
     update-on-kvstore mode, driven through run_steps' eager-fallback
-    path) against a FRESH server; returns (final params, dedup count)."""
+    path) against a FRESH server; returns (final params, dedup count).
+
+    ``window``/``kill_unacked``/``delay_ack`` arm the PIPELINED-channel
+    variant: MXNET_KVSTORE_WINDOW=window, server acks slowed so the
+    window provably fills, connection severed the first time
+    ``kill_unacked`` envelopes are in flight.
+
+    The no-fault baseline is memoized (fully deterministic: fixed
+    seeds, fresh server) — two tests compare against it and the suite
+    runs close to its CI time box."""
+    import contextlib
+    if kill is None and window is None and kill_unacked is None \
+            and _BASELINE_CACHE:
+        params, dedup = _BASELINE_CACHE[0]
+        return {k: v.copy() for k, v in params.items()}, dedup
     srv = _serve(monkeypatch)
     try:
+        if window is not None:
+            monkeypatch.setenv("MXNET_KVSTORE_WINDOW", str(window))
         mx.random.seed(7)
         rs = np.random.RandomState(11)
         data = rs.uniform(-1, 1, (K, BATCH, NIN)).astype(np.float32)
@@ -139,12 +164,23 @@ def _train_through_kvstore(monkeypatch, kill=None):
                 mod.run_steps(data, label, k=K)
             assert faultinject.stats()["kills_fired"] == 1, \
                 "fault did not fire inside run_steps"
+        elif kill_unacked is not None:
+            with contextlib.ExitStack() as stack:
+                stack.enter_context(faultinject.delay_acks(delay_ack))
+                stack.enter_context(
+                    faultinject.kill_when_unacked(kill_unacked))
+                mod.run_steps(data, label, k=K)
+            assert faultinject.stats()["kills_fired"] == 1, \
+                "window kill did not fire inside run_steps"
         else:
             mod.run_steps(data, label, k=K)
         arg, _aux = mod.get_params()
         params = {k: v.asnumpy().copy() for k, v in arg.items()}
         dedup = srv.dedup_count
         mod._kvstore.close(stop_servers=True)
+        if kill is None and window is None and kill_unacked is None:
+            _BASELINE_CACHE[0] = (
+                {k: v.copy() for k, v in params.items()}, dedup)
         return params, dedup
     finally:
         srv.stop()
@@ -228,7 +264,7 @@ def test_delayed_acks_keep_fifo_semantics(monkeypatch):
     srv = _serve(monkeypatch)
     try:
         kv = mx.kv.create('dist_async')
-        with faultinject.delay_acks(0.03):
+        with faultinject.delay_acks(0.02):
             kv.init('a', mx.nd.zeros(SHAPE))
             kv.push('a', mx.nd.ones(SHAPE) * 2)
             out = mx.nd.zeros(SHAPE)
@@ -284,6 +320,130 @@ def test_barrier_timeout_names_missing_ranks(monkeypatch):
         kv.close(stop_servers=True)
     finally:
         srv.stop()
+
+
+def test_window_kill_with_k_unacked_replays_whole_window(monkeypatch):
+    """Pipelined channel: with slowed acks a burst of pushes fills the
+    in-flight window; severing the connection with 4 envelopes unacked
+    must replay ALL 4 in seq order on the fresh connection, each applied
+    exactly once (server dedup) — the final weight is the exact serial
+    result.  The kill point itself is the pipelining proof: a
+    stop-and-wait channel can never have 4 envelopes unacked."""
+    monkeypatch.setenv("MXNET_KVSTORE_WINDOW", "8")
+    srv = _serve(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.ones(SHAPE))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.0,
+                                          wd=0.0, rescale_grad=1.0))
+        out = mx.nd.zeros(SHAPE)
+        with faultinject.delay_acks(0.03):
+            with faultinject.kill_when_unacked(4):
+                for i in range(6):
+                    kv.push('w', mx.nd.ones(SHAPE) * (i + 1))
+                kv.pull('w', out=out)
+        # w = 1 - 0.5 * (1+2+3+4+5+6): a lost or double-applied push in
+        # the replayed window breaks the exact total
+        np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.5 * 21,
+                                   rtol=1e-6)
+        assert faultinject.stats()["kills_fired"] == 1
+        counts = profiler.channel_counts()
+        assert counts.get("kvstore.reconnect", 0) >= 1, counts
+        assert counts.get("kvstore.replay", 0) == 4, counts
+        assert counts.get("kvstore.replay_acked", 0) == 4, counts
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_window_one_never_fills_pipeline(monkeypatch):
+    """MXNET_KVSTORE_WINDOW=1 degrades to stop-and-wait: at most one
+    envelope is ever unacked, so an armed 2-deep window kill can never
+    fire and the run completes untouched."""
+    monkeypatch.setenv("MXNET_KVSTORE_WINDOW", "1")
+    srv = _serve(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.zeros(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        with faultinject.delay_acks(0.02):
+            with faultinject.kill_when_unacked(2):
+                for i in range(4):
+                    kv.push('w', mx.nd.ones(SHAPE) * (i + 1))
+                kv.pull('w', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 4.0)  # assign semantics
+        assert faultinject.stats()["kills_fired"] == 0
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_window_full_replay_mid_run_steps_bit_identical(monkeypatch):
+    """THE windowed acceptance scenario: a connection killed mid-
+    run_steps with the ENTIRE window in flight (window=2: the Module
+    update path keeps one fire-and-forget push + one pull outstanding)
+    replays the whole window in order and finishes with params
+    BIT-IDENTICAL to an uninterrupted run."""
+    baseline, dedup0 = _train_through_kvstore(monkeypatch)
+    assert dedup0 == 0
+    got, _dedup = _train_through_kvstore(monkeypatch, window=2,
+                                         kill_unacked=2, delay_ack=0.01)
+    assert set(got) == set(baseline)
+    for name in baseline:
+        np.testing.assert_array_equal(
+            got[name], baseline[name],
+            err_msg=f"{name} diverged after full-window kill")
+    counts = profiler.channel_counts()
+    assert counts.get("kvstore.reconnect", 0) >= 1, counts
+    assert counts.get("kvstore.replay", 0) >= 2, counts
+
+
+def test_window_deep_pipeline_gluon_bit_identical(monkeypatch):
+    """Deep window (8) under the gluon Trainer, whose step pushes every
+    param fire-and-forget before one batched pull — 6+ envelopes in
+    flight.  A kill at depth 5 replays the window; two training steps
+    end bit-identical to the uninterrupted twin."""
+    import mxnet_tpu.gluon as gluon
+    from mxnet_tpu import autograd
+
+    x = mx.nd.array(np.array([[1., 2., 3.], [4., 5., 6.]], np.float32))
+
+    def run(fault):
+        srv = _serve(monkeypatch)
+        try:
+            net = gluon.nn.Dense(2, in_units=3, prefix='wdp_')
+            net.initialize(mx.initializer.One())
+            tr = gluon.Trainer(net.collect_params(), 'sgd',
+                               {'learning_rate': 0.1, 'momentum': 0.9,
+                                'wd': 0.0}, kvstore='dist_async')
+            for step in range(2):
+                with autograd.record():
+                    loss = (net(x) ** 2).sum()
+                loss.backward()
+                if fault and step == 1:
+                    with faultinject.delay_acks(0.02):
+                        with faultinject.kill_when_unacked(4):
+                            tr.step(batch_size=2)
+                    assert faultinject.stats()["kills_fired"] == 1, \
+                        "deep-window kill did not fire"
+                    faultinject.reset()
+                else:
+                    tr.step(batch_size=2)
+            params = {k: v.data().asnumpy().copy()
+                      for k, v in net.collect_params().items()}
+            tr._kvstore.close(stop_servers=True)
+            return params
+        finally:
+            srv.stop()
+
+    baseline = run(fault=False)
+    monkeypatch.setenv("MXNET_KVSTORE_WINDOW", "8")
+    got = run(fault=True)
+    assert set(got) == set(baseline)
+    for name in baseline:
+        np.testing.assert_array_equal(
+            got[name], baseline[name],
+            err_msg=f"{name} diverged after deep-window kill")
 
 
 def test_close_warns_on_stuck_io_thread(monkeypatch):

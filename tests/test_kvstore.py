@@ -2,6 +2,8 @@
 (model: tests/python/unittest/test_kvstore.py — init/push/pull
 aggregation, list keys, string keys, custom updater, set_optimizer,
 row_sparse_pull)."""
+import time
+
 import numpy as np
 import pytest
 
@@ -409,6 +411,11 @@ def test_dist_async_server_death_surfaces_as_error(monkeypatch):
     monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
     monkeypatch.setenv("DMLC_NUM_WORKER", "1")
     monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    # millisecond backoff: the error CONTRACT is what's under test, not
+    # the production retry schedule (~7s of default backoff per pull)
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "4")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
     try:
         kv = mx.kv.create('dist_async')
         kv.init('a', mx.nd.ones(SHAPE))
@@ -573,6 +580,445 @@ def test_dist_async_stale_checkpoint_after_load(monkeypatch):
     finally:
         for s in srvs:
             s.stop()
+
+
+def _serve_one(monkeypatch, **kw):
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srv = KVStoreServer(server_id=0, num_workers=1, **kw)
+    srv.start_background()
+    monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    return srv
+
+
+def test_set_gradient_compression_validation():
+    """Local stores have no wire — compression raises, like the
+    reference; bad configs fail loudly."""
+    from mxnet_tpu.base import MXNetError
+    kv = mx.kv.create('local')
+    with pytest.raises(MXNetError, match="not supported"):
+        kv.set_gradient_compression({'type': '2bit'})
+    kv2 = mx.kv.create('device')
+    kv2.set_gradient_compression({'type': '2bit', 'threshold': 0.5})
+    with pytest.raises(MXNetError, match="type"):
+        kv2.set_gradient_compression({'type': '3bit'})
+    with pytest.raises(MXNetError, match="threshold"):
+        kv2.set_gradient_compression({'type': '2bit', 'threshold': 0.0})
+    with pytest.raises(MXNetError, match="unknown"):
+        kv2.set_gradient_compression({'type': '2bit', 'bogus': 1})
+
+
+def test_dist_async_2bit_push_wire_bytes_8x(monkeypatch):
+    """THE compression acceptance: 2-bit quantization cuts the measured
+    push wire bytes >= 8x for an fp32 payload, asserted against the
+    transport byte counters (profiler.channel_bytes), not computed from
+    theory."""
+    from mxnet_tpu import profiler
+
+    def push_bytes(compress):
+        srv = _serve_one(monkeypatch)
+        try:
+            kv = mx.kv.create('dist_async')
+            if compress:
+                kv.set_gradient_compression({'type': '2bit',
+                                             'threshold': 0.5})
+            big = np.zeros((256, 256), np.float32)       # 256 KiB fp32
+            kv.init('w', mx.nd.NDArray(big))
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
+                                              momentum=0.0, wd=0.0,
+                                              rescale_grad=1.0))
+            profiler.reset_channel_bytes()
+            kv.push('w', mx.nd.NDArray(np.ones((256, 256), np.float32)))
+            kv._conns[0].flush()
+            sent = profiler.channel_bytes().get("sent", 0)
+            kv.close(stop_servers=True)
+            return sent
+        finally:
+            srv.stop()
+
+    raw = push_bytes(compress=False)
+    packed = push_bytes(compress=True)
+    assert raw > 256 * 256 * 4                  # full fp32 went out
+    assert raw / packed >= 8.0, (raw, packed)   # >= 8x on the wire
+
+
+def test_2bit_error_feedback_residual_drains(monkeypatch):
+    """A gradient below the threshold is NOT lost: it accumulates in the
+    worker-side residual until a quantum fires, the residual stays
+    bounded by the threshold, and the applied total tracks the true
+    gradient sum to within one quantum (error feedback drains)."""
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.set_gradient_compression({'type': '2bit', 'threshold': 1.0})
+        kv.init('w', mx.nd.zeros((2, 2)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, momentum=0.0,
+                                          wd=0.0, rescale_grad=1.0))
+        n, g = 10, np.float32(0.4)
+        for _ in range(n):
+            kv.push('w', mx.nd.NDArray(np.full((2, 2), g, np.float32)))
+        out = mx.nd.zeros((2, 2))
+        kv.pull('w', out=out)
+        # simulate the quantizer bit-for-bit (same fp32 ops)
+        resid, fired = np.float32(0.0), 0
+        for _ in range(n):
+            v = np.float32(resid + g)
+            q = np.float32(1.0) if v >= 1.0 else np.float32(0.0)
+            resid = np.float32(v - q)
+            fired += int(q)
+        np.testing.assert_allclose(out.asnumpy(), -float(fired), rtol=0,
+                                   atol=0)   # quanta are exact fp32
+        assert fired >= 3                    # sub-threshold grads DID fire
+        residual = kv._gc_residual['w']
+        assert np.all(np.abs(residual) < 1.0), residual   # bounded
+        np.testing.assert_allclose(residual, n * g - fired, rtol=1e-6)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_dist_async_2bit_convergence(monkeypatch):
+    """Convergence through the compressed wire: a small convex least-
+    squares problem trained via dist_async server-side SGD reaches the
+    same loss tolerance with 2-bit compression as without — the error-
+    feedback residual keeps the quantized updates unbiased."""
+
+    rs = np.random.RandomState(3)
+    X = rs.normal(size=(32, 4)).astype(np.float32)
+    w_true = np.array([[1.5], [-2.0], [0.5], [3.0]], np.float32)
+    y = X @ w_true
+
+    def train(compress, iters=160):
+        srv = _serve_one(monkeypatch)
+        try:
+            kv = mx.kv.create('dist_async')
+            if compress:
+                # threshold ~ the gradient scale: each element moves by
+                # lr*threshold per fired quantum, and error feedback
+                # carries the remainder — too small a threshold caps the
+                # per-step movement and stretches convergence
+                kv.set_gradient_compression({'type': '2bit',
+                                             'threshold': 1.0})
+            kv.init('w', mx.nd.zeros((4, 1)))
+            kv.set_optimizer(mx.optimizer.SGD(
+                learning_rate=0.05, momentum=0.0, wd=0.0,
+                rescale_grad=1.0))
+            out = mx.nd.zeros((4, 1))
+            for _ in range(iters):
+                kv.pull('w', out=out)
+                w = out.asnumpy()
+                grad = X.T @ (X @ w - y) / len(X)
+                kv.push('w', mx.nd.NDArray(grad.astype(np.float32)))
+            kv.pull('w', out=out)
+            w = out.asnumpy()
+            loss = float(np.mean((X @ w - y) ** 2))
+            kv.close(stop_servers=True)
+            return loss
+        finally:
+            srv.stop()
+
+    loss_raw = train(compress=False)
+    loss_2bit = train(compress=True)
+    # SAME loss tolerance for both wires (initial loss ~97): the error-
+    # feedback residual keeps quantized updates unbiased, so the
+    # compressed run reaches the optimum, not a quantization floor
+    assert loss_raw < 1e-3, loss_raw
+    assert loss_2bit < 1e-3, (loss_raw, loss_2bit)
+
+
+def test_dist_async_fp16_wire_mode(monkeypatch):
+    """fp16 wire mode: pushes travel as half precision (2x fewer bytes),
+    values exactly representable in fp16 round-trip losslessly; pull
+    stays fp32."""
+    from mxnet_tpu import profiler
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.set_gradient_compression({'type': 'fp16'})
+        kv.init('w', mx.nd.zeros(SHAPE))
+        profiler.reset_channel_bytes()
+        kv.push('w', mx.nd.NDArray(np.full(SHAPE, 1.5, np.float32)))
+        kv._conns[0].flush()
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('w', out=out)     # assign semantics: stored = dequantized
+        np.testing.assert_array_equal(out.asnumpy(),
+                                      np.full(SHAPE, 1.5, np.float32))
+        assert out.asnumpy().dtype == np.float32
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_gluon_trainer_compression_plumb_through(monkeypatch):
+    """Trainer(compression_params=...) reaches the kvstore before the
+    first push: the first gradient already rides the compressed wire
+    (and a typo'd config fails at Trainer construction)."""
+    import mxnet_tpu.gluon as gluon
+    from mxnet_tpu import autograd
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="type"):
+        gluon.Trainer([], 'sgd', {}, compression_params={'type': 'bad'})
+    srv = _serve_one(monkeypatch)
+    try:
+        net = gluon.nn.Dense(1, use_bias=False, in_units=3,
+                             prefix='gcp_')
+        # constant init: this test must not consume the GLOBAL RNG (the
+        # suite's unseeded downstream inits depend on the stream)
+        net.initialize(mx.initializer.One())
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1, 'momentum': 0.0,
+                            'wd': 0.0}, kvstore='dist_async',
+                           compression_params={'type': '2bit',
+                                               'threshold': 0.5})
+        x = mx.nd.ones((2, 3))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(batch_size=2)
+        gc = tr._kvstore._gcompress
+        assert gc is not None and gc.type == '2bit' \
+            and gc.threshold == 0.5
+        # the push went through the quantizer: a residual exists
+        assert 'gcp_weight' in tr._kvstore._gc_residual
+        tr._kvstore.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_dist_async_coalesced_multi_key_push(monkeypatch):
+    """A LIST push of small keys bound for one server travels as ONE
+    multi-key envelope (one seq, one ack) instead of one frame per key;
+    values apply exactly as individual pushes would."""
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        keys = ['ck1', 'ck2', 'ck3']
+        for k in keys:
+            kv.init(k, mx.nd.zeros((2, 2)))
+        seq_before = kv._conns[0]._next_seq
+        kv.push(keys, [mx.nd.ones((2, 2)) * (i + 1)
+                       for i in range(len(keys))])
+        kv._conns[0].flush()
+        # 3 pushes + 1 flush = 2 envelopes when coalesced (4 uncoalesced)
+        assert kv._conns[0]._next_seq - seq_before == 2
+        for i, k in enumerate(keys):
+            out = mx.nd.zeros((2, 2))
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out.asnumpy(), i + 1)
+        # large payloads are NOT coalesced (each is its own frame)
+        monkeypatch.setenv("MXNET_KVSTORE_COALESCE_BYTES", "8")
+        kv2 = mx.kv.create('dist_async')
+        seq_before = kv2._conns[0]._next_seq
+        kv2.push(keys, [mx.nd.ones((2, 2))] * len(keys))
+        kv2._conns[0].flush()
+        assert kv2._conns[0]._next_seq - seq_before == 4
+        kv2.close()
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_app_error_poison_still_delivers_queued_pushes(monkeypatch):
+    """An application error on a fire-and-forget push poisons the
+    channel for NEW requests, but requests already queued behind it
+    must still be delivered (the socket is healthy) — a lost gradient
+    must not pass silently."""
+    from mxnet_tpu import faultinject
+    from mxnet_tpu.base import MXNetError
+    monkeypatch.setenv("MXNET_KVSTORE_WINDOW", "1")
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.zeros(SHAPE))
+        with faultinject.delay_acks(0.05):
+            # the bad push's "err" ack lands while the good push is
+            # still QUEUED (W=1: it only dequeues after that ack)
+            kv.push('nope', mx.nd.ones(SHAPE))      # errs server-side
+            kv.push('w', mx.nd.ones(SHAPE) * 5)     # must still apply
+        # the queued push reached the server: a fresh client sees it
+        kv2 = mx.kv.create('dist_async')
+        out = mx.nd.zeros(SHAPE)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            kv2.pull('w', out=out)
+            if out.asnumpy().max() == 5.0:
+                break
+            time.sleep(0.02)
+        np.testing.assert_allclose(out.asnumpy(), 5.0)
+        # ...while the poisoned channel refuses NEW work loudly
+        with pytest.raises(MXNetError, match="channel failed"):
+            kv.pull('w', out=out)
+        kv2.close(stop_servers=True)
+        kv.close()
+    finally:
+        srv.stop()
+
+
+def test_wire_rejects_hostile_pickle(monkeypatch, tmp_path):
+    """The deserializer is allowlisted: a peer-supplied pickle naming a
+    non-allowlisted callable (os.system) is REFUSED — no side effect,
+    connection dropped, and the server keeps serving other clients."""
+    import os as _os
+    import pickle as _pkl
+    import socket as _socket
+    from mxnet_tpu.kvstore_server import (_restricted_loads, _send_msg,
+                                          _recv_msg)
+
+    marker = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            return (_os.system, (f"touch {marker}",))
+
+    with pytest.raises(_pkl.UnpicklingError, match="refusing"):
+        _restricted_loads(_pkl.dumps(Evil()))
+
+    # gadgets INSIDE allowlisted-root packages must be refused too: the
+    # allowlist is per-(module, name), not per-root — numpy ships
+    # importable exec helpers (numpy.testing.runstring) that a REDUCE
+    # could otherwise call with attacker arguments
+    class EvilNumpyGadget:
+        def __reduce__(self):
+            import numpy.testing
+            return (numpy.testing.runstring, ("x = 1", {}))
+
+    with pytest.raises(_pkl.UnpicklingError, match="refusing"):
+        _restricted_loads(_pkl.dumps(EvilNumpyGadget()))
+
+    # mxnet_tpu itself is not blanket-trusted either: classes with
+    # side-effecting constructors (file writers) and module-level
+    # functions are refused — only classes from the optimizer/ndarray/
+    # scheduler surface resolve
+    import mxnet_tpu.recordio as _rio
+
+    class EvilFileWriter:
+        def __reduce__(self):
+            return (_rio.MXRecordIO, (str(marker), "w"))
+
+    with pytest.raises(_pkl.UnpicklingError, match="refusing"):
+        _restricted_loads(_pkl.dumps(EvilFileWriter()))
+    assert not marker.exists()
+
+    class EvilModuleFunc:
+        def __reduce__(self):
+            return (mx.optimizer.create, ("sgd",))   # function, not class
+
+    with pytest.raises(_pkl.UnpicklingError, match="refusing"):
+        _restricted_loads(_pkl.dumps(EvilModuleFunc()))
+
+    # the wire-protocol module itself is not blanket-trusted: its _Buf
+    # marker is allowlisted by NAME, while KVStoreServer (constructor
+    # binds a listening socket) stays out of REDUCE reach
+    from mxnet_tpu.kvstore_server import KVStoreServer as _KVS
+
+    class EvilSocketBinder:
+        def __reduce__(self):
+            return (_KVS, (0, 1, "127.0.0.1", 0))
+
+    with pytest.raises(_pkl.UnpicklingError, match="refusing"):
+        _restricted_loads(_pkl.dumps(EvilSocketBinder()))
+
+    srv = _serve_one(monkeypatch)
+    try:
+        s = _socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        _send_msg(s, ("push", "w", Evil()))
+        # server refuses the frame and drops the connection: EOF here
+        with pytest.raises((ConnectionError, OSError)):
+            _recv_msg(s)
+        s.close()
+        assert not marker.exists(), "hostile payload executed!"
+        # the server is still healthy for well-formed clients
+        kv = mx.kv.create('dist_async')
+        kv.init('ok', mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('ok', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+class _CustomUserOpt(mx.optimizer.SGD):
+    """Module-level so pickle can name it (stands in for a user's own
+    optimizer class living outside mxnet_tpu)."""
+
+
+def test_custom_optimizer_needs_env_allowlist(monkeypatch):
+    """Reference parity escape hatch: a user-defined optimizer class
+    outside mxnet_tpu is refused by the wire allowlist by DEFAULT, and
+    admitted when the operator names its module in
+    MXNET_KVSTORE_PICKLE_ALLOWLIST (set on every job role)."""
+    import pickle as _pkl
+    from mxnet_tpu.kvstore_server import _restricted_loads
+    import mxnet_tpu.optimizer as opt_mod
+    MyOpt = _CustomUserOpt
+
+    blob = _pkl.dumps(MyOpt(learning_rate=0.5))
+    monkeypatch.delenv("MXNET_KVSTORE_PICKLE_ALLOWLIST", raising=False)
+    with pytest.raises(_pkl.UnpicklingError,
+                       match="MXNET_KVSTORE_PICKLE_ALLOWLIST"):
+        _restricted_loads(blob)
+    monkeypatch.setenv("MXNET_KVSTORE_PICKLE_ALLOWLIST", MyOpt.__module__)
+    loaded = _restricted_loads(blob)
+    assert isinstance(loaded, opt_mod.SGD) and loaded.lr == 0.5
+    # end to end: ship it to a live server and train through it
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.ones(SHAPE))
+        kv.set_optimizer(MyOpt(learning_rate=0.5, momentum=0.0, wd=0.0,
+                               rescale_grad=1.0))
+        kv.push('w', mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('w', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 0.5, rtol=1e-6)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_wire_frame_roundtrip_zero_copy():
+    """The raw-buffer frame codec: nested tuples/lists/dicts of ndarrays
+    round-trip exactly (dtype, shape, 0-d, empty, int64) — tensors ride
+    raw buffers, never pickle."""
+    import socket as _socket
+    import threading as _threading
+    from mxnet_tpu.kvstore_server import _send_msg, _recv_msg
+    a, b = _socket.socketpair()
+    try:
+        msgs = [
+            ("init", "w", np.arange(12, dtype=np.float32).reshape(3, 4)),
+            ("ok", (np.ones((2, 3), np.float64), (4, 3))),
+            ("push", "k", np.float32(7.5) * np.ones((), np.float32)),
+            ("pull_rows", "k", np.array([], np.int64)),
+            {"states": [np.arange(4, dtype=np.int64)]},
+        ]
+        t = _threading.Thread(
+            target=lambda: [_send_msg(a, m) for m in msgs])
+        t.start()
+        for want in msgs:
+            got = _recv_msg(b)
+
+            def chk(x, y):
+                if isinstance(x, np.ndarray):
+                    assert x.dtype == y.dtype and x.shape == y.shape
+                    np.testing.assert_array_equal(x, y)
+                elif isinstance(x, (tuple, list)):
+                    assert len(x) == len(y)
+                    for i, j in zip(x, y):
+                        chk(i, j)
+                elif isinstance(x, dict):
+                    assert set(x) == set(y)
+                    for k in x:
+                        chk(x[k], y[k])
+                else:
+                    assert x == y, (x, y)
+            chk(want, got)
+        t.join()
+    finally:
+        a.close()
+        b.close()
 
 
 def test_dist_async_rejects_stripe_separator_keys(monkeypatch):
