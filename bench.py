@@ -375,9 +375,13 @@ def _run(batch):
     # configs.  See profiler.channel_bytes / docs/PERF_NOTES.md.
     from mxnet_tpu import profiler as _mx_prof
     wire0 = sum(_mx_prof.channel_bytes().values())
+    sync0 = _mx_prof.host_sync_total()
     t0 = time.perf_counter()
     for i in range(iters):
         step(i)
+    # snapshot host syncs BEFORE the barrier: hard_sync's own readback is
+    # measurement plumbing, not part of the training loop being scored
+    host_syncs = _mx_prof.host_sync_total() - sync0
     hard_sync()
     dt = time.perf_counter() - t0
     wire_bytes = sum(_mx_prof.channel_bytes().values()) - wire0
@@ -408,6 +412,12 @@ def _run(batch):
         "steps_per_call": STEPS_PER_CALL,
         "wire_bytes_per_step": round(
             wire_bytes / iters / STEPS_PER_CALL, 1),
+        # host-blocking readbacks per TRAINING step (profiler.host_syncs)
+        # — 0.0 in the steady state: the sync-free loop's one number.
+        # Nonzero means something in the step path re-grew a per-step
+        # device->host sync (docs/PERF_NOTES.md round 8).
+        "host_syncs_per_step": round(
+            host_syncs / iters / STEPS_PER_CALL, 3),
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
         "remat": (os.environ.get("MXNET_REMAT_POLICY", "full")

@@ -11,7 +11,8 @@ echo "== unit + integration suite (8-device CPU mesh via tests/conftest.py)"
 # runs the whole file — double-running the heaviest new file buys no
 # coverage.
 python -m pytest tests/ -q --durations=10 -m "" \
-    --ignore=tests/test_run_steps.py
+    --ignore=tests/test_run_steps.py \
+    --ignore=tests/test_sync_free.py
 
 echo "== tier-1: K-step scan == K eager steps (CPU bit-equivalence gate)"
 # The multi-step driver's correctness is provable WITHOUT a chip: the
@@ -20,6 +21,16 @@ echo "== tier-1: K-step scan == K eager steps (CPU bit-equivalence gate)"
 # change can't silently drop it from the gate.
 # -m "" so the slow-marked equivalence variants run here too
 JAX_PLATFORMS=cpu python -m pytest tests/test_run_steps.py -q -m ""
+
+echo "== sync-count regression gate (sync-free training loop)"
+# A short CPU fit() must record <= N/frequent + 2 host syncs per epoch
+# (device-resident metrics; callbacks are the only sync points) while
+# the legacy host-metric path is pinned at >= 1 sync PER BATCH — both
+# live in tests/test_sync_free.py, run as its own invocation so a
+# pytest.ini / conftest change can't silently drop the gate.  A
+# regression that re-grows a per-batch device->host sync fails HERE,
+# on CPU, instead of only showing up as step-time jitter on a chip.
+JAX_PLATFORMS=cpu python -m pytest tests/test_sync_free.py -q -m ""
 
 echo "== fault-injection smoke (dist_async kill-and-recover)"
 # The transport recovery path (reconnect + replay + server dedup,

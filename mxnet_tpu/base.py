@@ -123,6 +123,17 @@ declare_env("MXNET_ZERO_STAGE", int, 0,
             "ZeRO optimizer-state sharding over the dp mesh axis: 0 off, "
             "1 = shard optimizer states + fp32 master weights (Module "
             "zero_stage kwarg overrides)")
+declare_env("MXNET_DEVICE_METRICS", bool, True,
+            "device-resident metric accumulation in the training/eval "
+            "loops (EvalMetric.device_update + lazy sync); 0 restores "
+            "the classic one-host-readback-per-batch metric path")
+declare_env("MXNET_SCAN_CACHE_MAX", int, 32,
+            "max compiled K-step scan programs retained per "
+            "Module/Trainer (LRU; executor.scan_cache_store)")
+declare_env("MXNET_PREDICT_READBACK_BATCHES", int, 64,
+            "predict readback chunk: batches fetched per stacked "
+            "device_get (bounds device memory held by the stacked "
+            "readback; module.base_module.chunked_device_get)")
 
 
 # ---------------------------------------------------------------------------

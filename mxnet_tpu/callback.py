@@ -1,4 +1,14 @@
-"""Training callbacks (reference: python/mxnet/callback.py)."""
+"""Training callbacks (reference: python/mxnet/callback.py).
+
+SYNC CONTRACT (the sync-free training loop, docs/PERF_NOTES.md round 8):
+metric accumulation in fit/score is device-resident, and a callback that
+reads the metric — ``get_name_value()`` → ``EvalMetric.sync()`` — is the
+ONLY point where the host blocks on a device readback.  Callbacks that
+observe metrics therefore set the loop's sync cadence: Speedometer
+syncs once per ``frequent`` batches, LogValidationMetricsCallback once
+per evaluation, and a loop with no metric-reading callback syncs once
+per epoch (the epoch-end log).  tests/test_sync_free.py asserts this.
+"""
 from __future__ import annotations
 
 import logging
@@ -57,7 +67,15 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Throughput logging (reference: callback.py:120 Speedometer)."""
+    """Throughput logging (reference: callback.py:120 Speedometer).
+
+    Reading the metric here (every ``frequent`` batches) triggers the
+    lazy ``EvalMetric.sync()`` — with device-resident metrics this is
+    the training loop's ONLY per-interval host sync, so ``frequent`` is
+    literally the host-readbacks-per-epoch dial: N batches at
+    ``frequent=F`` cost floor((N-1)/F) syncs here (count hits
+    ``% F == 0`` on batch indices 1..N-1) plus the epoch-end log's one,
+    not N."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -110,7 +128,11 @@ class ProgressBar:
 
 
 class LogValidationMetricsCallback:
-    """reference: callback.py LogValidationMetricsCallback."""
+    """reference: callback.py LogValidationMetricsCallback.
+
+    ``get_name_value()`` below is the lazy sync point: the whole
+    validation pass accumulates on device and this callback's read is
+    its one host readback."""
 
     def __call__(self, param):
         if not param.eval_metric:

@@ -185,10 +185,13 @@ def build_multi_step(step_body, donate=True):
 
     ``step_body(carry, x, const) -> (carry, y)`` is the pure single-step
     function: ``carry`` holds everything that flows step-to-step (params,
-    aux/BN statistics, optimizer state), ``x`` holds the per-step inputs
-    scanned over their leading K axis (data, labels, per-step lr/wd/t,
-    RNG keys), and ``const`` holds step-invariant inputs (fixed params,
-    state inputs).  Returns a jitted ``fn(carry, xs, const) -> (carry,
+    aux/BN statistics, optimizer state — and, for callers that fold a
+    device-resident metric, the metric's ``(sum, count)`` state, so K
+    steps of metric accumulation ride the same one dispatch with zero
+    readbacks; see metric.EvalMetric.device_update), ``x`` holds the
+    per-step inputs scanned over their leading K axis (data, labels,
+    per-step lr/wd/t, RNG keys), and ``const`` holds step-invariant
+    inputs (fixed params, state inputs).  Returns a jitted ``fn(carry, xs, const) -> (carry,
     ys)``; K is the leading dim of ``xs``, so the jit cache is keyed by
     (K, shapes, carry structure) for free.  With ``donate`` the carry
     buffers (params/aux/optimizer state) are donated — XLA updates them
@@ -201,6 +204,31 @@ def build_multi_step(step_body, donate=True):
         return jax.lax.scan(body, carry, xs)
 
     return jax.jit(k_steps, donate_argnums=(0,) if donate else ())
+
+
+def scan_cache_lookup(cache, key):
+    """Bounded-LRU lookup for compiled multi-step programs (the one
+    cache policy shared by Module.run_steps and Trainer.step_k): a hit
+    is re-inserted so eviction pops the least-recently-used entry —
+    plain FIFO would evict the hot long-lived program, which is always
+    the FIRST one inserted."""
+    entry = cache.get(key)
+    if entry is not None:
+        cache[key] = cache.pop(key)
+    return entry
+
+
+def scan_cache_store(cache, key, entry):
+    """Insert + bound (``MXNET_SCAN_CACHE_MAX``, default 32): a metric
+    with non-primitive hyperparameters keys by object identity
+    (metric._device_sig), so recreating one per epoch would otherwise
+    retain a compiled scan program per instance for the process
+    lifetime."""
+    from .base import env
+    cache[key] = entry
+    while len(cache) > int(env("MXNET_SCAN_CACHE_MAX", 32)):
+        cache.pop(next(iter(cache)))
+    return entry
 
 
 # device buffers of the last schedule per optimizer (weak-keyed so a

@@ -378,7 +378,8 @@ class Trainer:
             for s, v in zip(st_old, st_new):
                 s._set_data(v)
 
-    def step_k(self, loss_fn, data, label=None, k=None, batch_size=None):
+    def step_k(self, loss_fn, data, label=None, k=None, batch_size=None,
+               eval_metric=None):
         """Run K training steps (forward + backward + update) as ONE
         scanned XLA program — the gluon analog of ``Module.run_steps``,
         built on the same ``executor.build_multi_step`` driver: a single
@@ -396,6 +397,17 @@ class Trainer:
         arrays, mirrored into loss_fn per step).  Returns the per-step
         loss values stacked on a leading K axis (ONE host readback reads
         them all).
+
+        ``eval_metric`` (the gluon leg of the sync-free training loop):
+        each step's ``(labels, loss)`` pair folds into the metric.  A
+        device-capable metric (metric.EvalMetric.device_update — e.g.
+        ``Loss``, ``MAE``) rides the scan carry: K steps of metric
+        accumulation cost ZERO extra dispatches and readbacks, and the
+        host only syncs when the metric is read (get_name_value) — on
+        the fused AND eager drivers alike.  Metrics without a device
+        form fold host-side: on the fused path from ONE stacked
+        readback of the K losses; on the eager fallback per step (the
+        eager driver is per-step in every respect).
 
         Per-step lr/wd schedules and update counts are precomputed
         host-side, exactly as K ``step()`` calls would advance them.
@@ -442,10 +454,11 @@ class Trainer:
                 and not getattr(self, "_update_on_kvstore", False))
         if not fuse:
             return self._step_k_eager(loss_fn, data_t, label_t, k,
-                                      batch_size)
-        return self._step_k_fused(loss_fn, data_t, label_t, k)
+                                      batch_size, eval_metric)
+        return self._step_k_fused(loss_fn, data_t, label_t, k, eval_metric)
 
-    def _step_k_eager(self, loss_fn, data_t, label_t, k, batch_size):
+    def _step_k_eager(self, loss_fn, data_t, label_t, k, batch_size,
+                      eval_metric=None):
         """K eager steps: record → backward → step, one dispatch each
         (the universal fallback; same math as the scanned path)."""
         from .. import autograd as _ag
@@ -464,10 +477,16 @@ class Trainer:
                 loss = loss_fn(*args)
             loss.backward()
             self.step(batch_size)
+            if eval_metric is not None:
+                # device-resident when the metric supports it (no sync)
+                labs = [NDArray(a[j]) for a in label_t] \
+                    if label_t is not None else []
+                eval_metric.accumulate(labs, [loss])
             losses.append(loss._data)
         return NDArray(jnp.stack(losses))
 
-    def _step_k_fused(self, loss_fn, data_t, label_t, k):
+    def _step_k_fused(self, loss_fn, data_t, label_t, k,
+                      eval_metric=None):
         from .. import autograd as _ag
         from .. import profiler as _prof
         from ..ndarray import NDArray
@@ -521,6 +540,12 @@ class Trainer:
         else:
             param_specs = None
         donate = bool(env("MXNET_FUSED_DONATE", True))
+        # device-capable metrics ride the scan carry (zero extra
+        # dispatches/readbacks for K steps of metric accumulation);
+        # others fold host-side from the stacked losses below
+        use_dev_metric = (eval_metric is not None
+                          and getattr(eval_metric, "device_enabled",
+                                      lambda: False)())
         # cache key: loss_fn by CODE + bound instance + closure-cell
         # identities, not object identity — the natural per-iteration
         # lambda (`tr.step_k(lambda x, y: loss(net(x), y), ...)`) is a
@@ -537,17 +562,20 @@ class Trainer:
                   tuple(id(p) for p in pins))
         key = (fn_key, tuple(idxs), len(aux_params), use_mp, needs_t,
                opt.hyperparam_signature(), zero1, param_specs,
-               label_t is None, donate)
+               label_t is None, donate,
+               eval_metric._device_sig() if use_dev_metric else None)
         cache = getattr(self, "_step_k_cache", None)
         if cache is None:
             cache = self._step_k_cache = {}
-        entry = cache.get(key)
+        from ..executor import scan_cache_lookup, scan_cache_store
+        entry = scan_cache_lookup(cache, key)
         # the entry PINS the id()'d objects: without the strong refs, a
         # GC'd closure object's address could be reused by a NEW object
         # and false-hit a program traced against the old one
         fn = entry[0] if entry is not None else None
         if fn is None:
             all_params = trainable + aux_params
+            metric = eval_metric if use_dev_metric else None
 
             def f_loss(ws_, auxs_, data_j, label_j):
                 """Functionalized forward: park traced values in the
@@ -576,7 +604,7 @@ class Trainer:
                         p._data._thunk = thunk
 
             def scan_body(carry, x, const):
-                ws_, auxs_, sts_ = carry
+                ws_, auxs_, sts_, mstate = carry
                 data_j, label_j, lrs, wds, ts = x
 
                 loss_val, vjp_fn, new_auxs = jax.vjp(
@@ -596,11 +624,18 @@ class Trainer:
                         for w, ps in zip(new_ws, param_specs))
                     new_sts = _par.constrain_zero_states(
                         new_sts, mesh, self._zero_dp)
-                return (new_ws, new_auxs, new_sts), loss_val
+                if metric is not None:
+                    # (labels, loss) fold into the device metric state —
+                    # accumulation stays in the one scanned program
+                    mstate = metric.device_update(
+                        mstate,
+                        list(label_j) if label_j is not None else [],
+                        [loss_val])
+                return (new_ws, new_auxs, new_sts, mstate), loss_val
 
             from ..executor import build_multi_step
             fn = build_multi_step(scan_body, donate=donate)
-            cache[key] = (fn, pins)
+            scan_cache_store(cache, key, (fn, pins))
 
         # per-step lr/wd/t advance exactly as K step() calls would
         # (shared helper with Module.run_steps); rollback keeps the host
@@ -609,11 +644,20 @@ class Trainer:
         from ..executor import precompute_step_schedules, schedule_rollback
         with schedule_rollback(opt):
             lrs, wds, ts = precompute_step_schedules(opt, idxs, k)
+            # _take (not peek), and only now that every pre-dispatch
+            # step that can fail (the schedule precompute above) is
+            # done: the carry is donated, so a failed DISPATCH must
+            # leave the metric empty rather than holding deleted
+            # buffers — but a failed precompute rolls back and must
+            # not cost the pending interval
+            init_m = eval_metric._take_device_state() if use_dev_metric \
+                else ()
 
             _prof.record_dispatch("step_k.dispatch")
             with _prof.scope("step_k_scan", "symbolic"):
-                (new_ws, new_auxs, new_sts), losses = fn(
-                    (ws, auxs, sts), (data_t, label_t, lrs, wds, ts), ())
+                (new_ws, new_auxs, new_sts, new_m), losses = fn(
+                    (ws, auxs, sts, init_m),
+                    (data_t, label_t, lrs, wds, ts), ())
         for p, w in zip(trainable, new_ws):
             p._data._set_data(w)
         for p, a in zip(aux_params, new_auxs):
@@ -621,6 +665,26 @@ class Trainer:
         for st_old, st_new in zip(states, new_sts):
             for s, v in zip(st_old, st_new):
                 s._set_data(v)
+        if use_dev_metric:
+            eval_metric._absorb_device_state(new_m)
+        elif eval_metric is not None:
+            # host fallback: ONE stacked readback for all K losses (and
+            # labels), folded per step.  NDArray-wrapped like the eager
+            # path — the same user metric must work on both drivers
+            eval_metric._warn_host_fallback()
+            # ONE blocking device_get for losses AND labels together —
+            # two sequential gets would pay the tunnel round trip twice
+            # while the sync counter reported one
+            host_losses, host_labels = jax.device_get(
+                (losses, label_t if label_t is not None else ()))
+            if label_t is None:
+                host_labels = None
+            _prof.record_host_sync("step_k.metric_fold")
+            for j in range(k):
+                eval_metric.update(
+                    [NDArray(a[j]) for a in host_labels]
+                    if host_labels is not None else [],
+                    [NDArray(host_losses[j])])
         return NDArray(losses)
 
     def allreduce_grads(self):

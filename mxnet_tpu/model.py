@@ -243,17 +243,40 @@ class FeedForward(object):
         else:
             result = outs.asnumpy()
         if return_data:
+            from .base import env
+            from .module.base_module import chunked_device_get
+            chunk = max(1, int(env("MXNET_PREDICT_READBACK_BATCHES", 64)))
             data_iter.reset()
-            datas, labels = [], []
+            pairs, pending = [], []
+
+            def _flush():
+                # one stacked readback per chunk of batches (was one
+                # asnumpy per batch per array — 2N host syncs for an
+                # N-batch predict); flushing INSIDE the loop keeps
+                # device memory at most `chunk` batches deep, the old
+                # streaming profile.  `chunk` is passed through so the
+                # flush threshold and the helper's split size can never
+                # silently diverge into multi-sync flushes.
+                pairs.extend(chunked_device_get(
+                    pending, "feedforward.predict.readback", chunk=chunk))
+                pending.clear()
+
             for i, batch in enumerate(data_iter):
                 if num_batch is not None and i >= num_batch:
                     break
-                # trim the final batch's pad rows so data/label rows stay
-                # aligned with the pad-trimmed predictions
+                # trim the final batch's pad rows ON DEVICE so data/label
+                # rows stay aligned with the pad-trimmed predictions;
+                # the loop itself never blocks on a readback between
+                # flush points
                 real = batch.data[0].shape[0] - (batch.pad or 0)
-                datas.append(batch.data[0].asnumpy()[:real])
-                labels.append(batch.label[0].asnumpy()[:real])
-            return result, np.concatenate(datas), np.concatenate(labels)
+                pending.append([batch.data[0]._data[:real],
+                                batch.label[0]._data[:real]])
+                if len(pending) >= chunk:
+                    _flush()
+            if pending:
+                _flush()
+            return (result, np.concatenate([p[0] for p in pairs]),
+                    np.concatenate([p[1] for p in pairs]))
         return result
 
     def score(self, X, eval_metric='acc', num_batch=None,

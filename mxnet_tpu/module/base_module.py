@@ -186,6 +186,9 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
+            # device-resident accumulation: the loop never blocks on a
+            # readback — the metric syncs ONCE at get_name_value below
+            # (or whenever a batch_end_callback reads it)
             self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
                 batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
@@ -238,9 +241,18 @@ class BaseModule:
                 assert len(out) == num_outputs, \
                     'Cannot merge batches, as num of outputs is not the same ' \
                     'in mini-batches. Maybe bucketing is used?'
+            # pad slicing already happened on device (above); batches
+            # come back in chunked stacked readbacks — one sync per
+            # MXNET_PREDICT_READBACK_BATCHES batches instead of one
+            # device->host copy per batch per output.  The NDArray
+            # wrappers are dropped first so each fetched chunk's device
+            # buffers free immediately (the old streaming memory
+            # profile, at a fraction of its sync cost).
+            groups = [[o._data for o in outs] for outs in output_list]
+            del output_list, outputs, out
+            host = chunked_device_get(groups, "predict.readback")
             output_list2 = [
-                NDArray(np.concatenate(
-                    [out[i].asnumpy() for out in output_list]))
+                NDArray(np.concatenate([h[i] for h in host]))
                 for i in range(num_outputs)]
             if num_outputs == 1 and not always_output_list:
                 return output_list2[0]
@@ -295,6 +307,13 @@ class BaseModule:
                     self.prepare(next_data_batch)
                 except StopIteration:
                     end_of_batch = True
+                # device-resident metric accumulation: nothing here
+                # blocks on the device.  The ONLY host syncs in this
+                # loop happen when a batch_end_callback reads the
+                # metric (EvalMetric.sync via get_name_value — e.g.
+                # Speedometer every `frequent` batches) and at the
+                # epoch-end log below: <= nbatch/frequent + 1 syncs
+                # per epoch, asserted by tests/test_sync_free.py.
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -437,3 +456,26 @@ def _as_list(obj):
     if isinstance(obj, (list, tuple)):
         return obj
     return [obj]
+
+
+def chunked_device_get(groups, tag, chunk=None):
+    """Fetch a list of per-batch value groups to host in CHUNKS of
+    ``MXNET_PREDICT_READBACK_BATCHES`` batches (default 64): each chunk
+    is one stacked ``jax.device_get`` (one host sync, recorded under
+    ``tag``), and the chunk's device buffers are released before the
+    next chunk is touched.  This keeps predict-style loops at O(1)
+    syncs per chunk WITHOUT retaining the whole dataset's outputs in
+    device memory the way a single end-of-run device_get would —
+    the memory profile the old per-batch asnumpy streaming had, at
+    1/chunk of its sync cost.  Mutates ``groups`` in place (device
+    values -> numpy) and returns it."""
+    import jax
+    from ..base import env
+    from .. import profiler as _prof
+    if chunk is None:
+        chunk = max(1, int(env("MXNET_PREDICT_READBACK_BATCHES", 64)))
+    for lo in range(0, len(groups), chunk):
+        host = jax.device_get(groups[lo:lo + chunk])
+        _prof.record_host_sync(tag)
+        groups[lo:lo + chunk] = host
+    return groups

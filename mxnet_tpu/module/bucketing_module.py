@@ -291,6 +291,10 @@ class BucketingModule(BaseModule):
         return self._curr_module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        """Delegates to the current bucket's module (device-resident
+        accumulation, Module.update_metric): the metric's device state
+        lives on the METRIC, not the bucket, so accumulation is
+        continuous across bucket switches with no extra syncs."""
         assert self.binded and self.params_initialized
         self._curr_module.update_metric(eval_metric, labels)
 

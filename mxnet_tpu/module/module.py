@@ -780,15 +780,34 @@ class Module(BaseModule):
 
         donate = bool(env("MXNET_FUSED_DONATE", True))
         sig = opt.hyperparam_signature()
+        # metric accumulation rides the scan carry when the metric has a
+        # device form: K steps of metrics cost ZERO extra dispatches and
+        # ZERO readbacks — the state stays on device until a callback
+        # syncs it (the tentpole of the sync-free loop; metrics without
+        # a device form keep the old one-readback host fold below)
+        use_dev_metric = (eval_metric is not None
+                          and getattr(eval_metric, "device_enabled",
+                                      lambda: False)())
         cache = self._run_steps_cache
-        cache_key = (tuple(names), sig, donate)
-        fn = cache.get(cache_key)
+        cache_key = (tuple(names), sig, donate,
+                     eval_metric._device_sig() if use_dev_metric else None)
+        from ..executor import scan_cache_lookup, scan_cache_store
+        fn = scan_cache_lookup(cache, cache_key)
         if fn is None:
             from ..executor import build_multi_step
             body = self._make_step_body(names)
+            metric = eval_metric if use_dev_metric else None
+            out_names = self._output_names
+            # label name -> stacked-input slot, in LABEL_NAMES order:
+            # the metric fold must see labels exactly as update_metric
+            # presents them (dict insertion order feeds _select_dict)
+            step_arg_names = [arg_names[io_idx[j]] for j in step_pos]
+            label_slots = [(nm, step_arg_names.index(nm))
+                           for nm in self._label_names
+                           if nm in step_arg_names]
 
             def scan_body(carry, x, const):
-                pvals, aux_vals, states = carry
+                pvals, aux_vals, states, mstate = carry
                 step_io, key, lrs, wds, t = x
                 io_vals = [None] * len(io_idx)
                 for j, v in zip(step_pos, step_io):
@@ -798,10 +817,16 @@ class Module(BaseModule):
                 outs, new_aux, new_params, new_states = body(
                     pvals, tuple(io_vals), aux_vals, key, states,
                     lrs, wds, t)
-                return (new_params, new_aux, new_states), outs
+                if metric is not None:
+                    mstate = metric.device_update_dict(
+                        mstate,
+                        {nm: step_io[i] for nm, i in label_slots},
+                        dict(zip(out_names, outs)))
+                return (new_params, new_aux, new_states, mstate), outs
 
-            fn = cache[cache_key] = build_multi_step(scan_body,
-                                                     donate=donate)
+            fn = scan_cache_store(cache, cache_key,
+                                  build_multi_step(scan_body,
+                                                   donate=donate))
         self._fused_upd_idx = upd_idx
         self._fused_io_idx = io_idx
         self._fused_donate = donate
@@ -838,11 +863,18 @@ class Module(BaseModule):
                             for j in step_pos)
             states = tuple(tuple(s._data for s in self._opt_states[n])
                            for n in names)
+            # seed the metric carry from any pending device state, so a
+            # log interval spanning eager batches AND run_steps calls
+            # accumulates continuously.  _take (not peek): the carry is
+            # DONATED — detaching first means a failed dispatch leaves
+            # the metric empty, not pointing at deleted buffers
+            init_m = eval_metric._take_device_state() \
+                if use_dev_metric else ()
 
             _prof.record_dispatch("run_steps.dispatch")
             with _prof.scope("run_steps_scan", "symbolic"):
-                (new_pvals, new_aux, new_states), ys = fn(
-                    (pvals, aux_vals, states),
+                (new_pvals, new_aux, new_states, new_m), ys = fn(
+                    (pvals, aux_vals, states, init_m),
                     (step_io, keys, lrs, wds, ts), const)
         self._params_dirty = True
         for n, w in zip(names, new_pvals):
@@ -870,7 +902,12 @@ class Module(BaseModule):
             exec_._out_aval_list(True), last_thunk)
 
         stacked = [NDArray(y) for y in ys]
-        if eval_metric is not None:
+        if use_dev_metric:
+            # K steps of metrics came back as the scan carry — adopt it
+            # as the metric's pending state; a later sync() (callback /
+            # get_name_value) is the only readback
+            eval_metric._absorb_device_state(new_m)
+        elif eval_metric is not None:
             self._fold_metric(eval_metric, label_arrays, ys, k)
         return stacked
 
@@ -889,11 +926,15 @@ class Module(BaseModule):
         return self._exec._sharded(jnp.asarray(arr), sh)
 
     def _fold_metric(self, eval_metric, label_arrays, ys, k):
-        """ONE host readback for all K steps' outputs, then fold them
-        into the metric per step (labels are already host-side)."""
+        """Host fallback for metrics without a device form: ONE host
+        readback for all K steps' outputs, then fold them into the
+        metric per step.  Values are NDArray-wrapped — the classic
+        custom-metric contract (user update() may call .asnumpy()), at
+        the price of the legacy path's per-value syncs."""
         from .. import profiler as _prof
         host_outs = jax.device_get(ys)
         _prof.record_dispatch("run_steps.readback")
+        _prof.record_host_sync("run_steps.metric_fold")
         labels_np = [np.asarray(a) for a in label_arrays]
         for j in range(k):
             eval_metric.update_dict(
@@ -951,7 +992,13 @@ class Module(BaseModule):
         return [self._exec.grad_dict[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update_dict(
+        """Device-resident when the metric supports it: accumulation
+        stays on the async engine (metric.EvalMetric.accumulate_dict)
+        and the host only syncs when a callback reads the metric — the
+        training loop itself never blocks on a device->host readback
+        (was: one asnumpy per output per batch through
+        EvalMetric.update)."""
+        eval_metric.accumulate_dict(
             dict(zip(self._label_names, labels or [])),
             dict(zip(self._output_names, self.get_outputs())))
 

@@ -67,7 +67,11 @@ class PythonModule(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         if self._label_shapes is not None:
-            eval_metric.update_dict(
+            # same sync-free contract as Module.update_metric: device-
+            # resident accumulation when the metric supports it, so a
+            # PythonModule-driven fit/score loop keeps callbacks as its
+            # only host sync points too
+            eval_metric.accumulate_dict(
                 dict(zip(self._label_names, labels or [])),
                 dict(zip(self._output_names, self.get_outputs())))
 

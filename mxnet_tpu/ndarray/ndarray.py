@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..base import MXNetError, env
 from ..context import Context, current_context, cpu
 from .. import autograd as _ag
+from .. import profiler as _prof
 from .. import random as _rnd
 from ..ops import registry as _reg
 
@@ -86,6 +87,7 @@ class NDArray:
 
     # -- engine sync points (reference: NDArray::WaitToRead/WaitToWrite) ----
     def wait_to_read(self):
+        _prof.record_host_sync("ndarray.wait_to_read")
         self._data.block_until_ready()
         return self
 
@@ -135,6 +137,10 @@ class NDArray:
 
     # -- conversions --------------------------------------------------------
     def asnumpy(self) -> np.ndarray:
+        # every asnumpy is a host-blocking device readback — the thing the
+        # sync-free training loop exists to avoid (profiler.host_syncs is
+        # the regression gate; see metric.EvalMetric.sync)
+        _prof.record_host_sync("ndarray.asnumpy")
         data = self._data
         if (hasattr(data, "sharding")
                 and not getattr(data, "is_fully_addressable", True)):

@@ -198,6 +198,44 @@ def reset_dispatch_counts():
         _dispatch_counts.clear()
 
 
+# -- host-sync counters ------------------------------------------------------
+# One counter per host-READBACK site (ndarray.asnumpy, metric.sync,
+# predict.readback, ...).  This is the test hook behind the sync-free
+# training loop: "the host touches the device once per LOG INTERVAL,
+# not once per batch" is asserted by tests/test_sync_free.py and the
+# ci/run_ci.sh sync-count gate against these counts, so a change that
+# quietly reintroduces a per-batch device->host sync fails loudly on
+# CPU instead of only showing up as step-time jitter on a chip.
+# Separate from the dispatch counters: a dispatch LAUNCHES device work
+# asynchronously; a sync BLOCKS the host on it — only the second one
+# serializes the loop.
+_host_sync_counts: dict = {}
+_host_sync_lock = threading.Lock()
+
+
+def record_host_sync(kind: str):
+    """Count one host-blocking device readback of ``kind`` (always on —
+    a dict increment is noise next to the device round-trip it marks)."""
+    with _host_sync_lock:
+        _host_sync_counts[kind] = _host_sync_counts.get(kind, 0) + 1
+
+
+def host_syncs() -> dict:
+    with _host_sync_lock:
+        return dict(_host_sync_counts)
+
+
+def host_sync_total() -> int:
+    """Total host syncs across all sites (the gate's one number)."""
+    with _host_sync_lock:
+        return sum(_host_sync_counts.values())
+
+
+def reset_host_syncs():
+    with _host_sync_lock:
+        _host_sync_counts.clear()
+
+
 # -- kvstore channel counters ------------------------------------------------
 # One counter per transport-resilience event on the dist kvstore channel
 # (retry, reconnect, replay, replay_acked, hard_fail, heartbeat,

@@ -18,3 +18,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from cpu_pin import pin_cpu  # noqa: E402
 
 pin_cpu(8)
+
+# NOTE: do NOT enable jax's persistent compilation cache
+# (jax_compilation_cache_dir) for this suite.  It would remove most of
+# the suite's XLA-compile wall time, but on jax 0.4.37 / XLA:CPU a
+# DESERIALIZED executable can silently produce different results than
+# the freshly-compiled one when buffer donation is in play: back-to-back
+# donated dispatches (exactly Module.run_steps / Trainer.step_k chaining
+# the carry with no host sync in between) came back with corrupted
+# params (~1e-3 to O(1) divergence) once both the eager fused-step and
+# the k_steps scan executables were cache hits, while any fresh compile
+# of either made the same run bit-exact.  Until the aliasing of
+# serialized executables is trustworthy, correctness wins over compile
+# time.

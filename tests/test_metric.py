@@ -1,4 +1,6 @@
 """Metric tests (reference: tests/python/unittest/test_metric.py)."""
+import logging
+
 import numpy as np
 import pytest
 
@@ -89,3 +91,326 @@ def test_f1_accepts_column_labels():
     m.update([mx.nd.array(np.array([[1], [0], [1]], np.float32))],
              [mx.nd.array(pred)])
     assert abs(m.get()[1] - 1.0) < 1e-9
+
+
+def test_f1_rejects_non_binary_labels():
+    m = mx.metric.F1()
+    pred = np.random.RandomState(0).rand(4, 2).astype('float32')
+    with pytest.raises(ValueError):
+        m.update([mx.nd.array(np.array([0., 1., 2., 1.]))],
+                 [mx.nd.array(pred)])
+
+
+# -- device-resident accumulation (the sync-free loop's metric leg) ---------
+
+_DEVICE_METRICS = [
+    ('acc', {}, 'classification'),
+    ('top_k_accuracy', {'top_k': 3}, 'classification'),
+    ('f1', {}, 'binary'),
+    ('ce', {}, 'prob'),
+    ('nll_loss', {}, 'prob'),
+    ('perplexity', {'ignore_label': 0}, 'prob'),
+    ('mae', {}, 'regression'),
+    ('mse', {}, 'regression'),
+    ('rmse', {}, 'regression'),
+    ('loss', {}, 'lossval'),
+]
+
+
+def _rand_batch(kind, rs, batch=32, nclass=5):
+    if kind == 'binary':
+        nclass = 2
+    if kind in ('classification', 'binary', 'prob'):
+        pred = rs.rand(batch, nclass).astype('float32') + 1e-3
+        pred /= pred.sum(1, keepdims=True)
+        label = rs.randint(0, nclass, (batch,)).astype('float32')
+        return label, pred
+    if kind == 'regression':
+        return (rs.randn(batch, 3).astype('float32'),
+                rs.randn(batch, 3).astype('float32'))
+    # 'lossval': the Loss metric folds an arbitrary loss-valued output
+    return (np.zeros((batch,), 'float32'),
+            rs.rand(batch).astype('float32'))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('name,kw,kind', _DEVICE_METRICS)
+def test_device_path_matches_host_path(name, kw, kind):
+    """Every converted metric: accumulating the same batches through
+    device_update + sync() reports the same get_name_value() as the
+    classic per-batch host update (fp32 CPU; integer-count metrics
+    exactly, float reductions to f32 rounding).  Slow-marked (one fold
+    compile per case); ci/run_ci.sh runs it via -m "" — the quick
+    tier-1 representative is test_device_path_matches_host_quick."""
+    rs = np.random.RandomState(7)
+    m_host = metric.create(name, **kw)
+    m_dev = metric.create(name, **kw)
+    assert m_dev.device_capable
+    for _ in range(3):
+        label, pred = _rand_batch(kind, rs)
+        m_host.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        m_dev.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    host_nv, dev_nv = m_host.get_name_value(), m_dev.get_name_value()
+    for (n1, v1), (n2, v2) in zip(host_nv, dev_nv):
+        assert n1 == n2
+        if kind == 'classification':    # integer counts: exact
+            assert v1 == v2, (name, v1, v2)
+        else:
+            np.testing.assert_allclose(v2, v1, rtol=2e-6,
+                                       err_msg=name)
+
+
+def test_device_path_matches_host_quick():
+    """Tier-1 representative of the parametrized sweep above: exact
+    device/host agreement for the workhorse metric (Accuracy)."""
+    rs = np.random.RandomState(7)
+    m_host, m_dev = metric.create('acc'), metric.create('acc')
+    for _ in range(3):
+        label, pred = _rand_batch('classification', rs)
+        m_host.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        m_dev.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert m_host.get() == m_dev.get()
+
+
+@pytest.mark.slow
+def test_composite_device_path_matches_host():
+    rs = np.random.RandomState(3)
+    m_host = metric.create(['acc', 'ce'])
+    m_dev = metric.create(['acc', 'ce'])
+    assert m_dev.device_capable
+    label, pred = _rand_batch('prob', rs)
+    m_host.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    m_dev.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    for (n1, v1), (n2, v2) in zip(m_host.get_name_value(),
+                                  m_dev.get_name_value()):
+        assert n1 == n2
+        np.testing.assert_allclose(v2, v1, rtol=2e-6)
+
+
+def test_f1_device_path_rejects_non_binary_labels_at_sync():
+    """The device path can't raise mid-trace, so F1 carries a bad-label
+    count in its state and the host path's binary-only validation fires
+    at the sync point instead of silently scoring garbage."""
+    m = mx.metric.F1()
+    pred = np.random.RandomState(0).rand(4, 2).astype('float32')
+    m.update_device([mx.nd.array(np.array([1., 0., 1., 1.]))],
+                    [mx.nd.array(pred)])   # good batch: accumulates
+    m.update_device([mx.nd.array(np.array([0., 1., 2., 1.]))],
+                    [mx.nd.array(pred)])   # bad batch: excluded
+    with pytest.raises(ValueError, match='binary'):
+        m.get()
+    # STICKY: catching the first error must not make later reads
+    # silently report a clean metric (host path re-raises per read too)
+    with pytest.raises(ValueError, match='binary'):
+        m.get()
+    # host parity: the good batch folded, the bad batch contributed
+    # NOTHING (the host path raises before accumulating it); reset()
+    # clears the error along with the counters
+    assert m.num_inst == 1
+    m.reset()
+    assert np.isnan(m.get()[1])
+    # negative labels (the -1/+1 convention) are caught the same way
+    m2 = mx.metric.F1()
+    m2.update_device([mx.nd.array(np.array([-1., 1., 1., 0.]))],
+                     [mx.nd.array(pred)])
+    with pytest.raises(ValueError, match='binary'):
+        m2.get()
+
+
+def test_cross_entropy_device_path_rejects_out_of_range_at_sync():
+    """CE/Perplexity device gathers would silently clamp what numpy's
+    host gather raises on — the deferred bad-label count turns that
+    into an IndexError at sync, with the bad batch excluded and
+    in-range NEGATIVE labels wrapping exactly like numpy."""
+    pred = np.array([[0.2, 0.3, 0.5], [0.6, 0.3, 0.1]], 'float32')
+    m = mx.metric.CrossEntropy()
+    m.update_device([mx.nd.array(np.array([1., 5.]))],  # 5 >= nclass
+                    [mx.nd.array(pred)])
+    with pytest.raises(IndexError, match='out of range'):
+        m.get()
+    assert m.num_inst == 0          # bad batch contributed nothing
+    # in-range negative labels wrap like numpy fancy indexing
+    m_host, m_dev = mx.metric.CrossEntropy(), mx.metric.CrossEntropy()
+    neg = np.array([-1., -3.], 'float32')   # -3 wraps to class 0
+    m_host.update([mx.nd.array(neg)], [mx.nd.array(pred)])
+    m_dev.update_device([mx.nd.array(neg)], [mx.nd.array(pred)])
+    np.testing.assert_allclose(m_dev.get()[1], m_host.get()[1], rtol=2e-6)
+    # perplexity: same deferred check through take_along_axis
+    p = mx.metric.Perplexity(ignore_label=None)
+    p.update_device([mx.nd.array(np.array([0., 7.]))], [mx.nd.array(pred)])
+    with pytest.raises(IndexError, match='out of range'):
+        p.get()
+
+
+def test_top_k_tie_breaking_matches_across_paths():
+    """Tied scores at the k-th boundary: host (stable descending sort)
+    and device (lax.top_k) break ties identically — lower index wins —
+    so the equivalence contract holds even on degenerate predictions."""
+    pred = np.ones((8, 5), 'float32')          # all tied
+    label = np.arange(8, dtype='float32') % 5
+    m_host = mx.metric.TopKAccuracy(top_k=3)
+    m_dev = mx.metric.TopKAccuracy(top_k=3)
+    m_host.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    m_dev.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert m_host.get() == m_dev.get()
+
+
+def test_top_k_nan_counts_as_maximal_on_both_paths():
+    """NaN predictions land IN the top-k set on host and device alike
+    (lax.top_k's total order; what argpartition's sort-NaN-last did) —
+    a plain argsort(-pred) host path would silently exclude them."""
+    pred = np.array([[0.1, np.nan, 0.3, 0.2]], 'float32')
+    label = np.array([1.], 'float32')
+    m_host = mx.metric.TopKAccuracy(top_k=2)
+    m_dev = mx.metric.TopKAccuracy(top_k=2)
+    m_host.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    m_dev.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert m_host.get() == m_dev.get() == (m_host.name, 1.0)
+
+
+def test_take_device_state_detaches_pending():
+    """The donating dispatchers (run_steps/step_k) take OWNERSHIP of
+    the pending state: after _take_device_state the metric holds None,
+    so a failed donated dispatch can't leave it pointing at deleted
+    buffers (later sync = lost interval, not a crash)."""
+    m = metric.create('acc')
+    label = np.array([1., 0.], 'float32')
+    pred = np.array([[0.3, 0.7], [0.9, 0.1]], 'float32')
+    m.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    st = m._take_device_state()
+    assert m._device_state is None and st is not None
+    m._absorb_device_state(st)      # the success path restores it
+    assert m.get()[1] == 1.0
+    # composite: take detaches every child
+    c = metric.create(['acc', 'mse'])
+    c.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    c._take_device_state()
+    assert all(ch._device_state is None for ch in c.metrics)
+
+
+def test_composite_accumulate_is_one_fused_dispatch():
+    """The composite hot path folds ALL children in ONE jitted program
+    per batch — k metrics never mean k dispatches (pinned by counting
+    jitted-fold invocations, which the composite makes exactly once)."""
+    c = metric.create(['acc', 'ce'])
+    calls = []
+    orig = type(c)._device_update_jitted
+
+    def spy(self, dict_form=False):
+        calls.append(type(self).__name__)
+        return orig(self, dict_form)
+
+    type(c)._device_update_jitted = spy
+    try:
+        label = np.array([1., 0.], 'float32')
+        pred = np.array([[0.3, 0.7], [0.9, 0.1]], 'float32')
+        c.accumulate_dict({'l': mx.nd.array(label)},
+                          {'p': mx.nd.array(pred)})
+    finally:
+        type(c)._device_update_jitted = orig
+    assert calls == ['CompositeEvalMetric'], calls
+    # and the fold's state landed on the children, not the composite
+    assert all(ch._device_state is not None for ch in c.metrics)
+    assert c.__dict__.get('_device_state') is None
+
+
+def test_fold_synced_warns_only_on_real_precision_loss(caplog):
+    """A big-but-exact i32 instance count must NOT trigger the range
+    warning; an f32 sum past 2^24 (or a wrapped count) must."""
+    m = metric.create('acc')
+    with caplog.at_level(logging.WARNING):
+        m._fold_synced((1000.0, 2 ** 24))      # count large, still exact
+    assert not [r for r in caplog.records if 'exact range' in r.message]
+    with caplog.at_level(logging.WARNING):
+        m._fold_synced((float(2 ** 24), 10))   # f32 sum saturated
+    assert [r for r in caplog.records if 'exact range' in r.message]
+
+
+def test_device_accumulation_is_lazy_until_sync():
+    """update_device never touches the host; get() drains the pending
+    state with exactly ONE readback, and reset() discards it."""
+    from mxnet_tpu import profiler as prof
+    rs = np.random.RandomState(5)
+    m = metric.create('acc')
+    label, pred = _rand_batch('classification', rs)
+    prof.reset_host_syncs()
+    for _ in range(4):
+        m.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    assert prof.host_sync_total() == 0, prof.host_syncs()
+    m.get()
+    assert prof.host_syncs() == {"metric.sync": 1}, prof.host_syncs()
+    assert m.num_inst == 4 * 32
+    # a second get() has nothing pending: no further syncs
+    m.get()
+    assert prof.host_syncs() == {"metric.sync": 1}, prof.host_syncs()
+    m.update_device([mx.nd.array(label)], [mx.nd.array(pred)])
+    m.reset()
+    assert m.num_inst == 0 and m._device_state is None
+    assert np.isnan(m.get()[1])
+
+
+def test_device_jit_cache_keyed_by_hyperparams():
+    """Two same-class metrics with different NON-PRIMITIVE
+    hyperparameters must never share a compiled fold (the jit cache
+    keys such kwargs by identity — regression: silent value sharing)."""
+    class WeightedSum(metric.EvalMetric):
+        device_capable = True
+
+        def __init__(self, scale, name='wsum'):
+            super().__init__(name, scale=scale)
+            self.scale = scale
+
+        def device_update(self, state, labels, preds):
+            import jax.numpy as jnp
+            s, n = state
+            for p in preds:
+                s = s + (p.sum() * self.scale[0]).astype(jnp.float32)
+                n = n + p.size
+            return (s, n)
+
+    a, b = WeightedSum([1.0]), WeightedSum([100.0])
+    assert a._device_sig() != b._device_sig()
+    x = mx.nd.array(np.ones(4, 'float32'))
+    a.update_device([], [x])
+    b.update_device([], [x])
+    assert a.get()[1] == 1.0 and b.get()[1] == 100.0
+
+
+@pytest.mark.slow
+def test_host_fallback_paths_pass_ndarrays():
+    """Custom metrics follow the classic contract: update() receives
+    NDArrays (may call .asnumpy()) on EVERY driver — eager loops AND
+    the run_steps/step_k host-fold fallbacks (regression: raw numpy
+    leaked through the stacked-readback fold)."""
+    class AsnumpyMetric(metric.EvalMetric):
+        def update(self, labels, preds):
+            for l, p in zip(labels, preds):
+                l.asnumpy()      # classic user-metric idiom
+                self.sum_metric += float(p.asnumpy().sum())
+                self.num_inst += 1
+
+    from mxnet_tpu import models
+    rs = np.random.RandomState(0)
+    k, batch = 2, 8
+    data = rs.rand(k, batch, 4).astype('float32')
+    label = rs.randint(0, 2, (k, batch)).astype('float32')
+    it = mx.io.NDArrayIter(data.reshape(-1, 4), label.reshape(-1), batch)
+    mod = mx.mod.Module(models.mlp(num_classes=2, num_hidden=(8,)),
+                        context=mx.cpu(0))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer='sgd')
+    m = AsnumpyMetric('asnp')
+    mod.run_steps(data, label, k=k, eval_metric=m)   # host-fold fallback
+    assert m.num_inst == k
+
+
+def test_accumulate_dict_env_kill_switch(monkeypatch):
+    """MXNET_DEVICE_METRICS=0 routes accumulate_dict to the classic
+    host path (the CI pin for the old behavior relies on this)."""
+    monkeypatch.setenv("MXNET_DEVICE_METRICS", "0")
+    rs = np.random.RandomState(6)
+    m = metric.create('acc')
+    label, pred = _rand_batch('classification', rs)
+    m.accumulate_dict({'l': mx.nd.array(label)}, {'p': mx.nd.array(pred)})
+    assert m._device_state is None and m.num_inst == 32
