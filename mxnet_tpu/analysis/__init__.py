@@ -1,0 +1,40 @@
+"""Framework-aware static analysis + runtime lock-order sanitizer.
+
+The last several PRs bought their wins by establishing cross-file
+invariants that nothing mechanical enforced:
+
+* every host readback in the training hot path routes through the
+  ``profiler.record_host_sync`` contract (the sync-free loop, PR 4);
+* peer bytes are only ever unpickled through the allowlisted decoder in
+  ``kvstore_server`` (PR 3);
+* five thread classes (ServerConn IO, heartbeat, prefetch workers,
+  server accept loops, async checkpoint writers) follow a lock
+  discipline and a sticky-error crash-propagation pattern nobody
+  checks.
+
+The reference design centralized all mutation through one dependency
+engine so these bugs could not exist (Chen et al., arXiv:1512.01274);
+this port is an explicitly concurrent runtime, so — like TensorFlow's
+answer (Abadi et al., arXiv:1605.08695) — it ships correctness tooling
+instead:
+
+* :mod:`mxnet_tpu.analysis.lint` — an AST linter over the package with
+  five framework-specific rule families (``host-sync``,
+  ``unsafe-pickle``, ``lock-order``, ``env-knob``, ``bare-thread``),
+  run as its own CI gate via ``python -m mxnet_tpu.analysis --strict``.
+* :mod:`mxnet_tpu.analysis.knobs` — the machine-readable registry view
+  of every ``MXNET_*`` environment knob (bridging
+  ``base.declare_env``), with the docs-drift check and the generated
+  markdown table folded into docs/ROBUSTNESS.md.
+* :mod:`mxnet_tpu.analysis.runtime` — an instrumented ``OrderedLock``
+  plus a monkeypatchable ``threading`` shim that records per-thread
+  lock-acquisition sequences at runtime, builds the global lock-order
+  graph and flags inversions — a mini lock-order sanitizer that runs
+  on CPU under the existing fault-injection tests.
+
+Rule catalog, allow-annotation syntax and extension guide:
+docs/ANALYSIS.md.
+"""
+from .lint import Finding, run_lint, lint_paths  # noqa: F401
+from .runtime import (  # noqa: F401
+    LockGraph, LockOrderError, OrderedLock, shim)

@@ -1,0 +1,60 @@
+"""``python -m mxnet_tpu.analysis`` — the static-analysis CI gate.
+
+Default run lints the installed ``mxnet_tpu`` package (plus the
+whole-package checks: static lock-order cycles, knob-registry drift
+against docs/ROBUSTNESS.md) and reports findings; ``--strict`` makes
+any unannotated finding fatal — that form is the ``analysis`` gate in
+ci/run_ci.sh.  Explicit paths lint those files/directories instead
+(the fixture tests drive this).  ``--knob-table`` prints the generated
+markdown knob table to fold into docs/ROBUSTNESS.md.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import knobs
+from .lint import lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="framework-aware lint + invariant gates "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the mxnet_tpu "
+                         "package + whole-package checks)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on any unannotated finding "
+                         "(the CI gate mode)")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the generated markdown knob table for "
+                         "docs/ROBUSTNESS.md and exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.knob_table:
+        print(knobs.markdown_table())
+        return 0
+    if args.list_rules:
+        from .rules import ALL_RULES
+        for rule in ALL_RULES:
+            doc = (sys.modules[type(rule).__module__].__doc__ or
+                   "").strip().splitlines()
+            print("%-14s %s" % (rule.name, doc[0] if doc else ""))
+        return 0
+
+    active, suppressed = lint_paths(args.paths or None)
+    for f in sorted(active, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render())
+    print("mxnet_tpu.analysis: %d finding(s), %d suppressed by "
+          "allow-annotations" % (len(active), len(suppressed)))
+    if active:
+        return 1 if args.strict else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Shared directed-graph reachability/cycle helpers.
+
+One implementation for both halves of the lock-order story — the
+static rule (:mod:`mxnet_tpu.analysis.rules.lock_order`) and the
+runtime sanitizer (:mod:`mxnet_tpu.analysis.runtime`) — so a
+hardening fix (iterative DFS, cycle-path reporting) can never apply to
+one and silently miss the other.  ``adj`` is ``{node: iterable of
+successor nodes}``; absent keys mean no successors.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+def reaches(adj: Dict[str, Iterable[str]], src: str, dst: str) -> bool:
+    """True when a directed path src -> ... -> dst exists (src == dst
+    counts: the empty path)."""
+    seen, stack = set(), [src]
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(adj.get(n, ()))
+    return False
+
+
+def find_cycle(adj: Dict[str, Iterable[str]]) -> Optional[List[str]]:
+    """A cycle as a node list ``[a, b, ..., a]``, or None when acyclic.
+    Iterative coloring DFS — safe on graphs deeper than the recursion
+    limit."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    for root in adj:
+        if color.get(root, WHITE) != WHITE:
+            continue
+        path = []
+        stack = [(root, iter(adj.get(root, ())))]
+        color[root] = GREY
+        path.append(root)
+        while stack:
+            node, succs = stack[-1]
+            advanced = False
+            for m in succs:
+                c = color.get(m, WHITE)
+                if c == GREY:
+                    return path[path.index(m):] + [m]
+                if c == WHITE:
+                    color[m] = GREY
+                    path.append(m)
+                    stack.append((m, iter(adj.get(m, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                path.pop()
+                color[node] = BLACK
+    return None

@@ -1,0 +1,188 @@
+"""AST lint driver: file loading, allow-annotations, rule dispatch.
+
+The linter is deliberately framework-specific — it exists to keep the
+invariants the codebase already paid for (sync-free hot path,
+allowlisted unpickling, lock discipline, knob registry, sticky-error
+threads) from rotting, not to restyle code.  Rules live in
+:mod:`mxnet_tpu.analysis.rules`; each is a small object with a
+``check_file(ctx, project)`` hook and an optional ``finalize(project)``
+hook for whole-package facts (the static lock-order graph, knob
+registry drift).
+
+Suppression contract
+--------------------
+A finding is suppressed by an explicit annotation **with a reason** on
+the flagged line or the line directly above it::
+
+    data = blob.asnumpy()   # analysis: allow(host-sync): init path, once per process
+
+    # analysis: allow(unsafe-pickle): trusted local checkpoint file
+    states = pickle.load(fin)
+
+``# analysis: allow-file(<rule>): <reason>`` anywhere in a file
+suppresses the rule for the whole file.  An annotation with no reason
+suppresses nothing — the reason is the point: it converts an invariant
+violation into a documented, reviewable exception.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_ANNOT_RE = re.compile(
+    r"#\s*analysis:\s*allow(?P<file>-file)?"
+    r"\((?P<rules>[a-zA-Z0-9_\-\s,]+)\)"
+    r"(?::\s*(?P<reason>\S.*))?")
+
+# The marker a non-package file (test fixture) uses to opt into the
+# hot-path host-sync rule, which otherwise keys off the module path.
+HOT_PATH_MARKER = "# analysis: hot-path"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+    rule: str
+    path: str          # path as given (package-relative in package mode)
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def render(self) -> str:
+        tag = " (allowed: %s)" % self.reason if self.suppressed else ""
+        return "%s:%d: [%s] %s%s" % (
+            self.path, self.line, self.rule, self.message, tag)
+
+
+class FileContext:
+    """Parsed view of one source file handed to every rule."""
+
+    def __init__(self, path: Path, relpath: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        # line -> (set of rule names, reason); reasonless annotations are
+        # kept (reason "") so strict reporting can point at them.
+        self.allow_lines: Dict[int, Tuple[Set[str], str]] = {}
+        self.allow_file: Dict[str, str] = {}
+        for ln, text in enumerate(self.lines, start=1):
+            m = _ANNOT_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            reason = (m.group("reason") or "").strip()
+            if m.group("file"):
+                for r in rules:
+                    self.allow_file[r] = reason
+            else:
+                self.allow_lines[ln] = (rules, reason)
+        self.hot_marker = HOT_PATH_MARKER in self.source
+
+    def allowance(self, rule: str, line: int) -> Optional[str]:
+        """Reason string if ``rule`` at ``line`` is annotated (the
+        annotation may sit on the line itself or the line above);
+        ``None`` when unannotated.  Empty reason -> not suppressed."""
+        if self.allow_file.get(rule):
+            return self.allow_file[rule]
+        for ln in (line, line - 1):
+            entry = self.allow_lines.get(ln)
+            if entry and rule in entry[0]:
+                return entry[1] or None
+        return None
+
+
+class Project:
+    """Cross-file accumulator shared by all rules in one run."""
+
+    def __init__(self, root: Path, is_package: bool):
+        self.root = root
+        self.is_package = is_package
+        self.files: List[FileContext] = []
+        # free-form per-rule scratch (lock graph, knob read sites, ...)
+        self.scratch: Dict[str, object] = {}
+
+
+def _iter_py_files(paths: Iterable[Path]):
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def package_root() -> Path:
+    return Path(__file__).resolve().parents[1]
+
+
+def _apply_allowances(ctx: FileContext, findings: Iterable[Finding]):
+    for f in findings:
+        reason = ctx.allowance(f.rule, f.line)
+        if reason is not None:
+            f.suppressed = True
+            f.reason = reason
+        yield f
+
+
+def lint_paths(paths: Optional[List[Path]] = None):
+    """Lint ``paths`` (default: the installed ``mxnet_tpu`` package).
+
+    Returns ``(active, suppressed)`` finding lists.  Whole-package
+    checks (static lock-order cycles, knob-registry drift against the
+    docs) run whenever the lint root IS the package, so a fixture
+    directory exercises per-site rules without dragging repo state in.
+    """
+    from .rules import ALL_RULES
+    if paths:
+        roots = [Path(p).resolve() for p in paths]
+    else:
+        roots = [package_root()]
+    root = roots[0] if len(roots) == 1 else Path(".").resolve()
+    is_package = len(roots) == 1 and roots[0].name == "mxnet_tpu" \
+        and (roots[0] / "base.py").exists()
+    project = Project(root=root, is_package=is_package)
+
+    findings: List[Finding] = []
+    for path in _iter_py_files(roots):
+        try:
+            rel = str(path.relative_to(root)) if path != root \
+                else path.name
+        except ValueError:
+            rel = str(path)
+        try:
+            ctx = FileContext(path, rel)
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            findings.append(Finding(
+                rule="parse", path=rel, line=getattr(exc, "lineno", 1) or 1,
+                message="could not parse: %s" % exc))
+            continue
+        project.files.append(ctx)
+        for rule in ALL_RULES:
+            findings.extend(
+                _apply_allowances(ctx, rule.check_file(ctx, project)))
+
+    ctx_by_rel = {c.relpath: c for c in project.files}
+    for rule in ALL_RULES:
+        final = getattr(rule, "finalize", None)
+        if final is None:
+            continue
+        for f in final(project):
+            ctx = ctx_by_rel.get(f.path)
+            if ctx is not None:
+                f = next(iter(_apply_allowances(ctx, [f])))
+            findings.append(f)
+
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    return active, suppressed
+
+
+def run_lint(paths: Optional[List[Path]] = None) -> List[Finding]:
+    """Convenience wrapper: active (unsuppressed) findings only."""
+    return lint_paths(paths)[0]

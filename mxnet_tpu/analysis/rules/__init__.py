@@ -1,0 +1,18 @@
+"""Rule registry: one module per rule family (docs/ANALYSIS.md).
+
+Adding a rule = add a module exposing a ``RULE`` object with a ``name``
+string, a ``check_file(ctx, project)`` generator, and optionally a
+``finalize(project)`` generator for whole-package facts, then list it
+here and give it a fixture pair under tests/analysis_fixtures/.
+"""
+from . import bare_thread, env_knobs, host_sync, lock_order, unsafe_pickle
+
+ALL_RULES = (
+    host_sync.RULE,
+    unsafe_pickle.RULE,
+    lock_order.RULE,
+    env_knobs.RULE,
+    bare_thread.RULE,
+)
+
+RULE_NAMES = tuple(r.name for r in ALL_RULES)

@@ -1,0 +1,167 @@
+"""bare-thread: thread targets must propagate crashes.
+
+A daemon thread that dies with an unhandled exception takes its
+traceback to stderr and nothing else: the consumer blocks forever on a
+queue/event the producer will never signal — the failure mode
+PrefetchingIter's sticky ``_ProducerError`` pattern exists to prevent
+(a parked exception the consumer re-raises on its next call).
+
+This rule flags every ``threading.Thread(target=...)`` whose target
+function contains no broad exception capture (``except Exception`` /
+``except BaseException`` / bare ``except``).  Catching broadly at a
+thread boundary is CORRECT — the point is what the handler does with
+it: park the error where the consumer looks (``self._err``, a queue
+sentinel, channel poison).  A target whose crash is already observable
+some other way (e.g. it holds the only socket, so death surfaces as
+ECONNRESET at every client) documents that with
+``# analysis: allow(bare-thread): <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _has_broad_handler(func_node) -> bool:
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        t = node.type
+        if t is None:
+            return True
+        names = t.elts if isinstance(t, ast.Tuple) else [t]
+        for n in names:
+            if isinstance(n, ast.Name) and n.id in _BROAD:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+                return True
+    return False
+
+
+class _Scope:
+    def __init__(self, node, cls, funcs):
+        self.node = node
+        self.cls = cls          # enclosing class name or None
+        self.funcs = funcs      # name -> FunctionDef visible here
+
+
+def _thread_call(node, thread_aliases):
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" \
+            and isinstance(f.value, ast.Name) \
+            and f.value.id in thread_aliases:
+        return True
+    if isinstance(f, ast.Name) and f.id == "Thread":
+        return True
+    return False
+
+
+class _BareThreadRule:
+    name = "bare-thread"
+
+    def check_file(self, ctx, project):
+        thread_aliases = {"threading"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        thread_aliases.add(a.asname or a.name)
+
+        # collect function defs with their lexical context
+        module_funcs = {}
+        class_methods = {}      # class name -> {method name -> def}
+        nested = {}             # outer FunctionDef -> {name -> def}
+
+        def collect(node, cls, outer):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    class_methods.setdefault(child.name, {})
+                    collect(child, child.name, None)
+                elif isinstance(child,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if outer is not None:
+                        nested.setdefault(outer, {})[child.name] = child
+                    elif cls is not None:
+                        class_methods[cls][child.name] = child
+                    else:
+                        module_funcs[child.name] = child
+                    collect(child, cls, child)
+                else:
+                    collect(child, cls, outer)
+
+        collect(ctx.tree, None, None)
+
+        findings = []
+
+        def visit(node, cls, outer_chain):
+            for child in ast.iter_child_nodes(node):
+                nxt_cls, nxt_chain = cls, outer_chain
+                if isinstance(child, ast.ClassDef):
+                    nxt_cls, nxt_chain = child.name, []
+                elif isinstance(child,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nxt_chain = outer_chain + [child]
+                if isinstance(child, ast.Call) \
+                        and _thread_call(child, thread_aliases):
+                    findings.extend(self._check_target(
+                        ctx, child, cls, outer_chain,
+                        module_funcs, class_methods, nested))
+                visit(child, nxt_cls, nxt_chain)
+
+        visit(ctx.tree, None, [])
+        return findings
+
+    def _check_target(self, ctx, call, cls, outer_chain,
+                      module_funcs, class_methods, nested):
+        target = next((kw.value for kw in call.keywords
+                       if kw.arg == "target"), None)
+        if target is None:
+            return [Finding(
+                rule=self.name, path=ctx.relpath, line=call.lineno,
+                message="threading.Thread with no resolvable target= — "
+                "cannot verify crash propagation; pass target= or "
+                "annotate")]
+        func = None
+        if isinstance(target, ast.Name):
+            for outer in reversed(outer_chain):
+                func = nested.get(outer, {}).get(target.id)
+                if func is not None:
+                    break
+            if func is None and cls is not None:
+                func = class_methods.get(cls, {}).get(target.id)
+            if func is None:
+                func = module_funcs.get(target.id)
+        elif isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id in ("self", "cls") and cls is not None:
+            func = class_methods.get(cls, {}).get(target.attr)
+        if func is None:
+            return [Finding(
+                rule=self.name, path=ctx.relpath, line=call.lineno,
+                message="thread target could not be resolved statically "
+                "— cannot verify crash propagation; use a local def / "
+                "method reference or annotate")]
+        if _has_broad_handler(func):
+            return []
+        return [Finding(
+            rule=self.name, path=ctx.relpath, line=call.lineno,
+            message="thread target %r has no broad exception capture: "
+            "an unexpected crash kills the thread silently and hangs "
+            "its consumers — park failures for the consumer (the "
+            "sticky-error pattern PrefetchingIter uses) or annotate "
+            "why thread death is already observable" % target_name(
+                target))]
+
+
+def target_name(target):
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return ast.dump(target)
+
+
+RULE = _BareThreadRule()

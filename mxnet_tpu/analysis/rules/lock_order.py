@@ -1,0 +1,239 @@
+"""lock-order: the static lock-acquisition graph must stay acyclic.
+
+The concurrent transport (ServerConn IO threads, heartbeat threads,
+prefetch workers, server accept loops) works because locks are always
+taken in one global order.  This rule extracts the static acquisition
+graph — ``with lock:`` nesting and ``.acquire()`` calls, one hop of
+intra-package call-following — and fails on cycles: two code paths that
+take the same pair of locks in opposite orders can deadlock under the
+right thread interleaving even if every test passes today.
+
+Lock identity is the canonical attribute path (``module.Class._lock``,
+``module._lock``): all instances sharing an allocation site are one
+node, the standard abstraction for order analysis.  An expression
+counts as a lock when its last component looks like one
+(``*_lock`` / ``*_cv`` / ``*_cond`` / ``lock`` / ``mutex``).
+
+Call-following is intentionally shallow (names resolved inside the
+package only) — the runtime sanitizer in
+:mod:`mxnet_tpu.analysis.runtime` covers what static resolution cannot
+see.  A cyclic edge that is provably benign (e.g. guarded by a
+try-order protocol) carries ``# analysis: allow(lock-order): <reason>``
+at the acquisition or call site.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .._graph import reaches
+from ..lint import Finding
+
+_LOCKISH = re.compile(r"(^|_)(lock|locks|mutex|cv|cond|condition)$",
+                      re.IGNORECASE)
+
+
+def _expr_path(node):
+    """Dotted text of a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _lock_name(node, mod, cls):
+    parts = _expr_path(node)
+    if not parts or not _LOCKISH.search(parts[-1]):
+        return None
+    if parts[0] in ("self", "cls"):
+        scope = "%s.%s" % (mod, cls) if cls else mod
+        return "%s.%s" % (scope, ".".join(parts[1:]))
+    return "%s.%s" % (mod, ".".join(parts))
+
+
+class _FuncRecord:
+    def __init__(self, fid):
+        self.fid = fid
+        # (lock, line, held-tuple) at each direct acquisition
+        self.acquisitions = []
+        # (callee-candidate-tuple, held-tuple, line)
+        self.calls = []
+
+
+class _Extractor:
+    """Walk one file, recording per-function acquisitions and calls
+    with the held-lock set live at each point."""
+
+    def __init__(self, ctx, mod):
+        self.ctx = ctx
+        self.mod = mod
+        self.cls = None
+        self.func = None       # current _FuncRecord
+        self.held = []
+        self.records = {}
+
+    def run(self):
+        self._walk(self.ctx.tree)
+        return self.records
+
+    def _walk(self, node):
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _visit(self, node):
+        if isinstance(node, ast.ClassDef):
+            prev, self.cls = self.cls, node.name
+            self._walk(node)
+            self.cls = prev
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = "%s.%s" % (self.mod, self.cls) if self.cls else self.mod
+            fid = "%s.%s" % (scope, node.name)
+            prev_f, prev_h = self.func, self.held
+            self.func = self.records.setdefault(fid, _FuncRecord(fid))
+            self.held = []
+            self._walk(node)
+            self.func, self.held = prev_f, prev_h
+        elif isinstance(node, ast.With):
+            pushed = 0
+            for item in node.items:
+                lock = _lock_name(item.context_expr, self.mod, self.cls)
+                if lock is not None:
+                    self._acquire(lock, item.context_expr.lineno)
+                    self.held.append(lock)
+                    pushed += 1
+                else:
+                    self._visit(item.context_expr)
+            for stmt in node.body:
+                self._visit(stmt)
+            for _ in range(pushed):
+                self.held.pop()
+        elif isinstance(node, ast.Call):
+            self._call(node)
+            self._walk(node)
+        else:
+            self._walk(node)
+
+    def _acquire(self, lock, line):
+        if self.func is not None:
+            self.func.acquisitions.append((lock, line, tuple(self.held)))
+
+    def _call(self, node):
+        f = node.func
+        # explicit .acquire() on a lock expression
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            lock = _lock_name(f.value, self.mod, self.cls)
+            if lock is not None:
+                self._acquire(lock, node.lineno)
+                return
+        if self.func is None:
+            return
+        cands = None
+        if isinstance(f, ast.Name):
+            scope = "%s.%s" % (self.mod, self.cls) if self.cls else None
+            cands = ("%s.%s" % (self.mod, f.id),) + (
+                ("%s.%s" % (scope, f.id),) if scope else ())
+        elif isinstance(f, ast.Attribute):
+            parts = _expr_path(f)
+            if parts and parts[0] in ("self", "cls") and len(parts) == 2 \
+                    and self.cls:
+                cands = ("%s.%s.%s" % (self.mod, self.cls, parts[1]),)
+            elif parts and len(parts) == 2:
+                # module-qualified call: matched by suffix at finalize
+                cands = ("*.%s.%s" % (parts[0], parts[1]),)
+        if cands:
+            self.func.calls.append((cands, tuple(self.held), node.lineno))
+
+
+class _LockOrderRule:
+    name = "lock-order"
+
+    def check_file(self, ctx, project):
+        mod = ctx.relpath.replace("\\", "/")
+        mod = re.sub(r"\.py$", "", mod).replace("/", ".")
+        mod = re.sub(r"\.__init__$", "", mod)
+        records = _Extractor(ctx, mod).run()
+        table = project.scratch.setdefault("lock-order", {})
+        for fid, rec in records.items():
+            table.setdefault(fid, rec)
+            project.scratch.setdefault("lock-order-files", {})[fid] = \
+                ctx.relpath
+        return ()
+
+    def finalize(self, project):
+        table = project.scratch.get("lock-order", {})
+        files = project.scratch.get("lock-order-files", {})
+        if not table:
+            return
+
+        def resolve(cands):
+            for c in cands:
+                if c.startswith("*."):
+                    suffix = c[1:]          # ".mod.func"
+                    for fid in table:
+                        if fid.endswith(suffix):
+                            return fid
+                elif c in table:
+                    return c
+            return None
+
+        # transitive closure of locks each function acquires
+        closure = {fid: {a[0] for a in rec.acquisitions}
+                   for fid, rec in table.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fid, rec in table.items():
+                for cands, _held, _line in rec.calls:
+                    callee = resolve(cands)
+                    if callee is None:
+                        continue
+                    extra = closure[callee] - closure[fid]
+                    if extra:
+                        closure[fid] |= extra
+                        changed = True
+
+        # edge set: (a, b) -> list of (file, line, via)
+        edges = {}
+
+        def add_edge(a, b, path, line, via):
+            if a == b:
+                return   # reentrant re-acquisition (RLock pattern)
+            edges.setdefault((a, b), []).append((path, line, via))
+
+        for fid, rec in table.items():
+            path = files.get(fid, "?")
+            for lock, line, held in rec.acquisitions:
+                for h in held:
+                    add_edge(h, lock, path, line, "direct")
+            for cands, held, line in rec.calls:
+                callee = resolve(cands)
+                if callee is None or not held:
+                    continue
+                for lock in closure[callee]:
+                    for h in held:
+                        add_edge(h, lock, path, line,
+                                 "via call to %s" % callee)
+
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        for (a, b), sites in sorted(edges.items()):
+            if not reaches(adj, b, a):
+                continue
+            for path, line, via in sites:
+                yield Finding(
+                    rule=self.name, path=path, line=line,
+                    message="acquiring %s while holding %s (%s) closes "
+                    "a lock-order cycle — another path takes these "
+                    "locks in the opposite order; pick one global "
+                    "order or annotate why the interleaving is "
+                    "impossible" % (b, a, via))
+
+
+RULE = _LockOrderRule()

@@ -1,0 +1,63 @@
+"""unsafe-pickle: peer bytes decode ONLY through the allowlisted path.
+
+``kvstore_server._recv_msg`` decodes bytes from any connected peer; a
+stock ``pickle.loads`` on that surface is arbitrary code execution
+(PR 3 landed the class-allowlisted ``_RestrictedUnpickler`` and pinned
+hostile-payload tests).  This rule flags every ``pickle.loads`` /
+``pickle.load`` / ``pickle.Unpickler`` reference in the package so no
+new decode site can bypass the allowlist silently.  ``pickle.dumps``
+(encoding) is fine.
+
+Legitimate exceptions — the restricted decoder itself, and loads of
+TRUSTED LOCAL files (a checkpoint this process wrote) — carry
+``# analysis: allow(unsafe-pickle): <reason>`` annotations; the reason
+must say why the bytes cannot be peer-controlled.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding
+
+_BAD_ATTRS = ("loads", "load", "Unpickler")
+
+
+class _UnsafePickleRule:
+    name = "unsafe-pickle"
+
+    def check_file(self, ctx, project):
+        pickle_aliases = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "pickle":
+                        pickle_aliases.add(a.asname or "pickle")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "pickle":
+                    for a in node.names:
+                        if a.name in _BAD_ATTRS:
+                            yield Finding(
+                                rule=self.name, path=ctx.relpath,
+                                line=node.lineno,
+                                message="direct import of pickle.%s; "
+                                "peer bytes must go through the "
+                                "kvstore_server allowlisted decoder "
+                                "(_RestrictedUnpickler / "
+                                "loads_allowlisted)" % a.name)
+        if not pickle_aliases:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in pickle_aliases \
+                    and node.attr in _BAD_ATTRS:
+                yield Finding(
+                    rule=self.name, path=ctx.relpath, line=node.lineno,
+                    message="pickle.%s can execute attacker-chosen code "
+                    "on peer-controlled bytes; decode through the "
+                    "kvstore_server allowlist (_restricted_loads) or "
+                    "annotate why these bytes are trusted-local"
+                    % node.attr)
+
+
+RULE = _UnsafePickleRule()

@@ -1,0 +1,288 @@
+"""Runtime lock-order sanitizer: OrderedLock + a ``threading`` shim.
+
+The static rule (:mod:`mxnet_tpu.analysis.rules.lock_order`) sees what
+it can resolve; this is the other half — observe the REAL per-thread
+acquisition sequences while the existing CPU test suites (the
+dist/fault-injection scenarios especially) run, build the global
+lock-order graph, and flag inversions.  The design is a miniature of
+TSan's deadlock detector: a lock is identified by its allocation site,
+an edge ``A -> B`` means "some thread acquired B while holding A", and
+a cycle in the edge set means two threads can deadlock under the right
+interleaving even if today's schedule never does.
+
+Two ways in:
+
+* ``OrderedLock(name=...)`` — an explicit instrumented lock for new
+  code (drop-in for ``threading.Lock``/``RLock``; works under
+  ``threading.Condition`` too, it forwards the ``_release_save`` /
+  ``_acquire_restore`` / ``_is_owned`` protocol).
+* ``with shim() as graph:`` — monkeypatch ``threading.Lock`` /
+  ``threading.RLock`` so every lock CONSTRUCTED inside the block is
+  instrumented (existing code unmodified: KVStoreServer, _ServerConn,
+  prefetchers...).  After the block, ``graph.assert_acyclic()``.
+
+``strict=True`` raises :class:`LockOrderError` at the acquisition that
+would close a cycle — BEFORE blocking on the inner lock, so the
+offending test fails instead of deadlocking.  Non-strict records the
+violation and lets the run finish (the mode the real fault-injection
+suite uses; a recorded graph is asserted acyclic at the end).
+
+Scope/soundness: edges are recorded for blocking acquires only — a
+failed or non-blocking ``acquire(False)`` cannot deadlock and would
+otherwise flag the benign trylock protocols ``Condition`` uses
+internally.  Reentrant re-acquisition (RLock) adds no edge.
+"""
+from __future__ import annotations
+
+import _thread
+import contextlib
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ._graph import find_cycle, reaches
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition closed a cycle in the global lock-order graph."""
+
+
+def _alloc_site() -> str:
+    """file:line of the frame that constructed the lock (first frame
+    outside this module and threading.py)."""
+    f = sys._getframe(2)
+    skip = (__file__, threading.__file__)
+    while f is not None and f.f_code.co_filename in skip:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fn = f.f_code.co_filename.rsplit("/", 1)[-1]
+    return "%s:%d" % (fn, f.f_lineno)
+
+
+class LockGraph:
+    """Global acquisition-order graph shared by a set of OrderedLocks.
+
+    Thread-safe via a raw ``_thread`` lock so the bookkeeping itself
+    can never appear in the graph it maintains."""
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self.closed = False
+        self._meta = _thread.allocate_lock()
+        # (held, acquired) -> (thread name, acquired-at site) 1st witness
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._adj: Dict[str, set] = {}
+        self._held: Dict[int, List[str]] = {}
+        self._violations: List[str] = []
+        self._acquires = 0
+
+    # -- queries -------------------------------------------------------------
+    def edges(self) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        with self._meta:
+            return dict(self._edges)
+
+    def violations(self) -> List[str]:
+        with self._meta:
+            return list(self._violations)
+
+    def acquire_count(self) -> int:
+        """Total successful acquisitions observed — the liveness probe:
+        an edge-free graph is a legitimate result (flat locking), a
+        zero acquire count means nothing was instrumented."""
+        with self._meta:
+            return self._acquires
+
+    def assert_acyclic(self) -> None:
+        """Full-graph check (covers violations recorded in non-strict
+        mode AND any cycle the incremental check classified late)."""
+        with self._meta:
+            if self._violations:
+                raise LockOrderError(
+                    "lock-order violations recorded:\n  " +
+                    "\n  ".join(self._violations))
+            # incremental insertion flags every cycle as it closes, so
+            # a clean violation list implies an acyclic edge set; walk
+            # anyway — cheap, and independent of the incremental logic
+            cycle = find_cycle(self._adj)
+            if cycle is not None:
+                raise LockOrderError(
+                    "lock-order cycle: %s" % " -> ".join(cycle))
+
+    # -- recording -----------------------------------------------------------
+    def _before_acquire(self, name: str, blocking: bool) -> None:
+        """Record edges held->name; in strict mode raise on a cycle
+        BEFORE the caller blocks on the inner lock."""
+        if self.closed or not blocking:
+            return
+        tid = _thread.get_ident()
+        cycle = None
+        with self._meta:
+            held = self._held.get(tid, ())
+            if name in held:
+                return   # reentrant (RLock): no new ordering fact
+            for h in held:
+                if (h, name) in self._edges:
+                    continue
+                if reaches(self._adj, name, h):
+                    cycle = ("thread %r acquiring %s while holding %s "
+                             "inverts the established order (%s -> ... "
+                             "-> %s exists)" % (
+                                 threading.current_thread().name,
+                                 name, h, name, h))
+                    self._violations.append(cycle)
+                self._edges[(h, name)] = (
+                    threading.current_thread().name, name)
+                self._adj.setdefault(h, set()).add(name)
+        if cycle is not None and self.strict:
+            raise LockOrderError(cycle)
+
+    def _after_acquire(self, name: str) -> None:
+        if self.closed:
+            return
+        tid = _thread.get_ident()
+        with self._meta:
+            self._acquires += 1
+            self._held.setdefault(tid, []).append(name)
+
+    def _on_release(self, name: str, all_holds: bool = False) -> int:
+        if self.closed:
+            return 0
+        tid = _thread.get_ident()
+        n = 0
+        with self._meta:
+            held = self._held.get(tid, [])
+            if all_holds:
+                n = held.count(name)
+                self._held[tid] = [h for h in held if h != name]
+                return n
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == name:
+                    del held[i]
+                    return 1
+            # released by a DIFFERENT thread than the acquirer — legal
+            # for a plain Lock (the handoff/signal pattern).  Clear the
+            # acquirer's entry, or the lock looks held-forever on that
+            # thread and every later acquisition there grows a phantom
+            # edge (false cycles under the shim).
+            for other_held in self._held.values():
+                for i in range(len(other_held) - 1, -1, -1):
+                    if other_held[i] == name:
+                        del other_held[i]
+                        return 1
+        return n
+
+
+_DEFAULT_GRAPH = LockGraph(strict=False)
+
+
+def default_graph() -> LockGraph:
+    return _DEFAULT_GRAPH
+
+
+class OrderedLock:
+    """Instrumented lock: records its acquisition order in a
+    :class:`LockGraph`.  ``rlock=True`` wraps a reentrant lock.  Locks
+    are named by allocation site (all locks born at one line are one
+    graph node — the lockset abstraction) unless ``name`` is given."""
+
+    def __init__(self, name: Optional[str] = None,
+                 graph: Optional[LockGraph] = None, rlock: bool = False):
+        # raw _thread primitives: never affected by the shim
+        self._inner = _thread.RLock() if rlock else _thread.allocate_lock()
+        self._graph = graph if graph is not None else _DEFAULT_GRAPH
+        self._name = name if name is not None else _alloc_site()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._graph._before_acquire(self._name, blocking)
+        if timeout == -1:
+            ok = self._inner.acquire(blocking)
+        else:
+            ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._graph._after_acquire(self._name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._graph._on_release(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        if locked is not None:
+            return locked()
+        # RLock without locked(): owned by anyone iff trylock fails
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    # -- threading.Condition protocol ---------------------------------------
+    # Condition(lock) binds these when present; forwarding them keeps
+    # cv.wait()'s full-release/re-acquire visible to the graph.
+    def _release_save(self):
+        if hasattr(self._inner, "_release_save"):
+            state = self._inner._release_save()
+        else:
+            self._inner.release()
+            state = None
+        count = self._graph._on_release(self._name, all_holds=True)
+        return (state, count)
+
+    def _acquire_restore(self, saved):
+        state, count = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        # the waiter held nothing while blocked; re-entering the lock
+        # re-records it (same edges as the original acquisition)
+        for _ in range(max(1, count)):
+            self._graph._after_acquire(self._name)
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return "<OrderedLock %s>" % self._name
+
+
+@contextlib.contextmanager
+def shim(strict: bool = False, graph: Optional[LockGraph] = None):
+    """Monkeypatch ``threading.Lock``/``threading.RLock`` so every lock
+    constructed in the block is an :class:`OrderedLock` recording into
+    one :class:`LockGraph` (yielded).  ``threading.Condition()`` with
+    no explicit lock picks the patched RLock up automatically.
+
+    Locks outlive the block safely: on exit the graph is closed, so
+    escaped instrumented locks keep working but stop recording."""
+    g = graph if graph is not None else LockGraph(strict=strict)
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+
+    def make_lock():
+        return OrderedLock(name=_alloc_site(), graph=g)
+
+    def make_rlock():
+        return OrderedLock(name=_alloc_site(), graph=g, rlock=True)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    try:
+        yield g
+    finally:
+        threading.Lock = orig_lock
+        threading.RLock = orig_rlock
+        g.closed = True

@@ -1,0 +1,49 @@
+# analysis: hot-path
+"""Annotation fixture: one violation per rule family, every one
+carrying an allow annotation WITH a reason — the whole file must lint
+clean, proving the suppression machinery end to end."""
+import os
+import pickle
+import threading
+
+
+def readback(nd):
+    # analysis: allow(host-sync): fixture — pretend this is a once-per-epoch exit point
+    return nd.asnumpy()
+
+
+def decode(blob):
+    # analysis: allow(unsafe-pickle): fixture — pretend these bytes are a trusted local file
+    return pickle.loads(blob)
+
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+
+
+def path_one():
+    with _a_lock:
+        # analysis: allow(lock-order): fixture — every edge of a cycle carries its own annotation
+        with _b_lock:
+            return 1
+
+
+def path_two():
+    with _b_lock:
+        # analysis: allow(lock-order): fixture — pretend a protocol makes this interleaving impossible
+        with _a_lock:
+            return 2
+
+
+def read_knob():
+    # analysis: allow(env-knob): fixture — pretend this knob belongs to an external plugin
+    return os.environ.get("MXNET_FIXTURE_ONLY_KNOB")
+
+
+def bare(q):
+    def worker():
+        q.get()
+
+    # analysis: allow(bare-thread): fixture — pretend thread death is observable via the queue sentinel
+    t = threading.Thread(target=worker, daemon=True)
+    return t

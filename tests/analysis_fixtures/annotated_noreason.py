@@ -1,0 +1,8 @@
+"""Annotation fixture: an allow with NO reason suppresses nothing —
+the reason is the reviewable artifact, not the annotation."""
+import pickle
+
+
+def decode(blob):
+    # analysis: allow(unsafe-pickle)
+    return pickle.loads(blob)            # still flagged

@@ -1,0 +1,22 @@
+# analysis: hot-path
+"""host-sync positive fixture: four readback shapes, none routed
+through a record_host_sync contract site, none annotated."""
+import numpy as np
+import jax
+
+
+def leak_asnumpy(nd):
+    return nd.asnumpy()                  # flagged
+
+
+def leak_wait(nd):
+    nd.wait_to_read()                    # flagged
+
+
+def leak_device_get(state):
+    return jax.device_get(state)         # flagged
+
+
+def leak_asarray_and_float(nd):
+    host = np.asarray(nd)                # flagged
+    return float(nd)                     # flagged

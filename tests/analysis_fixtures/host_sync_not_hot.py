@@ -1,0 +1,6 @@
+"""host-sync scoping fixture: NOT marked hot-path and not under a
+hot-path module path, so readbacks here are out of the rule's scope."""
+
+
+def cold_path_readback(nd):
+    return nd.asnumpy()

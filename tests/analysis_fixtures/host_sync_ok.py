@@ -1,0 +1,21 @@
+# analysis: hot-path
+"""host-sync negative fixture: every readback lives in a function that
+records itself under the host-sync contract."""
+import jax
+
+from mxnet_tpu import profiler as _prof
+
+
+def contract_site(state):
+    host = jax.device_get(state)
+    _prof.record_host_sync("fixture.sync")
+    return host
+
+
+def contract_site_asnumpy(nd):
+    _prof.record_host_sync("fixture.readback")
+    return nd.asnumpy()
+
+
+def no_sync_here(x, y):
+    return x + y

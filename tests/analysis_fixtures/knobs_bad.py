@@ -1,0 +1,15 @@
+"""env-knob positive fixture: reads of knobs the registry has never
+heard of, through every lookup shape the rule recognizes."""
+import os
+
+from mxnet_tpu import base
+from mxnet_tpu.base import env
+
+
+def read_unregistered():
+    a = env("MXNET_NOT_A_REAL_KNOB", 1)                  # flagged
+    b = os.environ.get("MXNET_ALSO_NOT_REGISTERED")      # flagged
+    c = os.getenv("MXNET_THIRD_FAKE_KNOB", "x")          # flagged
+    d = os.environ["MXNET_FOURTH_FAKE_KNOB"]             # flagged
+    e = base.env("MXNET_FIFTH_FAKE_KNOB", 3)             # flagged (module-qualified)
+    return a, b, c, d, e

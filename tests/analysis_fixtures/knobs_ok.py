@@ -1,0 +1,12 @@
+"""env-knob negative fixture: registered knobs, and non-MXNET env vars
+(launcher plumbing) that the rule does not police."""
+import os
+
+from mxnet_tpu.base import env
+
+
+def read_registered():
+    w = env("MXNET_KVSTORE_WINDOW", 8)
+    r = os.environ.get("MXNET_KVSTORE_RETRY_MAX")
+    rank = os.environ.get("DMLC_WORKER_ID", "0")
+    return w, r, rank

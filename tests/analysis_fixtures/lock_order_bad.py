@@ -1,0 +1,18 @@
+"""lock-order positive fixture: two paths take the same pair of locks
+in opposite orders — a deadlock waiting for the right interleaving."""
+import threading
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+
+
+def path_one():
+    with _a_lock:
+        with _b_lock:
+            return 1
+
+
+def path_two():
+    with _b_lock:
+        with _a_lock:
+            return 2

@@ -1,0 +1,23 @@
+"""lock-order positive fixture (interprocedural): the inversion only
+exists through a call — path_two holds b while CALLING a helper that
+takes a."""
+import threading
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+
+
+def takes_a():
+    with _a_lock:
+        return 1
+
+
+def path_one():
+    with _a_lock:
+        with _b_lock:
+            return 1
+
+
+def path_two():
+    with _b_lock:
+        return takes_a()

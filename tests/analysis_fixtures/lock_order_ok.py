@@ -1,0 +1,22 @@
+"""lock-order negative fixture: every path honors one global order
+(a before b), including through an intra-module call."""
+import threading
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+
+
+def inner():
+    with _b_lock:
+        return 1
+
+
+def path_one():
+    with _a_lock:
+        with _b_lock:
+            return 1
+
+
+def path_two():
+    with _a_lock:
+        return inner()

@@ -1,0 +1,14 @@
+"""unsafe-pickle positive fixture: stock decode surfaces."""
+import pickle
+
+
+def decode_wire(blob):
+    return pickle.loads(blob)            # flagged
+
+
+def decode_file(f):
+    return pickle.load(f)                # flagged
+
+
+class MyUnpickler(pickle.Unpickler):     # flagged
+    pass

@@ -1,0 +1,13 @@
+"""unsafe-pickle negative fixture: encoding is fine, and decoding
+through the allowlisted helper is the sanctioned path."""
+import pickle
+
+from mxnet_tpu.kvstore_server import _restricted_loads
+
+
+def encode(obj):
+    return pickle.dumps(obj)
+
+
+def decode_wire(blob):
+    return _restricted_loads(blob)

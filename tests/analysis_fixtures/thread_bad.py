@@ -1,0 +1,24 @@
+"""bare-thread positive fixture: targets with no crash propagation."""
+import threading
+
+
+def worker(q):
+    while True:
+        q.put(q.get() + 1)        # any exception kills the thread silently
+
+
+def spawn(q):
+    t = threading.Thread(target=worker, args=(q,), daemon=True)   # flagged
+    t.start()
+    return t
+
+
+class Pump:
+    def _loop(self):
+        while True:
+            self.step()
+
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)      # flagged
+        t.start()
+        return t

@@ -1,0 +1,38 @@
+"""bare-thread negative fixture: the sticky-error pattern — a broad
+capture that parks the failure where the consumer will see it."""
+import threading
+
+
+class Prefetcher:
+    def __init__(self):
+        self._err = None
+
+    def _loop(self):
+        try:
+            while True:
+                self.step()
+        except BaseException as e:  # crossing a thread: park it
+            self._err = e
+
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+        return t
+
+
+def spawn_local():
+    err = []
+
+    def run():
+        try:
+            do_work()
+        except Exception as e:
+            err.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, err
+
+
+def do_work():
+    pass
