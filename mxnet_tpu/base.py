@@ -90,11 +90,38 @@ def tag_for_remat(x, name):
         return x
     from jax.ad_checkpoint import checkpoint_name
     return checkpoint_name(x, name)
-declare_env("MXNET_PROFILER_MODE", str, "symbolic_only", "")
-declare_env("MXNET_PROFILER_AUTOSTART", bool, False, "")
+declare_env("MXNET_PROFILER_MODE", str, "symbolic_only",
+            "initial profiler mode: symbolic_only (dispatch events) or "
+            "all (every category); profiler_set_config overrides")
+declare_env("MXNET_PROFILER_AUTOSTART", bool, False,
+            "begin profiling at import (reference: engine profiler "
+            "autostart)")
+declare_env("MXNET_PROFILER_XLA_LOGDIR", str, "",
+            "directory for the XLA (xplane) device trace profiler "
+            "start()/stop() also drives; empty = host events only")
 declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
             "host worker threads for the data pipeline")
-declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19, "")
+declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19,
+            "dist kvstore: arrays above this many elements stripe "
+            "row-wise across all servers (per-stripe keys; parallel "
+            "serialize/apply)")
+declare_env("MXNET_KVSTORE_WINDOW", int, 8,
+            "dist_async channel: max envelopes in flight per server "
+            "connection (sliding-window pipeline; 1 = the old "
+            "stop-and-wait loop bit for bit)")
+declare_env("MXNET_KVSTORE_COMPRESSION", str, "",
+            "gradient compression for dist pushes: ''/none, 2bit or "
+            "fp16 (job-wide form of set_gradient_compression)")
+declare_env("MXNET_KVSTORE_COMPRESSION_THRESHOLD", float, 0.5,
+            "2bit quantization threshold t: gradient values quantize "
+            "to {-t, 0, +t} with worker-side error feedback")
+declare_env("MXNET_KVSTORE_COALESCE_BYTES", int, 16384,
+            "LIST pushes coalesce same-server keys at or below this "
+            "many payload bytes into one multi-key envelope")
+declare_env("MXNET_KVSTORE_PICKLE_ALLOWLIST", str, "",
+            "extra 'module' or 'module:name' entries (comma-separated) "
+            "the wire unpickler admits — the custom-optimizer escape "
+            "hatch (kvstore_server allowlist)")
 declare_env("MXNET_KVSTORE_RETRY_MAX", int, 8,
             "dist_async channel: reconnect attempts per failure episode "
             "before the channel fails hard")
@@ -134,6 +161,35 @@ declare_env("MXNET_PREDICT_READBACK_BATCHES", int, 64,
             "predict readback chunk: batches fetched per stacked "
             "device_get (bounds device memory held by the stacked "
             "readback; module.base_module.chunked_device_get)")
+declare_env("MXNET_FUSED_DONATE", bool, True,
+            "donate param/aux/opt-state buffers to the fused training "
+            "step so XLA updates them in place in HBM")
+declare_env("MXNET_ATTENTION_IMPL", str, "auto",
+            "attention kernel dispatch: flash (Pallas), xla (fused "
+            "jnp) or auto (the measured winner table decides)")
+# Deterministic fault injection (mxnet_tpu.faultinject) — the env forms
+# of configure(), for reaching into launcher-spawned worker processes.
+declare_env("MXNET_FI_KILL_POINT", str, "before_send",
+            "fault injection: where the one-shot connection kill fires "
+            "(before_send / after_send / on_recv)")
+declare_env("MXNET_FI_KILL_AFTER", int, None,
+            "fault injection: sever the client connection at exactly "
+            "this 1-based data-channel message count (unset = off)")
+declare_env("MXNET_FI_KILL_UNACKED", int, None,
+            "fault injection: sever the connection the moment this "
+            "many pipelined envelopes are unacked (unset = off)")
+declare_env("MXNET_FI_REFUSE_CONNECTS", int, 0,
+            "fault injection: refuse the next N client connect "
+            "attempts")
+declare_env("MXNET_FI_REFUSE_ACCEPTS", int, 0,
+            "fault injection: close the next N accepted server "
+            "connections immediately")
+declare_env("MXNET_FI_DELAY_ACK_MS", float, 0.0,
+            "fault injection: delay every server data-channel reply "
+            "by this many ms (heartbeats exempt)")
+declare_env("MXNET_FI_ONLY_RANK", int, None,
+            "fault injection: restrict the armed plan to this "
+            "DMLC_WORKER_ID (unset = all ranks)")
 
 
 # ---------------------------------------------------------------------------

@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError
+from .base import MXNetError, env as _base_env
 from .context import Context, current_context
 from . import random as _rnd
 from .ndarray import NDArray
@@ -378,8 +378,15 @@ class Executor:
         # dropped outputs (and their snapshots) alive.
         self._issued_outs: List = []
 
-        self._jit_fwd = jax.jit(
-            lambda a, x, k, t: run(a, x, k, t), static_argnums=(3,))
+        # MXNET_EXEC_BULK_EXEC_INFERENCE=0 restores per-op dispatch for
+        # forward-only graphs (the reference's bulk-exec toggle): the
+        # interpreter runs un-jitted, so every op is its own XLA call —
+        # slower, but each intermediate is individually inspectable.
+        if _base_env("MXNET_EXEC_BULK_EXEC_INFERENCE", True):
+            self._jit_fwd = jax.jit(
+                lambda a, x, k, t: run(a, x, k, t), static_argnums=(3,))
+        else:
+            self._jit_fwd = lambda a, x, k, t: run(a, x, k, t)
         self._jit_fwd_bwd = jax.jit(self._fused_fwd_bwd)
 
     # ------------------------------------------------------------------
@@ -550,6 +557,7 @@ class Executor:
         # host value (the SPMD data contract — dist scripts use identical
         # seeds/batches), so build the global array from the shards THIS
         # process addresses.
+        # analysis: allow(host-sync): multi-host staging — val is the HOST feed value every process supplies (SPMD data contract); the copy builds the global array, it does not read a device buffer back
         arr = np.asarray(val)
         return jax.make_array_from_callback(
             arr.shape, sh, lambda idx: arr[idx])

@@ -35,7 +35,13 @@ _MODE_ALL = "all"
 class _Profiler:
     def __init__(self):
         self.state = PROFILER_STATE_STOP
-        self.mode = _MODE_SYMBOLIC
+        # reference env parity: MXNET_PROFILER_MODE=all widens capture
+        # beyond dispatch events; any other value (incl. the reference
+        # spelling "symbolic_only") is the symbolic default.
+        # profiler_set_config overrides at runtime.
+        self.mode = _MODE_ALL \
+            if env("MXNET_PROFILER_MODE", "symbolic_only") == _MODE_ALL \
+            else _MODE_SYMBOLIC
         self.filename = "profile.json"
         self.continuous_dump = False
         self._events: List[dict] = []
