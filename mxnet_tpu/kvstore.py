@@ -367,6 +367,7 @@ class _ServerConn:
         self._sock = self._dial(connect_timeout)
         self._q = queue.Queue()
         self._err = None
+        self._dead = False   # IO thread crashed (set after _err; see _io_loop)
         # sliding window: entries are [envelope, pending, replayed] in
         # seq order; head = oldest unacked
         self._window = max(1, int(_env("MXNET_KVSTORE_WINDOW", 8)))
@@ -393,6 +394,7 @@ class _ServerConn:
         import socket
         import time
         from . import faultinject
+        from .kvstore_server import _set_nodelay
         deadline = time.monotonic() + connect_timeout
         while True:
             try:
@@ -403,6 +405,7 @@ class _ServerConn:
                 # arrives (unbounded); transport death still surfaces as
                 # ECONNRESET/EOF when the server process dies
                 sock.settimeout(None)
+                _set_nodelay(sock)
                 return sock
             except (ConnectionRefusedError, OSError):
                 # the server process is still importing/binding — workers
@@ -416,12 +419,56 @@ class _ServerConn:
     def _enqueue(self, item):
         """Queue a request and poke the IO thread's select()."""
         self._q.put(item)
+        if self._dead:
+            # the IO thread crashed between the caller's _err check and
+            # the put: nobody will ever dequeue this item — fail it here
+            # (_dead is set after _err and before the crash handler's
+            # drain, so seeing it guarantees _err is readable and that a
+            # put the handler missed is ours to fail)
+            self._drain_queue_failing(self._err)
         try:
             self._wake_w.send(b"\0")
         except (BlockingIOError, OSError):
             pass  # buffer full / closed: the thread is awake regardless
 
     def _io_loop(self):
+        """Thread entry: the pump with crash propagation.  Transport
+        faults have their own recovery path (_recover_or_fail), but an
+        UNEXPECTED crash in the pump logic itself used to kill the IO
+        thread silently — every queued request's ``pending.done`` then
+        never fires and callers block forever.  Park the failure as the
+        channel poison instead (the sticky-error pattern): in-flight
+        and queued requests fail with the cause, later enqueues raise
+        up front (``_err`` check in request())."""
+        try:
+            self._io_pump()
+        except Exception as exc:  # noqa: BLE001 — crossing a thread
+            err = MXNetError(
+                f"kvstore channel to {self._uri}: IO thread crashed: "
+                f"{type(exc).__name__}: {exc}")
+            err.__cause__ = exc
+            self._channel_failed(err)   # sets _err, fails the window
+            # _dead AFTER _err, BEFORE the drain: an enqueue that slips
+            # past request()'s _err precheck either lands before this
+            # drain (drained here) or puts after it — and then its own
+            # _enqueue post-check observes _dead=True and self-drains.
+            # Checking thread.is_alive() instead would leave a window
+            # (drain done, thread not yet exited).
+            self._dead = True
+            self._drain_queue_failing(err)
+
+    def _drain_queue_failing(self, err):
+        """Fail every request still sitting in the enqueue queue (the
+        window drain in _channel_failed only covers in-flight ones)."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                self._fail_pending(item[1], err)
+
+    def _io_pump(self):
         """The sliding-window pump.  Fill the window from the queue,
         then wait for whichever comes first: an ack (completes the head
         slot) or a wakeup byte (new work while acks are outstanding).
@@ -594,6 +641,7 @@ class _ServerConn:
         import socket
         from . import faultinject
         from . import profiler as _prof
+        from .kvstore_server import _set_nodelay
         try:
             self._sock.close()
         except (OSError, AttributeError):
@@ -622,6 +670,7 @@ class _ServerConn:
                 faultinject.client_connect(self._uri)
                 sock = socket.create_connection(self._addr, timeout=60)
                 sock.settimeout(None)
+                _set_nodelay(sock)
                 self._sock = sock
                 _prof.record_channel_event("kvstore.reconnect")
                 return

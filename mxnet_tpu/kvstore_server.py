@@ -211,7 +211,7 @@ def _env_allowlist():
     return entries
 
 
-class _RestrictedUnpickler(pickle.Unpickler):
+class _RestrictedUnpickler(pickle.Unpickler):  # analysis: allow(unsafe-pickle): this IS the allowlisted decoder — find_class below enforces the class allowlist every other site must route through
     def find_class(self, module, name):
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
@@ -238,6 +238,22 @@ def _restricted_loads(data):
     and peer-supplied control blobs (shipped optimizers, state blobs)."""
     import io
     return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def _set_nodelay(sock):
+    """Disable Nagle on a kvstore data socket.  A frame is two-plus
+    ``sendall`` calls (header+skeleton, then each raw tensor buffer);
+    with Nagle on, the small header write can sit in the kernel waiting
+    on the peer's delayed ACK before the tensor bytes follow — a
+    ~40 ms-class stall per frame on a real network (docs/PERF_NOTES.md
+    round 9).  Loopback never shows it, which is exactly why it must be
+    set unconditionally at connect/accept rather than found later on a
+    chip."""
+    import socket as _socket
+    try:
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    except (OSError, AttributeError):
+        pass   # non-TCP socket (tests stub with socketpairs)
 
 
 def _send_msg(sock, obj, fi_role=None):
@@ -634,6 +650,7 @@ class KVStoreServer:
                     break
                 if faultinject.server_accept(conn):
                     continue   # injected refusal: already closed
+                _set_nodelay(conn)
                 t = threading.Thread(target=self._serve_conn, args=(conn,),
                                      daemon=True)
                 t.start()
@@ -659,6 +676,7 @@ class KVStoreServer:
 
     def start_background(self):
         """Run the accept loop in a daemon thread (in-process tests)."""
+        # analysis: allow(bare-thread): a crash unwinds through run()'s finally, closing the listener — every client observes it as refused connects within its retry budget, and in-flight conns keep their own _serve_conn handlers
         t = threading.Thread(target=self.run, daemon=True)
         t.start()
         return t

@@ -1013,8 +1013,15 @@ class Module(BaseModule):
             self._kvstore.save_optimizer_states(fname)
         else:
             import pickle
-            states = {n: tuple(np.asarray(s._data) for s in st)
-                      for n, st in self._opt_states.items()}
+            import jax
+            from .. import profiler as _prof
+            # ONE stacked readback for every state tensor (was one
+            # np.asarray sync per state), recorded under the host-sync
+            # contract like every other deliberate readback site
+            states = jax.device_get(
+                {n: tuple(s._data for s in st)
+                 for n, st in self._opt_states.items()})
+            _prof.record_host_sync("module.save_optimizer_states")
             with open(fname, 'wb') as fout:
                 pickle.dump(states, fout)
 
@@ -1026,6 +1033,7 @@ class Module(BaseModule):
         else:
             import pickle
             with open(fname, 'rb') as fin:
+                # analysis: allow(unsafe-pickle): trusted LOCAL checkpoint file named by the caller — never bytes off the wire (those decode in kvstore_server through the allowlist)
                 states = pickle.load(fin)
             for n, st in states.items():
                 if n in self._opt_states:

@@ -461,3 +461,36 @@ def test_close_warns_on_stuck_io_thread(monkeypatch):
             conn.close(join_timeout=0.3)
     finally:
         srv.stop()
+
+
+def test_io_thread_crash_poisons_channel_instead_of_hanging(monkeypatch):
+    """Crash propagation for the IO pump itself (the bare-thread lint
+    contract, docs/ANALYSIS.md): an UNEXPECTED exception in the pump —
+    not a transport fault, those have their own recovery path — must
+    poison the channel and fail every waiter promptly.  Before the fix
+    the thread died silently and pending.done never fired: callers
+    blocked forever."""
+    srv = _serve(monkeypatch)
+    try:
+        from mxnet_tpu.kvstore import _ServerConn
+        conn = _ServerConn(f"127.0.0.1:{srv.port}")
+        # sanity: the channel works before the injected crash
+        assert conn.submit(("ping", 0), wait=True) is None
+
+        def boom(self):
+            raise RuntimeError("injected pump crash")
+
+        monkeypatch.setattr(_ServerConn, "_recv_ack", boom)
+        pending = conn.request(("pull", "w"))
+        # the waiter must FAIL (quickly), not hang
+        assert pending.done.wait(timeout=10), \
+            "pending never completed: IO-thread crash was swallowed"
+        assert pending.error is not None
+        assert "IO thread crashed" in str(pending.error)
+        # the poison is sticky: later requests are refused up front
+        with pytest.raises(MXNetError, match="channel failed"):
+            conn.request(("ping", 0))
+        conn._thread.join(timeout=5)
+        assert not conn._thread.is_alive()
+    finally:
+        srv.stop()
