@@ -5,6 +5,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== analysis gate: framework-aware lint + knob registry (docs/ANALYSIS.md)"
+# The invariants earlier PRs paid for — sync-free hot path, allowlisted
+# unpickling, acyclic lock order, declared+documented env knobs,
+# crash-propagating threads — enforced at the SOURCE level: any
+# unannotated finding (or a knob missing from the registry/ROBUSTNESS
+# table) fails here, before a single test runs.  Same check runs
+# in-process in tests/test_analysis.py; this invocation pins the entry
+# point the way a developer runs it.
+JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --strict
+
 echo "== unit + integration suite (8-device CPU mesh via tests/conftest.py)"
 # -m "" overrides pytest.ini's default "not slow": CI runs everything.
 # test_run_steps.py is excluded here because the dedicated gate below

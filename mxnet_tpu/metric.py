@@ -50,6 +50,7 @@ def check_label_shapes(labels, preds, shape=0):
 
 
 def _np(x):
+    # analysis: allow(host-sync): legacy host-metric fallback path (one sync per batch BY DESIGN, pinned >=N by test_sync_free); NDArray.asnumpy records itself
     return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
@@ -260,6 +261,7 @@ class EvalMetric:
         # diverging (sync more often — any callback reading the metric
         # does — or MXNET_DEVICE_METRICS=0).  A large count alone is
         # fine: i32 is exact all the way to the wrap.
+        # analysis: allow(host-sync): s/n are host scalars — sync() already read them back (recorded as metric.sync) before folding here
         if (abs(float(s)) >= 2 ** 24 or int(n) < 0) \
                 and not getattr(self, "_range_warned", False):
             self._range_warned = True
@@ -270,6 +272,7 @@ class EvalMetric:
                 "the host path — sync at shorter intervals (any callback "
                 "reading the metric) or set MXNET_DEVICE_METRICS=0",
                 self.name, s, n)
+        # analysis: allow(host-sync): same already-synced host scalars as above
         self.sum_metric += float(s)
         self.num_inst += int(n)
 

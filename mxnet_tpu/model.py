@@ -140,8 +140,10 @@ class FeedForward(object):
         from .io import DataIter, NDArrayIter
         if isinstance(X, DataIter):
             return X
+        # analysis: allow(host-sync): fit()-entry canonicalization of USER-SUPPLIED host data (lists/np arrays), once per fit, not per batch
         X = np.asarray(X)
         if y is not None:
+            # analysis: allow(host-sync): same user-supplied host data as above
             y = np.asarray(y)
         elif is_train:
             raise ValueError('y must be specified when X is numpy')
@@ -239,8 +241,10 @@ class FeedForward(object):
         outs = mod.predict(data_iter, num_batch=num_batch, reset=reset,
                            always_output_list=False)
         if isinstance(outs, list):
+            # analysis: allow(host-sync): predict EXIT point — one readback of the already-stacked outputs per predict() call (recorded by ndarray.asnumpy), not per batch
             result = [o.asnumpy() for o in outs]
         else:
+            # analysis: allow(host-sync): same predict exit readback as above
             result = outs.asnumpy()
         if return_data:
             from .base import env

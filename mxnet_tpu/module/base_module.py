@@ -76,6 +76,7 @@ def _canon_step_inputs(names, value, what, k=None):
             return v._data
         if isinstance(v, (np.ndarray, jnp.ndarray)):
             return v
+        # analysis: allow(host-sync): v is user feed data that is NOT an NDArray/jnp array (those returned above) — host lists/scalars only
         return np.asarray(v)
 
     if value is None:
@@ -93,6 +94,7 @@ def _canon_step_inputs(names, value, what, k=None):
             arrays = [_as_val(v) for v in value]
         elif len(names) == 1:
             # list of K per-step batches for the single input
+            # analysis: allow(host-sync): K-superbatch staging at run_steps entry — one host stack per K-step dispatch, amortized 1/K per step
             arrays = [np.stack([np.asarray(_as_val(v)) for v in value])]
         else:
             raise MXNetError(

@@ -139,6 +139,7 @@ class PythonLossModule(PythonModule):
         if self._grad_func is not None:
             grad = self._grad_func(self._scores, self._labels)
             if not isinstance(grad, NDArray):
+                # analysis: allow(host-sync): PythonLossModule is the reference's HOST-SIDE compat shim — user grad_func returns host values; per-batch crossing is its documented cost
                 grad = nd_array(np.asarray(grad))
             self._scores_grad = grad
         else:

