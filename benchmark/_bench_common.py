@@ -241,16 +241,69 @@ def peak_flops(device_kind):
     return None
 
 
+def fresh_process_probe(deadline_s, mark):
+    """Health-check backend bring-up in a FRESH child process, bounded
+    by ``deadline_s``.
+
+    Why a child process: jax serializes backend init behind a global
+    in-process lock, so ONE hung ``jax.devices()`` probe used to pin
+    every later attempt behind it — BENCH_r02–r05 all died on a single
+    120 s tunnel hang with four rounds of perf work queued behind it.
+    A probe that hangs in a child is killed and the PARENT stays
+    clean: the next attempt dials a fresh child, so a stuck tunnel
+    init can never serialize retries.  The probe only proves the
+    tunnel answers; the real in-process init follows a healthy probe.
+
+    Returns (True, device_kind) or (False, error_string).
+    """
+    import subprocess
+    code = ("import jax\n"
+            "d = jax.devices()[0]\n"
+            "print('PROBE_OK ' + d.device_kind, flush=True)\n")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    except OSError as e:
+        return False, "probe spawn failed: %s" % e
+    try:
+        out, _ = proc.communicate(timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=5)
+        except Exception:  # noqa: BLE001 — already killed; best effort
+            pass
+        return False, "timed out after %.0fs (tunnel hang)" % deadline_s
+    text = (out or b"").decode(errors="replace")
+    for line in text.splitlines():
+        if line.startswith("PROBE_OK"):
+            return True, line[len("PROBE_OK"):].strip()
+    return False, "probe exited rc=%s: %s" % (
+        proc.returncode, text.strip()[-300:] or "<no output>")
+
+
 def guarded_backend_init(mark, env_prefix="BENCH", error_json=None,
                          hold_budget_s=None, refuse_timeout_parent=True,
                          enforce_deadline=True):
-    """Initialize the jax backend with a deadline per attempt.
+    """Initialize the jax backend with a bounded deadline per attempt.
 
     Returns (device, None) on success or (None, error_string) on failure.
     An unhealthy tunnel makes ``jax.devices()`` BLOCK rather than raise,
-    so each attempt runs in a daemon thread.  A TIMED-OUT (vs raising)
-    attempt is not retried: jax serializes backend init behind a global
-    lock, so later attempts just block behind the stuck probe.
+    so bring-up is staged:
+
+    1. **fresh-process probe** — each attempt health-checks the backend
+       in a child process with a hard deadline (see
+       ``fresh_process_probe``); a hung probe is killed and the next
+       attempt automatically re-dials with a fresh child after
+       {prefix}_INIT_REDIAL_S, so a stuck tunnel init can't serialize
+       retries (the BENCH_r02–r05 wedge).  {prefix}_INIT_FRESH_PROBE=0
+       restores the direct in-process path.
+    2. **in-process init** — only after a healthy probe; still
+       thread-guarded with the same deadline.  If THIS hangs despite a
+       healthy probe it is not retried (jax serializes init behind a
+       global lock, so later in-process attempts would just queue
+       behind the stuck one).
 
     Relay discipline (guard_chip_client) is enforced HERE so no chip
     entry point can skip it; ``hold_budget_s`` defaults to the init
@@ -258,7 +311,8 @@ def guarded_backend_init(mark, env_prefix="BENCH", error_json=None,
     plausibly hold the relay before its own bounds fire).
 
     Env knobs: {prefix}_INIT_RETRIES (default 3), {prefix}_INIT_TIMEOUT_S
-    (default 120).
+    (default 120), {prefix}_INIT_FRESH_PROBE (default 1),
+    {prefix}_INIT_REDIAL_S (default 15).
     """
     import threading
     retries = max(1, int(os.environ.get(env_prefix + "_INIT_RETRIES", "3")))
@@ -269,17 +323,24 @@ def guarded_backend_init(mark, env_prefix="BENCH", error_json=None,
         mark("bad %s_INIT_TIMEOUT_S; using 120" % env_prefix)
         deadline = 120.0
     deadline = max(1.0, deadline)
+    fresh = os.environ.get(env_prefix + "_INIT_FRESH_PROBE", "1") != "0"
+    try:
+        redial = float(os.environ.get(env_prefix + "_INIT_REDIAL_S", "15"))
+    except ValueError:
+        redial = 15.0
     if hold_budget_s is None:
         try:
             stall = float(os.environ.get(env_prefix + "_STALL_DEADLINE_S",
                                          "1200"))
         except ValueError:
             stall = 1200.0
-        # worst real relay hold: ONE timed-out init attempt (a hung
-        # attempt is never retried — see the break below) + the stall
+        # worst real relay hold: every probe attempt is deadline-bounded
+        # and killed on expiry, so the budget is the retry loop's worst
+        # case (probes + redial waits + one in-process init) + the stall
         # watchdog's idle allowance.  chip_session.sh's STEP_BUDGET
-        # (1900s) is calibrated against exactly this bound.
-        hold_budget_s = deadline + max(0.0, stall)
+        # (1900s) is calibrated against this bound.
+        hold_budget_s = retries * (deadline + max(0.0, redial)) \
+            + deadline + max(0.0, stall)
     ok, gmsg, _reason = guard_chip_client(
         mark, error_json or {}, hold_budget_s=hold_budget_s,
         refuse_timeout_parent=refuse_timeout_parent,
@@ -289,6 +350,19 @@ def guarded_backend_init(mark, env_prefix="BENCH", error_json=None,
     import jax
     err = None
     for attempt in range(retries):
+        if fresh:
+            pok, info = fresh_process_probe(deadline, mark)
+            if not pok:
+                err = info
+                mark("backend probe attempt %d/%d failed: %s"
+                     % (attempt + 1, retries, info))
+                if attempt + 1 < retries:
+                    # automatic re-dial: the hung child is dead, the
+                    # parent is clean — wait out transient tunnel state
+                    # and try a fresh process
+                    time.sleep(max(0.0, redial))
+                continue
+            mark("fresh-process probe OK (%s)" % info)
         box = {}
 
         def _probe(box=box):
@@ -304,9 +378,10 @@ def guarded_backend_init(mark, env_prefix="BENCH", error_json=None,
             return box["dev"], None
         if "err" not in box:
             err = "timed out after %.0fs (tunnel hang)" % deadline
-            mark("backend init attempt %d hung; not retrying "
-                 "(init is serialized behind the stuck probe)"
-                 % (attempt + 1))
+            mark("in-process backend init attempt %d hung%s; not "
+                 "retrying (init is serialized behind the stuck probe)"
+                 % (attempt + 1,
+                    " despite a healthy probe" if fresh else ""))
             break
         err = box["err"]
         mark("backend init attempt %d failed: %s" % (attempt + 1, err))

@@ -65,6 +65,19 @@ JAX_PLATFORMS=cpu MXNET_KVSTORE_WINDOW=8 \
     python tools/launch.py -n 2 -s 1 \
     python tests/dist/dist_fault_injection.py
 
+echo "== serving smoke (replica + dynamic batcher + live weight refresh)"
+# The inference tier's acceptance across real process/socket boundaries
+# (docs/SERVING.md): one replica serves 64 concurrent requests through
+# the dynamic batcher with at most len(buckets) predict compiles
+# (profiler.record_dispatch pins it), exposes p50/p99/QPS, and a live
+# dist_async push + version bump changes served predictions WITHOUT a
+# replica restart.  Time-boxed: a batching or refresh regression
+# typically presents as a hang; the in-process twins live in
+# tests/test_serving.py.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py -n 1 -s 1 \
+    python tests/dist/dist_serving_smoke.py
+
 echo "== multichip dryrun (8 virtual devices)"
 JAX_PLATFORMS=cpu python - <<'PY'
 import cpu_pin

@@ -49,6 +49,7 @@ from . import kvstore as kv
 from . import kvstore
 from . import faultinject
 from . import model
+from . import serving
 from . import module
 from . import module as mod
 from .module import Module, BaseModule

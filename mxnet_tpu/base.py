@@ -141,6 +141,31 @@ declare_env("MXNET_KVSTORE_DEDUP_WINDOW", int, 8,
             "server: cached replies per client channel for idempotent "
             "replay acks after a reconnect (keep >= 2: a zombie "
             "connection can serve its last request late)")
+# -- serving tier (mxnet_tpu.serving) ---------------------------------------
+declare_env("MXNET_SERVING_BUCKETS", str, "1,2,4,8,16,32",
+            "serving: comma-separated batch-size buckets the replica "
+            "pre-compiles predict executables for (requests pad to the "
+            "smallest covering bucket — N requests never mean N compiles)")
+declare_env("MXNET_SERVING_MAX_WAIT_MS", float, 2.0,
+            "serving: dynamic batcher max wait for more requests before "
+            "dispatching a partially-filled bucket (the latency half of "
+            "the batching SLO dial; 0 dispatches immediately)")
+declare_env("MXNET_SERVING_QUEUE_DEPTH", int, 256,
+            "serving: admission control — requests queued past this "
+            "depth are shed with a typed BUSY reply instead of growing "
+            "an unbounded queue")
+declare_env("MXNET_SERVING_REFRESH_S", float, 0.0,
+            "serving: seconds between weight-version polls against the "
+            "live dist_async parameter servers (0 disables polling; the "
+            "serving_refresh envelope forces a check either way)")
+declare_env("MXNET_SERVING_CLIENT_WINDOW", int, 64,
+            "serving: max in-flight predict envelopes per client "
+            "connection (the serving override of MXNET_KVSTORE_WINDOW — "
+            "the replica's pipelined loop batches across the window)")
+declare_env("MXNET_SERVING_LATENCY_WINDOW", int, 2048,
+            "serving: ring size of the profiler's per-kind latency "
+            "sample window (p50/p99/QPS are computed over this window; "
+            "count/total stay lifetime)")
 declare_env("MXNET_CKPT_RENDEZVOUS_TIMEOUT", float, 600.0,
             "async checkpoint: seconds rank 0 waits for every rank's "
             "shard (and ranks wait for the index) before failing")

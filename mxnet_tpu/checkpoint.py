@@ -371,6 +371,21 @@ def save_checkpoint_sharded(prefix: str, epoch: int, symbol, arg_params,
     save_params_sharded(path, _merge_arg_aux(arg_params, aux_params))
 
 
+def load_serving_params(prefix: str, epoch: int):
+    """Checkpoint loader for serving replicas: returns ``(symbol,
+    arg_params, aux_params)`` from EITHER checkpoint flavor at
+    ``prefix-%04d.params`` — the classic single-file format
+    (``mx.model.save_checkpoint``) or the sharded multi-process format
+    (``save_checkpoint_sharded`` / :class:`AsyncCheckpointer`, detected
+    by its ``.index`` file).  A replica must be able to serve whatever
+    the trainer wrote without knowing how many hosts wrote it."""
+    path = f"{prefix}-{epoch:04d}.params"
+    if os.path.exists(path + ".index"):
+        return load_checkpoint_sharded(prefix, epoch)
+    from .model import load_checkpoint
+    return load_checkpoint(prefix, epoch)
+
+
 def load_checkpoint_sharded(prefix: str, epoch: int):
     """Sharded analog of mx.model.load_checkpoint (model.py:105)."""
     from .symbol.symbol import load as sym_load

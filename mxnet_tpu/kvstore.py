@@ -151,6 +151,20 @@ class KVStore:
                             jax.device_put(src._data,
                                            o.context.jax_device()))
 
+    def assign(self, key, value):
+        """Store value(s) VERBATIM, bypassing any installed updater, and
+        creating missing keys.  No reference analog: this is the
+        control-plane register the serving tier's weight-version counter
+        rides (:mod:`mxnet_tpu.serving` — routing a version bump through
+        ``push`` would hand it to the optimizer as a gradient)."""
+        keys, values = self._canon(key, value)
+        for k, vs in zip(keys, values):
+            val = vs[0]._data
+            if k in self._store:
+                self._store[k]._set_data(val)
+            else:
+                self._store[k] = NDArray(val)
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference: kvstore.h
         PullRowSparse / KVStoreLocal::PullRowSparseImpl,
@@ -339,7 +353,7 @@ class _ServerConn:
     ``MXNET_KVSTORE_HEARTBEAT_TIMEOUT`` and feeds ``num_dead_nodes()``.
     """
 
-    def __init__(self, uri, connect_timeout=60.0):
+    def __init__(self, uri, connect_timeout=60.0, window=None):
         import collections
         import socket as _socket
         import time
@@ -369,8 +383,11 @@ class _ServerConn:
         self._err = None
         self._dead = False   # IO thread crashed (set after _err; see _io_loop)
         # sliding window: entries are [envelope, pending, replayed] in
-        # seq order; head = oldest unacked
-        self._window = max(1, int(_env("MXNET_KVSTORE_WINDOW", 8)))
+        # seq order; head = oldest unacked.  ``window`` overrides the
+        # env (the serving client opens wide pipelines per connection
+        # without re-configuring the training job's kvstore channels).
+        self._window = max(1, int(window if window is not None
+                                  else _env("MXNET_KVSTORE_WINDOW", 8)))
         self._inflight = collections.deque()
         # wakeup pair: lets the IO thread wait on "ack readable" AND
         # "new request enqueued" at once (select) without polling
@@ -824,14 +841,18 @@ class KVStoreDistAsync(KVStore):
     norms instead, exactly the reference's striping caveat.
     """
 
-    def __init__(self):
+    def __init__(self, uris=None):
         super().__init__("dist_async")
-        uris = os.environ.get("MXT_SERVER_URIS", "")
+        if uris is None:
+            uris = os.environ.get("MXT_SERVER_URIS", "")
+        elif not isinstance(uris, str):
+            uris = ",".join(uris)
         if not uris:
             raise MXNetError(
                 "kvstore 'dist_async' needs running parameter servers: "
                 "launch with `python tools/launch.py -n W -s S cmd...` "
-                "(MXT_SERVER_URIS is set by the launcher) — see "
+                "(MXT_SERVER_URIS is set by the launcher; a serving "
+                "replica passes param_servers= explicitly) — see "
                 "docs/design/kvstore.md")
         self._conns = [_ServerConn(u) for u in uris.split(",")]
         self._bigarray_bound = int(float(os.environ.get(
@@ -975,6 +996,26 @@ class KVStoreDistAsync(KVStore):
             else:
                 self._conns[ci].submit(("push_multi", entries),
                                        wait=False)
+
+    def assign(self, key, value):
+        """Store value(s) verbatim on the owning server(s) — bypasses
+        the installed updater (see :meth:`KVStore.assign`).  Awaited:
+        when this returns, every later ``pull`` observes the value (the
+        serving version-bump publication contract)."""
+        keys, values = self._canon(key, value)
+        pendings = []
+        for k, vs in zip(keys, values):
+            arr = np.asarray(vs[0].asnumpy())
+            plan = self._stripe_plan(k, arr.shape)
+            if plan is None:
+                pendings.append(self._conn_of(k).request(("assign", k, arr)))
+            else:
+                pendings.extend(
+                    self._stripe_conn(k, i).request(
+                        ("assign", f"{k}@s{i}", arr[plan[i]:plan[i + 1]]))
+                    for i in range(len(plan) - 1))
+        for p in pendings:
+            _await(p)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Fetch the server's CURRENT weight — possibly mid-stream of other

@@ -366,6 +366,23 @@ class KVStoreServer:
             hb_timeout if hb_timeout is not None
             else _env("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", 15.0))
         self._hb_seen = {}            # rank -> last monotonic timestamp
+        # extension ops: subsystems riding the kvstore wire (the serving
+        # tier) register additional envelope types here instead of
+        # forking the frame/allowlist/exactly-once stack.  Dispatch is
+        # the LAST resort in _handle, so an extension can never shadow a
+        # core op.
+        self._ext_ops = {}
+
+    def register_op(self, op: str, fn) -> None:
+        """Register an extension envelope type: ``fn(msg, rank) ->
+        reply payload``.  The handler runs under the same exactly-once
+        envelope, allowlisted decode and error-reply contract as the
+        built-in ops; core op names are reserved."""
+        if op in ("ping", "init", "push", "push_multi", "pull",
+                  "pull_rows", "assign", "get_states", "set_states",
+                  "command", "barrier", "req"):
+            raise ValueError(f"cannot override core kvstore op {op!r}")
+        self._ext_ops[op] = fn
 
     # -- request handlers ----------------------------------------------------
     def _apply_push(self, key, arr):
@@ -417,6 +434,24 @@ class KVStoreServer:
             _, entries = msg
             for key, arr in entries:
                 self._apply_push(key, arr)
+            return None
+        if op == "assign":
+            # store the pushed value VERBATIM, bypassing any installed
+            # updater, creating the key if absent.  Control-plane
+            # metadata (the serving weight-version counter) must be a
+            # plain register: routing it through "push" would hand it to
+            # the SGD updater as a gradient.
+            _, key, arr = msg
+            from .ndarray import NDArray
+            import jax.numpy as jnp
+            if isinstance(arr, WirePayload):
+                arr = _decompress(arr)
+            with self._lock:
+                stored = self._store.get(key)
+                if stored is None:
+                    self._store[key] = NDArray(jnp.asarray(arr))
+                else:
+                    stored._set_data(jnp.asarray(arr))
             return None
         if op == "pull":
             _, key = msg
@@ -478,6 +513,9 @@ class KVStoreServer:
         if op == "barrier":
             self._barrier(rank)
             return None
+        ext = self._ext_ops.get(op)
+        if ext is not None:
+            return ext(msg, rank)
         raise ValueError(f"unknown op {op!r}")
 
     # -- exactly-once delivery ----------------------------------------------

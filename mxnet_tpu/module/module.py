@@ -1045,6 +1045,24 @@ class Module(BaseModule):
             # optimizer state per chip — the very peak ZeRO avoids
             self._shard_opt_states()
 
+    def bump_serving_version(self, version=None):
+        """Publish the CURRENT server-side weights to serving replicas
+        watching this job's parameter servers (the train-and-serve
+        topology, docs/SERVING.md).  Requires update-on-kvstore over a
+        dist store — in that mode the servers' weights are the live
+        weights by construction, so publication is just a version bump
+        (:func:`mxnet_tpu.serving.publish_version`); replicas ``pull()``
+        the refreshed parameters on their next refresh check."""
+        assert self.optimizer_initialized
+        if self._kvstore is None or not self._update_on_kvstore \
+                or 'dist' not in self._kvstore.type:
+            raise MXNetError(
+                "bump_serving_version needs update-on-kvstore over a "
+                "dist store (the servers must HOLD the live weights a "
+                "replica can pull) — init_optimizer(kvstore='dist_async')")
+        from ..serving import publish_version
+        return publish_version(self._kvstore, version)
+
     def borrow_optimizer(self, shared_module):
         """Share optimizer/updater/state with another Module
         (reference: module.py borrow_optimizer — BucketingModule makes all

@@ -296,6 +296,94 @@ def reset_channel_bytes():
         _channel_bytes.clear()
 
 
+# -- serving latency / QPS counters ------------------------------------------
+# Request-latency distributions for the serving tier (mxnet_tpu.serving):
+# per KIND (e.g. "serving.request", "serving.batch") a bounded ring of
+# duration samples plus completion timestamps.  p50/p99 sit next to
+# wire_bytes_per_step on purpose: the serving SLO numbers are first-class
+# profiler outputs, not log lines — tests/test_serving.py pins the
+# percentile and QPS arithmetic, and ServingReplica's "serving_stats"
+# envelope serves these dicts to clients.  Bounded (ring, not full
+# history): a replica serving millions of requests must not grow host
+# memory with uptime; MXNET_SERVING_LATENCY_WINDOW sizes the ring.
+_latency_lock = threading.Lock()
+_latency: dict = {}   # kind -> {"durs": deque, "ts": deque, "count", "total"}
+
+
+def _latency_window() -> int:
+    return max(2, int(env("MXNET_SERVING_LATENCY_WINDOW", 2048)))
+
+
+def record_latency(kind: str, dur_s: float, ts: Optional[float] = None):
+    """Record one completed request of ``kind`` taking ``dur_s`` seconds.
+    ``ts`` is the completion time (``time.monotonic()`` when omitted —
+    injectable so the QPS arithmetic is testable without sleeping)."""
+    if ts is None:
+        ts = time.monotonic()
+    with _latency_lock:
+        st = _latency.get(kind)
+        if st is None:
+            from collections import deque
+            w = _latency_window()
+            st = _latency[kind] = {"durs": deque(maxlen=w),
+                                   "ts": deque(maxlen=w),
+                                   "count": 0, "total": 0.0}
+        st["durs"].append(float(dur_s))
+        st["ts"].append(float(ts))
+        st["count"] += 1
+        st["total"] += float(dur_s)
+
+
+def percentile(samples, q) -> float:
+    """Nearest-rank percentile (q in [0, 100]) over ``samples``.  The
+    deterministic textbook definition — sorted sample at rank
+    ``ceil(q/100 * n)`` — so the p50/p99 numbers tests pin are exact,
+    not interpolation-scheme-dependent."""
+    xs = sorted(samples)
+    if not xs:
+        raise MXNetError("percentile of an empty sample set")
+    import math
+    rank = max(1, math.ceil((float(q) / 100.0) * len(xs)))
+    return xs[min(rank, len(xs)) - 1]
+
+
+def latency_stats(kind: str) -> Optional[dict]:
+    """{count, window, p50_ms, p99_ms, mean_ms, max_ms, qps} for ``kind``
+    or None before the first sample.  Percentiles/mean/max are over the
+    ring window; ``count``/``total`` are lifetime.  QPS is completions
+    over the window's timespan — (len-1)/(last-first), the unbiased
+    inter-arrival estimate; 0.0 until two samples exist."""
+    with _latency_lock:
+        st = _latency.get(kind)
+        if st is None:
+            return None
+        durs = list(st["durs"])
+        ts = list(st["ts"])
+        count, total = st["count"], st["total"]
+    qps = 0.0
+    if len(ts) >= 2 and ts[-1] > ts[0]:
+        qps = (len(ts) - 1) / (ts[-1] - ts[0])
+    return {
+        "count": count,
+        "window": len(durs),
+        "p50_ms": percentile(durs, 50) * 1e3,
+        "p99_ms": percentile(durs, 99) * 1e3,
+        "mean_ms": (sum(durs) / len(durs)) * 1e3,
+        "max_ms": max(durs) * 1e3,
+        "qps": qps,
+    }
+
+
+def latency_kinds() -> list:
+    with _latency_lock:
+        return sorted(_latency)
+
+
+def reset_latency():
+    with _latency_lock:
+        _latency.clear()
+
+
 _NULL = __import__("contextlib").nullcontext()
 
 

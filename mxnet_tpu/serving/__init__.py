@@ -1,0 +1,68 @@
+"""mxnet_tpu.serving — production inference on the hardened kvstore wire.
+
+The transport PRs 2–5 hardened for gradients (zero-copy tensor frames,
+sliding-window pipelining, reconnect + exactly-once replay, allowlisted
+decode, TCP_NODELAY) carries inference traffic unchanged; this package
+adds the server shape on top (TF-Serving, arXiv:1605.08695, rebuilt on
+this codebase's idioms):
+
+* :class:`BucketedPredictor` — a checkpoint loaded into bucketed
+  pre-compiled predict executables (pad-to-bucket batch shapes: N
+  request sizes never mean N compiles).
+* :class:`DynamicBatcher` — continuous batching: a request queue drains
+  into the largest ready bucket under ``MXNET_SERVING_MAX_WAIT_MS``,
+  with queue-depth admission control shedding overload as a typed BUSY
+  reply (:class:`BusyError` client-side).
+* :class:`ServingReplica` — a :class:`~mxnet_tpu.kvstore_server.
+  KVStoreServer` subclass serving ``predict`` / ``serving_stats`` /
+  ``serving_refresh`` envelopes over pipelined connections, and hot-
+  swapping weights ``pull()``-ed from live dist_async parameter servers
+  on a version bump — train and serve from one parameter-server
+  cluster.
+* :class:`ServingClient` — pipelined client riding the kvstore channel
+  (reconnect/replay and heartbeats included).
+
+Latency SLOs are first-class: every request records into
+``profiler.record_latency``; ``profiler.latency_stats("serving.
+request")`` exposes p50/p99/QPS next to ``wire_bytes_per_step``.
+
+See docs/SERVING.md for architecture, knobs and the train-and-serve
+topology.
+"""
+from .bucketed import BucketedPredictor, parse_buckets
+from .batcher import BusyError, DynamicBatcher
+from .replica import ServingReplica, VERSION_KEY
+from .client import PredictFuture, ServingClient
+
+__all__ = [
+    "BucketedPredictor", "BusyError", "DynamicBatcher", "PredictFuture",
+    "ServingClient", "ServingReplica", "VERSION_KEY", "parse_buckets",
+    "publish_version",
+]
+
+
+def publish_version(kv, version=None):
+    """Publish a serving weight version to the parameter servers the
+    replicas watch.  Call AFTER the weights on the servers are the ones
+    to serve (dist_async update-on-kvstore keeps them current by
+    construction); replicas refresh on the next poll tick or
+    ``serving_refresh`` envelope.
+
+    ``version=None`` increments the currently-published version (single
+    publisher — the trainer).  The counter rides :meth:`KVStore.assign`
+    (updater-bypassing), never ``push``: a version bump must not be
+    \"applied\" as a gradient."""
+    import jax.numpy as jnp
+    from ..base import MXNetError
+    from ..ndarray import NDArray
+    if version is None:
+        out = NDArray(jnp.zeros((1,), jnp.float64))
+        try:
+            kv.pull(VERSION_KEY, out=out)
+            current = int(round(float(out.asnumpy()[0])))
+        except MXNetError:
+            current = 0
+        version = current + 1
+    kv.assign(VERSION_KEY,
+              NDArray(jnp.asarray([float(version)], jnp.float64)))
+    return int(version)

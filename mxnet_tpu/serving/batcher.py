@@ -1,0 +1,237 @@
+"""Dynamic (continuous) request batcher with queue-depth admission control.
+
+The TF-Serving batching shape (arXiv:1605.08695 §4) on this package's
+threading idioms: requests enqueue as reply slots; ONE worker thread
+drains the queue into the largest ready bucket — it dispatches the
+moment the queued rows fill the biggest configured bucket, or when the
+OLDEST queued request has waited ``MXNET_SERVING_MAX_WAIT_MS``,
+whichever is first.  Admission control is a queue-depth dial
+(``MXNET_SERVING_QUEUE_DEPTH``): requests past the limit complete
+immediately with a typed BUSY reply instead of growing an unbounded
+queue — shedding is the SLO-preserving answer to overload, and the
+client surfaces it as :class:`BusyError`, distinct from every real
+error.
+
+Crash propagation follows the package's sticky-error thread contract
+(PrefetchingIter, _ServerConn._io_loop): a worker crash parks the error,
+fails every queued slot and every later submit loudly — a reply slot is
+never silently abandoned.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List
+
+import numpy as np
+
+from ..base import MXNetError, env
+from .. import profiler as _prof
+from .bucketed import _raw
+
+
+class BusyError(MXNetError):
+    """Typed overload signal: the replica shed this request at admission
+    (queue depth past ``MXNET_SERVING_QUEUE_DEPTH``).  Retry with
+    backoff or route to another replica — the model was never run."""
+
+
+class _ReplySlot:
+    """One request's reply rendezvous: ``reply`` is the transport-level
+    ``("ok"|"err", payload)`` tuple the connection writer sends when
+    ``done`` fires."""
+
+    __slots__ = ("done", "reply", "data", "n", "t_enqueue", "sig", "role")
+
+    def __init__(self, data=None, n=0, sig=None):
+        self.done = threading.Event()
+        self.reply = None
+        self.data = data
+        self.n = n
+        self.sig = sig
+        self.role = None     # fault-injection tag set by the conn loop
+        self.t_enqueue = time.monotonic()
+
+    def complete(self, reply):
+        self.reply = reply
+        self.done.set()
+
+
+class DynamicBatcher:
+    """Drain a request queue into bucketed predict dispatches."""
+
+    def __init__(self, predictor, max_wait_s=None, queue_depth=None):
+        self._predictor = predictor
+        self._max_wait = float(
+            env("MXNET_SERVING_MAX_WAIT_MS", 2.0) / 1000.0
+            if max_wait_s is None else max_wait_s)
+        self._queue_depth = int(env("MXNET_SERVING_QUEUE_DEPTH", 256)
+                                if queue_depth is None else queue_depth)
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._stop = False
+        self._err = None
+        self.batches = 0          # dispatches issued
+        self.shed = 0             # requests answered BUSY
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- intake --------------------------------------------------------------
+    def submit(self, data) -> _ReplySlot:
+        """Admit one request; ALWAYS returns a slot (completed on the
+        spot for BUSY/validation failures — the caller just forwards the
+        reply)."""
+        slot = _ReplySlot()
+        try:
+            datas, n, sig = self._validate(data)
+        except MXNetError as exc:
+            slot.complete(("err", f"{type(exc).__name__}: {exc}"))
+            return slot
+        slot.data, slot.n, slot.sig = datas, n, sig
+        with self._cv:
+            if self._err is not None:
+                slot.complete(("err", "serving batcher failed: "
+                               f"{self._err}"))
+                return slot
+            if self._stop:
+                slot.complete(("err", "serving replica is stopping"))
+                return slot
+            if len(self._q) >= self._queue_depth:
+                # the admission dial: shed NOW with a typed BUSY reply —
+                # never queue unboundedly (the p99 killer)
+                self.shed += 1
+                _prof.record_channel_event("serving.busy_shed")
+                slot.complete(("ok", ("busy", {
+                    "queue_depth": len(self._q),
+                    "limit": self._queue_depth})))
+                return slot
+            self._q.append(slot)
+            self._cv.notify_all()
+        return slot
+
+    def _validate(self, data):
+        if not isinstance(data, dict):
+            raise MXNetError("predict payload must be a {name: array} "
+                             f"dict, got {type(data).__name__}")
+        datas: Dict[str, np.ndarray] = {}
+        n = None
+        for name, v in data.items():
+            arr = np.asarray(_raw(v))
+            if arr.ndim < 1:
+                raise MXNetError(f"predict input {name!r} needs a batch "
+                                 "axis")
+            if n is None:
+                n = int(arr.shape[0])
+            elif int(arr.shape[0]) != n:
+                raise MXNetError("predict inputs disagree on the row "
+                                 "count")
+            datas[str(name)] = arr
+        if not datas or not n:
+            raise MXNetError("empty predict payload")
+        # the coalescing signature: only same-structure requests share a
+        # padded bucket (names + feature shapes + dtypes)
+        sig = tuple(sorted((name, tuple(a.shape[1:]), str(a.dtype))
+                           for name, a in datas.items()))
+        return datas, n, sig
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    @property
+    def queue_limit(self) -> int:
+        return self._queue_depth
+
+    # -- worker --------------------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    return
+                self._dispatch(batch)
+        except Exception as exc:  # noqa: BLE001 — sticky-error contract
+            with self._cv:
+                self._err = exc
+                failed, self._q = list(self._q), deque()
+            for slot in failed:
+                slot.complete(("err", f"serving batcher failed: {exc}"))
+
+    def _collect(self):
+        """Block for work, then drain until the largest bucket is full
+        or the oldest request's max-wait expires; returns the slots of
+        ONE dispatch (same structure signature), or None on stop.
+
+        Only slots sharing the HEAD's structure signature count toward
+        (and join) the dispatch — but the scan covers the WHOLE queue,
+        not just a contiguous prefix, so interleaved traffic from
+        clients with different input structures still coalesces instead
+        of degrading to batches of one.  Skipped slots keep their queue
+        order and their (older) enqueue times, so the next collect's
+        max-wait deadline fires for them immediately."""
+        max_rows = self._predictor.buckets[-1]
+        with self._cv:
+            while not self._q:
+                if self._stop:
+                    return None
+                self._cv.wait(0.1)
+            head_sig = self._q[0].sig
+            deadline = self._q[0].t_enqueue + self._max_wait
+            while not self._stop:
+                rows = sum(s.n for s in self._q if s.sig == head_sig)
+                if rows >= max_rows:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            taken: List[_ReplySlot] = []
+            kept: deque = deque()
+            rows = 0
+            while self._q:
+                slot = self._q.popleft()
+                if (slot.sig == head_sig
+                        and (not taken or rows + slot.n <= max_rows)):
+                    # the head always dispatches, even oversize (the
+                    # predictor chunks it through the largest bucket)
+                    taken.append(slot)
+                    rows += slot.n
+                else:
+                    kept.append(slot)
+            self._q = kept
+        return taken
+
+    def _dispatch(self, slots):
+        data = {name: np.concatenate([s.data[name] for s in slots], axis=0)
+                for name in slots[0].data}
+        try:
+            version, outs = self._predictor.predict(data)
+        except Exception as exc:  # noqa: BLE001 — fail THIS batch only
+            for slot in slots:
+                slot.complete(("err", f"{type(exc).__name__}: {exc}"))
+            return
+        self.batches += 1
+        lo = 0
+        now = time.monotonic()
+        for slot in slots:
+            hi = lo + slot.n
+            slot.complete(("ok", ("result", version,
+                                  [o[lo:hi] for o in outs])))
+            # end-to-end request latency (queue wait + padded forward +
+            # readback): the p50/p99/QPS the profiler serves
+            _prof.record_latency("serving.request",
+                                 now - slot.t_enqueue, ts=now)
+            lo = hi
+
+    def stop(self):
+        """Stop the worker; fail everything still queued."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+        with self._cv:
+            leftover, self._q = list(self._q), deque()
+        for slot in leftover:
+            slot.complete(("err", "serving replica is stopping"))

@@ -1,0 +1,106 @@
+"""Serving client: pipelined predict requests over the kvstore channel.
+
+Reuses :class:`mxnet_tpu.kvstore._ServerConn` verbatim — the serving
+wire IS the hardened kvstore wire, so a client gets the sliding-window
+pipeline (``MXNET_SERVING_CLIENT_WINDOW`` envelopes in flight — wide by
+default so the replica's batcher sees real concurrency from one
+connection), reconnect + full-window replay through connection kills,
+heartbeat liveness and TCP_NODELAY for free.  Replies are typed:
+
+* a served result returns ``(version, [np outputs])``;
+* an admission-control shed raises :class:`BusyError` (retryable — the
+  model never ran);
+* a real failure raises :class:`~mxnet_tpu.base.MXNetError`.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError, env
+from .batcher import BusyError
+from .bucketed import _raw
+
+
+class PredictFuture:
+    """Handle for one in-flight predict; ``get()`` blocks for the typed
+    reply."""
+
+    __slots__ = ("_pending", "version")
+
+    def __init__(self, pending):
+        self._pending = pending
+        self.version = None
+
+    def get(self):
+        from ..kvstore import _await
+        payload = _await(self._pending)   # raises MXNetError on "err"
+        if payload[0] == "busy":
+            info = payload[1]
+            raise BusyError(
+                "serving replica shed the request (queue depth "
+                f"{info.get('queue_depth')} >= limit {info.get('limit')})"
+                " — retry with backoff or use another replica")
+        _tag, version, outs = payload
+        self.version = int(version)
+        return [np.asarray(o) for o in outs]
+
+
+class ServingClient:
+    """Client for one :class:`~mxnet_tpu.serving.ServingReplica`."""
+
+    def __init__(self, uri, window=None, connect_timeout=60.0):
+        from ..kvstore import _ServerConn
+        w = int(env("MXNET_SERVING_CLIENT_WINDOW", 64)
+                if window is None else window)
+        self._conn = _ServerConn(uri, connect_timeout=connect_timeout,
+                                 window=max(1, w))
+
+    def predict_async(self, data, name="data") -> PredictFuture:
+        """Enqueue one predict; returns a :class:`PredictFuture`.  Many
+        futures may be outstanding — that is exactly what feeds the
+        replica's dynamic batcher."""
+        payload = self._payload(data, name)
+        return PredictFuture(self._conn.request(("predict", payload)))
+
+    def predict(self, data, name="data"):
+        """Blocking predict: returns the output list (np arrays, padded
+        rows already sliced off by the replica)."""
+        return self.predict_async(data, name=name).get()
+
+    @staticmethod
+    def _payload(data, name) -> Dict[str, np.ndarray]:
+        if not isinstance(data, dict):
+            data = {name: data}
+        out = {}
+        for k, v in data.items():
+            arr = np.asarray(_raw(v))
+            # ndim check BEFORE ascontiguousarray: the latter promotes
+            # 0-d to 1-d and would mask a scalar input
+            if arr.ndim < 1:
+                raise MXNetError(f"predict input {k!r} needs a batch axis")
+            out[str(k)] = np.ascontiguousarray(arr)
+        return out
+
+    def stats(self) -> dict:
+        """The replica's serving counters (version, queue depth,
+        batches, shed count, p50/p99/QPS latency dict)."""
+        return self._conn.submit(("serving_stats",), wait=True)
+
+    def refresh(self) -> dict:
+        """Force one weight-version check on the replica NOW; returns
+        {version, refreshed, skipped}."""
+        return self._conn.submit(("serving_refresh",), wait=True)
+
+    def version(self) -> Optional[int]:
+        return self.stats().get("version")
+
+    def close(self):
+        self._conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -609,6 +609,48 @@ def test_set_gradient_compression_validation():
         kv2.set_gradient_compression({'type': '2bit', 'bogus': 1})
 
 
+def test_assign_bypasses_updater(monkeypatch):
+    """The 'assign' envelope (serving version publication) stores the
+    value VERBATIM — never through the installed optimizer — and
+    creates missing keys, on both the local store and the dist_async
+    wire."""
+    # local store
+    kv = mx.kv.create('local')
+    kv.init(3, mx.nd.zeros(SHAPE))
+    applied = []
+    kv._set_updater(lambda key, recv, stored: applied.append(key))
+    kv.assign(3, mx.nd.ones(SHAPE) * 7)
+    kv.assign('fresh_key', mx.nd.ones((2,)) * 3)   # no init needed
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 7.0)
+    out2 = mx.nd.zeros((2,))
+    kv.pull('fresh_key', out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), 3.0)
+    assert applied == []
+
+    # dist_async wire: a push goes through SGD, an assign does not
+    srv = _serve_one(monkeypatch)
+    try:
+        dkv = mx.kv.create('dist_async')
+        dkv.init('w', mx.nd.zeros(SHAPE))
+        dkv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                           momentum=0.0, wd=0.0,
+                                           rescale_grad=1.0))
+        dkv.push('w', mx.nd.ones(SHAPE))             # w = -0.1
+        dkv.assign('w', mx.nd.ones(SHAPE) * 42)      # w = 42, verbatim
+        dkv.assign('meta', mx.nd.ones((1,)) * 5)     # created on the fly
+        out = mx.nd.zeros(SHAPE)
+        dkv.pull('w', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 42.0)
+        mout = mx.nd.zeros((1,))
+        dkv.pull('meta', out=mout)
+        np.testing.assert_allclose(mout.asnumpy(), 5.0)
+        dkv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
 def test_dist_async_2bit_push_wire_bytes_8x(monkeypatch):
     """THE compression acceptance: 2-bit quantization cuts the measured
     push wire bytes >= 8x for an fp32 payload, asserted against the

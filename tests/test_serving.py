@@ -1,0 +1,597 @@
+"""mxnet_tpu.serving — the inference tier on the hardened kvstore wire.
+
+Covers the ISSUE 6 acceptance surface on CPU, in tier-1:
+
+* deterministic bucket selection and pad-slice semantics;
+* the compile pin — any request mix costs at most ``len(buckets)``
+  predict compiles (``profiler.record_dispatch``);
+* queue-depth admission control returning the typed BUSY reply;
+* p50/p99/QPS counter arithmetic pinned exactly;
+* 64 concurrent requests through one replica's dynamic batcher;
+* a live dist_async weight refresh changing served predictions without
+  a restart;
+* hostile predict envelopes rejected by the allowlisted decoder with
+  the connection dropped — the serving extension of the kvstore wire's
+  hostile-payload tests (tests/test_kvstore.py).
+"""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore_server import KVStoreServer, _send_msg, _recv_msg
+from mxnet_tpu.serving import (BucketedPredictor, BusyError,
+                               DynamicBatcher, ServingClient,
+                               ServingReplica, parse_buckets,
+                               publish_version)
+
+FEAT = 4
+HIDDEN = 3
+
+
+def _softmax_symbol():
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=HIDDEN, name='fc')
+    return mx.sym.SoftmaxOutput(fc, name='softmax')
+
+
+def _params(seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        'fc_weight': mx.nd.NDArray(
+            rs.randn(HIDDEN, FEAT).astype(np.float32)),
+        'fc_bias': mx.nd.NDArray(
+            rs.randn(HIDDEN).astype(np.float32)),
+    }
+
+
+def _ref_softmax(x, params):
+    w = np.asarray(params['fc_weight'].asnumpy())
+    b = np.asarray(params['fc_bias'].asnumpy())
+    logits = x @ w.T + b
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _make_predictor(buckets=(2, 4, 8), seed=0):
+    params = _params(seed)
+    pred = BucketedPredictor(_softmax_symbol(), {'data': (FEAT,)},
+                             params, buckets=list(buckets))
+    return pred, params
+
+
+# -- bucket selection / parse ------------------------------------------------
+def test_parse_buckets():
+    assert parse_buckets("1,2,4,8,16,32") == [1, 2, 4, 8, 16, 32]
+    assert parse_buckets(" 8, 2,2,4 ") == [2, 4, 8]
+    assert parse_buckets([4, 1]) == [1, 4]
+    with pytest.raises(MXNetError, match="bucket"):
+        parse_buckets("0,2")
+    with pytest.raises(MXNetError, match="bucket"):
+        parse_buckets("")
+    with pytest.raises(MXNetError, match="bucket"):
+        parse_buckets("two")
+
+
+def test_bucket_selection_deterministic():
+    """Smallest covering bucket, largest for oversize — pure and exact
+    (the batcher's padding arithmetic stands on this)."""
+    pred, _ = _make_predictor(buckets=(2, 4, 8))
+    assert [pred.select_bucket(n) for n in (1, 2, 3, 4, 5, 8)] \
+        == [2, 2, 4, 4, 8, 8]
+    # oversize chunks through the largest bucket
+    assert pred.select_bucket(9) == 8
+    assert pred.select_bucket(100) == 8
+    with pytest.raises(MXNetError, match="row"):
+        pred.select_bucket(0)
+
+
+# -- pad/slice + compile pin -------------------------------------------------
+def test_padded_rows_sliced_before_reply():
+    """A 3-row request through a 4-bucket returns EXACTLY 3 rows, equal
+    to the direct un-padded math — padding is invisible to clients."""
+    pred, params = _make_predictor(buckets=(4, 8))
+    x = np.random.RandomState(1).randn(3, FEAT).astype(np.float32)
+    version, outs = pred.predict({'data': x})
+    assert version == 0
+    assert outs[0].shape == (3, HIDDEN)
+    np.testing.assert_allclose(outs[0], _ref_softmax(x, params),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_oversize_request_chunks_through_largest_bucket():
+    pred, params = _make_predictor(buckets=(2, 4))
+    x = np.random.RandomState(2).randn(11, FEAT).astype(np.float32)
+    _v, outs = pred.predict({'data': x})
+    assert outs[0].shape == (11, HIDDEN)
+    np.testing.assert_allclose(outs[0], _ref_softmax(x, params),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_compile_pin_at_most_len_buckets():
+    """Any request-size mix compiles at most one executable per bucket
+    — N requests never mean N compiles (the tentpole's core claim)."""
+    profiler.reset_dispatch_counts()
+    pred, _ = _make_predictor(buckets=(1, 2, 4))
+    pred.warmup()
+    base = profiler.dispatch_counts().get("serving.predict_compile", 0)
+    assert base == 3
+    rs = np.random.RandomState(3)
+    for n in (1, 2, 3, 4, 1, 3, 4, 2, 4, 4, 1):
+        pred.predict({'data': rs.randn(n, FEAT).astype(np.float32)})
+    counts = profiler.dispatch_counts()
+    assert counts.get("serving.predict_compile", 0) == 3, counts
+    # ...and float64 client input is cast, not recompiled
+    pred.predict({'data': rs.randn(2, FEAT)})   # float64
+    assert profiler.dispatch_counts().get(
+        "serving.predict_compile", 0) == 3
+
+
+def test_weight_swap_no_recompile_changes_predictions():
+    """set_params hot-swaps weights without touching the compile count
+    — the mechanism the live dist_async refresh rides."""
+    profiler.reset_dispatch_counts()
+    pred, _ = _make_predictor(buckets=(2, 4))
+    x = np.random.RandomState(4).randn(2, FEAT).astype(np.float32)
+    _v, before = pred.predict({'data': x})
+    compiles = profiler.dispatch_counts().get("serving.predict_compile", 0)
+    new_params = _params(seed=9)
+    pred.set_params(new_params, version=7)
+    v, after = pred.predict({'data': x})
+    assert v == 7 and pred.version == 7
+    assert not np.allclose(before[0], after[0])
+    np.testing.assert_allclose(after[0], _ref_softmax(x, new_params),
+                               rtol=1e-5, atol=1e-6)
+    assert profiler.dispatch_counts().get(
+        "serving.predict_compile", 0) == compiles
+    # a refresh may never re-architect the model
+    bad = dict(new_params)
+    bad['fc_weight'] = mx.nd.NDArray(np.zeros((HIDDEN, FEAT + 1),
+                                              np.float32))
+    with pytest.raises(MXNetError, match="shape"):
+        pred.set_params(bad)
+
+
+# -- latency / QPS counter math ----------------------------------------------
+def test_percentile_nearest_rank():
+    assert profiler.percentile([1.0], 50) == 1.0
+    assert profiler.percentile([1.0, 2.0], 50) == 1.0
+    assert profiler.percentile([1.0, 2.0], 99) == 2.0
+    assert profiler.percentile(list(range(1, 101)), 50) == 50
+    assert profiler.percentile(list(range(1, 101)), 99) == 99
+    assert profiler.percentile([3.0, 1.0, 2.0], 100) == 3.0
+    with pytest.raises(MXNetError, match="empty"):
+        profiler.percentile([], 50)
+
+
+def test_latency_stats_math_pinned():
+    """p50/p99/mean/max/QPS over injected samples are EXACT — the SLO
+    numbers a replica reports must not be estimation-scheme-dependent."""
+    kind = "serving.test_pinned"
+    profiler.reset_latency()
+    for dur, ts in [(0.010, 1.0), (0.040, 2.0), (0.020, 3.0),
+                    (0.030, 5.0)]:
+        profiler.record_latency(kind, dur, ts=ts)
+    st = profiler.latency_stats(kind)
+    assert st["count"] == 4 and st["window"] == 4
+    assert st["p50_ms"] == pytest.approx(20.0)   # rank ceil(.5*4)=2 of
+    assert st["p99_ms"] == pytest.approx(40.0)   # [10,20,30,40]; rank 4
+    assert st["mean_ms"] == pytest.approx(25.0)
+    assert st["max_ms"] == pytest.approx(40.0)
+    assert st["qps"] == pytest.approx(3 / 4.0)   # 3 intervals over 4s
+    assert profiler.latency_stats("serving.never_recorded") is None
+
+
+def test_latency_window_bounds_memory(monkeypatch):
+    """The sample ring is bounded by MXNET_SERVING_LATENCY_WINDOW;
+    count stays lifetime while percentiles cover the window."""
+    monkeypatch.setenv("MXNET_SERVING_LATENCY_WINDOW", "4")
+    profiler.reset_latency()
+    kind = "serving.test_window"
+    for i in range(10):
+        profiler.record_latency(kind, float(i), ts=float(i))
+    st = profiler.latency_stats(kind)
+    assert st["count"] == 10 and st["window"] == 4
+    # window holds the LAST 4 samples: 6,7,8,9
+    assert st["max_ms"] == pytest.approx(9000.0)
+    assert st["p50_ms"] == pytest.approx(7000.0)
+
+
+# -- admission control --------------------------------------------------------
+class _BlockingPredictor:
+    """Stub predictor whose forward parks on an event — makes queue
+    buildup deterministic for the shedding tests."""
+
+    buckets = [1]
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def predict(self, data):
+        self.started.set()
+        assert self.release.wait(30), "test never released the predictor"
+        return 0, [np.asarray(data["data"])]
+
+
+def test_queue_depth_shedding_returns_busy():
+    """Requests past the queue-depth dial complete IMMEDIATELY with the
+    typed BUSY payload — never an error, never unbounded queueing."""
+    stub = _BlockingPredictor()
+    b = DynamicBatcher(stub, max_wait_s=0.0, queue_depth=2)
+    try:
+        x = {"data": np.ones((1, 2), np.float32)}
+        s1 = b.submit(x)
+        assert stub.started.wait(10)     # worker is inside predict(s1)
+        s2, s3 = b.submit(x), b.submit(x)
+        assert b.queue_depth == 2
+        s4 = b.submit(x)                 # past the dial: shed NOW
+        assert s4.done.is_set()
+        status, payload = s4.reply
+        assert status == "ok" and payload[0] == "busy"
+        assert payload[1] == {"queue_depth": 2, "limit": 2}
+        assert b.shed == 1
+        stub.release.set()
+        for s in (s1, s2, s3):
+            assert s.done.wait(10)
+            assert s.reply[0] == "ok" and s.reply[1][0] == "result"
+    finally:
+        stub.release.set()
+        b.stop()
+
+
+def test_client_raises_typed_busy_error():
+    """Client side of the shed: BusyError (a typed, retryable signal),
+    not a generic failure.  queue_depth=0 sheds every request."""
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, _params(),
+                         buckets=[1, 2], queue_depth=0, warmup=False)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}", window=4)
+    try:
+        with pytest.raises(BusyError, match="shed"):
+            cli.predict(np.zeros((1, FEAT), np.float32))
+        assert issubclass(BusyError, MXNetError)
+    finally:
+        cli.close()
+        rep.stop()
+
+
+def test_batcher_coalesces_past_mixed_signatures():
+    """Interleaved traffic with different input structures must still
+    coalesce: the collect scan skips non-matching slots (they dispatch
+    in their own batch) instead of fragmenting everything to batches of
+    one."""
+
+    class _Recording(_BlockingPredictor):
+        def __init__(self):
+            super().__init__()
+            self.calls = []
+
+        def predict(self, data):
+            self.started.set()
+            assert self.release.wait(30)
+            arr = data["data"]
+            self.calls.append((int(arr.shape[0]), str(arr.dtype)))
+            return 0, [np.asarray(arr)]
+
+    stub = _Recording()
+    stub.buckets = [4]
+    b = DynamicBatcher(stub, max_wait_s=0.0, queue_depth=16)
+    try:
+        a = {"data": np.ones((1, 2), np.float32)}
+        other = {"data": np.ones((1, 2), np.float64)}   # different sig
+        first = b.submit(a)              # worker grabs this immediately
+        assert stub.started.wait(10)
+        # queued while the worker is parked: A, OTHER, A
+        s_a1, s_o, s_a2 = b.submit(a), b.submit(other), b.submit(a)
+        stub.release.set()
+        for s in (first, s_a1, s_o, s_a2):
+            assert s.done.wait(10)
+            assert s.reply[0] == "ok" and s.reply[1][0] == "result"
+        # dispatch 2 coalesced BOTH float32 slots across the float64
+        # slot in between; the float64 one ran alone
+        assert stub.calls == [(1, "float32"), (2, "float32"),
+                              (1, "float64")], stub.calls
+    finally:
+        stub.release.set()
+        b.stop()
+
+
+def test_refresh_transport_failure_does_not_advance_version(monkeypatch):
+    """A transport failure mid-refresh must surface (and leave the seen
+    version untouched so the next poll retries) — only a genuinely
+    missing key reads as 'frozen param / not published'."""
+    ps = KVStoreServer(server_id=0, num_workers=1)
+    ps.start_background()
+    ps_uri = f"127.0.0.1:{ps.port}"
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0")
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, _params(),
+                         buckets=[2], param_servers=ps_uri,
+                         max_wait_s=0.0, warmup=False)
+    try:
+        # nothing published: a clean no-op, not an error
+        assert rep._refresh_once()["refreshed"] is False
+        assert rep._seen_version is None
+        # dead servers: the refresh RAISES instead of pretending the
+        # version space is empty, and the next call re-dials fresh
+        ps.stop()
+        with pytest.raises(MXNetError):
+            rep._refresh_once()
+        assert rep._seen_version is None
+        assert rep._ps is None    # poisoned client was dropped
+    finally:
+        rep.stop()
+        ps.stop()
+
+
+def test_batcher_crash_propagates_to_slots():
+    """The sticky-error thread contract: a predictor crash fails every
+    queued slot loudly and poisons later submits."""
+
+    class _Exploding:
+        buckets = [4]
+
+        def predict(self, data):
+            raise RuntimeError("boom")
+
+    b = DynamicBatcher(_Exploding(), max_wait_s=0.0, queue_depth=8)
+    try:
+        s = b.submit({"data": np.ones((1, 2), np.float32)})
+        assert s.done.wait(10)
+        status, payload = s.reply
+        assert status == "err" and "boom" in payload
+    finally:
+        b.stop()
+
+
+# -- the 64-concurrent acceptance smoke ---------------------------------------
+def test_replica_serves_64_concurrent_through_batcher():
+    """ISSUE 6 acceptance: one replica, >= 64 concurrent requests, all
+    correct, at most len(buckets) compiles, real batching (fewer
+    dispatches than requests), p50/p99/QPS exposed."""
+    profiler.reset_dispatch_counts()
+    profiler.reset_latency()
+    params = _params()
+    buckets = [1, 2, 4, 8]
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, params,
+                         buckets=buckets, max_wait_s=0.05)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}", window=64)
+    try:
+        rs = np.random.RandomState(5)
+        x = rs.randn(8, FEAT).astype(np.float32)
+        ref = _ref_softmax(x, params)
+        futs = [cli.predict_async(x[i % 8:i % 8 + 1]) for i in range(64)]
+        for i, fut in enumerate(futs):
+            out = fut.get()
+            np.testing.assert_allclose(out[0], ref[i % 8:i % 8 + 1],
+                                       rtol=1e-5, atol=1e-6)
+            assert fut.version == 0
+        counts = profiler.dispatch_counts()
+        assert counts.get("serving.predict_compile", 0) <= len(buckets), \
+            counts
+        st = cli.stats()
+        assert st["version"] == 0 and st["shed"] == 0
+        # the batcher actually coalesced: far fewer forwards than
+        # requests (64 single-row requests, 50 ms fill window)
+        assert 1 <= st["batches"] < 64
+        lat = st["latency"]
+        assert lat["count"] >= 64
+        assert lat["p50_ms"] > 0 and lat["p99_ms"] >= lat["p50_ms"]
+        assert lat["qps"] > 0
+    finally:
+        cli.close()
+        rep.stop()
+
+
+# -- live dist_async weight refresh -------------------------------------------
+def test_weight_refresh_from_live_dist_async(monkeypatch):
+    """Train-and-serve: an SGD push to the live parameter servers plus a
+    version bump changes served predictions WITHOUT a replica restart
+    (and without one extra compile)."""
+    profiler.reset_dispatch_counts()
+    ps = KVStoreServer(server_id=0, num_workers=1)
+    ps.start_background()
+    ps_uri = f"127.0.0.1:{ps.port}"
+    monkeypatch.setenv("MXT_SERVER_URIS", ps_uri)
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    params = _params()
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, params,
+                         buckets=[2, 4], param_servers=ps_uri,
+                         max_wait_s=0.005)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}")
+    kv = mx.kv.create('dist_async')
+    try:
+        x = np.random.RandomState(6).randn(3, FEAT).astype(np.float32)
+        np.testing.assert_allclose(cli.predict(x)[0],
+                                   _ref_softmax(x, params),
+                                   rtol=1e-5, atol=1e-6)
+        compiles = profiler.dispatch_counts().get(
+            "serving.predict_compile", 0)
+
+        # the trainer: init weights on the servers, install SGD, push a
+        # gradient — the server-side weights are now the live weights
+        w0 = np.asarray(params['fc_weight'].asnumpy())
+        b0 = np.asarray(params['fc_bias'].asnumpy())
+        kv.init('fc_weight', mx.nd.NDArray(w0))
+        kv.init('fc_bias', mx.nd.NDArray(b0))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.0,
+                                          wd=0.0, rescale_grad=1.0))
+        grad = np.ones_like(w0)
+        kv.push('fc_weight', mx.nd.NDArray(grad))
+        kv.barrier()
+
+        # no bump yet -> refresh is a no-op and predictions are stale
+        assert cli.refresh()["refreshed"] is False
+        assert rep.version == 0
+
+        v = publish_version(kv)
+        assert v == 1
+        r = cli.refresh()
+        assert r["refreshed"] is True and r["version"] == 1
+
+        new_params = {'fc_weight': mx.nd.NDArray(w0 - 0.1 * grad),
+                      'fc_bias': mx.nd.NDArray(b0)}
+        fut = cli.predict_async(x)
+        out = fut.get()
+        np.testing.assert_allclose(out[0], _ref_softmax(x, new_params),
+                                   rtol=1e-5, atol=1e-6)
+        assert fut.version == 1
+        # hot swap: zero additional compiles
+        assert profiler.dispatch_counts().get(
+            "serving.predict_compile", 0) == compiles
+
+        # second bump via the auto-increment path
+        assert publish_version(kv) == 2
+        assert cli.refresh()["version"] == 2
+    finally:
+        cli.close()
+        kv.close(stop_servers=False)
+        rep.stop()
+        ps.stop()
+
+
+def test_assign_and_publish_version_local_store():
+    """publish_version works against the local store too (single-process
+    test rigs); assign never routes through the updater."""
+    kv = mx.kv.create('local')
+    applied = []
+    kv._set_updater(lambda key, recv, stored: applied.append(key))
+    assert publish_version(kv) == 1
+    assert publish_version(kv) == 2
+    assert publish_version(kv, version=10) == 10
+    out = mx.nd.zeros((1,), dtype="float64")
+    kv.pull(serving.VERSION_KEY, out=out)
+    assert int(out.asnumpy()[0]) == 10
+    assert applied == []   # assign bypassed the updater
+
+
+# -- hostile payloads on the serving envelopes --------------------------------
+def test_serving_rejects_hostile_predict_payload(tmp_path):
+    """The serving envelopes decode through the SAME allowlisted
+    unpickler as the gradient path: a malicious predict request is
+    refused, the connection dropped, no side effect runs, and the
+    replica keeps serving well-formed clients."""
+    marker = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {marker}",))
+
+    import mxnet_tpu.recordio as _rio
+
+    class EvilFileWriter:
+        def __reduce__(self):
+            return (_rio.MXRecordIO, (str(marker), "w"))
+
+    params = _params()
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, params,
+                         buckets=[1, 2], max_wait_s=0.0, warmup=False)
+    rep.start_background()
+    try:
+        for payload in (Evil(), EvilFileWriter()):
+            # enveloped predict carrying a gadget where the tensor
+            # should be: decode fails inside the allowlist, the replica
+            # drops the connection before any handler runs
+            s = socket.create_connection(("127.0.0.1", rep.port),
+                                         timeout=5)
+            _send_msg(s, ("req", (0, "cafe"), 0,
+                          ("predict", {"data": payload})))
+            with pytest.raises((ConnectionError, OSError)):
+                _recv_msg(s)
+            s.close()
+        # raw (un-enveloped) form must die the same way
+        s = socket.create_connection(("127.0.0.1", rep.port), timeout=5)
+        _send_msg(s, ("predict", {"data": Evil()}))
+        with pytest.raises((ConnectionError, OSError)):
+            _recv_msg(s)
+        s.close()
+        assert not marker.exists(), "hostile payload executed!"
+        # replica is still healthy for honest clients
+        cli = ServingClient(f"127.0.0.1:{rep.port}", window=4)
+        try:
+            out = cli.predict(np.zeros((1, FEAT), np.float32))
+            assert out[0].shape == (1, HIDDEN)
+        finally:
+            cli.close()
+    finally:
+        rep.stop()
+
+
+def test_malformed_predict_is_an_error_not_a_crash():
+    """Well-formed frames with BAD predict payloads (wrong feature
+    shape, not a dict, empty) come back as typed per-request errors;
+    the replica survives all of them."""
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, _params(),
+                         buckets=[1, 2], max_wait_s=0.0, warmup=False)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}", window=4)
+    try:
+        with pytest.raises(MXNetError, match="feature shape"):
+            cli.predict(np.zeros((1, FEAT + 2), np.float32))
+        with pytest.raises(MXNetError, match="batch axis"):
+            cli.predict(np.float32(3.0))
+        # still serving
+        assert cli.predict(np.zeros((2, FEAT),
+                                    np.float32))[0].shape == (2, HIDDEN)
+    finally:
+        cli.close()
+        rep.stop()
+
+
+@pytest.mark.parametrize("fmt", ["classic", "sharded"])
+def test_replica_from_checkpoint_both_formats(tmp_path, fmt):
+    """A replica serves whatever checkpoint flavor the trainer wrote:
+    the classic single-file format and the sharded multi-process format
+    both load through checkpoint.load_serving_params."""
+    params = _params(seed=11)
+    sym = _softmax_symbol()
+    prefix = str(tmp_path / "model")
+    if fmt == "classic":
+        mx.model.save_checkpoint(prefix, 3, sym, params, {})
+    else:
+        from mxnet_tpu.checkpoint import save_checkpoint_sharded
+        save_checkpoint_sharded(prefix, 3, sym, params, {})
+    rep = ServingReplica.from_checkpoint(
+        prefix, 3, {'data': (FEAT,)}, buckets=[2, 4], max_wait_s=0.0,
+        warmup=False)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}", window=4)
+    try:
+        x = np.random.RandomState(12).randn(3, FEAT).astype(np.float32)
+        np.testing.assert_allclose(cli.predict(x)[0],
+                                   _ref_softmax(x, params),
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        cli.close()
+        rep.stop()
+
+
+def test_stats_envelope_shape():
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, _params(),
+                         buckets=[1, 2], max_wait_s=0.0, warmup=False)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}", window=4)
+    try:
+        cli.predict(np.zeros((1, FEAT), np.float32))
+        st = cli.stats()
+        for key in ("version", "buckets", "queue_depth", "queue_limit",
+                    "batches", "shed", "refreshes", "latency"):
+            assert key in st, st
+        assert st["buckets"] == [1, 2]
+        assert st["latency"]["count"] >= 1
+    finally:
+        cli.close()
+        rep.stop()
