@@ -65,6 +65,24 @@ JAX_PLATFORMS=cpu MXNET_KVSTORE_WINDOW=8 \
     python tools/launch.py -n 2 -s 1 \
     python tests/dist/dist_fault_injection.py
 
+echo "== elastic-membership smoke (SIGKILL a server mid-epoch, no restart)"
+# The roster must ACT on the liveness/striping/replay primitives
+# (docs/ROBUSTNESS.md elastic membership): server 1 is REALLY SIGKILLed
+# after serving exactly the last ack of round 2 (the count is derived
+# from the wire protocol — dist_elastic_membership.expected_kill_acks
+# documents the arithmetic and prints it under MXT_PRINT_KILL_ACKS).
+# The surviving roster evicts it, re-stripes, hands state off from the
+# workers' sync-point caches and re-pushes the orphaned gradients; the
+# job must COMPLETE WITHOUT RESTART with final weights BIT-IDENTICAL to
+# the static-roster golden.  Time-boxed: an elastic regression
+# typically presents as a hang in the renegotiated barrier.
+kill_acks=$(MXT_PRINT_KILL_ACKS=1 python tests/dist/dist_elastic_membership.py)
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py --elastic -n 2 -s 2 \
+    --env MXNET_FI_KILL_PROCESS_AFTER="$kill_acks" \
+    --env MXNET_FI_ONLY_SERVER=1 \
+    python tests/dist/dist_elastic_membership.py
+
 echo "== serving smoke (replica + dynamic batcher + live weight refresh)"
 # The inference tier's acceptance across real process/socket boundaries
 # (docs/SERVING.md): one replica serves 64 concurrent requests through

@@ -47,6 +47,7 @@ from . import image
 from . import image as img
 from . import kvstore as kv
 from . import kvstore
+from . import membership
 from . import faultinject
 from . import model
 from . import serving

@@ -141,6 +141,22 @@ declare_env("MXNET_KVSTORE_DEDUP_WINDOW", int, 8,
             "server: cached replies per client channel for idempotent "
             "replay acks after a reconnect (keep >= 2: a zombie "
             "connection can serve its last request late)")
+declare_env("MXNET_KVSTORE_ELASTIC", bool, False,
+            "dist_async elastic membership: servers/workers may join or "
+            "leave mid-job — versioned roster on server 0, stripe-plan "
+            "re-derivation + striped-state handoff on a roster bump, "
+            "barriers renegotiate instead of failing "
+            "(mxnet_tpu.membership; docs/ROBUSTNESS.md)")
+declare_env("MXNET_KVSTORE_SNAPSHOT_S", float, 0.0,
+            "elastic: seconds between each non-coordinator server's "
+            "state snapshot to the coordinator (the killed-server "
+            "optimizer-state recovery source; 0 disables snapshots — "
+            "weights still recover from the workers' quorum re-push)")
+declare_env("MXNET_KVSTORE_ELASTIC_PUSH_LOG", int, 256,
+            "elastic: per-worker cap on pushes remembered since each "
+            "key's last pull, re-applied under the new layout when a "
+            "server dies with them (older entries fall off: "
+            "best-effort for barrier-free async jobs)")
 # -- serving tier (mxnet_tpu.serving) ---------------------------------------
 declare_env("MXNET_SERVING_BUCKETS", str, "1,2,4,8,16,32",
             "serving: comma-separated batch-size buckets the replica "
@@ -215,6 +231,13 @@ declare_env("MXNET_FI_DELAY_ACK_MS", float, 0.0,
 declare_env("MXNET_FI_ONLY_RANK", int, None,
             "fault injection: restrict the armed plan to this "
             "DMLC_WORKER_ID (unset = all ranks)")
+declare_env("MXNET_FI_KILL_PROCESS_AFTER", int, None,
+            "fault injection: SIGKILL this process after serving "
+            "exactly this many enveloped data-channel replies — real "
+            "process death for elastic-membership tests (unset = off)")
+declare_env("MXNET_FI_ONLY_SERVER", int, None,
+            "fault injection: restrict the process-kill plan to this "
+            "DMLC_SERVER_ID (unset = all servers)")
 
 
 # ---------------------------------------------------------------------------

@@ -161,6 +161,25 @@ def num_dead_nodes() -> int:
     return total
 
 
+def roster_generation() -> int:
+    """The highest elastic-membership roster generation any open
+    dist_async store in this process has converged onto (0 for a static
+    roster / no elastic stores).  Rides the same weakref registry as
+    ``num_dead_nodes`` — a store that has been GC'd stops reporting.
+    Job-level liveness in one read: a generation that moved means the
+    cluster lost or gained members and this process has already
+    re-derived its striping against the survivors."""
+    best = 0
+    for ref in list(_dead_node_sources):
+        obj = ref()
+        if obj is None:
+            continue
+        gen = getattr(obj, "_roster_gen", None)
+        if isinstance(gen, int) and gen > best:
+            best = gen
+    return best
+
+
 def shutdown() -> None:
     global _initialized
     if not _initialized:

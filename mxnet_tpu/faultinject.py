@@ -62,6 +62,9 @@ class _Plan:
         self.refuse_accepts = 0         # remaining accepts to drop
         self.accepts_refused = 0
         self.only_rank = None           # limit the plan to one worker rank
+        self.kill_process_after = None  # SIGKILL self after n served acks
+        self.acks_served = 0            # enveloped replies counted
+        self.only_server = None         # limit process kill to one server id
 
 
 _plan = _Plan()
@@ -71,6 +74,12 @@ def _rank_active():
     if _plan.only_rank is None:
         return True
     return os.environ.get("DMLC_WORKER_ID", "0") == str(_plan.only_rank)
+
+
+def _server_active():
+    if _plan.only_server is None:
+        return True
+    return os.environ.get("DMLC_SERVER_ID", "0") == str(_plan.only_server)
 
 
 def reset():
@@ -86,12 +95,13 @@ def stats() -> dict:
         return {"kills_fired": _plan.kills_fired,
                 "connects_refused": _plan.connects_refused,
                 "accepts_refused": _plan.accepts_refused,
-                "messages_seen": _plan.sent}
+                "messages_seen": _plan.sent,
+                "acks_served": _plan.acks_served}
 
 
 def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
               refuse_connects=0, refuse_accepts=0, only_rank=None,
-              kill_unacked=None):
+              kill_unacked=None, kill_process_after=None, only_server=None):
     """Arm a plan directly (the non-context-manager form; multi-process
     scripts use this after deciding per-rank what to inject)."""
     if kill_point not in KILL_POINTS:
@@ -109,6 +119,10 @@ def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
         _plan.refuse_accepts = int(refuse_accepts)
         _plan.accepts_refused = 0
         _plan.only_rank = only_rank
+        _plan.kill_process_after = (int(kill_process_after)
+                                    if kill_process_after else None)
+        _plan.acks_served = 0
+        _plan.only_server = only_server
 
 
 @contextlib.contextmanager
@@ -142,6 +156,26 @@ def kill_when_unacked(k):
     finally:
         with _lock:
             _plan.kill_unacked = None
+
+
+@contextlib.contextmanager
+def kill_process_after_acks(n):
+    """SIGKILL THIS PROCESS the moment it has served ``n`` enveloped
+    data-channel replies — REAL process death (no atexit, no socket
+    shutdown handshake, no Python unwind), the preemption shape the
+    elastic-membership machinery must survive.  Heartbeat pings and raw
+    messages are exempt, so the count is deterministic: it advances
+    only on the exactly-once request stream.  Env form:
+    ``MXNET_FI_KILL_PROCESS_AFTER`` (+ ``MXNET_FI_ONLY_SERVER`` to
+    target one DMLC_SERVER_ID in a launcher-spawned job)."""
+    with _lock:
+        _plan.kill_process_after = int(n)
+        _plan.acks_served = 0
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.kill_process_after = None
 
 
 @contextlib.contextmanager
@@ -275,6 +309,33 @@ def server_reply_delay():
         time.sleep(d)
 
 
+def _sigkill_self():
+    """SIGKILL this process (separate function so in-process tests can
+    monkeypatch the trigger without actually dying)."""
+    import signal
+    import sys
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def server_replied():
+    """Called after every ENVELOPED server reply hit the socket (raw
+    messages and heartbeat pings are exempt, keeping the count
+    deterministic).  Fires the armed process kill — SIGKILL, not an
+    exception: elastic tests need real process death, with the served
+    state genuinely lost."""
+    with _lock:
+        if _plan.kill_process_after is None or not _server_active():
+            return
+        _plan.acks_served += 1
+        if _plan.acks_served < _plan.kill_process_after:
+            return
+        _plan.kill_process_after = None     # fire once
+        _plan.kills_fired += 1
+    _sigkill_self()
+
+
 def _arm_from_env():
     """One-shot env activation (multi-process tests: the launcher can't
     reach into a worker, but its environment can)."""
@@ -283,8 +344,10 @@ def _arm_from_env():
     rc = os.environ.get("MXNET_FI_REFUSE_CONNECTS")
     ra = os.environ.get("MXNET_FI_REFUSE_ACCEPTS")
     dl = os.environ.get("MXNET_FI_DELAY_ACK_MS")
+    kp = os.environ.get("MXNET_FI_KILL_PROCESS_AFTER")
     orank = os.environ.get("MXNET_FI_ONLY_RANK")
-    if not (ka or ku or rc or ra or dl):
+    osrv = os.environ.get("MXNET_FI_ONLY_SERVER")
+    if not (ka or ku or rc or ra or dl or kp):
         return
     configure(
         kill_after=int(ka) if ka else None,
@@ -293,7 +356,9 @@ def _arm_from_env():
         delay_ack_s=float(dl) / 1000.0 if dl else 0.0,
         refuse_connects=int(rc) if rc else 0,
         refuse_accepts=int(ra) if ra else 0,
-        only_rank=int(orank) if orank else None)
+        only_rank=int(orank) if orank else None,
+        kill_process_after=int(kp) if kp else None,
+        only_server=int(osrv) if osrv else None)
 
 
 _arm_from_env()

@@ -37,7 +37,6 @@ import os
 import pickle
 import queue
 import threading
-import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -841,7 +840,7 @@ class KVStoreDistAsync(KVStore):
     norms instead, exactly the reference's striping caveat.
     """
 
-    def __init__(self, uris=None):
+    def __init__(self, uris=None, roster_member=None):
         super().__init__("dist_async")
         if uris is None:
             uris = os.environ.get("MXT_SERVER_URIS", "")
@@ -854,10 +853,59 @@ class KVStoreDistAsync(KVStore):
                 "(MXT_SERVER_URIS is set by the launcher; a serving "
                 "replica passes param_servers= explicitly) — see "
                 "docs/design/kvstore.md")
-        self._conns = [_ServerConn(u) for u in uris.split(",")]
+        from .base import env as _env
+        uri_list = uris.split(",")
+        # -- elastic membership (mxnet_tpu.membership) --------------------
+        # The env uris are only the BOOTSTRAP set: under
+        # MXNET_KVSTORE_ELASTIC the authoritative server list is the
+        # coordinator's roster (generation-numbered; server 0).  A
+        # ``roster_member`` client registers as a live worker rank
+        # (barriers count it, silence evicts it); an observer — the
+        # serving replica's refresh client — follows the roster without
+        # ever joining it.
+        self._elastic = bool(_env("MXNET_KVSTORE_ELASTIC", False))
+        self._roster_member = (self._elastic if roster_member is None
+                               else bool(roster_member)) and self._elastic
+        self._roster_gen = 0
+        self._roster_servers = list(uri_list)
+        self._live_workers = None
+        self._pull_cache: Dict[str, np.ndarray] = {}
+        self._push_log: Dict[str, list] = {}
+        self._push_log_order = None
+        self._push_log_cap = int(_env("MXNET_KVSTORE_ELASTIC_PUSH_LOG",
+                                      256))
+        if self._elastic:
+            import collections
+            self._push_log_order = collections.deque()
+            # dial the coordinator alone first: other bootstrap uris may
+            # already be stale (a late joiner arrives AFTER churn)
+            coord = _ServerConn(uri_list[0])
+            self._conns = [coord]
+            if self._roster_member:
+                reply = coord.submit(
+                    ("roster_join", "worker", self.rank), wait=True)
+            else:
+                reply = coord.submit(("roster_get",), wait=True)
+            gen, servers, workers = reply
+            conns = []
+            for u in servers:
+                conns.append(coord if u == uri_list[0] else _ServerConn(u))
+            if uri_list[0] not in servers:
+                coord.close(retry=False)
+            self._conns = conns
+            self._roster_gen = int(gen)
+            self._roster_servers = list(servers)
+            self._live_workers = list(workers)
+            from . import profiler as _prof
+            _prof.record_channel_gauge("kvstore.roster_generation",
+                                       self._roster_gen)
+        else:
+            self._conns = [_ServerConn(u) for u in uri_list]
         self._bigarray_bound = int(float(os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")))
         self._stripes: Dict[str, list] = {}  # key -> row boundaries
+        self._stripes_nservers = len(self._conns)
+        self._last_moved_keys = set()
         self._closed = False
         # wire compression: error-feedback residuals live worker-side,
         # one per WIRE key (stripes quantize independently).  Env
@@ -885,16 +933,42 @@ class KVStoreDistAsync(KVStore):
 
     @property
     def num_workers(self) -> int:
+        # elastic: the LIVE roster's worker count, not the launch-time
+        # env — joins and evictions move it mid-job
+        if self._elastic and self._live_workers is not None:
+            return max(1, len(self._live_workers))
         return int(os.environ.get("DMLC_NUM_WORKER", "1"))
 
     def _conn_of(self, k: str) -> _ServerConn:
-        return self._conns[zlib.crc32(k.encode()) % len(self._conns)]
+        # routing math lives in membership.server_index — the handoff
+        # planner derives placement from the same function, so the two
+        # can never diverge
+        from .membership import server_index
+        return self._conns[server_index(k, len(self._conns))]
 
     # -- big-array striping --------------------------------------------------
     def _stripe_plan(self, k: str, shape):
         """Row boundaries for a striped key, or None.  Deterministic from
-        (key, shape, num_servers), so every worker computes the identical
-        plan with no coordination."""
+        (key, shape, num_servers) — the math lives in
+        :func:`membership.stripe_plan` so handoff planning and the
+        worker can never diverge — and every worker computes the
+        identical plan with no coordination.
+
+        Plans are cached per key; the cache is valid ONLY for the server
+        count it was derived against.  A server-count change without
+        :meth:`_reset_stripe_plans` is a HARD error: a stale plan routes
+        rows to the wrong servers silently (the elastic roster path
+        clears the cache on every roster bump; nothing else may change
+        the connection list)."""
+        if self._stripes and self._stripes_nservers != len(self._conns):
+            raise MXNetError(
+                "kvstore dist_async: the server count changed "
+                f"({self._stripes_nservers} -> {len(self._conns)}) with "
+                "stripe plans still cached — a stale plan silently "
+                "routes rows to the wrong servers.  Membership changes "
+                "must go through the elastic roster path "
+                "(MXNET_KVSTORE_ELASTIC=1), which calls "
+                "_reset_stripe_plans() on every roster bump")
         if k in self._stripes:
             return self._stripes[k]
         if "@s" in k:
@@ -906,28 +980,319 @@ class KVStoreDistAsync(KVStore):
             raise MXNetError(
                 f"kvstore dist_async: key {k!r} contains the reserved "
                 "stripe separator '@s' — rename the parameter")
-        n = len(self._conns)
-        if (n <= 1 or not shape or len(shape) == 0
-                or int(np.prod(shape)) <= self._bigarray_bound
-                or shape[0] < 2):
-            plan = None
-        else:
-            parts = min(n, shape[0])
-            bounds = [shape[0] * i // parts for i in range(parts + 1)]
-            plan = bounds
+        from . import membership as _mem
+        plan = _mem.stripe_plan(k, shape, len(self._conns),
+                                self._bigarray_bound)
         self._stripes[k] = plan
+        self._stripes_nservers = len(self._conns)
         return plan
+
+    def _reset_stripe_plans(self):
+        """Invalidate every cached stripe plan (the roster changed: row
+        boundaries and owners must re-derive against the live server
+        set).  The elastic path calls this inside ``_apply_roster``."""
+        self._stripes.clear()
+        self._stripes_nservers = len(self._conns)
 
     def _stripe_conn(self, k: str, i: int) -> _ServerConn:
         # consecutive stripes land on consecutive servers, offset by the
         # key hash so different big keys don't all start at server 0
-        base = zlib.crc32(k.encode())
-        return self._conns[(base + i) % len(self._conns)]
+        # (membership.stripe_server_index: shared with handoff planning)
+        from .membership import stripe_server_index
+        return self._conns[stripe_server_index(k, i, len(self._conns))]
+
+    # -- elastic membership (worker half; mxnet_tpu.membership) --------------
+    def _elastic_attempt(self, fn):
+        """Run one kv op; under MXNET_KVSTORE_ELASTIC a channel failure
+        triggers a roster repair (report the dead server, re-derive
+        striping against the surviving set, hand off state, re-push the
+        logged updates a dead server took with it) and ONE retry of the
+        op against the new generation.  Non-elastic behavior is
+        bit-identical to before: the failure propagates."""
+        if not self._elastic:
+            return fn()
+        attempts = 0
+        while True:
+            try:
+                return fn()
+            except MXNetError:
+                attempts += 1
+                if attempts > 2 or not self._elastic_repair():
+                    raise
+
+    def _elastic_repair(self) -> bool:
+        """Converge this worker onto the live roster after a failure.
+        Returns True when anything changed (retry is worth it): a
+        generation bump was applied, or a poisoned-but-alive connection
+        was re-dialed.  The COORDINATOR going down is the one
+        unrecoverable death (v1 contract, docs/ROBUSTNESS.md): repair
+        reports False and the original failure propagates."""
+        from . import profiler as _prof
+        coord = self._conns[0]
+        dead, poisoned = [], []
+        for c in self._conns:
+            if (c._err is not None and c._sock is None) or c.is_dead():
+                dead.append(c)
+            elif c._err is not None:
+                poisoned.append(c)
+        if coord in dead:
+            return False
+        try:
+            reply = None
+            for c in dead:
+                reply = coord.submit(
+                    ("roster_dead", "server", c._uri), wait=True)
+                _prof.record_channel_event("kvstore.eviction_reported")
+            if reply is None:
+                reply = coord.submit(("roster_get",), wait=True)
+        except MXNetError:
+            return False
+        gen, servers, workers = reply
+        if int(gen) == self._roster_gen and not dead and not poisoned:
+            return False
+        try:
+            self._apply_roster(int(gen), servers, workers)
+        except MXNetError as exc:
+            # a roster-listed server died between the coordinator's view
+            # and our dial: report it so the NEXT repair converges on the
+            # shrunken roster, and let the original failure propagate —
+            # aborting the retry here must not strand the conn list
+            # half-applied (it hasn't been: _apply_roster swaps conns
+            # only after every dial succeeded)
+            uri = next((u for u in servers if u in str(exc)), None)
+            if uri is not None:
+                try:
+                    coord.submit(("roster_dead", "server", uri),
+                                 wait=True)
+                except MXNetError:
+                    pass
+            return False
+        return True
+
+    def _elastic_refresh(self):
+        """Pull the roster and converge if it moved (the cheap path a
+        barrier-reply generation bump triggers)."""
+        reply = self._conns[0].submit(("roster_get",), wait=True)
+        gen, servers, workers = reply
+        if int(gen) != self._roster_gen:
+            self._apply_roster(int(gen), servers, workers)
+
+    def _apply_roster(self, gen, servers, workers):
+        """Converge onto roster generation ``gen``: rebuild the
+        connection list in roster order (reusing healthy channels,
+        re-dialing poisoned ones, closing departed ones), invalidate
+        every stripe plan, ship the optimizer to newly-joined servers,
+        then hand off state for every key whose wire layout moved."""
+        from . import membership as _mem
+        from . import profiler as _prof
+        old_servers = list(self._roster_servers)
+        by_uri = {c._uri: c for c in self._conns}
+        conns, fresh = [], []
+        try:
+            for u in servers:
+                c = by_uri.pop(u, None)
+                if c is not None and (c._err is not None or c.is_dead()):
+                    c.close(retry=False)
+                    c = None
+                if c is None:
+                    # short dial budget: a roster-listed server that
+                    # cannot be reached within 10s most likely died
+                    # between the coordinator's view and ours — the
+                    # caller reports it dead and retries on the smaller
+                    # roster instead of blocking a full connect window
+                    c = _ServerConn(u, connect_timeout=10.0)
+                    fresh.append((u, c))
+                conns.append(c)
+        except MXNetError:
+            for _u, c in fresh:
+                c.close(retry=False)
+            raise
+        for c in by_uri.values():
+            c.close(retry=False)
+        self._conns = conns
+        self._roster_gen = int(gen)
+        self._roster_servers = list(servers)
+        self._live_workers = list(workers)
+        self._reset_stripe_plans()
+        self._last_moved_keys = set()
+        _prof.record_channel_event("kvstore.roster_bump")
+        _prof.record_channel_gauge("kvstore.roster_generation",
+                                   self._roster_gen)
+        # a joined-mid-job server has no updater yet: every worker ships
+        # the optimizer (idempotent — same object) before any state or
+        # gradient can reach the new shard
+        if self._optimizer is not None:
+            blob = pickle.dumps(self._optimizer)
+            from .kvstore_server import K_CONTROLLER
+            for _u, c in fresh:
+                if _u not in old_servers:
+                    c.submit(("command", K_CONTROLLER, blob), wait=True)
+        moved = _mem.plan_handoff(
+            {k: v.shape for k, v in self._pull_cache.items()},
+            old_servers, servers, self._bigarray_bound)
+        self._last_moved_keys = set(moved)
+        if moved and self._gc_residual:
+            # compression error-feedback residuals are keyed by WIRE key
+            # and shaped like the OLD stripe spans: under the new layout
+            # a moved key's residual would broadcast-add into the wrong
+            # rows (or crash on shape mismatch).  Dropping it loses at
+            # most one pending quantum per element — the bounded error
+            # class compression already accepts — and the buffer re-grows
+            # from zero on the next push.  Unmoved keys keep identical
+            # wire spans, so their residuals stay valid.
+            moved_set = set(moved)
+            for wk in [w for w in self._gc_residual
+                       if _mem.base_key(w) in moved_set]:
+                del self._gc_residual[wk]
+        if moved:
+            self._handoff(moved, old_servers)
+
+    def _handoff(self, moved, old_servers):
+        """Striped-state handoff after a roster bump, in three ordered
+        phases (docs/ROBUSTNESS.md has the sequence diagram):
+
+        1. **quorum re-push of values** — every worker re-pushes its
+           last-synced full value of each moved key under the NEW
+           layout; the server applies the FIRST arrival per (wire key,
+           generation) and acks the rest idempotently, so the racing
+           duplicates (and replays through connection kills) are
+           harmless.  The applied handoff purges the key's stale wire
+           forms, so in-flight old-layout pushes are absorbed into the
+           reset.
+        2. **optimizer-state restripe** — per-stripe states gathered
+           from the coordinator's snapshot of the departed servers plus
+           ``get_states`` of the survivors, merged and re-sliced along
+           the new plan (exact for elementwise state; a killed server
+           with no banked snapshot degrades to fresh state for its
+           stripes).
+        3. **re-push of logged updates** — each worker re-applies every
+           gradient it pushed since its last pull of a moved key (the
+           updates a SIGKILLed server took to its grave, or that the
+           handoff reset absorbed).  Phases 1+2 are awaited before 3 so
+           re-pushed gradients can never be wiped by a later handoff."""
+        from . import membership as _mem
+        from . import profiler as _prof
+        gen = self._roster_gen
+        servers = self._roster_servers
+        # gather old-layout optimizer state BEFORE any value handoff is
+        # issued: the first value handoff of a key PURGES its stale wire
+        # forms (and their states) on the survivors — collecting after
+        # would read back nothing
+        per_wire = self._collect_handoff_states(moved, old_servers)
+        pendings = []
+        for k in moved:
+            val = self._pull_cache.get(k)
+            if val is None:
+                continue
+            for wk, uri, part in _mem.restripe_value(
+                    k, val, servers, self._bigarray_bound):
+                part = np.ascontiguousarray(part)
+                _prof.record_channel_bytes("handoff", int(part.nbytes))
+                pendings.append(self._conns[servers.index(uri)].request(
+                    ("handoff", gen, wk, part, k)))
+        if per_wire:
+            for k in moved:
+                shape = self._pull_cache[k].shape
+                old_plan = _mem.stripe_plan(k, shape, len(old_servers),
+                                            self._bigarray_bound)
+                new_plan = _mem.stripe_plan(k, shape, len(servers),
+                                            self._bigarray_bound)
+                restriped = _mem.restripe_states(k, per_wire, old_plan,
+                                                 new_plan)
+                layout = _mem.wire_layout(k, shape, servers,
+                                          self._bigarray_bound)
+                for wk, st in restriped.items():
+                    uri = layout[wk][0]
+                    pendings.append(
+                        self._conns[servers.index(uri)].request(
+                            ("handoff_state", gen, wk, st, k)))
+        for p in pendings:
+            _await(p)
+        _prof.record_channel_event("kvstore.handoff_round")
+        for k in moved:
+            for grad in self._push_log.get(k, []):
+                _prof.record_channel_event("kvstore.orphan_repush")
+                self._route_push(k, grad)
+
+    def _collect_handoff_states(self, moved, old_servers):
+        """{old wire key: np state} for the moved keys: the departed
+        servers' stripes from the coordinator's banked snapshots, the
+        survivors' from a live ``get_states``.  Returns {} when no
+        optimizer is installed (nothing to restripe)."""
+        from .kvstore_server import _restricted_loads, _state_to_np
+        departed = [u for u in old_servers
+                    if u not in self._roster_servers]
+        per_wire = {}
+        for u in departed:
+            try:
+                snap = self._conns[0].submit(("roster_snapshot", u),
+                                             wait=True)
+            except MXNetError:
+                snap = None
+            if snap:
+                for wk, st in snap.get("states", {}).items():
+                    per_wire[str(wk)] = st
+        have_updater = False
+        for c in self._conns:
+            try:
+                blob = c.submit(("get_states", False), wait=True)
+            except MXNetError:
+                continue
+            if blob is None:
+                continue
+            have_updater = True
+            for wk, st in _restricted_loads(blob).items():
+                per_wire[str(wk)] = _state_to_np(st)
+        return per_wire if have_updater else {}
+
+    def _route_push(self, k: str, agg: np.ndarray):
+        """Send one (possibly compressed) push of a full gradient under
+        the CURRENT stripe plan — the shared tail of push() and the
+        orphan re-push."""
+        plan = self._stripe_plan(k, agg.shape)
+        if plan is None:
+            self._conn_of(k).submit(
+                ("push", k, self._wire_push_payload(k, agg)), wait=False)
+        else:
+            for i in range(len(plan) - 1):
+                wk = f"{k}@s{i}"
+                self._stripe_conn(k, i).submit(
+                    ("push", wk, self._wire_push_payload(
+                        wk, agg[plan[i]:plan[i + 1]])),
+                    wait=False)
+
+    def _cache_value(self, k: str, arr):
+        """Remember the last synced full value of ``k`` (the quorum
+        re-push source) and forget the now-absorbed push log."""
+        if not self._elastic:
+            return
+        self._pull_cache[k] = np.asarray(arr)
+        self._push_log.pop(k, None)
+
+    def _log_push(self, k: str, agg: np.ndarray):
+        """Remember one pushed gradient until the next pull of ``k``
+        syncs it into the cache (bounded by
+        MXNET_KVSTORE_ELASTIC_PUSH_LOG entries; the oldest fall off —
+        best-effort for jobs that never pull)."""
+        if not self._elastic:
+            return
+        self._push_log.setdefault(k, []).append(np.asarray(agg))
+        self._push_log_order.append(k)
+        while len(self._push_log_order) > self._push_log_cap:
+            old = self._push_log_order.popleft()
+            entries = self._push_log.get(old)
+            if entries:
+                entries.pop(0)
+                if not entries:
+                    self._push_log.pop(old, None)
 
     # -- kv ops --------------------------------------------------------------
     def init(self, key, value):
         """First-arriving init wins at the server (all workers call init;
         the server keeps one authoritative value)."""
+        self._elastic_attempt(lambda: self._init_impl(key, value))
+
+    def _init_impl(self, key, value):
         keys, values = self._canon(key, value)
         for k, vs in zip(keys, values):
             arr = np.asarray(vs[0].asnumpy())
@@ -941,6 +1306,7 @@ class KVStoreDistAsync(KVStore):
                     for i in range(len(plan) - 1)]
                 for p in pendings:
                     _await(p)
+            self._cache_value(k, arr)
 
     def _wire_push_payload(self, wire_key, arr):
         """Compress one push payload when compression is on (2bit keeps
@@ -966,11 +1332,22 @@ class KVStoreDistAsync(KVStore):
         A LIST push coalesces small keys bound for the same server into
         ONE multi-key envelope (``MXNET_KVSTORE_COALESCE_BYTES`` per-key
         bound) — small tensors stop paying a whole frame+ack each, the
-        comms analog of the reference's per-key engine-op batching."""
+        comms analog of the reference's per-key engine-op batching.
+
+        Elastic note: push is fire-and-forget, so it must NOT be blanket-
+        retried (earlier keys of this call may already sit in healthy
+        server queues — a retry would double-apply them).  Instead the
+        call is planned first and submitted second: a submit that hits a
+        failed channel repairs the roster, then re-routes only the
+        REMAINING entries — entries for keys whose layout moved are
+        skipped, because the repair already re-pushed them from the push
+        log."""
         keys, values = self._canon(key, value)
         small: Dict[int, list] = {}   # conn index -> [(wire_key, payload)]
+        planned = []                  # (base_key, conn, msg)
         for k, vs in zip(keys, values):
             agg = np.asarray(self._reduce(vs))
+            self._log_push(k, agg)
             plan = self._stripe_plan(k, agg.shape)
             if plan is None:
                 payload = self._wire_push_payload(k, agg)
@@ -981,27 +1358,65 @@ class KVStoreDistAsync(KVStore):
                     small.setdefault(self._conns.index(conn), []).append(
                         (k, payload))
                 else:
-                    conn.submit(("push", k, payload), wait=False)
+                    planned.append((k, conn, ("push", k, payload)))
             else:
                 for i in range(len(plan) - 1):
                     wk = f"{k}@s{i}"
-                    self._stripe_conn(k, i).submit(
-                        ("push", wk, self._wire_push_payload(
-                            wk, agg[plan[i]:plan[i + 1]])),
-                        wait=False)
+                    planned.append((k, self._stripe_conn(k, i), (
+                        "push", wk, self._wire_push_payload(
+                            wk, agg[plan[i]:plan[i + 1]]))))
         for ci, entries in small.items():
             if len(entries) == 1:
-                self._conns[ci].submit(
-                    ("push", entries[0][0], entries[0][1]), wait=False)
+                planned.append((entries[0][0], self._conns[ci],
+                                ("push", entries[0][0], entries[0][1])))
             else:
-                self._conns[ci].submit(("push_multi", entries),
-                                       wait=False)
+                planned.append((None, self._conns[ci],
+                                ("push_multi", entries)))
+        self._submit_planned(planned)
+
+    def _submit_planned(self, planned):
+        """Submit planned push envelopes; on a channel failure in
+        elastic mode, repair once and re-route the remainder under the
+        new layout (moved keys skipped — the repair's log re-push owns
+        them)."""
+        for idx, (_k, conn, msg) in enumerate(planned):
+            try:
+                conn.submit(msg, wait=False)
+            except MXNetError:
+                if not self._elastic or not self._elastic_repair():
+                    raise
+                self._reroute_planned(planned[idx:])
+                return
+
+    def _reroute_planned(self, rest):
+        """Re-route the unsent tail of a push call after a repair.  Keys
+        the repair moved are dropped here (their full logged gradients
+        were already re-pushed under the new layout); unmoved keys keep
+        their wire keys and go to the same URI's fresh channel."""
+        moved = self._last_moved_keys
+        for k, _old_conn, msg in rest:
+            if msg[0] == "push_multi":
+                for ek, payload in msg[1]:
+                    if ek not in moved:
+                        self._conn_of(ek).submit(("push", ek, payload),
+                                                 wait=False)
+            elif k not in moved:
+                wk = msg[1]
+                if "@s" in wk:
+                    base, i = wk.rsplit("@s", 1)
+                    self._stripe_conn(base, int(i)).submit(msg, wait=False)
+                else:
+                    self._conn_of(wk).submit(msg, wait=False)
 
     def assign(self, key, value):
         """Store value(s) verbatim on the owning server(s) — bypasses
         the installed updater (see :meth:`KVStore.assign`).  Awaited:
         when this returns, every later ``pull`` observes the value (the
-        serving version-bump publication contract)."""
+        serving version-bump publication contract).  Idempotent, so the
+        elastic path may retry it whole after a roster repair."""
+        self._elastic_attempt(lambda: self._assign_impl(key, value))
+
+    def _assign_impl(self, key, value):
         keys, values = self._canon(key, value)
         pendings = []
         for k, vs in zip(keys, values):
@@ -1014,6 +1429,7 @@ class KVStoreDistAsync(KVStore):
                     self._stripe_conn(k, i).request(
                         ("assign", f"{k}@s{i}", arr[plan[i]:plan[i + 1]]))
                     for i in range(len(plan) - 1))
+            self._cache_value(k, arr)
         for p in pendings:
             _await(p)
 
@@ -1024,7 +1440,12 @@ class KVStoreDistAsync(KVStore):
         All requests are enqueued before any reply is awaited, so an
         N-key pull over S servers costs ~max-RTT, not N round trips
         (the reference gets the same overlap from engine-async ZPull);
-        striped keys fetch every row-slice concurrently."""
+        striped keys fetch every row-slice concurrently.  Idempotent —
+        the elastic path retries it whole after a roster repair."""
+        self._elastic_attempt(
+            lambda: self._pull_impl(key, out, ignore_sparse))
+
+    def _pull_impl(self, key, out, ignore_sparse):
         import jax.numpy as jnp
         assert out is not None
         keys, outs = self._canon(key, out)
@@ -1040,11 +1461,21 @@ class KVStoreDistAsync(KVStore):
                     self._stripe_conn(k, i).request(("pull", f"{k}@s{i}"))
                     for i in range(len(plan) - 1)])
         for k, os_, pending in zip(keys, outs, pendings):
+            # cache from the HOST-side wire replies before converting to
+            # jnp: caching the device array instead would cost an extra
+            # unrecorded device->host readback per key per pull in
+            # elastic mode (the sync-free gates exist to prevent exactly
+            # that class of regrowth)
             if isinstance(pending, list):
-                val = jnp.concatenate(
-                    [jnp.asarray(_await(p)) for p in pending], axis=0)
+                val_np = np.concatenate(
+                    [np.asarray(_await(p)) for p in pending], axis=0)
             else:
-                val = jnp.asarray(_await(pending))
+                val_np = np.asarray(_await(pending))
+            # the completed pull is this worker's sync point for k: the
+            # cache becomes the quorum re-push value, and every logged
+            # push up to here is absorbed into it
+            self._cache_value(k, val_np)
+            val = jnp.asarray(val_np)
             for o in os_:
                 o._set_data(val.astype(o._data.dtype)
                             if o._data.dtype != val.dtype else val)
@@ -1055,6 +1486,10 @@ class KVStoreDistAsync(KVStore):
         kvstore_dist_server.h:211).  Same out-array semantics as the
         local store: RowSparseNDArray gets values+indices, dense gets a
         scatter.  Requests pipeline like pull."""
+        self._elastic_attempt(
+            lambda: self._row_sparse_pull_impl(key, out, row_ids))
+
+    def _row_sparse_pull_impl(self, key, out, row_ids):
         import jax.numpy as jnp
         assert out is not None and row_ids is not None
         keys, outs = self._canon(key, out)
@@ -1103,14 +1538,27 @@ class KVStoreDistAsync(KVStore):
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the servers (reference kvstore.py:353:
         rank 0 pickles it; _send_command_to_servers head=0), then barrier
-        so every worker sees the installed updater before pushing."""
+        so every worker sees the installed updater before pushing.
+        Idempotent (same blob), so the elastic path retries it whole —
+        and every worker KEEPS the optimizer so a server joining later
+        can be armed during roster repair."""
         self._optimizer = optimizer
-        if self.rank == 0:
-            blob = pickle.dumps(optimizer)
-            from .kvstore_server import K_CONTROLLER
-            for c in self._conns:
-                c.submit(("command", K_CONTROLLER, blob), wait=True)
+        self._elastic_attempt(lambda: self._ship_optimizer(optimizer))
         self.barrier()
+
+    def _ship_optimizer(self, optimizer):
+        if self.rank != 0 and not self._elastic:
+            return
+        if self.rank != 0 and self._elastic:
+            # non-zero ranks still ship nothing at install time (rank 0
+            # owns it, reference semantics) — they only re-arm JOINED
+            # servers during repair, where every worker races
+            # idempotently
+            return
+        blob = pickle.dumps(optimizer)
+        from .kvstore_server import K_CONTROLLER
+        for c in self._conns:
+            c.submit(("command", K_CONTROLLER, blob), wait=True)
 
     def _send_command_to_servers(self, head, body):
         for c in self._conns:
@@ -1190,11 +1638,25 @@ class KVStoreDistAsync(KVStore):
         """Flush this worker's outstanding pushes, then rendezvous on
         server 0 (reference: Postoffice::Barrier after engine drain).
         The wait is unbounded, but a participant that dies mid-wait is
-        NAMED: the server fails the barrier for everyone else once the
-        missing rank's heartbeat goes silent past the timeout."""
+        NAMED — with its last-heartbeat age — in the static-roster
+        failure; under MXNET_KVSTORE_ELASTIC the barrier RENEGOTIATES
+        instead: the coordinator evicts the silent rank, re-targets the
+        live worker count and wakes the parked survivors, and the reply
+        carries the roster generation so a bump is discovered (and
+        converged onto) at every sync point for free."""
+        # the flush is idempotent (a no-op command per channel), so a
+        # channel death here repairs and retries cleanly; the barrier
+        # submit itself is NOT retried — the coordinator channel dying
+        # is the unrecoverable case anyway
+        self._elastic_attempt(self._flush_all)
+        payload = self._conns[0].submit(("barrier",), wait=True)
+        if self._elastic and isinstance(payload, int) \
+                and payload != self._roster_gen:
+            self._elastic_refresh()
+
+    def _flush_all(self):
         for c in self._conns:
             c.flush()
-        self._conns[0].submit(("barrier",), wait=True)
 
     def num_dead_nodes(self) -> int:
         """Number of server channels whose heartbeat has gone silent
@@ -1206,6 +1668,14 @@ class KVStoreDistAsync(KVStore):
     def close(self, stop_servers=False):
         from .kvstore_server import K_STOP_SERVER
         self._closed = True
+        if self._roster_member:
+            # graceful departure: deregister so the surviving workers'
+            # barriers re-target without waiting out a heartbeat timeout
+            try:
+                self._conns[0].submit(
+                    ("roster_leave", "worker", self.rank), wait=True)
+            except MXNetError:
+                pass  # the coordinator will evict us on silence instead
         # deliver queued pushes while the servers are still guaranteed up
         for c in self._conns:
             try:

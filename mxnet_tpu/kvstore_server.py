@@ -317,7 +317,8 @@ class KVStoreServer:
     """
 
     def __init__(self, server_id=0, num_workers=1,
-                 host="127.0.0.1", port=0, hb_timeout=None):
+                 host="127.0.0.1", port=0, hb_timeout=None,
+                 elastic=None, uri=None, roster_servers=None):
         self.server_id = server_id
         self.num_workers = num_workers
         self._store = {}          # key -> NDArray (host CPU)
@@ -372,6 +373,33 @@ class KVStoreServer:
         # the LAST resort in _handle, so an extension can never shadow a
         # core op.
         self._ext_ops = {}
+        # -- elastic membership (mxnet_tpu.membership) --------------------
+        # Server 0 of the roster is the COORDINATOR: it owns the
+        # generation-numbered membership ledger, renegotiates barriers
+        # when a rank is evicted, and banks non-coordinator servers'
+        # periodic state snapshots (the killed-server recovery source).
+        # Non-coordinator elastic servers run a beat loop toward the
+        # coordinator instead.
+        self._elastic = bool(_env("MXNET_KVSTORE_ELASTIC", False)
+                             if elastic is None else elastic)
+        self.uri = uri or f"{host}:{self.port}"
+        # the coordinator ledger is created LAZILY (first roster op /
+        # first barrier): in-process tests only know every server's
+        # bound port — and can set MXT_SERVER_URIS — after construction
+        self._membership = None
+        self._membership_lock = threading.Lock()
+        self._roster_servers = list(roster_servers) if roster_servers \
+            else None
+        self._beat_thread = None
+        self._beat_seq = 0
+        self._snapshot_s = float(_env("MXNET_KVSTORE_SNAPSHOT_S", 0.0))
+        # handoff dedup: wire key -> newest applied roster generation
+        # (values), same for optimizer state; base key -> generation the
+        # stale wire forms were purged at.  Quorum re-pushes and
+        # replayed envelopes are idempotent through these.
+        self._handoff_gen = {}
+        self._handoff_state_gen = {}
+        self._handoff_base_gen = {}
 
     def register_op(self, op: str, fn) -> None:
         """Register an extension envelope type: ``fn(msg, rank) ->
@@ -380,7 +408,10 @@ class KVStoreServer:
         built-in ops; core op names are reserved."""
         if op in ("ping", "init", "push", "push_multi", "pull",
                   "pull_rows", "assign", "get_states", "set_states",
-                  "command", "barrier", "req"):
+                  "command", "barrier", "req", "roster_get",
+                  "roster_join", "roster_leave", "roster_dead",
+                  "roster_beat", "roster_snapshot", "handoff",
+                  "handoff_state"):
             raise ValueError(f"cannot override core kvstore op {op!r}")
         self._ext_ops[op] = fn
 
@@ -511,8 +542,33 @@ class KVStoreServer:
             _, head, body = msg
             return self._command(head, body)
         if op == "barrier":
-            self._barrier(rank)
-            return None
+            return self._barrier(rank)
+        if op == "roster_get":
+            return self._roster_get()
+        if op in ("roster_join", "roster_leave", "roster_dead"):
+            _, role, ident = msg
+            return self._roster_mutate(op[len("roster_"):], role, ident)
+        if op == "roster_beat":
+            # a non-coordinator server's liveness beat, optionally
+            # carrying its state snapshot (raw message: beats must never
+            # be stalled by a delay-acks fault plan, like heartbeats)
+            _, suri, seq, snap = msg
+            m = self._get_membership()
+            if m is None:
+                return None
+            m.note_server_beat(suri, seq=seq, snapshot=snap)
+            return m.generation
+        if op == "roster_snapshot":
+            _, ident = msg
+            m = self._require_membership()
+            return m.snapshot_of(ident)
+        if op == "handoff":
+            _, gen, wire_key, arr, bkey = msg
+            return self._apply_handoff(int(gen), wire_key, arr, bkey)
+        if op == "handoff_state":
+            _, gen, wire_key, state, bkey = msg
+            return self._apply_handoff_state(int(gen), wire_key, state,
+                                             bkey)
         ext = self._ext_ops.get(op)
         if ext is not None:
             return ext(msg, rank)
@@ -584,8 +640,186 @@ class KVStoreServer:
         if self._hb_timeout <= 0:
             return set()
         now = time.monotonic()
+        live = self._live_worker_ranks()
         return {r for r, t in self._hb_seen.items()
-                if r < self.num_workers and now - t > self._hb_timeout}
+                if r in live and now - t > self._hb_timeout}
+
+    def _live_worker_ranks(self):
+        m = self._get_membership()
+        if m is not None:
+            return set(m.workers_snapshot())
+        return set(range(self.num_workers))
+
+    def _heartbeat_ages(self, ranks):
+        """Per-rank last-heartbeat age, for barrier failures that must
+        carry EVIDENCE, not just rank ids.  Caller holds _barrier_cv."""
+        now = time.monotonic()
+        parts = []
+        for r in sorted(ranks):
+            t = self._hb_seen.get(r)
+            parts.append("rank %s: %s" % (
+                r, "never heard from" if t is None
+                else "last heartbeat %.1fs ago" % (now - t)))
+        return "; ".join(parts)
+
+    # -- elastic membership (coordinator half; mxnet_tpu.membership) ---------
+    def _get_membership(self):
+        """The coordinator ledger — server 0 of an elastic roster only
+        (lazily created so in-process tests can bind ports and set
+        MXT_SERVER_URIS before the first roster op arrives)."""
+        if not self._elastic or self.server_id != 0:
+            return None
+        with self._membership_lock:
+            if self._membership is None:
+                uris = self._roster_servers or \
+                    [u for u in os.environ.get(
+                        "MXT_SERVER_URIS", "").split(",") if u] or \
+                    [self.uri]
+                from .membership import MembershipCoordinator
+                self._membership = MembershipCoordinator(
+                    uris, range(self.num_workers))
+            return self._membership
+
+    def _require_membership(self):
+        m = self._get_membership()
+        if m is None:
+            raise RuntimeError(
+                "not the roster coordinator (roster ops go to server 0 "
+                "of an elastic job; set MXNET_KVSTORE_ELASTIC=1)")
+        return m
+
+    def _evict_silent_servers(self, m):
+        """Coordinator-driven server eviction: a server whose beat went
+        silent past hb_timeout is removed from the roster (the worker-
+        report path converges to the same state; both are idempotent)."""
+        for u in m.silent_servers(self._hb_timeout):
+            try:
+                m.report_dead_server(u)
+            except RuntimeError:
+                continue   # the last server is never evicted
+            _prof.record_channel_event("kvstore.server_eviction")
+            _prof.record_channel_gauge("kvstore.roster_generation",
+                                       m.generation)
+
+    def _roster_get(self):
+        m = self._require_membership()
+        self._evict_silent_servers(m)
+        return m.roster().as_wire()
+
+    def _roster_mutate(self, action, role, ident):
+        """join/leave/dead for either role; returns the FULL post-change
+        roster so the caller refreshes in the same round trip.  All
+        mutations are idempotent — racing duplicate reports of one dead
+        server collapse into a single generation bump."""
+        m = self._require_membership()
+        before = m.generation
+        if role == "server":
+            uri = str(ident)
+            if action == "join":
+                m.join_server(uri)
+            elif action == "leave":
+                m.leave_server(uri)
+            else:
+                m.report_dead_server(uri)
+        elif role == "worker":
+            rank = int(ident)
+            if action == "join":
+                m.join_worker(rank)
+            elif action == "leave":
+                m.leave_worker(rank)
+                with self._barrier_cv:
+                    self._hb_seen.pop(rank, None)
+            else:
+                m.evict_worker(rank)
+                with self._barrier_cv:
+                    self._hb_seen.pop(rank, None)
+        else:
+            raise ValueError(f"unknown roster role {role!r}")
+        after = m.generation
+        if after != before:
+            if action == "dead":
+                _prof.record_channel_event(
+                    "kvstore.server_eviction" if role == "server"
+                    else "kvstore.worker_eviction")
+            _prof.record_channel_gauge("kvstore.roster_generation", after)
+            with self._barrier_cv:
+                # membership changed: parked barrier waiters must
+                # re-evaluate their target against the new roster
+                self._barrier_release_locked()
+                self._barrier_cv.notify_all()
+        return m.roster().as_wire()
+
+    def _apply_handoff(self, gen, wire_key, arr, bkey):
+        """Install a handed-off VALUE (the workers' quorum re-push, or a
+        snapshot restripe).  First delivery per (wire_key, generation)
+        wins; duplicates — every worker races to hand off the same
+        bytes, and replays ride the exactly-once envelope on top — are
+        acked without re-applying.  The first handoff of a logical key
+        in a generation purges that key's stale wire forms (old stripe
+        keys / whole-key form) plus their optimizer state, so a
+        re-striped layout never leaves orphans behind."""
+        from .ndarray import NDArray
+        import jax.numpy as jnp
+        if isinstance(arr, WirePayload):
+            arr = _decompress(arr)
+        with self._lock:
+            if gen <= self._handoff_gen.get(wire_key, -1):
+                _prof.record_channel_event("kvstore.handoff_dup")
+                return False
+            if self._handoff_base_gen.get(bkey, -1) < gen:
+                self._handoff_base_gen[bkey] = gen
+                stale = [k for k in self._store
+                         if k == bkey or k.startswith(bkey + "@s")]
+                for k in stale:
+                    del self._store[k]
+                    if self._updater is not None:
+                        self._updater.states.pop(_key_int(k), None)
+                        self._updater.states_synced.pop(_key_int(k), None)
+            self._handoff_gen[wire_key] = gen
+            self._store[wire_key] = NDArray(jnp.asarray(arr))
+        _prof.record_channel_event("kvstore.handoff_applied")
+        return True
+
+    def _apply_handoff_state(self, gen, wire_key, state, bkey):
+        """Install handed-off OPTIMIZER STATE for one wire key (from the
+        coordinator's snapshot of the departed server, restriped by the
+        handing-off worker).  Same first-per-generation dedup as value
+        handoff; a None state clears the slot so the optimizer re-creates
+        fresh state (the non-row-decomposable fallback)."""
+        idx = _key_int(wire_key)
+        with self._lock:
+            if self._updater is None:
+                return False
+            if gen <= self._handoff_state_gen.get(wire_key, -1):
+                _prof.record_channel_event("kvstore.handoff_dup")
+                return False
+            self._handoff_state_gen[wire_key] = gen
+            st = _state_to_nd(state)
+            if st is None:
+                self._updater.states.pop(idx, None)
+                self._updater.states_synced.pop(idx, None)
+            else:
+                self._updater.states[idx] = st
+                self._updater.states_synced[idx] = True
+        _prof.record_channel_event("kvstore.handoff_state_applied")
+        return True
+
+    def _snapshot_struct(self):
+        """This shard's full state as a wire structure ({wire_key: np
+        value} + per-key optimizer state) — what the beat loop ships to
+        the coordinator so a SIGKILL does not take the shard's optimizer
+        state to its grave.  Rides the zero-copy frames (np arrays never
+        pass through pickle)."""
+        with self._lock:
+            store = {k: np.asarray(v.asnumpy())
+                     for k, v in self._store.items()}
+            states = {}
+            if self._updater is not None:
+                owned = {_key_int(k) for k in self._store}
+                for k, st in self._updater.states.items():
+                    if k in owned:
+                        states[str(k)] = _state_to_np(st)
+        return {"store": store, "states": states}
 
     def _command(self, head, body):
         """reference kvstore_dist_server.h:149-162 ``CommandHandle``."""
@@ -603,41 +837,188 @@ class KVStoreServer:
             return None
         return None  # kSyncMode etc.: accepted, no-op in the async server
 
+    def _barrier_target(self):
+        """How many arrivals release the barrier.  Elastic coordinator:
+        the LIVE roster's worker count (re-read every evaluation, so an
+        eviction mid-wait shrinks the target); otherwise the static
+        num_workers.  Caller holds _barrier_cv."""
+        m = self._get_membership()
+        if m is not None:
+            return max(1, len(m.workers_snapshot()))
+        return self.num_workers
+
+    def _barrier_release_locked(self):
+        """Release the barrier if the arrival count meets the (possibly
+        just-shrunk) target.  Caller holds _barrier_cv."""
+        if self._barrier_count < self._barrier_target() \
+                or self._barrier_count <= 0:
+            return False
+        self._barrier_count = 0
+        self._barrier_gen += 1
+        self._barrier_ranks = set()
+        self._barrier_cv.notify_all()
+        return True
+
     def _barrier(self, rank=None):
-        """Count one arrival per worker; release everyone when all
-        ``num_workers`` are in (reference: Postoffice::Barrier).
+        """Count one arrival per worker; release everyone when every
+        live worker is in (reference: Postoffice::Barrier).
 
         The wait itself stays UNBOUNDED (a slow worker is legal) — but
         when the heartbeat registry shows a missing rank went SILENT
-        past hb_timeout, the wait fails naming the dead ranks instead of
-        blocking the surviving workers forever."""
+        past hb_timeout:
+
+        * **static roster** — the wait fails naming the dead ranks AND
+          each one's last-heartbeat age (operators get evidence, not
+          just ids);
+        * **elastic coordinator** — the barrier RENEGOTIATES instead of
+          failing: the silent rank is evicted (generation bump), the
+          target re-reads the live roster, and the parked survivors are
+          released the moment the shrunken target is met.  Returns the
+          roster generation so workers piggyback bump discovery on every
+          barrier.  An evicted rank that was merely slow and arrives
+          later is re-admitted (join, another bump) — its arrival must
+          not corrupt the count."""
         with self._barrier_cv:
+            m = self._get_membership()
+            if m is not None and rank is not None \
+                    and rank not in m.workers_snapshot():
+                m.join_worker(rank)
+                _prof.record_channel_gauge("kvstore.roster_generation",
+                                           m.generation)
             gen = self._barrier_gen
             if rank is not None:
                 self._barrier_ranks.add(rank)
             self._barrier_count += 1
-            if self._barrier_count >= self.num_workers:
-                self._barrier_count = 0
-                self._barrier_gen += 1
-                self._barrier_ranks = set()
-                self._barrier_cv.notify_all()
-                return
+            if self._barrier_release_locked():
+                return self._barrier_payload()
             while self._barrier_gen == gen and not self._stop.is_set():
                 self._barrier_cv.wait(0.1)
                 if self._barrier_gen != gen or self._stop.is_set():
                     break
                 silent = self._silent_ranks() - self._barrier_ranks
-                if silent:
-                    arrived = sorted(self._barrier_ranks)
-                    # unwind this arrival so a later retry re-enters
-                    # cleanly once the dead rank is replaced
-                    self._barrier_count -= 1
-                    if rank is not None:
-                        self._barrier_ranks.discard(rank)
-                    raise RuntimeError(
-                        "barrier timed out: worker rank(s) %s missing "
-                        "(no heartbeat for > %.1fs); arrived rank(s): %s"
-                        % (sorted(silent), self._hb_timeout, arrived))
+                if not silent:
+                    continue
+                if m is not None:
+                    for r in sorted(silent):
+                        m.evict_worker(r)
+                        self._hb_seen.pop(r, None)
+                        _prof.record_channel_event(
+                            "kvstore.worker_eviction")
+                    _prof.record_channel_gauge(
+                        "kvstore.roster_generation", m.generation)
+                    if self._barrier_release_locked():
+                        return self._barrier_payload()
+                    continue
+                arrived = sorted(self._barrier_ranks)
+                ages = self._heartbeat_ages(silent)
+                # unwind this arrival so a later retry re-enters
+                # cleanly once the dead rank is replaced
+                self._barrier_count -= 1
+                if rank is not None:
+                    self._barrier_ranks.discard(rank)
+                raise RuntimeError(
+                    "barrier timed out: worker rank(s) %s missing "
+                    "(no heartbeat for > %.1fs; %s); arrived rank(s): %s"
+                    % (sorted(silent), self._hb_timeout, ages, arrived))
+            return self._barrier_payload()
+
+    def _barrier_payload(self):
+        """Barrier replies carry the roster generation on an elastic
+        coordinator (None otherwise) — the zero-extra-RTT way workers
+        learn of roster bumps at every sync point.  Caller holds
+        _barrier_cv."""
+        m = self._get_membership()
+        return None if m is None else m.generation
+
+    # -- elastic beat loop (non-coordinator half) ----------------------------
+    def _coordinator_addr(self):
+        """(host, port) of roster server 0, or None.  Resolved lazily
+        from the ctor roster / MXT_SERVER_URIS (in-process tests set the
+        env after binding ports)."""
+        uris = self._roster_servers or \
+            [u for u in os.environ.get("MXT_SERVER_URIS", "").split(",")
+             if u]
+        if not uris or uris[0] == self.uri:
+            return None
+        host, port = uris[0].rsplit(":", 1)
+        return (host, int(port))
+
+    def _beat_loop(self):
+        """Non-coordinator elastic servers beat the coordinator on their
+        own socket (liveness) and piggyback a full state snapshot every
+        MXNET_KVSTORE_SNAPSHOT_S seconds (the killed-server recovery
+        source).  A missed beat IS the signal — the coordinator evicts
+        on silence — so faults here are swallowed and the socket
+        re-dialed next tick."""
+        import socket as _socket
+        interval = float(_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 5.0))
+        if interval <= 0:
+            interval = 5.0
+        last_snap = None
+        sock = None
+        while not self._stop.is_set():
+            addr = self._coordinator_addr()
+            if addr is not None:
+                snap = None
+                now = time.monotonic()
+                if self._snapshot_s > 0 and (
+                        last_snap is None
+                        or now - last_snap >= self._snapshot_s):
+                    snap = self._snapshot_struct()
+                try:
+                    if sock is None:
+                        sock = _socket.create_connection(
+                            addr, timeout=self._hb_timeout or 15.0)
+                        sock.settimeout(self._hb_timeout or 15.0)
+                    self._beat_seq += 1
+                    _send_msg(sock, ("roster_beat", self.uri,
+                                     self._beat_seq, snap))
+                    status, _payload = _recv_msg(sock)
+                    if status == "ok" and snap is not None:
+                        last_snap = now
+                except Exception:  # noqa: BLE001 — the miss IS the signal
+                    _prof.record_channel_event("kvstore.beat_miss")
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        sock = None
+            self._stop.wait(min(interval, self._snapshot_s)
+                            if self._snapshot_s > 0 else interval)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def leave(self):
+        """GRACEFUL departure (scale-down, planned preemption): ship one
+        final state snapshot to the coordinator, deregister from the
+        roster (generation bump — workers re-stripe and hand the state
+        back out at their next sync point), then stop serving.  The
+        kill-path twin — SIGKILL, no goodbye — is what the periodic
+        snapshot exists for."""
+        import socket as _socket
+        addr = self._coordinator_addr()
+        if addr is not None:
+            try:
+                sock = _socket.create_connection(addr, timeout=15.0)
+                sock.settimeout(15.0)
+                try:
+                    self._beat_seq += 1
+                    _send_msg(sock, ("roster_beat", self.uri,
+                                     self._beat_seq,
+                                     self._snapshot_struct()))
+                    _recv_msg(sock)
+                    _send_msg(sock, ("roster_leave", "server", self.uri))
+                    _recv_msg(sock)
+                finally:
+                    sock.close()
+            except Exception:  # noqa: BLE001 — departing anyway; the
+                # coordinator will evict us on beat silence instead
+                _prof.record_channel_event("kvstore.beat_miss")
+        self.stop()
 
     # -- connection plumbing -------------------------------------------------
     def _serve_conn(self, conn):
@@ -673,11 +1054,20 @@ class KVStoreServer:
                         # replay on the new connection is acked from
                         # cache — drop this connection only
                         return
+                    if role == "server":
+                        # enveloped replies only: the deterministic ack
+                        # count behind the process-level kill point
+                        faultinject.server_replied()
         except Exception:  # noqa: BLE001 — conn died mid-reply
             pass
 
     def run(self):
         """Blocking accept loop; returns after a kStopServer command."""
+        if self._elastic and self.server_id != 0 \
+                and self._beat_thread is None:
+            self._beat_thread = threading.Thread(target=self._beat_loop,
+                                                 daemon=True)
+            self._beat_thread.start()
         try:
             while not self._stop.is_set():
                 try:
@@ -727,6 +1117,36 @@ def _key_int(k):
         return k
 
 
+def _state_to_np(state):
+    """Optimizer state → plain numpy for the snapshot/handoff wire
+    (rides the zero-copy frames; non-array state is not
+    row-decomposable and maps to None — see membership.restripe_states)."""
+    from .ndarray import NDArray
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return np.asarray(state.asnumpy())
+    if isinstance(state, np.ndarray):
+        return state
+    if isinstance(state, (tuple, list)):
+        return tuple(_state_to_np(s) for s in state)
+    return None
+
+
+def _state_to_nd(state):
+    """Wire numpy state → the NDArray shapes Updater stores."""
+    from .ndarray import NDArray
+    import jax.numpy as jnp
+    if state is None:
+        return None
+    if isinstance(state, np.ndarray):
+        return NDArray(jnp.asarray(state))
+    if isinstance(state, (tuple, list)):
+        parts = tuple(_state_to_nd(s) for s in state)
+        return None if all(p is None for p in parts) else parts
+    return None
+
+
 def _init_kvstore_server_module():
     """Turn a ``DMLC_ROLE=server`` process into a blocking server, then
     exit — the reference hook verbatim (python/mxnet/kvstore_server.py:75:
@@ -751,7 +1171,7 @@ def _init_kvstore_server_module():
     sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
     uris = os.environ.get("MXT_SERVER_URIS", "")
     num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    host, port = "127.0.0.1", 0
+    host, port, my = "127.0.0.1", 0, None
     if uris:
         my = uris.split(",")[sid]
         host, port = my.rsplit(":", 1)
@@ -763,8 +1183,11 @@ def _init_kvstore_server_module():
         # see module docstring)
         if host not in ("127.0.0.1", "localhost"):
             host = "0.0.0.0"
+    # identity on the roster = the ADVERTISED uri (the bind host may be
+    # 0.0.0.0 in ssh mode; workers and the coordinator know us by the
+    # launcher-assigned address)
     server = KVStoreServer(server_id=sid, num_workers=num_workers,
-                           host=host, port=port)
+                           host=host, port=port, uri=my)
     print(f"kvstore server {sid} listening on port {server.port}",
           flush=True)
     server.run()

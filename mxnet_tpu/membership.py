@@ -1,0 +1,342 @@
+"""Elastic membership: a versioned roster for the dist_async cluster.
+
+The transport already had every primitive needed to survive roster
+churn — heartbeat liveness (``num_dead_nodes()``), deterministic
+row-striping (``KVStoreDistAsync._stripe_plan``), exactly-once
+envelopes with full-window replay — without ever ACTING on them: a dead
+server was only *named* in a barrier failure (reference: the fixed
+ps-lite roster, arXiv:1512.01274).  This module is the acting-on-them
+layer, the dynamic-membership trait TensorFlow's production experience
+(arXiv:1605.08695) showed separates a lab parameter server from one
+that rides preemptible capacity:
+
+* **Roster** — an epoch-numbered generation, the ordered server URI
+  tuple (order IS the stripe-slot mapping) and the live worker-rank
+  tuple.  Negotiated over the existing control channel; the
+  COORDINATOR is server 0 of the current generation (killing the
+  coordinator itself is the one unrecoverable death in v1 — run it on
+  the least-preemptible host).
+* **Pure roster arithmetic** (this module, no sockets): stripe-plan
+  derivation, wire-key layouts per server set, handoff planning
+  between generations, per-stripe optimizer-state restriping.  Every
+  worker computes the identical layout from the same roster with no
+  coordination — determinism is the correctness argument, and
+  ``tests/test_membership.py`` pins it as pure units.
+* **Coordinator state machine** (:class:`MembershipCoordinator`):
+  join/leave/evict with generation bumps only on actual change, so
+  duplicate reports (every surviving worker races to report the same
+  dead server) are idempotent.
+
+The kvstore client (``kvstore.KVStoreDistAsync``) and server
+(``kvstore_server.KVStoreServer``) own the socket halves: roster ops
+ride the ordinary exactly-once envelopes, handoff values ride the same
+zero-copy frames as pushes, and the server dedups handoffs
+per-(wire key, generation) so duplicate delivery — the quorum re-push
+by ALL workers, or a replay through a connection kill — applies once.
+See docs/ROBUSTNESS.md (elastic membership) for the full protocol.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: stripe-suffix separator, shared with the kvstore wire protocol
+STRIPE_SEP = "@s"
+
+
+# ---------------------------------------------------------------------------
+# Pure roster arithmetic — no sockets, no state.  Every function is
+# deterministic from its arguments so every observer of the same roster
+# generation derives the identical layout.
+# ---------------------------------------------------------------------------
+def stripe_plan(key: str, shape, num_servers: int,
+                bigarray_bound: int) -> Optional[List[int]]:
+    """Row boundaries for a striped key, or None for an unstriped one.
+    Deterministic from (key, shape, num_servers, bound) — the single
+    source of truth behind ``KVStoreDistAsync._stripe_plan`` and every
+    handoff computation; two generations with the same server COUNT
+    always yield the same plan."""
+    if num_servers <= 1 or not shape or len(shape) == 0 \
+            or int(np.prod(shape)) <= bigarray_bound or shape[0] < 2:
+        return None
+    parts = min(num_servers, shape[0])
+    return [shape[0] * i // parts for i in range(parts + 1)]
+
+
+def server_index(key: str, num_servers: int) -> int:
+    """crc32 routing of an unstriped key to a server slot."""
+    return zlib.crc32(key.encode()) % num_servers
+
+
+def stripe_server_index(key: str, i: int, num_servers: int) -> int:
+    """Server slot owning stripe ``i`` of ``key``: consecutive stripes
+    land on consecutive servers, offset by the key hash."""
+    return (zlib.crc32(key.encode()) + i) % num_servers
+
+
+def wire_layout(key: str, shape, servers: Sequence[str],
+                bigarray_bound: int) -> Dict[str, Tuple[str, int, int]]:
+    """The full wire placement of one logical key against one server
+    set: ``{wire_key: (server_uri, row_start, row_stop)}``.  For an
+    unstriped key the row span is the whole leading axis (or (0, 0)
+    for scalars)."""
+    n = len(servers)
+    plan = stripe_plan(key, shape, n, bigarray_bound)
+    if plan is None:
+        rows = int(shape[0]) if shape else 0
+        return {key: (servers[server_index(key, n)], 0, rows)}
+    out = {}
+    for i in range(len(plan) - 1):
+        out[f"{key}{STRIPE_SEP}{i}"] = (
+            servers[stripe_server_index(key, i, n)], plan[i], plan[i + 1])
+    return out
+
+
+def base_key(wire_key: str) -> str:
+    """The logical key behind a wire key (stripe suffix stripped)."""
+    if STRIPE_SEP in wire_key:
+        base, _, idx = wire_key.rpartition(STRIPE_SEP)
+        if idx.isdigit():
+            return base
+    return wire_key
+
+
+def plan_handoff(key_shapes: Dict[str, tuple], old_servers: Sequence[str],
+                 new_servers: Sequence[str],
+                 bigarray_bound: int) -> List[str]:
+    """The logical keys whose wire layout CHANGES between two server
+    sets — the keys that need a state handoff on this roster bump.  A
+    key whose every wire key maps to the same URI with the same row
+    span needs nothing (its owning server survived in the same slot
+    role); everything else is re-pushed under the new layout."""
+    moved = []
+    for key, shape in key_shapes.items():
+        old = wire_layout(key, shape, old_servers, bigarray_bound)
+        new = wire_layout(key, shape, new_servers, bigarray_bound)
+        if old != new:
+            moved.append(key)
+    return moved
+
+
+def restripe_value(key: str, value: np.ndarray, servers: Sequence[str],
+                   bigarray_bound: int) -> List[Tuple[str, str, np.ndarray]]:
+    """Slice one full key value into its new-layout handoff pushes:
+    ``[(wire_key, server_uri, row_slice)]`` in stripe order."""
+    out = []
+    layout = wire_layout(key, value.shape, servers, bigarray_bound)
+    for wk, (uri, lo, hi) in layout.items():
+        out.append((wk, uri,
+                    value if wk == key else value[lo:hi]))
+    return out
+
+
+def _concat_states(parts):
+    """Concatenate per-stripe optimizer states along axis 0.  States are
+    the shapes ``optimizer.create_state`` produces: an ndarray shaped
+    like the weight stripe, a tuple/list of those (momentum pairs), or
+    None (stateless).  Anything else is not row-decomposable and maps
+    to None (the optimizer re-creates fresh state — the documented
+    restriping caveat for non-elementwise state)."""
+    if all(p is None for p in parts):
+        return None
+    if all(isinstance(p, np.ndarray) for p in parts):
+        return np.concatenate(parts, axis=0)
+    if all(isinstance(p, (tuple, list)) for p in parts) \
+            and len({len(p) for p in parts}) == 1:
+        cols = []
+        for items in zip(*parts):
+            cols.append(_concat_states(list(items)))
+        return tuple(cols)
+    return None
+
+
+def _slice_state(state, lo, hi):
+    if state is None:
+        return None
+    if isinstance(state, np.ndarray):
+        return state[lo:hi]
+    if isinstance(state, (tuple, list)):
+        return tuple(_slice_state(s, lo, hi) for s in state)
+    return None
+
+
+def restripe_states(key: str, per_wire_states: Dict[str, object],
+                    old_plan: Optional[List[int]],
+                    new_plan: Optional[List[int]]):
+    """Re-key per-stripe optimizer state from one plan to another:
+    merge the old stripes' states (concatenating leading-axis arrays),
+    then re-slice along the NEW plan.  Returns ``{wire_key: state}``
+    under the new plan, or {} when the old stripes don't cover the full
+    key (a partial snapshot cannot be restriped soundly — the optimizer
+    re-creates state for the missing rows instead of training on a
+    silently misaligned merge).
+
+    Elementwise optimizers (SGD/Adam: state shaped like the weight)
+    restripe EXACTLY; per-layer state (LARS/LAMB norms) is not
+    row-decomposable and comes back None per stripe — the same caveat
+    striping itself carries."""
+    if old_plan is None:
+        parts = [per_wire_states.get(key)]
+        spans = [(0, None)]
+    else:
+        parts, spans = [], []
+        for i in range(len(old_plan) - 1):
+            wk = f"{key}{STRIPE_SEP}{i}"
+            if wk not in per_wire_states:
+                return {}
+            parts.append(per_wire_states[wk])
+            spans.append((old_plan[i], old_plan[i + 1]))
+    merged = _concat_states(parts)
+    if new_plan is None:
+        return {key: merged}
+    out = {}
+    for i in range(len(new_plan) - 1):
+        out[f"{key}{STRIPE_SEP}{i}"] = _slice_state(
+            merged, new_plan[i], new_plan[i + 1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Coordinator state machine (lives inside server 0 of the roster)
+# ---------------------------------------------------------------------------
+class Roster:
+    """One immutable roster generation."""
+
+    __slots__ = ("generation", "servers", "workers")
+
+    def __init__(self, generation: int, servers: Tuple[str, ...],
+                 workers: Tuple[int, ...]):
+        self.generation = int(generation)
+        self.servers = tuple(servers)
+        self.workers = tuple(sorted(workers))
+
+    def as_wire(self):
+        return (self.generation, list(self.servers), list(self.workers))
+
+    def __repr__(self):
+        return (f"Roster(gen={self.generation}, servers={self.servers}, "
+                f"workers={self.workers})")
+
+
+class MembershipCoordinator:
+    """Epoch-numbered membership ledger (server 0 owns one instance).
+
+    Every mutation bumps the generation ONLY on actual change, so the
+    surviving workers' racing reports of the same dead server collapse
+    into one bump; removal preserves the surviving servers' relative
+    order, so every observer of generation G derives the identical
+    stripe-slot mapping.  Thread-safe; the lock is a leaf (no calls out
+    while held) so it can never join a lock cycle with the server's
+    store lock or barrier condition."""
+
+    def __init__(self, servers: Sequence[str], workers: Sequence[int]):
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._servers: List[str] = list(dict.fromkeys(servers))
+        self._workers = set(int(w) for w in workers)
+        self._server_seen: Dict[str, float] = {}
+        self._snapshots: Dict[str, tuple] = {}   # uri -> (seq, blob)
+        self.evictions = 0
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def roster(self) -> Roster:
+        with self._lock:
+            return Roster(self._generation, tuple(self._servers),
+                          tuple(self._workers))
+
+    def workers_snapshot(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._workers))
+
+    # -- mutations (generation bumps only on change) -------------------------
+    def join_server(self, uri: str) -> int:
+        with self._lock:
+            if uri not in self._servers:
+                self._servers.append(uri)
+                self._generation += 1
+            self._server_seen[uri] = time.monotonic()
+            return self._generation
+
+    def leave_server(self, uri: str) -> int:
+        return self._remove_server(uri, evict=False)
+
+    def report_dead_server(self, uri: str) -> int:
+        return self._remove_server(uri, evict=True)
+
+    def _remove_server(self, uri: str, evict: bool) -> int:
+        with self._lock:
+            if uri in self._servers:
+                if len(self._servers) <= 1:
+                    raise RuntimeError(
+                        "cannot remove the last server (the coordinator "
+                        "itself) from the roster")
+                self._servers.remove(uri)
+                self._server_seen.pop(uri, None)
+                self._generation += 1
+                if evict:
+                    self.evictions += 1
+            return self._generation
+
+    def join_worker(self, rank: int) -> int:
+        with self._lock:
+            rank = int(rank)
+            if rank not in self._workers:
+                self._workers.add(rank)
+                self._generation += 1
+            return self._generation
+
+    def leave_worker(self, rank: int) -> int:
+        return self._remove_worker(rank, evict=False)
+
+    def evict_worker(self, rank: int) -> int:
+        return self._remove_worker(rank, evict=True)
+
+    def _remove_worker(self, rank: int, evict: bool) -> int:
+        with self._lock:
+            rank = int(rank)
+            if rank in self._workers:
+                self._workers.discard(rank)
+                self._generation += 1
+                if evict:
+                    self.evictions += 1
+            return self._generation
+
+    # -- server liveness + state snapshots -----------------------------------
+    def note_server_beat(self, uri: str, seq: Optional[int] = None,
+                         snapshot=None) -> None:
+        with self._lock:
+            if uri in self._servers:
+                self._server_seen[uri] = time.monotonic()
+            if snapshot is not None and seq is not None:
+                have = self._snapshots.get(uri)
+                if have is None or seq >= have[0]:
+                    self._snapshots[uri] = (int(seq), snapshot)
+
+    def snapshot_of(self, uri: str):
+        """The last state snapshot a (possibly now-dead) server shipped,
+        or None.  Snapshots OUTLIVE eviction on purpose — they are the
+        killed-server recovery source."""
+        with self._lock:
+            have = self._snapshots.get(uri)
+            return None if have is None else have[1]
+
+    def silent_servers(self, timeout: float) -> List[str]:
+        """Non-coordinator servers heard from at least once and then
+        silent past ``timeout`` (same never-heard-never-dead contract as
+        worker liveness)."""
+        if timeout <= 0:
+            return []
+        now = time.monotonic()
+        with self._lock:
+            return [u for u in self._servers[1:]
+                    if u in self._server_seen
+                    and now - self._server_seen[u] > timeout]

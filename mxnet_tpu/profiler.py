@@ -260,6 +260,15 @@ def record_channel_event(kind: str):
         _channel_counts[kind] = _channel_counts.get(kind, 0) + 1
 
 
+def record_channel_gauge(kind: str, value):
+    """SET a transport gauge (last-value, not a count): the elastic
+    roster generation is the canonical one — ``kvstore.roster_generation``
+    must read as "which membership epoch am I on", where an increment
+    per observer would be meaningless."""
+    with _channel_lock:
+        _channel_counts[kind] = value
+
+
 def channel_counts() -> dict:
     with _channel_lock:
         return dict(_channel_counts)

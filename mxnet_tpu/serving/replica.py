@@ -140,6 +140,11 @@ class ServingReplica(KVStoreServer):
             "batches": self._batcher.batches,
             "shed": self._batcher.shed,
             "refreshes": self.refreshes,
+            # which membership epoch the weight-refresh client last
+            # converged onto (0 = static roster or no client yet): lets
+            # an operator correlate a served-version stall with training
+            # -cluster churn from the serving side alone
+            "roster_generation": getattr(self._ps, "_roster_gen", 0) or 0,
             "latency": _prof.latency_stats("serving.request"),
         }
 
@@ -155,7 +160,14 @@ class ServingReplica(KVStoreServer):
         with self._ps_lock:
             if self._ps is None:
                 from ..kvstore import KVStoreDistAsync
-                self._ps = KVStoreDistAsync(uris=self._ps_uris)
+                # roster_member=False: under MXNET_KVSTORE_ELASTIC this
+                # client FOLLOWS the training roster (a server evicted
+                # between version pulls repairs transparently mid-pull)
+                # but must never JOIN it — a replica registering as a
+                # worker rank would inflate every training barrier, and
+                # its close() would evict the real rank sharing its id
+                self._ps = KVStoreDistAsync(uris=self._ps_uris,
+                                            roster_member=False)
             return self._ps
 
     @staticmethod

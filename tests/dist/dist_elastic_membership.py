@@ -1,0 +1,137 @@
+"""Elastic-membership smoke: SIGKILL a parameter server mid-epoch and
+finish bit-identical to the uninterrupted run — no restarts.
+
+Run via:  python tools/launch.py --elastic -n 2 -s 2 \
+              --env MXNET_FI_KILL_PROCESS_AFTER=<N> \
+              --env MXNET_FI_ONLY_SERVER=1 \
+              python tests/dist/dist_elastic_membership.py
+
+Two workers train against two servers with one striped key (a row
+slice on each server) and one small key per server.  Server 1 is
+REALLY SIGKILLed — ``faultinject.kill_process_after_acks`` fires after
+it serves exactly the last ack of round KILL_ROUND, a deterministic
+barrier-phase boundary — taking its stripe state to its grave.  The
+surviving roster must: detect the death, evict it (coordinator =
+server 0), re-derive striping, hand the state off from the workers'
+sync-point caches, re-push the orphaned round-(K+1) gradients, and
+finish.  Proof is BIT-IDENTITY: integer gradients with a power-of-two
+lr make every update exact in fp32 and order-independent, so the final
+weights must EQUAL the static-roster analytic golden — a lost push, a
+double-applied handoff or a mis-striped row all break equality.
+
+The ack count (MXNET_FI_KILL_PROCESS_AFTER) is derived from the wire
+protocol; ``expected_kill_acks`` below documents the arithmetic and
+ci/run_ci.sh passes its value in.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_KVSTORE_ELASTIC", "1")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX", "3")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_INITIAL_MS", "20")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX_MS", "200")
+os.environ.setdefault("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.5")
+os.environ.setdefault("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "2.0")
+os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import membership, profiler  # noqa: E402
+
+ROUNDS = 4
+KILL_ROUND = 2          # server 1 dies at the END of this round
+LR = 0.125              # power of two: every update exact in fp32
+
+
+def pick_small_keys():
+    """One small key owned by each server under the 2-server roster."""
+    keys = {}
+    i = 0
+    while len(keys) < 2 and i < 1000:
+        k = f"k{i}"
+        keys.setdefault(membership.server_index(k, 2), k)
+        i += 1
+    return keys[0], keys[1]
+
+
+def expected_kill_acks(nworker=2, kill_round=KILL_ROUND):
+    """Enveloped replies server 1 serves through the end of
+    ``kill_round`` — the deterministic kill point ci/run_ci.sh arms.
+
+    Setup, per worker: init big-stripe (1) + init small1 (1) + the
+    set_optimizer barrier's channel flush (1); plus rank 0's optimizer
+    command (1).  Each round, per worker: push big-stripe (1) + push
+    small1 (1) + barrier flush (1) + pull big-stripe (1) + pull small1
+    (1) + barrier flush (1).  Barrier rendezvous and roster ops ride
+    server 0; heartbeats are raw and exempt — the count advances on
+    exactly these envelopes."""
+    setup = nworker * 3 + 1
+    per_round = nworker * 6
+    return setup + per_round * kill_round
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == 2, nworker
+    small0, small1 = pick_small_keys()
+    big0 = np.arange(40, dtype=np.float32).reshape(10, 4)
+
+    kv.init("big", mx.nd.NDArray(big0))
+    kv.init(small0, mx.nd.zeros((2, 2)))
+    kv.init(small1, mx.nd.zeros((2, 2)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=LR, momentum=0.0,
+                                      wd=0.0, rescale_grad=1.0))
+
+    out_big = mx.nd.zeros((10, 4))
+    out_s = [mx.nd.zeros((2, 2)), mx.nd.zeros((2, 2))]
+    grad = float(rank + 1)
+    for _r in range(ROUNDS):
+        kv.push("big", mx.nd.ones((10, 4)) * grad)
+        kv.push(small0, mx.nd.ones((2, 2)) * grad)
+        kv.push(small1, mx.nd.ones((2, 2)) * grad)
+        kv.barrier()
+        kv.pull("big", out=out_big)
+        kv.pull(small0, out=out_s[0])
+        kv.pull(small1, out=out_s[1])
+        kv.barrier()
+
+    # every worker must have crossed the repair: one server died
+    counts = profiler.channel_counts()
+    assert counts.get("kvstore.roster_bump", 0) >= 1, counts
+    assert counts.get("kvstore.roster_generation", 0) >= 1, counts
+    assert kv._roster_gen >= 1 and len(kv._conns) == 1, \
+        (kv._roster_gen, len(kv._conns))
+    assert profiler.channel_bytes().get("handoff", 0) > 0
+
+    # bit-identity vs the static-roster golden: total pushed gradient is
+    # ROUNDS * (1 + 2) per element, each update exact in fp32
+    total = ROUNDS * sum(r + 1 for r in range(nworker))
+    np.testing.assert_array_equal(
+        out_big.asnumpy(), big0 - LR * total,
+        err_msg="striped key diverged from the static-roster run")
+    for o in out_s:
+        np.testing.assert_array_equal(
+            o.asnumpy(), np.full((2, 2), -LR * total, np.float32),
+            err_msg="small key diverged from the static-roster run")
+
+    kv.barrier()
+    kv.close(stop_servers=True)
+    print("dist_elastic_membership rank %d/%d OK "
+          "(SIGKILL survived, bit-identical; roster gen %d)"
+          % (rank, nworker, kv._roster_gen), flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("MXT_PRINT_KILL_ACKS"):
+        print(expected_kill_acks())
+        sys.exit(0)
+    main()

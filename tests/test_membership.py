@@ -1,0 +1,682 @@
+"""Elastic membership: roster arithmetic as pure units, handoff
+idempotency, barrier renegotiation — and the in-process
+kill-a-server / join-a-worker integration flows.
+
+The pure tests need NO sockets: stripe-plan derivation, wire layouts
+and state restriping are deterministic functions of the roster
+(mxnet_tpu/membership.py), and the server-side handoff/barrier
+machinery is driven through ``KVStoreServer._handle`` directly.  The
+integration tests run real in-process servers and assert the
+acceptance property: kill a server mid-job and the surviving roster
+finishes with EXACTLY the uninterrupted values (SGD arithmetic is
+order-independent for the integer/power-of-two values used here)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject, membership, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore_server import KVStoreServer
+
+SHAPE = (3, 4)
+
+
+# ---------------------------------------------------------------------------
+# pure roster arithmetic (no sockets)
+# ---------------------------------------------------------------------------
+def test_stripe_plan_deterministic_across_generations():
+    """The plan is a pure function of (key, shape, n, bound): two
+    generations with the same server count derive identical plans, and
+    every worker derives the same plan with no coordination."""
+    for n in (1, 2, 3, 5):
+        a = membership.stripe_plan("w", (10, 4), n, 16)
+        b = membership.stripe_plan("w", (10, 4), n, 16)
+        assert a == b
+    assert membership.stripe_plan("w", (10, 4), 1, 16) is None
+    assert membership.stripe_plan("w", (10, 4), 2, 1000) is None  # small
+    plan = membership.stripe_plan("w", (10, 4), 2, 16)
+    assert plan == [0, 5, 10]
+    plan3 = membership.stripe_plan("w", (10, 4), 3, 16)
+    assert plan3[0] == 0 and plan3[-1] == 10 and len(plan3) == 4
+    # more servers than rows: parts cap at the row count
+    tall = membership.stripe_plan("w", (2, 1000), 5, 16)
+    assert tall == [0, 1, 2]
+
+
+def test_stripe_plan_matches_worker_derivation(monkeypatch):
+    """kvstore's _stripe_plan delegates to membership.stripe_plan — the
+    two can never diverge (handoff planning depends on it)."""
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+        kv = mx.kv.create("dist_async")
+        assert kv._stripe_plan("w", (10, 4)) == membership.stripe_plan(
+            "w", (10, 4), 1, 16)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_wire_layout_owner_stability_under_eviction():
+    """Removal preserves the survivors' relative order; a key whose
+    every wire key keeps its URI and row span is NOT moved by the
+    bump.  (The reverse — crc routing moving keys between survivors —
+    is expected and is exactly what plan_handoff detects.)"""
+    servers2 = ["hostA:1", "hostB:2"]
+    servers1 = ["hostA:1"]
+    lay2 = membership.wire_layout("w", (10, 4), servers2, 16)
+    assert set(lay2) == {"w@s0", "w@s1"}
+    spans = sorted((lo, hi) for _u, lo, hi in lay2.values())
+    assert spans == [(0, 5), (5, 10)]
+    lay1 = membership.wire_layout("w", (10, 4), servers1, 16)
+    assert lay1 == {"w": ("hostA:1", 0, 10)}  # unstriped on one server
+    # a small key: moved only if its crc owner changed
+    small = membership.wire_layout("k", (2, 2), servers2, 1000)
+    (uri, lo, hi), = small.values()
+    assert (lo, hi) == (0, 2) and uri in servers2
+
+
+def test_plan_handoff_flags_only_moved_keys():
+    servers2 = ["hostA:1", "hostB:2"]
+    servers1 = ["hostA:1"]
+    shapes = {"big": (10, 4), "smallA": (2, 2), "smallB": (2, 2)}
+    # find one small key on each server under the 2-server layout
+    owners = {k: next(iter(membership.wire_layout(
+        k, shapes[k], servers2, 16).values()))[0]
+        for k in ("smallA", "smallB")}
+    moved = set(membership.plan_handoff(shapes, servers2, servers1, 16))
+    assert "big" in moved          # re-striped 2 -> 1
+    for k, owner in owners.items():
+        if owner == "hostA:1":
+            assert k not in moved  # survivor kept it: nothing to do
+        else:
+            assert k in moved      # dead server owned it
+    # identical roster: nothing moves
+    assert membership.plan_handoff(shapes, servers2, servers2, 16) == []
+
+
+def test_restripe_value_slices_follow_new_layout():
+    val = np.arange(40, dtype=np.float32).reshape(10, 4)
+    parts = membership.restripe_value("w", val, ["a:1", "b:2"], 16)
+    assert {wk for wk, _u, _v in parts} == {"w@s0", "w@s1"}
+    got = np.concatenate([v for _wk, _u, v in sorted(parts)], axis=0)
+    np.testing.assert_array_equal(got, val)
+    whole = membership.restripe_value("w", val, ["a:1"], 16)
+    assert len(whole) == 1 and whole[0][0] == "w"
+    np.testing.assert_array_equal(whole[0][2], val)
+
+
+def test_restripe_states_exact_merge():
+    """Elementwise (momentum-shaped) state restripes EXACTLY: merge the
+    old stripes along axis 0, re-slice along the new plan."""
+    mom = np.arange(40, dtype=np.float32).reshape(10, 4)
+    old_plan = [0, 5, 10]
+    per_wire = {"w@s0": (mom[0:5],), "w@s1": (mom[5:10],)}
+    # 2 stripes -> whole key
+    out = membership.restripe_states("w", per_wire, old_plan, None)
+    np.testing.assert_array_equal(out["w"][0], mom)
+    # 2 stripes -> 3 stripes
+    new_plan = membership.stripe_plan("w", (10, 4), 3, 16)
+    out3 = membership.restripe_states("w", per_wire, old_plan, new_plan)
+    got = np.concatenate(
+        [out3[f"w@s{i}"][0] for i in range(len(new_plan) - 1)], axis=0)
+    np.testing.assert_array_equal(got, mom)
+    # bare-array state works too
+    outb = membership.restripe_states(
+        "w", {"w@s0": mom[0:5], "w@s1": mom[5:10]}, old_plan, None)
+    np.testing.assert_array_equal(outb["w"], mom)
+    # stateless () states stay empty, never invent arrays
+    oute = membership.restripe_states(
+        "w", {"w@s0": (), "w@s1": ()}, old_plan, None)
+    assert oute["w"] == ()
+    # a PARTIAL snapshot cannot be restriped soundly: {} (fresh state)
+    assert membership.restripe_states(
+        "w", {"w@s0": (mom[0:5],)}, old_plan, None) == {}
+    # non-row-decomposable state degrades to None per new stripe
+    outn = membership.restripe_states(
+        "w", {"w@s0": 3.5, "w@s1": 4.5}, old_plan, None)
+    assert outn == {"w": None}
+
+
+def test_coordinator_idempotent_mutations():
+    m = membership.MembershipCoordinator(["a:1", "b:2"], [0, 1])
+    assert m.generation == 0
+    g1 = m.report_dead_server("b:2")
+    assert g1 == 1 and m.evictions == 1
+    # duplicate reports (every worker races to report) do NOT re-bump
+    assert m.report_dead_server("b:2") == 1 and m.evictions == 1
+    assert m.roster().servers == ("a:1",)
+    # the LAST server (the coordinator itself) can never be removed
+    with pytest.raises(RuntimeError, match="last server"):
+        m.report_dead_server("a:1")
+    # joins bump once, re-joins don't
+    assert m.join_server("c:3") == 2
+    assert m.join_server("c:3") == 2
+    assert m.roster().servers == ("a:1", "c:3")   # order preserved
+    assert m.join_worker(2) == 3
+    assert m.join_worker(2) == 3
+    assert m.evict_worker(1) == 4
+    assert m.evict_worker(1) == 4 and m.evictions == 2
+    assert m.roster().workers == (0, 2)
+
+
+def test_coordinator_snapshots_outlive_eviction():
+    m = membership.MembershipCoordinator(["a:1", "b:2"], [0])
+    m.note_server_beat("b:2", seq=1, snapshot={"store": {"k": 1}})
+    m.note_server_beat("b:2", seq=3, snapshot={"store": {"k": 3}})
+    m.note_server_beat("b:2", seq=2, snapshot={"store": {"k": 2}})  # stale
+    m.report_dead_server("b:2")
+    snap = m.snapshot_of("b:2")
+    assert snap == {"store": {"k": 3}}   # newest seq wins, survives death
+    assert m.snapshot_of("nope:0") is None
+
+
+def test_coordinator_silent_server_detection():
+    m = membership.MembershipCoordinator(["a:1", "b:2", "c:3"], [0])
+    # never heard from = never declared dead (may still be starting)
+    assert m.silent_servers(0.01) == []
+    m.note_server_beat("b:2")
+    time.sleep(0.05)
+    assert m.silent_servers(0.01) == ["b:2"]
+    assert m.silent_servers(0) == []     # timeout 0 disables
+
+
+# ---------------------------------------------------------------------------
+# server-side handoff + barrier machinery, driven with NO sockets
+# ---------------------------------------------------------------------------
+def _mk_server(**kw):
+    kw.setdefault("num_workers", 1)
+    srv = KVStoreServer(server_id=0, **kw)
+    srv._listener.close()    # never serving: pure _handle driving
+    return srv
+
+
+def test_handoff_idempotent_under_duplicate_delivery():
+    """Quorum re-push: every worker sends the same handoff; the FIRST
+    per (wire key, generation) applies, duplicates ack as no-ops, a
+    stale generation never regresses the key, a newer one re-applies."""
+    srv = _mk_server(elastic=True)
+    v1 = np.full(SHAPE, 7.0, np.float32)
+    assert srv._handle(("handoff", 3, "w", v1, "w")) is True
+    assert srv._handle(("handoff", 3, "w",
+                        np.full(SHAPE, 9.0, np.float32), "w")) is False
+    np.testing.assert_array_equal(srv._store["w"].asnumpy(), 7.0)
+    # stale generation: ignored
+    assert srv._handle(("handoff", 2, "w",
+                        np.full(SHAPE, 1.0, np.float32), "w")) is False
+    np.testing.assert_array_equal(srv._store["w"].asnumpy(), 7.0)
+    # newer generation: re-applies
+    assert srv._handle(("handoff", 4, "w",
+                        np.full(SHAPE, 2.0, np.float32), "w")) is True
+    np.testing.assert_array_equal(srv._store["w"].asnumpy(), 2.0)
+
+
+def test_handoff_purges_stale_wire_forms():
+    """The first handoff of a logical key in a generation deletes the
+    key's OLD wire forms (stripe keys from the previous layout) and
+    their optimizer state, so a re-striped layout leaves no orphans."""
+    from mxnet_tpu import optimizer as opt
+    srv = _mk_server(elastic=True)
+    srv._updater = opt.get_updater(opt.SGD(learning_rate=0.5,
+                                           momentum=0.9))
+    srv._handle(("init", "w@s1", np.zeros((5, 4), np.float32)))
+    srv._handle(("push", "w@s1", np.ones((5, 4), np.float32)))
+    assert "w@s1" in srv._store and srv._updater.states
+    srv._handle(("handoff", 1, "w",
+                 np.zeros((10, 4), np.float32), "w"))
+    assert "w@s1" not in srv._store and "w" in srv._store
+    assert "w@s1" not in srv._updater.states
+    # an in-flight OLD-layout push arriving post-purge fails loudly (the
+    # pusher's own repair re-applies it from its push log)
+    with pytest.raises(Exception, match="uninitialized"):
+        srv._apply_push("w@s1", np.ones((5, 4), np.float32))
+
+
+def test_handoff_state_idempotent_and_installed():
+    from mxnet_tpu import optimizer as opt
+    srv = _mk_server(elastic=True)
+    srv._updater = opt.get_updater(opt.SGD(learning_rate=0.5,
+                                           momentum=0.9))
+    mom = np.full((10, 4), 0.25, np.float32)
+    assert srv._handle(("handoff_state", 1, "w", (mom,), "w")) is True
+    assert srv._handle(("handoff_state", 1, "w", (mom * 9,), "w")) is False
+    st = srv._updater.states["w"]
+    np.testing.assert_array_equal(np.asarray(st[0].asnumpy()), mom)
+    # None clears the slot (the optimizer re-creates fresh state)
+    assert srv._handle(("handoff_state", 2, "w", None, "w")) is True
+    assert "w" not in srv._updater.states
+
+
+def test_barrier_renegotiates_with_evicted_rank(monkeypatch):
+    """Elastic coordinator: a 2-worker barrier whose rank 1 was alive
+    and went silent does NOT fail — rank 1 is evicted (generation
+    bump), the target re-reads the live roster and rank 0 is released.
+    Pure threads, no sockets."""
+    srv = _mk_server(num_workers=2, elastic=True, hb_timeout=0.2)
+    srv._note_ping(0)
+    srv._note_ping(1)
+    with srv._barrier_cv:
+        srv._hb_seen[1] = time.monotonic() - 99.0   # long silent
+    t0 = time.monotonic()
+    gen = srv._handle(("barrier",), rank=0)
+    assert time.monotonic() - t0 < 5.0
+    assert gen == srv._get_membership().generation >= 1
+    assert srv._get_membership().roster().workers == (0,)
+    assert profiler.channel_counts().get("kvstore.worker_eviction", 0) >= 1
+    # the evicted rank was merely slow: arriving at the next barrier
+    # RE-ADMITS it (join, another bump) instead of corrupting the count.
+    # Stretch the silence budget so phase 2 tests re-admission alone,
+    # not another round of evictions racing the parked waiters.
+    srv._hb_timeout = 60.0
+    done = []
+
+    def late_rank1():
+        try:
+            done.append(srv._handle(("barrier",), rank=1))
+        except Exception as exc:  # noqa: BLE001 — surfaced via assert
+            done.append(exc)
+
+    t = threading.Thread(target=late_rank1, daemon=True)
+    t.start()
+    time.sleep(0.3)          # rank 1 parks: roster is {0, 1} again
+    srv._note_ping(1)
+    srv._handle(("barrier",), rank=0)
+    t.join(timeout=5)
+    assert not t.is_alive() and isinstance(done[0], int)
+    assert srv._get_membership().roster().workers == (0, 1)
+    srv._stop.set()
+
+
+def test_static_barrier_error_names_heartbeat_age():
+    """Satellite: the non-elastic barrier failure carries per-rank
+    last-heartbeat AGE — evidence, not just rank ids."""
+    srv = _mk_server(num_workers=2, elastic=False, hb_timeout=0.2)
+    srv._note_ping(0)
+    srv._note_ping(1)
+    with srv._barrier_cv:
+        srv._hb_seen[1] = time.monotonic() - 42.0
+    with pytest.raises(RuntimeError) as ei:
+        srv._handle(("barrier",), rank=0)
+    msg = str(ei.value)
+    assert "[1]" in msg and "arrived rank(s): [0]" in msg
+    assert "rank 1: last heartbeat" in msg and "ago" in msg
+    srv._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# faultinject: the process-level kill point
+# ---------------------------------------------------------------------------
+def test_kill_process_after_acks_fires_at_exact_count(monkeypatch):
+    """SIGKILL after exactly n enveloped replies (the trigger is
+    monkeypatched so the test process survives); heartbeat pings never
+    advance the count."""
+    fired = []
+    monkeypatch.setattr(faultinject, "_sigkill_self",
+                        lambda: fired.append(True))
+    faultinject.reset()
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.05")
+        with faultinject.kill_process_after_acks(3):
+            kv = mx.kv.create("dist_async")
+            kv.init("a", mx.nd.ones(SHAPE))        # ack 1
+            out = mx.nd.zeros(SHAPE)
+            kv.pull("a", out=out)                  # ack 2
+            time.sleep(0.3)                        # heartbeats flow...
+            assert not fired                       # ...and don't count
+            kv.pull("a", out=out)                  # ack 3 -> kill
+            deadline = time.time() + 5
+            while not fired and time.time() < deadline:
+                time.sleep(0.01)
+            assert fired and faultinject.stats()["kills_fired"] == 1
+        kv.close(stop_servers=True)
+    finally:
+        faultinject.reset()
+        srv.stop()
+
+
+def test_kill_process_env_arming(monkeypatch):
+    """MXNET_FI_KILL_PROCESS_AFTER / MXNET_FI_ONLY_SERVER arm the plan
+    from the environment (the launcher-spawned-process path), and the
+    server-id filter keeps the plan off other shards."""
+    faultinject.reset()
+    try:
+        faultinject.configure(kill_process_after=2, only_server=1)
+        monkeypatch.setenv("DMLC_SERVER_ID", "0")
+        faultinject.server_replied()
+        faultinject.server_replied()
+        faultinject.server_replied()
+        assert faultinject.stats()["kills_fired"] == 0   # wrong server id
+        monkeypatch.setattr(faultinject, "_sigkill_self", lambda: None)
+        monkeypatch.setenv("DMLC_SERVER_ID", "1")
+        faultinject.server_replied()
+        faultinject.server_replied()
+        assert faultinject.stats()["kills_fired"] == 1
+    finally:
+        faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# integration: real in-process servers, sockets, kill / join / leave
+# ---------------------------------------------------------------------------
+def _elastic_pair(monkeypatch, num_workers=1, snapshot_s=0.0):
+    """Two elastic in-process servers sharing a roster, env wired."""
+    monkeypatch.setenv("MXNET_KVSTORE_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+    monkeypatch.setenv("MXNET_KVSTORE_SNAPSHOT_S", str(snapshot_s))
+    monkeypatch.setenv("DMLC_NUM_WORKER", str(num_workers))
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    srv0 = KVStoreServer(server_id=0, num_workers=num_workers,
+                         elastic=True)
+    srv1 = KVStoreServer(server_id=1, num_workers=num_workers,
+                         elastic=True)
+    uris = f"127.0.0.1:{srv0.port},127.0.0.1:{srv1.port}"
+    monkeypatch.setenv("MXT_SERVER_URIS", uris)
+    srv0._roster_servers = uris.split(",")
+    srv1._roster_servers = uris.split(",")
+    srv0._snapshot_s = snapshot_s
+    srv1._snapshot_s = snapshot_s
+    srv0.start_background()
+    srv1.start_background()
+    return srv0, srv1
+
+
+def test_elastic_server_death_recovers_exact(monkeypatch):
+    """THE acceptance flow, in-process: kill server 1 mid-job; the
+    worker reports it, re-derives striping against the survivor, hands
+    the state off from its pull cache and re-pushes the updates the
+    dead server took with it — final weights EXACTLY equal the
+    uninterrupted run (integer grads, power-of-two lr: the arithmetic
+    is order-independent and exact in fp32)."""
+    srv0, srv1 = _elastic_pair(monkeypatch)
+    try:
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.init("small", mx.nd.ones((2, 2)))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((10, 4)))
+        kv.push("small", mx.nd.ones((2, 2)))
+        out_b, out_s = mx.nd.zeros((10, 4)), mx.nd.zeros((2, 2))
+        kv.pull("big", out=out_b)        # sync point: cache = server state
+        kv.pull("small", out=out_s)
+        # both servers hold live stripes before the kill
+        assert len(srv0._store) >= 1 and len(srv1._store) >= 1
+        gen0 = kv._roster_gen
+        srv1.stop()                      # SIGKILL-equivalent: state LOST
+        # next round rides the repair path end to end
+        kv.push("big", mx.nd.ones((10, 4)) * 2)
+        kv.push("small", mx.nd.ones((2, 2)) * 2)
+        kv.barrier()
+        kv.pull("big", out=out_b)
+        kv.pull("small", out=out_s)
+        np.testing.assert_array_equal(out_b.asnumpy(), big - 0.125 * 3)
+        np.testing.assert_array_equal(out_s.asnumpy(), 1.0 - 0.125 * 3)
+        assert kv._roster_gen > gen0
+        assert kv._roster_servers == [f"127.0.0.1:{srv0.port}"]
+        counts = profiler.channel_counts()
+        assert counts.get("kvstore.roster_bump", 0) >= 1
+        assert counts.get("kvstore.handoff_applied", 0) >= 1
+        assert counts.get("kvstore.orphan_repush", 0) >= 1
+        assert counts.get("kvstore.roster_generation", 0) >= 1
+        assert profiler.channel_bytes().get("handoff", 0) > 0
+        from mxnet_tpu import distributed
+        assert distributed.roster_generation() >= 1
+        # striping must have re-derived: the survivor now owns ALL keys
+        assert "big" in srv0._store and "small" in srv0._store
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_elastic_momentum_state_hands_off_via_snapshot(monkeypatch):
+    """Optimizer state survives a SIGKILL through the coordinator's
+    banked snapshot: a momentum-SGD run that loses server 1 and repairs
+    at a QUIESCENT sync point (barrier, no pushes in flight) finishes
+    EXACTLY like an uninterrupted single-server run of the same push
+    sequence — momentum restriping is elementwise-exact.  (A repair
+    with pushes still in flight keeps VALUES exact but may capture
+    survivor-stripe momentum one update ahead — the same staleness
+    async SGD already tolerates; docs/ROBUSTNESS.md.)"""
+    srv0, srv1 = _elastic_pair(monkeypatch, snapshot_s=0.05)
+    try:
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        opt = mx.optimizer.SGD(learning_rate=0.125, momentum=0.5,
+                               wd=0.0, rescale_grad=1.0)
+        kv.set_optimizer(opt)
+        kv.push("big", mx.nd.ones((10, 4)))      # momentum builds
+        out = mx.nd.zeros((10, 4))
+        kv.pull("big", out=out)                  # sync point
+        # which of big's stripes lives on (doomed) server 1
+        uris = os.environ["MXT_SERVER_URIS"].split(",")
+        doomed_wk = [wk for wk, (uri, _lo, _hi) in membership.wire_layout(
+            "big", (10, 4), uris, 16).items() if uri == uris[1]][0]
+
+        def banked_momentum():
+            m = srv0._get_membership()
+            snap = m.snapshot_of(uris[1]) if m else None
+            return snap is not None and snap.get("states", {}).get(
+                doomed_wk) not in (None, ())
+
+        deadline = time.time() + 5
+        while not banked_momentum() and time.time() < deadline:
+            time.sleep(0.02)                 # wait for a POST-push beat
+        assert banked_momentum(), "no momentum-bearing snapshot banked"
+        srv1.stop()
+        kv.barrier()         # quiescent repair: handoff at the sync point
+        kv.push("big", mx.nd.ones((10, 4)))      # momentum compounds on
+        kv.barrier()
+        kv.pull("big", out=out)
+        # golden: the same sequence against one never-interrupted server
+        mom = np.zeros((10, 4), np.float32)
+        w = big.copy()
+        for _ in range(2):
+            mom = 0.5 * mom - 0.125 * np.ones((10, 4), np.float32)
+            w = w + mom
+        np.testing.assert_array_equal(out.asnumpy(), w)
+        counts = profiler.channel_counts()
+        assert counts.get("kvstore.handoff_state_applied", 0) >= 1
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_elastic_repair_with_compression_residuals(monkeypatch):
+    """2-bit wire compression composes with a roster bump: the
+    error-feedback residuals are keyed by WIRE key and shaped like the
+    OLD stripe spans — a re-stripe must drop the moved keys' residuals
+    (bounded pending-quantum loss, same class as compression itself)
+    instead of broadcast-adding stale rows into the new layout or
+    crashing on the shape mismatch."""
+    srv0, srv1 = _elastic_pair(monkeypatch)
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESSION", "2bit")
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESSION_THRESHOLD", "0.5")
+    try:
+        kv = mx.kv.create("dist_async")
+        big = np.zeros((10, 4), np.float32)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=1.0, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        # fractional grads leave nonzero residuals behind, one per
+        # OLD-layout stripe key
+        kv.push("big", mx.nd.ones((10, 4)) * 0.3)
+        out = mx.nd.zeros((10, 4))
+        kv.pull("big", out=out)
+        assert any("@s" in wk for wk in kv._gc_residual)
+        srv1.stop()
+        kv.push("big", mx.nd.ones((10, 4)) * 0.3)   # repairs mid-flight
+        kv.barrier()
+        kv.pull("big", out=out)                     # completes, no crash
+        # stale striped residuals are gone; the re-grown one matches the
+        # new (whole-key) layout
+        assert not any("@s" in wk for wk in kv._gc_residual), \
+            kv._gc_residual.keys()
+        if "big" in kv._gc_residual:
+            assert kv._gc_residual["big"].shape == (10, 4)
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_elastic_worker_join_and_graceful_leave(monkeypatch):
+    """Add a worker at step N: a second worker joins mid-job (roster
+    bump), barriers re-target the grown roster, and a graceful close
+    deregisters it so the survivor's barriers shrink back without
+    waiting out a heartbeat timeout."""
+    srv0, srv1 = _elastic_pair(monkeypatch, num_workers=1)
+    try:
+        kv1 = mx.kv.create("dist_async")
+        kv1.init("w", mx.nd.zeros(SHAPE))
+        kv1.barrier()                      # 1-worker barrier: immediate
+        assert kv1.num_workers == 1
+        monkeypatch.setenv("DMLC_WORKER_ID", "1")
+        kv2 = mx.kv.create("dist_async")   # joins: generation bump
+        assert kv2.num_workers == 2
+        done = []
+
+        def w2_barrier():
+            try:
+                kv2.barrier()
+                done.append("ok")
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                done.append(exc)
+
+        t = threading.Thread(target=w2_barrier, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        assert not done                    # rank 1 is parked, waiting
+        kv1.barrier()                      # rank 0 arrives -> released
+        t.join(timeout=10)
+        assert done == ["ok"]
+        assert kv1.num_workers == 2        # barrier reply refreshed kv1
+        kv2.close()                        # graceful roster_leave
+        kv1.barrier()                      # 1-worker again: immediate
+        assert kv1.num_workers == 1
+        kv1.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_elastic_graceful_server_leave(monkeypatch):
+    """A departing server ships its final snapshot and deregisters; the
+    worker converges at its next op and the values survive exactly."""
+    srv0, srv1 = _elastic_pair(monkeypatch, snapshot_s=3600.0)
+    try:
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.push("big", mx.nd.NDArray(big * 3))   # assign semantics
+        out = mx.nd.zeros((10, 4))
+        kv.pull("big", out=out)
+        srv1.leave()                       # snapshot + roster_leave + stop
+        kv.push("big", mx.nd.NDArray(big * 5))
+        kv.barrier()
+        kv.pull("big", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), big * 5)
+        assert len(kv._conns) == 1
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_stripe_plan_staleness_is_hard_error(monkeypatch):
+    """Satellite: mutating the server set WITHOUT the elastic path must
+    fail loudly — a stale cached plan silently routes rows to the wrong
+    servers.  _reset_stripe_plans() is the sanctioned clear."""
+    srvs = [KVStoreServer(server_id=i, num_workers=1) for i in range(2)]
+    for s in srvs:
+        s.start_background()
+    try:
+        monkeypatch.setenv("MXT_SERVER_URIS", ",".join(
+            f"127.0.0.1:{s.port}" for s in srvs))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        dropped = kv._conns.pop()          # the old test-only mutation
+        with pytest.raises(MXNetError, match="server count changed"):
+            kv._stripe_plan("big", big.shape)
+        kv._reset_stripe_plans()
+        assert kv._stripe_plan("big", big.shape) is None  # 1 server now
+        kv._conns.append(dropped)
+        kv._reset_stripe_plans()
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_serving_replica_tolerates_roster_bump(monkeypatch):
+    """The serving tier's weight-refresh client follows the roster: a
+    parameter server dying between version pulls repairs transparently
+    (roster_member=False — the replica never joins the roster), and a
+    version bump published AFTER the churn still refreshes served
+    weights with zero replica restarts."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.replica import VERSION_KEY
+    srv0, srv1 = _elastic_pair(monkeypatch)
+    try:
+        kv = mx.kv.create("dist_async")
+        # layer name chosen so 'fca_weight' crc-routes to server 1 (the
+        # one we kill) — the refresh MUST cross the repair path
+        assert membership.server_index("fca_weight", 2) == 1
+        w = np.full((2, 4), 2.0, np.float32)   # FC weight: (hidden, in)
+        kv.init("fca_weight", mx.nd.NDArray(w))
+        serving.publish_version(kv, 1)
+        # build a replica over the SAME roster
+        import mxnet_tpu.symbol as sym
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=2, no_bias=True,
+                                 name="fca")
+        replica = serving.ServingReplica(
+            net, {"data": (1, 4)},
+            {"fca_weight": mx.nd.NDArray(w)}, {},
+            param_servers=os.environ["MXT_SERVER_URIS"].split(","),
+            refresh_interval=0.0, port=0)
+        r1 = replica._refresh_once()
+        gen_before = getattr(replica._ps, "_roster_gen", 0)
+        # kill whichever server does NOT host the coordinator
+        srv1.stop()
+        # trainer-side: repair + handoff re-homes every key (incl. the
+        # version register), then publish a NEW version
+        kv.push("fca_weight", mx.nd.NDArray(np.full((2, 4), 5.0,
+                                                    np.float32)))
+        kv.barrier()
+        serving.publish_version(kv, 2)
+        r2 = replica._refresh_once()       # repairs mid-pull if needed
+        assert r2["version"] == 2 and r2["refreshed"]
+        assert getattr(replica._ps, "_roster_gen", 0) > gen_before
+        stats = replica._op_stats(("serving_stats",), None)
+        assert stats["roster_generation"] >= 1
+        replica.stop()
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()

@@ -116,6 +116,18 @@ def test_bucket_sentence_iter():
 def test_bucketing_module_trains():
     """Config-4 analog (LSTM PTB via BucketingModule) at toy scale:
     loss must drop across epochs."""
+    # BucketSentenceIter.reset() shuffles through the GLOBAL python
+    # `random` (never seeded anywhere: urandom entropy) and np.random,
+    # and Xavier draws from mx.random's global key — all three stream
+    # positions depended on whatever the suite ran (and consumed)
+    # before this test, so the epoch data ORDER and the init — and with
+    # them this marginal 0.8x convergence threshold — were
+    # nondeterministic per run.  Pin all three so the trajectory is
+    # reproducible.
+    import random as _pyrandom
+    _pyrandom.seed(0)
+    np.random.seed(0)
+    mx.random.seed(0)
     rng = np.random.RandomState(0)
     V, E, H = 20, 8, 16
     # predictable sequences: next token = (tok + 1) % V

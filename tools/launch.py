@@ -70,6 +70,8 @@ def _worker_env(args, coord_uri, port, wid):
     })
     if getattr(args, "server_uris", None):
         env["MXT_SERVER_URIS"] = ",".join(args.server_uris)
+    if getattr(args, "elastic", False):
+        env.setdefault("MXNET_KVSTORE_ELASTIC", "1")
     return env
 
 
@@ -88,6 +90,8 @@ def _server_env(args, sid):
         "MXT_SERVER_URIS": ",".join(args.server_uris),
         "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
     })
+    if getattr(args, "elastic", False):
+        env.setdefault("MXNET_KVSTORE_ELASTIC", "1")
     return env
 
 
@@ -210,6 +214,13 @@ def main():
                          "(default: this process's cwd)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE env for every worker")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic membership (MXNET_KVSTORE_ELASTIC): a "
+                         "parameter server exiting — even killed — no "
+                         "longer fails the job; surviving workers "
+                         "re-stripe and hand state off over the roster "
+                         "(server 0, the coordinator, staying up is "
+                         "still required)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run on every worker")
     args = ap.parse_args()
@@ -272,10 +283,28 @@ def main():
             slive.remove(p)
             # exit 0 = the documented kStopServer shutdown (a worker's
             # kv.close(stop_servers=True)) — benign; only a CRASHED
-            # server (nonzero) fails the job
+            # server (nonzero) fails the job.  Under --elastic a dead
+            # server is a MEMBERSHIP event, not a job failure: the
+            # surviving workers evict it from the roster, re-derive
+            # striping and hand its state off (the workers' own exit
+            # codes still decide the job).
             if code != 0 and rc == 0:
-                rc = code
-                _kill_all()
+                sid = sprocs.index(p)
+                if args.elastic and sid != 0:
+                    print("launch.py: server %d exited %d; elastic job "
+                          "continues on the surviving roster"
+                          % (sid, code), flush=True)
+                else:
+                    # server 0 is the roster COORDINATOR: its death is
+                    # the one unrecoverable membership event
+                    # (docs/ROBUSTNESS.md) — fail fast instead of
+                    # letting every worker burn its reconnect budget
+                    if args.elastic:
+                        print("launch.py: coordinator (server 0) exited "
+                              "%d — unrecoverable; failing the job"
+                              % code, flush=True)
+                    rc = code
+                    _kill_all()
         time.sleep(0.1)
     # workers done: tear the servers down (the reference's scheduler sends
     # kStopServer at job end; here the launcher owns teardown)
