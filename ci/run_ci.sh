@@ -83,6 +83,28 @@ JAX_PLATFORMS=cpu timeout -k 10 240 \
     --env MXNET_FI_ONLY_SERVER=1 \
     python tests/dist/dist_elastic_membership.py
 
+echo "== coordinator-failover smoke (SIGKILL server 0 mid-epoch, no restart)"
+# Same arithmetic contract, but the SIGKILL now lands on the
+# COORDINATOR itself — the death PR 7 still fail-fasted on.  The
+# surviving workers elect the deterministic successor
+# (membership.elect_successor — pure roster arithmetic, no votes),
+# server 1 verifies the death and rebuilds the ledger at
+# max(reported)+1, the idempotent bseq barrier retries absorb whichever
+# replies died with server 0, and the job must COMPLETE WITHOUT RESTART
+# bit-identical to the static-roster golden.  MXNET_FI_ONLY_COORDINATOR
+# composes with the server-id filter so the plan names the ROLE, not
+# just the id.  Time-boxed: a succession regression presents as a hang
+# in the retried barrier.
+kill_acks0=$(MXT_PRINT_KILL_ACKS=1 MXT_KILL_SERVER=0 \
+    python tests/dist/dist_elastic_membership.py)
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py --elastic -n 2 -s 2 \
+    --env MXNET_FI_KILL_PROCESS_AFTER="$kill_acks0" \
+    --env MXNET_FI_ONLY_SERVER=0 \
+    --env MXNET_FI_ONLY_COORDINATOR=1 \
+    --env MXT_KILL_SERVER=0 \
+    python tests/dist/dist_elastic_membership.py
+
 echo "== serving smoke (replica + dynamic batcher + live weight refresh)"
 # The inference tier's acceptance across real process/socket boundaries
 # (docs/SERVING.md): one replica serves 64 concurrent requests through

@@ -143,13 +143,16 @@ declare_env("MXNET_KVSTORE_DEDUP_WINDOW", int, 8,
             "connection can serve its last request late)")
 declare_env("MXNET_KVSTORE_ELASTIC", bool, False,
             "dist_async elastic membership: servers/workers may join or "
-            "leave mid-job — versioned roster on server 0, stripe-plan "
-            "re-derivation + striped-state handoff on a roster bump, "
-            "barriers renegotiate instead of failing "
-            "(mxnet_tpu.membership; docs/ROBUSTNESS.md)")
+            "leave mid-job — versioned roster on the slot-0 coordinator "
+            "(with deterministic successor election when the "
+            "coordinator itself dies), stripe-plan re-derivation + "
+            "striped-state handoff on a roster bump, barriers "
+            "renegotiate instead of failing (mxnet_tpu.membership; "
+            "docs/ROBUSTNESS.md)")
 declare_env("MXNET_KVSTORE_SNAPSHOT_S", float, 0.0,
-            "elastic: seconds between each non-coordinator server's "
-            "state snapshot to the coordinator (the killed-server "
+            "elastic: seconds between each server's state-snapshot "
+            "beats, fanned out to EVERY peer so the bank outlives any "
+            "single server incl. the coordinator (the killed-server "
             "optimizer-state recovery source; 0 disables snapshots — "
             "weights still recover from the workers' quorum re-push)")
 declare_env("MXNET_KVSTORE_ELASTIC_PUSH_LOG", int, 256,
@@ -238,6 +241,17 @@ declare_env("MXNET_FI_KILL_PROCESS_AFTER", int, None,
 declare_env("MXNET_FI_ONLY_SERVER", int, None,
             "fault injection: restrict the process-kill plan to this "
             "DMLC_SERVER_ID (unset = all servers)")
+declare_env("MXNET_FI_ONLY_COORDINATOR", bool, False,
+            "fault injection: restrict the process-kill plans to the "
+            "process CURRENTLY holding the elastic roster coordinator "
+            "role (kvstore_server keeps the flag current across "
+            "failovers; composes with MXNET_FI_ONLY_SERVER and the "
+            "KILL_PROCESS_AFTER / KILL_ON_BEAT_SEQ kill points)")
+declare_env("MXNET_FI_KILL_ON_BEAT_SEQ", int, None,
+            "fault injection: SIGKILL this process when its elastic "
+            "beat loop sends beat number N — the deterministic beat-"
+            "boundary kill point for coordinator-failover tests, where "
+            "the enveloped-ack count is timing-dependent (unset = off)")
 
 
 # ---------------------------------------------------------------------------

@@ -133,6 +133,22 @@ def _register_dead_node_source(obj) -> None:
     _dead_node_sources.append(weakref.ref(obj))
 
 
+def _live_sources():
+    """The registry's still-alive objects, pruning dead weakrefs as a
+    side effect — the one deref/prune loop every aggregate reads
+    through (num_dead_nodes / roster_generation /
+    coordinator_failovers)."""
+    for ref in list(_dead_node_sources):
+        obj = ref()
+        if obj is None:
+            try:
+                _dead_node_sources.remove(ref)
+            except ValueError:
+                pass
+            continue
+        yield obj
+
+
 def num_dead_nodes() -> int:
     """Reference parity: KVStore::get_num_dead_node (kvstore.h:328).
 
@@ -146,14 +162,7 @@ def num_dead_nodes() -> int:
     itself here — a server whose channel has gone silent past
     ``MXNET_KVSTORE_HEARTBEAT_TIMEOUT`` counts as a dead node."""
     total = 0
-    for ref in list(_dead_node_sources):
-        obj = ref()
-        if obj is None:
-            try:
-                _dead_node_sources.remove(ref)
-            except ValueError:
-                pass
-            continue
+    for obj in _live_sources():
         try:
             total += obj.num_dead_nodes()
         except Exception:  # noqa: BLE001 — a broken source is not a death
@@ -170,14 +179,29 @@ def roster_generation() -> int:
     cluster lost or gained members and this process has already
     re-derived its striping against the survivors."""
     best = 0
-    for ref in list(_dead_node_sources):
-        obj = ref()
-        if obj is None:
-            continue
+    for obj in _live_sources():
         gen = getattr(obj, "_roster_gen", None)
         if isinstance(gen, int) and gen > best:
             best = gen
     return best
+
+
+def coordinator_failovers() -> int:
+    """Coordinator successions any open dist_async store in this
+    process has ridden through (0 = the bootstrap coordinator still
+    leads).  The companion read to :func:`roster_generation`: a
+    generation that moved says the roster churned; a failover count
+    that moved says the churn took the COORDINATOR itself — the elastic
+    layer elected a successor, rebuilt the ledger and kept going
+    (profiler gauges ``kvstore.coordinator_slot`` and
+    ``kvstore.failover_rebuild_s`` carry the detail).  Same weakref
+    registry as ``num_dead_nodes``."""
+    total = 0
+    for obj in _live_sources():
+        n = getattr(obj, "_failovers", None)
+        if isinstance(n, int):
+            total += n
+    return total
 
 
 def shutdown() -> None:

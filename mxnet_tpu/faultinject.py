@@ -14,7 +14,15 @@ or an env-configured worker process — arms a plan:
   must be deduped), or while awaiting its ack (``on_recv``);
 * **delay acks** server-side (widens race windows deterministically);
 * **refuse connects** client-side and/or **drop accepts** server-side
-  (exercises connect/reconnect backoff).
+  (exercises connect/reconnect backoff);
+* **kill the process** after exactly N enveloped replies
+  (``kill_process_after_acks``) or at beat number N of the elastic beat
+  loop (``kill_on_beat_seq``) — REAL SIGKILL, the preemption shape the
+  elastic membership and coordinator-failover machinery must survive;
+  target one server id (``MXNET_FI_ONLY_SERVER``) and/or the process
+  currently holding the COORDINATOR role
+  (``MXNET_FI_ONLY_COORDINATOR``, kept current across failovers by
+  ``note_coordinator``).
 
 Heartbeat channels are exempt (the hooks are only called with
 ``fi_role`` set on DATA-channel traffic), so a plan severs exactly the
@@ -65,9 +73,25 @@ class _Plan:
         self.kill_process_after = None  # SIGKILL self after n served acks
         self.acks_served = 0            # enveloped replies counted
         self.only_server = None         # limit process kill to one server id
+        self.only_coordinator = False   # limit process kill to the
+        #                                 CURRENT roster coordinator
+        self.kill_on_beat_seq = None    # SIGKILL self at beat number n
 
 
 _plan = _Plan()
+
+# Whether THIS process currently holds the elastic roster COORDINATOR
+# role.  kvstore_server keeps it current (ctor role, every beat tick,
+# and at failover promotion), so MXNET_FI_ONLY_COORDINATOR plans track
+# the role across a succession instead of a fixed server id.
+_is_coordinator = False
+
+
+def note_coordinator(flag: bool) -> None:
+    """Record whether this process is the roster coordinator right now
+    (called by kvstore_server; the ONLY_COORDINATOR filter reads it)."""
+    global _is_coordinator
+    _is_coordinator = bool(flag)
 
 
 def _rank_active():
@@ -77,9 +101,12 @@ def _rank_active():
 
 
 def _server_active():
-    if _plan.only_server is None:
-        return True
-    return os.environ.get("DMLC_SERVER_ID", "0") == str(_plan.only_server)
+    if _plan.only_server is not None and \
+            os.environ.get("DMLC_SERVER_ID", "0") != str(_plan.only_server):
+        return False
+    if _plan.only_coordinator and not _is_coordinator:
+        return False
+    return True
 
 
 def reset():
@@ -101,7 +128,8 @@ def stats() -> dict:
 
 def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
               refuse_connects=0, refuse_accepts=0, only_rank=None,
-              kill_unacked=None, kill_process_after=None, only_server=None):
+              kill_unacked=None, kill_process_after=None, only_server=None,
+              only_coordinator=False, kill_on_beat_seq=None):
     """Arm a plan directly (the non-context-manager form; multi-process
     scripts use this after deciding per-rank what to inject)."""
     if kill_point not in KILL_POINTS:
@@ -123,6 +151,9 @@ def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
                                     if kill_process_after else None)
         _plan.acks_served = 0
         _plan.only_server = only_server
+        _plan.only_coordinator = bool(only_coordinator)
+        _plan.kill_on_beat_seq = (int(kill_on_beat_seq)
+                                  if kill_on_beat_seq else None)
 
 
 @contextlib.contextmanager
@@ -176,6 +207,26 @@ def kill_process_after_acks(n):
     finally:
         with _lock:
             _plan.kill_process_after = None
+
+
+@contextlib.contextmanager
+def kill_on_beat_seq(n):
+    """SIGKILL THIS PROCESS when its elastic beat loop sends beat number
+    ``n`` — the deterministic BEAT-boundary kill point.  The enveloped-
+    ack count (``kill_process_after_acks``) is the right dial for a
+    data-shard server, but the COORDINATOR also serves barrier
+    rendezvous and roster ops whose ack ordering is timing-dependent;
+    the beat seq is process-monotonic and advances only in the beat
+    loop, so a coordinator death lands at an exact protocol boundary
+    every run.  Env form: ``MXNET_FI_KILL_ON_BEAT_SEQ`` (compose with
+    ``MXNET_FI_ONLY_SERVER`` / ``MXNET_FI_ONLY_COORDINATOR``)."""
+    with _lock:
+        _plan.kill_on_beat_seq = int(n)
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.kill_on_beat_seq = None
 
 
 @contextlib.contextmanager
@@ -336,6 +387,22 @@ def server_replied():
     _sigkill_self()
 
 
+def server_beat(seq):
+    """Called by the elastic beat loop with every beat it sends (the seq
+    is process-monotonic across all peers).  Fires the armed beat-
+    boundary SIGKILL — real process death at an exact beat number, the
+    deterministic way to kill a COORDINATOR whose enveloped-ack count
+    is timing-dependent (it serves barrier rendezvous)."""
+    with _lock:
+        if _plan.kill_on_beat_seq is None or not _server_active():
+            return
+        if int(seq) < _plan.kill_on_beat_seq:
+            return
+        _plan.kill_on_beat_seq = None       # fire once
+        _plan.kills_fired += 1
+    _sigkill_self()
+
+
 def _arm_from_env():
     """One-shot env activation (multi-process tests: the launcher can't
     reach into a worker, but its environment can)."""
@@ -345,9 +412,11 @@ def _arm_from_env():
     ra = os.environ.get("MXNET_FI_REFUSE_ACCEPTS")
     dl = os.environ.get("MXNET_FI_DELAY_ACK_MS")
     kp = os.environ.get("MXNET_FI_KILL_PROCESS_AFTER")
+    kb = os.environ.get("MXNET_FI_KILL_ON_BEAT_SEQ")
     orank = os.environ.get("MXNET_FI_ONLY_RANK")
     osrv = os.environ.get("MXNET_FI_ONLY_SERVER")
-    if not (ka or ku or rc or ra or dl or kp):
+    ocoord = os.environ.get("MXNET_FI_ONLY_COORDINATOR")
+    if not (ka or ku or rc or ra or dl or kp or kb):
         return
     configure(
         kill_after=int(ka) if ka else None,
@@ -358,7 +427,10 @@ def _arm_from_env():
         refuse_accepts=int(ra) if ra else 0,
         only_rank=int(orank) if orank else None,
         kill_process_after=int(kp) if kp else None,
-        only_server=int(osrv) if osrv else None)
+        only_server=int(osrv) if osrv else None,
+        only_coordinator=bool(ocoord) and
+        ocoord.lower() not in ("0", "false", "off", ""),
+        kill_on_beat_seq=int(kb) if kb else None)
 
 
 _arm_from_env()

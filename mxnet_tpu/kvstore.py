@@ -868,7 +868,11 @@ class KVStoreDistAsync(KVStore):
                                else bool(roster_member)) and self._elastic
         self._roster_gen = 0
         self._roster_servers = list(uri_list)
+        self._bootstrap_servers = list(uri_list)
         self._live_workers = None
+        self._failovers = 0           # coordinator successions ridden
+        self._coordinator_slot = 0    # bootstrap slot of the coordinator
+        self._barrier_seq = 0         # per-worker barrier sequence
         self._pull_cache: Dict[str, np.ndarray] = {}
         self._push_log: Dict[str, list] = {}
         self._push_log_order = None
@@ -877,20 +881,45 @@ class KVStoreDistAsync(KVStore):
         if self._elastic:
             import collections
             self._push_log_order = collections.deque()
-            # dial the coordinator alone first: other bootstrap uris may
-            # already be stale (a late joiner arrives AFTER churn)
-            coord = _ServerConn(uri_list[0])
+            # dial the bootstrap uris in order until one answers the
+            # roster op: slot 0 is the coordinator in the common case,
+            # but a late joiner may arrive AFTER churn — any surviving
+            # server forwards the op one hop to the live coordinator
+            # (kvstore_server "roster_fwd"), so reaching ANY of them is
+            # enough to converge onto the current roster
+            join_msg = (("roster_join", "worker", self.rank)
+                        if self._roster_member else ("roster_get",))
+            coord = reply = last_exc = None
+            for i, u in enumerate(uri_list):
+                try:
+                    c = _ServerConn(u, connect_timeout=(
+                        60.0 if i == 0 else 15.0))
+                except MXNetError as exc:
+                    last_exc = exc
+                    continue
+                try:
+                    reply = c.submit(join_msg, wait=True)
+                    coord = c
+                    break
+                except MXNetError as exc:
+                    last_exc = exc
+                    c.close(retry=False)
+            if reply is None:
+                raise MXNetError(
+                    "kvstore dist_async: no bootstrap server answered "
+                    f"the roster (tried {uri_list}): {last_exc}")
             self._conns = [coord]
-            if self._roster_member:
-                reply = coord.submit(
-                    ("roster_join", "worker", self.rank), wait=True)
-            else:
-                reply = coord.submit(("roster_get",), wait=True)
-            gen, servers, workers = reply
+            gen, servers, workers = reply[0], reply[1], reply[2]
+            if len(reply) > 3:
+                # worker-join replies carry the cohort's barrier floor:
+                # seeding our sequence there keeps raw barrier seqs
+                # globally aligned, so arrivals pair exactly even
+                # against a failover successor with empty barrier state
+                self._barrier_seq = int(reply[3])
             conns = []
             for u in servers:
-                conns.append(coord if u == uri_list[0] else _ServerConn(u))
-            if uri_list[0] not in servers:
+                conns.append(coord if u == coord._uri else _ServerConn(u))
+            if coord._uri not in servers:
                 coord.close(retry=False)
             self._conns = conns
             self._roster_gen = int(gen)
@@ -1002,6 +1031,19 @@ class KVStoreDistAsync(KVStore):
         return self._conns[stripe_server_index(k, i, len(self._conns))]
 
     # -- elastic membership (worker half; mxnet_tpu.membership) --------------
+    def _coordinator_conn(self) -> _ServerConn:
+        """The channel to the CURRENT roster coordinator — derived via
+        membership.coordinator_uri (the worker-side twin of the
+        server's _coordinator_addr, one source of truth for both).
+        Connections are kept in roster order, so this is conns[0]
+        except transiently mid-repair."""
+        from .membership import coordinator_uri
+        curi = coordinator_uri(self._roster_servers)
+        for c in self._conns:
+            if c._uri == curi:
+                return c
+        return self._conns[0]
+
     def _elastic_attempt(self, fn):
         """Run one kv op; under MXNET_KVSTORE_ELASTIC a channel failure
         triggers a roster repair (report the dead server, re-derive
@@ -1024,29 +1066,64 @@ class KVStoreDistAsync(KVStore):
         """Converge this worker onto the live roster after a failure.
         Returns True when anything changed (retry is worth it): a
         generation bump was applied, or a poisoned-but-alive connection
-        was re-dialed.  The COORDINATOR going down is the one
-        unrecoverable death (v1 contract, docs/ROBUSTNESS.md): repair
-        reports False and the original failure propagates."""
+        was re-dialed.
+
+        The COORDINATOR going down is just another membership event:
+        this worker independently elects
+        ``membership.elect_successor(roster, dead)`` — the same pure
+        arithmetic every other observer computes, no votes — and
+        reports the death THERE.  The successor verifies the death with
+        its own probe, rebuilds the ledger at max(reported
+        generation)+1 and answers with the post-succession roster; the
+        ordinary three-phase handoff then reconstructs the dead
+        coordinator's stripes.  Only every-server-dead is
+        unrecoverable (elect_successor returns None)."""
+        from . import membership as _mem
         from . import profiler as _prof
-        coord = self._conns[0]
         dead, poisoned = [], []
         for c in self._conns:
             if (c._err is not None and c._sock is None) or c.is_dead():
                 dead.append(c)
             elif c._err is not None:
                 poisoned.append(c)
-        if coord in dead:
-            return False
-        try:
-            reply = None
-            for c in dead:
-                reply = coord.submit(
-                    ("roster_dead", "server", c._uri), wait=True)
-                _prof.record_channel_event("kvstore.eviction_reported")
-            if reply is None:
-                reply = coord.submit(("roster_get",), wait=True)
-        except MXNetError:
-            return False
+        dead_uris = {c._uri for c in dead}
+        coord_uri = _mem.coordinator_uri(self._roster_servers)
+        succession = coord_uri in dead_uris
+        reply = None
+        while True:
+            if coord_uri in dead_uris:
+                succ_uri = _mem.elect_successor(self._roster_servers,
+                                                dead_uris)
+                if succ_uri is None:
+                    return False   # every server dead: nothing to elect
+                target = next((c for c in self._conns
+                               if c._uri == succ_uri), None)
+                if target is None:
+                    return False   # conns/roster diverged: no dial
+            else:
+                target = self._coordinator_conn()
+            try:
+                # report the dead coordinator FIRST: the hint lets the
+                # successor verify + promote inside this very request
+                for uri in sorted(dead_uris, key=lambda u: u != coord_uri):
+                    reply = target.submit(
+                        ("roster_dead", "server", uri), wait=True)
+                    _prof.record_channel_event("kvstore.eviction_reported")
+                if reply is None:
+                    reply = target.submit(("roster_get",), wait=True)
+                break
+            except MXNetError:
+                if target._err is not None and target._sock is None \
+                        and target._uri not in dead_uris:
+                    # the elected target ITSELF died before answering
+                    # (simultaneous multi-server preemption): its
+                    # channel is now hard evidence — add it to the dead
+                    # set and walk the election to the next slot, the
+                    # same probe-walk the server side runs
+                    dead_uris.add(target._uri)
+                    succession = True
+                    continue
+                return False   # an app refusal / unreachable roster
         gen, servers, workers = reply
         if int(gen) == self._roster_gen and not dead and not poisoned:
             return False
@@ -1062,17 +1139,21 @@ class KVStoreDistAsync(KVStore):
             uri = next((u for u in servers if u in str(exc)), None)
             if uri is not None:
                 try:
-                    coord.submit(("roster_dead", "server", uri),
-                                 wait=True)
+                    target.submit(("roster_dead", "server", uri),
+                                  wait=True)
                 except MXNetError:
                     pass
             return False
+        if succession:
+            self._failovers += 1
+            _prof.record_channel_event(
+                "kvstore.coordinator_failover_observed")
         return True
 
     def _elastic_refresh(self):
         """Pull the roster and converge if it moved (the cheap path a
         barrier-reply generation bump triggers)."""
-        reply = self._conns[0].submit(("roster_get",), wait=True)
+        reply = self._coordinator_conn().submit(("roster_get",), wait=True)
         gen, servers, workers = reply
         if int(gen) != self._roster_gen:
             self._apply_roster(int(gen), servers, workers)
@@ -1118,6 +1199,14 @@ class KVStoreDistAsync(KVStore):
         _prof.record_channel_event("kvstore.roster_bump")
         _prof.record_channel_gauge("kvstore.roster_generation",
                                    self._roster_gen)
+        # which bootstrap slot leads now (-1 = a joined-later server):
+        # a failover is observable as this gauge moving off slot 0
+        curi = _mem.coordinator_uri(servers)
+        self._coordinator_slot = (
+            self._bootstrap_servers.index(curi)
+            if curi in self._bootstrap_servers else -1)
+        _prof.record_channel_gauge("kvstore.coordinator_slot",
+                                   self._coordinator_slot)
         # a joined-mid-job server has no updater yet: every worker ships
         # the optimizer (idempotent — same object) before any state or
         # gradient can reach the new shard
@@ -1225,8 +1314,8 @@ class KVStoreDistAsync(KVStore):
         per_wire = {}
         for u in departed:
             try:
-                snap = self._conns[0].submit(("roster_snapshot", u),
-                                             wait=True)
+                snap = self._coordinator_conn().submit(
+                    ("roster_snapshot", u), wait=True)
             except MXNetError:
                 snap = None
             if snap:
@@ -1636,23 +1725,41 @@ class KVStoreDistAsync(KVStore):
 
     def barrier(self):
         """Flush this worker's outstanding pushes, then rendezvous on
-        server 0 (reference: Postoffice::Barrier after engine drain).
-        The wait is unbounded, but a participant that dies mid-wait is
-        NAMED — with its last-heartbeat age — in the static-roster
-        failure; under MXNET_KVSTORE_ELASTIC the barrier RENEGOTIATES
-        instead: the coordinator evicts the silent rank, re-targets the
-        live worker count and wakes the parked survivors, and the reply
-        carries the roster generation so a bump is discovered (and
-        converged onto) at every sync point for free."""
+        the roster coordinator (reference: Postoffice::Barrier after
+        engine drain).  The wait is unbounded, but a participant that
+        dies mid-wait is NAMED — with its last-heartbeat age — in the
+        static-roster failure; under MXNET_KVSTORE_ELASTIC the barrier
+        RENEGOTIATES instead: the coordinator evicts the silent rank,
+        re-targets the live worker set and wakes the parked survivors,
+        and the reply carries the roster generation so a bump is
+        discovered (and converged onto) at every sync point for free.
+
+        Arrivals carry this worker's barrier SEQUENCE number, making
+        them idempotent: when the COORDINATOR dies mid-wait, the elastic
+        retry re-sends the SAME (rank, seq) arrival to the elected
+        successor — released immediately if the rendezvous already
+        happened before the reply was lost, counted once otherwise —
+        so a failover can never skew the workers' barrier pairing."""
         # the flush is idempotent (a no-op command per channel), so a
-        # channel death here repairs and retries cleanly; the barrier
-        # submit itself is NOT retried — the coordinator channel dying
-        # is the unrecoverable case anyway
+        # channel death here repairs and retries cleanly
         self._elastic_attempt(self._flush_all)
-        payload = self._conns[0].submit(("barrier",), wait=True)
+        self._barrier_seq += 1
+        bseq = self._barrier_seq
+        payload = self._elastic_attempt(
+            lambda: self._coordinator_conn().submit(("barrier", bseq),
+                                                    wait=True))
+        if isinstance(payload, (tuple, list)) and len(payload) == 2:
+            # the coordinator realigned this (re-)joined rank to the
+            # cohort's pending rendezvous: adopt the effective sequence
+            # so every later raw sequence is globally aligned again
+            payload, realign = payload
+            self._barrier_seq = bseq + int(realign)
         if self._elastic and isinstance(payload, int) \
                 and payload != self._roster_gen:
-            self._elastic_refresh()
+            # the refresh rides the repair wrapper too: the coordinator
+            # can die in the reply-to-refresh window, and that death is
+            # as survivable as any other
+            self._elastic_attempt(self._elastic_refresh)
 
     def _flush_all(self):
         for c in self._conns:
@@ -1672,7 +1779,7 @@ class KVStoreDistAsync(KVStore):
             # graceful departure: deregister so the surviving workers'
             # barriers re-target without waiting out a heartbeat timeout
             try:
-                self._conns[0].submit(
+                self._coordinator_conn().submit(
                     ("roster_leave", "worker", self.rank), wait=True)
             except MXNetError:
                 pass  # the coordinator will evict us on silence instead

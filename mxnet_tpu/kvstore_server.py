@@ -325,9 +325,29 @@ class KVStoreServer:
         self._updater = None
         self._lock = threading.Lock()
         self._barrier_cv = threading.Condition()
-        self._barrier_count = 0
-        self._barrier_gen = 0
-        self._barrier_ranks = set()   # ranks currently arrived
+        # barrier state is per-rank SEQUENCE-numbered, not a bare count:
+        # an arrival (rank, b) is released once every live rank's
+        # highest arrival reaches b.  In the common case (all ranks at
+        # the same b) the last arrival releases everyone — exactly the
+        # old counting behavior — but a worker whose barrier reply died
+        # with a failing coordinator can RETRY the same logical barrier
+        # (same b) against the successor idempotently, instead of
+        # entering a phantom extra rendezvous that would skew every
+        # later barrier and hang the job's final one.
+        self._barrier_high = {}   # rank -> highest bseq arrived
+        self._barrier_done = {}   # rank -> highest bseq released
+        # joiners align to the cohort: a rank that joins (or rejoins)
+        # mid-job may arrive with a sequence below the cohort's pending
+        # rendezvous; its first arrival is offset there ONE-SHOT and
+        # the offset rides the reply so the CLIENT adopts the effective
+        # sequence — deliberately no server-side offset state, so a
+        # failover successor starting empty loses nothing
+        self._barrier_joined = set()   # ranks whose next arrival aligns
+        # the client identity last seen BARRIERING per rank: a fresh
+        # client generation under an old rank id (a job resumed against
+        # live servers) starts a fresh sequence — stale release marks
+        # must not no-op its first rendezvous
+        self._barrier_client = {}
         self._stop = threading.Event()
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.5)
@@ -374,12 +394,17 @@ class KVStoreServer:
         # core op.
         self._ext_ops = {}
         # -- elastic membership (mxnet_tpu.membership) --------------------
-        # Server 0 of the roster is the COORDINATOR: it owns the
-        # generation-numbered membership ledger, renegotiates barriers
-        # when a rank is evicted, and banks non-coordinator servers'
-        # periodic state snapshots (the killed-server recovery source).
-        # Non-coordinator elastic servers run a beat loop toward the
-        # coordinator instead.
+        # Slot 0 of the CURRENT roster is the COORDINATOR
+        # (membership.coordinator_uri — roster-derived, not a fixed
+        # server id): it owns the generation-numbered membership ledger,
+        # renegotiates barriers when a rank is evicted, and banks the
+        # other servers' periodic state snapshots.  EVERY elastic server
+        # runs the beat loop, fanning beats (and snapshots) out to every
+        # peer — so the snapshot bank outlives any single server — and
+        # on coordinator silence each survivor independently elects
+        # membership.elect_successor; the elected one verifies the death
+        # and promotes itself (_maybe_promote), rebuilding the ledger
+        # from the survivors' ledger_reports + its local peer bank.
         self._elastic = bool(_env("MXNET_KVSTORE_ELASTIC", False)
                              if elastic is None else elastic)
         self.uri = uri or f"{host}:{self.port}"
@@ -393,6 +418,21 @@ class KVStoreServer:
         self._beat_thread = None
         self._beat_seq = 0
         self._snapshot_s = float(_env("MXNET_KVSTORE_SNAPSHOT_S", 0.0))
+        # this server's view of the live roster (updated from every
+        # coordinator beat reply) — the rebuild source on promotion
+        self._known_roster = None
+        self._known_gen = 0
+        self._known_workers = None
+        # peer snapshot bank: uri -> (beat seq, snapshot struct).  Grown
+        # from the beat fan-out on EVERY server, so the killed-server
+        # recovery source no longer dies with server 0; promoted into
+        # the rebuilt ledger on failover.
+        self._peer_snapshots = {}
+        self._promoted = False        # this server succeeded a dead coord
+        self._coord_last_ok = None    # last successful coordinator beat
+        self._coord_refused = False   # last coordinator dial was refused
+        self._peer_heard = set()      # peers that EVER acked a beat
+        self._peer_refused = set()    # heard-then-refused peers (evidence)
         # handoff dedup: wire key -> newest applied roster generation
         # (values), same for optimizer state; base key -> generation the
         # stale wire forms were purged at.  Quorum re-pushes and
@@ -411,7 +451,7 @@ class KVStoreServer:
                   "command", "barrier", "req", "roster_get",
                   "roster_join", "roster_leave", "roster_dead",
                   "roster_beat", "roster_snapshot", "handoff",
-                  "handoff_state"):
+                  "handoff_state", "ledger_report", "roster_fwd"):
             raise ValueError(f"cannot override core kvstore op {op!r}")
         self._ext_ops[op] = fn
 
@@ -435,7 +475,7 @@ class KVStoreServer:
             else:
                 stored._set_data(grad._data)
 
-    def _handle(self, msg, rank=None):
+    def _handle(self, msg, rank=None, client=None):
         op = msg[0]
         if op == "ping":
             # heartbeat: out-of-band liveness (its own connection — the
@@ -542,26 +582,52 @@ class KVStoreServer:
             _, head, body = msg
             return self._command(head, body)
         if op == "barrier":
-            return self._barrier(rank)
+            return self._barrier(rank, msg[1] if len(msg) > 1 else None,
+                                 client=client)
         if op == "roster_get":
-            return self._roster_get()
+            return self._roster_op(("roster_get",))
         if op in ("roster_join", "roster_leave", "roster_dead"):
             _, role, ident = msg
-            return self._roster_mutate(op[len("roster_"):], role, ident)
+            return self._roster_op((op, role, ident))
+        if op == "roster_fwd":
+            # a peer forwarded a roster op it could not serve (it is not
+            # the coordinator): dispatch locally, NEVER re-forward — one
+            # hop bounds the succession-window relay
+            return self._roster_op(tuple(msg[1]), forwarded=True)
         if op == "roster_beat":
-            # a non-coordinator server's liveness beat, optionally
-            # carrying its state snapshot (raw message: beats must never
-            # be stalled by a delay-acks fault plan, like heartbeats)
+            # a peer server's liveness beat, optionally carrying its
+            # state snapshot (raw message: beats must never be stalled
+            # by a delay-acks fault plan, like heartbeats).  EVERY
+            # elastic server banks the snapshot — the bank must outlive
+            # the coordinator — and the coordinator's reply carries the
+            # full roster so peers track the membership they may one
+            # day have to rebuild.
             _, suri, seq, snap = msg
+            self._bank_peer_snapshot(suri, seq, snap)
             m = self._get_membership()
             if m is None:
                 return None
             m.note_server_beat(suri, seq=seq, snapshot=snap)
-            return m.generation
+            return m.roster().as_wire()
         if op == "roster_snapshot":
+            # serve from the ledger bank OR the local peer bank: the
+            # request must be answerable on whichever server is the
+            # coordinator after a failover
             _, ident = msg
-            m = self._require_membership()
-            return m.snapshot_of(ident)
+            m = self._get_membership()
+            snap = m.snapshot_of(ident) if m is not None else None
+            if snap is None:
+                have = self._peer_snapshots.get(ident)
+                snap = have[1] if have else None
+            if snap is None and m is None:
+                self._require_membership()   # classic not-coordinator error
+            return snap
+        if op == "ledger_report":
+            # ("ledger_report", True) is the SLIM form the promotion
+            # sweep uses (generation + beat seq only); the bare op also
+            # names the live key set, for operator forensics
+            return self._ledger_report(
+                slim=bool(msg[1]) if len(msg) > 1 else False)
         if op == "handoff":
             _, gen, wire_key, arr, bkey = msg
             return self._apply_handoff(int(gen), wire_key, arr, bkey)
@@ -607,7 +673,7 @@ class KVStoreServer:
         reply = None
         try:
             try:
-                reply = ("ok", self._handle(inner, rank=rank))
+                reply = ("ok", self._handle(inner, rank=rank, client=cid))
             except Exception as exc:  # noqa: BLE001 — to the client
                 reply = ("err", f"{type(exc).__name__}: {exc}")
         finally:
@@ -663,18 +729,53 @@ class KVStoreServer:
         return "; ".join(parts)
 
     # -- elastic membership (coordinator half; mxnet_tpu.membership) ---------
+    def _roster_uris(self, self_fallback=True):
+        """This server's best view of the roster server order: the live
+        roster learned from coordinator beat replies, else the bootstrap
+        roster (ctor / MXT_SERVER_URIS — in-process tests set the env
+        after binding ports), else just self (``self_fallback=False``
+        returns [] instead, for callers that must distinguish "no
+        roster source at all" — coordinator-role derivation falls back
+        to the launcher's server_id there)."""
+        uris = (self._known_roster or self._roster_servers
+                or [u for u in os.environ.get(
+                    "MXT_SERVER_URIS", "").split(",") if u])
+        if not uris and self_fallback:
+            return [self.uri]
+        return uris
+
+    def _is_coordinator(self):
+        """Whether THIS server currently holds the coordinator role —
+        roster-derived (membership.coordinator_uri over the live view),
+        never a hardcoded server id: a failover re-seats slot 0.  A
+        promoted successor stays coordinator for good (the old one is
+        dead by verified evidence).  Until ANY roster source exists
+        (ctor roster, beat replies, MXT_SERVER_URIS — in-process tests
+        set the env after binding ports), the launcher's server_id
+        decides: without this, the [self.uri] fallback would make EVERY
+        just-started elastic server consider itself coordinator, arming
+        ONLY_COORDINATOR fault plans (and minting throwaway ledgers) on
+        non-slot-0 servers."""
+        if not self._elastic:
+            return False
+        if self._promoted:
+            return True
+        from .membership import coordinator_uri
+        uris = self._roster_uris(self_fallback=False)
+        if not uris:
+            return self.server_id == 0
+        return coordinator_uri(uris) == self.uri
+
     def _get_membership(self):
-        """The coordinator ledger — server 0 of an elastic roster only
-        (lazily created so in-process tests can bind ports and set
-        MXT_SERVER_URIS before the first roster op arrives)."""
-        if not self._elastic or self.server_id != 0:
+        """The coordinator ledger — the roster's slot-0 server of an
+        elastic job only (lazily created so in-process tests can bind
+        ports and set MXT_SERVER_URIS before the first roster op
+        arrives)."""
+        if not self._is_coordinator():
             return None
         with self._membership_lock:
             if self._membership is None:
-                uris = self._roster_servers or \
-                    [u for u in os.environ.get(
-                        "MXT_SERVER_URIS", "").split(",") if u] or \
-                    [self.uri]
+                uris = self._roster_uris()
                 from .membership import MembershipCoordinator
                 self._membership = MembershipCoordinator(
                     uris, range(self.num_workers))
@@ -684,9 +785,297 @@ class KVStoreServer:
         m = self._get_membership()
         if m is None:
             raise RuntimeError(
-                "not the roster coordinator (roster ops go to server 0 "
-                "of an elastic job; set MXNET_KVSTORE_ELASTIC=1)")
+                "not the roster coordinator (roster ops go to slot 0 "
+                "of the live roster of an elastic job; set "
+                "MXNET_KVSTORE_ELASTIC=1)")
         return m
+
+    def _roster_op(self, inner, forwarded=False):
+        """Dispatch one roster op at the right server: locally when this
+        server is (or — on CONFIRMED coordinator death — just became)
+        the coordinator; otherwise forwarded ONE hop to the live
+        coordinator.  The forwarding keeps roster ops flowing through
+        the succession window: a worker or late joiner whose stale
+        roster points at any surviving server still reaches the ledger,
+        and its envelope replays dedup exactly like every other op."""
+        m = self._get_membership()
+        if m is None and self._elastic:
+            dead_hint = None
+            if inner[0] == "roster_dead" and len(inner) == 3 \
+                    and inner[1] == "server":
+                dead_hint = str(inner[2])
+            if self._maybe_promote(dead_hint=dead_hint):
+                m = self._get_membership()
+            else:
+                return self._forward_roster_op(inner, forwarded)
+        if m is None:
+            self._require_membership()   # raises the classic error
+        if inner[0] == "roster_get":
+            return self._roster_get(m)
+        _op, role, ident = inner
+        return self._roster_mutate(m, _op[len("roster_"):], role, ident)
+
+    def _forward_roster_op(self, inner, forwarded):
+        """Relay a roster op to the live coordinator over a short-lived
+        socket (one hop only).  A refused relay dial is itself death
+        evidence: re-try the succession check before giving up."""
+        from .membership import coordinator_uri, elect_successor
+        if forwarded:
+            raise RuntimeError(
+                "forwarded roster op reached a non-coordinator (roster "
+                "views diverged mid-succession); retry against the "
+                "current roster")
+        addr = self._coordinator_addr()
+        if addr is not None:
+            try:
+                status, payload = self._oneshot_request(
+                    addr, ("roster_fwd", list(inner)),
+                    self._hb_timeout or 15.0)
+                if status != "ok":
+                    raise RuntimeError(str(payload))
+                return payload
+            except (ConnectionError, OSError):
+                # the coordinator refused/died mid-relay: that IS local
+                # evidence — run the succession check before failing
+                curi = coordinator_uri(self._roster_uris())
+                if self._maybe_promote(dead_hint=curi):
+                    return self._roster_op(inner, forwarded=True)
+        curi = coordinator_uri(self._roster_uris())
+        succ = elect_successor(self._roster_uris(), {curi})
+        raise RuntimeError(
+            "not the roster coordinator (coordinator %s unreachable "
+            "from %s; deterministic successor is %s)"
+            % (curi, self.uri, succ))
+
+    # -- coordinator failover (succession + ledger rebuild) ------------------
+    def _coordinator_silent(self):
+        """LOCAL evidence of coordinator death from the beat loop: the
+        last dial was refused (decisive — the port is gone), or a
+        previously-acking coordinator has been silent past hb_timeout.
+        Never-heard-never-dead: a coordinator we never reached may still
+        be starting up."""
+        if self._coord_refused:
+            return True
+        if self._hb_timeout <= 0 or self._coord_last_ok is None:
+            return False
+        return time.monotonic() - self._coord_last_ok > self._hb_timeout
+
+    def _probe_confirmed_dead(self, curi):
+        """Probe a peer's listener before acting on its reported death
+        (the coordinator pre-promotion, and each intermediate slot the
+        succession election walks past).  ONLY a REFUSED dial confirms
+        death — the port is gone, the process with it.  A completed
+        connect means it is alive, and a TIMEOUT is inconclusive (a
+        slow or partitioned-from-us coordinator may still be serving
+        workers that can reach it): both REFUSE the promotion — the
+        no-split-brain guard.  Succession therefore never fires on
+        reachability alone; a host that vanishes without closing its
+        ports (cable pull) degrades to the pre-failover behavior
+        (the job fails loudly) rather than risking two coordinators."""
+        import socket as _socket
+        try:
+            sock = _socket.create_connection(
+                self._uri_addr(curi),
+                timeout=min(2.0, self._hb_timeout or 2.0))
+        except ConnectionRefusedError:
+            return True
+        except ValueError:
+            return True    # malformed uri can never serve again
+        except OSError:
+            return False   # timeout/unreachable: inconclusive, refuse
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return False
+
+    def _maybe_promote(self, dead_hint=None):
+        """Deterministic succession: promote this server to coordinator
+        iff (a) the current coordinator is confirmed dead by LOCAL
+        evidence — beat silence / refused dials, or a probe when a peer
+        reports it dead (``dead_hint``) — and (b)
+        membership.elect_successor over the last-known roster and the
+        full locally-evidenced dead set picks this very server.  When
+        the election lands on an INTERMEDIATE slot, that slot is probed
+        too and the election walks on if it is also dead — so a
+        simultaneous multi-server preemption (coordinator AND the next
+        slots) still seats the true survivor in one call.  Pure
+        arithmetic plus local probes, no votes.  Idempotent and
+        thread-safe; True when this server IS the coordinator on
+        exit."""
+        from .membership import coordinator_uri, elect_successor
+        if not self._elastic:
+            return False
+        if self._promoted:
+            return True
+        uris = self._roster_uris()
+        curi = coordinator_uri(uris)
+        if curi is None or curi == self.uri:
+            return self._is_coordinator()
+        hinted = dead_hint is not None and str(dead_hint) == curi
+        if not hinted and not self._coordinator_silent():
+            return False
+        if not self._probe_confirmed_dead(curi):
+            return False
+        dead = {curi} | set(self._peer_refused)
+        dead.discard(self.uri)
+        while True:
+            succ = elect_successor(uris, dead)
+            if succ is None or succ == self.uri:
+                break
+            if self._probe_confirmed_dead(succ):
+                dead.add(succ)     # intermediate slot dead too: walk on
+                continue
+            return False           # a live better-ranked successor leads
+        if succ != self.uri:
+            return False
+        self._promote_to_coordinator(dead)
+        return self._promoted
+
+    def _promote_to_coordinator(self, dead_uris):
+        """Become the coordinator: sweep the surviving servers for their
+        ledger_reports, rebuild the ledger at max(reported generation)+1
+        (membership.rebuild_ledger — stale-coordinator envelopes are
+        rejected by the existing per-generation staleness checks), and
+        promote the local peer snapshot bank into it.  ``dead_uris`` is
+        the election's full probe-confirmed dead set — every member is
+        excluded from the rebuilt roster, so a multi-death succession
+        never re-seats a corpse at slot 0.  In-flight roster ops from
+        workers replay against this server through the ordinary
+        exactly-once envelope path; the workers' three-phase handoff
+        then reconstructs the dead servers' stripes."""
+        from . import membership as _mem
+        if isinstance(dead_uris, str):
+            dead_uris = {dead_uris}
+        t0 = time.monotonic()
+        if self._promoted:
+            return
+        # the sweep dials peers with real socket timeouts: run it
+        # BEFORE taking the ledger lock, or every _get_membership()
+        # caller (barrier arrivals included) would stall behind the
+        # promotion's network round trips.  Racing promoters both
+        # sweep; the lock below picks one winner.
+        uris = [u for u in self._roster_uris() if u not in dead_uris]
+        reports = [self._ledger_report(slim=True)]
+        for u in uris:
+            if u == self.uri:
+                continue
+            r = self._sweep_ledger_report(u)
+            if r is not None:
+                reports.append(r)
+        workers = self._known_workers
+        if workers is None:
+            workers = range(self.num_workers)
+        with self._lock:
+            snapshots = dict(self._peer_snapshots)
+        with self._membership_lock:
+            if self._promoted:
+                return
+            self._membership = _mem.rebuild_ledger(
+                uris, workers, reports, snapshots)
+            self._promoted = True
+            self._known_roster = list(uris)
+            self._known_gen = self._membership.generation
+        faultinject.note_coordinator(True)
+        _prof.record_channel_event("kvstore.coordinator_failover")
+        _prof.record_channel_gauge("kvstore.coordinator_slot",
+                                   self.server_id)
+        _prof.record_channel_gauge("kvstore.failover_rebuild_s",
+                                   time.monotonic() - t0)
+        _prof.record_channel_gauge("kvstore.roster_generation",
+                                   self._known_gen)
+        print("kvstore server %d (%s): promoted to roster coordinator "
+              "(predecessor(s) %s dead; generation resumes at %d)"
+              % (self.server_id, self.uri, sorted(dead_uris),
+                 self._known_gen), flush=True)
+
+    def _ledger_report(self, slim=False):
+        """This server's contribution to a successor's ledger rebuild:
+        last-known generation and beat seq (the successor resumes the
+        generation counter past every report, so any envelope the dead
+        coordinator's epoch stamped is stale).  The full form also
+        names the live key set — operator forensics (which keys a dead
+        server held), NOT a merge input; the promotion sweep asks for
+        ``slim=True`` so a real job's thousands of wire keys never ride
+        the latency-critical rebuild."""
+        m = self._membership
+        gen = m.generation if m is not None else self._known_gen
+        with self._lock:
+            # any generation this shard WITNESSED raises the floor: a
+            # handoff applied at G proves G was issued even if no beat
+            # reply ever carried it here (the coordinator can die within
+            # one beat interval of issuing G — the correlated-preemption
+            # window).  Without this the successor could resume AT G and
+            # the per-(wire key, generation) handoff dedup would swallow
+            # the next round's handoffs as duplicates.
+            for d in (self._handoff_gen, self._handoff_state_gen,
+                      self._handoff_base_gen):
+                if d:
+                    gen = max(gen, max(d.values()))
+            keys = None if slim else sorted(self._store)
+        out = {"uri": self.uri, "generation": int(gen),
+               "beat_seq": int(self._beat_seq)}
+        if keys is not None:
+            out["keys"] = keys
+        return out
+
+    def _oneshot_request(self, addr, msg, timeout):
+        """One raw request over a short-lived socket — the shared dial/
+        send/await/close shape behind roster forwarding and the ledger
+        sweep (one place to keep the nodelay/timeout treatment).
+        Returns the (status, payload) reply; transport faults raise so
+        each caller keeps its own error policy."""
+        import socket as _socket
+        sock = _socket.create_connection(addr, timeout=timeout)
+        try:
+            sock.settimeout(timeout)
+            _set_nodelay(sock)
+            _send_msg(sock, msg)
+            return _recv_msg(sock)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _sweep_ledger_report(self, uri):
+        """Demand one peer's ledger_report over a short-lived socket
+        (promotion sweep).  An unreachable peer is skipped — it either
+        re-joins through the ordinary path or gets evicted on
+        silence."""
+        try:
+            status, payload = self._oneshot_request(
+                self._uri_addr(uri), ("ledger_report", True),
+                min(5.0, self._hb_timeout or 5.0))
+            return payload if status == "ok" else None
+        except Exception:  # noqa: BLE001 — an unreachable peer is skipped
+            return None
+
+    def _bank_peer_snapshot(self, uri, seq, snap):
+        """Bank one peer's beat snapshot locally — the every-server half
+        of the bank that must outlive server 0 (membership.bank_newest
+        is the shared newest-seq-wins rule)."""
+        from .membership import bank_newest
+        with self._lock:
+            bank_newest(self._peer_snapshots, uri, seq, snap)
+
+    def _note_roster_wire(self, payload):
+        """Digest a beat reply carrying the live roster (only
+        coordinators put one on the wire).  Generation-monotonic: a
+        stale roster — an old coordinator that has not yet learned of
+        its own replacement — can never regress this server's view."""
+        try:
+            gen, servers, workers = payload
+        except (TypeError, ValueError):
+            return
+        if not isinstance(servers, (list, tuple)) or not servers:
+            return
+        if int(gen) < self._known_gen:
+            return
+        self._known_gen = int(gen)
+        self._known_roster = [str(u) for u in servers]
+        self._known_workers = list(workers) \
+            if isinstance(workers, (list, tuple)) else None
 
     def _evict_silent_servers(self, m):
         """Coordinator-driven server eviction: a server whose beat went
@@ -701,17 +1090,17 @@ class KVStoreServer:
             _prof.record_channel_gauge("kvstore.roster_generation",
                                        m.generation)
 
-    def _roster_get(self):
-        m = self._require_membership()
+    def _roster_get(self, m):
         self._evict_silent_servers(m)
         return m.roster().as_wire()
 
-    def _roster_mutate(self, action, role, ident):
+    def _roster_mutate(self, m, action, role, ident):
         """join/leave/dead for either role; returns the FULL post-change
         roster so the caller refreshes in the same round trip.  All
         mutations are idempotent — racing duplicate reports of one dead
-        server collapse into a single generation bump."""
-        m = self._require_membership()
+        server collapse into a single generation bump (and a worker's
+        report of the already-replaced dead coordinator is a no-op: the
+        rebuild removed it before the report arrived)."""
         before = m.generation
         if role == "server":
             uri = str(ident)
@@ -720,22 +1109,46 @@ class KVStoreServer:
             elif action == "leave":
                 m.leave_server(uri)
             else:
+                if uri == self.uri:
+                    # a false-positive report (reporter's heartbeat
+                    # blip) relayed to the very coordinator it names:
+                    # answering this request IS proof of life — refusing
+                    # keeps a live coordinator from evicting itself
+                    # (split brain via a self-removed roster)
+                    raise RuntimeError(
+                        "refusing dead-server report naming this "
+                        "coordinator — it is alive (it is answering "
+                        "the report)")
                 m.report_dead_server(uri)
         elif role == "worker":
             rank = int(ident)
             if action == "join":
-                m.join_worker(rank)
+                with self._barrier_cv:
+                    if rank not in m.workers_snapshot():
+                        self._barrier_joined.add(rank)
+                        # a genuinely re-joining rank (relaunch under
+                        # the same id) must not inherit its
+                        # predecessor's release marks — a stale done
+                        # would let its first barriers sail through
+                        # without a rendezvous
+                        self._barrier_high.pop(rank, None)
+                        self._barrier_done.pop(rank, None)
+                    m.join_worker(rank)
             elif action == "leave":
                 m.leave_worker(rank)
                 with self._barrier_cv:
-                    self._hb_seen.pop(rank, None)
+                    self._forget_barrier_rank(rank)
             else:
                 m.evict_worker(rank)
                 with self._barrier_cv:
-                    self._hb_seen.pop(rank, None)
+                    self._forget_barrier_rank(rank)
         else:
             raise ValueError(f"unknown roster role {role!r}")
         after = m.generation
+        floor = None
+        if role == "worker" and action == "join":
+            with self._barrier_cv:
+                floor = self._barrier_floor_locked()
         if after != before:
             if action == "dead":
                 _prof.record_channel_event(
@@ -747,7 +1160,15 @@ class KVStoreServer:
                 # re-evaluate their target against the new roster
                 self._barrier_release_locked()
                 self._barrier_cv.notify_all()
-        return m.roster().as_wire()
+        wire = m.roster().as_wire()
+        if floor is not None:
+            # a joining WORKER also receives the cohort's barrier floor:
+            # it seeds its own barrier sequence there, so raw client
+            # sequences stay globally cohort-aligned — which is what
+            # lets a failover successor start with EMPTY barrier state
+            # and still pair every retried arrival exactly
+            wire = wire + (floor,)
+        return wire
 
     def _apply_handoff(self, gen, wire_key, arr, bkey):
         """Install a handed-off VALUE (the workers' quorum re-push, or a
@@ -837,31 +1258,83 @@ class KVStoreServer:
             return None
         return None  # kSyncMode etc.: accepted, no-op in the async server
 
-    def _barrier_target(self):
-        """How many arrivals release the barrier.  Elastic coordinator:
-        the LIVE roster's worker count (re-read every evaluation, so an
-        eviction mid-wait shrinks the target); otherwise the static
-        num_workers.  Caller holds _barrier_cv."""
+    def _barrier_target_ranks(self):
+        """The live worker ranks a barrier must rendezvous (re-read
+        every evaluation, so an eviction mid-wait shrinks the set).
+        Caller holds _barrier_cv."""
         m = self._get_membership()
         if m is not None:
-            return max(1, len(m.workers_snapshot()))
-        return self.num_workers
+            return set(m.workers_snapshot())
+        return set(range(self.num_workers))
 
     def _barrier_release_locked(self):
-        """Release the barrier if the arrival count meets the (possibly
-        just-shrunk) target.  Caller holds _barrier_cv."""
-        if self._barrier_count < self._barrier_target() \
-                or self._barrier_count <= 0:
+        """Advance the per-rank release floor: an arrival ``(rank, b)``
+        releases once every LIVE rank's highest arrival reaches ``b``
+        (the floor).  Caller holds _barrier_cv; True when anything
+        released."""
+        live = self._barrier_target_ranks()
+        if not live:
             return False
-        self._barrier_count = 0
-        self._barrier_gen += 1
-        self._barrier_ranks = set()
-        self._barrier_cv.notify_all()
-        return True
+        floor = min(self._barrier_high.get(r, 0) for r in live)
+        released = False
+        for r, high in self._barrier_high.items():
+            done = min(high, floor)
+            if done > self._barrier_done.get(r, 0):
+                self._barrier_done[r] = done
+                released = True
+        if released:
+            self._barrier_cv.notify_all()
+        return released
 
-    def _barrier(self, rank=None):
-        """Count one arrival per worker; release everyone when every
-        live worker is in (reference: Postoffice::Barrier).
+    def _barrier_released(self, rank, bseq):
+        """Caller holds _barrier_cv."""
+        return bseq <= self._barrier_done.get(rank, 0)
+
+    def _barrier_floor_locked(self):
+        """The cohort's release floor — min done over live ranks that
+        have ARRIVED at least once (a not-yet-arrived fellow joiner
+        must not drag the floor to zero).  A joining worker seeds its
+        barrier sequence here, so raw client sequences are cohort-
+        aligned from the first call.  Caller holds _barrier_cv."""
+        live = self._barrier_target_ranks()
+        arrived = [r for r in live if self._barrier_high.get(r, 0) > 0]
+        if not arrived:
+            return 0
+        return min(self._barrier_done.get(r, 0) for r in arrived)
+
+    def _forget_barrier_rank(self, rank):
+        """Drop a departed rank's barrier state (a relaunch under the
+        same rank id starts a fresh, join-aligned sequence).  A parked
+        arrival of the departing rank is RELEASED — it is off the
+        roster either way, and letting it go beats stranding its
+        connection thread forever.  Caller holds _barrier_cv."""
+        self._hb_seen.pop(rank, None)
+        high = self._barrier_high.pop(rank, None)
+        if high:
+            self._barrier_done[rank] = max(
+                self._barrier_done.get(rank, 0), high)
+        else:
+            self._barrier_done.pop(rank, None)
+        self._barrier_joined.discard(rank)
+        self._barrier_client.pop(rank, None)
+        self._barrier_cv.notify_all()
+
+    def _barrier(self, rank=None, bseq=None, client=None):
+        """Rendezvous every live worker (reference: Postoffice::Barrier).
+
+        Arrivals carry a per-rank barrier SEQUENCE number ``bseq`` (the
+        worker's count of barrier() calls; server-assigned
+        ``high(rank)+1`` when absent): arrival ``(rank, b)`` is released
+        once every live rank's highest arrival is >= ``b``.  In
+        lockstep this is exactly the old counting barrier — the last
+        arrival releases everyone — but it is additionally IDEMPOTENT:
+        a worker whose barrier reply died with a failing COORDINATOR
+        retries the same ``(rank, b)`` against the successor and is
+        released immediately if the rendezvous already happened,
+        instead of entering a phantom extra barrier that would skew
+        every later rendezvous (and hang the job's final one).  That
+        idempotence is what makes the barrier exact through the
+        succession window.
 
         The wait itself stays UNBOUNDED (a slow worker is legal) — but
         when the heartbeat registry shows a missing rank went SILENT
@@ -872,55 +1345,106 @@ class KVStoreServer:
           just ids);
         * **elastic coordinator** — the barrier RENEGOTIATES instead of
           failing: the silent rank is evicted (generation bump), the
-          target re-reads the live roster, and the parked survivors are
-          released the moment the shrunken target is met.  Returns the
-          roster generation so workers piggyback bump discovery on every
-          barrier.  An evicted rank that was merely slow and arrives
-          later is re-admitted (join, another bump) — its arrival must
-          not corrupt the count."""
+          floor re-reads the live roster, and the parked survivors are
+          released the moment the shrunken set has all arrived.
+          Returns the roster generation so workers piggyback bump
+          discovery on every barrier.  An evicted rank that was merely
+          slow and arrives later is re-admitted (join, another bump)
+          with a fresh barrier sequence."""
         with self._barrier_cv:
+            if client is not None and rank is not None:
+                prev = self._barrier_client.get(rank)
+                if prev is not None and prev != client:
+                    # a NEW client generation is barriering under an
+                    # old rank id (trainer resumed against live
+                    # servers): its sequence restarts at 1, so it
+                    # realigns exactly like a joiner — one-shot offset
+                    # to the cohort's pending rendezvous, adopted
+                    # client-side via the reply.  Without this the
+                    # predecessors' release marks would turn the
+                    # resumed job's first rendezvous into instant
+                    # no-ops.
+                    self._barrier_joined.add(rank)
+                self._barrier_client[rank] = client
             m = self._get_membership()
             if m is not None and rank is not None \
                     and rank not in m.workers_snapshot():
                 m.join_worker(rank)
+                self._barrier_joined.add(rank)
+                # fresh sequence on re-admission (see _roster_mutate)
+                self._barrier_high.pop(rank, None)
+                self._barrier_done.pop(rank, None)
                 _prof.record_channel_gauge("kvstore.roster_generation",
                                            m.generation)
-            gen = self._barrier_gen
-            if rank is not None:
-                self._barrier_ranks.add(rank)
-            self._barrier_count += 1
-            if self._barrier_release_locked():
-                return self._barrier_payload()
-            while self._barrier_gen == gen and not self._stop.is_set():
+            if rank is None:
+                # anonymous raw-message arrival: tracked under a
+                # synthetic rank outside every live set — it waits for
+                # the live workers' rendezvous without being waited for
+                rank = -1
+            joined = rank in self._barrier_joined
+            self._barrier_joined.discard(rank)
+            if joined:
+                # align the joiner to the cohort's earliest pending
+                # rendezvous: the ARRIVED live ranks' release floor + 1
+                # (a fellow just-joined rank that has not arrived yet
+                # must not drag the alignment down to rendezvous 1)
+                others = [r for r in self._barrier_target_ranks()
+                          if r != rank
+                          and self._barrier_high.get(r, 0) > 0]
+                first = (min(self._barrier_done.get(r, 0)
+                             for r in others) + 1) if others else 1
+            realign = 0
+            if bseq is None:
+                # server-assigned sequence (legacy raw arrivals, tests):
+                # already in effective terms
+                bseq = self._barrier_high.get(rank, 0) + 1
+                if joined:
+                    bseq = max(bseq, first)
+            else:
+                bseq = int(bseq)
+                if joined and first > bseq:
+                    # one-shot: this arrival runs at the cohort's
+                    # sequence, and the offset rides the reply so the
+                    # client bumps its own counter — raw sequences are
+                    # globally aligned again from the next call, with
+                    # no server-side offset to lose at a failover
+                    realign = first - bseq
+                    bseq = first
+            self._barrier_high[rank] = max(
+                self._barrier_high.get(rank, 0), bseq)
+            self._barrier_release_locked()
+            while not self._barrier_released(rank, bseq) \
+                    and not self._stop.is_set():
                 self._barrier_cv.wait(0.1)
-                if self._barrier_gen != gen or self._stop.is_set():
+                if self._barrier_released(rank, bseq) \
+                        or self._stop.is_set():
                     break
-                silent = self._silent_ranks() - self._barrier_ranks
+                live = self._barrier_target_ranks()
+                waiting_for = {r for r in live
+                               if self._barrier_high.get(r, 0) < bseq}
+                silent = self._silent_ranks() & waiting_for
                 if not silent:
                     continue
                 if m is not None:
                     for r in sorted(silent):
                         m.evict_worker(r)
-                        self._hb_seen.pop(r, None)
+                        self._forget_barrier_rank(r)
                         _prof.record_channel_event(
                             "kvstore.worker_eviction")
                     _prof.record_channel_gauge(
                         "kvstore.roster_generation", m.generation)
-                    if self._barrier_release_locked():
-                        return self._barrier_payload()
+                    self._barrier_release_locked()
                     continue
-                arrived = sorted(self._barrier_ranks)
+                arrived = sorted(
+                    r for r in live
+                    if self._barrier_high.get(r, 0) >= bseq)
                 ages = self._heartbeat_ages(silent)
-                # unwind this arrival so a later retry re-enters
-                # cleanly once the dead rank is replaced
-                self._barrier_count -= 1
-                if rank is not None:
-                    self._barrier_ranks.discard(rank)
                 raise RuntimeError(
                     "barrier timed out: worker rank(s) %s missing "
                     "(no heartbeat for > %.1fs; %s); arrived rank(s): %s"
                     % (sorted(silent), self._hb_timeout, ages, arrived))
-            return self._barrier_payload()
+            payload = self._barrier_payload()
+            return (payload, realign) if realign else payload
 
     def _barrier_payload(self):
         """Barrier replies carry the roster generation on an elastic
@@ -930,67 +1454,141 @@ class KVStoreServer:
         m = self._get_membership()
         return None if m is None else m.generation
 
-    # -- elastic beat loop (non-coordinator half) ----------------------------
-    def _coordinator_addr(self):
-        """(host, port) of roster server 0, or None.  Resolved lazily
-        from the ctor roster / MXT_SERVER_URIS (in-process tests set the
-        env after binding ports)."""
-        uris = self._roster_servers or \
-            [u for u in os.environ.get("MXT_SERVER_URIS", "").split(",")
-             if u]
-        if not uris or uris[0] == self.uri:
-            return None
-        host, port = uris[0].rsplit(":", 1)
+    # -- elastic beat loop (every elastic server) ----------------------------
+    @staticmethod
+    def _uri_addr(uri):
+        host, port = uri.rsplit(":", 1)
         return (host, int(port))
 
+    def _coordinator_addr(self):
+        """(host, port) of the LIVE roster's coordinator, or None when
+        this server is it (or no roster is known yet).  Derived through
+        membership.coordinator_uri over the freshest roster view — the
+        single source of truth the worker-side twin
+        (KVStoreDistAsync._coordinator_conn) routes through too, so a
+        failover re-seats both sides identically."""
+        from .membership import coordinator_uri
+        curi = coordinator_uri(self._roster_uris())
+        if curi is None or curi == self.uri:
+            return None
+        return self._uri_addr(curi)
+
     def _beat_loop(self):
-        """Non-coordinator elastic servers beat the coordinator on their
-        own socket (liveness) and piggyback a full state snapshot every
-        MXNET_KVSTORE_SNAPSHOT_S seconds (the killed-server recovery
-        source).  A missed beat IS the signal — the coordinator evicts
-        on silence — so faults here are swallowed and the socket
-        re-dialed next tick."""
+        """Every elastic server beats every OTHER roster server on its
+        own sockets: liveness toward the coordinator (whose reply
+        carries the live roster, so peers track the membership they may
+        one day rebuild) and snapshot fan-out everywhere — each peer
+        banks the beats it receives, so the snapshot bank (the
+        killed-server recovery source) OUTLIVES any single server,
+        including the coordinator.  A missed beat IS the signal — the
+        coordinator evicts silent peers — so faults are swallowed and
+        the socket re-dialed next tick.  Coordinator SILENCE is also
+        detected here: a refused dial (decisive) or hb_timeout of quiet
+        feeds _maybe_promote, where the deterministically elected
+        successor verifies the death and takes over."""
         import socket as _socket
         interval = float(_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 5.0))
         if interval <= 0:
             interval = 5.0
         last_snap = None
-        sock = None
-        while not self._stop.is_set():
-            addr = self._coordinator_addr()
-            if addr is not None:
+        socks = {}
+        try:
+            while not self._stop.is_set():
+                if self.uri not in self._roster_uris():
+                    # not a roster MEMBER (a serving replica in the
+                    # train-and-serve topology sees MXT_SERVER_URIS +
+                    # MXNET_KVSTORE_ELASTIC without ever being on the
+                    # roster): observe, never beat — the server-side
+                    # twin of the worker's roster_member=False
+                    faultinject.note_coordinator(False)
+                    self._stop.wait(interval)
+                    continue
+                faultinject.note_coordinator(self._is_coordinator())
+                from .membership import coordinator_uri
+                curi = coordinator_uri(self._roster_uris())
                 snap = None
                 now = time.monotonic()
                 if self._snapshot_s > 0 and (
                         last_snap is None
                         or now - last_snap >= self._snapshot_s):
                     snap = self._snapshot_struct()
-                try:
-                    if sock is None:
-                        sock = _socket.create_connection(
-                            addr, timeout=self._hb_timeout or 15.0)
-                        sock.settimeout(self._hb_timeout or 15.0)
+                sent_snap = False
+                for uri in list(self._roster_uris()):
+                    if uri == self.uri:
+                        continue
                     self._beat_seq += 1
-                    _send_msg(sock, ("roster_beat", self.uri,
-                                     self._beat_seq, snap))
-                    status, _payload = _recv_msg(sock)
-                    if status == "ok" and snap is not None:
-                        last_snap = now
-                except Exception:  # noqa: BLE001 — the miss IS the signal
-                    _prof.record_channel_event("kvstore.beat_miss")
-                    if sock is not None:
+                    faultinject.server_beat(self._beat_seq)
+                    try:
+                        sock = socks.get(uri)
+                        if sock is None:
+                            sock = _socket.create_connection(
+                                self._uri_addr(uri),
+                                timeout=self._hb_timeout or 15.0)
+                            sock.settimeout(self._hb_timeout or 15.0)
+                            socks[uri] = sock
+                        _send_msg(sock, ("roster_beat", self.uri,
+                                         self._beat_seq, snap))
+                        status, payload = _recv_msg(sock)
+                        if status == "ok":
+                            if snap is not None:
+                                sent_snap = True
+                            # digest ANY roster-carrying reply (only a
+                            # coordinator puts one on the wire): after a
+                            # failover the new coordinator is NOT the uri
+                            # this server still believes leads, and its
+                            # replies are how the stale view heals
+                            self._note_roster_wire(payload)
+                            self._peer_heard.add(uri)
+                            self._peer_refused.discard(uri)
+                            if uri == curi:
+                                self._coord_last_ok = time.monotonic()
+                                self._coord_refused = False
+                    except Exception as exc:  # noqa: BLE001 — the miss IS the signal
+                        _prof.record_channel_event("kvstore.beat_miss")
+                        if isinstance(exc, ConnectionRefusedError) \
+                                and uri in self._peer_heard:
+                            # a HEARD-FROM peer's port is GONE — decisive
+                            # death evidence, banked for the succession
+                            # election's dead set.  Never-heard-never-
+                            # dead still holds: a refused dial to a peer
+                            # that never acked is just one still binding
+                            # its listener at job start, and promoting
+                            # off it would split the roster from minute
+                            # zero
+                            self._peer_refused.add(uri)
+                            if uri == curi:
+                                self._coord_refused = True
+                        sock = socks.pop(uri, None)
+                        if sock is not None:
+                            try:
+                                sock.close()
+                            except OSError:
+                                pass
+                if sent_snap:
+                    last_snap = now
+                if not self._is_coordinator():
+                    self._maybe_promote()
+                # prune channels to servers no longer on the roster
+                for uri in list(socks):
+                    if uri not in self._roster_uris():
+                        s = socks.pop(uri)
                         try:
-                            sock.close()
+                            s.close()
                         except OSError:
                             pass
-                        sock = None
-            self._stop.wait(min(interval, self._snapshot_s)
-                            if self._snapshot_s > 0 else interval)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+                self._stop.wait(min(interval, self._snapshot_s)
+                                if self._snapshot_s > 0 else interval)
+        except Exception:  # noqa: BLE001 — park the crash as a counter:
+            # the loop's death is observable (beats stop -> the
+            # coordinator evicts this server on silence; if this WAS the
+            # coordinator, the successor takes over), never silent
+            _prof.record_channel_event("kvstore.beat_loop_crash")
+        finally:
+            for sock in socks.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def leave(self):
         """GRACEFUL departure (scale-down, planned preemption): ship one
@@ -1063,11 +1661,12 @@ class KVStoreServer:
 
     def run(self):
         """Blocking accept loop; returns after a kStopServer command."""
-        if self._elastic and self.server_id != 0 \
-                and self._beat_thread is None:
-            self._beat_thread = threading.Thread(target=self._beat_loop,
-                                                 daemon=True)
-            self._beat_thread.start()
+        if self._elastic:
+            faultinject.note_coordinator(self._is_coordinator())
+            if self._beat_thread is None:
+                self._beat_thread = threading.Thread(
+                    target=self._beat_loop, daemon=True)
+                self._beat_thread.start()
         try:
             while not self._stop.is_set():
                 try:

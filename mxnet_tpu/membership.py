@@ -13,9 +13,15 @@ that rides preemptible capacity:
 * **Roster** — an epoch-numbered generation, the ordered server URI
   tuple (order IS the stripe-slot mapping) and the live worker-rank
   tuple.  Negotiated over the existing control channel; the
-  COORDINATOR is server 0 of the current generation (killing the
-  coordinator itself is the one unrecoverable death in v1 — run it on
-  the least-preemptible host).
+  COORDINATOR is slot 0 of the current generation
+  (:func:`coordinator_uri` — the single source of truth both the
+  server's and the worker's address derivation route through).
+  Coordinator death is itself a survivable membership event: on
+  coordinator silence every observer independently elects
+  :func:`elect_successor` — pure arithmetic over the ordered roster,
+  no votes, the same determinism trick ``stripe_plan`` uses — and the
+  elected survivor rebuilds the ledger with :func:`rebuild_ledger`
+  from the reports and snapshot bank it already holds.
 * **Pure roster arithmetic** (this module, no sockets): stripe-plan
   derivation, wire-key layouts per server set, handoff planning
   between generations, per-stripe optimizer-state restriping.  Every
@@ -48,6 +54,21 @@ import numpy as np
 STRIPE_SEP = "@s"
 
 
+def bank_newest(bank: Dict[str, tuple], uri: str, seq, snapshot) -> None:
+    """THE snapshot-banking rule, in one place: keep the newest-seq
+    snapshot per uri (ties re-bank — a re-sent equal seq is the same
+    beat).  Used by the ledger bank (``note_server_beat`` /
+    ``preload_snapshot``) and every server's local peer bank
+    (``kvstore_server._bank_peer_snapshot``) so the three banks can
+    never diverge on the tie-break or seq coercion.  Caller holds
+    whatever lock guards ``bank``; a None snapshot banks nothing."""
+    if snapshot is None or seq is None:
+        return
+    have = bank.get(uri)
+    if have is None or int(seq) >= have[0]:
+        bank[uri] = (int(seq), snapshot)
+
+
 # ---------------------------------------------------------------------------
 # Pure roster arithmetic — no sockets, no state.  Every function is
 # deterministic from its arguments so every observer of the same roster
@@ -65,6 +86,38 @@ def stripe_plan(key: str, shape, num_servers: int,
         return None
     parts = min(num_servers, shape[0])
     return [shape[0] * i // parts for i in range(parts + 1)]
+
+
+def coordinator_uri(servers: Optional[Sequence[str]]) -> Optional[str]:
+    """The coordinator of a roster: slot 0 of the CURRENT generation's
+    server order (removal preserves survivor order, so succession walks
+    the roster deterministically).  The single source of truth behind
+    ``kvstore_server._coordinator_addr`` and the worker's
+    ``_coordinator_conn`` — both used to hardcode bootstrap slot 0,
+    which goes stale the moment a failover re-seats the roster."""
+    if not servers:
+        return None
+    for u in servers:
+        if u:
+            return u
+    return None
+
+
+def elect_successor(servers: Optional[Sequence[str]],
+                    dead) -> Optional[str]:
+    """Deterministic coordinator succession: the first roster slot not
+    known dead.  Pure arithmetic over the same ordered roster every
+    observer already holds — no votes, no extra protocol (the
+    ``stripe_plan`` determinism trick applied to leadership): any two
+    observers of the same (roster, dead set) elect the SAME successor,
+    and observers with momentarily different dead sets converge through
+    the ``roster_dead`` / :func:`rebuild_ledger` path.  None when every
+    server is dead (nothing left to elect)."""
+    dead = set(dead or ())
+    for u in servers or ():
+        if u and u not in dead:
+            return u
+    return None
 
 
 def server_index(key: str, num_servers: int) -> int:
@@ -241,6 +294,7 @@ class MembershipCoordinator:
         self._server_seen: Dict[str, float] = {}
         self._snapshots: Dict[str, tuple] = {}   # uri -> (seq, blob)
         self.evictions = 0
+        self.failovers = 0   # ledgers this one succeeded (rebuild_ledger)
 
     # -- views ---------------------------------------------------------------
     @property
@@ -316,10 +370,16 @@ class MembershipCoordinator:
         with self._lock:
             if uri in self._servers:
                 self._server_seen[uri] = time.monotonic()
-            if snapshot is not None and seq is not None:
-                have = self._snapshots.get(uri)
-                if have is None or seq >= have[0]:
-                    self._snapshots[uri] = (int(seq), snapshot)
+            bank_newest(self._snapshots, uri, seq, snapshot)
+
+    def preload_snapshot(self, uri: str, seq: int, snapshot) -> None:
+        """Seed the snapshot bank without touching liveness — the
+        failover path: the elected successor promotes its LOCAL peer
+        bank (grown from the beat fan-out) into the rebuilt ledger.
+        Same newest-seq-wins rule as :meth:`note_server_beat`
+        (:func:`bank_newest` is the one implementation both share)."""
+        with self._lock:
+            bank_newest(self._snapshots, uri, seq, snapshot)
 
     def snapshot_of(self, uri: str):
         """The last state snapshot a (possibly now-dead) server shipped,
@@ -340,3 +400,52 @@ class MembershipCoordinator:
             return [u for u in self._servers[1:]
                     if u in self._server_seen
                     and now - self._server_seen[u] > timeout]
+
+
+def rebuild_ledger(servers: Sequence[str], workers: Sequence[int],
+                   reports: Sequence[dict],
+                   snapshots: Optional[Dict[str, tuple]] = None
+                   ) -> MembershipCoordinator:
+    """Rebuild the coordinator ledger on the elected successor — pure
+    merge over the three sources the successor already has or can
+    demand: its own last-seen roster (``servers``/``workers``, carried
+    on every beat reply and barrier exchange), the ``ledger_report``
+    sweep of the survivors (each ships its last-known generation, beat
+    seq and live key set), and the local peer snapshot bank (grown from
+    the beat fan-out, so it outlives server 0).
+
+    Merge rules (pinned socket-free by tests/test_membership.py) — the
+    ONLY report field the merge consumes is ``generation``; beat seqs
+    and key sets ride the full (non-slim) report for operator
+    forensics, never as merge inputs:
+
+    * the generation resumes at ``max(reported generations) + 1`` —
+      every envelope a stale coordinator (or a worker still converged
+      on its roster) stamped with an older generation is rejected by
+      the EXISTING per-generation staleness checks (handoff dedup,
+      barrier-reply bump discovery), no new wire checks needed;
+    * duplicate reports are idempotent (the merge is a max over a set —
+      every survivor racing to report changes nothing twice);
+    * reports never ADD servers the successor's roster view lacks: an
+      unknown reporter re-joins through the ordinary join path, it is
+      not grandfathered into slot arithmetic mid-rebuild;
+    * missing snapshots stay missing — the bank never invents state, so
+      a later restripe of an unbanked dead server degrades to fresh
+      state exactly like :func:`restripe_states`' partial-snapshot
+      refusal, instead of training on fabricated momentum."""
+    gen = 0
+    for r in reports or ():
+        try:
+            gen = max(gen, int(r.get("generation", 0)))
+        except (AttributeError, TypeError, ValueError):
+            continue
+    m = MembershipCoordinator(servers, workers)
+    with m._lock:
+        m._generation = gen + 1
+    m.failovers = 1
+    for uri, entry in (snapshots or {}).items():
+        if entry is None:
+            continue
+        seq, snap = entry
+        m.preload_snapshot(uri, seq, snap)
+    return m

@@ -245,7 +245,11 @@ def reset_host_syncs():
 # -- kvstore channel counters ------------------------------------------------
 # One counter per transport-resilience event on the dist kvstore channel
 # (retry, reconnect, replay, replay_acked, hard_fail, heartbeat,
-# heartbeat_miss).  Separate from the dispatch counters on purpose: the
+# heartbeat_miss; the elastic layer adds roster_bump, the eviction/
+# handoff family, coordinator_failover / coordinator_failover_observed
+# and the coordinator_slot + failover_rebuild_s gauges — a coordinator
+# succession is a first-class counter, not a log line).  Separate from
+# the dispatch counters on purpose: the
 # multi-step-driver tests assert dispatch_counts() by EXACT equality, and
 # a channel retry must never be able to fail a dispatch-contract test.
 # tests/test_faultinject.py asserts recovery paths against these.

@@ -145,6 +145,14 @@ class ServingReplica(KVStoreServer):
             # an operator correlate a served-version stall with training
             # -cluster churn from the serving side alone
             "roster_generation": getattr(self._ps, "_roster_gen", 0) or 0,
+            # which bootstrap slot leads the training roster (-1 = a
+            # joined-later server) and how many coordinator successions
+            # the refresh client has ridden: a FAILOVER is observable
+            # from the serving side without log-diving
+            "coordinator_slot": getattr(self._ps, "_coordinator_slot",
+                                        0) or 0,
+            "coordinator_failovers": getattr(self._ps, "_failovers",
+                                             0) or 0,
             "latency": _prof.latency_stats("serving.request"),
         }
 

@@ -3,25 +3,35 @@ finish bit-identical to the uninterrupted run — no restarts.
 
 Run via:  python tools/launch.py --elastic -n 2 -s 2 \
               --env MXNET_FI_KILL_PROCESS_AFTER=<N> \
-              --env MXNET_FI_ONLY_SERVER=1 \
+              --env MXNET_FI_ONLY_SERVER=<SID> \
+              --env MXT_KILL_SERVER=<SID> \
               python tests/dist/dist_elastic_membership.py
 
 Two workers train against two servers with one striped key (a row
-slice on each server) and one small key per server.  Server 1 is
-REALLY SIGKILLed — ``faultinject.kill_process_after_acks`` fires after
-it serves exactly the last ack of round KILL_ROUND, a deterministic
-barrier-phase boundary — taking its stripe state to its grave.  The
-surviving roster must: detect the death, evict it (coordinator =
-server 0), re-derive striping, hand the state off from the workers'
-sync-point caches, re-push the orphaned round-(K+1) gradients, and
-finish.  Proof is BIT-IDENTITY: integer gradients with a power-of-two
-lr make every update exact in fp32 and order-independent, so the final
-weights must EQUAL the static-roster analytic golden — a lost push, a
-double-applied handoff or a mis-striped row all break equality.
+slice on each server) and one small key per server.  Server
+MXT_KILL_SERVER (default 1) is REALLY SIGKILLed —
+``faultinject.kill_process_after_acks`` fires after it serves exactly
+the last ack of round KILL_ROUND, a deterministic protocol boundary —
+taking its stripe state to its grave.  The surviving roster must:
+detect the death, evict it, re-derive striping, hand the state off
+from the workers' sync-point caches, re-push the orphaned
+round-(K+1) gradients, and finish.  Proof is BIT-IDENTITY: integer
+gradients with a power-of-two lr make every update exact in fp32 and
+order-independent, so the final weights must EQUAL the static-roster
+analytic golden — a lost push, a double-applied handoff or a
+mis-striped row all break equality.
+
+MXT_KILL_SERVER=0 kills the COORDINATOR itself (compose with
+MXNET_FI_ONLY_COORDINATOR=1 so the plan names the role, not just the
+id): the workers elect the deterministic successor, server 1 verifies
+the death and rebuilds the ledger, the idempotent bseq barrier retries
+absorb the replies that died with server 0, and the same bit-identity
+must hold — coordinator death is no longer the one unrecoverable
+membership event.
 
 The ack count (MXNET_FI_KILL_PROCESS_AFTER) is derived from the wire
-protocol; ``expected_kill_acks`` below documents the arithmetic and
-ci/run_ci.sh passes its value in.
+protocol; ``expected_kill_acks`` below documents the arithmetic for
+both targets and ci/run_ci.sh passes its value in.
 """
 import os
 import sys
@@ -47,7 +57,8 @@ import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import membership, profiler  # noqa: E402
 
 ROUNDS = 4
-KILL_ROUND = 2          # server 1 dies at the END of this round
+KILL_ROUND = 2          # the doomed server dies at the END of this round
+KILL_SERVER = int(os.environ.get("MXT_KILL_SERVER", "1"))
 LR = 0.125              # power of two: every update exact in fp32
 
 
@@ -62,19 +73,34 @@ def pick_small_keys():
     return keys[0], keys[1]
 
 
-def expected_kill_acks(nworker=2, kill_round=KILL_ROUND):
-    """Enveloped replies server 1 serves through the end of
+def expected_kill_acks(nworker=2, kill_round=KILL_ROUND,
+                       server=KILL_SERVER):
+    """Enveloped replies the doomed server serves through the end of
     ``kill_round`` — the deterministic kill point ci/run_ci.sh arms.
 
-    Setup, per worker: init big-stripe (1) + init small1 (1) + the
-    set_optimizer barrier's channel flush (1); plus rank 0's optimizer
-    command (1).  Each round, per worker: push big-stripe (1) + push
-    small1 (1) + barrier flush (1) + pull big-stripe (1) + pull small1
-    (1) + barrier flush (1).  Barrier rendezvous and roster ops ride
-    server 0; heartbeats are raw and exempt — the count advances on
-    exactly these envelopes."""
-    setup = nworker * 3 + 1
-    per_round = nworker * 6
+    Server 1 (a pure data shard): setup, per worker: init big-stripe
+    (1) + init small1 (1) + the set_optimizer barrier's channel flush
+    (1); plus rank 0's optimizer command (1).  Each round, per worker:
+    push big-stripe (1) + push small1 (1) + barrier flush (1) + pull
+    big-stripe (1) + pull small1 (1) + barrier flush (1).  Barrier
+    rendezvous and roster ops ride the coordinator; heartbeats and
+    roster beats are raw and exempt — the count advances on exactly
+    these envelopes.
+
+    Server 0 (the COORDINATOR) additionally serves, per worker, the
+    elastic ctor's roster_join (1) and each barrier's rendezvous
+    envelope (1 per barrier, 2 barriers per round + 1 in
+    set_optimizer), on top of its own data-shard share (one big
+    stripe + small0).  The kill therefore lands right at a round-end
+    barrier release — the messiest boundary, which is the point: the
+    bseq-idempotent retry against the successor must absorb whichever
+    worker's reply died with the coordinator."""
+    if server == 0:
+        setup = nworker * 5 + 1
+        per_round = nworker * 8
+    else:
+        setup = nworker * 3 + 1
+        per_round = nworker * 6
     return setup + per_round * kill_round
 
 
@@ -111,6 +137,13 @@ def main():
     assert kv._roster_gen >= 1 and len(kv._conns) == 1, \
         (kv._roster_gen, len(kv._conns))
     assert profiler.channel_bytes().get("handoff", 0) > 0
+    if KILL_SERVER == 0:
+        # the COORDINATOR died: this worker must have ridden a real
+        # succession — failover observed, bootstrap slot 1 leads now
+        assert kv._failovers >= 1, kv._failovers
+        assert counts.get("kvstore.coordinator_failover_observed",
+                          0) >= 1, counts
+        assert counts.get("kvstore.coordinator_slot", None) == 1, counts
 
     # bit-identity vs the static-roster golden: total pushed gradient is
     # ROUNDS * (1 + 2) per element, each update exact in fp32

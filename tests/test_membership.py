@@ -146,6 +146,70 @@ def test_restripe_states_exact_merge():
     assert outn == {"w": None}
 
 
+def test_coordinator_uri_and_successor_deterministic():
+    """Succession is pure arithmetic over the ordered roster: every
+    observer of the same (roster, dead set) elects the SAME successor,
+    across generations, with no votes — and the successor IS the
+    coordinator of the post-eviction roster (removal preserves
+    order)."""
+    servers = ["a:1", "b:2", "c:3"]
+    assert membership.coordinator_uri(servers) == "a:1"
+    assert membership.coordinator_uri([]) is None
+    assert membership.coordinator_uri(None) is None
+    for _ in range(3):     # deterministic: same answer every evaluation
+        assert membership.elect_successor(servers, {"a:1"}) == "b:2"
+    assert membership.elect_successor(servers, {"a:1", "b:2"}) == "c:3"
+    assert membership.elect_successor(servers, set(servers)) is None
+    assert membership.elect_successor(servers, set()) == "a:1"
+    assert membership.elect_successor(None, set()) is None
+    # composition: evicting the coordinator from the ledger yields a
+    # roster whose slot 0 is exactly the elected successor, so every
+    # observer converges on one leader with no coordination
+    m = membership.MembershipCoordinator(servers, [0])
+    m.report_dead_server("a:1")
+    assert membership.coordinator_uri(m.roster().servers) \
+        == membership.elect_successor(servers, {"a:1"})
+
+
+def test_rebuild_ledger_merge_rules():
+    """The failover rebuild is a pure merge: generation resumes at
+    max(reported)+1, duplicate reports are idempotent, reports never
+    add servers the successor's roster view lacks, and the snapshot
+    bank never invents missing state."""
+    mom = np.arange(40, dtype=np.float32).reshape(10, 4)
+    reports = [
+        {"uri": "b:2", "generation": 3, "beat_seq": 7, "keys": ["w@s1"]},
+        {"uri": "c:3", "generation": 5, "beat_seq": 2, "keys": []},
+    ]
+    snaps = {"a:1": (4, {"store": {}, "states": {"w@s0": (mom[0:5],)}})}
+    m = membership.rebuild_ledger(["b:2", "c:3"], [0, 1], reports, snaps)
+    assert m.generation == 6           # max(reported) + 1
+    assert m.failovers == 1
+    assert m.roster().servers == ("b:2", "c:3")
+    assert m.roster().workers == (0, 1)
+    # duplicate reports (every survivor races to report) are idempotent
+    m2 = membership.rebuild_ledger(["b:2", "c:3"], [0, 1],
+                                   reports + reports, snaps)
+    assert m2.generation == 6
+    # an unknown reporter contributes its generation only — it re-joins
+    # through the ordinary path, never grandfathered into slot math
+    m3 = membership.rebuild_ledger(["b:2"], [0], reports, None)
+    assert m3.generation == 6 and m3.roster().servers == ("b:2",)
+    # malformed reports are skipped, not fatal (a half-written reply
+    # from a dying peer must not block the succession)
+    assert membership.rebuild_ledger(
+        ["b:2"], [0], [{"generation": "x"}, None, {}], None
+    ).generation == 1
+    # missing snapshot REFUSAL: the bank answers only what was banked...
+    assert m.snapshot_of("a:1") == snaps["a:1"][1]
+    assert m.snapshot_of("never-banked:9") is None
+    # ...so a restripe over an unbanked dead stripe refuses ({} = the
+    # optimizer re-creates fresh state) instead of inventing momentum
+    per_wire = dict(m.snapshot_of("a:1")["states"])   # w@s0 only
+    assert membership.restripe_states("w", per_wire, [0, 5, 10],
+                                      None) == {}
+
+
 def test_coordinator_idempotent_mutations():
     m = membership.MembershipCoordinator(["a:1", "b:2"], [0, 1])
     assert m.generation == 0
@@ -253,6 +317,180 @@ def test_handoff_state_idempotent_and_installed():
     # None clears the slot (the optimizer re-creates fresh state)
     assert srv._handle(("handoff_state", 2, "w", None, "w")) is True
     assert "w" not in srv._updater.states
+
+
+def test_stale_coordinator_envelopes_rejected():
+    """After a failover the successor's ledger resumes at
+    max(reported)+1, so envelopes stamped by the dead coordinator's
+    epoch — handoffs a worker still converged on the stale roster keeps
+    re-sending — are refused by the EXISTING per-generation staleness
+    checks; no new wire validation was needed.  Socket-free."""
+    srv = _mk_server(elastic=True)
+    srv._membership = membership.rebuild_ledger(
+        [srv.uri], [0], [{"uri": "dead:1", "generation": 4,
+                          "beat_seq": 9, "keys": ["w"]}], None)
+    srv._promoted = True
+    gen = srv._membership.generation
+    assert gen == 5
+    # the post-failover roster (and generation) is what roster ops serve
+    assert srv._handle(("roster_get",)) == (5, [srv.uri], [0])
+    # barrier replies carry the resumed generation, so workers discover
+    # the succession at their next sync point for free
+    assert srv._handle(("barrier",), rank=0) == 5
+    # a post-failover handoff at the rebuilt generation lands...
+    v_new = np.full(SHAPE, 7.0, np.float32)
+    assert srv._handle(("handoff", gen, "w", v_new, "w")) is True
+    # ...and every stale-epoch envelope is rejected, values untouched
+    for stale in (gen - 1, gen - 3):
+        assert srv._handle(("handoff", stale, "w",
+                            np.zeros(SHAPE, np.float32), "w")) is False
+    np.testing.assert_array_equal(srv._store["w"].asnumpy(), 7.0)
+    srv._stop.set()
+
+
+def test_ledger_report_names_generation_and_keys():
+    """Every elastic server answers ledger_report — the rebuild sweep's
+    input: last-known generation, beat seq and the live key set."""
+    srv = _mk_server(elastic=True)
+    srv._handle(("init", "w", np.zeros(SHAPE, np.float32)))
+    srv._known_gen = 3
+    srv._beat_seq = 11
+    r = srv._handle(("ledger_report",))
+    assert r["uri"] == srv.uri and r["keys"] == ["w"]
+    assert r["beat_seq"] == 11 and r["generation"] == 3
+    # a coordinator reports its LEDGER generation, not the passive view
+    srv._get_membership().join_server("x:9")
+    assert srv._handle(("ledger_report",))["generation"] \
+        == srv._get_membership().generation
+    srv._stop.set()
+
+
+def test_join_reply_carries_cohort_barrier_floor():
+    """A joining worker's reply carries the cohort's barrier release
+    floor (computed over ARRIVED ranks only, so two simultaneous
+    joiners both align to the real cohort, not to each other's zero):
+    the joiner seeds its raw sequence there, keeping client sequences
+    globally aligned — the invariant that lets a failover successor
+    pair arrivals with EMPTY barrier state."""
+    srv = _mk_server(num_workers=1, elastic=True)
+    assert srv._handle(("barrier", 1), rank=0) == 0   # cohort runs...
+    assert srv._handle(("barrier", 2), rank=0) == 0
+    reply = srv._handle(("roster_join", "worker", 1))
+    assert len(reply) == 4 and reply[3] == 2          # floor = done(0)
+    # a second concurrent joiner sees the SAME floor (rank 1 has not
+    # arrived yet and must not drag it to zero)
+    assert srv._handle(("roster_join", "worker", 2))[3] == 2
+    # the seeded joiner's first arrival (floor+1) parks until the
+    # cohort reaches the same rendezvous
+    done = []
+
+    def joiner():
+        try:
+            done.append(srv._handle(("barrier", 3), rank=1))
+        except Exception as exc:  # noqa: BLE001 — surfaced via assert
+            done.append(exc)
+
+    t = threading.Thread(target=joiner, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not done
+    srv._handle(("roster_leave", "worker", 2))        # 2 never arrives
+    srv._handle(("barrier", 3), rank=0)
+    t.join(timeout=5)
+    assert not t.is_alive() and isinstance(done[0], int)
+    srv._stop.set()
+
+
+def test_rejoin_realignment_is_one_shot_client_adopted():
+    """A (re-)joined rank arriving with a drifted sequence is realigned
+    to the cohort's pending rendezvous ONE-SHOT: the offset rides the
+    barrier reply so the client adopts the effective sequence — there
+    is deliberately NO server-side offset state, which is why a
+    failover successor can start with an empty barrier map and still
+    pair every arrival."""
+    srv = _mk_server(num_workers=1, elastic=True)
+    assert srv._handle(("barrier", 1), rank=0) == 0
+    assert srv._handle(("barrier", 2), rank=0) == 0
+    srv._handle(("roster_join", "worker", 1))
+    done = []
+
+    def drifted():
+        try:
+            done.append(srv._handle(("barrier", 1), rank=1))
+        except Exception as exc:  # noqa: BLE001 — surfaced via assert
+            done.append(exc)
+
+    t = threading.Thread(target=drifted, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not done                  # realigned to rendezvous 3: parks
+    srv._handle(("barrier", 3), rank=0)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    payload = done[0]
+    assert isinstance(payload, tuple) and payload[1] == 2, payload
+    # the adopted sequence keeps pairing exactly: raw 1+2+1 = 4 next
+    assert srv._barrier_high[1] == 3
+    srv._stop.set()
+
+
+def test_fresh_client_generation_resets_barrier_sequence():
+    """A trainer RESUMED against live servers barriers under the same
+    rank ids but a new client nonce and a sequence restarting at 1: the
+    dead predecessors' release marks must not turn the first rendezvous
+    into instant no-ops (the resumed set_optimizer barrier must really
+    rendezvous)."""
+    srv = _mk_server(num_workers=2, elastic=False)
+    srv._note_ping(0)
+    srv._note_ping(1)
+    results = []
+
+    def arrive(rank, bseq, client):
+        try:
+            results.append(srv._handle(("barrier", bseq), rank=rank,
+                                       client=client))
+        except Exception as exc:  # noqa: BLE001 — surfaced via assert
+            results.append(exc)
+
+    # first client generation completes rendezvous 1
+    t0 = threading.Thread(target=arrive, args=(0, 1, (0, "A")),
+                          daemon=True)
+    t0.start()
+    time.sleep(0.1)
+    arrive(1, 1, (1, "A"))
+    t0.join(timeout=5)
+    assert len(results) == 2 and not any(
+        isinstance(r, Exception) for r in results)
+    # the job restarts: NEW nonces, sequences back at 1 — rank 0 must
+    # PARK (no instant release off the stale done marks)...
+    results.clear()
+    t2 = threading.Thread(target=arrive, args=(0, 1, (0, "B")),
+                          daemon=True)
+    t2.start()
+    time.sleep(0.2)
+    assert not results, "fresh client released without a rendezvous"
+    # ...until the other resumed rank arrives
+    arrive(1, 1, (1, "B"))
+    t2.join(timeout=5)
+    assert len(results) == 2 and not any(
+        isinstance(r, Exception) for r in results)
+    srv._stop.set()
+
+
+def test_dead_report_naming_live_coordinator_refused():
+    """A false-positive roster_dead (the reporter's heartbeat blipped)
+    that reaches the very coordinator it names is REFUSED — answering
+    the report IS proof of life, and a live coordinator must never
+    evict itself into a split-brain roster."""
+    srv = _mk_server(elastic=True)
+    m = srv._get_membership()
+    m.join_server("b:2")
+    with pytest.raises(Exception, match="alive"):
+        srv._handle(("roster_dead", "server", srv.uri))
+    assert srv.uri in m.roster().servers
+    # reports naming OTHER servers keep working
+    assert srv._handle(("roster_dead", "server", "b:2"))[1] == [srv.uri]
+    srv._stop.set()
 
 
 def test_barrier_renegotiates_with_evicted_rank(monkeypatch):
@@ -365,6 +603,60 @@ def test_kill_process_env_arming(monkeypatch):
         faultinject.server_replied()
         assert faultinject.stats()["kills_fired"] == 1
     finally:
+        faultinject.reset()
+
+
+def test_kill_on_beat_seq_fires_at_exact_beat(monkeypatch):
+    """The beat-boundary SIGKILL point: fires exactly when the armed
+    beat number is reached, once (the deterministic way to kill a
+    COORDINATOR, whose enveloped-ack ordering is timing-dependent)."""
+    fired = []
+    monkeypatch.setattr(faultinject, "_sigkill_self",
+                        lambda: fired.append(True))
+    faultinject.reset()
+    try:
+        faultinject.configure(kill_on_beat_seq=3)
+        faultinject.server_beat(1)
+        faultinject.server_beat(2)
+        assert not fired
+        faultinject.server_beat(3)
+        assert fired and faultinject.stats()["kills_fired"] == 1
+        faultinject.server_beat(4)          # fired once, stays disarmed
+        assert len(fired) == 1
+    finally:
+        faultinject.reset()
+
+
+def test_only_coordinator_filter_composes(monkeypatch):
+    """MXNET_FI_ONLY_COORDINATOR gates the process-kill points on the
+    CURRENT coordinator role — kept fresh across failovers via
+    note_coordinator — composing with the ack-count and beat-seq
+    points (and with MXNET_FI_ONLY_SERVER)."""
+    fired = []
+    monkeypatch.setattr(faultinject, "_sigkill_self",
+                        lambda: fired.append(True))
+    faultinject.reset()
+    try:
+        faultinject.configure(kill_process_after=1, only_coordinator=True)
+        faultinject.note_coordinator(False)
+        faultinject.server_replied()
+        assert not fired          # not the coordinator: count frozen
+        faultinject.note_coordinator(True)   # a failover promotes us
+        faultinject.server_replied()
+        assert len(fired) == 1
+        # env arming covers the new knobs too
+        faultinject.reset()
+        monkeypatch.setenv("MXNET_FI_KILL_ON_BEAT_SEQ", "2")
+        monkeypatch.setenv("MXNET_FI_ONLY_COORDINATOR", "1")
+        faultinject._arm_from_env()
+        faultinject.note_coordinator(False)
+        faultinject.server_beat(2)
+        assert len(fired) == 1    # filtered: not the coordinator
+        faultinject.note_coordinator(True)
+        faultinject.server_beat(3)
+        assert len(fired) == 2
+    finally:
+        faultinject.note_coordinator(False)
         faultinject.reset()
 
 
@@ -633,6 +925,186 @@ def test_stripe_plan_staleness_is_hard_error(monkeypatch):
             s.stop()
 
 
+def test_elastic_coordinator_death_fails_over_exact(monkeypatch):
+    """THE tentpole flow, in-process: kill server 0 — the COORDINATOR.
+    The worker elects the deterministic successor (server 1), reports
+    the death there; the successor verifies it with its own probe,
+    rebuilds the ledger at max(reported)+1 and answers with the
+    post-succession roster; the ordinary three-phase handoff then
+    reconstructs server 0's stripes — final weights EXACTLY equal the
+    uninterrupted run (integer grads, power-of-two lr).  No restart,
+    no votes, no extra protocol."""
+    srv0, srv1 = _elastic_pair(monkeypatch)
+    try:
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.init("small", mx.nd.ones((2, 2)))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((10, 4)))
+        kv.push("small", mx.nd.ones((2, 2)))
+        out_b, out_s = mx.nd.zeros((10, 4)), mx.nd.zeros((2, 2))
+        kv.pull("big", out=out_b)        # sync point: cache = state
+        kv.pull("small", out=out_s)
+        gen0 = kv._roster_gen
+        srv0.stop()                      # the COORDINATOR dies
+        # the next round rides succession + repair end to end
+        kv.push("big", mx.nd.ones((10, 4)) * 2)
+        kv.push("small", mx.nd.ones((2, 2)) * 2)
+        kv.barrier()                     # retried against the successor
+        kv.pull("big", out=out_b)
+        kv.pull("small", out=out_s)
+        np.testing.assert_array_equal(out_b.asnumpy(), big - 0.125 * 3)
+        np.testing.assert_array_equal(out_s.asnumpy(), 1.0 - 0.125 * 3)
+        uris = os.environ["MXT_SERVER_URIS"].split(",")
+        assert kv._roster_servers == [uris[1]]
+        assert kv._roster_gen > gen0
+        assert kv._failovers == 1
+        assert srv1._promoted
+        assert srv1._get_membership().roster().servers == (uris[1],)
+        counts = profiler.channel_counts()
+        assert counts.get("kvstore.coordinator_failover", 0) >= 1
+        assert counts.get("kvstore.coordinator_failover_observed",
+                          0) >= 1
+        assert counts.get("kvstore.coordinator_slot", None) == 1
+        assert counts.get("kvstore.failover_rebuild_s", None) is not None
+        from mxnet_tpu import distributed
+        assert distributed.coordinator_failovers() >= 1
+        # the survivor (now coordinator) owns every key
+        assert "big" in srv1._store and "small" in srv1._store
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_elastic_coordinator_death_momentum_via_peer_bank(monkeypatch):
+    """The snapshot bank OUTLIVES server 0: the coordinator's beat
+    fan-out ships its state snapshots to every peer, each peer banks
+    them, and a promotion preloads the local bank into the rebuilt
+    ledger — so momentum on the dead COORDINATOR's stripes restripes
+    elementwise-exactly, same contract as the non-coordinator kill."""
+    srv0, srv1 = _elastic_pair(monkeypatch, snapshot_s=0.05)
+    try:
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.5, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((10, 4)))      # momentum builds
+        out = mx.nd.zeros((10, 4))
+        kv.pull("big", out=out)                  # sync point
+        uris = os.environ["MXT_SERVER_URIS"].split(",")
+        doomed_wk = [wk for wk, (uri, _lo, _hi) in membership.wire_layout(
+            "big", (10, 4), uris, 16).items() if uri == uris[0]][0]
+
+        def banked_on_peer():
+            have = srv1._peer_snapshots.get(uris[0])
+            return have is not None and have[1].get("states", {}).get(
+                doomed_wk) not in (None, ())
+
+        deadline = time.time() + 5
+        while not banked_on_peer() and time.time() < deadline:
+            time.sleep(0.02)             # wait for a POST-push beat
+        assert banked_on_peer(), \
+            "no momentum-bearing coordinator snapshot banked on the peer"
+        srv0.stop()                      # the COORDINATOR dies
+        kv.barrier()     # quiescent repair: succession at the sync point
+        kv.push("big", mx.nd.ones((10, 4)))      # momentum compounds on
+        kv.barrier()
+        kv.pull("big", out=out)
+        # golden: the same sequence against one never-interrupted server
+        mom = np.zeros((10, 4), np.float32)
+        w = big.copy()
+        for _ in range(2):
+            mom = 0.5 * mom - 0.125 * np.ones((10, 4), np.float32)
+            w = w + mom
+        np.testing.assert_array_equal(out.asnumpy(), w)
+        assert profiler.channel_counts().get(
+            "kvstore.handoff_state_applied", 0) >= 1
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_elastic_double_death_walks_to_true_survivor(monkeypatch):
+    """Coordinator AND the next roster slot die together: the worker's
+    repair walks the election past the dead successor (its channel's
+    hard failure is the evidence), and the true survivor's probe-walk
+    excludes BOTH corpses from the rebuilt roster — values stay exact
+    on the last server standing."""
+    monkeypatch.setenv("MXNET_KVSTORE_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+    monkeypatch.setenv("MXNET_KVSTORE_SNAPSHOT_S", "0")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    srvs = [KVStoreServer(server_id=i, num_workers=1, elastic=True)
+            for i in range(3)]
+    uris = ",".join(f"127.0.0.1:{s.port}" for s in srvs)
+    monkeypatch.setenv("MXT_SERVER_URIS", uris)
+    for s in srvs:
+        s._roster_servers = uris.split(",")
+        s.start_background()
+    try:
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((10, 4)))
+        out = mx.nd.zeros((10, 4))
+        kv.pull("big", out=out)
+        srvs[0].stop()               # the coordinator...
+        srvs[1].stop()               # ...AND its deterministic successor
+        kv.push("big", mx.nd.ones((10, 4)) * 2)
+        kv.barrier()
+        kv.pull("big", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), big - 0.125 * 3)
+        assert kv._roster_servers == [uris.split(",")[2]]
+        assert srvs[2]._promoted and kv._failovers >= 1
+        assert kv._coordinator_slot == 2
+        m = srvs[2]._get_membership()
+        assert m.roster().servers == (uris.split(",")[2],)
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_beat_loop_self_promotes_on_coordinator_silence(monkeypatch):
+    """No worker needed: the survivors' own beat loops detect the
+    coordinator's death (refused dial = decisive evidence), every one
+    elects the same successor, and the elected one promotes itself —
+    so a workerless window (e.g. between epochs) still converges."""
+    srv0, srv1 = _elastic_pair(monkeypatch)
+    try:
+        deadline = time.time() + 5
+        while srv1._coord_last_ok is None and time.time() < deadline:
+            time.sleep(0.02)             # beats flowing to server 0
+        assert srv1._coord_last_ok is not None
+        srv0.stop()
+        deadline = time.time() + 10
+        while not srv1._promoted and time.time() < deadline:
+            time.sleep(0.05)
+        assert srv1._promoted
+        uris = os.environ["MXT_SERVER_URIS"].split(",")
+        m = srv1._get_membership()
+        assert m is not None and m.roster().servers == (uris[1],)
+        assert m.failovers == 1
+        assert profiler.channel_counts().get(
+            "kvstore.coordinator_failover", 0) >= 1
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
 def test_serving_replica_tolerates_roster_bump(monkeypatch):
     """The serving tier's weight-refresh client follows the roster: a
     parameter server dying between version pulls repairs transparently
@@ -675,6 +1147,9 @@ def test_serving_replica_tolerates_roster_bump(monkeypatch):
         assert getattr(replica._ps, "_roster_gen", 0) > gen_before
         stats = replica._op_stats(("serving_stats",), None)
         assert stats["roster_generation"] >= 1
+        # the failover observables surface through serving_stats too
+        assert "coordinator_slot" in stats
+        assert stats["coordinator_failovers"] == 0   # srv1 was not coord
         replica.stop()
         kv.close(stop_servers=True)
     finally:

@@ -216,11 +216,12 @@ def main():
                     help="extra KEY=VALUE env for every worker")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic membership (MXNET_KVSTORE_ELASTIC): a "
-                         "parameter server exiting — even killed — no "
-                         "longer fails the job; surviving workers "
-                         "re-stripe and hand state off over the roster "
-                         "(server 0, the coordinator, staying up is "
-                         "still required)")
+                         "parameter server exiting — even killed, even "
+                         "server 0, the roster coordinator — no longer "
+                         "fails the job; the survivors elect the "
+                         "deterministic successor, rebuild the "
+                         "membership ledger, re-stripe and hand state "
+                         "off over the roster")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run on every worker")
     args = ap.parse_args()
@@ -283,26 +284,24 @@ def main():
             slive.remove(p)
             # exit 0 = the documented kStopServer shutdown (a worker's
             # kv.close(stop_servers=True)) — benign; only a CRASHED
-            # server (nonzero) fails the job.  Under --elastic a dead
-            # server is a MEMBERSHIP event, not a job failure: the
-            # surviving workers evict it from the roster, re-derive
-            # striping and hand its state off (the workers' own exit
-            # codes still decide the job).
+            # server (nonzero) fails the job.  Under --elastic ANY dead
+            # server — the coordinator included — is a MEMBERSHIP
+            # event, not a job failure: the survivors evict it from the
+            # roster (slot 0's death seats the deterministically
+            # elected successor, docs/ROBUSTNESS.md coordinator
+            # failover), re-derive striping and hand its state off (the
+            # workers' own exit codes still decide the job).  Every
+            # server dying leaves the workers to fail on their own
+            # exhausted retry budgets, which sets rc.
             if code != 0 and rc == 0:
                 sid = sprocs.index(p)
-                if args.elastic and sid != 0:
+                if args.elastic:
                     print("launch.py: server %d exited %d; elastic job "
-                          "continues on the surviving roster"
-                          % (sid, code), flush=True)
+                          "continues on the surviving roster%s"
+                          % (sid, code,
+                             " (coordinator died: successor takes over)"
+                             if sid == 0 else ""), flush=True)
                 else:
-                    # server 0 is the roster COORDINATOR: its death is
-                    # the one unrecoverable membership event
-                    # (docs/ROBUSTNESS.md) — fail fast instead of
-                    # letting every worker burn its reconnect budget
-                    if args.elastic:
-                        print("launch.py: coordinator (server 0) exited "
-                              "%d — unrecoverable; failing the job"
-                              % code, flush=True)
                     rc = code
                     _kill_all()
         time.sleep(0.1)
