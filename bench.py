@@ -376,6 +376,8 @@ def _run(batch):
     from mxnet_tpu import profiler as _mx_prof
     wire0 = sum(_mx_prof.channel_bytes().values())
     sync0 = _mx_prof.host_sync_total()
+    wait0 = _mx_prof.wire_wait_ms()
+    round0 = _mx_prof.wire_round_ms()
     t0 = time.perf_counter()
     for i in range(iters):
         step(i)
@@ -385,6 +387,12 @@ def _run(batch):
     hard_sync()
     dt = time.perf_counter() - t0
     wire_bytes = sum(_mx_prof.channel_bytes().values()) - wire0
+    # overlap over THIS timed region only (wait/round deltas), so
+    # warmup and earlier configs can't dilute the reported fraction
+    wire_wait_d = _mx_prof.wire_wait_ms() - wait0
+    wire_round_d = _mx_prof.wire_round_ms() - round0
+    overlap_pct = (max(0.0, 100.0 * (1.0 - wire_wait_d / wire_round_d))
+                   if wire_round_d > 0 else 0.0)
 
     # one step() call runs STEPS_PER_CALL training steps; report per
     # TRAINING step so K=1 and K=8 rows compare directly
@@ -418,6 +426,14 @@ def _run(batch):
         # device->host sync (docs/PERF_NOTES.md round 8).
         "host_syncs_per_step": round(
             host_syncs / iters / STEPS_PER_CALL, 3),
+        # exposed (host-blocked) kvstore wire per TRAINING step and the
+        # fraction of the wire hidden behind the scanned compute — 0.0
+        # off the dist path; under fused dist_async training the
+        # overlap_pct is the round-10 headline number
+        # (docs/PERF_NOTES.md; profiler.wire_wait_ms/wire_overlap_pct)
+        "wire_wait_ms_per_step": round(
+            wire_wait_d / iters / STEPS_PER_CALL, 3),
+        "overlap_pct": round(overlap_pct, 1),
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
         "remat": (os.environ.get("MXNET_REMAT_POLICY", "full")

@@ -105,6 +105,25 @@ JAX_PLATFORMS=cpu timeout -k 10 240 \
     --env MXT_KILL_SERVER=0 \
     python tests/dist/dist_elastic_membership.py
 
+echo "== fused-dist smoke (K-step scan over the dist_async wire, overlapped)"
+# The two headline wins finally compose (ISSUE 10 / PERF_NOTES round 10):
+# run_steps on update-on-kvstore drives the chunked scanned driver — one
+# dispatch per chunk — with the grad-push/weight-pull round overlapped
+# behind the next chunk's compute.  Two workers train eager vs fused
+# (staleness 0 and 1) against one server; constant integer gradients x a
+# power-of-two lr make all three runs BIT-IDENTICAL to the analytic
+# golden (convergence equivalence), and the launcher-armed server ack
+# delay makes the overlap measurable: wire_wait_ms of the staleness-1
+# run must sit STRICTLY below the staleness-0 (unoverlapped) baseline,
+# overlap_pct strictly above.  The in-process twins (bit-exact staleness
+# goldens, dispatch pins, mid-window kill replay) run in tier-1
+# (tests/test_fused_dist.py).  Time-boxed: an overlap regression
+# presents as a failed inequality, a driver regression as a hang.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 \
+    --env MXNET_FI_DELAY_ACK_MS=10 \
+    python tests/dist/dist_fused_runsteps.py
+
 echo "== serving smoke (replica + dynamic batcher + live weight refresh)"
 # The inference tier's acceptance across real process/socket boundaries
 # (docs/SERVING.md): one replica serves 64 concurrent requests through

@@ -160,6 +160,32 @@ declare_env("MXNET_KVSTORE_ELASTIC_PUSH_LOG", int, 256,
             "key's last pull, re-applied under the new layout when a "
             "server dies with them (older entries fall off: "
             "best-effort for barrier-free async jobs)")
+declare_env("MXNET_KVSTORE_FUSED", bool, True,
+            "dist_async: let run_steps/step_k drive update-on-kvstore "
+            "training through the chunked K-step scan with the push/"
+            "pull wire overlapped behind the next chunk's compute "
+            "(docs/PERF_NOTES.md round 10); 0 restores the eager "
+            "per-step dist loop.  Elastic jobs "
+            "(MXNET_KVSTORE_ELASTIC) always take the eager loop — "
+            "roster repair does not compose with in-flight pull_async "
+            "handles yet")
+declare_env("MXNET_KVSTORE_FUSED_CHUNK", int, 8,
+            "fused-dist driver: scanned steps per chunk — one host "
+            "dispatch and one push/pull wire round per chunk; larger "
+            "chunks amortize dispatch further but widen the window of "
+            "local (worker-replica) weight evolution between server "
+            "sync points.  A K not divisible by the chunk compiles the "
+            "tail chunk as its own XLA program — size K in multiples "
+            "to pay exactly one compile")
+declare_env("MXNET_KVSTORE_FUSED_STALENESS", int, 1,
+            "fused-dist driver: exactly how many chunk boundaries the "
+            "adopted server weights lag — chunk j always starts from "
+            "the pull issued after chunk j-1-S's pushes (deterministic, "
+            "so goldens are simulable).  0 degrades to a barrier'd "
+            "chunk boundary (no overlap) that single-worker matches the "
+            "eager dist loop bit-for-bit; 1 (default) hides the wire "
+            "behind one chunk of compute — async-SGD-grade staleness, "
+            "same class as the elastic handoff contract")
 # -- serving tier (mxnet_tpu.serving) ---------------------------------------
 declare_env("MXNET_SERVING_BUCKETS", str, "1,2,4,8,16,32",
             "serving: comma-separated batch-size buckets the replica "

@@ -206,6 +206,69 @@ def build_multi_step(step_body, donate=True):
     return jax.jit(k_steps, donate_argnums=(0,) if donate else ())
 
 
+def fused_dist_knobs(k):
+    """``(chunk_size, staleness)`` for the fused-dist drivers — one
+    reader for the knob pair so Module and Trainer can never parse the
+    envs differently.  Note a ``k`` that is not a multiple of the chunk
+    produces one tail chunk with its own leading dimension, which
+    compiles as its own XLA program (the jit cache keys on shape):
+    size K-step calls as multiples of MXNET_KVSTORE_FUSED_CHUNK to pay
+    exactly one compile."""
+    from .base import env
+    chunk = max(1, min(k, int(env("MXNET_KVSTORE_FUSED_CHUNK", 8))))
+    staleness = max(0, int(env("MXNET_KVSTORE_FUSED_STALENESS", 1)))
+    return chunk, staleness
+
+
+def drive_chunked_dist(num_steps, chunk_size, staleness, dispatch_chunk,
+                       ship_chunk):
+    """The chunked-scan dist_async driver: overlap the kvstore wire
+    behind the scanned compute (the MXNet dependency-engine thesis —
+    overlap communication with computation, arXiv:1512.01274 — rebuilt
+    on XLA async dispatch; PipeDream-shaped pipelining, arXiv:1806.03377).
+
+    ``num_steps`` splits into ceil(num_steps/chunk_size) chunks.  Per
+    chunk ``j``:
+
+    1. if chunk ``j-1-staleness`` has a wire round in flight, BLOCK on
+       it and hand its pulled weights to ``dispatch_chunk`` for
+       adoption — with staleness 0 this is a barrier'd chunk boundary
+       (the wire fully exposed, every chunk starts from the server's
+       post-previous-chunk weights); with staleness S>=1 the round has
+       had S chunks of compute to resolve, so the block is only the
+       un-overlapped residue (profiler.record_wire_wait counts it),
+    2. ``dispatch_chunk(j, lo, hi, adopted) -> grads_host`` dispatches
+       the scanned compute for steps [lo, hi) and reads the chunk's
+       per-step gradients back (blocking on the chunk's COMPUTE, never
+       on the wire),
+    3. ``ship_chunk(j, grads_host) -> handle`` pushes the gradients
+       (fire-and-forget through the pipelined window) and enqueues the
+       next pull; ``handle.wait() -> {name: host array}`` resolves it.
+
+    The lag is EXACT, not just bounded: chunk ``j`` always adopts the
+    round issued after chunk ``j-1-staleness``'s pushes, even when a
+    fresher round happens to have resolved — determinism is what makes
+    the staleness-1 analytic golden (and any future autotuned setting)
+    simulable and therefore testable (tests/test_fused_dist.py).
+
+    Returns the FINAL round's pulled values — the server-authoritative
+    weights at the sync point — or None when num_steps == 0."""
+    import math
+    n_chunks = math.ceil(num_steps / chunk_size)
+    pending = {}
+    for j in range(n_chunks):
+        due = j - 1 - staleness
+        adopted = pending.pop(due).wait() if due in pending else None
+        lo = j * chunk_size
+        hi = min(num_steps, lo + chunk_size)
+        grads = dispatch_chunk(j, lo, hi, adopted)
+        pending[j] = ship_chunk(j, grads)
+    final = None
+    for j in sorted(pending):
+        final = pending[j].wait()
+    return final
+
+
 def scan_cache_lookup(cache, key):
     """Bounded-LRU lookup for compiled multi-step programs (the one
     cache policy shared by Module.run_steps and Trainer.step_k): a hit

@@ -216,11 +216,15 @@ class Trainer:
             self._fused_update(fused_batch, updater)
 
     def _step_on_kvstore(self, ignore_stale_grad):
-        """Async-PS step: push every grad (fire-and-forget, overlapping),
-        then ONE batched pull of the server's current weights back
-        (reference: trainer.py:148 _update update-on-kvstore branch;
-        pipelined pull = ~max-RTT, not N round trips).  Per-server FIFO
-        guarantees each pull observes this worker's own pushes."""
+        """Async-PS step: ONE list-form push of every grad (small
+        same-server keys coalesce into a single ``push_multi`` envelope
+        under ``MXNET_KVSTORE_COALESCE_BYTES`` — per-param pushes used
+        to bypass the coalescing path entirely and pay a frame+ack per
+        tiny tensor), then ONE batched pull of the server's current
+        weights back (reference: trainer.py:148 _update
+        update-on-kvstore branch; pipelined pull = ~max-RTT, not N
+        round trips).  Per-server FIFO guarantees each pull observes
+        this worker's own pushes."""
         snap = (self._optimizer.lr, self._optimizer.rescale_grad)
         if snap != self._kv_opt_snapshot \
                 and not getattr(self, "_kv_opt_drift_warned", False):
@@ -249,9 +253,10 @@ class Trainer:
                 # makes a late init safe under concurrent workers)
                 self._kvstore.init(param.name, param.data())
                 self._kv_param_inited.add(param.name)
-            self._kvstore.push(param.name, param.grad())
             live.append(param)
         if live:
+            self._kvstore.push([p.name for p in live],
+                               [p.grad() for p in live])
             self._kvstore.pull([p.name for p in live],
                                out=[p.data() for p in live])
 
@@ -411,8 +416,16 @@ class Trainer:
 
         Per-step lr/wd schedules and update counts are precomputed
         host-side, exactly as K ``step()`` calls would advance them.
-        Falls back to the eager loop (autograd record/backward + step)
-        for K=1, dist_async update-on-kvstore, non-pure optimizers, or
+        dist_async update-on-kvstore runs the CHUNKED variant of the
+        same scan — one dispatch per ``MXNET_KVSTORE_FUSED_CHUNK``
+        steps, a local worker-side replica of the server update keeping
+        the in-chunk trajectory fresh, and the grad-push/weight-pull
+        wire overlapped behind the next chunk's compute
+        (``MXNET_KVSTORE_FUSED_STALENESS``; the Module.run_steps dist
+        driver's gluon twin — see its docstring for the staleness
+        contract).  Falls back to the eager loop (autograd
+        record/backward + step) for K=1, non-pure optimizers,
+        ``MXNET_KVSTORE_FUSED=0`` (dist), or
         ``MXNET_EXEC_BULK_EXEC_TRAIN=0``.  Caveat: ops drawing from the
         global RNG (Dropout) freeze their trace-time draw — use the
         eager path (or Module.run_steps, whose interpreter threads keys
@@ -448,11 +461,25 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        fuse = (k > 1
-                and env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
-                and getattr(self._optimizer, "pure_update", False)
-                and not getattr(self, "_update_on_kvstore", False))
-        if not fuse:
+        fusable = (k > 1
+                   and env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
+                   and getattr(self._optimizer, "pure_update", False))
+        if getattr(self, "_update_on_kvstore", False):
+            # dist_async no longer falls back to eager: the chunked
+            # driver scans fwd+bwd+local-update and overlaps the
+            # grad-push/weight-pull wire behind the next chunk's
+            # compute (the Module.run_steps dist driver's gluon twin).
+            # Elastic jobs keep the eager loop — its blocking pulls
+            # ride the roster-repair wrapper, which an in-flight
+            # pull_async handle cannot yet (docs/ROBUSTNESS.md).
+            if (fusable and env("MXNET_KVSTORE_FUSED", True)
+                    and not getattr(self._kvstore, "_elastic", False)):
+                self._ensure_kv_optimizer()
+                return self._step_k_fused(loss_fn, data_t, label_t, k,
+                                          eval_metric, dist=True)
+            return self._step_k_eager(loss_fn, data_t, label_t, k,
+                                      batch_size, eval_metric)
+        if not fusable:
             return self._step_k_eager(loss_fn, data_t, label_t, k,
                                       batch_size, eval_metric)
         return self._step_k_fused(loss_fn, data_t, label_t, k, eval_metric)
@@ -486,7 +513,16 @@ class Trainer:
         return NDArray(jnp.stack(losses))
 
     def _step_k_fused(self, loss_fn, data_t, label_t, k,
-                      eval_metric=None):
+                      eval_metric=None, dist=False):
+        """``dist=True`` is the update-on-kvstore variant: the SAME
+        scanned body (the local update doubles as the worker-side
+        replica of the server's updater — both run
+        ``Optimizer._update_impl``) additionally scans out the raw
+        per-step gradients, and the dispatch runs chunked through
+        ``executor.drive_chunked_dist`` with the push/pull wire
+        overlapped behind the next chunk's compute.  Staleness
+        semantics and the exactness contract are documented on
+        ``Module._run_steps_fused_dist``."""
         from .. import autograd as _ag
         from .. import profiler as _prof
         from ..ndarray import NDArray
@@ -494,7 +530,10 @@ class Trainer:
         import jax.numpy as jnp
         opt = self._optimizer
         updater = self._updaters[0]
-        zero1 = self._zero_stage >= 1 and self._zero_dp > 1
+        # ZeRO-1 state sharding composes with the LOCAL fused driver
+        # only — under update-on-kvstore the authoritative states live
+        # on the servers and the local replica states stay replicated
+        zero1 = self._zero_stage >= 1 and self._zero_dp > 1 and not dist
         deferred = [p.name for p in self._params
                     if p._deferred_init is not None]
         if deferred:
@@ -562,7 +601,7 @@ class Trainer:
                   tuple(id(p) for p in pins))
         key = (fn_key, tuple(idxs), len(aux_params), use_mp, needs_t,
                opt.hyperparam_signature(), zero1, param_specs,
-               label_t is None, donate,
+               label_t is None, donate, dist,
                eval_metric._device_sig() if use_dev_metric else None)
         cache = getattr(self, "_step_k_cache", None)
         if cache is None:
@@ -631,7 +670,8 @@ class Trainer:
                         mstate,
                         list(label_j) if label_j is not None else [],
                         [loss_val])
-                return (new_ws, new_auxs, new_sts, mstate), loss_val
+                ys = (loss_val, grads) if dist else loss_val
+                return (new_ws, new_auxs, new_sts, mstate), ys
 
             from ..executor import build_multi_step
             fn = build_multi_step(scan_body, donate=donate)
@@ -640,10 +680,14 @@ class Trainer:
         # per-step lr/wd/t advance exactly as K step() calls would
         # (shared helper with Module.run_steps); rollback keeps the host
         # schedule transactional with the dispatch — a failed compile
-        # must not leave counts K steps ahead of the params
+        # must not leave counts K steps ahead of the params.  The dist
+        # driver keys schedules by param NAME — the wire key the
+        # SERVER's updater advances counts under — so the local replica
+        # samples the same lr sequence the server does
         from ..executor import precompute_step_schedules, schedule_rollback
+        sched_keys = [p.name for p in trainable] if dist else idxs
         with schedule_rollback(opt):
-            lrs, wds, ts = precompute_step_schedules(opt, idxs, k)
+            lrs, wds, ts = precompute_step_schedules(opt, sched_keys, k)
             # _take (not peek), and only now that every pre-dispatch
             # step that can fail (the schedule precompute above) is
             # done: the carry is donated, so a failed DISPATCH must
@@ -653,18 +697,28 @@ class Trainer:
             init_m = eval_metric._take_device_state() if use_dev_metric \
                 else ()
 
-            _prof.record_dispatch("step_k.dispatch")
-            with _prof.scope("step_k_scan", "symbolic"):
-                (new_ws, new_auxs, new_sts, new_m), losses = fn(
-                    (ws, auxs, sts, init_m),
-                    (data_t, label_t, lrs, wds, ts), ())
-        for p, w in zip(trainable, new_ws):
-            p._data._set_data(w)
-        for p, a in zip(aux_params, new_auxs):
-            p._data._set_data(a)
-        for st_old, st_new in zip(states, new_sts):
-            for s, v in zip(st_old, st_new):
-                s._set_data(v)
+            def _writeback(ws_, auxs_, sts_):
+                for p, w in zip(trainable, ws_):
+                    p._data._set_data(w)
+                for p, a in zip(aux_params, auxs_):
+                    p._data._set_data(a)
+                for st_old, st_new in zip(states, sts_):
+                    for s, v in zip(st_old, st_new):
+                        s._set_data(v)
+
+            if dist:
+                new_ws, new_auxs, new_sts, new_m, losses = \
+                    self._drive_step_k_dist(fn, trainable, use_mp, ws,
+                                            auxs, sts, init_m, data_t,
+                                            label_t, lrs, wds, ts, k,
+                                            _writeback)
+            else:
+                _prof.record_dispatch("step_k.dispatch")
+                with _prof.scope("step_k_scan", "symbolic"):
+                    (new_ws, new_auxs, new_sts, new_m), losses = fn(
+                        (ws, auxs, sts, init_m),
+                        (data_t, label_t, lrs, wds, ts), ())
+        _writeback(new_ws, new_auxs, new_sts)
         if use_dev_metric:
             eval_metric._absorb_device_state(new_m)
         elif eval_metric is not None:
@@ -686,6 +740,100 @@ class Trainer:
                     if host_labels is not None else [],
                     [NDArray(host_losses[j])])
         return NDArray(losses)
+
+    def _drive_step_k_dist(self, fn, trainable, use_mp, ws, auxs, sts,
+                           init_m, data_t, label_t, lrs, wds, ts, k,
+                           on_failure):
+        """Chunked dispatch of the dist step_k scan: one compiled-scan
+        launch and one grad-push/weight-pull wire round per chunk, the
+        round overlapped behind the NEXT chunk's compute
+        (executor.drive_chunked_dist; profiler.wire_wait_ms /
+        wire_overlap_pct count the exposed vs hidden wire).  Returns
+        ``(new_ws, new_auxs, new_sts, new_m, stacked_losses)`` with
+        ``new_ws`` the FINAL pull's server-authoritative weights."""
+        import jax
+        import jax.numpy as jnp
+        from .. import profiler as _prof
+        from ..executor import drive_chunked_dist, fused_dist_knobs
+        kv = self._kvstore
+        names = [p.name for p in trainable]
+        shapes = [tuple(p._data.shape) for p in trainable]
+        dtypes = [p._data._data.dtype for p in trainable]
+        # a deferred-init param that materialized after _init_kvstore
+        # must register before its first push — same first-init-wins
+        # late registration the eager _step_on_kvstore performs
+        for p in trainable:
+            if p.name not in self._kv_param_inited:
+                kv.init(p.name, p.data())
+                self._kv_param_inited.add(p.name)
+        chunk, staleness = fused_dist_knobs(k)
+        carry = {"ws": ws, "auxs": auxs, "sts": sts, "m": init_m,
+                 "losses": []}
+
+        def adopt(adopted):
+            # chunk-boundary re-sync: weights adopt the pulled server
+            # values — for a multi-precision param the fp32 MASTER in
+            # states[0] adopts too (the update runs on it and recasts
+            # the weight); the rest of the replica optimizer state and
+            # aux stay local (the async-SGD-grade part of the contract)
+            new_ws, new_sts = [], list(carry["sts"])
+            for i, (n, dt) in enumerate(zip(names, dtypes)):
+                w = jnp.asarray(adopted[n])
+                if use_mp[i]:
+                    master = w.astype(jnp.float32)
+                    new_sts[i] = (master,) + tuple(new_sts[i][1:])
+                    w = master.astype(dt)
+                else:
+                    w = w.astype(dt)
+                new_ws.append(w)
+            carry["ws"] = tuple(new_ws)
+            carry["sts"] = tuple(new_sts)
+
+        def dispatch_chunk(j, lo, hi, adopted):
+            if adopted is not None:
+                adopt(adopted)
+            xs = (tuple(a[lo:hi] for a in data_t),
+                  tuple(a[lo:hi] for a in label_t)
+                  if label_t is not None else None,
+                  tuple(v[lo:hi] for v in lrs),
+                  tuple(v[lo:hi] for v in wds),
+                  tuple(v[lo:hi] for v in ts))
+            _prof.record_dispatch("step_k.dist_chunk")
+            with _prof.scope("step_k_dist_chunk", "symbolic"):
+                (nws, nauxs, nsts, nm), (losses, grads) = fn(
+                    (carry["ws"], carry["auxs"], carry["sts"],
+                     carry["m"]), xs, ())
+            carry.update(ws=nws, auxs=nauxs, sts=nsts, m=nm)
+            carry["losses"].append(losses)
+            # ONE stacked readback of the chunk's raw per-step grads —
+            # blocks on the chunk's COMPUTE; the wire round itself is
+            # what the driver overlaps behind the next chunk
+            grads_np = jax.device_get(grads)
+            _prof.record_host_sync("step_k.dist_grad_readback")
+            return grads_np
+
+        def ship_chunk(j, grads_np):
+            return kv.ship_chunk_steps(names, grads_np, shapes)
+
+        try:
+            final = drive_chunked_dist(k, chunk, staleness,
+                                       dispatch_chunk, ship_chunk)
+        except BaseException:
+            # a wire failure mid-drive lands AFTER earlier chunks
+            # donated the original param/aux/state buffers — the carry
+            # holds the latest chunk's OUTPUT arrays (alive): park them
+            # so the trainer's params stay readable at the last
+            # locally-completed step
+            on_failure(carry["ws"], carry["auxs"], carry["sts"])
+            raise
+        # the final pull is the sync point: trainable weights adopt the
+        # server-authoritative values, fp32 masters included (exactly
+        # like step()'s pull)
+        adopt(final)
+        losses = (jnp.concatenate(carry["losses"])
+                  if len(carry["losses"]) > 1 else carry["losses"][0])
+        return (carry["ws"], carry["auxs"], carry["sts"], carry["m"],
+                losses)
 
     def allreduce_grads(self):
         """No-op on TPU: gradient reduction is fused into backward

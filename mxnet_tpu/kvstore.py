@@ -821,6 +821,49 @@ def _await(pending):
     return pending.value
 
 
+class _PullHandle:
+    """One in-flight batched pull (:meth:`KVStoreDistAsync.pull_async`).
+
+    ``wait()`` blocks for every reply, reassembles stripes, syncs the
+    elastic pull cache exactly like a blocking :meth:`pull`, and returns
+    ``{key: np.ndarray}``.  It also feeds the two wire-overlap clocks
+    (profiler.record_wire_wait / record_wire_round): the time spent
+    BLOCKED inside ``wait()`` is the exposed wire, the enqueue->resolved
+    span is the full round — their ratio is the overlap fraction the
+    fused-dist driver is regression-gated on.  Idempotent: a second
+    ``wait()`` returns the cached result without re-counting."""
+
+    __slots__ = ("_kv", "_reqs", "_t0", "_result")
+
+    def __init__(self, kv, reqs):
+        import time
+        self._kv = kv
+        self._reqs = reqs
+        self._t0 = time.monotonic()
+        self._result = None
+
+    def wait(self):
+        if self._result is not None:
+            return self._result
+        import time
+        from . import profiler as _prof
+        t_wait = time.monotonic()
+        vals = {}
+        for k, pending in self._reqs:
+            if isinstance(pending, list):
+                val = np.concatenate(
+                    [np.asarray(_await(p)) for p in pending], axis=0)
+            else:
+                val = np.asarray(_await(pending))
+            self._kv._cache_value(k, val)
+            vals[k] = val
+        t1 = time.monotonic()
+        _prof.record_wire_wait(t1 - t_wait)
+        _prof.record_wire_round(t1 - self._t0)
+        self._result = vals
+        return vals
+
+
 class KVStoreDistAsync(KVStore):
     """Worker-side kvstore ``dist_async`` (reference: kvstore_dist.h worker
     + the server's immediate-apply branch, kvstore_dist_server.h:405-430).
@@ -1432,16 +1475,27 @@ class KVStoreDistAsync(KVStore):
         skipped, because the repair already re-pushed them from the push
         log."""
         keys, values = self._canon(key, value)
+        self._push_aggregated(
+            [(k, np.asarray(self._reduce(vs)))
+             for k, vs in zip(keys, values)])
+
+    def _push_aggregated(self, pairs):
+        """Plan and submit one push round of already-reduced HOST
+        gradients ``[(key, np.ndarray), ...]`` — the shared tail of
+        :meth:`push` and the fused-dist chunk driver (which reads a
+        whole chunk's gradients back in ONE stacked device_get and must
+        not re-enter through NDArray wrappers).  Compression, striping,
+        same-server coalescing and the elastic push log all live here,
+        so the two entry points can never diverge on the wire."""
         small: Dict[int, list] = {}   # conn index -> [(wire_key, payload)]
         planned = []                  # (base_key, conn, msg)
-        for k, vs in zip(keys, values):
-            agg = np.asarray(self._reduce(vs))
+        for k, agg in pairs:
             self._log_push(k, agg)
             plan = self._stripe_plan(k, agg.shape)
             if plan is None:
                 payload = self._wire_push_payload(k, agg)
                 conn = self._conn_of(k)
-                if (len(keys) > 1
+                if (len(pairs) > 1
                         and self._payload_nbytes(payload)
                         <= self._coalesce_bytes):
                     small.setdefault(self._conns.index(conn), []).append(
@@ -1568,6 +1622,51 @@ class KVStoreDistAsync(KVStore):
             for o in os_:
                 o._set_data(val.astype(o._data.dtype)
                             if o._data.dtype != val.dtype else val)
+
+    def ship_chunk_steps(self, names, grads_np, shapes):
+        """The shared SHIP leg of the fused-dist chunk drivers
+        (Module._run_steps_fused_dist and Trainer step_k's dist path —
+        one implementation so the wire contract can never diverge):
+        push one chunk's per-step gradients in STEP order — the server's
+        momentum/schedule state must advance once per step, exactly as
+        the eager loop ships — with the small same-server keys of each
+        step coalescing into one envelope, then enqueue the next
+        non-blocking pull and return its handle."""
+        for s in range(grads_np[0].shape[0]):
+            self._push_aggregated(
+                [(n, np.ascontiguousarray(g[s]))
+                 for n, g in zip(names, grads_np)])
+        return self.pull_async(list(names), list(shapes))
+
+    def pull_async(self, keys, shapes):
+        """Enqueue a batched pull of ``keys`` and return a
+        :class:`_PullHandle` immediately — the non-blocking half of the
+        fused-dist driver's wire round: the requests ride the pipelined
+        window now (per-server FIFO, so the replies observe every prior
+        push from THIS worker), and ``handle.wait()`` collects the host
+        values later, after the next chunk's compute has been
+        dispatched.  ``shapes`` supplies each key's full logical shape
+        so the stripe plan derives without an out array.
+
+        Transport faults recover transparently through the channel's
+        reconnect+replay; under MXNET_KVSTORE_ELASTIC a HARD channel
+        failure surfaces from ``wait()`` instead of triggering a roster
+        repair — the in-flight handle cannot be re-routed (composing
+        the fused driver with elastic repair is roadmap work; the eager
+        per-step loop remains the repair-capable path)."""
+        if isinstance(keys, str):
+            keys, shapes = [keys], [shapes]
+        reqs = []
+        for k, shape in zip(keys, shapes):
+            k = _key(k)
+            plan = self._stripe_plan(k, tuple(shape))
+            if plan is None:
+                reqs.append((k, self._conn_of(k).request(("pull", k))))
+            else:
+                reqs.append((k, [
+                    self._stripe_conn(k, i).request(("pull", f"{k}@s{i}"))
+                    for i in range(len(plan) - 1)]))
+        return _PullHandle(self, reqs)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows from the owning server — O(rows)

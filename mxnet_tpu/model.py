@@ -58,15 +58,28 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    """reference: model.py:105 — push grads, pull updated weights."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
+    """reference: model.py:105 — push grads, pull updated weights.
+
+    ONE list-form push then ONE list-form pull (was an interleaved
+    per-key push/pull pair): small same-server keys coalesce into one
+    ``push_multi`` envelope (``MXNET_KVSTORE_COALESCE_BYTES``) and the
+    pipelined pull costs ~max-RTT instead of N round trips.  Values are
+    unchanged — per-server FIFO still guarantees every pull observes
+    this worker's own pushes, and distinct keys are independent on the
+    server."""
+    names, grads, args = [], [], []
+    for index, (arg_list, grad_list) in enumerate(
+            zip(param_arrays, grad_arrays)):
         if grad_list is None or (isinstance(grad_list, list)
                                  and grad_list[0] is None):
             continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+        names.append(param_names[index])
+        grads.append(grad_list)
+        args.append(arg_list)
+    if not names:
+        return
+    kvstore.push(names, grads)
+    kvstore.pull(names, out=args)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,

@@ -620,13 +620,20 @@ class Module(BaseModule):
         io_idx = [i for i in range(len(arg_names)) if i not in upd_set]
         return upd_idx, io_idx
 
-    def _make_step_body(self, names):
+    def _make_step_body(self, names, with_grads=False):
         """Build the PURE single fused-step function
         ``step(pvals, io_vals, aux_vals, key, states, lrs, wds, t) ->
         (outs, new_aux, new_params, new_states)`` shared by the per-step
         jit (update) and the K-step scan (run_steps): both drivers trace
         the SAME body, so scanned training is bit-equivalent to eager
-        fused steps by construction."""
+        fused steps by construction.
+
+        ``with_grads`` appends the raw (pre-rescale) per-param gradients
+        to the return — the fused-dist driver ships exactly these over
+        the kvstore wire, the same quantity the eager dist loop reads
+        from grad_dict, while the LOCAL update the body already applied
+        keeps the in-chunk weight trajectory fresh (the worker-side
+        replica of the server's update; docs/PERF_NOTES.md round 10)."""
         exec_ = self._exec
         run = exec_._run
         arg_names = exec_._arg_names
@@ -695,6 +702,9 @@ class Module(BaseModule):
                 # grads feeding it)
                 new_states = _par.constrain_zero_states(
                     new_states, self._mesh, self._zero_dp())
+            if with_grads:
+                return (outs, new_aux, tuple(new_params),
+                        tuple(new_states), tuple(grads))
             return outs, new_aux, tuple(new_params), tuple(new_states)
 
         return step
@@ -732,11 +742,16 @@ class Module(BaseModule):
         returned stacked outputs yourself.
 
         The compiled program is cached per (K, shapes, param set,
-        optimizer hyperparameters).  Falls back to the eager per-step
-        driver (BaseModule.run_steps) for K=1, shape changes vs the
-        bound shapes (bucketing / variable shapes), non-pure optimizers,
-        update-on-kvstore, and ``MXNET_EXEC_BULK_EXEC_TRAIN=0`` — same
-        math, K dispatches.
+        optimizer hyperparameters).  dist_async update-on-kvstore runs
+        the CHUNKED variant of the same program — one dispatch per
+        ``MXNET_KVSTORE_FUSED_CHUNK`` steps with the grad-push/weight-
+        pull wire overlapped behind the next chunk's compute
+        (:meth:`_run_steps_fused_dist`).  Falls back to the eager
+        per-step driver (BaseModule.run_steps) for K=1, shape changes
+        vs the bound shapes (bucketing / variable shapes), non-pure
+        optimizers, non-dist_async update-on-kvstore,
+        ``MXNET_KVSTORE_FUSED=0``, and
+        ``MXNET_EXEC_BULK_EXEC_TRAIN=0`` — same math, K dispatches.
 
         Returns the per-step outputs stacked on a leading K axis, one
         NDArray per output; scanned training is bit-equivalent to K
@@ -756,20 +771,45 @@ class Module(BaseModule):
             tuple(a.shape[1:]) == tuple(self._exec.arg_dict[n].shape)
             for n, a in zip(self._data_names + self._label_names,
                             data_arrays + label_arrays))
-        use_fused = (k > 1 and bool(names) and shapes_ok
-                     and env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
-                     and getattr(opt, "pure_update", False)
-                     and not self._update_on_kvstore)
-        if not use_fused:
+        fusable = (k > 1 and bool(names) and shapes_ok
+                   and env("MXNET_EXEC_BULK_EXEC_TRAIN", True)
+                   and getattr(opt, "pure_update", False))
+        if self._update_on_kvstore:
+            # dist_async update-on-kvstore no longer falls back to eager:
+            # the chunked driver scans fwd+bwd+local-update per chunk and
+            # overlaps the push/pull wire behind the next chunk's compute
+            # (_run_steps_fused_dist).  Other update-on-kvstore stores
+            # (local multi-device, dist_sync) keep the eager per-step
+            # loop — they have no async wire to overlap.  Elastic jobs
+            # keep the eager loop too: its blocking pulls ride the
+            # roster-repair wrapper, while an in-flight pull_async
+            # handle cannot re-route across a roster bump yet (the
+            # ROADMAP composition item; docs/ROBUSTNESS.md).
+            if (fusable and self._kvstore is not None
+                    and getattr(self._kvstore, "type", "") == "dist_async"
+                    and not getattr(self._kvstore, "_elastic", False)
+                    and env("MXNET_KVSTORE_FUSED", True)):
+                return self._run_steps_fused_dist(
+                    data_arrays, label_arrays, k, names, eval_metric)
+            return self._run_steps_eager(data_arrays, label_arrays, k,
+                                         eval_metric)
+        if not fusable:
             return self._run_steps_eager(data_arrays, label_arrays, k,
                                          eval_metric)
         return self._run_steps_fused(data_arrays, label_arrays, k, names,
                                      eval_metric)
 
-    def _run_steps_fused(self, data_arrays, label_arrays, k, names,
-                         eval_metric):
+    def _compile_run_steps_scan(self, names, eval_metric, use_dev_metric,
+                                donate, with_grads=False):
+        """Compiled K-step scan program over the fused step body, cached
+        per (param set, optimizer hyperparameters, donation, metric
+        device signature, grads-on-the-wire) — shared by the local
+        fused driver (:meth:`_run_steps_fused`) and the dist_async
+        chunked driver (:meth:`_run_steps_fused_dist`), which
+        additionally scans the per-step raw gradients out for the
+        kvstore wire.  Returns
+        ``(fn, upd_idx, io_idx, step_pos, const_pos)``."""
         exec_ = self._exec
-        opt = self._optimizer
         arg_names = exec_._arg_names
         upd_idx, io_idx = self._split_arg_idx(names)
         step_names = set(self._data_names) | set(self._label_names)
@@ -777,25 +817,15 @@ class Module(BaseModule):
                     if arg_names[i] in step_names]
         const_pos = [j for j, i in enumerate(io_idx)
                      if arg_names[i] not in step_names]
-
-        donate = bool(env("MXNET_FUSED_DONATE", True))
-        sig = opt.hyperparam_signature()
-        # metric accumulation rides the scan carry when the metric has a
-        # device form: K steps of metrics cost ZERO extra dispatches and
-        # ZERO readbacks — the state stays on device until a callback
-        # syncs it (the tentpole of the sync-free loop; metrics without
-        # a device form keep the old one-readback host fold below)
-        use_dev_metric = (eval_metric is not None
-                          and getattr(eval_metric, "device_enabled",
-                                      lambda: False)())
         cache = self._run_steps_cache
-        cache_key = (tuple(names), sig, donate,
+        cache_key = (tuple(names), self._optimizer.hyperparam_signature(),
+                     donate, with_grads,
                      eval_metric._device_sig() if use_dev_metric else None)
         from ..executor import scan_cache_lookup, scan_cache_store
         fn = scan_cache_lookup(cache, cache_key)
         if fn is None:
             from ..executor import build_multi_step
-            body = self._make_step_body(names)
+            body = self._make_step_body(names, with_grads=with_grads)
             metric = eval_metric if use_dev_metric else None
             out_names = self._output_names
             # label name -> stacked-input slot, in LABEL_NAMES order:
@@ -814,19 +844,39 @@ class Module(BaseModule):
                     io_vals[j] = v
                 for j, v in zip(const_pos, const):
                     io_vals[j] = v
-                outs, new_aux, new_params, new_states = body(
-                    pvals, tuple(io_vals), aux_vals, key, states,
-                    lrs, wds, t)
+                res = body(pvals, tuple(io_vals), aux_vals, key, states,
+                           lrs, wds, t)
+                outs, new_aux, new_params, new_states = res[:4]
                 if metric is not None:
                     mstate = metric.device_update_dict(
                         mstate,
                         {nm: step_io[i] for nm, i in label_slots},
                         dict(zip(out_names, outs)))
-                return (new_params, new_aux, new_states, mstate), outs
+                ys = (outs, res[4]) if with_grads else outs
+                return (new_params, new_aux, new_states, mstate), ys
 
             fn = scan_cache_store(cache, cache_key,
                                   build_multi_step(scan_body,
                                                    donate=donate))
+        return fn, upd_idx, io_idx, step_pos, const_pos
+
+    def _run_steps_fused(self, data_arrays, label_arrays, k, names,
+                         eval_metric):
+        exec_ = self._exec
+        opt = self._optimizer
+        arg_names = exec_._arg_names
+        donate = bool(env("MXNET_FUSED_DONATE", True))
+        # metric accumulation rides the scan carry when the metric has a
+        # device form: K steps of metrics cost ZERO extra dispatches and
+        # ZERO readbacks — the state stays on device until a callback
+        # syncs it (the tentpole of the sync-free loop; metrics without
+        # a device form keep the old one-readback host fold below)
+        use_dev_metric = (eval_metric is not None
+                          and getattr(eval_metric, "device_enabled",
+                                      lambda: False)())
+        fn, upd_idx, io_idx, step_pos, const_pos = \
+            self._compile_run_steps_scan(names, eval_metric,
+                                         use_dev_metric, donate)
         self._fused_upd_idx = upd_idx
         self._fused_io_idx = io_idx
         self._fused_donate = donate
@@ -910,6 +960,212 @@ class Module(BaseModule):
         elif eval_metric is not None:
             self._fold_metric(eval_metric, label_arrays, ys, k)
         return stacked
+
+    def _run_steps_fused_dist(self, data_arrays, label_arrays, k, names,
+                              eval_metric):
+        """K update-on-kvstore steps as a CHUNKED scan with the wire
+        overlapped behind compute — dispatch amortization and the
+        pipelined dist_async wire finally compose (the MXNet
+        dependency-engine thesis rebuilt on XLA async dispatch;
+        docs/PERF_NOTES.md round 10).
+
+        The scanned body is the SAME fused step as the local driver —
+        fwd+bwd plus a LOCAL optimizer update (the worker-side replica
+        of the server's updater; both run ``Optimizer._update_impl``)
+        — so the in-chunk weight trajectory stays fresh, and it
+        additionally scans out the raw per-step gradients.  Per chunk
+        of ``MXNET_KVSTORE_FUSED_CHUNK`` steps the host reads those
+        gradients back in ONE stacked device_get, pushes them per step
+        through the pipelined window (small keys coalesce per
+        envelope) and enqueues a non-blocking ``pull_async``; the
+        round resolves while the NEXT chunk computes
+        (executor.drive_chunked_dist), and its server-authoritative
+        weights replace the carry exactly
+        ``MXNET_KVSTORE_FUSED_STALENESS`` chunk boundaries later.
+        Staleness 0 degrades to a barrier'd boundary: single-worker it
+        is bit-identical to the eager dist loop (the local replica and
+        the server apply identical update sequences); multi-worker the
+        contract is the elastic handoff one — bit-identical at
+        quiescent sync points for commutative updates, async-SGD-grade
+        in between.  Optimizer state and aux (BN stats) stay
+        worker-local between sync points; the final pull is adopted as
+        the authoritative weights (fp32 masters included for
+        multi-precision params), exactly like the eager loop's last
+        pull.  Composing this driver with MXNET_KVSTORE_ELASTIC roster
+        repair is roadmap work — elastic jobs are routed to the eager
+        loop instead (transport kills still recover here through the
+        window replay underneath; a HARD failure mid-drive writes the
+        carry's last chunk-output state back so the module stays
+        readable, then raises)."""
+        exec_ = self._exec
+        opt = self._optimizer
+        kv = self._kvstore
+        arg_names = exec_._arg_names
+        donate = bool(env("MXNET_FUSED_DONATE", True))
+        use_dev_metric = (eval_metric is not None
+                          and getattr(eval_metric, "device_enabled",
+                                      lambda: False)())
+        fn, upd_idx, io_idx, step_pos, const_pos = \
+            self._compile_run_steps_scan(names, eval_metric,
+                                         use_dev_metric, donate,
+                                         with_grads=True)
+        self._fused_upd_idx = upd_idx
+        self._fused_io_idx = io_idx
+        self._fused_donate = donate
+
+        from ..executor import (drive_chunked_dist, fused_dist_knobs,
+                                precompute_step_schedules,
+                                schedule_rollback)
+        chunk, staleness = fused_dist_knobs(k)
+        shapes = {n: tuple(exec_.arg_dict[n].shape) for n in names}
+        # multi-precision params update on the fp32 master in states[0]
+        # (apply_fused recasts the weight from it), so adopting pulled
+        # server weights must ALSO overwrite the master — replacing only
+        # pvals would be recomputed away on the very next step
+        use_mp = [opt.mp_states_active(exec_.arg_dict[n],
+                                       self._opt_states[n])
+                  for n in names]
+        from .. import profiler as _prof
+        with schedule_rollback(opt):
+            # worker-side schedules advance per step exactly as the
+            # server's per-push counts do (single worker: identical lr
+            # sequence; multi-worker the server counts all ranks'
+            # pushes — the same server-authoritative behavior the
+            # eager dist loop has)
+            lrs, wds, tcols = precompute_step_schedules(opt, names, k)
+            ts = tcols[0]
+            run = exec_._run
+            if getattr(run, "needs_rng", False):
+                keys = jnp.stack([_rnd.next_key() for _ in range(k)])
+            else:
+                keys = jnp.stack([_rnd.key_for(run)] * k)
+            arg_vals = exec_._arg_vals()
+            aux_vals = exec_._aux_vals()
+            const = tuple(arg_vals[io_idx[j]] for j in const_pos)
+            step_io = tuple(self._stacked_input(arg_names[io_idx[j]],
+                                                data_arrays, label_arrays)
+                            for j in step_pos)
+            init_m = eval_metric._take_device_state() \
+                if use_dev_metric else ()
+            carry = {
+                "pvals": tuple(arg_vals[i] for i in upd_idx),
+                "aux": aux_vals,
+                "states": tuple(
+                    tuple(s._data for s in self._opt_states[n])
+                    for n in names),
+                "m": init_m,
+                "outs": [],
+            }
+
+            def adopt(adopted):
+                # chunk-boundary re-sync: the carry WEIGHTS adopt the
+                # pulled server values (authoritative — they include
+                # every worker's pushes through the due chunk); for a
+                # multi-precision param the fp32 MASTER in states[0]
+                # adopts too (the update runs on it and recasts the
+                # weight, so it is the real carrier).  The rest of the
+                # optimizer state and aux stay local — the
+                # async-SGD-grade part of the contract.
+                pvals, states = [], list(carry["states"])
+                for i, n in enumerate(names):
+                    w = jnp.asarray(adopted[n])
+                    if use_mp[i]:
+                        master = w.astype(jnp.float32)
+                        states[i] = (master,) + tuple(states[i][1:])
+                        w = master.astype(exec_.arg_dict[n].dtype)
+                    else:
+                        w = w.astype(exec_.arg_dict[n].dtype)
+                    pvals.append(w)
+                carry["pvals"] = tuple(pvals)
+                carry["states"] = tuple(states)
+
+            def dispatch_chunk(j, lo, hi, adopted):
+                if adopted is not None:
+                    adopt(adopted)
+                xs = (tuple(a[lo:hi] for a in step_io), keys[lo:hi],
+                      tuple(v[lo:hi] for v in lrs),
+                      tuple(v[lo:hi] for v in wds), ts[lo:hi])
+                _prof.record_dispatch("run_steps.dist_chunk")
+                with _prof.scope("run_steps_dist_chunk", "symbolic"):
+                    (new_p, new_aux, new_st, new_m), (outs, grads) = fn(
+                        (carry["pvals"], carry["aux"], carry["states"],
+                         carry["m"]), xs, const)
+                carry.update(pvals=new_p, aux=new_aux, states=new_st,
+                             m=new_m)
+                carry["outs"].append(outs)
+                # ONE stacked readback of the chunk's per-step raw
+                # gradients — the wire needs host bytes; this blocks on
+                # the chunk's COMPUTE only (the wire round itself is
+                # what the driver overlaps behind the next chunk)
+                grads_np = jax.device_get(grads)
+                _prof.record_host_sync("run_steps.dist_grad_readback")
+                return grads_np
+
+            def ship_chunk(j, grads_np):
+                return kv.ship_chunk_steps(names, grads_np,
+                                           [shapes[n] for n in names])
+
+            try:
+                final = drive_chunked_dist(k, chunk, staleness,
+                                           dispatch_chunk, ship_chunk)
+            except BaseException:
+                # a wire failure mid-drive lands AFTER earlier chunks
+                # donated the original param/aux/state buffers — but the
+                # carry holds the latest chunk's OUTPUT arrays (alive):
+                # write them back so the module stays readable at the
+                # last locally-completed step, and poison the stale lazy
+                # handles exactly like the success path does
+                self._writeback_dist_carry(names, carry)
+                if donate:
+                    self._poison_after_donate()
+                raise
+
+        self._params_dirty = True
+        # the FINAL pull is the sync point: the local params adopt the
+        # server-authoritative weights, exactly how the eager dist
+        # loop's last per-step pull leaves them (fp32 masters included)
+        adopt(final)
+        self._writeback_dist_carry(names, carry)
+        if donate:
+            self._poison_after_donate()
+        self._pending_backward = False
+
+        ys = [jnp.concatenate([c[i] for c in carry["outs"]])
+              if len(carry["outs"]) > 1 else carry["outs"][0][i]
+              for i in range(len(self._output_names))]
+
+        from ..executor import make_lazy_outputs
+
+        def last_thunk(outs):
+            def thunk():
+                for oa, y in zip(outs, ys):
+                    oa._set_data(y[-1])
+            return thunk
+
+        exec_._out_arrays = make_lazy_outputs(
+            exec_._out_aval_list(True), last_thunk)
+
+        stacked = [NDArray(y) for y in ys]
+        if use_dev_metric:
+            eval_metric._absorb_device_state(carry["m"])
+        elif eval_metric is not None:
+            self._fold_metric(eval_metric, label_arrays, ys, k)
+        return stacked
+
+    def _writeback_dist_carry(self, names, carry):
+        """Write the dist driver's carry (latest chunk-output params,
+        aux, optimizer states) back into the executor — the shared tail
+        of the success path (after adopting the final pull) and the
+        mid-drive failure path (where the carry is the last consistent
+        local state the donated originals can be replaced with)."""
+        exec_ = self._exec
+        for n, w in zip(names, carry["pvals"]):
+            exec_.arg_dict[n]._set_data(w)
+        for a, v in zip(exec_.aux_arrays, carry["aux"]):
+            a._set_data(v)
+        for n, st in zip(names, carry["states"]):
+            for s_arr, v in zip(self._opt_states[n], st):
+                s_arr._set_data(v)
 
     def _stacked_input(self, name, data_arrays, label_arrays):
         """Device value for one stacked (k, batch, ...) input, with the

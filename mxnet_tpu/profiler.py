@@ -309,6 +309,71 @@ def reset_channel_bytes():
         _channel_bytes.clear()
 
 
+# -- kvstore wire-overlap counters -------------------------------------------
+# The fused-dist K-step driver overlaps the push/pull wire round of chunk
+# j-1 behind chunk j's scanned compute (docs/PERF_NOTES.md round 10).
+# Two clocks make the overlap CPU-testable the way host_syncs made the
+# sync-free loop testable:
+#   * wire_wait  — host time actually BLOCKED on a pull future (the
+#     exposed, un-overlapped part of the wire),
+#   * wire_round — full enqueue->resolved time of the same rounds (what
+#     the wire costs with no overlap at all).
+# overlap_pct = 100*(1 - wait/round) is the regression gate: staleness 0
+# (barrier'd chunk boundary) pins it near 0, staleness >= 1 must keep it
+# strictly positive whenever compute overlaps any of the round trip —
+# ci/run_ci.sh asserts wire_wait_ms strictly below the unoverlapped
+# baseline on CPU.
+_wire_lock = threading.Lock()
+_wire = {"wait_s": 0.0, "round_s": 0.0, "rounds": 0}
+
+
+def record_wire_wait(dur_s: float):
+    """Add host-blocked seconds spent waiting on an in-flight kvstore
+    pull (the exposed wire)."""
+    with _wire_lock:
+        _wire["wait_s"] += float(dur_s)
+
+
+def record_wire_round(dur_s: float):
+    """Add one completed wire round's full enqueue->resolved seconds."""
+    with _wire_lock:
+        _wire["round_s"] += float(dur_s)
+        _wire["rounds"] += 1
+
+
+def wire_wait_ms() -> float:
+    with _wire_lock:
+        return _wire["wait_s"] * 1e3
+
+
+def wire_round_ms() -> float:
+    with _wire_lock:
+        return _wire["round_s"] * 1e3
+
+
+def wire_rounds() -> int:
+    with _wire_lock:
+        return _wire["rounds"]
+
+
+def wire_overlap_pct() -> float:
+    """Fraction of the wire hidden behind compute, as a percentage:
+    100*(1 - wait/round) over every recorded round, 0.0 before the
+    first round (and never negative — scheduling jitter can make a
+    single wait marginally exceed its round)."""
+    with _wire_lock:
+        if _wire["rounds"] == 0 or _wire["round_s"] <= 0.0:
+            return 0.0
+        return max(0.0, 100.0 * (1.0 - _wire["wait_s"] / _wire["round_s"]))
+
+
+def reset_wire_counters():
+    with _wire_lock:
+        _wire["wait_s"] = 0.0
+        _wire["round_s"] = 0.0
+        _wire["rounds"] = 0
+
+
 # -- serving latency / QPS counters ------------------------------------------
 # Request-latency distributions for the serving tier (mxnet_tpu.serving):
 # per KIND (e.g. "serving.request", "serving.batch") a bounded ring of

@@ -1,0 +1,169 @@
+"""Fused-dist run_steps smoke: the K-step scanned driver on the REAL
+dist_async wire, across process/socket boundaries under the launcher.
+
+Run via:  python tools/launch.py -n 2 -s 1 \
+              --env MXNET_FI_DELAY_ACK_MS=10 \
+              python tests/dist/dist_fused_runsteps.py
+
+Two workers train three sibling linear models against one parameter
+server: once through the EAGER per-step push/pull loop, once through
+the chunked fused driver with staleness 0 (barrier'd boundaries — the
+unoverlapped baseline), once with staleness 1 (the wire hidden behind
+the next chunk's compute).  Gradients are CONSTANT in the weights
+(MakeLoss over a linear head: dW rows = the batch's column sums —
+integers), so with a power-of-two lr every update is exact in fp32 and
+order-independent across the async workers: all three runs must land
+BIT-IDENTICAL on the same analytic golden after the final barrier —
+the convergence-equivalence half of the gate.
+
+The overlap half: the launcher arms a deterministic server-side ack
+delay (MXNET_FI_DELAY_ACK_MS) so the wire round dominates scheduler
+noise, and each worker asserts profiler.wire_wait_ms for the
+staleness-1 run STRICTLY below the staleness-0 baseline (and its
+overlap_pct strictly above) — a regression that stops overlapping the
+wire re-exposes the full round and fails the inequality.  The
+in-process twins (bit-exact staleness goldens, dispatch pins, kill
+replay) live in tests/test_fused_dist.py.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_INITIAL_MS", "20")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX_MS", "200")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+
+K = 16
+CHUNK = 2
+# sized so one chunk's scanned compute (~2 x 200 MFLOP) exceeds one
+# wire round under the launcher-armed ack delay: staleness 1 then has
+# real compute to hide the round behind, and the wait_s1 < wait_s0
+# margin is structural (~a full round per chunk), not scheduler noise.
+# The model stays LINEAR so gradients are constant in the weights —
+# dW[h, :] = sum_b X[b, :], integers — which is what makes the golden
+# exact and order-independent across the async workers.
+BATCH = 256
+NIN = 512
+NH = 256
+LR = 0.125              # power of two: every update exact in fp32
+NWORKER = int(os.environ.get("DMLC_NUM_WORKER", "2"))
+
+
+def rank_data(rank):
+    """Integer batches, deterministic per rank — every process can
+    recompute every rank's gradient stream locally for the golden."""
+    rs = np.random.RandomState(100 + rank)
+    return rs.randint(-1, 2, (K, BATCH, NIN)).astype(np.float32)
+
+
+def init_weight():
+    rs = np.random.RandomState(0)
+    return rs.randint(-2, 3, (NH, NIN)).astype(np.float32)
+
+
+def golden():
+    """W0 - lr * sum of every rank's every-step gradient.  MakeLoss
+    seeds the head with grad_scale=1, so dW[h, :] = sum_b X[b, :] —
+    constant in W, integer, order-independent: the async interleaving
+    cannot change the exact final value."""
+    w = init_weight().copy()
+    for r in range(NWORKER):
+        data = rank_data(r)
+        for s in range(K):
+            g = np.tile(data[s].sum(axis=0), (NH, 1)).astype(np.float32)
+            w = w - np.float32(LR) * g
+    return w
+
+
+def make_module(tag):
+    data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(data, num_hidden=NH, no_bias=True,
+                                name=f'fc_{tag}')
+    sym = mx.sym.MakeLoss(net, name=f'loss_{tag}')
+    mod = mx.mod.Module(sym, data_names=('data',), label_names=None)
+    mod.bind(data_shapes=[('data', (BATCH, NIN))])
+    mod.init_params(
+        arg_params={f'fc_{tag}_weight': mx.nd.array(init_weight())})
+    mod.init_optimizer(
+        kvstore='dist_async', optimizer='sgd',
+        optimizer_params={'learning_rate': LR, 'momentum': 0.0,
+                          'wd': 0.0, 'rescale_grad': 1.0})
+    return mod
+
+
+def main():
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    data = rank_data(rank)
+    os.environ["MXNET_KVSTORE_FUSED_CHUNK"] = str(CHUNK)
+
+    # all three modules (and their set_optimizer barriers) up front so
+    # the phases below stay in lockstep across workers
+    mod_e = make_module("e")
+    mod_s0 = make_module("s0")
+    mod_s1 = make_module("s1")
+    kv = mod_e._kvstore
+
+    # -- phase 1: the eager per-step dist loop (the equivalence ref) --
+    os.environ["MXNET_KVSTORE_FUSED"] = "0"
+    mod_e.run_steps(data, k=K)
+    kv.barrier()
+
+    # -- phase 2: fused, staleness 0 — the unoverlapped baseline ------
+    os.environ["MXNET_KVSTORE_FUSED"] = "1"
+    os.environ["MXNET_KVSTORE_FUSED_STALENESS"] = "0"
+    profiler.reset_wire_counters()
+    profiler.reset_dispatch_counts()
+    mod_s0.run_steps(data, k=K)
+    wait_s0 = profiler.wire_wait_ms()
+    overlap_s0 = profiler.wire_overlap_pct()
+    n_chunks = profiler.dispatch_counts().get("run_steps.dist_chunk", 0)
+    assert n_chunks == K // CHUNK, \
+        f"expected {K // CHUNK} chunk dispatches, got {n_chunks}"
+    kv.barrier()
+
+    # -- phase 3: fused, staleness 1 — the wire behind the compute ----
+    os.environ["MXNET_KVSTORE_FUSED_STALENESS"] = "1"
+    profiler.reset_wire_counters()
+    mod_s1.run_steps(data, k=K)
+    wait_s1 = profiler.wire_wait_ms()
+    overlap_s1 = profiler.wire_overlap_pct()
+    kv.barrier()   # every rank's pushes applied before the final read
+
+    # -- convergence equivalence: all three == the analytic golden ----
+    want = golden()
+    for tag in ("e", "s0", "s1"):
+        out = mx.nd.zeros((NH, NIN))
+        kv.pull(f'fc_{tag}_weight', out=out)
+        np.testing.assert_array_equal(
+            out.asnumpy(), want,
+            err_msg=f"run {tag!r} diverged from the eager-loop golden")
+
+    # -- overlap: staleness 1 must hide wire the baseline exposes -----
+    assert wait_s1 < wait_s0, \
+        (f"staleness-1 wire wait {wait_s1:.1f}ms not below the "
+         f"unoverlapped staleness-0 baseline {wait_s0:.1f}ms")
+    assert overlap_s1 > overlap_s0, \
+        (f"staleness-1 overlap {overlap_s1:.1f}% not above the "
+         f"staleness-0 baseline {overlap_s0:.1f}%")
+
+    kv.barrier()
+    for m in (mod_s1, mod_s0, mod_e):
+        m._kvstore.close()
+    print("dist_fused_runsteps rank %d/%d OK (golden exact; wire wait "
+          "%.1fms -> %.1fms, overlap %.1f%% -> %.1f%%)"
+          % (rank, NWORKER, wait_s0, wait_s1, overlap_s0, overlap_s1),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

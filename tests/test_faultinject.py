@@ -125,8 +125,10 @@ def _symbol():
 def _train_through_kvstore(monkeypatch, kill=None, window=None,
                            kill_unacked=None, delay_ack=0.0):
     """One full dist_async training run (Module + server-side SGD, the
-    update-on-kvstore mode, driven through run_steps' eager-fallback
-    path) against a FRESH server; returns (final params, dedup count).
+    update-on-kvstore mode, driven through run_steps' FUSED chunked
+    driver — K=6 fits one default chunk, so the wire stream is 6
+    coalesced per-step pushes then one pull per param) against a FRESH
+    server; returns (final params, dedup count).
 
     ``window``/``kill_unacked``/``delay_ack`` arm the PIPELINED-channel
     variant: MXNET_KVSTORE_WINDOW=window, server acks slowed so the
@@ -194,14 +196,22 @@ def test_kill_mid_run_steps_recovers_bit_identical(monkeypatch):
     counter says exactly how each replay was resolved)."""
     baseline, dedup0 = _train_through_kvstore(monkeypatch)
     assert dedup0 == 0
-    # (message index, point): ~12 wire messages per training step, so 10
-    # lands inside step 1 and 17 inside step 2 of the K-step window —
-    # both mid-run_steps.  before_send = request never delivered (replay
-    # IS first delivery, dedup 0); after_send = request applied but the
-    # ack lost (replay must dedup, exactly once).
-    for kill, want_dedup in (((10, "before_send"), 0),
-                             ((17, "after_send"), 1)):
-        got, dedup = _train_through_kvstore(monkeypatch, kill=kill)
+    # (message index, point): run_steps now drives the FUSED dist
+    # driver — K=6 steps in one chunk is 6 coalesced push_multi
+    # envelopes (messages 1-6) then 4 pull envelopes (7-10, one per
+    # param) — so 4 lands mid-push-stream and 8 mid-pull-stream, both
+    # inside the one run_steps call.  before_send = request never
+    # delivered (replay IS first delivery, dedup 0); after_send =
+    # request applied but the ack lost (replay must dedup, exactly
+    # once).  The kill runs pin the window at 1 (stop-and-wait,
+    # bit-identical by the transport contract) so EXACTLY the killed
+    # envelope is in flight and the dedup count is deterministic; the
+    # deep-window replay variants live in
+    # test_window_full_replay_mid_run_steps_bit_identical.
+    for kill, want_dedup in (((4, "before_send"), 0),
+                             ((8, "after_send"), 1)):
+        got, dedup = _train_through_kvstore(monkeypatch, kill=kill,
+                                            window=1)
         assert set(got) == set(baseline)
         for name in baseline:
             np.testing.assert_array_equal(
@@ -400,13 +410,19 @@ def test_window_full_replay_mid_run_steps_bit_identical(monkeypatch):
 
 def test_window_deep_pipeline_gluon_bit_identical(monkeypatch):
     """Deep window (8) under the gluon Trainer, whose step pushes every
-    param fire-and-forget before one batched pull — 6+ envelopes in
-    flight.  A kill at depth 5 replays the window; two training steps
-    end bit-identical to the uninterrupted twin."""
+    param fire-and-forget before one batched pull — 4 envelopes in
+    flight.  A kill at depth 4 replays the window; two training steps
+    end bit-identical to the uninterrupted twin.  Coalescing is
+    disabled explicitly: the trainer's list-form push would otherwise
+    fold both params into ONE push_multi envelope (pinned in
+    test_kvstore.py) and the window could never reach the armed depth
+    — this test is about the DEEP pipeline, so it keeps one envelope
+    per param."""
     import mxnet_tpu.gluon as gluon
     from mxnet_tpu import autograd
 
     x = mx.nd.array(np.array([[1., 2., 3.], [4., 5., 6.]], np.float32))
+    monkeypatch.setenv("MXNET_KVSTORE_COALESCE_BYTES", "0")
 
     def run(fault):
         srv = _serve(monkeypatch)

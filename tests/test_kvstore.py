@@ -861,6 +861,71 @@ def test_dist_async_coalesced_multi_key_push(monkeypatch):
         srv.stop()
 
 
+def test_pull_async_matches_pull_and_counts_one_round(monkeypatch):
+    """pull_async enqueues now and resolves later: the handle returns
+    the same host values a blocking pull writes, records exactly ONE
+    wire round, and a second wait() is an idempotent cache hit (no
+    double-counted round)."""
+    from mxnet_tpu import profiler as prof
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('pa1', mx.nd.ones((2, 2)) * 3)
+        kv.init('pa2', mx.nd.ones((3,)) * 5)
+        prof.reset_wire_counters()
+        h = kv.pull_async(['pa1', 'pa2'], [(2, 2), (3,)])
+        vals = h.wait()
+        np.testing.assert_array_equal(vals['pa1'], np.full((2, 2), 3.0))
+        np.testing.assert_array_equal(vals['pa2'], np.full((3,), 5.0))
+        assert prof.wire_rounds() == 1
+        assert prof.wire_round_ms() >= prof.wire_wait_ms() >= 0.0
+        assert h.wait() is vals
+        assert prof.wire_rounds() == 1
+        # FIFO: a pull_async enqueued after a push observes that push
+        kv.push('pa2', mx.nd.ones((3,)) * 4)   # no updater: assign
+        vals2 = kv.pull_async('pa2', (3,)).wait()
+        np.testing.assert_array_equal(vals2['pa2'], np.full((3,), 4.0))
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_gluon_trainer_step_coalesces_small_pushes(monkeypatch):
+    """_step_on_kvstore ships its gradients as ONE list push, so the
+    small params coalesce into a single push_multi envelope per server
+    (MXNET_KVSTORE_COALESCE_BYTES) instead of one frame+ack per param —
+    the per-param loop used to bypass the coalescing path entirely.
+    Pinned by envelope count: one steady-state step() over 4 small
+    params = 1 coalesced push + 4 pulls = 5 envelopes (was 8)."""
+    import mxnet_tpu.gluon as gluon
+    from mxnet_tpu import autograd
+    srv = _serve_one(monkeypatch)
+    try:
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(2, in_units=3))     # weight + bias
+        net.add(gluon.nn.Dense(1, in_units=2))     # weight + bias
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1, 'momentum': 0.0,
+                            'wd': 0.0}, kvstore='dist_async')
+        x = mx.nd.ones((2, 3))
+
+        def one_step():
+            with autograd.record():
+                loss = (net(x) * net(x)).sum()
+            loss.backward()
+            tr.step(batch_size=2)
+
+        one_step()   # first step ships the optimizer — measure the next
+        conn = tr._kvstore._conns[0]
+        seq_before = conn._next_seq
+        one_step()
+        assert conn._next_seq - seq_before == 5
+        tr._kvstore.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
 def test_app_error_poison_still_delivers_queued_pushes(monkeypatch):
     """An application error on a fire-and-forget push poisons the
     channel for NEW requests, but requests already queued behind it
