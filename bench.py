@@ -36,53 +36,84 @@ import numpy as np
 BASELINE_IMGS_PER_SEC = 109.0   # ResNet-50, 1x K80, batch 32
 
 
-def _measured_defaults():
-    """Config defaults promoted from the best MEASURED sweep result
-    (BENCH_DEFAULTS.json, written by tools/chip_session.sh after its MFU
-    sweep).  Env vars still override.  This closes the loop when the
-    operator isn't around: any successful sweep upgrades the next
-    driver-run bench to the winning config automatically."""
+def _promote_mod():
+    """mxnet_tpu.autotune.promote loaded BY PATH — the module is
+    stdlib-only on purpose, because bench must not import the
+    mxnet_tpu package (and thus jax) before the guarded backend init."""
+    import importlib.util
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_DEFAULTS.json")
-    try:
-        with open(path) as f:
-            d = json.load(f)
-        return d if isinstance(d, dict) else {}
-    except Exception:  # noqa: BLE001 — absent/corrupt file = no defaults
-        return {}
+                        "mxnet_tpu", "autotune", "promote.py")
+    spec = importlib.util.spec_from_file_location("_bench_promote", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-_DEF = _measured_defaults()
-BATCH = int(os.environ.get("BENCH_BATCH", _DEF.get("batch", 256)))
-DTYPE = os.environ.get("BENCH_DTYPE", _DEF.get("dtype", "bfloat16"))
-OPT = os.environ.get("BENCH_OPT", _DEF.get("opt", "sgd"))
+def _defaults_path():
+    return os.environ.get("BENCH_DEFAULTS_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DEFAULTS.json")
+
+
+def _topology_key(device_kind, hosts=1):
+    """THE topology this run measures: device kind x host count x
+    worker/server count (promoted defaults are keyed by it, so a
+    b256-TPU winner can never leak into a CPU or MULTICHIP run)."""
+    return _promote_mod().topology_key(
+        device_kind, hosts=hosts,
+        workers=int(os.environ.get("DMLC_NUM_WORKER", "1") or 1),
+        servers=int(os.environ.get("DMLC_NUM_SERVER", "0") or 0))
+
+
+def _resolve_config(device_kind, hosts=1):
+    """Resolution order per knob: env var > the PER-TOPOLOGY promoted
+    entry in BENCH_DEFAULTS.json (autotune/chip_session winners; legacy
+    flat files apply only to the topology their provenance names) >
+    built-in defaults.  Resolved only AFTER backend init because the
+    topology is unknowable before the device kind is.  Promoted ``env``
+    knobs (e.g. a measured-best MXNET_KVSTORE_WINDOW) are setdefault-ed
+    into the environment — an explicit env var always wins."""
+    prom = _promote_mod()
+    topo = _topology_key(device_kind, hosts)
+    entry = prom.lookup_defaults(_defaults_path(), topo)
+    applied_env = prom.apply_env_defaults(entry)
+    cfg = {
+        "topology": topo,
+        "applied_env": applied_env,
+        "batch": int(os.environ.get("BENCH_BATCH",
+                                    entry.get("batch", 256))),
+        "dtype": os.environ.get("BENCH_DTYPE",
+                                entry.get("dtype", "bfloat16")),
+        "opt": os.environ.get("BENCH_OPT", entry.get("opt", "sgd")),
+        # Steps fused into ONE dispatch via Module.run_steps (lax.scan
+        # over the fused step).  K>1 amortizes the ~12 ms/step host
+        # dispatch through the tunnel (docs/PERF_NOTES.md) to 1/K per
+        # step — 1 = classic per-step dispatch.
+        "steps_per_call": int(os.environ.get(
+            "BENCH_STEPS_PER_CALL", entry.get("steps_per_call", 1))),
+        # TPU-native stem variant (space-to-depth, mathematically
+        # equivalent — models/resnet.py space_to_depth_stem_weight)
+        "stem": os.environ.get("BENCH_STEM", entry.get("stem", "conv7")),
+        # activation layout: nchw (MXNet default) or nhwc (channels-
+        # last, the MLPerf-TPU ResNet convention; weights stay OIHW)
+        "layout": os.environ.get(
+            "BENCH_LAYOUT", str(entry.get("layout", "nchw"))).upper(),
+        # BENCH_REMAT: 0 (off), 1/full (whole-step recompute),
+        # save_matmuls (keep conv/FC outputs)
+        "remat": os.environ.get("BENCH_REMAT",
+                                str(entry.get("remat", "0"))),
+    }
+    if cfg["remat"] not in ("0", "", "False", "false"):
+        # must be set before the Module traces the step
+        # (executor.maybe_mirror); "False" guards the promoted path:
+        # sweep records log remat=False for the off case
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+        if cfg["remat"] not in ("1", "full", "True", "true"):
+            os.environ["MXNET_REMAT_POLICY"] = cfg["remat"]
+    return cfg
+
+
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
-# Steps fused into ONE dispatch via Module.run_steps (lax.scan over the
-# fused step).  K>1 amortizes the ~12 ms/step host dispatch through the
-# tunnel (docs/PERF_NOTES.md) to 1/K per step — the lever that makes the
-# multi-step driver's win measurable on a chip.  1 = classic per-step
-# dispatch (forward+update per batch).
-STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL",
-                                    _DEF.get("steps_per_call", 1)))
-# TPU-native stem variant (space-to-depth, mathematically equivalent —
-# models/resnet.py space_to_depth_stem_weight) and rematerialization.
-# BENCH_REMAT: 0 (off), 1/full (whole-step recompute), save_matmuls
-# (keep conv/FC outputs, recompute elementwise chains only)
-STEM = os.environ.get("BENCH_STEM", _DEF.get("stem", "conv7"))
-# activation layout: nchw (MXNet default) or nhwc (channels-last, the
-# MLPerf-TPU ResNet convention; weights stay OIHW either way —
-# models/resnet.py layout kwarg, equality-tested in tests/test_models.py)
-LAYOUT = os.environ.get("BENCH_LAYOUT",
-                        str(_DEF.get("layout", "nchw"))).upper()
-_REMAT = os.environ.get("BENCH_REMAT", str(_DEF.get("remat", "0")))
-if _REMAT not in ("0", "", "False", "false"):
-    # must be set before the Module traces the step (executor.maybe_mirror)
-    # ("False" guards the promoted-defaults path: sweep records log
-    # remat=False for the off case)
-    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
-    if _REMAT not in ("1", "full", "True", "true"):
-        os.environ["MXNET_REMAT_POLICY"] = _REMAT
 
 def _make_record_iter(batch):
     """Raw-uint8 record dataset for real-data mode (built once, cached).
@@ -144,18 +175,25 @@ _ERR_BASE = {"metric": "resnet50_train_imgs_per_sec", "value": None,
 from benchmark._bench_common import with_last_good as _with_last_good  # noqa: E402,E501
 
 
+# the batch _run actually resolved (the OOM-halving loop needs it when
+# the first attempt resolved its batch from the per-topology defaults)
+_LAST_BATCH = [0]
+
+
 def main():
-    batch = BATCH
+    batch = None     # None = resolve from env / per-topology defaults
     while True:
         try:
             return _run(batch)
         except Exception as e:  # noqa: BLE001
             if "RESOURCE_EXHAUSTED" in str(e):
-                if batch > 32:
+                used = batch or _LAST_BATCH[0] or 256
+                if used > 32:
                     _mark("OOM at batch %d — retrying at %d"
-                          % (batch, batch // 2))
-                    batch //= 2
+                          % (used, used // 2))
+                    batch = used // 2
                     continue
+                batch = used
                 print(json.dumps(dict(
                     _with_last_good(_ERR_BASE),
                     error="OOM even at batch %d: %s" % (batch,
@@ -190,13 +228,25 @@ def _run(batch):
     start_stall_watchdog(_mark, _with_last_good(_ERR_BASE))
     import jax  # deliberately AFTER the guard: refusals never load PJRT
     import jax.numpy as jnp
+    # topology known only now (device kind + process count): resolve the
+    # promoted per-topology defaults BEFORE the framework import so any
+    # promoted env knobs are in place for every later read
+    cfg = _resolve_config(dev.device_kind, hosts=jax.process_count())
+    if cfg["applied_env"]:
+        _mark("promoted env defaults for %s: %s"
+              % (cfg["topology"], cfg["applied_env"]))
+    if batch is None:
+        batch = cfg["batch"]
+    _LAST_BATCH[0] = batch
+    steps_per_call = cfg["steps_per_call"]
     import mxnet_tpu as mx
     from mxnet_tpu import models
 
     sym = models.resnet(num_classes=1000, num_layers=50,
-                        image_shape=(3, 224, 224), stem=STEM,
-                        layout=LAYOUT)
-    compute_dtype = None if DTYPE in ("float32", "fp32") else jnp.dtype(DTYPE)
+                        image_shape=(3, 224, 224), stem=cfg["stem"],
+                        layout=cfg["layout"])
+    compute_dtype = None if cfg["dtype"] in ("float32", "fp32") \
+        else jnp.dtype(cfg["dtype"])
     mod = mx.mod.Module(sym, context=mx.tpu(0),
                         compute_dtype=compute_dtype)
 
@@ -208,7 +258,7 @@ def _run(batch):
     mod.init_params(mx.initializer.Xavier(rnd_type="gaussian", magnitude=2.0))
     # BENCH_OPT=lars exercises the large-batch trust-ratio recipe (same
     # lr/momentum/wd knobs; LARS adds per-layer rate adaptation)
-    mod.init_optimizer(optimizer=OPT,
+    mod.init_optimizer(optimizer=cfg["opt"],
                        optimizer_params={"learning_rate": 0.1,
                                          "momentum": 0.9, "wd": 1e-4})
     _mark("module bound + params initialized")
@@ -217,7 +267,7 @@ def _run(batch):
     # (a 256x3x224x224 fp32 batch is 154 MB; pushing it through a
     # remote-attached chip's tunnel would measure the tunnel, not the chip)
     batches = []
-    super_batches = []   # (k, batch, ...) stacks for STEPS_PER_CALL > 1
+    super_batches = []   # (k, batch, ...) stacks for steps_per_call > 1
     if os.environ.get("BENCH_DATA", "synthetic") != "record":
         for seed in (0, 1):
             k = jax.random.PRNGKey(seed)
@@ -229,15 +279,15 @@ def _run(batch):
             bx.wait_to_read()
             by.wait_to_read()
             batches.append(mx.io.DataBatch(data=[bx], label=[by]))
-        if STEPS_PER_CALL > 1:
+        if steps_per_call > 1:
             # K distinct per-step batches stacked on device (tiling the
             # two base batches — rotation inside the scan, like the
             # K=1 loop rotates across calls)
             for s in (0, 1):
                 bx = jnp.stack([batches[(s + j) % 2].data[0]._data
-                                for j in range(STEPS_PER_CALL)])
+                                for j in range(steps_per_call)])
                 by = jnp.stack([batches[(s + j) % 2].label[0]._data
-                                for j in range(STEPS_PER_CALL)])
+                                for j in range(steps_per_call)])
                 bx.block_until_ready()
                 super_batches.append((bx, by))
 
@@ -272,18 +322,18 @@ def _run(batch):
 
         nhwc_feed = real_iter.provide_data[0].shape[-1] == 3
 
-        if STEPS_PER_CALL > 1:
+        if steps_per_call > 1:
             def step(i):
                 # K host batches -> ONE stacked uint8 transfer -> device
                 # layout/cast -> ONE scanned dispatch for all K steps
                 datas, labels = zip(*[feed_q.get()
-                                      for _ in range(STEPS_PER_CALL)])
+                                      for _ in range(steps_per_call)])
                 dx = jnp.asarray(np.stack(datas))    # uint8, one transfer
                 if nhwc_feed:                        # (k,n,H,W,C)->(k,n,C,H,W)
                     dx = jnp.transpose(dx, (0, 1, 4, 2, 3))
                 mod.run_steps(dx.astype(jnp.float32),
                               jnp.asarray(np.stack(labels)),
-                              k=STEPS_PER_CALL)
+                              k=steps_per_call)
         else:
             def step(i):
                 data, label = feed_q.get()
@@ -295,10 +345,10 @@ def _run(batch):
                 mod.forward(mx.io.DataBatch(data=[bx], label=[by]),
                             is_train=True)
                 mod.update()
-    elif STEPS_PER_CALL > 1:
+    elif steps_per_call > 1:
         def step(i):
             bx, by = super_batches[i % len(super_batches)]
-            mod.run_steps(bx, by, k=STEPS_PER_CALL)
+            mod.run_steps(bx, by, k=steps_per_call)
     else:
         def step(i):
             b = batches[i % len(batches)]
@@ -394,9 +444,9 @@ def _run(batch):
     overlap_pct = (max(0.0, 100.0 * (1.0 - wire_wait_d / wire_round_d))
                    if wire_round_d > 0 else 0.0)
 
-    # one step() call runs STEPS_PER_CALL training steps; report per
+    # one step() call runs steps_per_call training steps; report per
     # TRAINING step so K=1 and K=8 rows compare directly
-    step_s = dt / iters / STEPS_PER_CALL
+    step_s = dt / iters / steps_per_call
     imgs_per_sec = batch / step_s
     peak = _peak_flops(dev.device_kind)
     mfu = (flops_per_step / step_s / peak) if peak else None
@@ -408,31 +458,31 @@ def _run(batch):
         "step_ms": round(step_s * 1e3, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "batch": batch,
-        "dtype": str(DTYPE),
+        "dtype": str(cfg["dtype"]),
         "device": dev.device_kind,
         "flops_per_step": flops_per_step,
         "flops_source": flops_source,
         "peak_flops": peak,
-        "stem": STEM,
-        "layout": LAYOUT.lower(),
-        "opt": OPT,
+        "stem": cfg["stem"],
+        "layout": cfg["layout"].lower(),
+        "opt": cfg["opt"],
         "iters": iters,
-        "steps_per_call": STEPS_PER_CALL,
+        "steps_per_call": steps_per_call,
         "wire_bytes_per_step": round(
-            wire_bytes / iters / STEPS_PER_CALL, 1),
+            wire_bytes / iters / steps_per_call, 1),
         # host-blocking readbacks per TRAINING step (profiler.host_syncs)
         # — 0.0 in the steady state: the sync-free loop's one number.
         # Nonzero means something in the step path re-grew a per-step
         # device->host sync (docs/PERF_NOTES.md round 8).
         "host_syncs_per_step": round(
-            host_syncs / iters / STEPS_PER_CALL, 3),
+            host_syncs / iters / steps_per_call, 3),
         # exposed (host-blocked) kvstore wire per TRAINING step and the
         # fraction of the wire hidden behind the scanned compute — 0.0
         # off the dist path; under fused dist_async training the
         # overlap_pct is the round-10 headline number
         # (docs/PERF_NOTES.md; profiler.wire_wait_ms/wire_overlap_pct)
         "wire_wait_ms_per_step": round(
-            wire_wait_d / iters / STEPS_PER_CALL, 3),
+            wire_wait_d / iters / steps_per_call, 3),
         "overlap_pct": round(overlap_pct, 1),
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
@@ -440,6 +490,10 @@ def _run(batch):
                   if os.environ.get("MXNET_BACKWARD_DO_MIRROR") == "1"
                   else False),
         "data_mode": os.environ.get("BENCH_DATA", "synthetic"),
+        # the topology this measurement belongs to — promotion keys
+        # BENCH_DEFAULTS.json entries by it (autotune/promote.py)
+        "topology": cfg["topology"],
+        "hosts": jax.process_count(),
     }
     if real_iter is not None:
         out["host_pipeline_imgs_per_sec"] = round(host_rate, 1)

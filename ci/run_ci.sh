@@ -137,6 +137,41 @@ JAX_PLATFORMS=cpu timeout -k 10 240 \
     python tools/launch.py -n 1 -s 1 \
     python tests/dist/dist_serving_smoke.py
 
+echo "== autotune smoke (stub-backend sweep: propose/measure/journal/promote)"
+# The measurement harness itself is CI-gated end to end on CPU
+# (docs/AUTOTUNE.md): a 6-trial sweep over a 2-knob toy space (the stub
+# axes restricted to 3x2 declared choices) against the deterministic
+# stub backend must CONVERGE to the analytic optimum (window=8,
+# chunk=4) and promote it into a throwaway PER-TOPOLOGY defaults file —
+# the exact loop a chip session runs (--target bench) proven without a
+# chip.  Time-boxed: a searcher/executor regression presents as a
+# missed optimum or a hang.
+rm -f /tmp/_autotune_smoke.jsonl /tmp/_autotune_smoke_defaults.json
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python -m mxnet_tpu.autotune --target stub --trials 6 \
+    --restrict MXNET_KVSTORE_WINDOW=4,8,16 \
+    --restrict MXNET_KVSTORE_FUSED_CHUNK=2,4 \
+    --journal /tmp/_autotune_smoke.jsonl \
+    --defaults /tmp/_autotune_smoke_defaults.json \
+    | tee /tmp/_autotune_smoke.out
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+lines = [json.loads(l) for l in open("/tmp/_autotune_smoke.out")
+         if l.startswith("{")]
+assert len(lines) == 1, "one-JSON-line contract violated"
+out = lines[0]
+best = {"MXNET_KVSTORE_WINDOW": 8, "MXNET_KVSTORE_FUSED_CHUNK": 4}
+assert out["best_config"] == best, out
+assert out["promoted"] is True, out
+from mxnet_tpu.autotune import lookup_defaults, topology_key
+path = "/tmp/_autotune_smoke_defaults.json"
+entry = lookup_defaults(path, topology_key("cpu-stub"))
+assert entry["env"] == best, entry
+# and ONLY that topology: nothing leaks to a different device kind
+assert lookup_defaults(path, topology_key("cpu")) == {}
+print("autotune smoke OK: converged to", out["best_config"])
+PY
+
 echo "== multichip dryrun (8 virtual devices)"
 JAX_PLATFORMS=cpu python - <<'PY'
 import cpu_pin

@@ -1,0 +1,117 @@
+"""Failover measurement target: elastic coordinator-kill rebuild cost.
+
+The in-process twin of the CI coordinator-failover smoke, instrumented
+as a measurement: two elastic servers + one worker, keys striped
+across both, then the COORDINATOR is stopped mid-job — the worker
+elects the successor, the ledger rebuilds, the three-phase handoff
+re-stripes, and the probe reports the ``kvstore.failover_rebuild_s``
+gauge (the successor's rebuild clock) plus the worker-observed repair
+wall time.  This is the roadmap's handoff/failover cost curve: sweep
+MXNET_KVSTORE_SNAPSHOT_S (cadence) x MXNET_KVSTORE_WINDOW and see what
+cadence actually buys at repair time.
+
+Objective key: ``failover_rebuild_s`` (minimize).  Run under
+JAX_PLATFORMS=cpu for a chip-independent number — the cost is host/
+wire-bound (ledger rebuild + restripe + re-push), not compute.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _rig_env() -> None:
+    """Fixed rig knobs — setdefault so the SWEPT knobs (snapshot
+    cadence, window) ride in from the executor untouched."""
+    for name, val in (
+            ("MXNET_KVSTORE_ELASTIC", "1"),
+            ("MXNET_KVSTORE_RETRY_MAX", "3"),
+            ("MXNET_KVSTORE_RETRY_INITIAL_MS", "10"),
+            ("MXNET_KVSTORE_RETRY_MAX_MS", "100"),
+            ("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1"),
+            ("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5"),
+            ("MXNET_KVSTORE_BIGARRAY_BOUND", "1024"),
+            ("DMLC_NUM_WORKER", "1"),
+            ("DMLC_WORKER_ID", "0")):
+        os.environ.setdefault(name, val)
+
+
+def main() -> int:
+    _rig_env()
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    rows = int(os.environ.get("MXT_AUTOTUNE_FAILOVER_ROWS", "4096"))
+    snapshot_s = float(os.environ.get("MXNET_KVSTORE_SNAPSHOT_S", "0"))
+
+    srv0 = KVStoreServer(server_id=0, num_workers=1, elastic=True)
+    srv1 = KVStoreServer(server_id=1, num_workers=1, elastic=True)
+    uris = "127.0.0.1:%d,127.0.0.1:%d" % (srv0.port, srv1.port)
+    os.environ["MXT_SERVER_URIS"] = uris
+    for srv in (srv0, srv1):
+        srv._roster_servers = uris.split(",")
+        srv._snapshot_s = snapshot_s
+    srv0.start_background()
+    srv1.start_background()
+    kv = mx.kv.create("dist_async")
+    try:
+        big = np.arange(rows * 32, dtype=np.float32).reshape(rows, 32)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.init("small", mx.nd.ones((4, 4)))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.9, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((rows, 32)))
+        kv.push("small", mx.nd.ones((4, 4)))
+        out_b, out_s = mx.nd.zeros((rows, 32)), mx.nd.zeros((4, 4))
+        kv.pull("big", out=out_b)      # sync point: pull cache = state
+        kv.pull("small", out=out_s)
+        if snapshot_s > 0:             # let at least one snapshot beat land
+            time.sleep(min(2.0, 2.5 * snapshot_s))
+
+        t0 = time.perf_counter()
+        srv0.stop()                    # the COORDINATOR dies
+        # the next round rides succession + repair end to end
+        kv.push("big", mx.nd.ones((rows, 32)))
+        kv.push("small", mx.nd.ones((4, 4)))
+        kv.barrier()
+        kv.pull("big", out=out_b)
+        kv.pull("small", out=out_s)
+        repair_wall_s = time.perf_counter() - t0
+
+        counts = profiler.channel_counts()
+        rebuild = counts.get("kvstore.failover_rebuild_s")
+        import jax
+        out = {
+            "metric": "kvstore_failover_rebuild_s",
+            "value": rebuild,
+            "unit": "s",
+            "failover_rebuild_s": rebuild,
+            "repair_wall_s": round(repair_wall_s, 4),
+            "failovers": counts.get("kvstore.coordinator_failover", 0),
+            "rows": rows,
+            "snapshot_s": snapshot_s,
+            "window": int(os.environ.get("MXNET_KVSTORE_WINDOW", "8")),
+            "device": jax.devices()[0].device_kind,
+            "workers": 1, "servers": 2,   # the probe's topology
+        }
+        if rebuild is None:
+            out["error"] = ("no kvstore.failover_rebuild_s gauge — "
+                            "failover never ran")
+        print(json.dumps(out))
+        return 0 if out.get("error") is None else 1
+    finally:
+        try:
+            kv.close(stop_servers=True)
+        except Exception:  # noqa: BLE001 — teardown after a kill probe
+            pass
+        srv0.stop()
+        srv1.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""The append-only, resumable trials journal (JSONL).
+
+One line per measured trial.  Append-only is the resume contract: a
+sweep killed mid-trial loses at most the line being written — ``load``
+tolerates a truncated trailing line, and the searcher's dedup over
+``(target, canonical config)`` means re-running the same command
+simply continues where the dead sweep stopped.  No rewriting, ever:
+imported history, failed trials and timeouts all stay on the record
+(the cost model filters by status; a timeout is itself a data point a
+future searcher can learn to avoid).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import List, Optional
+
+SCHEMA = 1
+
+
+@dataclasses.dataclass
+class Trial:
+    num: int                    # 1-based position in THIS journal
+    target: str                 # targets.TARGETS key
+    config: dict                # {env knob name: value}
+    status: str                 # ok | timeout | crash | error
+    objective: Optional[float]  # raw objective (sign per target), None unless ok
+    metrics: dict = dataclasses.field(default_factory=dict)
+    duration_s: Optional[float] = None
+    error: Optional[str] = None
+    source: str = "measured"    # 'measured' or the imported-history file
+    ts: Optional[float] = None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["schema"] = SCHEMA
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Trial":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok" and self.objective is not None
+
+
+class Journal:
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> List[Trial]:
+        """All parseable trials, in order.  A truncated/corrupt line
+        (the killed-mid-write case) is skipped, not fatal — resume must
+        work from exactly the file a dead sweep left behind."""
+        out: List[Trial] = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(d, dict) and "target" in d:
+                    try:
+                        out.append(Trial.from_json(d))
+                    except TypeError:
+                        continue
+        return out
+
+    def append(self, trial: Trial) -> Trial:
+        if trial.ts is None:
+            trial.ts = time.time()
+        d = os.path.dirname(os.path.abspath(self.path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # a sweep killed mid-append leaves a TORN line with no trailing
+        # newline — the next record must start on a fresh line or the
+        # concatenation corrupts BOTH lines
+        lead = ""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) != b"\n":
+                    lead = "\n"
+        except OSError:
+            pass   # absent or empty file: no repair needed
+        with open(self.path, "a") as f:
+            f.write(lead + json.dumps(trial.to_json()) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return trial
+
+    def next_num(self) -> int:
+        trials = self.load()
+        return (max((t.num for t in trials), default=0)) + 1
+
+    def sources(self) -> set:
+        return {t.source for t in self.load()}

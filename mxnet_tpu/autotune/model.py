@@ -1,0 +1,63 @@
+"""The fit-on-the-fly cost model: ridge regression over knob features.
+
+Deliberately tiny (closed-form normal equations over the
+``SearchSpace.encode`` features — one-hot choices, normalized ranges):
+with tens of trials per sweep, a learned-GNN TpuGraphs-style model has
+nothing to chew on, but a linear model over one-hot knob indicators
+already captures "window 8 beats window 1" and "2bit helps at batch
+512" — enough to steer scarce chip minutes toward the frontier instead
+of the grid (the pruning role the TVM loop gives its XGBoost ranker,
+arXiv:1802.04799 §5).  The searcher treats it as advisory: epsilon
+exploration keeps measuring off-model configs, and every measurement
+refits.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .journal import Trial
+from .space import SearchSpace
+
+
+class CostModel:
+    """Ridge regressor mapping encoded configs -> objective."""
+
+    def __init__(self, space: SearchSpace, l2: float = 1e-2):
+        self.space = space
+        self.l2 = float(l2)
+        self._w: Optional[np.ndarray] = None
+
+    @property
+    def fitted(self) -> bool:
+        return self._w is not None
+
+    def fit(self, trials: List[Trial]) -> bool:
+        """Fit on the ok trials; False when there is not enough signal
+        (fewer than 2 distinct measured configs)."""
+        rows, ys = [], []
+        for t in trials:
+            if not t.ok:
+                continue
+            rows.append(self.space.encode(t.config))
+            ys.append(float(t.objective))
+        if len(rows) < 2:
+            self._w = None
+            return False
+        x = np.asarray(rows, dtype=np.float64)
+        x = np.concatenate([x, np.ones((x.shape[0], 1))], axis=1)  # bias
+        y = np.asarray(ys, dtype=np.float64)
+        # normal equations with an l2 floor: always solvable, even for
+        # the rank-deficient few-trials start
+        a = x.T @ x + self.l2 * np.eye(x.shape[1])
+        self._w = np.linalg.solve(a, x.T @ y)
+        return True
+
+    def predict(self, configs: List[dict]) -> np.ndarray:
+        if self._w is None:
+            raise RuntimeError("CostModel.predict before a successful fit")
+        x = np.asarray([self.space.encode(c) for c in configs],
+                       dtype=np.float64)
+        x = np.concatenate([x, np.ones((x.shape[0], 1))], axis=1)
+        return x @ self._w

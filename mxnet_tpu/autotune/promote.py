@@ -1,0 +1,122 @@
+"""Per-topology promoted defaults (BENCH_DEFAULTS.json, schema 2).
+
+The seed repo's file was one flat dict — the best config of whatever
+chip last ran, applied to EVERY later run: a b256-TPU winner would
+silently become the CPU smoke's batch, and a MULTICHIP promotion would
+clobber the single-chip row.  Schema 2 keys every entry by TOPOLOGY —
+device kind x host count x worker/server count — and consumers look up
+exactly their own topology (and only it):
+
+    {"schema": 2,
+     "topologies": {
+       "TPU v5 lite|hosts=1|n=1|s=0": {
+         "batch": 256, "dtype": "bfloat16", ...,     # bench.py keys
+         "env": {"MXNET_KVSTORE_WINDOW": 8, ...},    # knob setdefaults
+         "promoted_from": {...}}}}                   # provenance
+
+Back-compat: a legacy flat file is read as ONE topology keyed by its
+``promoted_from.device`` (the only provenance it carried) — so the old
+TPU-v5e entry still applies to TPU-v5e runs and no longer leaks
+anywhere else.  Promotion keeps the >2% hysteresis per topology (noise
+must not flip defaults) and is strictly per-key: promoting a MULTICHIP
+row can never touch the single-chip one.
+
+Stdlib-only on purpose: bench.py and tools/ import this before/without
+a healthy backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+SCHEMA = 2
+_UNKNOWN_DEVICE = "unknown-device"
+
+
+def topology_key(device: str, hosts: int = 1, workers: int = 1,
+                 servers: int = 0) -> str:
+    """The canonical topology identity a measurement/consumer runs in."""
+    return "%s|hosts=%d|n=%d|s=%d" % (
+        device or _UNKNOWN_DEVICE, int(hosts), int(workers), int(servers))
+
+
+def _migrate_flat(doc: dict) -> dict:
+    """View a legacy flat defaults dict as a one-topology schema-2 doc."""
+    device = (doc.get("promoted_from") or {}).get("device") \
+        or _UNKNOWN_DEVICE
+    return {"schema": SCHEMA,
+            "topologies": {topology_key(device): dict(doc)}}
+
+
+def load_defaults(path: str) -> dict:
+    """The schema-2 doc at ``path`` ({} topologies when absent/corrupt);
+    legacy flat files are migrated in-memory."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {"schema": SCHEMA, "topologies": {}}
+    if not isinstance(doc, dict):
+        return {"schema": SCHEMA, "topologies": {}}
+    if isinstance(doc.get("topologies"), dict):
+        return {"schema": SCHEMA, "topologies": dict(doc["topologies"])}
+    if doc:
+        return _migrate_flat(doc)
+    return {"schema": SCHEMA, "topologies": {}}
+
+
+def lookup_defaults(path: str, topology: Optional[str]) -> dict:
+    """The promoted entry for EXACTLY ``topology`` ({} when absent or
+    topology is None — an unknown device gets no promoted config, which
+    is the whole point)."""
+    if not topology:
+        return {}
+    entry = load_defaults(path)["topologies"].get(topology)
+    return dict(entry) if isinstance(entry, dict) else {}
+
+
+def promote(path: str, topology: str, entry: dict, value: float,
+            maximize: bool = True, provenance: Optional[dict] = None,
+            hysteresis: float = 0.02) -> bool:
+    """Write ``entry`` as ``topology``'s promoted defaults when
+    ``value`` beats the currently-promoted value by more than
+    ``hysteresis`` (sign-aware) — noise can't flip defaults back and
+    forth, and other topologies' rows are never touched.  Returns
+    whether the file was written."""
+    doc = load_defaults(path)
+    current = doc["topologies"].get(topology) or {}
+    prev = (current.get("promoted_from") or {})
+    prev_val = prev.get("value")
+    if prev_val is not None:
+        margin = 1.0 + hysteresis
+        beats = value > prev_val * margin if maximize \
+            else value < prev_val / margin
+        if not beats:
+            return False
+    row = dict(entry)
+    row["promoted_from"] = dict(provenance or {}, value=value,
+                                maximize=maximize,
+                                ts=(provenance or {}).get("ts")
+                                or time.time())
+    doc["topologies"][topology] = row
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return True
+
+
+def apply_env_defaults(entry: dict, environ=None) -> dict:
+    """``os.environ.setdefault`` every knob in the entry's ``env`` dict
+    (explicit env always wins over a promoted default); returns the
+    knobs actually applied."""
+    environ = os.environ if environ is None else environ
+    applied = {}
+    for name, value in (entry.get("env") or {}).items():
+        if name not in environ:
+            environ[name] = str(value)
+            applied[name] = value
+    return applied

@@ -1,0 +1,169 @@
+"""Proposal strategies: random / grid baselines + the epsilon-greedy
+model searcher.
+
+Determinism contract (pinned in tests/test_autotune.py): the next
+proposal is a pure function of (journal contents, seed).  Every
+``propose`` call seeds a fresh RNG from ``(seed, len(trials))`` — so a
+resumed sweep, a re-run after a kill, or an identical journal on
+another machine all propose the SAME next config, regardless of how
+many proposals this process already made.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .journal import Trial
+from .model import CostModel
+from .space import SearchSpace
+
+
+def _rng_for(seed: int, n_trials: int) -> np.random.RandomState:
+    # mix, then clamp into RandomState's 32-bit seed domain
+    return np.random.RandomState((seed * 1000003 + n_trials) % (2 ** 32))
+
+
+class Searcher:
+    def __init__(self, space: SearchSpace, maximize: bool = True,
+                 seed: int = 0):
+        self.space = space
+        self.maximize = maximize
+        self.seed = int(seed)
+
+    # -- shared helpers -----------------------------------------------------
+    def _measured(self, trials: List[Trial]) -> set:
+        # Only trials THIS harness measured block re-proposal.  Imported
+        # history (source = a file name) warms the cost model but never
+        # vetoes a config: the banked rows may be from another device/
+        # pre-TCP_NODELAY era, and re-measuring the historical best is
+        # often exactly the point (the roadmap's re-baseline).  config
+        # {} marks an imported round whose settings are unknown — it
+        # must not shadow the registry-default config either.
+        return {self.space.canonical(t.config) for t in trials
+                if t.config and t.source == "measured"}
+
+    def _random_unmeasured(self, rng, measured, tries: int = 128) -> dict:
+        cand = self.space.sample(rng)
+        for _ in range(tries):
+            if self.space.canonical(cand) not in measured:
+                return cand
+            cand = self.space.sample(rng)
+        return cand      # space exhausted (tiny all-choice spaces): re-measure
+
+    def _best(self, trials: List[Trial]) -> Optional[Trial]:
+        ok = [t for t in trials if t.ok]
+        if not ok:
+            return None
+        key = (lambda t: t.objective) if self.maximize \
+            else (lambda t: -t.objective)
+        return max(ok, key=key)
+
+    def propose(self, trials: List[Trial]) -> dict:
+        raise NotImplementedError
+
+
+class RandomSearcher(Searcher):
+    def propose(self, trials: List[Trial]) -> dict:
+        rng = _rng_for(self.seed, len(trials))
+        return self._random_unmeasured(rng, self._measured(trials))
+
+
+class GridSearcher(Searcher):
+    """Deterministic enumeration of the per-axis grids; the journal is
+    the cursor — the first grid point not yet measured is next."""
+
+    def __init__(self, space, maximize=True, seed=0, grid_points: int = 5):
+        super().__init__(space, maximize=maximize, seed=seed)
+        self.grid_points = int(grid_points)
+
+    def propose(self, trials: List[Trial]) -> dict:
+        measured = self._measured(trials)
+        for config in self.space.grid(self.grid_points):
+            if self.space.canonical(config) not in measured:
+                return config
+        # grid exhausted: keep exploring off-grid points
+        return self._random_unmeasured(_rng_for(self.seed, len(trials)),
+                                       measured)
+
+
+class ModelSearcher(Searcher):
+    """Epsilon-greedy over the ridge cost model: with probability
+    epsilon explore a random unmeasured config; otherwise fit on the
+    journal and take the best-scored unmeasured candidate from a pool
+    of random samples + neighbors of the measured best + the registry
+    defaults.  Scores carry a count-based NOVELTY bonus — an axis value
+    no ok trial has touched adds (objective spread)/len(axes) toward
+    the optimization direction — because a linear model scores
+    never-observed one-hot columns at zero and would otherwise starve
+    whole axes of measurement (the classic cold-start pathology; the
+    TVM loop solves it with epsilon + diversity, arXiv:1802.04799 §5.3)."""
+
+    def __init__(self, space, maximize=True, seed=0, epsilon: float = 0.25,
+                 candidates: int = 64, novelty_weight: float = 1.0):
+        super().__init__(space, maximize=maximize, seed=seed)
+        self.epsilon = float(epsilon)
+        self.candidates = int(candidates)
+        self.novelty_weight = float(novelty_weight)
+
+    def _novelty(self, config: dict, trials: List[Trial]) -> float:
+        """Fraction of this config's axis values never measured ok."""
+        seen = {n: set() for n in self.space.axes}
+        for t in trials:
+            if not t.ok or not t.config:
+                continue
+            for n, a in self.space.axes.items():
+                seen[n].add(a.coerce(t.config.get(n, a.default)))
+        fresh = sum(
+            1 for n, a in self.space.axes.items()
+            if a.coerce(config.get(n, a.default)) not in seen[n])
+        return fresh / len(self.space.axes)
+
+    def propose(self, trials: List[Trial]) -> dict:
+        rng = _rng_for(self.seed, len(trials))
+        measured = self._measured(trials)
+        explore = rng.uniform() < self.epsilon
+        model = CostModel(self.space)
+        if explore or not model.fit(trials):
+            return self._random_unmeasured(rng, measured)
+        pool = [self.space.sample(rng) for _ in range(self.candidates)]
+        pool.append(self.space.default_config())
+        best = self._best(trials)
+        if best is not None:
+            pool.extend(self.space.neighbors(best.config))
+        seen = set()
+        fresh = []
+        for c in pool:
+            key = self.space.canonical(c)
+            if key in measured or key in seen:
+                continue
+            seen.add(key)
+            fresh.append(c)
+        if not fresh:
+            return self._random_unmeasured(rng, measured)
+        scores = model.predict(fresh)
+        ys = [t.objective for t in trials if t.ok]
+        spread = (max(ys) - min(ys)) if len(ys) >= 2 else 1.0
+        spread = spread or 1.0
+        sign = 1.0 if self.maximize else -1.0
+        bonus = self.novelty_weight * spread
+        scored = [s + sign * bonus * self._novelty(c, trials)
+                  for s, c in zip(scores, fresh)]
+        i = int(np.argmax(scored) if self.maximize
+                else np.argmin(scored))
+        return fresh[i]
+
+
+def make_searcher(strategy: str, space: SearchSpace, maximize: bool,
+                  seed: int, epsilon: float = 0.25,
+                  candidates: int = 64) -> Searcher:
+    if strategy == "random":
+        return RandomSearcher(space, maximize=maximize, seed=seed)
+    if strategy == "grid":
+        return GridSearcher(space, maximize=maximize, seed=seed)
+    if strategy == "model":
+        return ModelSearcher(space, maximize=maximize, seed=seed,
+                             epsilon=epsilon, candidates=candidates)
+    raise MXNetError("autotune: unknown strategy %r (model|random|grid)"
+                     % strategy)

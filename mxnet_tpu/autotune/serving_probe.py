@@ -1,0 +1,90 @@
+"""Serving measurement target: p99 / QPS of one replica under a storm.
+
+Stands up an in-process ServingReplica + pipelined ServingClient with
+the serving knobs taken straight from the environment (exactly how a
+production replica reads them — the sweep's config IS the env), fires
+a mixed-batch request storm, and prints the one-JSON-line measurement
+from the ``serving_stats`` latency counters: p50/p99 (nearest-rank over
+the profiler ring) and QPS.  BUSY sheds are retried like a production
+client would — a queue-depth config that sheds pays for it in latency,
+not in a probe crash.
+
+Objective key: ``p99_ms`` (minimize).  Swept knobs:
+MXNET_SERVING_BUCKETS / _MAX_WAIT_MS / _QUEUE_DEPTH / _CLIENT_WINDOW.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import BusyError, ServingClient, ServingReplica
+
+    feat, hidden = 32, 8
+    requests = int(os.environ.get("MXT_AUTOTUNE_SERVING_REQUESTS", "192"))
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc")
+    sym = mx.sym.SoftmaxOutput(fc, name="softmax")
+    rs = np.random.RandomState(0)
+    params = {
+        "fc_weight": mx.nd.NDArray(rs.randn(hidden, feat)
+                                   .astype(np.float32)),
+        "fc_bias": mx.nd.NDArray(rs.randn(hidden).astype(np.float32)),
+    }
+
+    # buckets / max_wait / queue_depth resolve from the env inside the
+    # replica; the client window from MXNET_SERVING_CLIENT_WINDOW
+    rep = ServingReplica(sym, {"data": (feat,)}, params)
+    rep.start_background()
+    cli = ServingClient("127.0.0.1:%d" % rep.port)
+    try:
+        x = rs.randn(8, feat).astype(np.float32)
+        futs = []
+        for i in range(requests):
+            rows = 1 + (i % 8)
+            req = x[:rows]
+            for _ in range(64):          # BUSY = retryable, not fatal
+                try:
+                    futs.append(cli.predict_async(req))
+                    break
+                except BusyError:
+                    time.sleep(0.002)
+            else:
+                raise RuntimeError("shed on every retry — queue depth "
+                                   "config starves the probe")
+        for fut in futs:
+            fut.get()
+        st = cli.stats()
+        lat = st.get("latency") or {}
+        import jax
+        out = {
+            "metric": "serving_p99_ms",
+            "value": lat.get("p99_ms"),
+            "unit": "ms",
+            "p50_ms": lat.get("p50_ms"),
+            "p99_ms": lat.get("p99_ms"),
+            "qps": lat.get("qps"),
+            "requests": len(futs),
+            "batches": st.get("batches"),
+            "shed": st.get("shed"),
+            "device": jax.devices()[0].device_kind,
+            "workers": 1, "servers": 1,   # one client, one replica
+        }
+        if out["value"] is None:
+            out["error"] = "serving_stats returned no latency window"
+        print(json.dumps(out))
+        return 0 if out.get("error") is None else 1
+    finally:
+        cli.close()
+        rep.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Search spaces derived from the ``base.declare_env`` knob registry.
+
+The registry is the ONLY source of axes: a knob becomes tunable by
+declaring ``tune=`` metadata (choices or a min/max range) next to its
+type, default and doc string — so the search space can never drift from
+what the framework actually reads, and an undeclared knob can never be
+tuned (``space_for`` raises; the ``env-knob`` lint rule additionally
+flags any built-in target axis naming an unregistered knob).
+
+Every axis knows how to sample, enumerate, perturb and ENCODE itself —
+the encoding (one-hot choices, [0,1]-normalized ranges, log-scaled
+where declared) is the feature vector the cost model regresses over.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, list_env_flags, list_env_tunables
+
+
+@dataclasses.dataclass(frozen=True)
+class Axis:
+    """One tunable knob: its registry identity plus tune metadata."""
+    name: str
+    typ: type
+    default: object
+    kind: str                      # 'choice' | 'int' | 'float'
+    choices: Optional[tuple] = None
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    log: bool = False
+
+    # -- sampling / enumeration ---------------------------------------------
+    def sample(self, rng):
+        if self.kind == "choice":
+            return self.choices[rng.randint(len(self.choices))]
+        u = rng.uniform()
+        return self._from_unit(u)
+
+    def _from_unit(self, u: float):
+        lo, hi = float(self.lo), float(self.hi)
+        if self.log:
+            v = math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+        else:
+            v = lo + u * (hi - lo)
+        if self.kind == "int":
+            return int(min(self.hi, max(self.lo, round(v))))
+        return float(v)
+
+    def grid(self, n: int = 5) -> tuple:
+        """Deterministic candidate values: all choices, or n points
+        spaced over the range (log-spaced when declared log)."""
+        if self.kind == "choice":
+            return tuple(self.choices)
+        pts = [self._from_unit(i / (n - 1)) for i in range(n)]
+        out = []
+        for p in pts:           # int ranges can collapse duplicate points
+            if p not in out:
+                out.append(p)
+        return tuple(out)
+
+    def neighbors(self, value) -> list:
+        """Adjacent values: choice index +-1, or a x2 / /2 step clipped
+        to the range — the local moves the model searcher explores
+        around the measured best."""
+        if self.kind == "choice":
+            try:
+                i = self.choices.index(value)
+            except ValueError:
+                return [self.choices[0]]
+            out = []
+            if i > 0:
+                out.append(self.choices[i - 1])
+            if i + 1 < len(self.choices):
+                out.append(self.choices[i + 1])
+            return out
+        out = []
+        for v in (value * 0.5, value * 2.0):
+            v = min(float(self.hi), max(float(self.lo), v))
+            if self.kind == "int":
+                v = int(round(v))
+            if v != value:
+                out.append(v)
+        return out
+
+    # -- features -----------------------------------------------------------
+    def encode(self, value) -> List[float]:
+        """Feature columns for the cost model: one-hot for choices,
+        one [0,1]-normalized column for ranges."""
+        if self.kind == "choice":
+            row = [0.0] * len(self.choices)
+            try:
+                row[self.choices.index(value)] = 1.0
+            except ValueError:
+                pass        # unknown (e.g. imported-history) value: all-zero
+            return row
+        lo, hi = float(self.lo), float(self.hi)
+        v = float(value)
+        if self.log:
+            v = max(v, lo)
+            u = (math.log(v) - math.log(lo)) / (math.log(hi) - math.log(lo))
+        else:
+            u = (v - lo) / (hi - lo)
+        return [min(1.0, max(0.0, u))]
+
+    def width(self) -> int:
+        return len(self.choices) if self.kind == "choice" else 1
+
+    def coerce(self, raw):
+        """Parse a journal/env string back to the axis's python type."""
+        if self.typ is bool and isinstance(raw, str):
+            return raw.lower() not in ("0", "false", "off", "")
+        try:
+            return self.typ(raw)
+        except (TypeError, ValueError):
+            return raw
+
+
+def restrict_axis(axis: Axis, values: Sequence) -> Axis:
+    """Narrow an axis to an explicit value list (the operator's
+    chip-session move: sweep only the plausible corner).  Still
+    registry-bounded: every value must sit inside the DECLARED choices
+    (or range) — a restriction can never smuggle in an untunable
+    setting."""
+    vals = tuple(axis.coerce(v) for v in values)
+    if not vals:
+        raise MXNetError("autotune: empty restriction for %s" % axis.name)
+    if axis.kind == "choice":
+        bad = [v for v in vals if v not in axis.choices]
+        if bad:
+            raise MXNetError(
+                "autotune: restriction values %r for %s are outside its "
+                "declared choices %r" % (bad, axis.name, axis.choices))
+    else:
+        bad = [v for v in vals
+               if not (float(axis.lo) <= float(v) <= float(axis.hi))]
+        if bad:
+            raise MXNetError(
+                "autotune: restriction values %r for %s are outside its "
+                "declared range [%r, %r]"
+                % (bad, axis.name, axis.lo, axis.hi))
+    return Axis(name=axis.name, typ=axis.typ, default=axis.default,
+                kind="choice", choices=vals)
+
+
+def axis_for(name: str) -> Axis:
+    """The Axis for one registered knob; raises for undeclared or
+    tune-less knobs — the 'undeclared knobs can never be tuned' gate."""
+    flags = list_env_flags()
+    if name not in flags:
+        raise MXNetError(
+            "autotune: knob %s is not declared via base.declare_env — "
+            "undeclared knobs can never be tuned" % name)
+    typ, default, _doc = flags[name]
+    tune = list_env_tunables().get(name)
+    if tune is None:
+        raise MXNetError(
+            "autotune: knob %s is declared but carries no tune= "
+            "metadata — declare its choices or min/max range to make "
+            "it sweepable" % name)
+    if tune["kind"] == "choice":
+        return Axis(name=name, typ=typ, default=default, kind="choice",
+                    choices=tuple(tune["choices"]))
+    return Axis(name=name, typ=typ, default=default, kind=tune["kind"],
+                lo=tune["min"], hi=tune["max"], log=tune["log"])
+
+
+class SearchSpace:
+    """An ordered set of axes; configs are {env name: value} dicts."""
+
+    def __init__(self, axes: Sequence[Axis]):
+        if not axes:
+            raise MXNetError("autotune: empty search space")
+        self.axes: Dict[str, Axis] = {a.name: a for a in axes}
+
+    def __len__(self):
+        return len(self.axes)
+
+    # -- configs ------------------------------------------------------------
+    def default_config(self) -> dict:
+        return {n: a.default for n, a in self.axes.items()}
+
+    def sample(self, rng) -> dict:
+        return {n: a.sample(rng) for n, a in self.axes.items()}
+
+    def grid(self, n: int = 5) -> Iterator[dict]:
+        """Cartesian product of per-axis grids, in declaration order."""
+        names = list(self.axes)
+        per_axis = [self.axes[name].grid(n) for name in names]
+        for combo in itertools.product(*per_axis):
+            yield dict(zip(names, combo))
+
+    def neighbors(self, config: dict) -> List[dict]:
+        """One-axis-changed variants of ``config``."""
+        out = []
+        for name, axis in self.axes.items():
+            for v in axis.neighbors(config.get(name, axis.default)):
+                cand = dict(config)
+                cand[name] = v
+                out.append(cand)
+        return out
+
+    def canonical(self, config: dict) -> Tuple:
+        """Hashable identity for dedup across proposals/journal resume
+        (axis order fixed; values coerced through the axis type so a
+        journal round trip — json stringification — cannot split one
+        config into two identities)."""
+        return tuple((n, a.coerce(config.get(n, a.default)))
+                     for n, a in self.axes.items())
+
+    def encode(self, config: dict) -> List[float]:
+        row: List[float] = []
+        for n, a in self.axes.items():
+            row.extend(a.encode(a.coerce(config.get(n, a.default))))
+        return row
+
+    def feature_width(self) -> int:
+        return sum(a.width() for a in self.axes.values())
+
+    def size(self) -> Optional[int]:
+        """Config count for all-choice spaces, None for continuous."""
+        total = 1
+        for a in self.axes.values():
+            if a.kind != "choice":
+                return None
+            total *= len(a.choices)
+        return total
+
+
+def space_for(knob_names: Sequence[str],
+              restrict: Optional[Dict[str, Sequence]] = None) \
+        -> SearchSpace:
+    """Build the space for an explicit knob list (a target's axes),
+    optionally narrowing axes to explicit value lists."""
+    restrict = restrict or {}
+    unknown = set(restrict) - set(knob_names)
+    if unknown:
+        raise MXNetError("autotune: restriction names %s are not axes "
+                         "of this space %s"
+                         % (sorted(unknown), list(knob_names)))
+    axes = []
+    for n in knob_names:
+        a = axis_for(n)
+        if n in restrict:
+            a = restrict_axis(a, restrict[n])
+        axes.append(a)
+    return SearchSpace(axes)
+
+
+def tunable_names() -> List[str]:
+    """Every registered knob carrying tune metadata."""
+    return sorted(list_env_tunables())

@@ -1,0 +1,59 @@
+"""Deterministic stub measure backend — the tier-1 stand-in for a chip.
+
+Reads two REAL registry knobs from the environment and prints one JSON
+measurement line whose value is an analytic bowl with a known best
+(window=8, chunk=4) — so searcher convergence, journaling, resume,
+timeout handling and per-topology promotion are all testable on CPU in
+milliseconds, before a chip session ever runs.
+
+Run by PATH (not ``-m``): stdlib only, no mxnet_tpu/jax import — a
+6-trial CI sweep must cost seconds.  Test hooks (MXT_ prefix: harness
+controls, not framework knobs):
+
+* ``MXT_AUTOTUNE_STUB_SLEEP_S`` — hold this long before replying (the
+  deliberately-hanging target for executor timeout/kill tests);
+* ``MXT_AUTOTUNE_STUB_CRASH=1`` — exit nonzero before printing;
+* ``MXT_AUTOTUNE_STUB_DEVICE`` — device field override (topology tests).
+"""
+import json
+import math
+import os
+import sys
+import time
+
+KNOB_WINDOW = "MXNET_KVSTORE_WINDOW"
+KNOB_CHUNK = "MXNET_KVSTORE_FUSED_CHUNK"
+
+BEST = {KNOB_WINDOW: 8, KNOB_CHUNK: 4}
+
+
+def objective(window: int, chunk: int) -> float:
+    """Analytic bowl, maximized exactly at the BEST config."""
+    w = math.log2(max(1, window))
+    c = math.log2(max(1, chunk))
+    return round(100.0 - 6.0 * (w - 3.0) ** 2 - 4.0 * (c - 2.0) ** 2, 4)
+
+
+def main() -> int:
+    sleep_s = float(os.environ.get("MXT_AUTOTUNE_STUB_SLEEP_S", "0"))
+    if sleep_s > 0:
+        time.sleep(sleep_s)
+    if os.environ.get("MXT_AUTOTUNE_STUB_CRASH") == "1":
+        print("stub: deliberate crash before the JSON line",
+              file=sys.stderr)
+        return 7
+    window = int(os.environ.get(KNOB_WINDOW, "8"))
+    chunk = int(os.environ.get(KNOB_CHUNK, "8"))
+    print(json.dumps({
+        "metric": "stub_throughput",
+        "value": objective(window, chunk),
+        "unit": "units/sec",
+        "device": os.environ.get("MXT_AUTOTUNE_STUB_DEVICE", "cpu-stub"),
+        KNOB_WINDOW: window,
+        KNOB_CHUNK: chunk,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,8 +34,53 @@ class MXNetError(RuntimeError):
 # ---------------------------------------------------------------------------
 _ENV_FLAGS: Dict[str, tuple] = {}
 
+# Tune metadata sidecar (mxnet_tpu.autotune): knobs that additionally
+# carry a search-space description.  Kept out of the _ENV_FLAGS tuple so
+# every existing (typ, default, doc) unpacker stays valid.
+_ENV_TUNE: Dict[str, dict] = {}
 
-def declare_env(name: str, typ: type, default, doc: str = "") -> None:
+
+def _validate_tune(name: str, typ: type, tune: dict) -> dict:
+    """Normalize/validate declare_env tune metadata.  Two shapes:
+    ``{"choices": [...]}`` (ordered candidate values, any typ) or
+    ``{"min": lo, "max": hi[, "log": True]}`` (numeric range)."""
+    if not isinstance(tune, dict):
+        raise MXNetError("declare_env(%s): tune metadata must be a dict, "
+                         "got %r" % (name, type(tune).__name__))
+    unknown = set(tune) - {"choices", "min", "max", "log"}
+    if unknown:
+        raise MXNetError("declare_env(%s): unknown tune keys %s"
+                         % (name, sorted(unknown)))
+    if "choices" in tune:
+        choices = list(tune["choices"])
+        if not choices:
+            raise MXNetError("declare_env(%s): empty tune choices" % name)
+        if "min" in tune or "max" in tune:
+            raise MXNetError("declare_env(%s): tune metadata is choices "
+                             "OR a min/max range, not both" % name)
+        return {"kind": "choice", "choices": choices}
+    if "min" not in tune or "max" not in tune:
+        raise MXNetError("declare_env(%s): tune metadata needs either "
+                         "'choices' or both 'min' and 'max'" % name)
+    if typ not in (int, float):
+        raise MXNetError("declare_env(%s): min/max tune ranges require "
+                         "an int or float knob, got %s"
+                         % (name, typ.__name__))
+    lo, hi = typ(tune["min"]), typ(tune["max"])
+    if not lo < hi:
+        raise MXNetError("declare_env(%s): tune range needs min < max, "
+                         "got [%r, %r]" % (name, lo, hi))
+    log = bool(tune.get("log", False))
+    if log and lo <= 0:
+        raise MXNetError("declare_env(%s): log-scale tune range needs "
+                         "min > 0" % name)
+    return {"kind": typ.__name__, "min": lo, "max": hi, "log": log}
+
+
+def declare_env(name: str, typ: type, default, doc: str = "",
+                tune: Optional[dict] = None) -> None:
+    if tune is not None:
+        _ENV_TUNE[name] = _validate_tune(name, typ, tune)
     _ENV_FLAGS[name] = (typ, default, doc)
 
 
@@ -60,6 +105,13 @@ def env(name: str, default=None):
 
 def list_env_flags() -> Dict[str, tuple]:
     return dict(_ENV_FLAGS)
+
+
+def list_env_tunables() -> Dict[str, dict]:
+    """Knobs that declared a search space (``declare_env(..., tune=)``).
+    The ONLY source mxnet_tpu.autotune derives axes from — an undeclared
+    knob can never be tuned."""
+    return {name: dict(meta) for name, meta in _ENV_TUNE.items()}
 
 
 # The runtime flags carried over from the reference that still make sense on
@@ -108,16 +160,20 @@ declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19,
 declare_env("MXNET_KVSTORE_WINDOW", int, 8,
             "dist_async channel: max envelopes in flight per server "
             "connection (sliding-window pipeline; 1 = the old "
-            "stop-and-wait loop bit for bit)")
+            "stop-and-wait loop bit for bit)",
+            tune={"choices": [1, 2, 4, 8, 16, 32]})
 declare_env("MXNET_KVSTORE_COMPRESSION", str, "",
             "gradient compression for dist pushes: ''/none, 2bit or "
-            "fp16 (job-wide form of set_gradient_compression)")
+            "fp16 (job-wide form of set_gradient_compression)",
+            tune={"choices": ["", "fp16", "2bit"]})
 declare_env("MXNET_KVSTORE_COMPRESSION_THRESHOLD", float, 0.5,
             "2bit quantization threshold t: gradient values quantize "
-            "to {-t, 0, +t} with worker-side error feedback")
+            "to {-t, 0, +t} with worker-side error feedback",
+            tune={"min": 0.05, "max": 2.0, "log": True})
 declare_env("MXNET_KVSTORE_COALESCE_BYTES", int, 16384,
             "LIST pushes coalesce same-server keys at or below this "
-            "many payload bytes into one multi-key envelope")
+            "many payload bytes into one multi-key envelope",
+            tune={"choices": [0, 4096, 16384, 65536, 262144]})
 declare_env("MXNET_KVSTORE_PICKLE_ALLOWLIST", str, "",
             "extra 'module' or 'module:name' entries (comma-separated) "
             "the wire unpickler admits — the custom-optimizer escape "
@@ -154,7 +210,8 @@ declare_env("MXNET_KVSTORE_SNAPSHOT_S", float, 0.0,
             "beats, fanned out to EVERY peer so the bank outlives any "
             "single server incl. the coordinator (the killed-server "
             "optimizer-state recovery source; 0 disables snapshots — "
-            "weights still recover from the workers' quorum re-push)")
+            "weights still recover from the workers' quorum re-push)",
+            tune={"choices": [0.0, 0.25, 1.0, 5.0]})
 declare_env("MXNET_KVSTORE_ELASTIC_PUSH_LOG", int, 256,
             "elastic: per-worker cap on pushes remembered since each "
             "key's last pull, re-applied under the new layout when a "
@@ -176,7 +233,8 @@ declare_env("MXNET_KVSTORE_FUSED_CHUNK", int, 8,
             "local (worker-replica) weight evolution between server "
             "sync points.  A K not divisible by the chunk compiles the "
             "tail chunk as its own XLA program — size K in multiples "
-            "to pay exactly one compile")
+            "to pay exactly one compile",
+            tune={"choices": [1, 2, 4, 8, 16, 32]})
 declare_env("MXNET_KVSTORE_FUSED_STALENESS", int, 1,
             "fused-dist driver: exactly how many chunk boundaries the "
             "adopted server weights lag — chunk j always starts from "
@@ -185,20 +243,25 @@ declare_env("MXNET_KVSTORE_FUSED_STALENESS", int, 1,
             "chunk boundary (no overlap) that single-worker matches the "
             "eager dist loop bit-for-bit; 1 (default) hides the wire "
             "behind one chunk of compute — async-SGD-grade staleness, "
-            "same class as the elastic handoff contract")
+            "same class as the elastic handoff contract",
+            tune={"choices": [0, 1, 2]})
 # -- serving tier (mxnet_tpu.serving) ---------------------------------------
 declare_env("MXNET_SERVING_BUCKETS", str, "1,2,4,8,16,32",
             "serving: comma-separated batch-size buckets the replica "
             "pre-compiles predict executables for (requests pad to the "
-            "smallest covering bucket — N requests never mean N compiles)")
+            "smallest covering bucket — N requests never mean N compiles)",
+            tune={"choices": ["1,2,4,8,16,32", "1,4,16,64",
+                              "8,16,32,64", "1,8,64"]})
 declare_env("MXNET_SERVING_MAX_WAIT_MS", float, 2.0,
             "serving: dynamic batcher max wait for more requests before "
             "dispatching a partially-filled bucket (the latency half of "
-            "the batching SLO dial; 0 dispatches immediately)")
+            "the batching SLO dial; 0 dispatches immediately)",
+            tune={"choices": [0.0, 0.5, 2.0, 5.0]})
 declare_env("MXNET_SERVING_QUEUE_DEPTH", int, 256,
             "serving: admission control — requests queued past this "
             "depth are shed with a typed BUSY reply instead of growing "
-            "an unbounded queue")
+            "an unbounded queue",
+            tune={"choices": [64, 256, 1024]})
 declare_env("MXNET_SERVING_REFRESH_S", float, 0.0,
             "serving: seconds between weight-version polls against the "
             "live dist_async parameter servers (0 disables polling; the "
@@ -206,7 +269,8 @@ declare_env("MXNET_SERVING_REFRESH_S", float, 0.0,
 declare_env("MXNET_SERVING_CLIENT_WINDOW", int, 64,
             "serving: max in-flight predict envelopes per client "
             "connection (the serving override of MXNET_KVSTORE_WINDOW — "
-            "the replica's pipelined loop batches across the window)")
+            "the replica's pipelined loop batches across the window)",
+            tune={"choices": [16, 64, 256]})
 declare_env("MXNET_SERVING_LATENCY_WINDOW", int, 2048,
             "serving: ring size of the profiler's per-kind latency "
             "sample window (p50/p99/QPS are computed over this window; "
@@ -278,6 +342,69 @@ declare_env("MXNET_FI_KILL_ON_BEAT_SEQ", int, None,
             "beat loop sends beat number N — the deterministic beat-"
             "boundary kill point for coordinator-failover tests, where "
             "the enveloped-ack count is timing-dependent (unset = off)")
+# -- bench-script knobs (bench.py / benchmark/*) -----------------------------
+# Read by the repo-level bench scripts, which sit OUTSIDE the linted
+# package — declared here anyway because registration is what makes a
+# knob tunable: mxnet_tpu.autotune derives its search space exclusively
+# from this registry (docs/AUTOTUNE.md), so an undeclared bench axis
+# could never be swept.
+declare_env("BENCH_BATCH", int, 256,
+            "bench.py: training batch size (halved automatically on "
+            "OOM; per-topology BENCH_DEFAULTS.json overrides the "
+            "built-in default, env overrides both)",
+            tune={"choices": [64, 128, 256, 512, 1024]})
+declare_env("BENCH_DTYPE", str, "bfloat16",
+            "bench.py: compute dtype for the fused step (bfloat16 = "
+            "mixed precision with fp32 masters; float32 = full "
+            "precision)",
+            tune={"choices": ["bfloat16", "float32"]})
+declare_env("BENCH_OPT", str, "sgd",
+            "bench.py: optimizer driven through init_optimizer (lars "
+            "exercises the large-batch trust-ratio recipe)",
+            tune={"choices": ["sgd", "lars"]})
+declare_env("BENCH_STEPS_PER_CALL", int, 1,
+            "bench.py: training steps fused into ONE run_steps dispatch "
+            "(lax.scan); K>1 amortizes the host dispatch through the "
+            "tunnel to 1/K per step, 1 = classic per-step dispatch",
+            tune={"choices": [1, 2, 4, 8, 16]})
+declare_env("BENCH_STEM", str, "conv7",
+            "bench.py: ResNet stem variant — conv7 (reference 7x7) or "
+            "s2d (TPU-native space-to-depth, mathematically equivalent)",
+            tune={"choices": ["conv7", "s2d"]})
+declare_env("BENCH_LAYOUT", str, "nchw",
+            "bench.py: activation layout — nchw (MXNet default) or "
+            "nhwc (channels-last, the MLPerf-TPU ResNet convention)",
+            tune={"choices": ["nchw", "nhwc"]})
+declare_env("BENCH_REMAT", str, "0",
+            "bench.py: rematerialization — 0 off, 1/full whole-step "
+            "recompute, save_matmuls keeps conv/FC outputs and "
+            "recomputes elementwise chains",
+            tune={"choices": ["0", "1", "save_matmuls"]})
+# -- autotune harness (mxnet_tpu.autotune) -----------------------------------
+declare_env("MXNET_AUTOTUNE_TRIALS", int, 16,
+            "autotune: measured trials per sweep invocation (the CLI "
+            "--trials default; resume counts prior journaled trials "
+            "toward nothing — this is trials THIS run)")
+declare_env("MXNET_AUTOTUNE_SEED", int, 0,
+            "autotune: RNG seed for proposal sampling — same journal + "
+            "same seed reproduces the same proposal sequence exactly")
+declare_env("MXNET_AUTOTUNE_EPSILON", float, 0.25,
+            "autotune: epsilon-greedy exploration rate for the model "
+            "searcher — fraction of proposals drawn uniformly from the "
+            "space instead of argmax over the fitted cost model")
+declare_env("MXNET_AUTOTUNE_STRATEGY", str, "model",
+            "autotune: proposal strategy — model (fit-on-the-fly "
+            "regressor + epsilon-greedy), random, or grid")
+declare_env("MXNET_AUTOTUNE_TRIAL_TIMEOUT_S", float, 900.0,
+            "autotune: hard deadline per measured trial — the "
+            "subprocess executor SIGKILLs the config's whole process "
+            "group at the deadline and records status=timeout "
+            "(fresh_process_probe discipline: a hung trial can never "
+            "serialize the sweep)")
+declare_env("MXNET_AUTOTUNE_CANDIDATES", int, 64,
+            "autotune: candidate pool size the model searcher scores "
+            "per proposal (random samples + neighbors of the measured "
+            "best)")
 
 
 # ---------------------------------------------------------------------------

@@ -166,7 +166,13 @@ def test_promote_defaults_ignores_cpu_rows(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "OUT", str(out))
     assert mod.main() == 0
     d = json.loads(out.read_text())
-    assert d["batch"] == 512 and d["promoted_from"]["device"] == "TPU v5 lite"
+    # schema 2: the winner lands under ITS topology key and only there
+    # (autotune/promote.py — a TPU winner can't leak into a CPU run)
+    topo = "TPU v5 lite|hosts=1|n=1|s=0"
+    entry = d["topologies"][topo]
+    assert entry["batch"] == 512
+    assert entry["promoted_from"]["device"] == "TPU v5 lite"
+    assert list(d["topologies"]) == [topo]
 
     # cpu-only log promotes nothing
     log.write_text(json.dumps(rows[1]) + "\n")

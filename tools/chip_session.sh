@@ -136,9 +136,28 @@ python tools/promote_bench_defaults.py || true
 # Profiles the PROMOTED winner config (read explicitly — run_bench pins
 # everything else, so spell the winner's axes out here)
 PROMOTED_ENV=$(python - <<'PY'
+import importlib.util
 import json
+spec = importlib.util.spec_from_file_location(
+    "p", "mxnet_tpu/autotune/promote.py")
+p = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(p)
+# schema 2 is per-topology: read the chip's own entry — the device this
+# session just swept is the one the last banked log row names
+d = {}
 try:
-    d = json.load(open("BENCH_DEFAULTS.json"))
+    last = None
+    for line in open("BENCH_LOG.jsonl"):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and row.get("value"):
+            last = row
+    if last is not None:
+        topo = last.get("topology") or p.topology_key(
+            last.get("device"), hosts=int(last.get("hosts", 1)))
+        d = p.lookup_defaults("BENCH_DEFAULTS.json", topo)
 except Exception:
     d = {}
 print("BENCH_BATCH=%s BENCH_STEM=%s BENCH_OPT=%s BENCH_DTYPE=%s "

@@ -1,14 +1,19 @@
 #!/usr/bin/env python
 """Promote the best measured sweep config to bench defaults.
 
-Scans BENCH_LOG.jsonl for resnet50 synthetic-data measurements and, when
-the winner beats the CURRENT default config's best measurement by a
-margin (>2%, so noise can't flip defaults back and forth), writes
-BENCH_DEFAULTS.json — which bench.py reads for its BATCH/STEM/REMAT/OPT
-defaults (env still overrides).  Run by tools/chip_session.sh after the
-MFU sweep; safe to run any time (no log → no file → bench keeps built-in
-defaults).
+Scans BENCH_LOG.jsonl for resnet50 synthetic-data measurements and
+promotes the winner into its PER-TOPOLOGY entry of BENCH_DEFAULTS.json
+(schema 2, mxnet_tpu/autotune/promote.py: device kind x host count x
+worker/server count) — bench.py resolves exactly its own topology's
+entry, so a b256-TPU winner can never leak into a CPU or MULTICHIP
+run.  The >2% hysteresis lives in promote(): noise can't flip defaults
+back and forth, and other topologies' rows are never touched.  Run by
+tools/chip_session.sh after the MFU sweep; safe to run any time (no
+log → no file → bench keeps built-in defaults).  The richer sweep
+driver (`python -m mxnet_tpu.autotune --target bench`) promotes
+through the same schema.
 """
+import importlib.util
 import json
 import os
 import sys
@@ -16,6 +21,17 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(ROOT, "BENCH_LOG.jsonl")
 OUT = os.path.join(ROOT, "BENCH_DEFAULTS.json")
+
+
+def _promote_mod():
+    """autotune.promote loaded BY PATH (stdlib-only module) — this tool
+    must stay runnable without importing the full package/jax."""
+    spec = importlib.util.spec_from_file_location(
+        "_tool_promote",
+        os.path.join(ROOT, "mxnet_tpu", "autotune", "promote.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def remat_str(v):
@@ -73,41 +89,33 @@ def main():
             # duplicate so provenance survives
             best = d
 
-    current = {}
-    if os.path.exists(OUT):
-        try:
-            with open(OUT) as f:
-                current = json.load(f)
-        except ValueError:
-            current = {}
-
-    cand = {
+    prom = _promote_mod()
+    # rows written by the current bench.py carry their topology; older
+    # banked rows fall back to the single-host key for their device
+    topo = best.get("topology") or prom.topology_key(
+        best.get("device"), hosts=int(best.get("hosts", 1)))
+    entry = {
         "batch": int(best.get("batch", 256)),
         "stem": best.get("stem", "conv7"),
         "layout": best.get("layout", "nchw"),
         "opt": best.get("opt", "sgd"),
         "dtype": best.get("dtype", "bfloat16"),
         "remat": remat_str(best.get("remat", "0")),
-        # provenance, for the next reader
-        "promoted_from": {"value": best["value"],
-                          "mfu": best.get("mfu"),
-                          "ts": best.get("ts"),
-                          "tag": best.get("tag"),
-                          "device": best.get("device")},
+        "steps_per_call": int(best.get("steps_per_call", 1)),
     }
-    prev = current.get("promoted_from") or {}
-    prev_val = prev.get("value", 0) or 0
-    same_device = prev.get("device") == best.get("device")
-    if prev_val and same_device and best["value"] < prev_val * 1.02:
-        # >2% hysteresis so noise can't flip defaults; only comparable
-        # on the same device kind — a chip swap always re-promotes
-        print("promote: best %.1f does not beat promoted %.1f by >2%% — "
-              "keeping current defaults" % (best["value"], prev_val))
+    wrote = prom.promote(
+        OUT, topo, entry, float(best["value"]), maximize=True,
+        provenance={"mfu": best.get("mfu"), "ts": best.get("ts"),
+                    "tag": best.get("tag"), "device": best.get("device"),
+                    "metric": best.get("metric")})
+    if not wrote:
+        print("promote: best %.1f does not beat the promoted value for "
+              "%s by >2%% — keeping current defaults"
+              % (best["value"], topo))
         return 0
-    with open(OUT, "w") as f:
-        json.dump(cand, f, indent=1)
-    print("promote: defaults <- %s (%.1f imgs/sec, mfu %s)"
-          % ({k: cand[k] for k in ("batch", "stem", "opt", "remat")},
+    print("promote: %s <- %s (%.1f imgs/sec, mfu %s)"
+          % (topo,
+             {k: entry[k] for k in ("batch", "stem", "opt", "remat")},
              best["value"], best.get("mfu")))
     return 0
 
