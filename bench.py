@@ -497,6 +497,19 @@ def _run(batch):
     }
     if real_iter is not None:
         out["host_pipeline_imgs_per_sec"] = round(host_rate, 1)
+    # cluster counters next to wire_bytes_per_step (when a dist kvstore
+    # is live): every server's ("stats",) reply — channel counts/gauges,
+    # byte counters, wire clocks — rides the one-line JSON row, so
+    # autotune trials and chip sessions bank cluster evidence for free
+    # (docs/OBSERVABILITY.md).  Compact form; absent in single-process
+    # configs so the CI bench-contract row stays lean.
+    try:
+        from mxnet_tpu import distributed as _mx_dist
+        cstats = _mx_dist.cluster_stats(compact=True)
+        if cstats.get("servers"):
+            out["cluster_stats"] = cstats
+    except Exception:  # noqa: BLE001 — stats must never fail the bench
+        pass
     try:
         stats = dev.memory_stats() or {}
         peak_bytes = stats.get("peak_bytes_in_use")

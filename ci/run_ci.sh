@@ -77,11 +77,53 @@ echo "== elastic-membership smoke (SIGKILL a server mid-epoch, no restart)"
 # the static-roster golden.  Time-boxed: an elastic regression
 # typically presents as a hang in the renegotiated barrier.
 kill_acks=$(MXT_PRINT_KILL_ACKS=1 python tests/dist/dist_elastic_membership.py)
-JAX_PLATFORMS=cpu timeout -k 10 240 \
+# The gate now ALSO runs traced (MXNET_TRACE=1, near-zero overhead by
+# contract): after the job survives, the per-process span journals must
+# merge into ONE chrome trace in which the handoff is a span with its
+# three protocol phases as children, hanging off the worker-side
+# kv.repair span, with cross-process flow arrows into the surviving
+# servers — the ISSUE 12 acceptance timeline (docs/OBSERVABILITY.md).
+# The SIGKILLed server's journal is torn mid-append by design; the
+# merge must tolerate it.
+rm -rf /tmp/_trace_elastic && mkdir -p /tmp/_trace_elastic
+JAX_PLATFORMS=cpu MXNET_TRACE=1 MXNET_TRACE_DIR=/tmp/_trace_elastic \
+    timeout -k 10 240 \
     python tools/launch.py --elastic -n 2 -s 2 \
     --env MXNET_FI_KILL_PROCESS_AFTER="$kill_acks" \
     --env MXNET_FI_ONLY_SERVER=1 \
     python tests/dist/dist_elastic_membership.py
+python tools/trace_merge.py --spans /tmp/_trace_elastic \
+    -o /tmp/_trace_elastic_merged.json
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+m = json.load(open("/tmp/_trace_elastic_merged.json"))
+evs = [e for e in m["traceEvents"] if e.get("ph") == "X"]
+by_span = {e["args"]["span"]: e for e in evs}
+handoffs = [e for e in evs if e["name"] == "kv.handoff"]
+assert handoffs, "merged elastic trace has no kv.handoff span"
+# every handoff carries its three protocol phases as children ...
+for h in handoffs:
+    kids = {e["name"] for e in evs
+            if e["args"].get("parent") == h["args"]["span"]}
+    assert {"handoff.values", "handoff.states",
+            "handoff.repush"} <= kids, (h["args"]["span"], kids)
+# ... and at least one hangs off a worker-side kv.repair span.  (A
+# worker that discovers the bump at a barrier instead of on a failed
+# channel parents its handoff under kv.refresh — legal; but the kill
+# lands mid-round with pushes in flight to the doomed server, so SOME
+# worker always takes the channel-failure repair path.)
+parents = {h["args"]["span"]:
+           (by_span.get(h["args"].get("parent")) or {}).get("name")
+           for h in handoffs}
+assert set(parents.values()) <= {"kv.repair", "kv.refresh"}, parents
+assert "kv.repair" in parents.values(), parents
+traces = {e["args"]["trace"] for e in handoffs}
+flows = [e for e in m["traceEvents"] if e.get("cat") == "flow"
+         and e.get("ph") == "f" and e["id"].split(":")[0] in traces]
+assert flows, "handoff trace has no cross-process flow"
+print("elastic trace OK: handoff span + 3 phases under kv.repair, "
+      "%d flows in its trace" % len(flows))
+PY
 
 echo "== coordinator-failover smoke (SIGKILL server 0 mid-epoch, no restart)"
 # Same arithmetic contract, but the SIGKILL now lands on the
@@ -136,6 +178,35 @@ echo "== serving smoke (replica + dynamic batcher + live weight refresh)"
 JAX_PLATFORMS=cpu timeout -k 10 240 \
     python tools/launch.py -n 1 -s 1 \
     python tests/dist/dist_serving_smoke.py
+
+echo "== tracing smoke (spans on the wire + merged timeline + stats sweep)"
+# ISSUE 12's cluster-observability gate (docs/OBSERVABILITY.md): a
+# 2-worker/1-server launcher job with MXNET_TRACE=1 must (a) pass the
+# in-process stats sweep — kv.server_stats per server and
+# distributed.cluster_stats() returning every rank's counters — inside
+# dist_tracing_smoke.py, and (b) leave per-process span journals that
+# trace_merge --spans stitches into ONE chrome trace with spans from
+# >= 3 processes and >= 1 cross-process flow arrow (a worker-side kv op
+# linked to its server-side child span).  Time-boxed: a propagation
+# regression presents as a missing span/flow, a flush regression as an
+# empty journal.
+rm -rf /tmp/_trace_smoke && mkdir -p /tmp/_trace_smoke
+JAX_PLATFORMS=cpu MXNET_TRACE=1 MXNET_TRACE_DIR=/tmp/_trace_smoke \
+    timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 \
+    python tests/dist/dist_tracing_smoke.py
+python tools/trace_merge.py --spans /tmp/_trace_smoke \
+    -o /tmp/_trace_merged.json
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+m = json.load(open("/tmp/_trace_merged.json"))
+md = m["metadata"]
+pids = {e["pid"] for e in m["traceEvents"] if e.get("ph") == "X"}
+assert len(pids) >= 3, f"expected spans from >= 3 processes, got {pids}"
+assert md["cross_process_flows"] >= 1, md
+print("tracing smoke OK: %d spans, %d processes, %d flows"
+      % (md["spans"], len(pids), md["cross_process_flows"]))
+PY
 
 echo "== autotune smoke (stub-backend sweep: propose/measure/journal/promote)"
 # The measurement harness itself is CI-gated end to end on CPU

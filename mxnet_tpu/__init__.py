@@ -55,6 +55,7 @@ from . import module
 from . import module as mod
 from .module import Module, BaseModule
 from . import profiler
+from . import tracing
 from . import monitor
 from .monitor import Monitor
 from . import visualization

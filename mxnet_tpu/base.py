@@ -151,6 +151,26 @@ declare_env("MXNET_PROFILER_AUTOSTART", bool, False,
 declare_env("MXNET_PROFILER_XLA_LOGDIR", str, "",
             "directory for the XLA (xplane) device trace profiler "
             "start()/stop() also drives; empty = host events only")
+# -- cluster tracing (mxnet_tpu.tracing; docs/OBSERVABILITY.md) --------------
+declare_env("MXNET_TRACE", bool, False,
+            "master switch for Dapper-style span tracing: kvstore "
+            "request envelopes carry (trace_id, parent span) so "
+            "server-side handling becomes child spans of the worker-"
+            "side call; off (default) adds ZERO envelope bytes and "
+            "near-zero cost at every instrumentation site")
+declare_env("MXNET_TRACE_DIR", str, "",
+            "tracing: directory each process appends its span journal "
+            "to (<role>-<rank>.trace.jsonl, fsync'd, torn-line "
+            "tolerant); merge with tools/trace_merge.py --spans; "
+            "empty = in-memory ring only")
+declare_env("MXNET_TRACE_RING", int, 4096,
+            "tracing: bounded in-memory span ring per process (the "
+            "stats op and in-process tests read it; older spans fall "
+            "off — the file journal is the durable record)")
+declare_env("MXNET_TRACE_FLUSH_N", int, 32,
+            "tracing: spans buffered between flush+fsync of the trace "
+            "journal (a SIGKILL loses at most this many spans plus "
+            "one torn line, which the reader skips)")
 declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
             "host worker threads for the data pipeline")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19,

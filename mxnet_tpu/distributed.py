@@ -204,6 +204,59 @@ def coordinator_failovers() -> int:
     return total
 
 
+def cluster_stats(compact: bool = False) -> dict:
+    """One dict of cluster-wide observability counters
+    (docs/OBSERVABILITY.md): this process's own profiler snapshot under
+    ``workers[<rank>]``, every live parameter server's ``("stats",)``
+    reply under ``servers[<uri>]`` — swept through the same weakref
+    registry as :func:`num_dead_nodes`, so a GC'd store stops being
+    consulted — and ``stats_bank``, the newest-beat-wins merge of the
+    servers' last-known-counters banks, which still names members that
+    have DIED (the bank outlives eviction, like the elastic state
+    snapshots).  ``compact=True`` trims each entry to the transport
+    families (what bench.py banks into its one-line JSON row).
+
+    A server whose channel fails mid-sweep is skipped rather than
+    failing the whole sweep: its last-known counters are usually still
+    in the surviving servers' banks — that is the bank's whole point."""
+    from . import profiler as _prof
+    from . import tracing as _tr
+    _role, rank = _tr.role_rank()   # the shared DMLC-label derivation
+    out: dict = {
+        "workers": {str(rank): _prof.snapshot(compact=compact)},
+        "servers": {},
+        "stats_bank": {},
+    }
+    for obj in _live_sources():
+        conns = getattr(obj, "_conns", None)
+        server_stats = getattr(obj, "server_stats", None)
+        if conns is None or server_stats is None:
+            continue
+        for i, c in enumerate(list(conns)):
+            uri = str(getattr(c, "_uri", i))
+            if uri in out["servers"]:
+                continue
+            try:
+                st = server_stats(i)
+            except MXNetError:
+                continue   # dead mid-sweep: the bank below may cover it
+            if not isinstance(st, dict):
+                continue
+            bank = st.pop("stats_bank", None) or {}
+            if compact:
+                st = {k: st[k] for k in ("channel", "channel_bytes",
+                                         "wire", "server") if k in st}
+            out["servers"][uri] = st
+            for u, entry in bank.items():
+                if not isinstance(entry, dict):
+                    continue
+                prev = out["stats_bank"].get(u)
+                if prev is None or int(entry.get("beat_seq", 0)) >= \
+                        int(prev.get("beat_seq", 0)):
+                    out["stats_bank"][u] = entry
+    return out
+
+
 def shutdown() -> None:
     global _initialized
     if not _initialized:

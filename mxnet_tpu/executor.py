@@ -254,18 +254,34 @@ def drive_chunked_dist(num_steps, chunk_size, staleness, dispatch_chunk,
     Returns the FINAL round's pulled values — the server-authoritative
     weights at the sync point — or None when num_steps == 0."""
     import math
+    from . import tracing as _tr
     n_chunks = math.ceil(num_steps / chunk_size)
     pending = {}
     for j in range(n_chunks):
-        due = j - 1 - staleness
-        adopted = pending.pop(due).wait() if due in pending else None
-        lo = j * chunk_size
-        hi = min(num_steps, lo + chunk_size)
-        grads = dispatch_chunk(j, lo, hi, adopted)
-        pending[j] = ship_chunk(j, grads)
+        # one span per chunk: its children separate the scanned COMPUTE
+        # from the exposed wire (the _PullHandle's kv.wire_wait span
+        # lands under fused.adopt_wait, its kv.wire_round sibling shows
+        # the full overlapped round) — the overlap the driver buys
+        # becomes VISIBLE on the merged timeline, not just a percentage
+        # (docs/OBSERVABILITY.md)
+        with _tr.span("fused.chunk", cat="fused", args={"chunk": j}):
+            due = j - 1 - staleness
+            if due in pending:
+                with _tr.span("fused.adopt_wait", cat="fused",
+                              args={"due": due}):
+                    adopted = pending.pop(due).wait()
+            else:
+                adopted = None
+            lo = j * chunk_size
+            hi = min(num_steps, lo + chunk_size)
+            with _tr.span("fused.chunk_compute", cat="fused",
+                          args={"lo": lo, "hi": hi}):
+                grads = dispatch_chunk(j, lo, hi, adopted)
+            pending[j] = ship_chunk(j, grads)
     final = None
     for j in sorted(pending):
-        final = pending[j].wait()
+        with _tr.span("fused.drain_wait", cat="fused", args={"chunk": j}):
+            final = pending[j].wait()
     return final
 
 

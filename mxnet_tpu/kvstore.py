@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .ndarray import NDArray
 from . import optimizer as opt
+from . import tracing as _tr
 # canonical key coercion lives beside the wire protocol so worker-side
 # and server-side updater indexing can never diverge
 from .kvstore_server import _key_int as _key_int_impl
@@ -226,6 +227,18 @@ class KVStore:
         :func:`distributed.num_dead_nodes`)."""
         from . import distributed as _dist
         return _dist.num_dead_nodes()
+
+    def server_stats(self, rank: int = 0) -> dict:
+        """The profiler snapshot of "server" ``rank`` (docs/
+        OBSERVABILITY.md).  Store types with no server processes ARE
+        their own server: the local process's snapshot comes back, so
+        callers sweep uniformly across store types.  ``KVStoreDistAsync``
+        overrides this with the real ``("stats",)`` wire op."""
+        from . import profiler as _prof
+        if rank != 0:
+            raise MXNetError(
+                f"kvstore type {self.type!r} has no server rank {rank}")
+        return _prof.snapshot()
 
     def _send_command_to_servers(self, head, body):
         pass  # sync/allreduce types have no server processes
@@ -550,7 +563,7 @@ class _ServerConn:
         it with its original (client_id, seq)."""
         from .kvstore_server import _send_msg
         from . import faultinject
-        msg, pending = item
+        msg, pending, tctx = item
         if self._err is not None and self._sock is None:
             # hard transport poison: the channel is gone for good — fail
             # queued work instead of sending into nothing.  An
@@ -561,7 +574,20 @@ class _ServerConn:
             # pass silently" — only NEW enqueues are refused).
             self._fail_pending(pending, self._err)
             return
-        envelope = ("req", self._client_id, self._next_seq, msg)
+        if tctx is not None:
+            # trace propagation (mxnet_tpu.tracing): the optional 5th
+            # element carries (trace_id, parent span_id, send epoch-us)
+            # captured at ENQUEUE time on the caller's thread — the
+            # server opens a child span of the worker-side call.  The
+            # stamped envelope lives in the window, so a reconnect
+            # REPLAYS the same trace field: retries annotate the
+            # original trace instead of starting a new one.  With
+            # MXNET_TRACE=0 the envelope stays the classic 4-tuple —
+            # zero added wire bytes (pinned by tests/test_tracing.py).
+            envelope = ("req", self._client_id, self._next_seq, msg,
+                        (tctx[0], tctx[1], _tr.now_us()))
+        else:
+            envelope = ("req", self._client_id, self._next_seq, msg)
         self._next_seq += 1
         self._inflight.append([envelope, pending, False])
         try:
@@ -743,7 +769,8 @@ class _ServerConn:
         if self._err is not None:
             raise MXNetError(f"kvstore server channel failed: {self._err}")
         pending = _Pending()
-        self._enqueue((msg, pending))
+        self._enqueue((msg, pending,
+                       _tr.current_ctx() if _tr.enabled() else None))
         return pending
 
     def submit(self, msg, wait=False):
@@ -752,7 +779,8 @@ class _ServerConn:
             if self._err is not None:
                 raise MXNetError(
                     f"kvstore server channel failed: {self._err}")
-            self._enqueue((msg, None))
+            self._enqueue((msg, None,
+                           _tr.current_ctx() if _tr.enabled() else None))
             return None
         return _await(self.request(msg))
 
@@ -833,13 +861,18 @@ class _PullHandle:
     fused-dist driver is regression-gated on.  Idempotent: a second
     ``wait()`` returns the cached result without re-counting."""
 
-    __slots__ = ("_kv", "_reqs", "_t0", "_result")
+    __slots__ = ("_kv", "_reqs", "_t0", "_t0_ns", "_ctx", "_result")
 
     def __init__(self, kv, reqs):
         import time
         self._kv = kv
         self._reqs = reqs
         self._t0 = time.monotonic()
+        # the enqueue site's span context anchors the ROUND span: the
+        # full enqueue->resolved interval crosses threads/chunks, so it
+        # cannot ride the thread-local stack
+        self._t0_ns = time.monotonic_ns() if _tr.enabled() else 0
+        self._ctx = _tr.current_ctx() if _tr.enabled() else None
         self._result = None
 
     def wait(self):
@@ -848,18 +881,32 @@ class _PullHandle:
         import time
         from . import profiler as _prof
         t_wait = time.monotonic()
-        vals = {}
-        for k, pending in self._reqs:
-            if isinstance(pending, list):
-                val = np.concatenate(
-                    [np.asarray(_await(p)) for p in pending], axis=0)
-            else:
-                val = np.asarray(_await(pending))
-            self._kv._cache_value(k, val)
-            vals[k] = val
+        sp = _tr.span_begin("kv.wire_wait", cat="wire")
+        try:
+            vals = {}
+            for k, pending in self._reqs:
+                if isinstance(pending, list):
+                    val = np.concatenate(
+                        [np.asarray(_await(p)) for p in pending], axis=0)
+                else:
+                    val = np.asarray(_await(pending))
+                self._kv._cache_value(k, val)
+                vals[k] = val
+        finally:
+            # end even when a channel failure raises out of _await: a
+            # leaked open span would stay on the thread-local stack and
+            # mis-parent every later span on this thread
+            _tr.span_end(sp, args={"keys": len(self._reqs)})
         t1 = time.monotonic()
         _prof.record_wire_wait(t1 - t_wait)
         _prof.record_wire_round(t1 - self._t0)
+        if self._t0_ns:
+            # the overlap the fused driver buys becomes VISIBLE: the
+            # round span (enqueue->resolved) sits over the wire_wait
+            # span (the exposed residue) on the merged timeline
+            _tr.add_span("kv.wire_round", self._t0_ns,
+                         time.monotonic_ns(), cat="wire", ctx=self._ctx,
+                         args={"keys": len(self._reqs)})
         self._result = vals
         return vals
 
@@ -1106,6 +1153,14 @@ class KVStoreDistAsync(KVStore):
                     raise
 
     def _elastic_repair(self) -> bool:
+        """Span-wrapped entry: a repair episode (and the handoff inside
+        it) shows up on the merged cluster timeline as one
+        ``kv.repair`` span — the observable form of "this worker rode a
+        roster bump" (docs/OBSERVABILITY.md)."""
+        with _tr.span("kv.repair", cat="elastic"):
+            return self._elastic_repair_impl()
+
+    def _elastic_repair_impl(self) -> bool:
         """Converge this worker onto the live roster after a failure.
         Returns True when anything changed (retry is worth it): a
         generation bump was applied, or a poisoned-but-alive connection
@@ -1196,10 +1251,12 @@ class KVStoreDistAsync(KVStore):
     def _elastic_refresh(self):
         """Pull the roster and converge if it moved (the cheap path a
         barrier-reply generation bump triggers)."""
-        reply = self._coordinator_conn().submit(("roster_get",), wait=True)
-        gen, servers, workers = reply
-        if int(gen) != self._roster_gen:
-            self._apply_roster(int(gen), servers, workers)
+        with _tr.span("kv.refresh", cat="elastic"):
+            reply = self._coordinator_conn().submit(("roster_get",),
+                                                    wait=True)
+            gen, servers, workers = reply
+            if int(gen) != self._roster_gen:
+                self._apply_roster(int(gen), servers, workers)
 
     def _apply_roster(self, gen, servers, workers):
         """Converge onto roster generation ``gen``: rebuild the
@@ -1306,45 +1363,66 @@ class KVStoreDistAsync(KVStore):
         from . import profiler as _prof
         gen = self._roster_gen
         servers = self._roster_servers
-        # gather old-layout optimizer state BEFORE any value handoff is
-        # issued: the first value handoff of a key PURGES its stale wire
-        # forms (and their states) on the survivors — collecting after
-        # would read back nothing
-        per_wire = self._collect_handoff_states(moved, old_servers)
-        pendings = []
-        for k in moved:
-            val = self._pull_cache.get(k)
-            if val is None:
-                continue
-            for wk, uri, part in _mem.restripe_value(
-                    k, val, servers, self._bigarray_bound):
-                part = np.ascontiguousarray(part)
-                _prof.record_channel_bytes("handoff", int(part.nbytes))
-                pendings.append(self._conns[servers.index(uri)].request(
-                    ("handoff", gen, wk, part, k)))
-        if per_wire:
-            for k in moved:
-                shape = self._pull_cache[k].shape
-                old_plan = _mem.stripe_plan(k, shape, len(old_servers),
-                                            self._bigarray_bound)
-                new_plan = _mem.stripe_plan(k, shape, len(servers),
-                                            self._bigarray_bound)
-                restriped = _mem.restripe_states(k, per_wire, old_plan,
-                                                 new_plan)
-                layout = _mem.wire_layout(k, shape, servers,
-                                          self._bigarray_bound)
-                for wk, st in restriped.items():
-                    uri = layout[wk][0]
-                    pendings.append(
-                        self._conns[servers.index(uri)].request(
-                            ("handoff_state", gen, wk, st, k)))
-        for p in pendings:
-            _await(p)
-        _prof.record_channel_event("kvstore.handoff_round")
-        for k in moved:
-            for grad in self._push_log.get(k, []):
-                _prof.record_channel_event("kvstore.orphan_repush")
-                self._route_push(k, grad)
+        # The whole handoff — and each of its three protocol phases —
+        # is a span, so a roster bump's repair window reads off the
+        # merged cluster timeline instead of only off the
+        # failover_rebuild_s gauge (docs/OBSERVABILITY.md).  The wire
+        # behavior is UNCHANGED: values and states all enqueue before
+        # any await (max pipelining); the shared await of phases 1+2
+        # completes inside the states span, and phase 3 still starts
+        # only after it.
+        hsp = _tr.span_begin("kv.handoff", cat="elastic",
+                             args={"moved": len(moved),
+                                   "generation": int(gen)})
+        try:
+            # gather old-layout optimizer state BEFORE any value handoff
+            # is issued: the first value handoff of a key PURGES its
+            # stale wire forms (and their states) on the survivors —
+            # collecting after would read back nothing
+            with _tr.span("handoff.collect", cat="elastic"):
+                per_wire = self._collect_handoff_states(moved, old_servers)
+            pendings = []
+            with _tr.span("handoff.values", cat="elastic"):
+                for k in moved:
+                    val = self._pull_cache.get(k)
+                    if val is None:
+                        continue
+                    for wk, uri, part in _mem.restripe_value(
+                            k, val, servers, self._bigarray_bound):
+                        part = np.ascontiguousarray(part)
+                        _prof.record_channel_bytes("handoff",
+                                                   int(part.nbytes))
+                        pendings.append(
+                            self._conns[servers.index(uri)].request(
+                                ("handoff", gen, wk, part, k)))
+            with _tr.span("handoff.states", cat="elastic"):
+                if per_wire:
+                    for k in moved:
+                        shape = self._pull_cache[k].shape
+                        old_plan = _mem.stripe_plan(
+                            k, shape, len(old_servers),
+                            self._bigarray_bound)
+                        new_plan = _mem.stripe_plan(
+                            k, shape, len(servers), self._bigarray_bound)
+                        restriped = _mem.restripe_states(
+                            k, per_wire, old_plan, new_plan)
+                        layout = _mem.wire_layout(k, shape, servers,
+                                                  self._bigarray_bound)
+                        for wk, st in restriped.items():
+                            uri = layout[wk][0]
+                            pendings.append(
+                                self._conns[servers.index(uri)].request(
+                                    ("handoff_state", gen, wk, st, k)))
+                for p in pendings:
+                    _await(p)
+            _prof.record_channel_event("kvstore.handoff_round")
+            with _tr.span("handoff.repush", cat="elastic"):
+                for k in moved:
+                    for grad in self._push_log.get(k, []):
+                        _prof.record_channel_event("kvstore.orphan_repush")
+                        self._route_push(k, grad)
+        finally:
+            _tr.span_end(hsp)
 
     def _collect_handoff_states(self, moved, old_servers):
         """{old wire key: np state} for the moved keys: the departed
@@ -1422,7 +1500,8 @@ class KVStoreDistAsync(KVStore):
     def init(self, key, value):
         """First-arriving init wins at the server (all workers call init;
         the server keeps one authoritative value)."""
-        self._elastic_attempt(lambda: self._init_impl(key, value))
+        with _tr.span("kv.init"):
+            self._elastic_attempt(lambda: self._init_impl(key, value))
 
     def _init_impl(self, key, value):
         keys, values = self._canon(key, value)
@@ -1475,9 +1554,10 @@ class KVStoreDistAsync(KVStore):
         skipped, because the repair already re-pushed them from the push
         log."""
         keys, values = self._canon(key, value)
-        self._push_aggregated(
-            [(k, np.asarray(self._reduce(vs)))
-             for k, vs in zip(keys, values)])
+        with _tr.span("kv.push", args={"keys": len(keys)}):
+            self._push_aggregated(
+                [(k, np.asarray(self._reduce(vs)))
+                 for k, vs in zip(keys, values)])
 
     def _push_aggregated(self, pairs):
         """Plan and submit one push round of already-reduced HOST
@@ -1557,7 +1637,8 @@ class KVStoreDistAsync(KVStore):
         when this returns, every later ``pull`` observes the value (the
         serving version-bump publication contract).  Idempotent, so the
         elastic path may retry it whole after a roster repair."""
-        self._elastic_attempt(lambda: self._assign_impl(key, value))
+        with _tr.span("kv.assign"):
+            self._elastic_attempt(lambda: self._assign_impl(key, value))
 
     def _assign_impl(self, key, value):
         keys, values = self._canon(key, value)
@@ -1585,8 +1666,9 @@ class KVStoreDistAsync(KVStore):
         (the reference gets the same overlap from engine-async ZPull);
         striped keys fetch every row-slice concurrently.  Idempotent —
         the elastic path retries it whole after a roster repair."""
-        self._elastic_attempt(
-            lambda: self._pull_impl(key, out, ignore_sparse))
+        with _tr.span("kv.pull"):
+            self._elastic_attempt(
+                lambda: self._pull_impl(key, out, ignore_sparse))
 
     def _pull_impl(self, key, out, ignore_sparse):
         import jax.numpy as jnp
@@ -1632,11 +1714,13 @@ class KVStoreDistAsync(KVStore):
         the eager loop ships — with the small same-server keys of each
         step coalescing into one envelope, then enqueue the next
         non-blocking pull and return its handle."""
-        for s in range(grads_np[0].shape[0]):
-            self._push_aggregated(
-                [(n, np.ascontiguousarray(g[s]))
-                 for n, g in zip(names, grads_np)])
-        return self.pull_async(list(names), list(shapes))
+        with _tr.span("kv.ship_chunk",
+                      args={"steps": int(grads_np[0].shape[0])}):
+            for s in range(grads_np[0].shape[0]):
+                self._push_aggregated(
+                    [(n, np.ascontiguousarray(g[s]))
+                     for n, g in zip(names, grads_np)])
+            return self.pull_async(list(names), list(shapes))
 
     def pull_async(self, keys, shapes):
         """Enqueue a batched pull of ``keys`` and return a
@@ -1674,8 +1758,9 @@ class KVStoreDistAsync(KVStore):
         kvstore_dist_server.h:211).  Same out-array semantics as the
         local store: RowSparseNDArray gets values+indices, dense gets a
         scatter.  Requests pipeline like pull."""
-        self._elastic_attempt(
-            lambda: self._row_sparse_pull_impl(key, out, row_ids))
+        with _tr.span("kv.row_sparse_pull"):
+            self._elastic_attempt(
+                lambda: self._row_sparse_pull_impl(key, out, row_ids))
 
     def _row_sparse_pull_impl(self, key, out, row_ids):
         import jax.numpy as jnp
@@ -1841,24 +1926,26 @@ class KVStoreDistAsync(KVStore):
         so a failover can never skew the workers' barrier pairing."""
         # the flush is idempotent (a no-op command per channel), so a
         # channel death here repairs and retries cleanly
-        self._elastic_attempt(self._flush_all)
-        self._barrier_seq += 1
-        bseq = self._barrier_seq
-        payload = self._elastic_attempt(
-            lambda: self._coordinator_conn().submit(("barrier", bseq),
-                                                    wait=True))
-        if isinstance(payload, (tuple, list)) and len(payload) == 2:
-            # the coordinator realigned this (re-)joined rank to the
-            # cohort's pending rendezvous: adopt the effective sequence
-            # so every later raw sequence is globally aligned again
-            payload, realign = payload
-            self._barrier_seq = bseq + int(realign)
-        if self._elastic and isinstance(payload, int) \
-                and payload != self._roster_gen:
-            # the refresh rides the repair wrapper too: the coordinator
-            # can die in the reply-to-refresh window, and that death is
-            # as survivable as any other
-            self._elastic_attempt(self._elastic_refresh)
+        with _tr.span("kv.barrier"):
+            self._elastic_attempt(self._flush_all)
+            self._barrier_seq += 1
+            bseq = self._barrier_seq
+            payload = self._elastic_attempt(
+                lambda: self._coordinator_conn().submit(("barrier", bseq),
+                                                        wait=True))
+            if isinstance(payload, (tuple, list)) and len(payload) == 2:
+                # the coordinator realigned this (re-)joined rank to the
+                # cohort's pending rendezvous: adopt the effective
+                # sequence so every later raw sequence is globally
+                # aligned again
+                payload, realign = payload
+                self._barrier_seq = bseq + int(realign)
+            if self._elastic and isinstance(payload, int) \
+                    and payload != self._roster_gen:
+                # the refresh rides the repair wrapper too: the
+                # coordinator can die in the reply-to-refresh window, and
+                # that death is as survivable as any other
+                self._elastic_attempt(self._elastic_refresh)
 
     def _flush_all(self):
         for c in self._conns:
@@ -1870,6 +1957,20 @@ class KVStoreDistAsync(KVStore):
         if self._closed:
             return 0
         return sum(1 for c in self._conns if c.is_dead())
+
+    def server_stats(self, rank: int = 0) -> dict:
+        """The full profiler snapshot of server ``rank`` over the wire —
+        the universal ``("stats",)`` envelope every KVStoreServer
+        answers (kvstore_server._stats_payload: dispatch/host-sync/
+        channel counts, gauges, byte counters, latency rings, roster
+        generation, and the coordinator's last-known-stats bank of dead
+        peers).  ``distributed.cluster_stats()`` sweeps this across
+        every live server."""
+        if not 0 <= rank < len(self._conns):
+            raise MXNetError(
+                f"server rank {rank} out of range "
+                f"(live servers: {len(self._conns)})")
+        return self._conns[rank].submit(("stats",), wait=True)
 
     def close(self, stop_servers=False):
         from .kvstore_server import K_STOP_SERVER

@@ -45,6 +45,7 @@ import numpy as np
 
 from . import faultinject
 from . import profiler as _prof
+from . import tracing as _tr
 from .base import env as _env
 from .compression import WirePayload, decompress as _decompress
 
@@ -428,6 +429,13 @@ class KVStoreServer:
         # recovery source no longer dies with server 0; promoted into
         # the rebuilt ledger on failover.
         self._peer_snapshots = {}
+        # peer stats bank: uri -> (beat seq, compact profiler counters).
+        # Beats piggyback profiler.snapshot(compact=True), banked on
+        # EVERY server with the same newest-seq-wins rule as snapshots —
+        # so the last-known counters of a SIGKILLed member survive its
+        # death (and the coordinator's death) and ride the "stats"
+        # envelope's stats_bank field (docs/OBSERVABILITY.md)
+        self._peer_stats = {}
         self._promoted = False        # this server succeeded a dead coord
         self._coord_last_ok = None    # last successful coordinator beat
         self._coord_refused = False   # last coordinator dial was refused
@@ -448,7 +456,7 @@ class KVStoreServer:
         built-in ops; core op names are reserved."""
         if op in ("ping", "init", "push", "push_multi", "pull",
                   "pull_rows", "assign", "get_states", "set_states",
-                  "command", "barrier", "req", "roster_get",
+                  "command", "barrier", "req", "stats", "roster_get",
                   "roster_join", "roster_leave", "roster_dead",
                   "roster_beat", "roster_snapshot", "handoff",
                   "handoff_state", "ledger_report", "roster_fwd"):
@@ -471,7 +479,11 @@ class KVStoreServer:
             if stored is None:
                 raise KeyError(f"push to uninitialized key {key!r}")
             if self._updater is not None:
-                self._updater(_key_int(key), grad, stored)
+                # child of the srv.push envelope span: on the merged
+                # timeline the optimizer apply separates from
+                # decode/lock time (docs/OBSERVABILITY.md)
+                with _tr.span("srv.updater_apply", cat="server"):
+                    self._updater(_key_int(key), grad, stored)
             else:
                 stored._set_data(grad._data)
 
@@ -584,6 +596,13 @@ class KVStoreServer:
         if op == "barrier":
             return self._barrier(rank, msg[1] if len(msg) > 1 else None,
                                  client=client)
+        if op == "stats":
+            # the universal observability envelope: EVERY server (and
+            # every subclass — the serving replica generalizes its old
+            # serving_stats through this) answers with the full
+            # profiler snapshot plus server identity and the last-
+            # known-stats bank of its peers (docs/OBSERVABILITY.md)
+            return self._stats_payload()
         if op == "roster_get":
             return self._roster_op(("roster_get",))
         if op in ("roster_join", "roster_leave", "roster_dead"):
@@ -602,12 +621,15 @@ class KVStoreServer:
             # the coordinator — and the coordinator's reply carries the
             # full roster so peers track the membership they may one
             # day have to rebuild.
-            _, suri, seq, snap = msg
+            _, suri, seq, snap = msg[:4]
+            stats = msg[4] if len(msg) > 4 else None
             self._bank_peer_snapshot(suri, seq, snap)
+            if stats is not None:
+                self._bank_peer_stats(suri, seq, stats)
             m = self._get_membership()
             if m is None:
                 return None
-            m.note_server_beat(suri, seq=seq, snapshot=snap)
+            m.note_server_beat(suri, seq=seq, snapshot=snap, stats=stats)
             return m.roster().as_wire()
         if op == "roster_snapshot":
             # serve from the ledger bank OR the local peer bank: the
@@ -641,6 +663,31 @@ class KVStoreServer:
         raise ValueError(f"unknown op {op!r}")
 
     # -- exactly-once delivery ----------------------------------------------
+    def _traced_exactly_once(self, cid, seq, inner, wctx):
+        """The exactly-once path under a server-side span.  ``wctx`` is
+        the envelope's optional trace field ``(trace_id, parent
+        span_id, client send epoch-us)``: with it the span is a CHILD
+        of the worker-side call — and a REPLAYED envelope carries the
+        original field, so reconnects annotate the same trace; with
+        tracing on but an untraced client the span roots fresh.  The
+        send stamp rides into span args for the merge tool's
+        clock-offset estimate (tools/trace_merge.py --spans)."""
+        if not _tr.enabled():
+            return self._exactly_once(cid, seq, inner)
+        op = inner[0] if isinstance(inner, (tuple, list)) and inner \
+            else "?"
+        args = None
+        if wctx is not None and len(wctx) > 2:
+            args = {"client_send_us": float(wctx[2])}
+        sp = _tr.span_begin(
+            "srv.%s" % op, cat="server",
+            ctx=(wctx[0], wctx[1]) if wctx is not None else None,
+            args=args)
+        try:
+            return self._exactly_once(cid, seq, inner)
+        finally:
+            _tr.span_end(sp)
+
     def _exactly_once(self, client_id, seq, inner):
         """Serve one enveloped request with at-most-once application.
 
@@ -667,6 +714,11 @@ class KVStoreServer:
                 self._dedup_cv.wait(0.1)
             if seq in st["replies"]:
                 self.dedup_count += 1
+                # a replayed envelope served from cache: mark it on the
+                # trace — the replay carries the ORIGINAL trace field,
+                # so this instant lands in the original trace, proving
+                # the reconnect was absorbed idempotently
+                _tr.instant("srv.dedup_hit", args={"seq": seq})
                 return st["replies"][seq]
             st["inflight"].add(seq)
         rank = cid[0] if isinstance(cid, tuple) and cid else None
@@ -950,32 +1002,44 @@ class KVStoreServer:
         t0 = time.monotonic()
         if self._promoted:
             return
-        # the sweep dials peers with real socket timeouts: run it
-        # BEFORE taking the ledger lock, or every _get_membership()
-        # caller (barrier arrivals included) would stall behind the
-        # promotion's network round trips.  Racing promoters both
-        # sweep; the lock below picks one winner.
-        uris = [u for u in self._roster_uris() if u not in dead_uris]
-        reports = [self._ledger_report(slim=True)]
-        for u in uris:
-            if u == self.uri:
-                continue
-            r = self._sweep_ledger_report(u)
-            if r is not None:
-                reports.append(r)
-        workers = self._known_workers
-        if workers is None:
-            workers = range(self.num_workers)
-        with self._lock:
-            snapshots = dict(self._peer_snapshots)
-        with self._membership_lock:
-            if self._promoted:
-                return
-            self._membership = _mem.rebuild_ledger(
-                uris, workers, reports, snapshots)
-            self._promoted = True
-            self._known_roster = list(uris)
-            self._known_gen = self._membership.generation
+        # the failover_rebuild_s gauge, as a SPAN with its two halves as
+        # children: the peer sweep (network round trips) vs the pure
+        # ledger rebuild — on the merged timeline the rebuild window
+        # sits between the dead coordinator's last span and the first
+        # post-succession barrier release (docs/OBSERVABILITY.md)
+        fsp = _tr.span_begin("srv.failover_rebuild", cat="elastic",
+                             args={"dead": sorted(dead_uris)})
+        try:
+            # the sweep dials peers with real socket timeouts: run it
+            # BEFORE taking the ledger lock, or every _get_membership()
+            # caller (barrier arrivals included) would stall behind the
+            # promotion's network round trips.  Racing promoters both
+            # sweep; the lock below picks one winner.
+            uris = [u for u in self._roster_uris() if u not in dead_uris]
+            with _tr.span("failover.sweep", cat="elastic"):
+                reports = [self._ledger_report(slim=True)]
+                for u in uris:
+                    if u == self.uri:
+                        continue
+                    r = self._sweep_ledger_report(u)
+                    if r is not None:
+                        reports.append(r)
+            workers = self._known_workers
+            if workers is None:
+                workers = range(self.num_workers)
+            with self._lock:
+                snapshots = dict(self._peer_snapshots)
+            with _tr.span("failover.rebuild", cat="elastic"):
+                with self._membership_lock:
+                    if self._promoted:
+                        return
+                    self._membership = _mem.rebuild_ledger(
+                        uris, workers, reports, snapshots)
+                    self._promoted = True
+                    self._known_roster = list(uris)
+                    self._known_gen = self._membership.generation
+        finally:
+            _tr.span_end(fsp)
         faultinject.note_coordinator(True)
         _prof.record_channel_event("kvstore.coordinator_failover")
         _prof.record_channel_gauge("kvstore.coordinator_slot",
@@ -1058,6 +1122,52 @@ class KVStoreServer:
         from .membership import bank_newest
         with self._lock:
             bank_newest(self._peer_snapshots, uri, seq, snap)
+
+    def _bank_peer_stats(self, uri, seq, stats):
+        """Bank one peer's piggybacked counter snapshot (same
+        newest-seq-wins rule as state snapshots; served by the "stats"
+        envelope's stats_bank field)."""
+        from .membership import bank_newest
+        with self._lock:
+            bank_newest(self._peer_stats, uri, seq, stats)
+
+    def _stats_payload(self):
+        """The ``("stats",)`` reply: the FULL profiler snapshot
+        (dispatch/host-sync/channel counts, gauges, byte counters,
+        latency rings, tracing state — profiler.snapshot is the one
+        source every consumer shares) plus this server's identity and
+        its last-known-stats bank of peers, which OUTLIVES any member's
+        death the way the state-snapshot bank does.  Subclasses extend
+        rather than replace (the serving replica adds its serving
+        section on top)."""
+        snap = _prof.snapshot()
+        m = self._membership   # peek — never force-create the ledger
+        snap["server"] = {
+            "server_id": self.server_id,
+            "uri": self.uri,
+            "num_workers": self.num_workers,
+            "dedup_count": self.dedup_count,
+            "elastic": self._elastic,
+            "coordinator": self._is_coordinator() if self._elastic
+            else False,
+            "beat_seq": int(self._beat_seq),
+            "roster_generation": int(
+                m.generation if m is not None else self._known_gen),
+        }
+        with self._lock:
+            snap["stats_bank"] = {
+                u: dict(entry[1], beat_seq=int(entry[0]))
+                for u, entry in self._peer_stats.items()
+                if isinstance(entry[1], dict)}
+        if m is not None:
+            # the ledger's bank (grown from beats the coordinator saw,
+            # preloaded across failovers) backfills peers this server's
+            # local bank never heard from
+            for u, entry in m.stats_bank().items():
+                if isinstance(entry[1], dict):
+                    snap["stats_bank"].setdefault(
+                        u, dict(entry[1], beat_seq=int(entry[0])))
+        return snap
 
     def _note_roster_wire(self, payload):
         """Digest a beat reply carrying the live roster (only
@@ -1413,36 +1523,47 @@ class KVStoreServer:
             self._barrier_high[rank] = max(
                 self._barrier_high.get(rank, 0), bseq)
             self._barrier_release_locked()
-            while not self._barrier_released(rank, bseq) \
-                    and not self._stop.is_set():
-                self._barrier_cv.wait(0.1)
-                if self._barrier_released(rank, bseq) \
-                        or self._stop.is_set():
-                    break
-                live = self._barrier_target_ranks()
-                waiting_for = {r for r in live
-                               if self._barrier_high.get(r, 0) < bseq}
-                silent = self._silent_ranks() & waiting_for
-                if not silent:
-                    continue
-                if m is not None:
-                    for r in sorted(silent):
-                        m.evict_worker(r)
-                        self._forget_barrier_rank(r)
-                        _prof.record_channel_event(
-                            "kvstore.worker_eviction")
-                    _prof.record_channel_gauge(
-                        "kvstore.roster_generation", m.generation)
-                    self._barrier_release_locked()
-                    continue
-                arrived = sorted(
-                    r for r in live
-                    if self._barrier_high.get(r, 0) >= bseq)
-                ages = self._heartbeat_ages(silent)
-                raise RuntimeError(
-                    "barrier timed out: worker rank(s) %s missing "
-                    "(no heartbeat for > %.1fs; %s); arrived rank(s): %s"
-                    % (sorted(silent), self._hb_timeout, ages, arrived))
+            # the park (arrival -> release) is a span nested under the
+            # srv.barrier envelope span: on the merged timeline the
+            # rendezvous skew between ranks — and a renegotiation's
+            # eviction window — reads directly off the park widths
+            park = _tr.span_begin("srv.barrier_park", cat="server",
+                                  args={"rank": rank, "bseq": bseq})
+            try:
+                while not self._barrier_released(rank, bseq) \
+                        and not self._stop.is_set():
+                    self._barrier_cv.wait(0.1)
+                    if self._barrier_released(rank, bseq) \
+                            or self._stop.is_set():
+                        break
+                    live = self._barrier_target_ranks()
+                    waiting_for = {r for r in live
+                                   if self._barrier_high.get(r, 0) < bseq}
+                    silent = self._silent_ranks() & waiting_for
+                    if not silent:
+                        continue
+                    if m is not None:
+                        for r in sorted(silent):
+                            m.evict_worker(r)
+                            self._forget_barrier_rank(r)
+                            _prof.record_channel_event(
+                                "kvstore.worker_eviction")
+                        _prof.record_channel_gauge(
+                            "kvstore.roster_generation", m.generation)
+                        self._barrier_release_locked()
+                        continue
+                    arrived = sorted(
+                        r for r in live
+                        if self._barrier_high.get(r, 0) >= bseq)
+                    ages = self._heartbeat_ages(silent)
+                    raise RuntimeError(
+                        "barrier timed out: worker rank(s) %s missing "
+                        "(no heartbeat for > %.1fs; %s); "
+                        "arrived rank(s): %s"
+                        % (sorted(silent), self._hb_timeout, ages,
+                           arrived))
+            finally:
+                _tr.span_end(park)
             payload = self._barrier_payload()
             return (payload, realign) if realign else payload
 
@@ -1512,6 +1633,12 @@ class KVStoreServer:
                         last_snap is None
                         or now - last_snap >= self._snapshot_s):
                     snap = self._snapshot_struct()
+                # every beat piggybacks this server's compact counter
+                # snapshot (channel counts/gauges/bytes, wire clocks):
+                # peers bank it newest-seq-wins, so the cluster holds a
+                # last-known-stats view of every member that survives
+                # its SIGKILL (docs/OBSERVABILITY.md stats bank)
+                beat_stats = _prof.snapshot(compact=True)
                 sent_snap = False
                 for uri in list(self._roster_uris()):
                     if uri == self.uri:
@@ -1527,7 +1654,8 @@ class KVStoreServer:
                             sock.settimeout(self._hb_timeout or 15.0)
                             socks[uri] = sock
                         _send_msg(sock, ("roster_beat", self.uri,
-                                         self._beat_seq, snap))
+                                         self._beat_seq, snap,
+                                         beat_stats))
                         status, payload = _recv_msg(sock)
                         if status == "ok":
                             if snap is not None:
@@ -1628,10 +1756,14 @@ class KVStoreServer:
                     except (ConnectionError, OSError):
                         return
                     if msg and msg[0] == "req":
-                        # client envelope: (op, client_id, seq, inner) —
-                        # the exactly-once path (reconnect + replay)
-                        _, cid, seq, inner = msg
-                        reply = self._exactly_once(cid, seq, inner)
+                        # client envelope: (op, client_id, seq, inner
+                        # [, trace]) — the exactly-once path (reconnect
+                        # + replay); the optional 5th element is the
+                        # span context propagated from the worker
+                        _, cid, seq, inner = msg[:4]
+                        reply = self._traced_exactly_once(
+                            cid, seq, inner,
+                            msg[4] if len(msg) > 4 else None)
                         role = "server"
                     else:
                         # raw message (heartbeat pings, legacy callers):

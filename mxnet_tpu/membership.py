@@ -293,6 +293,12 @@ class MembershipCoordinator:
         self._workers = set(int(w) for w in workers)
         self._server_seen: Dict[str, float] = {}
         self._snapshots: Dict[str, tuple] = {}   # uri -> (seq, blob)
+        # last-known compact profiler counters per server, piggybacked
+        # on beats (kvstore_server beat loop) — same newest-seq-wins
+        # rule and same outlives-eviction contract as the state
+        # snapshots: the counters of a SIGKILLed member stay readable
+        # through the coordinator's "stats" envelope
+        self._stats: Dict[str, tuple] = {}       # uri -> (seq, counters)
         self.evictions = 0
         self.failovers = 0   # ledgers this one succeeded (rebuild_ledger)
 
@@ -366,11 +372,12 @@ class MembershipCoordinator:
 
     # -- server liveness + state snapshots -----------------------------------
     def note_server_beat(self, uri: str, seq: Optional[int] = None,
-                         snapshot=None) -> None:
+                         snapshot=None, stats=None) -> None:
         with self._lock:
             if uri in self._servers:
                 self._server_seen[uri] = time.monotonic()
             bank_newest(self._snapshots, uri, seq, snapshot)
+            bank_newest(self._stats, uri, seq, stats)
 
     def preload_snapshot(self, uri: str, seq: int, snapshot) -> None:
         """Seed the snapshot bank without touching liveness — the
@@ -388,6 +395,22 @@ class MembershipCoordinator:
         with self._lock:
             have = self._snapshots.get(uri)
             return None if have is None else have[1]
+
+    def stats_of(self, uri: str):
+        """The last compact counter snapshot a (possibly now-dead)
+        server piggybacked on a beat, or None.  Outlives eviction like
+        :meth:`snapshot_of` — the forensic record of what a killed
+        member was doing when it died."""
+        with self._lock:
+            have = self._stats.get(uri)
+            return None if have is None else have[1]
+
+    def stats_bank(self) -> Dict[str, tuple]:
+        """The whole stats bank, ``{uri: (beat_seq, counters)}`` — what
+        the coordinator folds into its ``("stats",)`` reply so a
+        cluster sweep sees dead members' last-known counters too."""
+        with self._lock:
+            return dict(self._stats)
 
     def silent_servers(self, timeout: float) -> List[str]:
         """Non-coordinator servers heard from at least once and then

@@ -24,6 +24,7 @@ import time
 from typing import List, Optional
 
 from .base import MXNetError, env
+from . import tracing
 
 PROFILER_STATE_STOP = 0
 PROFILER_STATE_RUN = 1
@@ -174,6 +175,20 @@ def is_running():
 
 def record_event(name, start_us, dur_us, category="operator"):
     _profiler.record(name, start_us, dur_us, category)
+
+
+# -- span tracing (mxnet_tpu.tracing; docs/OBSERVABILITY.md) -----------------
+# The profiler's cross-process face: span_begin/span_end with a
+# thread-local current span, monotonic clocks, a bounded ring and the
+# MXNET_TRACE master switch all live in mxnet_tpu.tracing — re-exported
+# here so instrumentation sites (and the reference-shaped public
+# surface) reach them as profiler.span_begin(...) without a second
+# import.
+span = tracing.span
+span_begin = tracing.span_begin
+span_end = tracing.span_end
+trace_instant = tracing.instant
+trace_enabled = tracing.enabled
 
 
 # -- host-dispatch counters --------------------------------------------------
@@ -329,16 +344,32 @@ _wire = {"wait_s": 0.0, "round_s": 0.0, "rounds": 0}
 
 def record_wire_wait(dur_s: float):
     """Add host-blocked seconds spent waiting on an in-flight kvstore
-    pull (the exposed wire)."""
+    pull (the exposed wire).  Also emitted as a chrome-trace event
+    (category "wire") when the profiler is running, so a single-process
+    trace shows the wire stall next to the dispatches it blocked —
+    these clocks used to feed only the counters and never reached the
+    trace export."""
     with _wire_lock:
         _wire["wait_s"] += float(dur_s)
+    if _profiler.state == PROFILER_STATE_RUN:
+        dur_us = float(dur_s) * 1e6
+        _profiler.record("kvstore.wire_wait",
+                         time.perf_counter_ns() // 1000 - int(dur_us),
+                         dur_us, "wire")
 
 
 def record_wire_round(dur_s: float):
-    """Add one completed wire round's full enqueue->resolved seconds."""
+    """Add one completed wire round's full enqueue->resolved seconds
+    (chrome-trace event "wire" category when the profiler runs — see
+    record_wire_wait)."""
     with _wire_lock:
         _wire["round_s"] += float(dur_s)
         _wire["rounds"] += 1
+    if _profiler.state == PROFILER_STATE_RUN:
+        dur_us = float(dur_s) * 1e6
+        _profiler.record("kvstore.wire_round",
+                         time.perf_counter_ns() // 1000 - int(dur_us),
+                         dur_us, "wire")
 
 
 def wire_wait_ms() -> float:
@@ -398,6 +429,15 @@ def record_latency(kind: str, dur_s: float, ts: Optional[float] = None):
     injectable so the QPS arithmetic is testable without sleeping)."""
     if ts is None:
         ts = time.monotonic()
+    if _profiler.state == PROFILER_STATE_RUN:
+        # latency samples used to live only in the percentile ring and
+        # never reached the chrome-trace export; emit each completed
+        # request as a trace event so a single-process serving trace
+        # shows queue-wait + forward time per request
+        dur_us = float(dur_s) * 1e6
+        _profiler.record(kind,
+                         time.perf_counter_ns() // 1000 - int(dur_us),
+                         dur_us, "latency")
     with _latency_lock:
         st = _latency.get(kind)
         if st is None:
@@ -476,5 +516,88 @@ def scope(name, category="operator", require_mode=None):
     return _profiler.scope(name, category)
 
 
+# -- the universal snapshot ---------------------------------------------------
+def snapshot(compact: bool = False) -> dict:
+    """EVERY counter family in one plain-builtin dict — the single
+    source behind the kvstore ``("stats",)`` envelope
+    (kvstore_server._stats_payload), ``distributed.cluster_stats()``,
+    the elastic beat piggyback and ``python -m mxnet_tpu.profiler
+    --dump``, so no consumer can drift from another.
+
+    ``compact=True`` returns only the transport families (channel
+    counts/gauges, bytes, wire clocks) — the per-beat piggyback the
+    elastic stats bank accumulates; full counters since process start,
+    so a lost beat costs freshness, never correctness."""
+    out = {
+        "channel": channel_counts(),
+        "channel_bytes": channel_bytes(),
+        "wire": {
+            "wait_ms": wire_wait_ms(),
+            "round_ms": wire_round_ms(),
+            "rounds": wire_rounds(),
+            "overlap_pct": wire_overlap_pct(),
+        },
+    }
+    if compact:
+        return out
+    role, rank = tracing.role_rank()
+    out.update({
+        "pid": os.getpid(),
+        "role": role,
+        "rank": int(rank or 0),
+        "dispatch": dispatch_counts(),
+        "host_syncs": host_syncs(),
+        "host_sync_total": host_sync_total(),
+        "latency": {k: latency_stats(k) for k in latency_kinds()},
+        "trace": tracing.stats(),
+    })
+    return out
+
+
+def reset_all():
+    """Zero every counter family (the --reset CLI and test isolation;
+    the span FILE journal is append-only evidence and stays)."""
+    reset_dispatch_counts()
+    reset_host_syncs()
+    reset_channel_counts()
+    reset_channel_bytes()
+    reset_wire_counters()
+    reset_latency()
+    tracing.reset()
+
+
+def _main(argv=None) -> int:
+    """``python -m mxnet_tpu.profiler [--dump] [--reset]`` — the shell
+    face of :func:`snapshot` for scripts and chip runbooks: ``--dump``
+    (the default) prints the full snapshot as ONE JSON line (the same
+    one-line contract bench.py and the autotune executor parse);
+    ``--reset`` zeroes the counters first (combine both for a
+    read-and-rearm)."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.profiler",
+        description="dump/reset the mxnet_tpu profiler counter "
+                    "snapshot (docs/OBSERVABILITY.md)")
+    ap.add_argument("--dump", action="store_true",
+                    help="print the snapshot as one JSON line (default "
+                         "when --reset is not given)")
+    ap.add_argument("--reset", action="store_true",
+                    help="zero every counter family")
+    args = ap.parse_args(argv)
+    # dump BEFORE reset: the --dump --reset combination is
+    # read-and-rearm — print the accumulated counters, THEN zero them
+    # (the other order would print an empty snapshot and lose the data)
+    if args.dump or not args.reset:
+        print(json.dumps(snapshot(), sort_keys=True, default=str))
+    if args.reset:
+        reset_all()
+    return 0
+
+
 if env("MXNET_PROFILER_AUTOSTART", 0):
     profiler_set_state("run")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..base import MXNetError, env
 from .. import profiler as _prof
+from .. import tracing as _tr
 from .bucketed import _raw
 
 
@@ -42,7 +43,8 @@ class _ReplySlot:
     ``("ok"|"err", payload)`` tuple the connection writer sends when
     ``done`` fires."""
 
-    __slots__ = ("done", "reply", "data", "n", "t_enqueue", "sig", "role")
+    __slots__ = ("done", "reply", "data", "n", "t_enqueue", "sig", "role",
+                 "span")
 
     def __init__(self, data=None, n=0, sig=None):
         self.done = threading.Event()
@@ -51,6 +53,7 @@ class _ReplySlot:
         self.n = n
         self.sig = sig
         self.role = None     # fault-injection tag set by the conn loop
+        self.span = None     # detached srv.predict span (replica._admit)
         self.t_enqueue = time.monotonic()
 
     def complete(self, reply):
@@ -78,11 +81,15 @@ class DynamicBatcher:
         self._thread.start()
 
     # -- intake --------------------------------------------------------------
-    def submit(self, data) -> _ReplySlot:
+    def submit(self, data, span=None) -> _ReplySlot:
         """Admit one request; ALWAYS returns a slot (completed on the
         spot for BUSY/validation failures — the caller just forwards the
-        reply)."""
+        reply).  ``span`` (a detached tracing span, replica._admit)
+        must ride in HERE, before the slot is queued: attaching it
+        after submit would race the batcher thread, which annotates
+        the span with the request's queue wait at dispatch."""
         slot = _ReplySlot()
+        slot.span = span
         try:
             datas, n, sig = self._validate(data)
         except MXNetError as exc:
@@ -206,17 +213,38 @@ class DynamicBatcher:
     def _dispatch(self, slots):
         data = {name: np.concatenate([s.data[name] for s in slots], axis=0)
                 for name in slots[0].data}
+        t_batch = time.monotonic()
+        # the DEVICE half of a request's latency: queue-wait is
+        # (t_batch - slot.t_enqueue) per slot, everything inside this
+        # span is padded forward + readback.  Each parked slot's
+        # detached srv.predict span (replica._admit) spans the whole
+        # stay, so on the merged timeline queue time and device time
+        # separate per request (docs/OBSERVABILITY.md)
+        bsp = _tr.span_begin(
+            "serving.batch", cat="serving", detach=True,
+            args={"rows": int(sum(s.n for s in slots)),
+                  "slots": len(slots),
+                  "queue_wait_ms_max": round(
+                      (t_batch - min(s.t_enqueue for s in slots)) * 1e3,
+                      3)})
         try:
             version, outs = self._predictor.predict(data)
         except Exception as exc:  # noqa: BLE001 — fail THIS batch only
+            _tr.span_end(bsp, args={"error": type(exc).__name__})
             for slot in slots:
                 slot.complete(("err", f"{type(exc).__name__}: {exc}"))
             return
+        _tr.span_end(bsp)
         self.batches += 1
         lo = 0
         now = time.monotonic()
         for slot in slots:
             hi = lo + slot.n
+            if slot.span is not None:
+                slot.span.args = dict(
+                    slot.span.args or {},
+                    queue_wait_ms=round((t_batch - slot.t_enqueue) * 1e3,
+                                        3))
             slot.complete(("ok", ("result", version,
                                   [o[lo:hi] for o in outs])))
             # end-to-end request latency (queue wait + padded forward +

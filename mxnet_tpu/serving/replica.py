@@ -45,6 +45,7 @@ import numpy as np
 from ..base import MXNetError, env
 from ..kvstore_server import KVStoreServer, _send_msg, _recv_msg
 from .. import profiler as _prof
+from .. import tracing as _tr
 from .batcher import DynamicBatcher, _ReplySlot
 from .bucketed import BucketedPredictor
 
@@ -116,11 +117,12 @@ class ServingReplica(KVStoreServer):
         return list(self._predictor.buckets)
 
     # -- serving envelope handlers -------------------------------------------
-    def _dispatch_deferred(self, inner) -> _ReplySlot:
+    def _dispatch_deferred(self, inner, span=None) -> _ReplySlot:
         """Pipelined path: park the predict in the batcher, return the
-        reply slot the connection writer awaits."""
+        reply slot the connection writer awaits (``span`` attaches to
+        the slot BEFORE it is queued — see DynamicBatcher.submit)."""
         payload = inner[1] if len(inner) > 1 else None
-        return self._batcher.submit(payload)
+        return self._batcher.submit(payload, span=span)
 
     def _op_predict_sync(self, msg, rank):
         """Raw-message / legacy fallback: same batcher, awaited inline."""
@@ -158,6 +160,16 @@ class ServingReplica(KVStoreServer):
 
     def _op_refresh(self, msg, rank):
         return self._refresh_once()
+
+    def _stats_payload(self):
+        """The universal ``("stats",)`` envelope, serving-flavored: the
+        base server's full profiler snapshot plus the old
+        ``serving_stats`` dict under ``serving`` — one stats op for the
+        whole cluster, and ``serving_stats`` stays answering for
+        existing clients (it IS the ``serving`` section)."""
+        snap = super()._stats_payload()
+        snap["serving"] = self._op_stats(None, None)
+        return snap
 
     # -- weight refresh (live dist_async parameter servers) ------------------
     def _ps_client(self):
@@ -293,15 +305,30 @@ class ServingReplica(KVStoreServer):
         ops park in the batcher; everything else completes inline
         through the base server's exactly-once machinery."""
         if msg and msg[0] == "req":
-            _, cid, seq, inner = msg
+            _, cid, seq, inner = msg[:4]
+            wctx = msg[4] if len(msg) > 4 else None
             if inner and inner[0] in self._deferred_ops:
                 if isinstance(cid, (tuple, list)) and cid:
                     self._note_ping(cid[0])
-                slot = self._dispatch_deferred(inner)
+                # DETACHED span, begun BEFORE the batcher sees the slot
+                # (attaching after submit would race the batcher's
+                # queue-wait annotation) and ended by the reply writer
+                # once the slot completes — it covers the request's
+                # whole replica stay (queue wait + padded forward),
+                # child of the client-side call when the envelope
+                # carried a trace field
+                sp = None
+                if _tr.enabled():
+                    sp = _tr.span_begin(
+                        "srv.predict", cat="server", detach=True,
+                        ctx=(wctx[0], wctx[1]) if wctx else None,
+                        args=({"client_send_us": float(wctx[2])}
+                              if wctx and len(wctx) > 2 else None))
+                slot = self._dispatch_deferred(inner, span=sp)
                 slot.role = "server"
                 return slot
             cidt = tuple(cid) if isinstance(cid, list) else cid
-            reply = self._exactly_once(cidt, seq, inner)
+            reply = self._traced_exactly_once(cidt, seq, inner, wctx)
             return _CompletedSlot(reply, "server")
         try:
             reply = ("ok", self._handle(msg))
@@ -318,6 +345,7 @@ class ServingReplica(KVStoreServer):
                 if slot is None:
                     return
                 slot.done.wait()
+                _tr.span_end(getattr(slot, "span", None))
                 try:
                     _send_msg(conn, slot.reply,
                               fi_role=getattr(slot, "role", None))

@@ -1,0 +1,103 @@
+"""Cluster-tracing smoke: spans + the universal stats op across REAL
+process/socket boundaries under the launcher (docs/OBSERVABILITY.md).
+
+Run via:  MXNET_TRACE=1 MXNET_TRACE_DIR=<dir> \
+              python tools/launch.py -n 2 -s 1 \
+              python tests/dist/dist_tracing_smoke.py
+
+Each worker drives init/push/pull/barrier traffic through the
+dist_async wire with MXNET_TRACE=1, then asserts the observability
+contract in-process:
+
+* its own spans were recorded AND flushed to
+  ``MXNET_TRACE_DIR/worker-<rank>.trace.jsonl`` (fsync'd, readable);
+* ``kv.server_stats(rank)`` answers for every server with real
+  counters (recv bytes > 0 — the pushes it just absorbed);
+* ``distributed.cluster_stats()`` sweeps this worker + every live
+  server into one dict ("a stats sweep returning every rank's
+  counters").
+
+The MERGED-timeline half of the gate (spans from >= 3 processes, >= 1
+cross-process flow arrow) runs in ci/run_ci.sh AFTER the launcher
+exits, via ``tools/trace_merge.py --spans`` over the same trace dir —
+the server's journal is complete only once the launcher tears it down.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import tracing  # noqa: E402
+
+SHAPE = (4, 3)
+
+
+def main():
+    assert tracing.enabled(), \
+        "smoke must run with MXNET_TRACE=1 (the launcher propagates env)"
+    assert tracing.trace_file_path(), "smoke needs MXNET_TRACE_DIR"
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    nserver = int(os.environ["DMLC_NUM_SERVER"])
+
+    kv.init(f"w{rank}", mx.nd.zeros(SHAPE))
+    kv.push(f"w{rank}", mx.nd.ones(SHAPE) * (rank + 1))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(f"w{rank}", out=out)
+    np.testing.assert_array_equal(
+        out.asnumpy(), np.full(SHAPE, rank + 1, np.float32))
+    kv.barrier()
+
+    # -- spans: worker-side ops recorded, server children linked -------------
+    recs = tracing.ring_records()
+    names = {r["name"] for r in recs}
+    for expected in ("kv.init", "kv.push", "kv.pull", "kv.barrier"):
+        assert expected in names, (expected, sorted(names))
+    pull = [r for r in recs if r["name"] == "kv.pull"][0]
+    assert pull["role"] == "worker" and pull["rank"] == str(rank)
+
+    # -- the stats sweep: every server answers with real counters ------------
+    for sid in range(nserver):
+        st = kv.server_stats(sid)
+        assert st["server"]["server_id"] == sid, st["server"]
+        assert st["channel_bytes"].get("recv", 0) > 0, \
+            f"server {sid} shows no received bytes"
+        assert st["role"] == "server"
+    cs = mx.distributed.cluster_stats()
+    assert str(rank) in cs["workers"]
+    me = cs["workers"][str(rank)]
+    assert me["channel_bytes"].get("sent", 0) > 0
+    assert me["trace"]["recorded"] > 0
+    assert len(cs["servers"]) == nserver, sorted(cs["servers"])
+    for uri, st in cs["servers"].items():
+        assert st["server"]["uri"] == uri
+
+    # rendezvous BEFORE closing: the sweep above needs every server
+    # alive, and rank 0's stop_servers must not race a slower sweep
+    kv.barrier()
+
+    # -- journal flushed and readable ----------------------------------------
+    tracing.flush()
+    path = tracing.trace_file_path()
+    assert os.path.basename(path) == f"worker-{rank}.trace.jsonl"
+    flushed = tracing.read_trace_file(path)
+    assert any(r["name"] == "kv.pull" for r in flushed), \
+        "journal missing worker spans after flush"
+
+    kv.close(stop_servers=(rank == 0))
+    print(f"worker {rank}: tracing smoke OK "
+          f"({len(recs)} spans, {len(cs['servers'])} servers swept)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
