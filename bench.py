@@ -424,6 +424,7 @@ def _run(batch):
     # evidence for the gradient-compression win); 0 in single-process
     # configs.  See profiler.channel_bytes / docs/PERF_NOTES.md.
     from mxnet_tpu import profiler as _mx_prof
+    from mxnet_tpu import health as _mx_health
     wire0 = sum(_mx_prof.channel_bytes().values())
     sync0 = _mx_prof.host_sync_total()
     wait0 = _mx_prof.wire_wait_ms()
@@ -490,6 +491,12 @@ def _run(batch):
                   if os.environ.get("MXNET_BACKWARD_DO_MIRROR") == "1"
                   else False),
         "data_mode": os.environ.get("BENCH_DATA", "synthetic"),
+        # end-of-run health digest next to the perf numbers: watchdog
+        # trip counts and the worst SLO verdict the run saw — an
+        # UNHEALTHY run (stalled barrier, BUSY storm, dead node) is
+        # visible in BENCH_LOG.jsonl, not just slow
+        # (docs/OBSERVABILITY.md health section)
+        "health": _mx_health.summary(),
         # the topology this measurement belongs to — promotion keys
         # BENCH_DEFAULTS.json entries by it (autotune/promote.py)
         "topology": cfg["topology"],

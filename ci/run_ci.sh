@@ -85,12 +85,14 @@ kill_acks=$(MXT_PRINT_KILL_ACKS=1 python tests/dist/dist_elastic_membership.py)
 # servers — the ISSUE 12 acceptance timeline (docs/OBSERVABILITY.md).
 # The SIGKILLed server's journal is torn mid-append by design; the
 # merge must tolerate it.
-rm -rf /tmp/_trace_elastic && mkdir -p /tmp/_trace_elastic
+rm -rf /tmp/_trace_elastic /tmp/_health_elastic
+mkdir -p /tmp/_trace_elastic /tmp/_health_elastic
 JAX_PLATFORMS=cpu MXNET_TRACE=1 MXNET_TRACE_DIR=/tmp/_trace_elastic \
     timeout -k 10 240 \
     python tools/launch.py --elastic -n 2 -s 2 \
     --env MXNET_FI_KILL_PROCESS_AFTER="$kill_acks" \
     --env MXNET_FI_ONLY_SERVER=1 \
+    --env MXNET_HEALTH_DIR=/tmp/_health_elastic \
     python tests/dist/dist_elastic_membership.py
 python tools/trace_merge.py --spans /tmp/_trace_elastic \
     -o /tmp/_trace_elastic_merged.json
@@ -124,6 +126,28 @@ assert flows, "handoff trace has no cross-process flow"
 print("elastic trace OK: handoff span + 3 phases under kv.repair, "
       "%d flows in its trace" % len(flows))
 PY
+# The same run's flight-recorder bundles feed the postmortem (ISSUE 13):
+# the SIGKILLed server left NO bundle — the report must reconstruct the
+# death from the survivors' bundles: who (server 1, by uri), the repair
+# phase in flight, and witness health events from >= 1 survivor.
+python tools/postmortem.py /tmp/_health_elastic \
+    --trace-dir /tmp/_trace_elastic -o /tmp/_pm_elastic.json
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+r = json.load(open("/tmp/_pm_elastic.json"))
+dead = [d for d in r["dead"] if d["shape"] == "sigkill"]
+assert len(dead) == 1, r["dead"]
+d = dead[0]
+assert (d["role"], d["rank"]) == ("server", "1"), d
+assert d["uri"], d
+assert d["named_by"], "no survivor named the dead server"
+assert len(d["witness_events"]) >= 1, d
+assert d["repair_phases"], "no repair phases reconstructed"
+assert d["phase_in_flight"] is not None, d
+print("postmortem OK: %s-%s (%s) died during %s; named by %s"
+      % (d["role"], d["rank"], d["uri"], d["phase_in_flight"],
+         ", ".join(d["named_by"])))
+PY
 
 echo "== coordinator-failover smoke (SIGKILL server 0 mid-epoch, no restart)"
 # Same arithmetic contract, but the SIGKILL now lands on the
@@ -139,13 +163,41 @@ echo "== coordinator-failover smoke (SIGKILL server 0 mid-epoch, no restart)"
 # in the retried barrier.
 kill_acks0=$(MXT_PRINT_KILL_ACKS=1 MXT_KILL_SERVER=0 \
     python tests/dist/dist_elastic_membership.py)
+rm -rf /tmp/_health_failover && mkdir -p /tmp/_health_failover
 JAX_PLATFORMS=cpu timeout -k 10 240 \
     python tools/launch.py --elastic -n 2 -s 2 \
     --env MXNET_FI_KILL_PROCESS_AFTER="$kill_acks0" \
     --env MXNET_FI_ONLY_SERVER=0 \
     --env MXNET_FI_ONLY_COORDINATOR=1 \
     --env MXT_KILL_SERVER=0 \
+    --env MXNET_HEALTH_DIR=/tmp/_health_failover \
     python tests/dist/dist_elastic_membership.py
+# This run is UNTRACED (no MXNET_TRACE): the postmortem must
+# reconstruct the coordinator's death from crash bundles ALONE —
+# proving the flight recorder independent of full tracing (the ISSUE 13
+# acceptance's second half).  Who: server 0, the coordinator; the
+# successor's own bundle records the failover it ran.
+python tools/postmortem.py /tmp/_health_failover -o /tmp/_pm_failover.json
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+r = json.load(open("/tmp/_pm_failover.json"))
+dead = [d for d in r["dead"] if d["shape"] == "sigkill"]
+assert len(dead) == 1, r["dead"]
+d = dead[0]
+assert (d["role"], d["rank"]) == ("server", "0"), d
+assert d["named_by"], "no survivor named the dead coordinator"
+assert len(d["witness_events"]) >= 1, d
+assert d["repair_phases"], d
+# the successor (server 1) survived, recorded the succession, and its
+# bundle carries the failover evidence even with tracing fully off
+s1 = r["survivors"].get("server-1")
+assert s1 is not None, r["survivors"]
+assert any(e["kind"] == "failover" for e in d["witness_events"]) or \
+    "server-1" in d["named_by"], d
+print("postmortem OK (MXNET_TRACE=0): coordinator %s-%s died during %s;"
+      " witnesses: %s" % (d["role"], d["rank"], d["phase_in_flight"],
+                          ", ".join(d["named_by"])))
+PY
 
 echo "== fused-dist smoke (K-step scan over the dist_async wire, overlapped)"
 # The two headline wins finally compose (ISSUE 10 / PERF_NOTES round 10):
@@ -207,6 +259,26 @@ assert md["cross_process_flows"] >= 1, md
 print("tracing smoke OK: %d spans, %d processes, %d flows"
       % (md["spans"], len(pids), md["cross_process_flows"]))
 PY
+
+echo "== health smoke (injected barrier stall -> watchdog -> DEGRADED -> OK)"
+# The ISSUE 13 acceptance's first half: a launcher run with an INJECTED
+# barrier stall (faultinject.delay_barrier_release via
+# MXNET_FI_STALL_BARRIER_MS — a deterministic wedge, no dead process)
+# must trip the stall watchdog within its configured budget on every
+# process (workers on kv.barrier, the server on its park), flip cluster
+# health to DEGRADED on the server's universal ("stats",) reply and in
+# distributed.cluster_health(), and RECOVER to OK through the
+# hysteresis window once the stall clears — no restart, no manual
+# reset.  The assertions live in the script, per rank.  Time-boxed: a
+# watchdog regression presents as a failed assertion, a recovery
+# regression as a stuck DEGRADED.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 \
+    --env MXNET_FI_STALL_BARRIER_MS=3000 \
+    --env MXNET_HEALTH_BARRIER_STALL_S=0.4 \
+    --env MXNET_HEALTH_INTERVAL_S=0.1 \
+    --env MXNET_HEALTH_RECOVERY_S=1.0 \
+    python tests/dist/dist_health_smoke.py
 
 echo "== autotune smoke (stub-backend sweep: propose/measure/journal/promote)"
 # The measurement harness itself is CI-gated end to end on CPU

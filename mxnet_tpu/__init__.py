@@ -56,6 +56,7 @@ from . import module as mod
 from .module import Module, BaseModule
 from . import profiler
 from . import tracing
+from . import health
 from . import monitor
 from .monitor import Monitor
 from . import visualization

@@ -171,6 +171,61 @@ declare_env("MXNET_TRACE_FLUSH_N", int, 32,
             "tracing: spans buffered between flush+fsync of the trace "
             "journal (a SIGKILL loses at most this many spans plus "
             "one torn line, which the reader skips)")
+# -- cluster health (mxnet_tpu.health; docs/OBSERVABILITY.md) ----------------
+declare_env("MXNET_HEALTH", bool, True,
+            "master switch for the health layer: flight-recorder event "
+            "ring, stall watchdogs and SLO status evaluation; 0 makes "
+            "every entry point a no-op (status always OK, no monitor "
+            "thread, no crash bundles)")
+declare_env("MXNET_HEALTH_DIR", str, "",
+            "health: directory the flight recorder dumps its fsync'd "
+            "<role>-<rank>.crash.json bundle into on crashes, channel "
+            "poison, watchdog trips, SIGTERM and exit — the postmortem "
+            "evidence a SIGKILLed peer's survivors leave behind "
+            "(tools/postmortem.py merges them); empty = in-memory ring "
+            "only")
+declare_env("MXNET_HEALTH_INTERVAL_S", float, 1.0,
+            "health: watchdog monitor-thread poll interval (the thread "
+            "starts lazily with the first registered wait or probe)")
+declare_env("MXNET_HEALTH_EVENTS", int, 256,
+            "health: bounded size of the flight recorder's typed-event "
+            "ring (older events fall off; the crash bundle carries the "
+            "whole ring)")
+declare_env("MXNET_HEALTH_BARRIER_STALL_S", float, 30.0,
+            "health: a barrier wait (worker rendezvous or server park) "
+            "parked past this many seconds trips the barrier_stall "
+            "watchdog; 0 disables the check")
+declare_env("MXNET_HEALTH_WIRE_STALL_S", float, 30.0,
+            "health: a kvstore wire wait (pull_async resolution) stuck "
+            "past this many seconds with its round never completing "
+            "trips the wire_stall watchdog; 0 disables the check")
+declare_env("MXNET_HEALTH_RECOVERY_S", float, 5.0,
+            "health: recovery hysteresis — after every bad condition "
+            "clears, the status keeps reporting DEGRADED for this many "
+            "seconds before returning to OK, so a flapping condition "
+            "reads as one continuous degradation")
+declare_env("MXNET_HEALTH_P99_MS", float, 0.0,
+            "health SLO rule: serving.request p99 latency ceiling in "
+            "ms — p99 above it degrades the node; 0 disables the rule")
+declare_env("MXNET_HEALTH_OVERLAP_FLOOR", float, 0.0,
+            "health SLO rule: wire overlap_pct floor for the fused "
+            "dist driver — overlap below it (once >= 4 rounds have "
+            "completed) degrades the node; 0 disables the rule")
+declare_env("MXNET_HEALTH_FAILOVER_BUDGET_S", float, 0.0,
+            "health SLO rule: coordinator failover_rebuild_s budget — "
+            "a rebuild gauge above it degrades the node; 0 disables "
+            "the rule")
+declare_env("MXNET_HEALTH_QUEUE_SAT", float, 1.0,
+            "health: serving queue-depth saturation fraction — a "
+            "registered queue probe at or past this fraction of its "
+            "limit trips the queue_saturated watchdog")
+declare_env("MXNET_HEALTH_BUSY_STORM", int, 8,
+            "health: BUSY-shed storm threshold — this many busy_shed "
+            "events within MXNET_HEALTH_BUSY_WINDOW_S flip the replica "
+            "to DEGRADED (recovering with hysteresis); 0 disables")
+declare_env("MXNET_HEALTH_BUSY_WINDOW_S", float, 1.0,
+            "health: sliding window (seconds) the BUSY-shed storm rule "
+            "counts busy_shed events over")
 declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
             "host worker threads for the data pipeline")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19,
@@ -357,6 +412,13 @@ declare_env("MXNET_FI_ONLY_COORDINATOR", bool, False,
             "role (kvstore_server keeps the flag current across "
             "failovers; composes with MXNET_FI_ONLY_SERVER and the "
             "KILL_PROCESS_AFTER / KILL_ON_BEAT_SEQ kill points)")
+declare_env("MXNET_FI_STALL_BARRIER_MS", float, 0.0,
+            "fault injection: delay the server's handling of the NEXT "
+            "barrier arrival by this many ms before it registers — a "
+            "deterministic one-shot barrier wedge (every other rank's "
+            "park and the delayed rank's reply stretch by exactly this "
+            "long), the CPU-testable stall the mxnet_tpu.health "
+            "watchdog gates trip on (unset/0 = off)")
 declare_env("MXNET_FI_KILL_ON_BEAT_SEQ", int, None,
             "fault injection: SIGKILL this process when its elastic "
             "beat loop sends beat number N — the deterministic beat-"

@@ -232,6 +232,11 @@ def cluster_stats(compact: bool = False) -> dict:
         server_stats = getattr(obj, "server_stats", None)
         if conns is None or server_stats is None:
             continue
+        if getattr(obj, "_closed", False):
+            # a closed store lingering until gc must not be swept: its
+            # channels answer nothing (request() fails fast post-close,
+            # but skipping is cheaper than 2N raised errors)
+            continue
         for i, c in enumerate(list(conns)):
             uri = str(getattr(c, "_uri", i))
             if uri in out["servers"]:
@@ -245,7 +250,8 @@ def cluster_stats(compact: bool = False) -> dict:
             bank = st.pop("stats_bank", None) or {}
             if compact:
                 st = {k: st[k] for k in ("channel", "channel_bytes",
-                                         "wire", "server") if k in st}
+                                         "wire", "server", "health")
+                      if k in st}
             out["servers"][uri] = st
             for u, entry in bank.items():
                 if not isinstance(entry, dict):
@@ -255,6 +261,69 @@ def cluster_stats(compact: bool = False) -> dict:
                         int(prev.get("beat_seq", 0)):
                     out["stats_bank"][u] = entry
     return out
+
+
+def cluster_health() -> dict:
+    """One cluster-wide health verdict (docs/OBSERVABILITY.md health
+    section): per-node ``OK``/``DEGRADED``/``CRITICAL`` statuses — this
+    process's own, every live server's (from the health block its
+    ``("stats",)`` reply carries), and the banked last-known status of
+    members only the stats bank still remembers — rolled up to the
+    WORST observed.  A bank member absent from the live server sweep is
+    listed under ``dead`` and floors the cluster at DEGRADED (it was a
+    beating member once; now nobody answers for it), as does a nonzero
+    local ``num_dead_nodes()``.  Peer entries without a self-reported
+    health block are evaluated against the local SLO rule thresholds
+    (``health.evaluate``) so an old or minimal snapshot still gets a
+    verdict instead of a silent OK."""
+    from . import health as _health
+    order = {"OK": 0, "DEGRADED": 1, "CRITICAL": 2}
+    # compact sweep: the health block (and the channel/wire families
+    # the evaluate() fallback reads) ride the compact form — full
+    # snapshots would ship every server's latency tables and event
+    # rings per poll for nothing
+    stats = cluster_stats(compact=True)
+    nodes: dict = {}
+    dead: list = []
+    worst = "OK"
+
+    def verdict(snap):
+        h = snap.get("health") if isinstance(snap, dict) else None
+        if isinstance(h, dict) and h.get("status") in order:
+            return h["status"]
+        st, _failed = _health.evaluate(snap if isinstance(snap, dict)
+                                       else {})
+        return st
+
+    def fold(name, snap):
+        nonlocal worst
+        st = verdict(snap)
+        nodes[name] = st
+        if order[st] > order[worst]:
+            worst = st
+
+    for rank, snap in stats["workers"].items():
+        fold("worker-%s" % rank, snap)
+    for uri, snap in stats["servers"].items():
+        fold("server-%s" % uri, snap)
+    live_uris = set(stats["servers"])
+    for uri, entry in stats["stats_bank"].items():
+        if uri in live_uris:
+            continue
+        # a member the bank remembers but the live sweep cannot reach:
+        # dead (or partitioned).  Its last-known status is FORENSICS
+        # (shown per node), never a live verdict — a stale banked
+        # CRITICAL must not escalate a repaired cluster forever, so a
+        # dead member contributes exactly the DEGRADED floor
+        dead.append(uri)
+        nodes["dead-%s" % uri] = verdict(entry)
+        if order[worst] < order["DEGRADED"]:
+            worst = "DEGRADED"
+    n_dead = num_dead_nodes()
+    if n_dead and order[worst] < order["DEGRADED"]:
+        worst = "DEGRADED"
+    return {"status": worst, "nodes": nodes, "dead": sorted(dead),
+            "num_dead_nodes": n_dead}
 
 
 def shutdown() -> None:

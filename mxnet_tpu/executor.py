@@ -255,9 +255,13 @@ def drive_chunked_dist(num_steps, chunk_size, staleness, dispatch_chunk,
     weights at the sync point — or None when num_steps == 0."""
     import math
     from . import tracing as _tr
+    from . import health as _health
     n_chunks = math.ceil(num_steps / chunk_size)
     pending = {}
     for j in range(n_chunks):
+        # liveness breadcrumb per chunk: the health snapshot's
+        # progress_age_s separates a stalled driver from a slow one
+        _health.note_progress("fused.chunk")
         # one span per chunk: its children separate the scanned COMPUTE
         # from the exposed wire (the _PullHandle's kv.wire_wait span
         # lands under fused.adopt_wait, its kv.wire_round sibling shows

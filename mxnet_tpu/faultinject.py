@@ -76,6 +76,8 @@ class _Plan:
         self.only_coordinator = False   # limit process kill to the
         #                                 CURRENT roster coordinator
         self.kill_on_beat_seq = None    # SIGKILL self at beat number n
+        self.stall_barrier_s = 0.0      # injected barrier-arrival delay
+        self.stall_barrier_times = 0    # remaining stalls to inject
 
 
 _plan = _Plan()
@@ -129,7 +131,8 @@ def stats() -> dict:
 def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
               refuse_connects=0, refuse_accepts=0, only_rank=None,
               kill_unacked=None, kill_process_after=None, only_server=None,
-              only_coordinator=False, kill_on_beat_seq=None):
+              only_coordinator=False, kill_on_beat_seq=None,
+              stall_barrier_s=0.0, stall_barrier_times=1):
     """Arm a plan directly (the non-context-manager form; multi-process
     scripts use this after deciding per-rank what to inject)."""
     if kill_point not in KILL_POINTS:
@@ -154,6 +157,9 @@ def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
         _plan.only_coordinator = bool(only_coordinator)
         _plan.kill_on_beat_seq = (int(kill_on_beat_seq)
                                   if kill_on_beat_seq else None)
+        _plan.stall_barrier_s = float(stall_barrier_s)
+        _plan.stall_barrier_times = (int(stall_barrier_times)
+                                     if stall_barrier_s > 0 else 0)
 
 
 @contextlib.contextmanager
@@ -227,6 +233,28 @@ def kill_on_beat_seq(n):
     finally:
         with _lock:
             _plan.kill_on_beat_seq = None
+
+
+@contextlib.contextmanager
+def delay_barrier_release(ms, times=1):
+    """Deterministically WEDGE the next ``times`` barrier rendezvous:
+    the server sleeps ``ms`` milliseconds before registering the next
+    arriving barrier request, so every other rank's park — and the
+    delayed rank's own reply, hence its release — stretch by exactly
+    that long.  The CPU-testable stall the ``mxnet_tpu.health``
+    watchdogs exist for: no real wedge (dead peer, wedged lock) is
+    needed to prove a trip fires within its budget.  Env form:
+    ``MXNET_FI_STALL_BARRIER_MS`` (one stall; composes with
+    ``MXNET_FI_ONLY_SERVER`` / ``MXNET_FI_ONLY_COORDINATOR``)."""
+    with _lock:
+        _plan.stall_barrier_s = float(ms) / 1000.0
+        _plan.stall_barrier_times = int(times)
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.stall_barrier_s = 0.0
+            _plan.stall_barrier_times = 0
 
 
 @contextlib.contextmanager
@@ -360,6 +388,21 @@ def server_reply_delay():
         time.sleep(d)
 
 
+def barrier_stall():
+    """Called by the server at every barrier arrival, BEFORE the
+    arrival registers.  Fires the armed one-shot(s) of
+    :func:`delay_barrier_release` — the sleep happens outside every
+    lock, so only the stalled rendezvous (and the ranks parked on it)
+    feel it."""
+    with _lock:
+        if _plan.stall_barrier_times <= 0 or _plan.stall_barrier_s <= 0 \
+                or not _server_active():
+            return
+        _plan.stall_barrier_times -= 1
+        d = _plan.stall_barrier_s
+    time.sleep(d)
+
+
 def _sigkill_self():
     """SIGKILL this process (separate function so in-process tests can
     monkeypatch the trigger without actually dying)."""
@@ -413,10 +456,11 @@ def _arm_from_env():
     dl = os.environ.get("MXNET_FI_DELAY_ACK_MS")
     kp = os.environ.get("MXNET_FI_KILL_PROCESS_AFTER")
     kb = os.environ.get("MXNET_FI_KILL_ON_BEAT_SEQ")
+    sb = os.environ.get("MXNET_FI_STALL_BARRIER_MS")
     orank = os.environ.get("MXNET_FI_ONLY_RANK")
     osrv = os.environ.get("MXNET_FI_ONLY_SERVER")
     ocoord = os.environ.get("MXNET_FI_ONLY_COORDINATOR")
-    if not (ka or ku or rc or ra or dl or kp or kb):
+    if not (ka or ku or rc or ra or dl or kp or kb or sb):
         return
     configure(
         kill_after=int(ka) if ka else None,
@@ -430,7 +474,8 @@ def _arm_from_env():
         only_server=int(osrv) if osrv else None,
         only_coordinator=bool(ocoord) and
         ocoord.lower() not in ("0", "false", "off", ""),
-        kill_on_beat_seq=int(kb) if kb else None)
+        kill_on_beat_seq=int(kb) if kb else None,
+        stall_barrier_s=float(sb) / 1000.0 if sb else 0.0)
 
 
 _arm_from_env()

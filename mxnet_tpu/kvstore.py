@@ -47,6 +47,7 @@ from .base import MXNetError
 from .ndarray import NDArray
 from . import optimizer as opt
 from . import tracing as _tr
+from . import health as _health
 # canonical key coercion lives beside the wire protocol so worker-side
 # and server-side updater indexing can never diverge
 from .kvstore_server import _key_int as _key_int_impl
@@ -663,11 +664,16 @@ class _ServerConn:
                 self._reconnect(exc)   # raises once retries exhausted
 
     def _channel_failed(self, exc):
-        """Permanent failure: record the poison, fail the whole window."""
+        """Permanent failure: record the poison, fail the whole window.
+        The flight recorder marks it too (CRITICAL while outstanding)
+        and dumps a crash bundle — a hard-failed channel is exactly the
+        evidence a postmortem needs from a survivor."""
         self._err = exc
         while self._inflight:
             _envelope, pending, _replayed = self._inflight.popleft()
             self._fail_pending(pending, exc)
+        if not self._closing.is_set():
+            _health.note_channel_poison(self._uri)
 
     @staticmethod
     def _fail_pending(pending, exc):
@@ -829,6 +835,19 @@ class _ServerConn:
                 s.close()
             except OSError:
                 pass
+        # poison the channel for any LATER caller: with the IO thread
+        # gone, an enqueue after close would sit in the queue forever —
+        # request()'s _err precheck must fail fast instead.  This bit
+        # an observability sweep for real: cluster_stats() reaching a
+        # closed-but-not-yet-collected store hung the whole sweep.
+        if self._err is None:
+            self._err = MXNetError(
+                f"kvstore channel to {self._uri} is closed")
+        self._dead = True
+        self._drain_queue_failing(self._err)
+        # a deliberately-closed channel is not an outstanding failure:
+        # its poison (if any) stops contributing CRITICAL
+        _health.clear_channel_poison(self._uri)
 
 
 class _Pending:
@@ -882,6 +901,10 @@ class _PullHandle:
         from . import profiler as _prof
         t_wait = time.monotonic()
         sp = _tr.span_begin("kv.wire_wait", cat="wire")
+        # registered with the health watchdog: a wire wait parked past
+        # MXNET_HEALTH_WIRE_STALL_S with its round never resolving trips
+        # a typed wire_stall event (docs/OBSERVABILITY.md health section)
+        wtok = _health.wait_begin("kv.wire_wait")
         try:
             vals = {}
             for k, pending in self._reqs:
@@ -897,6 +920,7 @@ class _PullHandle:
             # leaked open span would stay on the thread-local stack and
             # mis-parent every later span on this thread
             _tr.span_end(sp, args={"keys": len(self._reqs)})
+            _health.wait_end(wtok)
         t1 = time.monotonic()
         _prof.record_wire_wait(t1 - t_wait)
         _prof.record_wire_round(t1 - self._t0)
@@ -1187,6 +1211,15 @@ class KVStoreDistAsync(KVStore):
         dead_uris = {c._uri for c in dead}
         coord_uri = _mem.coordinator_uri(self._roster_servers)
         succession = coord_uri in dead_uris
+        # flight-recorder evidence BEFORE any wire work: even if this
+        # worker dies mid-repair, its bundle names who it saw dead and
+        # that a repair was in flight (tools/postmortem.py correlates
+        # these across survivors)
+        for u in sorted(dead_uris):
+            _health.note("peer_dead", uri=u,
+                         coordinator=bool(u == coord_uri))
+        _health.note("repair.begin", dead=sorted(dead_uris),
+                     poisoned=[c._uri for c in poisoned])
         reply = None
         while True:
             if coord_uri in dead_uris:
@@ -1246,6 +1279,9 @@ class KVStoreDistAsync(KVStore):
             self._failovers += 1
             _prof.record_channel_event(
                 "kvstore.coordinator_failover_observed")
+            _health.note("failover_observed",
+                         coordinator_slot=self._coordinator_slot)
+        _health.note("repair.end", generation=self._roster_gen)
         return True
 
     def _elastic_refresh(self):
@@ -1299,6 +1335,10 @@ class KVStoreDistAsync(KVStore):
         _prof.record_channel_event("kvstore.roster_bump")
         _prof.record_channel_gauge("kvstore.roster_generation",
                                    self._roster_gen)
+        # every connection was just rebuilt against the live roster:
+        # outstanding channel poison is repaired, not outstanding
+        _health.clear_channel_poison()
+        _health.note("roster_bump", generation=self._roster_gen)
         # which bootstrap slot leads now (-1 = a joined-later server):
         # a failover is observable as this gauge moving off slot 0
         curi = _mem.coordinator_uri(servers)
@@ -1382,6 +1422,12 @@ class KVStoreDistAsync(KVStore):
             with _tr.span("handoff.collect", cat="elastic"):
                 per_wire = self._collect_handoff_states(moved, old_servers)
             pendings = []
+            # per-phase flight-recorder breadcrumbs: with MXNET_TRACE=0
+            # the spans vanish but the postmortem can still name the
+            # repair phase in flight from the bundles alone (the ISSUE
+            # 13 acceptance's trace-independence half)
+            _health.note("handoff.values", moved=len(moved),
+                         generation=int(gen))
             with _tr.span("handoff.values", cat="elastic"):
                 for k in moved:
                     val = self._pull_cache.get(k)
@@ -1395,6 +1441,7 @@ class KVStoreDistAsync(KVStore):
                         pendings.append(
                             self._conns[servers.index(uri)].request(
                                 ("handoff", gen, wk, part, k)))
+            _health.note("handoff.states", generation=int(gen))
             with _tr.span("handoff.states", cat="elastic"):
                 if per_wire:
                     for k in moved:
@@ -1416,6 +1463,7 @@ class KVStoreDistAsync(KVStore):
                 for p in pendings:
                     _await(p)
             _prof.record_channel_event("kvstore.handoff_round")
+            _health.note("handoff.repush", generation=int(gen))
             with _tr.span("handoff.repush", cat="elastic"):
                 for k in moved:
                     for grad in self._push_log.get(k, []):
@@ -1930,9 +1978,17 @@ class KVStoreDistAsync(KVStore):
             self._elastic_attempt(self._flush_all)
             self._barrier_seq += 1
             bseq = self._barrier_seq
-            payload = self._elastic_attempt(
-                lambda: self._coordinator_conn().submit(("barrier", bseq),
-                                                        wait=True))
+            # the rendezvous is a registered health wait: parked past
+            # MXNET_HEALTH_BARRIER_STALL_S the watchdog trips a typed
+            # barrier_stall event and the status degrades — a wedged
+            # barrier becomes a signal, not a silent hang
+            wtok = _health.wait_begin("kv.barrier")
+            try:
+                payload = self._elastic_attempt(
+                    lambda: self._coordinator_conn().submit(
+                        ("barrier", bseq), wait=True))
+            finally:
+                _health.wait_end(wtok)
             if isinstance(payload, (tuple, list)) and len(payload) == 2:
                 # the coordinator realigned this (re-)joined rank to the
                 # cohort's pending rendezvous: adopt the effective

@@ -46,6 +46,7 @@ import numpy as np
 from . import faultinject
 from . import profiler as _prof
 from . import tracing as _tr
+from . import health as _health
 from .base import env as _env
 from .compression import WirePayload, decompress as _decompress
 
@@ -1048,6 +1049,10 @@ class KVStoreServer:
                                    time.monotonic() - t0)
         _prof.record_channel_gauge("kvstore.roster_generation",
                                    self._known_gen)
+        _health.note("failover", dead=sorted(dead_uris),
+                     generation=int(self._known_gen),
+                     rebuild_s=round(time.monotonic() - t0, 3))
+        _health.dump("failover")
         print("kvstore server %d (%s): promoted to roster coordinator "
               "(predecessor(s) %s dead; generation resumes at %d)"
               % (self.server_id, self.uri, sorted(dead_uris),
@@ -1197,6 +1202,7 @@ class KVStoreServer:
             except RuntimeError:
                 continue   # the last server is never evicted
             _prof.record_channel_event("kvstore.server_eviction")
+            _health.note("server_evicted", uri=u, by="beat_silence")
             _prof.record_channel_gauge("kvstore.roster_generation",
                                        m.generation)
 
@@ -1264,6 +1270,8 @@ class KVStoreServer:
                 _prof.record_channel_event(
                     "kvstore.server_eviction" if role == "server"
                     else "kvstore.worker_eviction")
+                _health.note("%s_evicted" % role, ident=str(ident),
+                             by="report", generation=after)
             _prof.record_channel_gauge("kvstore.roster_generation", after)
             with self._barrier_cv:
                 # membership changed: parked barrier waiters must
@@ -1461,6 +1469,12 @@ class KVStoreServer:
           discovery on every barrier.  An evicted rank that was merely
           slow and arrives later is re-admitted (join, another bump)
           with a fresh barrier sequence."""
+        # deterministic stall injection (faultinject.delay_barrier_release
+        # / MXNET_FI_STALL_BARRIER_MS): delays THIS arrival's handling
+        # before it registers, so every other rank's park — and this
+        # rank's reply — stretch by exactly the armed delay.  The
+        # CPU-testable wedge the health watchdog gates trip on.
+        faultinject.barrier_stall()
         with self._barrier_cv:
             if client is not None and rank is not None:
                 prev = self._barrier_client.get(rank)
@@ -1529,6 +1543,10 @@ class KVStoreServer:
             # eviction window — reads directly off the park widths
             park = _tr.span_begin("srv.barrier_park", cat="server",
                                   args={"rank": rank, "bseq": bseq})
+            # the park is a registered health wait: a rendezvous parked
+            # past MXNET_HEALTH_BARRIER_STALL_S trips the server-side
+            # watchdog too, so BOTH halves of a wedged barrier degrade
+            wtok = _health.wait_begin("srv.barrier_park")
             try:
                 while not self._barrier_released(rank, bseq) \
                         and not self._stop.is_set():
@@ -1564,6 +1582,7 @@ class KVStoreServer:
                            arrived))
             finally:
                 _tr.span_end(park)
+                _health.wait_end(wtok)
             payload = self._barrier_payload()
             return (payload, realign) if realign else payload
 
@@ -1686,6 +1705,11 @@ class KVStoreServer:
                             self._peer_refused.add(uri)
                             if uri == curi:
                                 self._coord_refused = True
+                            # flight-recorder evidence: a survivor's
+                            # bundle names the peer whose port vanished
+                            # (the postmortem's who-died witness line)
+                            _health.note("peer_refused", uri=uri,
+                                         coordinator=bool(uri == curi))
                         sock = socks.pop(uri, None)
                         if sock is not None:
                             try:

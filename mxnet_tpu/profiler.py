@@ -25,6 +25,7 @@ from typing import List, Optional
 
 from .base import MXNetError, env
 from . import tracing
+from . import health as _health
 
 PROFILER_STATE_STOP = 0
 PROFILER_STATE_RUN = 1
@@ -539,6 +540,11 @@ def snapshot(compact: bool = False) -> dict:
         },
     }
     if compact:
+        # the health status rides the compact form too: beats piggyback
+        # it, so every peer's stats bank holds each member's last-known
+        # OK/DEGRADED/CRITICAL verdict next to its counters
+        # (docs/OBSERVABILITY.md health section)
+        out["health"] = _health.snapshot_section(compact=True)
         return out
     role, rank = tracing.role_rank()
     out.update({
@@ -550,6 +556,7 @@ def snapshot(compact: bool = False) -> dict:
         "host_sync_total": host_sync_total(),
         "latency": {k: latency_stats(k) for k in latency_kinds()},
         "trace": tracing.stats(),
+        "health": _health.snapshot_section(),
     })
     return out
 
@@ -567,23 +574,49 @@ def reset_all():
 
 
 def _main(argv=None) -> int:
-    """``python -m mxnet_tpu.profiler [--dump] [--reset]`` — the shell
-    face of :func:`snapshot` for scripts and chip runbooks: ``--dump``
-    (the default) prints the full snapshot as ONE JSON line (the same
-    one-line contract bench.py and the autotune executor parse);
-    ``--reset`` zeroes the counters first (combine both for a
-    read-and-rearm)."""
+    """``python -m mxnet_tpu.profiler [--dump] [--reset] [--watch S]``
+    — the shell face of :func:`snapshot` for scripts and chip runbooks:
+    ``--dump`` (the default) prints the full snapshot as ONE JSON line
+    (the same one-line contract bench.py and the autotune executor
+    parse); ``--reset`` zeroes the counters first (combine both for a
+    read-and-rearm); ``--watch S`` repeats the dump every S seconds —
+    one JSON line per tick, same contract — so a chip runbook can tail
+    live counters (``| jq .wire``) without writing a loop.  ``--ticks
+    N`` bounds the watch (0 = until interrupted)."""
     import argparse
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.profiler",
-        description="dump/reset the mxnet_tpu profiler counter "
+        description="dump/reset/watch the mxnet_tpu profiler counter "
                     "snapshot (docs/OBSERVABILITY.md)")
     ap.add_argument("--dump", action="store_true",
                     help="print the snapshot as one JSON line (default "
                          "when --reset is not given)")
     ap.add_argument("--reset", action="store_true",
                     help="zero every counter family")
+    ap.add_argument("--watch", type=float, default=None, metavar="S",
+                    help="interval mode: print one snapshot JSON line "
+                         "every S seconds (ctrl-C to stop)")
+    ap.add_argument("--ticks", type=int, default=0, metavar="N",
+                    help="with --watch: stop after N lines (0 = run "
+                         "until interrupted)")
     args = ap.parse_args(argv)
+    if args.watch is not None:
+        if args.watch <= 0:
+            ap.error("--watch interval must be > 0 seconds")
+        if args.reset:
+            reset_all()
+        tick = 0
+        try:
+            while True:
+                print(json.dumps(snapshot(), sort_keys=True,
+                                 default=str), flush=True)
+                tick += 1
+                if args.ticks and tick >= args.ticks:
+                    break
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            pass
+        return 0
     # dump BEFORE reset: the --dump --reset combination is
     # read-and-rearm — print the accumulated counters, THEN zero them
     # (the other order would print an empty snapshot and lose the data)

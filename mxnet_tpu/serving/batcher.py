@@ -29,6 +29,7 @@ import numpy as np
 from ..base import MXNetError, env
 from .. import profiler as _prof
 from .. import tracing as _tr
+from .. import health as _health
 from .bucketed import _raw
 
 
@@ -109,6 +110,11 @@ class DynamicBatcher:
                 # never queue unboundedly (the p99 killer)
                 self.shed += 1
                 _prof.record_channel_event("serving.busy_shed")
+                # the health rule engine counts these in a sliding
+                # window: >= MXNET_HEALTH_BUSY_STORM sheds within
+                # MXNET_HEALTH_BUSY_WINDOW_S flips the replica to
+                # DEGRADED (recovering with hysteresis)
+                _health.note("busy_shed")
                 slot.complete(("ok", ("busy", {
                     "queue_depth": len(self._q),
                     "limit": self._queue_depth})))

@@ -46,6 +46,7 @@ from ..base import MXNetError, env
 from ..kvstore_server import KVStoreServer, _send_msg, _recv_msg
 from .. import profiler as _prof
 from .. import tracing as _tr
+from .. import health as _health
 from .batcher import DynamicBatcher, _ReplySlot
 from .bucketed import BucketedPredictor
 
@@ -95,6 +96,20 @@ class ServingReplica(KVStoreServer):
             self._refresh_thread = threading.Thread(
                 target=self._refresh_loop, daemon=True)
             self._refresh_thread.start()
+        # the health watchdog samples the batcher queue every tick:
+        # depth at (or past) MXNET_HEALTH_QUEUE_SAT x limit trips a
+        # typed queue_saturated event and degrades this replica's
+        # status — the serving half of the SLO plane.  Keyed by port:
+        # two in-process replicas (tests, train-and-serve topologies)
+        # must not overwrite each other's probe — and one replica's
+        # stop() must not unregister the survivor's
+        self._health_probe_name = "serving.queue:%d" % self.port
+        _health.register_probe(self._health_probe_name,
+                               self._health_probe)
+
+    def _health_probe(self) -> dict:
+        return {"queue_depth": self._batcher.queue_depth,
+                "queue_limit": self._batcher.queue_limit}
 
     @classmethod
     def from_checkpoint(cls, prefix, epoch, data_shapes, **kwargs):
@@ -156,6 +171,11 @@ class ServingReplica(KVStoreServer):
             "coordinator_failovers": getattr(self._ps, "_failovers",
                                              0) or 0,
             "latency": _prof.latency_stats("serving.request"),
+            # the replica's health verdict next to its SLO numbers: a
+            # BUSY storm or saturated queue reads as DEGRADED here (and
+            # recovers with hysteresis — no flapping), so a router can
+            # steer on serving_stats alone (docs/OBSERVABILITY.md)
+            "health": _health.snapshot_section(compact=True),
         }
 
     def _op_refresh(self, msg, rank):
@@ -359,6 +379,7 @@ class ServingReplica(KVStoreServer):
 
     def stop(self):
         super().stop()
+        _health.unregister_probe(self._health_probe_name)
         self._batcher.stop()
         if self._ps is not None:
             try:

@@ -1,0 +1,104 @@
+"""Health smoke: an injected barrier stall must trip the watchdog
+within its budget, flip cluster health to DEGRADED on every live rank's
+``("stats",)`` reply, and recover to OK after the stall clears.
+
+Run via (ci/run_ci.sh health gate)::
+
+    python tools/launch.py -n 2 -s 1 \
+        --env MXNET_FI_STALL_BARRIER_MS=3000 \
+        --env MXNET_HEALTH_BARRIER_STALL_S=0.4 \
+        --env MXNET_HEALTH_INTERVAL_S=0.1 \
+        --env MXNET_HEALTH_RECOVERY_S=1.0 \
+        python tests/dist/dist_health_smoke.py
+
+The server delays the FIRST barrier arrival's registration by 3 s
+(``faultinject.delay_barrier_release`` armed through the env), so both
+workers' rendezvous — and the other rank's server-side park — stall
+well past the 0.4 s watchdog threshold: a real wedge, injected
+deterministically, no dead process needed.  Every process must trip
+(workers on their ``kv.barrier`` wait, the server on its
+``srv.barrier_park``), the trip must land within budget (threshold plus
+a few watchdog ticks), the DEGRADED status must be visible locally, on
+the server's universal stats reply AND in the
+``distributed.cluster_health()`` roll-up — and once the stall clears,
+everything must recover to OK through the hysteresis window (no manual
+reset, no restart).
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import health, distributed  # noqa: E402
+
+STALL_S = float(os.environ.get("MXNET_FI_STALL_BARRIER_MS", "3000")) / 1e3
+THRESH_S = float(os.environ.get("MXNET_HEALTH_BARRIER_STALL_S", "0.4"))
+TICK_S = float(os.environ.get("MXNET_HEALTH_INTERVAL_S", "0.1"))
+RECOVERY_S = float(os.environ.get("MXNET_HEALTH_RECOVERY_S", "1.0"))
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == 2, nworker
+    kv.init("w", mx.nd.zeros((2, 2)))
+
+    # -- the stalled rendezvous ---------------------------------------------
+    t0 = time.monotonic()
+    kv.barrier()                     # first barrier: the injected wedge
+    stalled = time.monotonic() - t0
+    assert stalled >= THRESH_S * 2, (
+        "the injected stall never materialized: barrier took %.3fs"
+        % stalled)
+
+    # the worker-side watchdog tripped DURING the stall, within budget
+    trips = health.trip_counts()
+    assert trips.get("barrier_stall", 0) >= 1, trips
+    ev = [e for e in health.events()
+          if e["kind"] == "watchdog.barrier_stall"]
+    assert ev, health.events()
+    budget = THRESH_S + 6 * TICK_S + 0.25   # threshold + ticks + sched slack
+    assert THRESH_S <= ev[0]["age_s"] <= budget, (ev[0], budget)
+
+    # DEGRADED everywhere while inside the recovery window: locally, on
+    # the server's universal ("stats",) reply (its own park tripped
+    # server-side), and in the cluster roll-up
+    assert health.status() == "DEGRADED", health.snapshot_section()
+    st = kv.server_stats(0)
+    assert st["health"]["status"] == "DEGRADED", st["health"]
+    assert st["health"]["trips"].get("barrier_stall", 0) >= 1, \
+        st["health"]
+    ch = distributed.cluster_health()
+    assert ch["status"] == "DEGRADED", ch
+
+    # -- recovery ------------------------------------------------------------
+    kv.barrier()                     # disarmed: a quick, healthy barrier
+    time.sleep(RECOVERY_S + 6 * TICK_S + 0.5)
+    assert health.status() == "OK", health.snapshot_section()
+    st = kv.server_stats(0)
+    assert st["health"]["status"] == "OK", st["health"]
+    ch = distributed.cluster_health()
+    assert ch["status"] == "OK", ch
+    # the trip REMAINS on the record (worst + counters) — recovery
+    # clears the status, never the evidence
+    assert st["health"]["worst"] == "DEGRADED"
+    assert health.summary()["worst"] == "DEGRADED"
+
+    kv.barrier()
+    kv.close(stop_servers=True)
+    print("dist_health_smoke rank %d/%d OK (stall %.2fs -> trip at "
+          "%.2fs -> DEGRADED -> OK)"
+          % (rank, nworker, stalled, ev[0]["age_s"]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,466 @@
+"""mxnet_tpu.health — watchdogs, SLO evaluation, crash forensics
+(ISSUE 13; docs/OBSERVABILITY.md health section).
+
+Tier-1 coverage, in-process:
+
+* the flight recorder: typed event ring (bounded), trip counters, the
+  fsync'd + atomically-replaced crash bundle with its reason history,
+  env fingerprint and exception capture, and the excepthook chain;
+* the watchdog: a registered barrier/wire wait parked past its
+  threshold trips within budget, degrades the status, emits the typed
+  event + ``health.*`` channel counter, and recovery notes the clear;
+* the SLO rule engine: p99 ceiling, overlap floor (gated on >= 4
+  rounds), failover budget — evaluated locally AND against an arbitrary
+  peer snapshot dict (:func:`health.evaluate`);
+* hysteresis: BUSY-shed storms flip DEGRADED and recover through the
+  window WITHOUT flapping — pinned with injected clocks, no sleeping;
+* channel poison = CRITICAL while outstanding, decaying through
+  DEGRADED after the repair clears it;
+* ``distributed.cluster_health()`` roll-up, the ``--watch`` profiler
+  CLI tick contract, the deterministic barrier-stall injector, and
+  ``tools/postmortem.py``'s who/phase/witnesses reconstruction from
+  synthetic bundles alone (no trace journals — the MXNET_TRACE=0
+  independence the ISSUE 13 acceptance demands).
+
+The 2-worker launcher acceptance (injected stall → watchdog trip →
+DEGRADED on every rank's stats reply → recovery) runs in ci/run_ci.sh
+via tests/dist/dist_health_smoke.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401 — package init (local kvstore below)
+from mxnet_tpu import faultinject, health, profiler
+from mxnet_tpu import distributed
+from mxnet_tpu.kvstore_server import KVStoreServer
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "tools"))
+import postmortem  # noqa: E402  (tools/postmortem.py)
+
+
+@pytest.fixture(autouse=True)
+def _health_reset(monkeypatch):
+    """Every test starts with a clean recorder, a fast watchdog tick
+    and default thresholds; teardown re-reads the restored env so no
+    test leaks health config into the suite."""
+    for knob in ("MXNET_HEALTH", "MXNET_HEALTH_DIR",
+                 "MXNET_HEALTH_BARRIER_STALL_S",
+                 "MXNET_HEALTH_WIRE_STALL_S", "MXNET_HEALTH_RECOVERY_S",
+                 "MXNET_HEALTH_P99_MS", "MXNET_HEALTH_OVERLAP_FLOOR",
+                 "MXNET_HEALTH_FAILOVER_BUDGET_S",
+                 "MXNET_HEALTH_BUSY_STORM",
+                 "MXNET_HEALTH_BUSY_WINDOW_S", "MXNET_HEALTH_EVENTS"):
+        monkeypatch.delenv(knob, raising=False)
+    monkeypatch.setenv("MXNET_HEALTH_INTERVAL_S", "0.05")
+    health.reconfigure()
+    health.reset()
+    profiler.reset_channel_counts()
+    profiler.reset_wire_counters()
+    profiler.reset_latency()
+    try:
+        yield
+    finally:
+        faultinject.reset()
+        with monkeypatch.context() as m:
+            m.delenv("MXNET_HEALTH_DIR", raising=False)
+            health.reconfigure()
+        health.reset()
+        profiler.reset_channel_counts()
+        profiler.reset_wire_counters()
+        profiler.reset_latency()
+
+
+# -- flight recorder ---------------------------------------------------------
+def test_note_ring_counts_and_bound(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_EVENTS", "16")
+    health.reconfigure()
+    for i in range(40):
+        health.note("t.tick", i=i)
+    evs = health.events()
+    assert len(evs) == 16                      # bounded ring
+    assert evs[-1]["i"] == 39 and evs[0]["i"] == 24
+    assert health.event_counts()["t.tick"] == 40   # lifetime count
+    assert all(e["kind"] == "t.tick" and "ts" in e and "mono" in e
+               for e in evs)
+
+
+def test_master_switch_off(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_HEALTH", "0")
+    monkeypatch.setenv("MXNET_HEALTH_DIR", str(tmp_path))
+    health.reconfigure()
+    health.note("t.ignored")
+    assert health.events() == []
+    assert health.wait_begin("kv.barrier") is None
+    assert health.status() == "OK"
+    assert health.dump("off") is None
+    assert list(tmp_path.iterdir()) == []
+    assert health.snapshot_section() == {"status": "OK",
+                                         "enabled": False}
+
+
+def test_bundle_dump_atomic_reasons_and_fingerprint(monkeypatch,
+                                                    tmp_path):
+    monkeypatch.setenv("MXNET_HEALTH_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_KVSTORE_WINDOW", "8")   # fingerprint bait
+    health.reconfigure()
+    health.note("t.before_crash", detail="x")
+    path = health.dump("first")
+    assert path == str(tmp_path / "local-0.crash.json")
+    b = json.loads(open(path).read())
+    assert b["reason"] == "first" and b["reasons"] == ["first"]
+    assert b["role"] == "local" and b["rank"] == "0"
+    assert b["env"]["MXNET_KVSTORE_WINDOW"] == "8"
+    assert any(e["kind"] == "t.before_crash" for e in b["events"])
+    # a re-dump REPLACES the file with a richer one: reason history
+    # accumulates, no .tmp litter survives the atomic rename
+    health.dump("second")
+    b2 = json.loads(open(path).read())
+    assert b2["reasons"] == ["first", "second"]
+    assert not [p for p in os.listdir(tmp_path) if ".tmp" in p]
+
+
+def test_excepthook_dumps_crash_bundle_and_chains(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_HEALTH_DIR", str(tmp_path))
+    health.reconfigure()
+    seen = []
+    monkeypatch.setattr(health, "_prev_excepthook",
+                        lambda t, v, tb: seen.append(t))
+    try:
+        raise ValueError("boom for the black box")
+    except ValueError as exc:
+        health._excepthook(ValueError, exc, exc.__traceback__)
+    assert seen == [ValueError]                # the chain ran
+    b = json.loads(open(tmp_path / "local-0.crash.json").read())
+    assert b["reason"] == "crash"
+    assert b["exception"]["type"] == "ValueError"
+    assert "boom for the black box" in b["exception"]["message"]
+    assert any("ValueError" in ln
+               for ln in b["exception"]["traceback"])
+
+
+# -- watchdog ----------------------------------------------------------------
+def test_watchdog_trips_stalled_barrier_wait_within_budget(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_BARRIER_STALL_S", "0.15")
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "0.3")
+    health.reconfigure()
+    tok = health.wait_begin("kv.barrier")
+    assert tok is not None
+    deadline = time.monotonic() + 5.0
+    while not health.trip_counts().get("barrier_stall") \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    trips = health.trip_counts()
+    assert trips.get("barrier_stall") == 1
+    ev = [e for e in health.events()
+          if e["kind"] == "watchdog.barrier_stall"]
+    assert ev and ev[0]["name"] == "kv.barrier"
+    # within budget: threshold + a few watchdog ticks of slack
+    assert 0.15 <= ev[0]["age_s"] <= 1.0
+    assert profiler.channel_counts().get("health.barrier_stall") == 1
+    assert health.status() == "DEGRADED"
+    assert "stalled_wait:kv.barrier" in health.snapshot_section()["active"]
+    health.wait_end(tok)
+    assert any(e["kind"] == "stall_cleared" for e in health.events())
+    # a tripped wait never re-trips after ending, and the status decays
+    # to OK once the recovery window passes
+    deadline = time.monotonic() + 5.0
+    while health.status() != "OK" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert health.status() == "OK"
+    assert health.trip_counts().get("barrier_stall") == 1
+    assert health.snapshot_section()["worst"] == "DEGRADED"
+
+
+def test_wire_wait_uses_wire_threshold(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_BARRIER_STALL_S", "60")
+    monkeypatch.setenv("MXNET_HEALTH_WIRE_STALL_S", "0.1")
+    health.reconfigure()
+    tok = health.wait_begin("kv.wire_wait")
+    deadline = time.monotonic() + 5.0
+    while not health.trip_counts().get("wire_stall") \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    health.wait_end(tok)
+    trips = health.trip_counts()
+    assert trips.get("wire_stall") == 1 and "barrier_stall" not in trips
+
+
+# -- SLO rules ---------------------------------------------------------------
+def test_slo_p99_rule(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_P99_MS", "100")
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "0")
+    health.reconfigure()
+    profiler.record_latency("serving.request", 0.010, ts=1.0)
+    assert health.status() == "OK"
+    profiler.record_latency("serving.request", 0.500, ts=2.0)
+    assert health.status() == "DEGRADED"
+    rules = {r["rule"]: r for r in health.snapshot_section()["rules"]}
+    assert rules["p99_ms"]["ok"] is False
+    assert rules["p99_ms"]["value"] == pytest.approx(500.0)
+
+
+def test_slo_overlap_floor_needs_rounds(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_OVERLAP_FLOOR", "25")
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "0")
+    health.reconfigure()
+    for _ in range(3):    # fully exposed wire, but < 4 rounds: no rule
+        profiler.record_wire_wait(0.1)
+        profiler.record_wire_round(0.1)
+    assert health.status() == "OK"
+    profiler.record_wire_wait(0.1)
+    profiler.record_wire_round(0.1)    # 4th round: the rule arms
+    assert health.status() == "DEGRADED"
+    assert "slo:overlap_floor" in health.snapshot_section()["active"]
+
+
+def test_slo_failover_budget(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_FAILOVER_BUDGET_S", "1.0")
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "0")
+    health.reconfigure()
+    profiler.record_channel_gauge("kvstore.failover_rebuild_s", 0.2)
+    assert health.status() == "OK"
+    profiler.record_channel_gauge("kvstore.failover_rebuild_s", 3.7)
+    assert health.status() == "DEGRADED"
+
+
+def test_evaluate_peer_snapshot(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_FAILOVER_BUDGET_S", "1.0")
+    health.reconfigure()
+    st, failed = health.evaluate(
+        {"channel": {"kvstore.failover_rebuild_s": 9.9}})
+    assert st == "DEGRADED"
+    assert [r["rule"] for r in failed] == ["failover_budget_s"]
+    # a self-reported peer status floors the verdict even with every
+    # numeric rule green
+    st, failed = health.evaluate(
+        {"channel": {}, "health": {"status": "CRITICAL"}})
+    assert st == "CRITICAL" and failed == []
+    assert health.evaluate({})[0] == "OK"
+
+
+# -- hysteresis (pinned with injected clocks: no sleeping, no flap) ----------
+def test_busy_storm_degrades_and_recovers_without_flapping(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_BUSY_STORM", "3")
+    monkeypatch.setenv("MXNET_HEALTH_BUSY_WINDOW_S", "1.0")
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "2.0")
+    health.reconfigure()
+    t0 = 1000.0
+    for i in range(3):
+        health.note("busy_shed", mono=t0 + i * 0.1)
+    assert health.status(now=t0 + 0.3) == "DEGRADED"      # storm active
+    # sheds age out of the window at t0+1.2 — but the status must NOT
+    # flap back: the recovery window holds it DEGRADED
+    assert health.status(now=t0 + 1.5) == "DEGRADED"
+    assert "busy_storm:3" not in \
+        [a for a in health.snapshot_section()["active"]]
+    # only past last_bad + recovery does it report OK again
+    assert health.status(now=t0 + 3.6) == "OK"
+    # and one more storm starts the cycle over (no sticky OK either)
+    health.note("busy_shed", mono=t0 + 4.0)
+    health.note("busy_shed", mono=t0 + 4.0)
+    health.note("busy_shed", mono=t0 + 4.0)
+    assert health.status(now=t0 + 4.1) == "DEGRADED"
+
+
+def test_below_storm_threshold_stays_ok(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_BUSY_STORM", "3")
+    monkeypatch.setenv("MXNET_HEALTH_BUSY_WINDOW_S", "1.0")
+    health.reconfigure()
+    t0 = 2000.0
+    health.note("busy_shed", mono=t0)
+    health.note("busy_shed", mono=t0 + 0.1)
+    assert health.status(now=t0 + 0.2) == "OK"
+
+
+def test_channel_poison_is_critical_until_cleared(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "0.1")
+    health.reconfigure()
+    health.note_channel_poison("127.0.0.1:9999")
+    assert health.status() == "CRITICAL"
+    assert health.snapshot_section(compact=True)["status"] == "CRITICAL"
+    health.clear_channel_poison("127.0.0.1:9999")
+    # recovery hysteresis: DEGRADED through the window, then OK
+    assert health.status() == "DEGRADED"
+    deadline = time.monotonic() + 5.0
+    while health.status() != "OK" and time.monotonic() < deadline:
+        time.sleep(0.03)
+    assert health.status() == "OK"
+    kinds = [e["kind"] for e in health.events()]
+    assert "channel_poison" in kinds and "poison_cleared" in kinds
+
+
+# -- roll-ups ----------------------------------------------------------------
+def test_snapshot_sections_and_cluster_health(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "0")
+    health.reconfigure()
+    snap = profiler.snapshot()
+    assert snap["health"]["status"] == "OK"
+    ch = distributed.cluster_health()
+    assert ch["status"] == "OK" and ch["nodes"]["worker-0"] == "OK"
+    health.note_channel_poison("x:1")
+    assert profiler.snapshot(compact=True)["health"]["status"] \
+        == "CRITICAL"
+    ch = distributed.cluster_health()
+    assert ch["status"] == "CRITICAL"
+    assert ch["nodes"]["worker-0"] == "CRITICAL"
+    health.clear_channel_poison()
+
+
+def test_stats_op_carries_health(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "0")
+    health.reconfigure()
+    srv = KVStoreServer(num_workers=1)
+    try:
+        payload = srv._stats_payload()
+        assert payload["health"]["status"] in ("OK", "DEGRADED",
+                                               "CRITICAL")
+    finally:
+        srv.stop()
+
+
+def test_summary_shape():
+    s = health.summary()
+    assert set(s) == {"status", "worst", "watchdog_trips"}
+    assert s["status"] == "OK" and s["worst"] == "OK"
+
+
+# -- the deterministic stall injector ----------------------------------------
+def test_delay_barrier_release_injector():
+    srv = KVStoreServer(num_workers=1)
+    try:
+        with faultinject.delay_barrier_release(120):
+            t0 = time.monotonic()
+            srv._barrier(rank=0)     # single worker: releases instantly
+            assert time.monotonic() - t0 >= 0.12
+        t0 = time.monotonic()
+        srv._barrier(rank=0)         # disarmed: no residual delay
+        assert time.monotonic() - t0 < 0.1
+    finally:
+        srv.stop()
+
+
+def test_stall_injector_env_arming(monkeypatch):
+    monkeypatch.setenv("MXNET_FI_STALL_BARRIER_MS", "80")
+    faultinject._arm_from_env()
+    srv = KVStoreServer(num_workers=1)
+    try:
+        t0 = time.monotonic()
+        srv._barrier(rank=0)
+        assert time.monotonic() - t0 >= 0.08
+        t0 = time.monotonic()
+        srv._barrier(rank=0)         # one-shot: fired once
+        assert time.monotonic() - t0 < 0.08
+    finally:
+        srv.stop()
+        faultinject.reset()
+
+
+# -- profiler --watch interval mode ------------------------------------------
+def test_profiler_watch_emits_one_json_line_per_tick():
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.profiler",
+         "--watch", "0.05", "--ticks", "3"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 3
+    for ln in lines:
+        snap = json.loads(ln)       # each tick honors the contract
+        assert "health" in snap and "wire" in snap
+
+
+# -- postmortem: who died, in which phase, what the survivors saw ------------
+def _bundle(role, rank, events, reasons=("exit",), ts=100.0):
+    return {
+        "schema": 1, "reason": reasons[-1], "reasons": list(reasons),
+        "ts": ts, "pid": 1, "role": role, "rank": str(rank),
+        "status": "OK", "trips": {}, "events": events,
+        "env": {"DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "2",
+                "MXT_SERVER_URIS": "127.0.0.1:9001,127.0.0.1:9002"},
+        "counters": {}, "roster_generation": 1,
+    }
+
+
+def test_postmortem_reconstructs_sigkill_from_bundles_alone(tmp_path):
+    """The ISSUE 13 acceptance shape, synthetically: server-1 leaves NO
+    bundle (SIGKILL), survivors' bundles name it, and the report
+    reconstructs who/phase/witnesses with no trace journals at all."""
+    dead_uri = "127.0.0.1:9002"
+    w0 = _bundle("worker", 0, [
+        {"ts": 10.0, "mono": 1.0, "kind": "peer_dead", "uri": dead_uri,
+         "coordinator": False},
+        {"ts": 10.1, "mono": 1.1, "kind": "repair.begin",
+         "dead": [dead_uri], "poisoned": []},
+        {"ts": 10.2, "mono": 1.2, "kind": "handoff.values", "moved": 1,
+         "generation": 1},
+        {"ts": 10.3, "mono": 1.3, "kind": "handoff.states",
+         "generation": 1},
+        {"ts": 10.4, "mono": 1.4, "kind": "handoff.repush",
+         "generation": 1},
+        {"ts": 10.5, "mono": 1.5, "kind": "repair.end", "generation": 1},
+    ], reasons=("channel_poison", "exit"))
+    w1 = _bundle("worker", 1, [
+        {"ts": 10.0, "mono": 1.0, "kind": "peer_dead", "uri": dead_uri,
+         "coordinator": False},
+    ])
+    s0 = _bundle("server", 0, [
+        {"ts": 10.2, "mono": 1.2, "kind": "server_evicted",
+         "ident": dead_uri, "by": "report", "generation": 1},
+    ])
+    for name, b in (("worker-0", w0), ("worker-1", w1),
+                    ("server-0", s0)):
+        (tmp_path / ("%s.crash.json" % name)).write_text(json.dumps(b))
+    report = postmortem.build_report(str(tmp_path))
+    assert report["present"] == ["server-0", "worker-0", "worker-1"]
+    dead = report["dead"]
+    assert len(dead) == 1
+    d = dead[0]
+    assert (d["role"], d["rank"], d["uri"]) == ("server", "1", dead_uri)
+    assert d["shape"] == "sigkill"
+    # phase in flight + the full repair phase sequence
+    assert d["phase_in_flight"] == "handoff.values"
+    assert d["repair_phases"] == [
+        "repair.begin", "handoff.values", "handoff.states",
+        "handoff.repush", "repair.end"]
+    # >= 1 surviving-process health event correlated to the death
+    assert "worker-0" in d["named_by"] and "worker-1" in d["named_by"]
+    assert len(d["witness_events"]) >= 2
+    # a clean exit with a channel_poison reason is a SURVIVOR (it
+    # poisoned, repaired and said goodbye), never a second corpse
+    assert "worker-0" in report["survivors"]
+
+
+def test_postmortem_names_crashed_process_from_its_own_bundle(tmp_path):
+    b = _bundle("worker", 0, [], reasons=("crash",))
+    b["exception"] = {"type": "ValueError", "message": "boom",
+                      "traceback": []}
+    b["env"] = {"DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "0"}
+    (tmp_path / "worker-0.crash.json").write_text(json.dumps(b))
+    report = postmortem.build_report(str(tmp_path))
+    assert len(report["dead"]) == 1
+    d = report["dead"][0]
+    assert d["shape"] == "crash" and d["named_by"] == ["self"]
+    assert d["exception"]["type"] == "ValueError"
+
+
+def test_postmortem_cli_writes_report_and_renders(tmp_path):
+    (tmp_path / "h").mkdir()
+    (tmp_path / "h" / "worker-0.crash.json").write_text(
+        json.dumps(_bundle("worker", 0, [])))
+    out = tmp_path / "report.json"
+    rc = postmortem.main([str(tmp_path / "h"), "-o", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    # worker-1 + both servers expected from the fingerprint, only
+    # worker-0 said goodbye
+    assert set(report["expected"]) == {"worker-0", "worker-1",
+                                       "server-0", "server-1"}
+    assert len(report["dead"]) == 3
+    assert postmortem.main([str(tmp_path / "nope")]) == 2

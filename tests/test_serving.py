@@ -595,3 +595,72 @@ def test_stats_envelope_shape():
     finally:
         cli.close()
         rep.stop()
+
+
+# -- health section in serving stats (ISSUE 13) -------------------------------
+def test_serving_stats_carries_health_section(monkeypatch):
+    """Satellite of the health layer: the ``serving_stats`` reply — and
+    the universal ``("stats",)`` payload's ``serving`` section — carry
+    the replica's OK/DEGRADED/CRITICAL verdict, so a router can steer
+    on serving stats alone (docs/OBSERVABILITY.md health section)."""
+    from mxnet_tpu import health
+    health.reset()
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, _params(),
+                         buckets=[1, 2], max_wait_s=0.0, warmup=False)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}", window=4)
+    try:
+        st = cli.stats()
+        assert st["health"]["status"] in ("OK", "DEGRADED", "CRITICAL")
+        assert "trips" in st["health"]
+        payload = rep._stats_payload()
+        assert payload["serving"]["health"]["status"] \
+            == payload["health"]["status"]
+    finally:
+        cli.close()
+        rep.stop()
+
+
+def test_busy_storm_flips_replica_degraded_and_back(monkeypatch):
+    """BusyError storms degrade the replica and recovery runs through
+    hysteresis — pinned with injected clocks so there is NO flapping
+    window at all: storm → DEGRADED; sheds age out of the window →
+    still DEGRADED (recovering); past recovery → OK."""
+    from mxnet_tpu import health
+    monkeypatch.setenv("MXNET_HEALTH_BUSY_STORM", "3")
+    monkeypatch.setenv("MXNET_HEALTH_BUSY_WINDOW_S", "0.5")
+    monkeypatch.setenv("MXNET_HEALTH_RECOVERY_S", "2.0")
+    health.reconfigure()
+    health.reset()
+    stub = _BlockingPredictor()
+    b = DynamicBatcher(stub, max_wait_s=0.0, queue_depth=1)
+    try:
+        x = {"data": np.ones((1, 2), np.float32)}
+        s1 = b.submit(x)
+        assert stub.started.wait(10)    # worker parked inside predict
+        s2 = b.submit(x)                # fills the depth-1 queue
+        shed = [b.submit(x) for _ in range(3)]   # the BUSY storm
+        assert all(s.done.is_set() and s.reply[1][0] == "busy"
+                   for s in shed)
+        assert b.shed == 3
+        t_storm = time.monotonic()
+        assert health.status(now=t_storm) == "DEGRADED"
+        assert health.event_counts().get("busy_shed", 0) >= 3
+        # sheds aged out of the 0.5s window: the storm condition is
+        # gone (status would be OK without hysteresis), but the
+        # recovery window holds DEGRADED — no flap
+        assert health.status(now=t_storm + 0.6) == "DEGRADED"
+        # past last_bad + recovery: OK again
+        assert health.status(now=t_storm + 3.0) == "OK"
+        stub.release.set()
+        for s in (s1, s2):
+            assert s.done.wait(10)
+    finally:
+        stub.release.set()
+        b.stop()
+        health.reset()
+        with monkeypatch.context() as m:
+            m.delenv("MXNET_HEALTH_BUSY_STORM", raising=False)
+            m.delenv("MXNET_HEALTH_BUSY_WINDOW_S", raising=False)
+            m.delenv("MXNET_HEALTH_RECOVERY_S", raising=False)
+            health.reconfigure()

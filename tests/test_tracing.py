@@ -315,7 +315,9 @@ def test_snapshot_shape_and_reset():
                 "host_syncs", "latency", "trace", "role", "rank", "pid"):
         assert key in snap, key
     compact = profiler.snapshot(compact=True)
-    assert set(compact) == {"channel", "channel_bytes", "wire"}
+    assert set(compact) == {"channel", "channel_bytes", "wire", "health"}
+    # the piggybacked health block is the compact form: status + counts
+    assert compact["health"]["status"] in ("OK", "DEGRADED", "CRITICAL")
     json.dumps(snap, default=str)   # wire/CLI-serializable
     profiler.record_dispatch("t.reset")
     profiler.reset_all()
@@ -344,7 +346,7 @@ def test_stats_op_and_cluster_stats(monkeypatch):
         compact = mx.distributed.cluster_stats(compact=True)
         for uri in uris:
             assert set(compact["servers"][uri]) <= \
-                {"channel", "channel_bytes", "wire", "server"}
+                {"channel", "channel_bytes", "wire", "server", "health"}
         kv.close(stop_servers=True)
     finally:
         for s in srvs:

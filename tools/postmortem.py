@@ -1,0 +1,305 @@
+#!/usr/bin/env python
+"""Postmortem: merge flight-recorder crash bundles into ONE incident report.
+
+The black-box half of ``mxnet_tpu.health`` (docs/OBSERVABILITY.md):
+every process of a launcher job dumps an fsync'd
+``MXNET_HEALTH_DIR/<role>-<rank>.crash.json`` bundle on crashes, channel
+poison, watchdog trips, SIGTERM and exit.  A SIGKILLed process leaves NO
+bundle — and that absence is itself the loudest evidence.  This tool
+reads the bundle directory and reconstructs the incident:
+
+* **who died** — the expected process set (derived from the bundles' env
+  fingerprints: ``DMLC_NUM_WORKER`` / ``DMLC_NUM_SERVER`` /
+  ``MXT_SERVER_URIS``) minus the processes that left a bundle, plus any
+  process whose own bundle records a crash/SIGTERM reason;
+* **in which phase** — the repair-family events the survivors recorded
+  (``repair.begin``, ``handoff.values/states/repush``, ``failover``)
+  ordered around the first death evidence;
+* **what the survivors saw** — every witness event (``peer_dead``,
+  ``peer_refused``, evictions, watchdog trips, channel poison) naming or
+  correlated in time with the death.
+
+Deliberately STDLIB-ONLY and trace-independent: with ``MXNET_TRACE=0``
+there are no span journals at all, and the report still reconstructs
+who/phase/witnesses from the bundles alone.  ``--trace-dir`` (optional)
+enriches the report with per-process span counts from the journals the
+tracing layer left behind.
+
+Usage::
+
+    python tools/postmortem.py /tmp/health_dir [-o report.json]
+    python tools/postmortem.py /tmp/health_dir --trace-dir /tmp/trace
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: event kinds that count as death/forensic witness evidence
+WITNESS_KINDS = (
+    "peer_dead", "peer_refused", "server_evicted", "worker_evicted",
+    "channel_poison", "failover", "failover_observed",
+    "watchdog.barrier_stall", "watchdog.wire_stall",
+    "watchdog.dead_node", "watchdog.queue_saturated",
+)
+
+#: the repair-family kinds whose order names the phase in flight
+REPAIR_KINDS = ("repair.begin", "handoff.values", "handoff.states",
+                "handoff.repush", "repair.end", "failover")
+
+
+def load_bundles(health_dir):
+    """{(role, rank): bundle} from every parseable *.crash.json (an
+    unparseable file is noted, never fatal — forensics over strictness)."""
+    bundles, broken = {}, []
+    for path in sorted(glob.glob(os.path.join(health_dir,
+                                              "*.crash.json"))):
+        try:
+            with open(path) as f:
+                b = json.load(f)
+        except (OSError, ValueError):
+            broken.append(os.path.basename(path))
+            continue
+        if not isinstance(b, dict):
+            broken.append(os.path.basename(path))
+            continue
+        b["_file"] = os.path.basename(path)
+        bundles[(str(b.get("role", "?")), str(b.get("rank", "?")))] = b
+    return bundles, broken
+
+
+def expected_processes(bundles):
+    """The launcher topology from the bundles' env fingerprints:
+    ``[(role, rank)]`` plus the server-slot → uri map.  Any one
+    survivor's fingerprint names the whole job."""
+    workers = servers = 0
+    uris = []
+    for b in bundles.values():
+        env = b.get("env") or {}
+        try:
+            workers = max(workers, int(env.get("DMLC_NUM_WORKER", 0)))
+            servers = max(servers, int(env.get("DMLC_NUM_SERVER", 0)))
+        except ValueError:
+            pass
+        u = [x for x in (env.get("MXT_SERVER_URIS") or "").split(",") if x]
+        if len(u) > len(uris):
+            uris = u
+    expected = [("worker", str(i)) for i in range(workers)] + \
+               [("server", str(i)) for i in range(servers)]
+    return expected, uris
+
+
+def all_events(bundles):
+    """Every event across every bundle, time-ordered, tagged with its
+    witness process."""
+    out = []
+    for (role, rank), b in bundles.items():
+        for e in b.get("events") or []:
+            if isinstance(e, dict):
+                out.append(dict(e, witness="%s-%s" % (role, rank)))
+    out.sort(key=lambda e: e.get("ts", 0))
+    return out
+
+
+def _names_uri(event, uri, rank, role):
+    """Does this event name the dead process (by uri, ident or the dead
+    list a failover carries)?  Eviction events carry the member under
+    ``ident`` — a server's ident IS its uri, a worker's is its rank."""
+    if uri and (event.get("uri") == uri
+                or uri in (event.get("dead") or [])):
+        return True
+    ident = event.get("ident")
+    if ident is not None:
+        if uri and str(ident) == uri:
+            return True
+        if role == "worker" and str(ident) == str(rank):
+            return True
+    return False
+
+
+def build_report(health_dir, trace_dir=None):
+    bundles, broken = load_bundles(health_dir)
+    expected, uris = expected_processes(bundles)
+    events = all_events(bundles)
+
+    dead = []
+    for role, rank in expected:
+        if (role, rank) in bundles:
+            continue
+        # no bundle at all: a SIGKILL-shaped death (the atexit dump
+        # never ran) — name it and gather what the survivors saw
+        uri = None
+        if role == "server":
+            try:
+                uri = uris[int(rank)]
+            except (IndexError, ValueError):
+                uri = None
+        named = [e for e in events
+                 if e["kind"] in WITNESS_KINDS
+                 and _names_uri(e, uri, rank, role)]
+        death_ts = named[0]["ts"] if named else None
+        # the repair the death triggered: repair-family events from the
+        # survivors at/after the first death evidence (small slack for
+        # clock scatter between processes on one host)
+        repair = [e for e in events
+                  if e["kind"] in REPAIR_KINDS
+                  and (death_ts is None or e["ts"] >= death_ts - 1.0)]
+        phases = []
+        for e in repair:
+            if e["kind"] not in phases:
+                phases.append(e["kind"])
+        dead.append({
+            "role": role,
+            "rank": rank,
+            "uri": uri,
+            "shape": "sigkill",          # died without a goodbye bundle
+            "death_ts": death_ts,
+            "named_by": sorted({e["witness"] for e in named}),
+            "witness_events": named,
+            "repair_phases": phases,
+            "phase_in_flight": next(
+                (e["kind"] for e in repair
+                 if e["kind"].startswith("handoff.")),
+                phases[0] if phases else None),
+        })
+    # processes that DID leave a bundle but recorded a violent reason.
+    # Deliberately NOT violent: channel_poison (witness evidence of
+    # someone ELSE's death — a worker that poisoned, repaired and
+    # exited cleanly is a survivor) and sigterm (the launcher TERMs
+    # every server at normal end-of-job; a process that dumped on
+    # SIGTERM said goodbye — it is listed under "terminated" instead,
+    # so an early kill -TERM is still on the record without every
+    # healthy run's report naming its servers dead)
+    for (role, rank), b in sorted(bundles.items()):
+        violent = [r for r in (b.get("reasons") or [])
+                   if r in ("crash", "thread_crash")]
+        if violent and not any(d["role"] == role and d["rank"] == rank
+                               for d in dead):
+            exc = b.get("exception") or {}
+            dead_entry = {
+                "role": role, "rank": rank,
+                "uri": (uris[int(rank)]
+                        if role == "server" and rank.isdigit()
+                        and int(rank) < len(uris) else None),
+                "shape": violent[-1],
+                "death_ts": b.get("ts"),
+                "named_by": ["self"],
+                "witness_events": [],
+                "repair_phases": [],
+                "phase_in_flight": None,
+            }
+            if exc:
+                dead_entry["exception"] = {
+                    "type": exc.get("type"),
+                    "message": exc.get("message")}
+            dead.append(dead_entry)
+    # terminated = SIGTERM'd AND otherwise clean: a process already in
+    # the dead list (it crashed too, around the TERM) must not ALSO be
+    # reported as a graceful goodbye
+    dead_names = {"%s-%s" % (d["role"], d["rank"]) for d in dead}
+    terminated = ["%s-%s" % (role, rank)
+                  for (role, rank), b in sorted(bundles.items())
+                  if "sigterm" in (b.get("reasons") or [])
+                  and "%s-%s" % (role, rank) not in dead_names]
+    report = {
+        "schema": 1,
+        "health_dir": os.path.abspath(health_dir),
+        "expected": ["%s-%s" % p for p in expected],
+        "present": ["%s-%s" % p for p in sorted(bundles)],
+        "broken_bundles": broken,
+        "dead": dead,
+        "terminated": terminated,
+        "survivors": {
+            "%s-%s" % (role, rank): {
+                "status": b.get("status"),
+                "reasons": b.get("reasons"),
+                "trips": b.get("trips"),
+                "roster_generation": b.get("roster_generation"),
+            } for (role, rank), b in sorted(bundles.items())},
+        "timeline": events,
+    }
+    if trace_dir:
+        # tools/trace_merge.py owns the torn-line-tolerant journal
+        # reader — one implementation, so a future framing change can
+        # never diverge between the merge tool and this count
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import trace_merge
+        spans = {}
+        for path in sorted(glob.glob(os.path.join(trace_dir,
+                                                  "*.trace.jsonl"))):
+            try:
+                spans[os.path.basename(path)] = \
+                    len(trace_merge.read_spans(path))
+            except OSError:
+                continue
+        report["trace_journals"] = spans
+    return report
+
+
+def render(report) -> str:
+    """The human-readable incident summary (the JSON is the machine
+    face; CI asserts against it)."""
+    lines = ["postmortem: %s" % report["health_dir"],
+             "  expected %d process(es), %d left a bundle" % (
+                 len(report["expected"]), len(report["present"]))]
+    if not report["dead"]:
+        lines.append("  no deaths detected: every expected process "
+                     "left a goodbye bundle with no violent reason")
+    for d in report["dead"]:
+        who = "%s-%s" % (d["role"], d["rank"])
+        if d.get("uri"):
+            who += " (%s)" % d["uri"]
+        lines.append("  DEAD: %s — %s" % (who, d["shape"]))
+        if d.get("exception"):
+            lines.append("    exception: %s: %s" % (
+                d["exception"].get("type"), d["exception"].get("message")))
+        if d["named_by"]:
+            lines.append("    named by: %s (%d witness event(s))"
+                         % (", ".join(d["named_by"]),
+                            len(d["witness_events"])))
+        if d["phase_in_flight"]:
+            lines.append("    repair phase in flight: %s (phases run: %s)"
+                         % (d["phase_in_flight"],
+                            " -> ".join(d["repair_phases"])))
+    for name in report.get("terminated", ()):
+        lines.append("  terminated (SIGTERM, said goodbye): %s" % name)
+    for name, s in report["survivors"].items():
+        lines.append("  survivor %s: status=%s trips=%s"
+                     % (name, s.get("status"), s.get("trips") or {}))
+    if report.get("trace_journals") is not None:
+        lines.append("  trace journals: %s" % report["trace_journals"])
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/postmortem.py",
+        description="merge mxnet_tpu.health crash bundles into one "
+                    "incident report (docs/OBSERVABILITY.md)")
+    ap.add_argument("health_dir",
+                    help="the MXNET_HEALTH_DIR the job dumped bundles "
+                         "into")
+    ap.add_argument("--trace-dir", default=None,
+                    help="optional MXNET_TRACE_DIR: per-process span "
+                         "journals enrich the report (torn tails "
+                         "tolerated)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the full JSON report here")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.health_dir):
+        print("postmortem: no such directory: %s" % args.health_dir,
+              file=sys.stderr)
+        return 2
+    report = build_report(args.health_dir, trace_dir=args.trace_dir)
+    print(render(report))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f, sort_keys=True, indent=1, default=str)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
