@@ -425,7 +425,8 @@ def _run(batch):
     # configs.  See profiler.channel_bytes / docs/PERF_NOTES.md.
     from mxnet_tpu import profiler as _mx_prof
     from mxnet_tpu import health as _mx_health
-    wire0 = sum(_mx_prof.channel_bytes().values())
+    wire0 = _mx_prof.wire_bytes_total()
+    ici0 = _mx_prof.ici_bytes_total()
     sync0 = _mx_prof.host_sync_total()
     wait0 = _mx_prof.wire_wait_ms()
     round0 = _mx_prof.wire_round_ms()
@@ -437,7 +438,8 @@ def _run(batch):
     host_syncs = _mx_prof.host_sync_total() - sync0
     hard_sync()
     dt = time.perf_counter() - t0
-    wire_bytes = sum(_mx_prof.channel_bytes().values()) - wire0
+    wire_bytes = _mx_prof.wire_bytes_total() - wire0
+    ici_bytes = _mx_prof.ici_bytes_total() - ici0
     # overlap over THIS timed region only (wait/round deltas), so
     # warmup and earlier configs can't dilute the reported fraction
     wire_wait_d = _mx_prof.wire_wait_ms() - wait0
@@ -471,6 +473,13 @@ def _run(batch):
         "steps_per_call": steps_per_call,
         "wire_bytes_per_step": round(
             wire_bytes / iters / steps_per_call, 1),
+        # in-host mesh bytes of the hierarchical kvstore tier
+        # (MXNET_KVSTORE_HIERARCHY): the bytes the tier moved OFF the
+        # wire and onto ICI — 0 when the tier is off.  Its companion
+        # regression gate is wire_bytes_per_step dropping by ~the
+        # workers-per-host factor (docs/PERF_NOTES.md round 11)
+        "ici_bytes_per_step": round(
+            ici_bytes / iters / steps_per_call, 1),
         # host-blocking readbacks per TRAINING step (profiler.host_syncs)
         # — 0.0 in the steady state: the sync-free loop's one number.
         # Nonzero means something in the step path re-grew a per-step

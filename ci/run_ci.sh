@@ -218,6 +218,86 @@ JAX_PLATFORMS=cpu timeout -k 10 240 \
     --env MXNET_FI_DELAY_ACK_MS=10 \
     python tests/dist/dist_fused_runsteps.py
 
+echo "== hierarchical kvstore smoke (in-mesh reduce + per-host wire shipping)"
+# ISSUE 14's tentpole gate: two workers forming ONE host group
+# (--workers-per-host 2) train flat then hierarchical through the fused
+# driver.  Both runs must land BIT-IDENTICAL on the same analytic
+# golden (summed SGD == sequential pushes, exact dyadics), the server's
+# own byte counters must show the hierarchy phase's wire at <= 60% of
+# the flat phase (the >= 40% acceptance drop), and the follower's
+# gradients must show up in the new "ici_*" counter family instead of
+# "sent" (the numbers behind bench.py's ici_bytes_per_step).  Runs
+# traced: the merged timeline must show the new tier — kv.mesh_reduce
+# and kv.leader_ship spans descending from a fused.chunk.  Time-boxed:
+# a fan-in regression presents as a hang, a byte regression as a
+# failed inequality.
+rm -rf /tmp/_trace_hier && mkdir -p /tmp/_trace_hier
+JAX_PLATFORMS=cpu MXNET_TRACE=1 MXNET_TRACE_DIR=/tmp/_trace_hier \
+    timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 --workers-per-host 2 \
+    python tests/dist/dist_hier_smoke.py
+python tools/trace_merge.py --spans /tmp/_trace_hier \
+    -o /tmp/_trace_hier_merged.json
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+m = json.load(open("/tmp/_trace_hier_merged.json"))
+evs = [e for e in m["traceEvents"] if e.get("ph") == "X"]
+by_span = {e["args"]["span"]: e for e in evs}
+def ancestors(e):
+    seen = set()
+    while e is not None and e["args"].get("parent") not in seen:
+        p = e["args"].get("parent")
+        seen.add(p)
+        e = by_span.get(p)
+        if e is not None:
+            yield e["name"]
+for name in ("kv.mesh_reduce", "kv.leader_ship"):
+    spans = [e for e in evs if e["name"] == name]
+    assert spans, f"merged hierarchy trace has no {name} span"
+    assert any("fused.chunk" in set(ancestors(s)) for s in spans), \
+        f"{name} never descends from a fused.chunk span"
+assert any(e["name"] == "kv.wire_wait" and e["args"].get("mesh")
+           for e in evs if e.get("args")), \
+    "no follower mesh wire_wait span"
+print("hier trace OK: mesh_reduce + leader_ship under fused.chunk")
+PY
+
+echo "== elastic-fused smoke (SIGKILL a server mid-drive of the chunked driver)"
+# The fused x elastic composition (ISSUE 14's second half): a single
+# worker drives K steps through executor.drive_chunked_dist with a
+# striped weight; server 1 is REALLY SIGKILLed right after serving the
+# first push of chunk 2 (deterministic ack arithmetic in the script),
+# leaving the chunk's second push and its pull round unserved.  The
+# push leg must repair+re-route, the in-flight _PullHandle must REPLAN
+# its unserved stripes against the survivor's layout, and the job must
+# complete with NO eager fallback (one dispatch per chunk, pinned)
+# bit-identical to the static-roster golden.  Runs traced: the merged
+# timeline must carry a kv.replan instant under a kv.repair span.
+# Time-boxed: a replan regression presents as a hang in wait().
+kill_acks_f=$(MXT_PRINT_KILL_ACKS=1 python tests/dist/dist_elastic_fused.py)
+rm -rf /tmp/_trace_efused && mkdir -p /tmp/_trace_efused
+JAX_PLATFORMS=cpu MXNET_TRACE=1 MXNET_TRACE_DIR=/tmp/_trace_efused \
+    timeout -k 10 240 \
+    python tools/launch.py --elastic -n 1 -s 2 \
+    --env MXNET_FI_KILL_PROCESS_AFTER="$kill_acks_f" \
+    --env MXNET_FI_ONLY_SERVER=1 \
+    python tests/dist/dist_elastic_fused.py
+python tools/trace_merge.py --spans /tmp/_trace_efused \
+    -o /tmp/_trace_efused_merged.json
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+m = json.load(open("/tmp/_trace_efused_merged.json"))
+evs = [e for e in m["traceEvents"] if e.get("ph") == "X"]
+by_span = {e["args"]["span"]: e for e in evs}
+replans = [e for e in evs if e["name"] == "kv.replan"]
+assert replans, "merged elastic-fused trace has no kv.replan instant"
+parents = {(by_span.get(e["args"].get("parent")) or {}).get("name")
+           for e in replans}
+assert "kv.repair" in parents, parents
+print("elastic-fused trace OK: %d kv.replan instants under kv.repair"
+      % len(replans))
+PY
+
 echo "== serving smoke (replica + dynamic batcher + live weight refresh)"
 # The inference tier's acceptance across real process/socket boundaries
 # (docs/SERVING.md): one replica serves 64 concurrent requests through

@@ -298,9 +298,9 @@ declare_env("MXNET_KVSTORE_FUSED", bool, True,
             "pull wire overlapped behind the next chunk's compute "
             "(docs/PERF_NOTES.md round 10); 0 restores the eager "
             "per-step dist loop.  Elastic jobs "
-            "(MXNET_KVSTORE_ELASTIC) always take the eager loop — "
-            "roster repair does not compose with in-flight pull_async "
-            "handles yet")
+            "(MXNET_KVSTORE_ELASTIC) ride it too: an in-flight "
+            "pull_async handle replans against the post-bump stripe "
+            "layout (docs/ROBUSTNESS.md replan contract)")
 declare_env("MXNET_KVSTORE_FUSED_CHUNK", int, 8,
             "fused-dist driver: scanned steps per chunk — one host "
             "dispatch and one push/pull wire round per chunk; larger "
@@ -320,6 +320,32 @@ declare_env("MXNET_KVSTORE_FUSED_STALENESS", int, 1,
             "behind one chunk of compute — async-SGD-grade staleness, "
             "same class as the elastic handoff contract",
             tune={"choices": [0, 1, 2]})
+declare_env("MXNET_KVSTORE_HIERARCHY", bool, False,
+            "dist_async: hierarchical reduction tier — workers sharing "
+            "a host (membership.host_groups over the launch topology) "
+            "allreduce gradients in-mesh "
+            "(parallel.mesh.local_allreduce_sum: ICI when the devices "
+            "allow) and only the per-host leader ships the reduced "
+            "gradient over the TCP wire, fanning pulled weights back "
+            "in-mesh; wire bytes per step drop by ~the workers-per-"
+            "host factor (docs/PERF_NOTES.md round 11).  Needs "
+            "MXNET_KVSTORE_WORKERS_PER_HOST and MXT_MESH_URIS (both "
+            "set by tools/launch.py --workers-per-host); static "
+            "rosters only",
+            tune={"choices": [0, 1]})
+declare_env("MXNET_KVSTORE_WORKERS_PER_HOST", int, 0,
+            "hierarchical kvstore tier: worker ranks per host — "
+            "consecutive ranks group (launchers fill host slots in "
+            "order), lowest rank leads.  0 means no topology is "
+            "known: MXNET_KVSTORE_HIERARCHY=1 then refuses loudly "
+            "instead of guessing a mesh that crosses hosts")
+declare_env("MXNET_KVSTORE_MESH_FANIN_S", float, 120.0,
+            "hierarchical kvstore tier: seconds the host-group leader "
+            "waits for every follower's contribution to a push round "
+            "(and a follower's collect waits for the leader's wire "
+            "round) before failing loudly — the fan-in watchdog that "
+            "turns a dead group member into a named error instead of "
+            "a silent hang (the wait is also health-registered)")
 # -- serving tier (mxnet_tpu.serving) ---------------------------------------
 declare_env("MXNET_SERVING_BUCKETS", str, "1,2,4,8,16,32",
             "serving: comma-separated batch-size buckets the replica "

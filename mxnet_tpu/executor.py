@@ -251,6 +251,13 @@ def drive_chunked_dist(num_steps, chunk_size, staleness, dispatch_chunk,
     the staleness-1 analytic golden (and any future autotuned setting)
     simulable and therefore testable (tests/test_fused_dist.py).
 
+    Fault composition: ``handle.wait()`` owns its own recovery — under
+    MXNET_KVSTORE_ELASTIC an in-flight round whose server died mid-pull
+    repairs the roster and REPLANS its unserved stripes from inside the
+    wait (kvstore._PullHandle._replan), so this driver needs no
+    elastic-specific control flow and elastic jobs run chunked instead
+    of falling back to the eager per-step loop.
+
     Returns the FINAL round's pulled values — the server-authoritative
     weights at the sync point — or None when num_steps == 0."""
     import math

@@ -469,11 +469,10 @@ class Trainer:
             # driver scans fwd+bwd+local-update and overlaps the
             # grad-push/weight-pull wire behind the next chunk's
             # compute (the Module.run_steps dist driver's gluon twin).
-            # Elastic jobs keep the eager loop — its blocking pulls
-            # ride the roster-repair wrapper, which an in-flight
-            # pull_async handle cannot yet (docs/ROBUSTNESS.md).
-            if (fusable and env("MXNET_KVSTORE_FUSED", True)
-                    and not getattr(self._kvstore, "_elastic", False)):
+            # Elastic jobs ride it too — an in-flight pull_async
+            # handle replans against the post-bump stripe layout from
+            # inside wait() (docs/ROBUSTNESS.md replan contract).
+            if fusable and env("MXNET_KVSTORE_FUSED", True):
                 self._ensure_kv_optimizer()
                 return self._step_k_fused(loss_fn, data_t, label_t, k,
                                           eval_metric, dist=True)

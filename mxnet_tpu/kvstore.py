@@ -366,7 +366,8 @@ class _ServerConn:
     ``MXNET_KVSTORE_HEARTBEAT_TIMEOUT`` and feeds ``num_dead_nodes()``.
     """
 
-    def __init__(self, uri, connect_timeout=60.0, window=None):
+    def __init__(self, uri, connect_timeout=60.0, window=None, rank=None,
+                 byte_kinds=("sent", "recv")):
         import collections
         import socket as _socket
         import time
@@ -374,7 +375,15 @@ class _ServerConn:
         self._uri = uri
         host, port = uri.rsplit(":", 1)
         self._addr = (host, int(port))
-        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        # ``rank`` override: in-process multi-worker tests (and the
+        # hierarchical tier's follower channels) run several stores of
+        # DIFFERENT ranks in one process, where the env var can only
+        # name one.  ``byte_kinds`` is the (send, recv) counter family
+        # pair — mesh channels count under "ici_*" (kvstore_server
+        # _send_msg byte_kind), the wire keeps the classic kinds.
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0")
+                         if rank is None else rank)
+        self._byte_kinds = tuple(byte_kinds)
         # channel identity: (worker_rank, nonce).  The nonce survives
         # reconnects (so replays dedup) but differs between channel
         # INSTANCES — two clients of the same rank (relaunch, tests)
@@ -594,7 +603,8 @@ class _ServerConn:
         try:
             if self._sock is None:
                 raise ConnectionError("channel has no connection")
-            _send_msg(self._sock, envelope, fi_role="client")
+            _send_msg(self._sock, envelope, fi_role="client",
+                      byte_kind=self._byte_kinds[0])
             faultinject.client_window(self._sock, len(self._inflight))
         except Exception as exc:  # noqa: BLE001 — transport fault
             self._recover_or_fail(exc)
@@ -605,7 +615,8 @@ class _ServerConn:
         from .kvstore_server import _recv_msg
         from . import profiler as _prof
         try:
-            reply = _recv_msg(self._sock, fi_role="client")
+            reply = _recv_msg(self._sock, fi_role="client",
+                              byte_kind=self._byte_kinds[1])
         except Exception as exc:  # noqa: BLE001 — transport fault
             self._recover_or_fail(exc)
             return
@@ -655,7 +666,8 @@ class _ServerConn:
                 for entry in self._inflight:
                     _prof.record_channel_event("kvstore.replay")
                     entry[2] = True
-                    _send_msg(self._sock, entry[0], fi_role="client")
+                    _send_msg(self._sock, entry[0], fi_role="client",
+                              byte_kind=self._byte_kinds[0])
                 return
             except Exception as exc:  # noqa: BLE001 — fault mid-replay
                 if self._closing.is_set():
@@ -739,8 +751,10 @@ class _ServerConn:
                     sock = socket.create_connection(
                         self._addr, timeout=self._hb_timeout)
                     sock.settimeout(self._hb_timeout)
-                _send_msg(sock, ("ping", self._rank))
-                status, _payload = _recv_msg(sock)
+                _send_msg(sock, ("ping", self._rank),
+                          byte_kind=self._byte_kinds[0])
+                status, _payload = _recv_msg(
+                    sock, byte_kind=self._byte_kinds[1])
                 if status == "ok":
                     self._hb_last_ack = time.monotonic()
                     _prof.record_channel_event("kvstore.heartbeat")
@@ -868,24 +882,22 @@ def _await(pending):
     return pending.value
 
 
-class _PullHandle:
-    """One in-flight batched pull (:meth:`KVStoreDistAsync.pull_async`).
-
-    ``wait()`` blocks for every reply, reassembles stripes, syncs the
-    elastic pull cache exactly like a blocking :meth:`pull`, and returns
-    ``{key: np.ndarray}``.  It also feeds the two wire-overlap clocks
+class _WireHandle:
+    """The shared timed-wait shell of the pull handles: idempotent,
+    thread-safe ``wait()`` (any thread — the hierarchy tier's
+    mesh-collect server waits the leader's handles concurrently with
+    the fused driver) feeding the two wire-overlap clocks
     (profiler.record_wire_wait / record_wire_round): the time spent
-    BLOCKED inside ``wait()`` is the exposed wire, the enqueue->resolved
-    span is the full round — their ratio is the overlap fraction the
-    fused-dist driver is regression-gated on.  Idempotent: a second
-    ``wait()`` returns the cached result without re-counting."""
+    BLOCKED inside ``wait()`` is the exposed wire, the
+    enqueue->resolved span is the full round — their ratio is the
+    overlap fraction the fused-dist driver is regression-gated on.
+    Subclasses implement ``_resolve() -> {key: np.ndarray}`` and
+    ``_nkeys()``; ``_span_args`` tags the spans."""
 
-    __slots__ = ("_kv", "_reqs", "_t0", "_t0_ns", "_ctx", "_result")
+    _span_args = None
 
-    def __init__(self, kv, reqs):
+    def __init__(self):
         import time
-        self._kv = kv
-        self._reqs = reqs
         self._t0 = time.monotonic()
         # the enqueue site's span context anchors the ROUND span: the
         # full enqueue->resolved interval crosses threads/chunks, so it
@@ -893,46 +905,417 @@ class _PullHandle:
         self._t0_ns = time.monotonic_ns() if _tr.enabled() else 0
         self._ctx = _tr.current_ctx() if _tr.enabled() else None
         self._result = None
+        self._lock = threading.Lock()
 
     def wait(self):
-        if self._result is not None:
-            return self._result
-        import time
+        with self._lock:
+            if self._result is not None:
+                return self._result
+            import time
+            from . import profiler as _prof
+            t_wait = time.monotonic()
+            sp = _tr.span_begin("kv.wire_wait", cat="wire",
+                                args=self._span_args)
+            # registered with the health watchdog: a wire wait parked
+            # past MXNET_HEALTH_WIRE_STALL_S with its round never
+            # resolving trips a typed wire_stall event
+            # (docs/OBSERVABILITY.md health section)
+            wtok = _health.wait_begin("kv.wire_wait")
+            try:
+                vals = self._resolve()
+            finally:
+                # end even when a channel failure raises out of the
+                # resolve: a leaked open span would stay on the
+                # thread-local stack and mis-parent every later span
+                # on this thread
+                _tr.span_end(sp, args={"keys": self._nkeys()})
+                _health.wait_end(wtok)
+            t1 = time.monotonic()
+            _prof.record_wire_wait(t1 - t_wait)
+            _prof.record_wire_round(t1 - self._t0)
+            if self._t0_ns:
+                # the overlap the fused driver buys becomes VISIBLE:
+                # the round span (enqueue->resolved) sits over the
+                # wire_wait span (the exposed residue) on the timeline
+                args = {"keys": self._nkeys()}
+                if self._span_args:
+                    args.update(self._span_args)
+                _tr.add_span("kv.wire_round", self._t0_ns,
+                             time.monotonic_ns(), cat="wire",
+                             ctx=self._ctx, args=args)
+            self._result = vals
+            return vals
+
+
+class _PullHandle(_WireHandle):
+    """One in-flight batched pull (:meth:`KVStoreDistAsync.pull_async`):
+    ``wait()`` blocks for every reply, reassembles stripes, syncs the
+    elastic pull cache exactly like a blocking :meth:`pull`, and
+    returns ``{key: np.ndarray}``.
+
+    **Elastic replan** (the fused×elastic composition): entries carry
+    each key's full shape and per-stripe row spans, so when a pending
+    stripe dies with its server mid-flight, ``wait()`` repairs the
+    roster (``KVStoreDistAsync._elastic_repair_impl``) and re-issues
+    ONLY the unserved tail under the new stripe layout — stripes whose
+    row span survived the bump keep their already-received values, the
+    rest re-request from the new owners — then re-awaits.  Cache and
+    clock bookkeeping stay exact: one ``_cache_value`` per key with the
+    final assembled value (its absorb mark advanced when the replan
+    re-issued against a log that had grown), one wire_wait/wire_round
+    sample per handle.  Entries are ``{key, shape, mark, parts: [[lo,
+    hi, wire_key, pending, value]]}`` with exactly one of
+    pending/value set per part."""
+
+    def __init__(self, kv, entries):
+        super().__init__()
+        self._kv = kv
+        self._entries = entries
+
+    def _nkeys(self):
+        return len(self._entries)
+
+    def _resolve(self):
+        """Await every part; on a channel failure under
+        MXNET_KVSTORE_ELASTIC, repair the roster and replan the
+        unserved tail against the new stripe layout (bounded retries —
+        the same budget as ``_elastic_attempt``)."""
+        kv = self._kv
+        attempts = 0
+        while True:
+            last_err = None
+            for e in self._entries:
+                for part in e["parts"]:
+                    if part[4] is not None:
+                        continue
+                    if part[3] is None:
+                        # re-issue itself failed last replan: the part
+                        # is still unserved — keep repairing
+                        last_err = last_err or MXNetError(
+                            f"pull of {part[2]!r} could not be "
+                            "re-issued after the roster repair")
+                        continue
+                    try:
+                        part[4] = np.asarray(_await(part[3]))
+                        part[3] = None
+                    except MXNetError as exc:
+                        part[3] = None
+                        last_err = exc
+            if last_err is None:
+                break
+            attempts += 1
+            if not getattr(kv, "_elastic", False) or attempts > 2:
+                raise last_err
+            # one kv.repair span covers the roster repair AND the
+            # replan instants it triggers, so the merged timeline shows
+            # "this in-flight pull rode a roster bump" in one place
+            with _tr.span("kv.repair", cat="elastic",
+                          args={"replan": True}):
+                try:
+                    kv._elastic_repair_impl()
+                except MXNetError:
+                    pass   # re-issue below may still reach survivors
+                self._replan()
+        out = {}
+        for e in self._entries:
+            parts = sorted(e["parts"], key=lambda p: p[0])
+            if len(parts) == 1:
+                val = parts[0][4]
+            else:
+                val = np.concatenate([p[4] for p in parts], axis=0)
+            # absorb only the pushes this pull OBSERVED (its enqueue
+            # mark): the fused driver resolves handles chunks later,
+            # with newer pushes in flight that must stay in the
+            # elastic re-push log
+            kv._cache_value(e["key"], val, mark=e.get("mark"))
+            out[e["key"]] = val
+        return out
+
+    def _replan(self):
+        """Re-derive the stripe layout of every key with unserved parts
+        and re-issue exactly those — a part whose (lo, hi) row span is
+        unchanged under the new plan keeps its received value (the
+        'unserved tail' contract, docs/ROBUSTNESS.md).
+
+        Mark discipline: a re-issued request is enqueued NOW — after
+        the repair's handoff re-pushes and any pushes logged since the
+        original enqueue (per-conn FIFO: its reply observes them all) —
+        so when the log has grown past the entry's mark, the WHOLE key
+        re-issues (mixing newly-observed rows with pre-push received
+        spans would make the cache absorb inconsistently) and the mark
+        advances to the current position.  With no interleaved pushes
+        the received spans are exact and reuse is safe."""
         from . import profiler as _prof
-        t_wait = time.monotonic()
-        sp = _tr.span_begin("kv.wire_wait", cat="wire")
-        # registered with the health watchdog: a wire wait parked past
-        # MXNET_HEALTH_WIRE_STALL_S with its round never resolving trips
-        # a typed wire_stall event (docs/OBSERVABILITY.md health section)
-        wtok = _health.wait_begin("kv.wire_wait")
+        kv = self._kv
+        for e in self._entries:
+            if all(p[4] is not None for p in e["parts"]):
+                continue
+            k, shape = e["key"], e["shape"]
+            plan = kv._stripe_plan(k, shape)
+            if plan is None:
+                spans = [(0, int(shape[0]) if shape else 0, k)]
+            else:
+                spans = [(plan[i], plan[i + 1], f"{k}@s{i}")
+                         for i in range(len(plan) - 1)]
+            cur_mark = kv._push_mark(k)
+            if cur_mark != e.get("mark"):
+                resolved = {}
+                e["mark"] = cur_mark
+            else:
+                resolved = {(p[0], p[1]): p[4] for p in e["parts"]
+                            if p[4] is not None}
+            new_parts, reissued = [], 0
+            for lo, hi, wk in spans:
+                if (lo, hi) in resolved:
+                    new_parts.append([lo, hi, wk, None, resolved[(lo, hi)]])
+                    continue
+                try:
+                    pending = kv._owner_conn(wk).request(("pull", wk))
+                except MXNetError:
+                    pending = None   # still down: next attempt retries
+                new_parts.append([lo, hi, wk, pending, None])
+                reissued += 1
+            e["parts"] = new_parts
+            _prof.record_channel_event("kvstore.pull_replan")
+            _tr.instant("kv.replan", cat="elastic",
+                        args={"key": k, "reissued": reissued,
+                              "kept": len(spans) - reissued,
+                              "generation": kv._roster_gen})
+
+
+class _MeshPullHandle(_WireHandle):
+    """The follower half of a hierarchical pull round: one
+    ``mesh_collect`` request against the host-group leader, resolved
+    when the leader's own wire round for the same sequence resolves.
+    Interface-compatible with :class:`_PullHandle` (``wait() -> {key:
+    np.ndarray}``) and shares its timed-wait shell, so the fused
+    driver's overlap accounting holds on followers too — their
+    "wire" is the in-host mesh channel (spans tagged ``mesh``)."""
+
+    _span_args = {"mesh": True}
+
+    def __init__(self, kv, keys, pending):
+        super().__init__()
+        self._kv = kv
+        self._keys = list(keys)
+        self._pending = pending
+
+    def _nkeys(self):
+        return len(self._keys)
+
+    def _resolve(self):
+        reply = _await(self._pending)
+        return {k: np.asarray(reply[k]) for k in self._keys}
+
+
+class _MeshLeader:
+    """The host-group leader's in-host aggregation endpoint
+    (``MXNET_KVSTORE_HIERARCHY`` — the hierarchical kvstore tier).
+
+    Followers on the same host connect through ordinary
+    :class:`_ServerConn` channels (window 1: the replay window is then
+    a single envelope, so the one-slot dedup below makes reconnect
+    replays exactly-once) and speak three ops over the standard frame
+    protocol, all bytes counted under the "ici_*" families:
+
+    * ``("mesh_push", seq, [(key, grad), ...])`` — deposit one push
+      round's gradients; the leader's ``_push_aggregated`` blocks on
+      :meth:`collect_push` until every follower's round ``seq``
+      arrived, reduces in-mesh and ships ONE summed push per key over
+      the TCP wire.
+    * ``("mesh_collect", seq, keys)`` — block until the leader's wire
+      pull for sequence ``seq`` resolves (:meth:`publish_handle`
+      registers it at ``pull_async`` time) and return its values: the
+      weight fan-out leg.  Served directly off the leader's
+      :class:`_PullHandle` (thread-safe ``wait``), so followers and
+      the leader's own fused driver resolve the SAME wire round.
+    * ``("command", ...)`` / ``("ping", ...)`` — flush/liveness no-ops.
+
+    Sequences pair by SPMD lockstep: every group member executes the
+    identical sequence of push/pull calls (the data-parallel contract
+    the whole repo leans on), so counter ``seq`` on the follower names
+    the same logical round as ``seq`` on the leader.  A member that
+    falls silent trips the fan-in timeout (``MXNET_KVSTORE_MESH_FANIN_S``)
+    — a loud error naming the missing round, never a silent hang (the
+    wait is also health-registered, so the watchdog sees it age)."""
+
+    def __init__(self, uri, n_followers):
+        import socket
+        from .base import env as _env
+        from .kvstore_server import _set_nodelay
+        host, port = uri.rsplit(":", 1)
+        self._uri = uri
+        self._n_followers = int(n_followers)
+        self._fanin_s = float(_env("MXNET_KVSTORE_MESH_FANIN_S", 120.0))
+        self._listener = socket.create_server((host, int(port)))
+        self._listener.settimeout(0.5)
+        self._stop = threading.Event()
+        self._cv = threading.Condition()
+        self._pushes: Dict[int, list] = {}    # seq -> [pairs, ...]
+        self._handles: Dict[int, list] = {}   # seq -> [handle, served]
+        # per-CLIENT envelope dedup (survives reconnects — a replay
+        # arrives on a FRESH connection): cid -> (seq, reply), plus the
+        # in-flight rendezvous for a replay racing the original
+        self._dedup: Dict[tuple, tuple] = {}
+        self._dedup_inflight: Dict[tuple, int] = {}
+        self._conns: list = []
+        self._set_nodelay = _set_nodelay
+        # analysis: allow(bare-thread): a crash closes the listener in run()'s finally — followers observe refused connects / EOF and fail their channels loudly, exactly like a dead parameter server
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- leader-side API (called from the worker's main thread) ----------
+    def collect_push(self, seq):
+        """Block until every follower's round ``seq`` gradients arrived;
+        pop and return them (a list of ``[(key, grad), ...]``)."""
+        wtok = _health.wait_begin("kv.mesh_fanin")
         try:
-            vals = {}
-            for k, pending in self._reqs:
-                if isinstance(pending, list):
-                    val = np.concatenate(
-                        [np.asarray(_await(p)) for p in pending], axis=0)
-                else:
-                    val = np.asarray(_await(pending))
-                self._kv._cache_value(k, val)
-                vals[k] = val
+            with self._cv:
+                ok = self._cv.wait_for(
+                    lambda: len(self._pushes.get(seq, ()))
+                    >= self._n_followers or self._stop.is_set(),
+                    timeout=self._fanin_s)
+                if not ok or self._stop.is_set():
+                    raise MXNetError(
+                        f"mesh leader {self._uri}: round {seq} fan-in "
+                        f"incomplete ({len(self._pushes.get(seq, ()))} "
+                        f"of {self._n_followers} followers) within "
+                        f"MXNET_KVSTORE_MESH_FANIN_S={self._fanin_s}s")
+                return self._pushes.pop(seq)
         finally:
-            # end even when a channel failure raises out of _await: a
-            # leaked open span would stay on the thread-local stack and
-            # mis-parent every later span on this thread
-            _tr.span_end(sp, args={"keys": len(self._reqs)})
             _health.wait_end(wtok)
-        t1 = time.monotonic()
-        _prof.record_wire_wait(t1 - t_wait)
-        _prof.record_wire_round(t1 - self._t0)
-        if self._t0_ns:
-            # the overlap the fused driver buys becomes VISIBLE: the
-            # round span (enqueue->resolved) sits over the wire_wait
-            # span (the exposed residue) on the merged timeline
-            _tr.add_span("kv.wire_round", self._t0_ns,
-                         time.monotonic_ns(), cat="wire", ctx=self._ctx,
-                         args={"keys": len(self._reqs)})
-        self._result = vals
-        return vals
+
+    def publish_handle(self, seq, handle):
+        """Register the leader's wire pull for round ``seq`` so
+        mesh_collect waiters can resolve against it."""
+        with self._cv:
+            self._handles[seq] = [handle, 0]
+            self._cv.notify_all()
+
+    def close(self):
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for c in list(self._conns):
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- serve side -------------------------------------------------------
+    def _run(self):
+        import socket
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                self._set_nodelay(conn)
+                self._conns.append(conn)
+                t = threading.Thread(target=self._serve_conn,
+                                     args=(conn,), daemon=True)
+                t.start()
+        finally:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _serve_conn(self, conn):
+        from .kvstore_server import _send_msg, _recv_msg
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    try:
+                        msg = _recv_msg(conn, byte_kind="ici_recv")
+                    except (ConnectionError, OSError):
+                        return
+                    if msg and msg[0] == "req":
+                        _, cid, seq, inner = msg[:4]
+                        reply = self._exactly_once(cid, seq, inner)
+                    else:
+                        # raw heartbeat pings from the follower channel
+                        reply = ("ok", None)
+                    try:
+                        _send_msg(conn, reply, byte_kind="ici_sent")
+                    except (ConnectionError, OSError):
+                        return
+        except Exception:  # noqa: BLE001 — conn died mid-reply
+            pass
+
+    def _exactly_once(self, cid, seq, inner):
+        """Per-CLIENT single-slot dedup, keyed (client_id, seq) like
+        the real server's window so a reconnect REPLAY — which arrives
+        on a FRESH connection whose thread has no local state — still
+        hits the cache instead of re-executing (a re-executed
+        mesh_push would double a follower's gradient in the round).
+        One slot per client suffices: mesh channels run window 1, so
+        at most one envelope per follower is ever unacked.  A replay
+        racing the original's in-flight execution parks until its
+        reply is stored (the zombie-duplicate shape the real server's
+        window also covers)."""
+        with self._cv:
+            while True:
+                have = self._dedup.get(cid)
+                if have is not None and have[0] == seq:
+                    return have[1]
+                if self._dedup_inflight.get(cid) != seq:
+                    self._dedup_inflight[cid] = seq
+                    break
+                if not self._cv.wait(timeout=self._fanin_s):
+                    return ("err", "mesh leader: duplicate envelope "
+                                   "parked past the fan-in budget")
+        try:
+            reply = ("ok", self._handle(inner))
+        except Exception as exc:  # noqa: BLE001
+            reply = ("err", f"{type(exc).__name__}: {exc}")
+        with self._cv:
+            self._dedup[cid] = (seq, reply)
+            if self._dedup_inflight.get(cid) == seq:
+                del self._dedup_inflight[cid]
+            self._cv.notify_all()
+        return reply
+
+    def _handle(self, inner):
+        from . import profiler as _prof
+        op = inner[0]
+        if op == "mesh_push":
+            _, seq, pairs = inner
+            with self._cv:
+                self._pushes.setdefault(int(seq), []).append(pairs)
+                self._cv.notify_all()
+            _prof.record_channel_event("kvstore.mesh_push")
+            return None
+        if op == "mesh_collect":
+            _, seq, keys = inner
+            seq = int(seq)
+            with self._cv:
+                ok = self._cv.wait_for(
+                    lambda: seq in self._handles or self._stop.is_set(),
+                    timeout=self._fanin_s)
+                if not ok or self._stop.is_set():
+                    raise MXNetError(
+                        f"mesh leader {self._uri}: no wire round "
+                        f"registered for collect seq {seq} within "
+                        f"{self._fanin_s}s")
+                entry = self._handles[seq]
+            vals = entry[0].wait()   # thread-safe, idempotent
+            with self._cv:
+                entry[1] += 1
+                if entry[1] >= self._n_followers:
+                    self._handles.pop(seq, None)
+            _prof.record_channel_event("kvstore.mesh_collect")
+            return {k: vals[k] for k in keys}
+        if op == "command":
+            return None   # follower channel flush token
+        raise MXNetError(f"mesh leader: unknown op {op!r}")
 
 
 class KVStoreDistAsync(KVStore):
@@ -954,8 +1337,12 @@ class KVStoreDistAsync(KVStore):
     norms instead, exactly the reference's striping caveat.
     """
 
-    def __init__(self, uris=None, roster_member=None):
+    def __init__(self, uris=None, roster_member=None, rank=None):
         super().__init__("dist_async")
+        # explicit rank override (tests running several worker stores —
+        # different ranks — in ONE process, where the DMLC env can only
+        # name one; the launcher path leaves it None)
+        self._rank_override = None if rank is None else int(rank)
         if uris is None:
             uris = os.environ.get("MXT_SERVER_URIS", "")
         elif not isinstance(uris, str):
@@ -989,6 +1376,16 @@ class KVStoreDistAsync(KVStore):
         self._barrier_seq = 0         # per-worker barrier sequence
         self._pull_cache: Dict[str, np.ndarray] = {}
         self._push_log: Dict[str, list] = {}
+        # absolute per-key push positions: _push_log_seq counts every
+        # push ever logged, _push_log_absorbed how many of those the
+        # cache has absorbed.  A pull's cache sync may only absorb
+        # pushes issued BEFORE the pull was ENQUEUED (its "mark") — the
+        # fused driver resolves pulls chunks later, with newer pushes
+        # already in flight, and absorbing those would drop them from
+        # the elastic re-push log (the exact-bookkeeping half of the
+        # ISSUE 14 replan contract)
+        self._push_log_seq: Dict[str, int] = {}
+        self._push_log_absorbed: Dict[str, int] = {}
         self._push_log_order = None
         self._push_log_cap = int(_env("MXNET_KVSTORE_ELASTIC_PUSH_LOG",
                                       256))
@@ -1007,7 +1404,7 @@ class KVStoreDistAsync(KVStore):
             for i, u in enumerate(uri_list):
                 try:
                     c = _ServerConn(u, connect_timeout=(
-                        60.0 if i == 0 else 15.0))
+                        60.0 if i == 0 else 15.0), rank=self.rank)
                 except MXNetError as exc:
                     last_exc = exc
                     continue
@@ -1032,7 +1429,8 @@ class KVStoreDistAsync(KVStore):
                 self._barrier_seq = int(reply[3])
             conns = []
             for u in servers:
-                conns.append(coord if u == coord._uri else _ServerConn(u))
+                conns.append(coord if u == coord._uri
+                             else _ServerConn(u, rank=self.rank))
             if coord._uri not in servers:
                 coord.close(retry=False)
             self._conns = conns
@@ -1043,7 +1441,8 @@ class KVStoreDistAsync(KVStore):
             _prof.record_channel_gauge("kvstore.roster_generation",
                                        self._roster_gen)
         else:
-            self._conns = [_ServerConn(u) for u in uri_list]
+            self._conns = [_ServerConn(u, rank=self.rank)
+                           for u in uri_list]
         self._bigarray_bound = int(float(os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")))
         self._stripes: Dict[str, list] = {}  # key -> row boundaries
@@ -1068,10 +1467,27 @@ class KVStoreDistAsync(KVStore):
         # silence on any worker↔server channel becomes visible job-wide
         from . import distributed as _dist
         _dist._register_dead_node_source(self)
+        # -- hierarchical tier (MXNET_KVSTORE_HIERARCHY) ------------------
+        # Workers sharing a host form a mesh group: gradients allreduce
+        # in-mesh (parallel.mesh.local_allreduce_sum — ICI when the
+        # devices allow it) and ONLY the per-host leader ships the
+        # reduced gradient over the TCP wire, fanning the pulled
+        # weights back in-mesh — wire bytes per step drop by ~the
+        # workers-per-host factor (docs/PERF_NOTES.md round 11).
+        self._hier = False
+        self._mesh_leader = None    # leader-side endpoint
+        self._mesh_conn = None      # follower-side channel to the leader
+        self._mesh_group = None
+        self._mesh_push_seq = 0
+        self._mesh_pull_seq = 0
+        if bool(_env("MXNET_KVSTORE_HIERARCHY", False)):
+            self._init_hierarchy()
 
     # -- identity (no jax.distributed needed: workers are independent) ------
     @property
     def rank(self) -> int:
+        if getattr(self, "_rank_override", None) is not None:
+            return self._rank_override
         return int(os.environ.get("DMLC_WORKER_ID", "0"))
 
     @property
@@ -1088,6 +1504,80 @@ class KVStoreDistAsync(KVStore):
         # can never diverge
         from .membership import server_index
         return self._conns[server_index(k, len(self._conns))]
+
+    # -- hierarchical tier (MXNET_KVSTORE_HIERARCHY) --------------------------
+    def _init_hierarchy(self):
+        """Resolve this worker's host group (membership.mesh_group over
+        the launch topology) and bring up its side of the mesh tier:
+        the leader binds the group's loopback endpoint (_MeshLeader),
+        followers dial it.  A one-member group (or a 1-worker job) is
+        flat — the tier quietly stays off."""
+        from .base import env as _env
+        from . import membership as _mem
+        if self._elastic:
+            raise MXNetError(
+                "MXNET_KVSTORE_HIERARCHY does not compose with "
+                "MXNET_KVSTORE_ELASTIC yet: the mesh group is derived "
+                "from the static launch topology, and a roster bump "
+                "would strand the in-host tier (docs/ROBUSTNESS.md).  "
+                "Run elastic jobs flat — their fused driver already "
+                "rides the _PullHandle replan path")
+        per_host = int(_env("MXNET_KVSTORE_WORKERS_PER_HOST", 0))
+        if per_host <= 0:
+            raise MXNetError(
+                "MXNET_KVSTORE_HIERARCHY=1 needs the host topology: "
+                "launch with `tools/launch.py --workers-per-host N` "
+                "(which also allocates MXT_MESH_URIS), or set "
+                "MXNET_KVSTORE_WORKERS_PER_HOST and MXT_MESH_URIS "
+                "explicitly")
+        nworkers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        leader, members, gi = _mem.mesh_group(
+            self.rank, range(nworkers), per_host)
+        if len(members) <= 1:
+            return   # a single-member group has nothing to reduce
+        mesh_uris = os.environ.get("MXT_MESH_URIS", "")
+        uris = [u for u in mesh_uris.split(",") if u]
+        if gi >= len(uris):
+            raise MXNetError(
+                f"MXNET_KVSTORE_HIERARCHY: no mesh endpoint for host "
+                f"group {gi} in MXT_MESH_URIS={mesh_uris!r} — launch "
+                "with tools/launch.py --workers-per-host, or export "
+                "one host:port per group")
+        self._hier = True
+        self._mesh_group = members
+        if self.rank == leader:
+            self._mesh_leader = _MeshLeader(uris[gi],
+                                            n_followers=len(members) - 1)
+        else:
+            # window 1: the replay window is one envelope, which the
+            # leader's one-slot dedup makes exactly-once (loopback
+            # RTTs are noise next to the wire round this tier removes)
+            self._mesh_conn = _ServerConn(
+                uris[gi], window=1, rank=self.rank,
+                byte_kinds=("ici_sent", "ici_recv"))
+
+    def _mesh_reduce(self, pairs, contribs):
+        """In-mesh sum of the leader's own gradients with every
+        follower's round contribution — parallel.mesh.local_allreduce_sum
+        (psum-on-devices when the local mesh allows, stacked jnp sum on
+        the CPU stub).  Key sets must match: the group runs the same
+        SPMD program."""
+        from .parallel.mesh import local_allreduce_sum
+        by_key = [dict(c) for c in contribs]
+        reduced = []
+        for k, agg in pairs:
+            parts = [agg]
+            for c in by_key:
+                if k not in c:
+                    raise MXNetError(
+                        f"hierarchical push: follower contribution is "
+                        f"missing key {k!r} — the group's push rounds "
+                        "have diverged (mesh members must run the same "
+                        "program)")
+                parts.append(c[k])
+            reduced.append((k, np.asarray(
+                local_allreduce_sum(parts), dtype=agg.dtype)))
+        return reduced
 
     # -- big-array striping --------------------------------------------------
     def _stripe_plan(self, k: str, shape):
@@ -1317,7 +1807,8 @@ class KVStoreDistAsync(KVStore):
                     # between the coordinator's view and ours — the
                     # caller reports it dead and retries on the smaller
                     # roster instead of blocking a full connect window
-                    c = _ServerConn(u, connect_timeout=10.0)
+                    c = _ServerConn(u, connect_timeout=10.0,
+                                    rank=self.rank)
                     fresh.append((u, c))
                 conns.append(c)
         except MXNetError:
@@ -1519,28 +2010,54 @@ class KVStoreDistAsync(KVStore):
                         wk, agg[plan[i]:plan[i + 1]])),
                     wait=False)
 
-    def _cache_value(self, k: str, arr):
+    def _push_mark(self, k: str) -> int:
+        """The key's current absolute push position — captured at pull
+        ENQUEUE time so the later cache sync absorbs exactly the pushes
+        that pull observed (per-conn FIFO: everything sent before the
+        pull request, nothing after)."""
+        return self._push_log_seq.get(k, 0)
+
+    def _cache_value(self, k: str, arr, mark=None):
         """Remember the last synced full value of ``k`` (the quorum
-        re-push source) and forget the now-absorbed push log."""
+        re-push source) and absorb the log entries the value reflects:
+        everything up to ``mark`` (the pull's enqueue position), or the
+        whole log when ``mark`` is None (init/assign — the value IS the
+        authoritative state)."""
         if not self._elastic:
             return
         self._pull_cache[k] = np.asarray(arr)
-        self._push_log.pop(k, None)
+        seq = self._push_log_seq.get(k, 0)
+        if mark is None or mark > seq:
+            mark = seq
+        absorbed = self._push_log_absorbed.get(k, 0)
+        n = mark - absorbed
+        if n > 0:
+            entries = self._push_log.get(k)
+            if entries:
+                del entries[:min(n, len(entries))]
+                if not entries:
+                    self._push_log.pop(k, None)
+        self._push_log_absorbed[k] = max(absorbed, mark)
 
     def _log_push(self, k: str, agg: np.ndarray):
-        """Remember one pushed gradient until the next pull of ``k``
-        syncs it into the cache (bounded by
+        """Remember one pushed gradient until a pull of ``k`` that
+        observed it syncs it into the cache (bounded by
         MXNET_KVSTORE_ELASTIC_PUSH_LOG entries; the oldest fall off —
         best-effort for jobs that never pull)."""
         if not self._elastic:
             return
         self._push_log.setdefault(k, []).append(np.asarray(agg))
+        self._push_log_seq[k] = self._push_log_seq.get(k, 0) + 1
         self._push_log_order.append(k)
         while len(self._push_log_order) > self._push_log_cap:
             old = self._push_log_order.popleft()
             entries = self._push_log.get(old)
             if entries:
                 entries.pop(0)
+                # a cap-dropped entry counts as absorbed so later
+                # marks keep addressing the list front correctly
+                self._push_log_absorbed[old] = \
+                    self._push_log_absorbed.get(old, 0) + 1
                 if not entries:
                     self._push_log.pop(old, None)
 
@@ -1614,7 +2131,39 @@ class KVStoreDistAsync(KVStore):
         whole chunk's gradients back in ONE stacked device_get and must
         not re-enter through NDArray wrappers).  Compression, striping,
         same-server coalescing and the elastic push log all live here,
-        so the two entry points can never diverge on the wire."""
+        so the two entry points can never diverge on the wire.
+
+        Under MXNET_KVSTORE_HIERARCHY this call IS one mesh round: a
+        follower deposits its raw gradients with the host-group leader
+        (in-host "ici" bytes, no compression — the error-feedback
+        residual lives where the wire is) and returns; the leader
+        blocks for the group's round, reduces in-mesh
+        (``kv.mesh_reduce``) and ships ONE summed push per key through
+        the normal plan below (``kv.leader_ship`` — compression,
+        striping and coalescing all compose on the reduced
+        gradient)."""
+        if self._hier:
+            seq = self._mesh_push_seq
+            self._mesh_push_seq += 1
+            if self._mesh_conn is not None:   # follower
+                self._mesh_conn.submit(
+                    ("mesh_push", seq,
+                     [(k, np.ascontiguousarray(a)) for k, a in pairs]),
+                    wait=False)
+                return
+            with _tr.span("kv.mesh_reduce", cat="hier",
+                          args={"seq": seq, "keys": len(pairs)}):
+                contribs = self._mesh_leader.collect_push(seq)
+                pairs = self._mesh_reduce(pairs, contribs)
+            with _tr.span("kv.leader_ship", cat="hier",
+                          args={"keys": len(pairs)}):
+                self._push_planned(pairs)
+            return
+        self._push_planned(pairs)
+
+    def _push_planned(self, pairs):
+        """The wire half of a push round: compression, striping,
+        same-server coalescing, the elastic push log."""
         small: Dict[int, list] = {}   # conn index -> [(wire_key, payload)]
         planned = []                  # (base_key, conn, msg)
         for k, agg in pairs:
@@ -1722,18 +2271,34 @@ class KVStoreDistAsync(KVStore):
         import jax.numpy as jnp
         assert out is not None
         keys, outs = self._canon(key, out)
+        if self._hier:
+            # one mesh round for the whole call: the leader runs (and
+            # registers) the wire pull, followers collect in-host —
+            # the same rendezvous sequence the fused driver uses, so
+            # eager pulls and pull_async stay interchangeable
+            handle = self.pull_async(
+                list(keys), [tuple(os_[0].shape) for os_ in outs])
+            vals = handle.wait()
+            for k, os_ in zip(keys, outs):
+                val = jnp.asarray(vals[k])
+                for o in os_:
+                    o._set_data(val.astype(o._data.dtype)
+                                if o._data.dtype != val.dtype else val)
+            return
         pendings = []
+        marks = []
         for k, os_ in zip(keys, outs):
             # the plan is deterministic from (key, shape): a client that
             # never init'ed this key derives it from the out array
             plan = self._stripe_plan(k, tuple(os_[0].shape))
+            marks.append(self._push_mark(k))
             if plan is None:
                 pendings.append(self._conn_of(k).request(("pull", k)))
             else:
                 pendings.append([
                     self._stripe_conn(k, i).request(("pull", f"{k}@s{i}"))
                     for i in range(len(plan) - 1)])
-        for k, os_, pending in zip(keys, outs, pendings):
+        for k, os_, pending, mark in zip(keys, outs, pendings, marks):
             # cache from the HOST-side wire replies before converting to
             # jnp: caching the device array instead would cost an extra
             # unrecorded device->host readback per key per pull in
@@ -1746,8 +2311,9 @@ class KVStoreDistAsync(KVStore):
                 val_np = np.asarray(_await(pending))
             # the completed pull is this worker's sync point for k: the
             # cache becomes the quorum re-push value, and every logged
-            # push up to here is absorbed into it
-            self._cache_value(k, val_np)
+            # push the pull OBSERVED (up to its enqueue mark) is
+            # absorbed into it
+            self._cache_value(k, val_np, mark=mark)
             val = jnp.asarray(val_np)
             for o in os_:
                 o._set_data(val.astype(o._data.dtype)
@@ -1782,23 +2348,52 @@ class KVStoreDistAsync(KVStore):
 
         Transport faults recover transparently through the channel's
         reconnect+replay; under MXNET_KVSTORE_ELASTIC a HARD channel
-        failure surfaces from ``wait()`` instead of triggering a roster
-        repair — the in-flight handle cannot be re-routed (composing
-        the fused driver with elastic repair is roadmap work; the eager
-        per-step loop remains the repair-capable path)."""
+        failure triggers a roster repair from inside ``wait()`` and the
+        handle REPLANS its unserved tail against the new stripe layout
+        (:meth:`_PullHandle._replan`) — the fused driver and elastic
+        membership compose (docs/ROBUSTNESS.md replan contract).
+
+        Under MXNET_KVSTORE_HIERARCHY a follower's pull is one
+        ``mesh_collect`` against the host-group leader (the weight
+        fan-in rides the in-host mesh, zero wire bytes); the leader
+        runs the real wire round and registers the handle so collects
+        resolve against the SAME round."""
         if isinstance(keys, str):
             keys, shapes = [keys], [shapes]
-        reqs = []
+        keys = [_key(k) for k in keys]
+        if self._hier:
+            seq = self._mesh_pull_seq
+            self._mesh_pull_seq += 1
+            if self._mesh_conn is not None:   # follower
+                pending = self._mesh_conn.request(
+                    ("mesh_collect", seq, list(keys)))
+                return _MeshPullHandle(self, keys, pending)
+        entries = []
         for k, shape in zip(keys, shapes):
-            k = _key(k)
-            plan = self._stripe_plan(k, tuple(shape))
-            if plan is None:
-                reqs.append((k, self._conn_of(k).request(("pull", k))))
-            else:
-                reqs.append((k, [
-                    self._stripe_conn(k, i).request(("pull", f"{k}@s{i}"))
-                    for i in range(len(plan) - 1)]))
-        return _PullHandle(self, reqs)
+            entries.append(self._elastic_attempt(
+                lambda k=k, shape=shape: self._enqueue_pull(k, shape)))
+        handle = _PullHandle(self, entries)
+        if self._hier:
+            self._mesh_leader.publish_handle(seq, handle)
+        return handle
+
+    def _enqueue_pull(self, k, shape):
+        """Issue the per-stripe pull requests of one key under the
+        CURRENT layout; returns the handle entry (the replan unit)."""
+        plan = self._stripe_plan(k, tuple(shape))
+        parts = []
+        if plan is None:
+            rows = int(shape[0]) if shape else 0
+            parts.append([0, rows, k,
+                          self._conn_of(k).request(("pull", k)), None])
+        else:
+            for i in range(len(plan) - 1):
+                wk = f"{k}@s{i}"
+                parts.append([plan[i], plan[i + 1], wk,
+                              self._stripe_conn(k, i).request(
+                                  ("pull", wk)), None])
+        return {"key": k, "shape": tuple(shape), "parts": parts,
+                "mark": self._push_mark(k)}
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows from the owning server — O(rows)
@@ -2004,6 +2599,13 @@ class KVStoreDistAsync(KVStore):
                 self._elastic_attempt(self._elastic_refresh)
 
     def _flush_all(self):
+        if self._mesh_conn is not None:
+            # a follower's queued mesh pushes must reach the leader
+            # before its barrier arrival — the leader (also a barrier
+            # participant) only arrives after shipping them, so the
+            # classic "every prior push visible after barrier" contract
+            # holds through the tier
+            self._mesh_conn.flush()
         for c in self._conns:
             c.flush()
 
@@ -2031,6 +2633,13 @@ class KVStoreDistAsync(KVStore):
     def close(self, stop_servers=False):
         from .kvstore_server import K_STOP_SERVER
         self._closed = True
+        if self._mesh_conn is not None:
+            self._mesh_conn.close(retry=False)
+            self._mesh_conn = None
+        if self._mesh_leader is not None:
+            self._mesh_leader.close()
+            self._mesh_leader = None
+        self._hier = False
         if self._roster_member:
             # graceful departure: deregister so the surviving workers'
             # barriers re-target without waiting out a heartbeat timeout

@@ -258,12 +258,17 @@ def _set_nodelay(sock):
         pass   # non-TCP socket (tests stub with socketpairs)
 
 
-def _send_msg(sock, obj, fi_role=None):
+def _send_msg(sock, obj, fi_role=None, byte_kind="sent"):
     """Zero-copy framed send (skeleton pickle + raw tensor buffers).
     ``fi_role`` tags DATA-channel traffic for the deterministic fault-
     injection hooks ("client" may be severed at an exact message,
     "server" may delay acks); untagged sends (heartbeats) are exempt so
-    a plan hits only what it targets."""
+    a plan hits only what it targets.  ``byte_kind`` names the byte
+    counter family the frame lands in: the default "sent" is the TCP
+    wire to the parameter servers; the hierarchical kvstore tier's
+    in-host mesh channels count under "ici_sent" so bench.py can report
+    wire vs in-mesh bytes separately (profiler.wire_bytes_total /
+    ici_bytes_total)."""
     if fi_role == "client":
         faultinject.client_send(sock)
     elif fi_role == "server":
@@ -272,7 +277,7 @@ def _send_msg(sock, obj, fi_role=None):
     skel = pickle.dumps(_pack(obj, bufs),
                         protocol=pickle.HIGHEST_PROTOCOL)
     total = 4 + len(skel) + sum(a.nbytes for a in bufs)
-    _prof.record_channel_bytes("sent", 8 + total)
+    _prof.record_channel_bytes(byte_kind, 8 + total)
     sock.sendall(struct.pack(">QI", total, len(skel)) + skel)
     for arr in bufs:
         sock.sendall(memoryview(arr).cast("B"))
@@ -290,13 +295,13 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_msg(sock, fi_role=None):
+def _recv_msg(sock, fi_role=None, byte_kind="recv"):
     if fi_role == "client":
         faultinject.client_recv(sock)
     total, skel_len = struct.unpack(">QI", _recv_exact(sock, 12))
     skel = _restricted_loads(_recv_exact(sock, skel_len))
     body = _recv_exact(sock, total - 4 - skel_len)
-    _prof.record_channel_bytes("recv", 8 + total)
+    _prof.record_channel_bytes(byte_kind, 8 + total)
     refs = []
     _collect_bufs(skel, refs)
     if not refs:

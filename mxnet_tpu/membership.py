@@ -120,6 +120,39 @@ def elect_successor(servers: Optional[Sequence[str]],
     return None
 
 
+def host_groups(workers: Sequence[int],
+                workers_per_host: int) -> List[Tuple[int, ...]]:
+    """Partition worker ranks into per-host mesh groups — the pure
+    arithmetic behind the hierarchical kvstore tier
+    (``MXNET_KVSTORE_HIERARCHY``).  Launchers place consecutive ranks
+    on one host (tools/launch.py fills each host's slots in order), so
+    rank ``r`` lives in group ``r // workers_per_host``; groups come
+    back sorted by their leader (lowest) rank.  Deterministic from
+    (workers, per_host) with no coordination — the same trick
+    :func:`stripe_plan` and :func:`elect_successor` use, applied to
+    host topology."""
+    per = max(1, int(workers_per_host))
+    groups: Dict[int, List[int]] = {}
+    for r in sorted(int(w) for w in workers):
+        groups.setdefault(r // per, []).append(r)
+    return [tuple(groups[g]) for g in sorted(groups)]
+
+
+def mesh_group(rank: int, workers: Sequence[int],
+               workers_per_host: int) -> Tuple[int, Tuple[int, ...], int]:
+    """``(leader_rank, members, group_index)`` of ``rank``'s host group
+    (:func:`host_groups`).  The leader — the lowest rank on the host —
+    is the ONLY member that ships gradients over the TCP wire; the
+    rest reduce into it in-mesh.  Raises when ``rank`` is not in
+    ``workers`` (a roster that does not know this rank cannot place
+    it)."""
+    for gi, members in enumerate(host_groups(workers, workers_per_host)):
+        if int(rank) in members:
+            return members[0], members, gi
+    raise ValueError(
+        f"mesh_group: rank {rank} not in worker set {tuple(workers)}")
+
+
 def server_index(key: str, num_servers: int) -> int:
     """crc32 routing of an unstriped key to a server slot."""
     return zlib.crc32(key.encode()) % num_servers

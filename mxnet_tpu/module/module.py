@@ -781,13 +781,13 @@ class Module(BaseModule):
             # (_run_steps_fused_dist).  Other update-on-kvstore stores
             # (local multi-device, dist_sync) keep the eager per-step
             # loop — they have no async wire to overlap.  Elastic jobs
-            # keep the eager loop too: its blocking pulls ride the
-            # roster-repair wrapper, while an in-flight pull_async
-            # handle cannot re-route across a roster bump yet (the
-            # ROADMAP composition item; docs/ROBUSTNESS.md).
+            # ride the chunked driver too: an in-flight pull_async
+            # handle now REPLANS itself against the post-bump stripe
+            # layout from inside wait() (kvstore._PullHandle._replan;
+            # docs/ROBUSTNESS.md replan contract), and the push leg
+            # already repaired+rerouted.
             if (fusable and self._kvstore is not None
                     and getattr(self._kvstore, "type", "") == "dist_async"
-                    and not getattr(self._kvstore, "_elastic", False)
                     and env("MXNET_KVSTORE_FUSED", True)):
                 return self._run_steps_fused_dist(
                     data_arrays, label_arrays, k, names, eval_metric)
@@ -991,12 +991,14 @@ class Module(BaseModule):
         worker-local between sync points; the final pull is adopted as
         the authoritative weights (fp32 masters included for
         multi-precision params), exactly like the eager loop's last
-        pull.  Composing this driver with MXNET_KVSTORE_ELASTIC roster
-        repair is roadmap work — elastic jobs are routed to the eager
-        loop instead (transport kills still recover here through the
-        window replay underneath; a HARD failure mid-drive writes the
-        carry's last chunk-output state back so the module stays
-        readable, then raises)."""
+        pull.  Under MXNET_KVSTORE_ELASTIC a roster bump mid-drive is
+        survivable: the push leg repairs and re-routes through
+        _submit_planned, and an in-flight pull handle replans its
+        unserved stripes against the new layout from inside wait()
+        (docs/ROBUSTNESS.md replan contract).  Transport kills still
+        recover through the window replay underneath; a HARD failure
+        mid-drive writes the carry's last chunk-output state back so
+        the module stays readable, then raises."""
         exec_ = self._exec
         opt = self._optimizer
         kv = self._kvstore

@@ -136,6 +136,58 @@ def use_mesh(mesh: Mesh):
         _state.mesh = prev
 
 
+# compiled reducers for local_allreduce_sum, keyed by (n, shape, dtype,
+# device ids) — one program per (member count, gradient shape) pair, so
+# the per-step leader reduction of the hierarchical kvstore tier pays
+# compilation exactly once
+_ALLREDUCE_CACHE: Dict[tuple, tuple] = {}
+
+
+def local_allreduce_sum(parts, devices=None):
+    """Sum equal-shape host arrays where the hardware holds them: the
+    in-mesh reduction of the hierarchical kvstore tier
+    (``MXNET_KVSTORE_HIERARCHY`` — kvstore.py's per-host leader reduces
+    its group's gradients here before anything touches the TCP wire).
+
+    With >= len(parts) local devices, each part lands on its own device
+    and ONE jitted sum with a replicated out-sharding runs over a 1-D
+    mesh — XLA emits the ICI all-reduce (the same mechanism
+    ``KVStore._reduce_on_mesh`` uses for multi-device pushes).  Fewer
+    devices (the CPU stub mesh's degenerate case) fall back to a
+    stacked jnp sum on the default device — bit-identical for the
+    two-member groups the CI gates pin (one fp32 add either way).
+    Returns a host ``np.ndarray``."""
+    parts = [np.asarray(p) for p in parts]
+    if len(parts) == 1:
+        return parts[0]
+    if devices is None:
+        devices = jax.local_devices()
+    n = len(parts)
+    shape, dtype = parts[0].shape, parts[0].dtype
+    if len(devices) < n:
+        import jax.numpy as jnp
+        return np.asarray(jnp.sum(
+            jnp.stack([jnp.asarray(p) for p in parts]), axis=0))
+    devs = list(devices)[:n]
+    sig = (n, shape, str(dtype), tuple(d.id for d in devs))
+    cached = _ALLREDUCE_CACHE.get(sig)
+    if cached is None:
+        import jax.numpy as jnp
+        mesh = Mesh(np.array(devs), ("kv",))
+        sharded = NamedSharding(mesh, P("kv"))
+        replicated = NamedSharding(mesh, P())
+        fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                     out_shardings=replicated)
+        cached = _ALLREDUCE_CACHE[sig] = (sharded, fn)
+        while len(_ALLREDUCE_CACHE) > 64:
+            _ALLREDUCE_CACHE.pop(next(iter(_ALLREDUCE_CACHE)))
+    sharded, fn = cached
+    stacked = jax.make_array_from_single_device_arrays(
+        (n,) + tuple(shape), sharded,
+        [jax.device_put(p[None], d) for p, d in zip(parts, devs)])
+    return np.asarray(fn(stacked))
+
+
 def data_pspec(ndim: int, batch_axes=("dp",)) -> P:
     """PartitionSpec for an input batch: dim 0 over dp (the reference's
     decide_slices batch split), other dims unsharded."""

@@ -320,6 +320,29 @@ def channel_bytes() -> dict:
         return dict(_channel_bytes)
 
 
+# The hierarchical kvstore tier's in-host mesh traffic counts under
+# "ici_*" kinds (kvstore_server._send_msg byte_kind) — a separate
+# counter FAMILY from the TCP wire, because the whole point of the tier
+# is moving bytes from the wire onto the mesh: bench.py reports
+# ici_bytes_per_step next to wire_bytes_per_step so the shift is a
+# banked, regression-gateable number (docs/PERF_NOTES.md round 11).
+ICI_BYTE_PREFIX = "ici_"
+
+
+def ici_bytes_total() -> int:
+    """Total in-mesh (hierarchy-tier) bytes moved so far."""
+    with _channel_lock:
+        return sum(v for k, v in _channel_bytes.items()
+                   if k.startswith(ICI_BYTE_PREFIX))
+
+
+def wire_bytes_total() -> int:
+    """Total non-mesh transport bytes (TCP wire + host collectives)."""
+    with _channel_lock:
+        return sum(v for k, v in _channel_bytes.items()
+                   if not k.startswith(ICI_BYTE_PREFIX))
+
+
 def reset_channel_bytes():
     with _channel_lock:
         _channel_bytes.clear()

@@ -1,0 +1,151 @@
+"""Elastic×fused smoke: SIGKILL a parameter server while the CHUNKED
+fused driver has a wire round in flight, and finish — no eager
+fallback, no restart — bit-identical to the static-roster golden.
+
+Run via:  python tools/launch.py --elastic -n 1 -s 2 \
+              --env MXNET_FI_KILL_PROCESS_AFTER=<N> \
+              --env MXNET_FI_ONLY_SERVER=1 \
+              python tests/dist/dist_elastic_fused.py
+
+One worker trains a striped linear model (one row stripe per server)
+through ``Module.run_steps`` → ``executor.drive_chunked_dist`` —
+ISSUE 14's composition: elastic jobs no longer fall back to the eager
+per-step loop, because an in-flight ``pull_async`` handle REPLANS
+itself against the post-bump stripe layout from inside ``wait()``
+(kvstore._PullHandle._replan) while the push leg repairs and re-routes
+through ``_submit_planned``.  Server 1 is REALLY SIGKILLed after
+serving exactly the first push of chunk 2 (the ack arithmetic below),
+taking its stripe to its grave with the chunk's remaining push and its
+pull round unserved.
+
+Single-worker on purpose: the worker's pull cache + push log then
+carry COMPLETE recovery information (one writer), so bit-identity
+against the analytic golden holds at ANY kill point — a lost push, a
+double-applied replay, a mis-striped replan row or a silent eager
+fallback each break the exact equality (multi-worker exactness is the
+elastic sync-point contract, docs/ROBUSTNESS.md).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_KVSTORE_ELASTIC", "1")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX", "3")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_INITIAL_MS", "20")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX_MS", "200")
+os.environ.setdefault("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.5")
+os.environ.setdefault("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "2.0")
+os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import math  # noqa: E402
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+
+K = 16
+CHUNK = 2
+BATCH = 4
+NIN = 16
+NH = 8                  # (8, 16) fp32 = 128 elems > bound 16: 2 stripes
+LR = 0.125              # power of two: every update exact in fp32
+
+
+def expected_kill_acks():
+    """Enveloped replies server 1 (the pure data shard — roster ops
+    ride the coordinator, beats and heartbeats are raw and exempt)
+    serves before the SIGKILL: setup is init stripe (1) + the
+    init-time pull stripe (1) + rank 0's optimizer command (1) +
+    set_optimizer's barrier channel-flush (1); each chunk then costs
+    CHUNK stripe pushes + 1 stripe pull.  Killing at setup + 2 chunks
+    + 1 lands right after the FIRST push of chunk 2 — chunk 2's second
+    push and its pull round die unserved, the messiest boundary the
+    replan exists for.  Single worker, one FIFO channel: the count is
+    exact."""
+    setup = 4
+    per_chunk = CHUNK + 1
+    return setup + 2 * per_chunk + 1
+
+
+def rank_data():
+    rs = np.random.RandomState(7)
+    return rs.randint(-1, 2, (K, BATCH, NIN)).astype(np.float32)
+
+
+def init_weight():
+    rs = np.random.RandomState(0)
+    return rs.randint(-2, 3, (NH, NIN)).astype(np.float32)
+
+
+def golden():
+    w = init_weight().copy()
+    data = rank_data()
+    for s in range(K):
+        g = np.tile(data[s].sum(axis=0), (NH, 1)).astype(np.float32)
+        w = w - np.float32(LR) * g
+    return w
+
+
+def main():
+    data = rank_data()
+    os.environ["MXNET_KVSTORE_FUSED_CHUNK"] = str(CHUNK)
+    os.environ["MXNET_KVSTORE_FUSED_STALENESS"] = "1"
+
+    sym_data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(sym_data, num_hidden=NH, no_bias=True,
+                                name='fc')
+    sym = mx.sym.MakeLoss(net, name='loss')
+    mod = mx.mod.Module(sym, data_names=('data',), label_names=None)
+    mod.bind(data_shapes=[('data', (BATCH, NIN))])
+    mod.init_params(arg_params={'fc_weight': mx.nd.array(init_weight())})
+    mod.init_optimizer(
+        kvstore='dist_async', optimizer='sgd',
+        optimizer_params={'learning_rate': LR, 'momentum': 0.0,
+                          'wd': 0.0, 'rescale_grad': 1.0})
+    kv = mod._kvstore
+    assert kv._elastic, "launch with --elastic"
+    assert kv._stripe_plan('fc_weight', (NH, NIN)) is not None, \
+        "weight must stripe across both servers for the kill to matter"
+
+    profiler.reset_dispatch_counts()
+    mod.run_steps(data, k=K)       # the SIGKILL lands mid-drive
+
+    # no eager fallback: the whole K ran through the chunked driver
+    counts = profiler.dispatch_counts()
+    n_chunks = counts.get("run_steps.dist_chunk", 0)
+    assert n_chunks == math.ceil(K / CHUNK), counts
+    assert "executor.fwd_bwd" not in counts
+
+    # the roster really bumped and the job converged onto the survivor
+    ch = profiler.channel_counts()
+    assert ch.get("kvstore.roster_bump", 0) >= 1, ch
+    assert kv._roster_gen >= 1 and len(kv._conns) == 1, \
+        (kv._roster_gen, len(kv._conns))
+
+    # bit-identity vs the static-roster golden
+    kv.barrier()
+    out = mx.nd.zeros((NH, NIN))
+    kv.pull('fc_weight', out=out)
+    np.testing.assert_array_equal(
+        out.asnumpy(), golden(),
+        err_msg="elastic fused run diverged from the static golden")
+
+    kv.barrier()
+    kv.close(stop_servers=True)
+    print("dist_elastic_fused OK (SIGKILL survived mid-drive through "
+          "the fused driver; %d chunks, roster gen %d, replans %d)"
+          % (n_chunks, kv._roster_gen,
+             ch.get("kvstore.pull_replan", 0)), flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("MXT_PRINT_KILL_ACKS"):
+        print(expected_kill_acks())
+        sys.exit(0)
+    main()

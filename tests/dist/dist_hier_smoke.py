@@ -1,0 +1,169 @@
+"""Hierarchical-kvstore smoke: in-mesh reduce + per-host wire shipping
+on the REAL dist_async wire, across process/socket boundaries.
+
+Run via:  python tools/launch.py -n 2 -s 1 --workers-per-host 2 \
+              python tests/dist/dist_hier_smoke.py
+
+Two workers forming ONE host group train the same linear model twice
+through the fused chunked driver: once flat (every worker pushes every
+gradient over the TCP wire) and once hierarchical
+(MXNET_KVSTORE_HIERARCHY=1: the two gradients allreduce in-mesh and
+only the leader — rank 0 — ships the SUM; pulled weights fan back
+in-host).  Gradients are CONSTANT in the weights (MakeLoss over a
+linear head — integer column sums) and the lr is a power of two, so
+BOTH runs must land BIT-IDENTICAL on the same analytic golden: summed
+SGD equals the two flat pushes applied in either order, exactly.
+
+The byte half of the gate: rank 0 reads the server's own ("stats",)
+byte counters around each phase — the hierarchy phase's wire traffic
+must sit at <= 60% of the flat phase's (the >= 40% acceptance drop;
+the structural number is ~50% for 2 workers/host) — and the follower
+asserts its own push bytes moved from the "sent" family to "ici_sent"
+(profiler.ici_bytes_total, the counters behind bench.py's
+ici_bytes_per_step).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_INITIAL_MS", "20")
+os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX_MS", "200")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+
+K = 8
+CHUNK = 2
+BATCH = 64
+NIN = 128
+NH = 64
+LR = 0.125              # power of two: every update exact in fp32
+NWORKER = int(os.environ.get("DMLC_NUM_WORKER", "2"))
+
+
+def rank_data(rank):
+    rs = np.random.RandomState(100 + rank)
+    return rs.randint(-1, 2, (K, BATCH, NIN)).astype(np.float32)
+
+
+def init_weight():
+    rs = np.random.RandomState(0)
+    return rs.randint(-2, 3, (NH, NIN)).astype(np.float32)
+
+
+def golden():
+    """W0 - lr * sum of every rank's every-step gradient — identical
+    for flat (two sequential SGD applies) and hierarchical (one summed
+    apply): the values are exact dyadics, so (w - a) - b == w - (a+b)
+    bit-for-bit."""
+    w = init_weight().copy()
+    for r in range(NWORKER):
+        data = rank_data(r)
+        for s in range(K):
+            g = np.tile(data[s].sum(axis=0), (NH, 1)).astype(np.float32)
+            w = w - np.float32(LR) * g
+    return w
+
+
+def make_module(tag):
+    data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(data, num_hidden=NH, no_bias=True,
+                                name=f'fc_{tag}')
+    sym = mx.sym.MakeLoss(net, name=f'loss_{tag}')
+    mod = mx.mod.Module(sym, data_names=('data',), label_names=None)
+    mod.bind(data_shapes=[('data', (BATCH, NIN))])
+    mod.init_params(
+        arg_params={f'fc_{tag}_weight': mx.nd.array(init_weight())})
+    mod.init_optimizer(
+        kvstore='dist_async', optimizer='sgd',
+        optimizer_params={'learning_rate': LR, 'momentum': 0.0,
+                          'wd': 0.0, 'rescale_grad': 1.0})
+    return mod
+
+
+def server_wire_bytes(kv):
+    """The server's own transport byte total (its ("stats",) reply) —
+    one number every rank can measure identically."""
+    st = kv.server_stats(0)
+    return sum(v for k, v in st.get("channel_bytes", {}).items()
+               if not k.startswith("ici_"))
+
+
+def main():
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    data = rank_data(rank)
+    os.environ["MXNET_KVSTORE_FUSED_CHUNK"] = str(CHUNK)
+    os.environ["MXNET_KVSTORE_FUSED_STALENESS"] = "1"
+    assert os.environ.get("MXT_MESH_URIS"), \
+        "launch with tools/launch.py --workers-per-host 2"
+
+    # both modules up front (set_optimizer barriers keep ranks in
+    # lockstep); the hierarchy store binds/dials its mesh endpoint at
+    # construction, before any phase runs
+    os.environ["MXNET_KVSTORE_HIERARCHY"] = "0"
+    mod_f = make_module("f")
+    os.environ["MXNET_KVSTORE_HIERARCHY"] = "1"
+    mod_h = make_module("h")
+    kv = mod_f._kvstore
+    assert mod_h._kvstore._hier, "hierarchy tier did not arm"
+
+    # -- phase 1: flat fused dist (the byte baseline) -----------------
+    kv.barrier()
+    b0 = server_wire_bytes(kv)
+    mod_f.run_steps(data, k=K)
+    kv.barrier()
+    b1 = server_wire_bytes(kv)
+
+    # -- phase 2: hierarchical — leader ships, follower rides ICI -----
+    ici0 = profiler.ici_bytes_total()
+    sent0 = profiler.channel_bytes().get("sent", 0)
+    mod_h.run_steps(data, k=K)
+    kv.barrier()
+    b2 = server_wire_bytes(kv)
+    ici_d = profiler.ici_bytes_total() - ici0
+    sent_d = profiler.channel_bytes().get("sent", 0) - sent0
+
+    # -- bit-identity: BOTH modes == the one analytic golden ----------
+    want = golden()
+    for tag, m in (("f", mod_f), ("h", mod_h)):
+        out = mx.nd.zeros((NH, NIN))
+        kv_t = m._kvstore
+        kv_t.pull(f'fc_{tag}_weight', out=out)
+        np.testing.assert_array_equal(
+            out.asnumpy(), want,
+            err_msg=f"run {tag!r} diverged from the analytic golden")
+
+    # -- the wire shrank by ~the workers-per-host factor --------------
+    flat_bytes, hier_bytes = b1 - b0, b2 - b1
+    assert hier_bytes < 0.6 * flat_bytes, \
+        (f"hierarchical wire bytes {hier_bytes} not under 60% of the "
+         f"flat baseline {flat_bytes} (acceptance: >= 40% drop)")
+    payload = NH * NIN * 4
+    if rank == 0:
+        assert ici_d > 0, "leader served no in-mesh traffic"
+    else:
+        # the follower's gradients now ride the mesh, not the wire:
+        # K pushes + K/CHUNK collects of a 32 KiB tensor each
+        assert ici_d > K * payload, (ici_d, K * payload)
+        assert sent_d < K * payload, \
+            (f"follower still pushed over the wire: sent {sent_d}b in "
+             f"the hierarchy phase (payload {payload}b x {K} steps)")
+
+    kv.barrier()
+    for m in (mod_h, mod_f):
+        m._kvstore.close()
+    print("dist_hier_smoke rank %d/%d OK (golden exact; wire %db -> "
+          "%db, ici %db)" % (rank, NWORKER, flat_bytes, hier_bytes,
+                             ici_d), flush=True)
+
+
+if __name__ == "__main__":
+    main()

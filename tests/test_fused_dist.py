@@ -195,31 +195,85 @@ def test_one_dispatch_per_chunk_no_eager_fallback(monkeypatch):
             s.stop()
 
 
-def test_elastic_gates_fused_off(monkeypatch):
-    """Under MXNET_KVSTORE_ELASTIC run_steps keeps the eager per-step
-    loop: its blocking pulls ride the roster-repair wrapper, which an
-    in-flight pull_async handle cannot yet (docs/ROBUSTNESS.md names
-    the boundary).  The gate reads the store's _elastic flag."""
+def _serve_elastic(monkeypatch, n=2):
+    """n elastic in-process servers sharing a roster (the
+    tests/test_membership.py harness shape), env wired for fast
+    retry/heartbeat budgets."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    monkeypatch.setenv("MXNET_KVSTORE_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    srvs = [KVStoreServer(server_id=i, num_workers=1, elastic=True)
+            for i in range(n)]
+    uris = ",".join(f"127.0.0.1:{s.port}" for s in srvs)
+    monkeypatch.setenv("MXT_SERVER_URIS", uris)
+    for s in srvs:
+        s._roster_servers = uris.split(",")
+        s.start_background()
+    return srvs
+
+
+def test_elastic_rides_fused_driver(monkeypatch):
+    """MXNET_KVSTORE_ELASTIC no longer gates the chunked driver off:
+    an elastic run_steps is one dispatch per chunk (never the eager
+    per-step loop) and lands bit-identical to the analytic staleness
+    golden — the fused×elastic composition the _PullHandle replan
+    bought (docs/ROBUSTNESS.md replan contract)."""
     data, label, w0 = _int_data(seed=6)
-    srvs = _serve(monkeypatch)
+    srvs = _serve_elastic(monkeypatch)
     try:
+        monkeypatch.setenv("MXNET_KVSTORE_FUSED_STALENESS", "1")
+        monkeypatch.setenv("MXNET_KVSTORE_FUSED_CHUNK", "2")
         mod = _make_module(w0)
-        called = {}
-        orig = mx.mod.Module._run_steps_eager
-
-        def spy(self, *a, **kw):
-            called["eager"] = True
-            # drop the faked flag before the real eager run pushes (a
-            # non-elastic ctor has no push log to feed)
-            self._kvstore._elastic = False
-            return orig(self, *a, **kw)
-
-        monkeypatch.setattr(mx.mod.Module, "_run_steps_eager", spy)
-        mod._kvstore._elastic = True
+        assert mod._kvstore._elastic
         prof.reset_dispatch_counts()
         mod.run_steps(data, label, k=K)
-        assert called.get("eager"), "elastic store did not gate fused off"
-        assert "run_steps.dist_chunk" not in prof.dispatch_counts()
+        counts = prof.dispatch_counts()
+        assert counts.get("run_steps.dist_chunk") == math.ceil(K / 2), \
+            counts
+        assert "executor.fwd_bwd" not in counts
+        w = mod.get_params()[0]['fc_weight'].asnumpy()
+        np.testing.assert_array_equal(
+            w, _simulate_chunked(w0, data, label, LR, 2, 1))
+        mod._kvstore.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_elastic_fused_survives_server_death(monkeypatch):
+    """A server death BETWEEN chunked runs repairs mid-drive (the push
+    leg re-routes, the pull handle replans) and the job completes
+    bit-identical to the static golden: the surviving layout's final
+    weights equal the simulation of every applied gradient."""
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "4")
+    data, label, w0 = _int_data(seed=7)
+    srvs = _serve_elastic(monkeypatch)
+    try:
+        monkeypatch.setenv("MXNET_KVSTORE_FUSED_STALENESS", "0")
+        monkeypatch.setenv("MXNET_KVSTORE_FUSED_CHUNK", "2")
+        mod = _make_module(w0)
+        kv = mod._kvstore
+        # fc_weight stripes across both servers under the tiny bound
+        assert kv._stripe_plan('fc_weight', w0.shape) is not None
+        half = K // 2
+        mod.run_steps(data[:half], label[:half], k=half)
+        kv.barrier()
+        srvs[1].stop()   # SIGKILL-equivalent: stripe state lost
+        prof.reset_dispatch_counts()
+        mod.run_steps(data[half:], label[half:], k=half)
+        counts = prof.dispatch_counts()
+        assert counts.get("run_steps.dist_chunk") == math.ceil(half / 2)
+        assert kv._roster_gen >= 1 and len(kv._conns) == 1
+        w = mod.get_params()[0]['fc_weight'].asnumpy()
+        np.testing.assert_array_equal(
+            w, _simulate_chunked(w0, data, label, LR, 2, 0),
+            err_msg="elastic fused run diverged from the static golden")
         mod._kvstore.close(stop_servers=True)
     finally:
         for s in srvs:

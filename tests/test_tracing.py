@@ -184,11 +184,11 @@ def _captured_sends(monkeypatch):
     real = srvmod._send_msg
     every, reqs = [], []
 
-    def spy(sock, obj, fi_role=None):
+    def spy(sock, obj, fi_role=None, byte_kind="sent"):
         every.append(obj)
         if isinstance(obj, tuple) and obj and obj[0] == "req":
             reqs.append(obj)
-        return real(sock, obj, fi_role=fi_role)
+        return real(sock, obj, fi_role=fi_role, byte_kind=byte_kind)
 
     monkeypatch.setattr(srvmod, "_send_msg", spy)
     return every, reqs

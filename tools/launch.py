@@ -72,6 +72,16 @@ def _worker_env(args, coord_uri, port, wid):
         env["MXT_SERVER_URIS"] = ",".join(args.server_uris)
     if getattr(args, "elastic", False):
         env.setdefault("MXNET_KVSTORE_ELASTIC", "1")
+    if getattr(args, "mesh_uris", None):
+        # hierarchical kvstore tier (MXNET_KVSTORE_HIERARCHY): one
+        # in-host aggregation endpoint per host group, leader = the
+        # group's lowest rank (membership.host_groups — consecutive
+        # ranks share a host, which is exactly how the spawn loops
+        # below fill slots)
+        env["MXT_MESH_URIS"] = ",".join(args.mesh_uris)
+        env.setdefault("MXNET_KVSTORE_HIERARCHY", "1")
+        env.setdefault("MXNET_KVSTORE_WORKERS_PER_HOST",
+                       str(args.workers_per_host))
     return env
 
 
@@ -214,6 +224,14 @@ def main():
                          "(default: this process's cwd)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE env for every worker")
+    ap.add_argument("--workers-per-host", type=int, default=0,
+                    help="hierarchical kvstore tier "
+                         "(MXNET_KVSTORE_HIERARCHY): worker ranks per "
+                         "host — consecutive ranks form one in-host "
+                         "mesh group whose leader alone ships "
+                         "gradients over the wire; allocates one mesh "
+                         "endpoint (MXT_MESH_URIS) per group.  0 = "
+                         "flat dist_async")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic membership (MXNET_KVSTORE_ELASTIC): a "
                          "parameter server exiting — even killed, even "
@@ -246,6 +264,23 @@ def main():
             args.server_uris = [f"127.0.0.1:{_free_port()}"
                                 for _ in range(args.num_servers)]
             sprocs = _spawn_servers_local(args)
+
+    # hierarchical tier: one mesh endpoint per host group, bound on the
+    # group leader's host (local mode: loopback).  Allocated before the
+    # spawn so every worker shares one MXT_MESH_URIS view, exactly like
+    # MXT_SERVER_URIS above.
+    args.mesh_uris = []
+    if args.workers_per_host > 0:
+        n_groups = -(-args.num_workers // args.workers_per_host)
+        if args.launcher == "ssh":
+            slots = _parse_hostfile(args.hostfile)
+            args.mesh_uris = [
+                "%s:%d" % (slots[(g * args.workers_per_host)
+                                 % len(slots)], _free_port())
+                for g in range(n_groups)]
+        else:
+            args.mesh_uris = ["127.0.0.1:%d" % _free_port()
+                              for _ in range(n_groups)]
 
     port = _free_port()
     procs = _spawn_ssh(args, port) if args.launcher == "ssh" \
