@@ -15,6 +15,13 @@ echo "== analysis gate: framework-aware lint + knob registry (docs/ANALYSIS.md)"
 # point the way a developer runs it.
 JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --strict
 
+echo "== analysis gate: generated doc tables in sync (--check drift mode)"
+# The knob table in docs/ROBUSTNESS.md and the wire-protocol op table
+# in docs/PROTOCOL.md are GENERATED projections; a knob or wire op
+# added without regenerating them fails HERE instead of silently
+# rotting the docs (regenerate: --knob-table / --protocol-table).
+JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --check
+
 echo "== unit + integration suite (8-device CPU mesh via tests/conftest.py)"
 # -m "" overrides pytest.ini's default "not slow": CI runs everything.
 # test_run_steps.py is excluded here because the dedicated gate below

@@ -19,22 +19,34 @@ answer (Abadi et al., arXiv:1605.08695) — it ships correctness tooling
 instead:
 
 * :mod:`mxnet_tpu.analysis.lint` — an AST linter over the package with
-  five framework-specific rule families (``host-sync``,
-  ``unsafe-pickle``, ``lock-order``, ``env-knob``, ``bare-thread``),
+  eight framework-specific rule families (``host-sync``,
+  ``unsafe-pickle``, ``lock-order``, ``blocking-under-lock``,
+  ``env-knob``, ``bare-thread``, ``protocol-op``, ``raw-send``),
   run as its own CI gate via ``python -m mxnet_tpu.analysis --strict``.
 * :mod:`mxnet_tpu.analysis.knobs` — the machine-readable registry view
   of every ``MXNET_*`` environment knob (bridging
   ``base.declare_env``), with the docs-drift check and the generated
   markdown table folded into docs/ROBUSTNESS.md.
+* :mod:`mxnet_tpu.analysis.protocol` — the wire-protocol registry
+  extracted from the AST (op dispatch chains, ``register_op`` sites,
+  client request sites, ``srv.*`` spans) behind the ``protocol-op``
+  conformance rule and the generated docs/PROTOCOL.md table
+  (``--protocol-table``; ``--check`` fails CI on drift).
 * :mod:`mxnet_tpu.analysis.runtime` — an instrumented ``OrderedLock``
   plus a monkeypatchable ``threading`` shim that records per-thread
   lock-acquisition sequences at runtime, builds the global lock-order
   graph and flags inversions — a mini lock-order sanitizer that runs
   on CPU under the existing fault-injection tests.
+* :mod:`mxnet_tpu.analysis.hb` — the happens-before RACE sanitizer:
+  vector clocks over the same shim (plus queue put/get and thread
+  start/join edges) and tracked wrappers for the hot shared
+  containers; an unsynchronized write/write or read↔write pair raises
+  with both stacks.
 
 Rule catalog, allow-annotation syntax and extension guide:
 docs/ANALYSIS.md.
 """
+from . import hb  # noqa: F401
 from .lint import Finding, run_lint, lint_paths  # noqa: F401
 from .runtime import (  # noqa: F401
     LockGraph, LockOrderError, OrderedLock, shim)

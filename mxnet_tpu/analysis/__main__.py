@@ -1,20 +1,28 @@
 """``python -m mxnet_tpu.analysis`` — the static-analysis CI gate.
 
 Default run lints the installed ``mxnet_tpu`` package (plus the
-whole-package checks: static lock-order cycles, knob-registry drift
-against docs/ROBUSTNESS.md) and reports findings; ``--strict`` makes
-any unannotated finding fatal — that form is the ``analysis`` gate in
+whole-package checks: static lock-order cycles, blocking-under-lock,
+the wire-protocol conformance table, knob-registry drift against
+docs/ROBUSTNESS.md) and reports findings; ``--strict`` makes any
+unannotated finding fatal — that form is the ``analysis`` gate in
 ci/run_ci.sh.  Explicit paths lint those files/directories instead
-(the fixture tests drive this).  ``--knob-table`` prints the generated
-markdown knob table to fold into docs/ROBUSTNESS.md.
+(the fixture tests drive this).
+
+``--knob-table`` / ``--protocol-table`` print the generated markdown
+tables docs/ROBUSTNESS.md and docs/PROTOCOL.md fold in; ``--check``
+fails (exit 2) when either docs copy is STALE instead of silently
+regenerating — the drift gate ci/run_ci.sh runs next to ``--strict``.
+``--json`` emits one finding per line (the Finding dataclass fields
+verbatim) so CI and the autotune journal consume findings without
+scraping text.
 """
 from __future__ import annotations
 
 import argparse
 import sys
 
-from . import knobs
-from .lint import lint_paths
+from . import knobs, protocol
+from .lint import lint_paths, package_root
 
 
 def main(argv=None) -> int:
@@ -28,9 +36,20 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero on any unannotated finding "
                          "(the CI gate mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="one finding per line as JSON (Finding "
+                         "dataclass fields; suppressed ones included "
+                         "with suppressed=true)")
     ap.add_argument("--knob-table", action="store_true",
                     help="print the generated markdown knob table for "
                          "docs/ROBUSTNESS.md and exit")
+    ap.add_argument("--protocol-table", action="store_true",
+                    help="print the generated wire-protocol op table "
+                         "for docs/PROTOCOL.md and exit")
+    ap.add_argument("--check", action="store_true",
+                    help="fail (exit 2) when a generated docs table "
+                         "(ROBUSTNESS.md knobs, PROTOCOL.md ops) is "
+                         "stale — the CI drift gate")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -38,19 +57,40 @@ def main(argv=None) -> int:
     if args.knob_table:
         print(knobs.markdown_table())
         return 0
+    if args.protocol_table:
+        print(protocol.markdown_table())
+        return 0
+    if args.check:
+        problems = [p for p in (knobs.check_drift(package_root()),
+                                protocol.check_drift(package_root()))
+                    if p]
+        for p in problems:
+            print(p)
+        if problems:
+            return 2
+        print("mxnet_tpu.analysis --check: generated doc tables are "
+              "in sync")
+        return 0
     if args.list_rules:
         from .rules import ALL_RULES
         for rule in ALL_RULES:
             doc = (sys.modules[type(rule).__module__].__doc__ or
                    "").strip().splitlines()
-            print("%-14s %s" % (rule.name, doc[0] if doc else ""))
+            print("%-20s %s" % (rule.name, doc[0] if doc else ""))
         return 0
 
     active, suppressed = lint_paths(args.paths or None)
-    for f in sorted(active, key=lambda f: (f.path, f.line, f.rule)):
-        print(f.render())
-    print("mxnet_tpu.analysis: %d finding(s), %d suppressed by "
-          "allow-annotations" % (len(active), len(suppressed)))
+    if args.json:
+        import dataclasses
+        import json
+        for f in sorted(active + suppressed,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            print(json.dumps(dataclasses.asdict(f), sort_keys=True))
+    else:
+        for f in sorted(active, key=lambda f: (f.path, f.line, f.rule)):
+            print(f.render())
+        print("mxnet_tpu.analysis: %d finding(s), %d suppressed by "
+              "allow-annotations" % (len(active), len(suppressed)))
     if active:
         return 1 if args.strict else 0
     return 0

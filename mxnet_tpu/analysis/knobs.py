@@ -79,6 +79,30 @@ def missing_in_text(text: str) -> List[str]:
             if ("`%s`" % name) not in text]
 
 
+def check_drift(package_root: Path) -> Optional[str]:
+    """Stale-table drift check (``--check``): the generated knob table
+    must appear VERBATIM between docs/ROBUSTNESS.md's markers — a knob
+    added/retyped/redocumented without regenerating the table is a CI
+    failure, not a silent regeneration.  None when in sync (or no docs
+    checkout).  ``package_root`` locates the docs checkout only: the
+    registry itself is runtime state of the IMPORTED package
+    (base.declare_env), so this check is meaningful for the live tree,
+    not an arbitrary other checkout."""
+    docs_path = Path(package_root).resolve().parent / "docs" \
+        / "ROBUSTNESS.md"
+    if not docs_path.exists():
+        if not docs_path.parent.exists():
+            return None   # installed package without a docs checkout
+        return ("docs/ROBUSTNESS.md does not exist but docs/ does: "
+                "the knob table (`python -m mxnet_tpu.analysis "
+                "--knob-table`) must live there")
+    if markdown_table() not in docs_path.read_text():
+        return ("docs/ROBUSTNESS.md knob table is STALE: regenerate "
+                "with `python -m mxnet_tpu.analysis --knob-table` and "
+                "paste it over the knob-table:begin/end block")
+    return None
+
+
 def docs_missing(package_root: Path) -> Tuple[List[str], Path]:
     """Registered knobs absent from docs/ROBUSTNESS.md.
 

@@ -1,0 +1,422 @@
+"""Machine-readable view of the distributed-plane wire protocol.
+
+The op table is EXTRACTED from the AST, never hand-maintained: the
+core envelope dispatch (``KVStoreServer._handle``'s ``op ==`` /
+``op in (...)`` chain), the mesh fan-in dispatch
+(``_MeshLeader._handle``), every ``register_op`` extension site (the
+serving tier), the reserved-core-op tuple inside ``register_op``
+itself, every client request site (``.request((op, ...))`` /
+``.submit((op, ...))`` / ``_oneshot_request(addr, (op, ...))`` with a
+literal op), and every literal ``srv.*`` span name.  Each handler
+carries a structured declaration comment on its dispatch line (or the
+line above)::
+
+    if op == "push":   # protocol: replay(dedup-window) reply(none)
+
+    server.register_op("predict", fn)  # protocol: replay(pure) reply(batch)
+
+    sp = _tr.span_begin("srv.failover_rebuild")  # protocol: span(phase)
+
+``replay(<guard>)`` declares WHY the handler is safe behind the
+exactly-once envelope's replay (a reconnect replays the whole unacked
+window):
+
+* ``pure`` — no observable server-state mutation; re-running is free.
+  Statically cross-checked: a dispatch branch declared pure that
+  writes ``self.*`` state is a finding.
+* ``idempotent`` — mutates, but replay converges to the same state by
+  construction (first-init-wins, verbatim assign, newest-seq-wins
+  banks, bseq-numbered barriers, roster joins).
+* ``dedup-window`` — NOT intrinsically replay-safe (a re-applied push
+  doubles a gradient); correct only because the per-client
+  ``(client_id, seq)`` dedup window serves replays from cache.  These
+  handlers must never be reachable outside the envelope.
+* ``per-generation`` — first delivery per ``(key, generation)`` wins;
+  duplicates ack without re-applying (handoff/handoff_state).
+
+``reply(<shape>)`` names the reply payload for the generated protocol
+table (docs/PROTOCOL.md) the way ``--knob-table`` feeds ROBUSTNESS.md.
+``span(phase)`` declares a ``srv.*`` span that is an internal phase of
+a handler, not an envelope op of its own.
+
+The projection cannot drift from the code because it IS the code; the
+``protocol-op`` rule fails CI when a handler, client site or span
+falls outside it.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+DOCS_BEGIN = "<!-- protocol-table:begin (generated:"
+DOCS_END = "<!-- protocol-table:end -->"
+
+REPLAY_GUARDS = ("pure", "idempotent", "dedup-window", "per-generation")
+
+# the wire envelope itself — dispatch machinery, not an op
+ENVELOPE_OP = "req"
+
+_PROTO_RE = re.compile(r"#\s*protocol:\s*(?P<body>\S.*)")
+_FIELD_RE = re.compile(r"(?P<key>[a-z-]+)\((?P<val>[^()]*)\)")
+
+
+@dataclasses.dataclass
+class Declaration:
+    """One parsed ``# protocol:`` comment."""
+    line: int
+    replay: Optional[str] = None
+    reply: Optional[str] = None
+    span: Optional[str] = None
+    unknown: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class OpInfo:
+    """One wire op: where it is dispatched/registered and its
+    declaration."""
+    name: str
+    kind: str               # "core" | "mesh" | "extension"
+    path: str
+    line: int
+    owner: str              # enclosing class of the dispatch/registration
+    decl: Optional[Declaration] = None
+
+    @property
+    def replay(self) -> Optional[str]:
+        return self.decl.replay if self.decl else None
+
+    @property
+    def reply(self) -> str:
+        return (self.decl.reply if self.decl and self.decl.reply
+                else "—")
+
+
+@dataclasses.dataclass
+class ClientSite:
+    """One literal client request site."""
+    op: str
+    path: str
+    line: int
+    via: str                # request | submit | _oneshot_request
+
+
+@dataclasses.dataclass
+class SpanSite:
+    """One literal ``srv.*`` span name."""
+    name: str
+    path: str
+    line: int
+    phase: bool             # declared span(phase)
+
+
+@dataclasses.dataclass
+class ProtocolTable:
+    ops: List[OpInfo] = dataclasses.field(default_factory=list)
+    clients: List[ClientSite] = dataclasses.field(default_factory=list)
+    spans: List[SpanSite] = dataclasses.field(default_factory=list)
+    reserved: List[str] = dataclasses.field(default_factory=list)
+    # dispatch branches declared pure that mutate self state:
+    # (op, path, line, what)
+    impure: List[Tuple[str, str, int, str]] = \
+        dataclasses.field(default_factory=list)
+    bad_decls: List[Tuple[str, int, str]] = \
+        dataclasses.field(default_factory=list)
+
+    def op_names(self) -> set:
+        return {o.name for o in self.ops}
+
+    def merge(self, other: "ProtocolTable") -> None:
+        self.ops.extend(other.ops)
+        self.clients.extend(other.clients)
+        self.spans.extend(other.spans)
+        self.reserved.extend(other.reserved)
+        self.impure.extend(other.impure)
+        self.bad_decls.extend(other.bad_decls)
+
+
+def _comment_lines(source: str):
+    """(line, comment-text) for REAL comment tokens only — a line scan
+    would also match protocol examples inside docstrings (this very
+    module's, for one)."""
+    import io
+    import tokenize
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError):
+        return
+
+
+def parse_declarations(source) -> Dict[int, Declaration]:
+    """``# protocol:`` comments by line number (1-based)."""
+    out: Dict[int, Declaration] = {}
+    for ln, text in _comment_lines(source):
+        m = _PROTO_RE.search(text)
+        if not m:
+            continue
+        decl = Declaration(line=ln)
+        unknown = []
+        for fm in _FIELD_RE.finditer(m.group("body")):
+            key, val = fm.group("key"), fm.group("val").strip()
+            if key == "replay":
+                decl.replay = val
+            elif key == "reply":
+                decl.reply = val
+            elif key == "span":
+                decl.span = val
+            else:
+                unknown.append(key)
+        decl.unknown = tuple(unknown)
+        out[ln] = decl
+    return out
+
+
+def _decl_at(decls: Dict[int, Declaration],
+             line: int) -> Optional[Declaration]:
+    """The declaration covering ``line`` (the line itself or the line
+    directly above — same placement contract as allow-annotations)."""
+    for ln in (line, line - 1):
+        if ln in decls:
+            return decls[ln]
+    return None
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _op_literals(node) -> List[str]:
+    """Strings of ``op == "x"`` / ``op in ("x", "y")`` compares."""
+    if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+            and isinstance(node.left, ast.Name)
+            and node.left.id == "op"
+            and isinstance(node.ops[0], (ast.Eq, ast.In))):
+        return []
+    comp = node.comparators[0]
+    if isinstance(comp, ast.Tuple):
+        vals = [_const_str(e) for e in comp.elts]
+        return [v for v in vals if v is not None]
+    v = _const_str(comp)
+    return [v] if v is not None else []
+
+
+def _self_mutations(stmts) -> List[Tuple[int, str]]:
+    """Direct writes to self-rooted state inside a dispatch branch —
+    the static cross-check behind ``replay(pure)``.  Shallow by
+    design: helper calls carry their own declarations."""
+    def rooted_self(node):
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id == "self"
+
+    out = []
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr.startswith("_apply") \
+                    and rooted_self(node.func):
+                out.append((node.lineno,
+                            "call to self.%s" % node.func.attr))
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                        and rooted_self(t):
+                    out.append((t.lineno, ast.unparse(t)))
+    return out
+
+
+_MESH_CLASSES = ("_MeshLeader",)
+_TRACING_FNS = ("span", "span_begin", "instant", "add_span")
+
+
+class _Extractor(ast.NodeVisitor):
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.table = ProtocolTable()
+        self.decls = parse_declarations(ctx.source)
+        self.cls: Optional[str] = None
+        self.fn: Optional[str] = None
+
+    def run(self) -> ProtocolTable:
+        self.visit(self.ctx.tree)
+        for decl in self.decls.values():
+            for key in decl.unknown:
+                self.table.bad_decls.append(
+                    (self.ctx.relpath, decl.line,
+                     "unknown protocol field %r (expected replay/"
+                     "reply/span)" % key))
+            if decl.replay is not None \
+                    and decl.replay not in REPLAY_GUARDS:
+                self.table.bad_decls.append(
+                    (self.ctx.relpath, decl.line,
+                     "unknown replay guard %r (expected one of %s)"
+                     % (decl.replay, ", ".join(REPLAY_GUARDS))))
+        return self.table
+
+    def visit_ClassDef(self, node):
+        prev, self.cls = self.cls, node.name
+        self.generic_visit(node)
+        self.cls = prev
+
+    def _visit_fn(self, node):
+        prev, self.fn = self.fn, node.name
+        if node.name == "_handle":
+            self._extract_dispatch(node)
+        elif node.name == "register_op":
+            self._extract_reserved(node)
+        self.generic_visit(node)
+        self.fn = prev
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _extract_dispatch(self, fn_node):
+        kind = "mesh" if self.cls in _MESH_CLASSES else "core"
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.If):
+                continue
+            names = _op_literals(node.test)
+            if not names:
+                continue
+            decl = _decl_at(self.decls, node.test.lineno)
+            for name in names:
+                info = OpInfo(name=name, kind=kind,
+                              path=self.ctx.relpath,
+                              line=node.test.lineno,
+                              owner=self.cls or "<module>", decl=decl)
+                self.table.ops.append(info)
+                if decl is not None and decl.replay == "pure":
+                    for ln, what in _self_mutations(node.body):
+                        self.table.impure.append(
+                            (name, self.ctx.relpath, ln, what))
+
+    def _extract_reserved(self, fn_node):
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Compare) \
+                    and isinstance(node.ops[0], ast.In) \
+                    and isinstance(node.comparators[0], ast.Tuple):
+                vals = [_const_str(e)
+                        for e in node.comparators[0].elts]
+                self.table.reserved.extend(
+                    v for v in vals if v is not None)
+                return
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "register_op" and node.args:
+                name = _const_str(node.args[0])
+                if name is not None:
+                    self.table.ops.append(OpInfo(
+                        name=name, kind="extension",
+                        path=self.ctx.relpath, line=node.lineno,
+                        owner=self.cls or "<module>",
+                        decl=_decl_at(self.decls, node.lineno)))
+            elif f.attr in ("request", "submit") and node.args:
+                self._client_site(node.args[0], f.attr, node.lineno)
+            elif f.attr == "_oneshot_request" and len(node.args) >= 2:
+                self._client_site(node.args[1], f.attr, node.lineno)
+            elif f.attr in _TRACING_FNS and node.args:
+                name = _const_str(node.args[0])
+                if name is not None and name.startswith("srv."):
+                    decl = _decl_at(self.decls, node.lineno)
+                    self.table.spans.append(SpanSite(
+                        name=name, path=self.ctx.relpath,
+                        line=node.lineno,
+                        phase=bool(decl and decl.span == "phase")))
+        self.generic_visit(node)
+
+    def _client_site(self, arg, via, line):
+        if isinstance(arg, (ast.Tuple, ast.List)) and arg.elts:
+            op = _const_str(arg.elts[0])
+            if op is not None:
+                self.table.clients.append(ClientSite(
+                    op=op, path=self.ctx.relpath, line=line, via=via))
+
+
+def extract_file(ctx) -> ProtocolTable:
+    """Protocol facts of one parsed file (analysis.lint.FileContext)."""
+    return _Extractor(ctx).run()
+
+
+def extract_package(root=None) -> ProtocolTable:
+    """The protocol table of the package at ``root`` (default: the
+    installed one) — drives --protocol-table and the docs drift
+    check."""
+    from pathlib import Path
+    from .lint import FileContext, package_root
+    root = Path(root) if root is not None else package_root()
+    table = ProtocolTable()
+    for path in sorted(root.rglob("*.py")):
+        try:
+            ctx = FileContext(path, str(path.relative_to(root)))
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        table.merge(extract_file(ctx))
+    return table
+
+
+def check_drift(package_root) -> Optional[str]:
+    """Stale-table drift check (``--check``): the docs/PROTOCOL.md
+    NEXT TO ``package_root`` must carry the op table extracted from
+    THAT tree verbatim between its markers.  None when in sync; an
+    error string otherwise (a missing docs file counts — every wire
+    op is born documented)."""
+    from pathlib import Path
+    root = Path(package_root).resolve()
+    docs_path = root.parent / "docs" / "PROTOCOL.md"
+    if not docs_path.exists():
+        if not (root.parent / "docs").exists():
+            return None   # installed package without a docs checkout
+        return ("docs/PROTOCOL.md does not exist: generate it around "
+                "`python -m mxnet_tpu.analysis --protocol-table`")
+    if markdown_table(extract_package(root)) not in \
+            docs_path.read_text():
+        return ("docs/PROTOCOL.md protocol table is STALE: regenerate "
+                "with `python -m mxnet_tpu.analysis --protocol-table` "
+                "and paste it over the protocol-table:begin/end block")
+    return None
+
+
+def markdown_table(table: Optional[ProtocolTable] = None) -> str:
+    """The protocol table docs/PROTOCOL.md folds in (regenerate with
+    ``python -m mxnet_tpu.analysis --protocol-table``)."""
+    if table is None:
+        table = extract_package()
+    lines = [
+        DOCS_BEGIN + " python -m mxnet_tpu.analysis"
+        " --protocol-table) -->",
+        "| op | kind | replay guard | reply | handler |",
+        "|----|------|--------------|-------|---------|",
+    ]
+    seen = set()
+    for op in sorted(table.ops, key=lambda o: (o.kind, o.name, o.line)):
+        key = (op.kind, op.name)
+        if key in seen:
+            continue   # an `op in (...)` chain names one line per op
+        seen.add(key)
+        # no line numbers: the docs copy must only drift when the
+        # PROTOCOL changes, not when unrelated edits shift a file
+        lines.append("| `%s` | %s | %s | %s | `%s` (%s) |" % (
+            op.name, op.kind, op.replay or "**undeclared**",
+            op.reply.replace("|", "\\|"), op.path, op.owner))
+    phases = sorted({s.name for s in table.spans if s.phase})
+    if phases:
+        lines.append("")
+        lines.append("Internal phase spans (`span(phase)` — handler "
+                     "sub-phases, not envelope ops): "
+                     + ", ".join("`%s`" % p for p in phases))
+    lines.append(DOCS_END)
+    return "\n".join(lines)

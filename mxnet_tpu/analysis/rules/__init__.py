@@ -5,14 +5,20 @@ string, a ``check_file(ctx, project)`` generator, and optionally a
 ``finalize(project)`` generator for whole-package facts, then list it
 here and give it a fixture pair under tests/analysis_fixtures/.
 """
-from . import bare_thread, env_knobs, host_sync, lock_order, unsafe_pickle
+from . import (bare_thread, blocking_lock, env_knobs, host_sync,
+               lock_order, protocol_ops, raw_send, unsafe_pickle)
 
 ALL_RULES = (
     host_sync.RULE,
     unsafe_pickle.RULE,
     lock_order.RULE,
+    # blocking-under-lock consumes the acquisition records lock-order's
+    # check_file accumulates — keep it AFTER lock_order here
+    blocking_lock.RULE,
     env_knobs.RULE,
     bare_thread.RULE,
+    protocol_ops.RULE,
+    raw_send.RULE,
 )
 
 RULE_NAMES = tuple(r.name for r in ALL_RULES)

@@ -1,0 +1,127 @@
+"""blocking-under-lock: no blocking call while holding a lock.
+
+The stranded ``_io_loop`` waiters PR 5 found and the promotion-sweep
+stall this codebase already engineered around (``_promote_to_
+coordinator`` deliberately sweeps peers BEFORE taking the ledger
+lock) are one hazard: a thread parks on the network / a condition /
+a wire round while holding a lock, and every sibling of that lock
+wedges behind it — under the right interleaving, forever.
+
+This rule computes it statically from the SAME acquisition graph the
+lock-order rule extracts (``with lock:`` nesting, ``.acquire()``
+calls, one hop of intra-package call-following): a call that can
+block — socket send/recv/accept/connect, ``.wait()``/``.wait_for()``,
+``select``, ``sleep``, ``device_get``, the wire helpers
+(``_send_msg``/``_recv_msg``/``_await``/``_oneshot_request``/
+``submit(..., wait=True)``), barrier parks, mesh fan-in
+(``collect_push``/``mesh_collect``) — made while a lock is held is a
+finding, directly or through a resolvable callee.
+
+The one legal shape is the condition-variable park: ``cv.wait()``
+while holding ``cv`` RELEASES the lock before parking, so a wait
+whose receiver is exactly the held lock is exempt — but a caller
+parking that cv while holding a DIFFERENT lock is still flagged.
+A deliberate block-under-lock (a handle lock whose very contract is
+serializing waiters) carries
+``# analysis: allow(blocking-under-lock): <reason>``.
+"""
+from __future__ import annotations
+
+from ..lint import Finding
+from .lock_order import resolve_callee
+
+
+class _BlockingLockRule:
+    name = "blocking-under-lock"
+
+    # no check_file: the lock-order rule (registered earlier in
+    # ALL_RULES) populates project.scratch["lock-order"] with the
+    # shared per-function records, including blocking sites.
+
+    def check_file(self, ctx, project):
+        return ()
+
+    def finalize(self, project):
+        table = project.scratch.get("lock-order", {})
+        files = project.scratch.get("lock-order-files", {})
+        if not table:
+            return
+
+        def resolve_all(cands):
+            """Like the lock-order resolve, plus a subclass fallback:
+            a self-call that misses exactly (``_WireHandle.wait``
+            calling ``self._resolve``, defined only on subclasses)
+            unions every same-module method of that name — blocking is
+            a may-property, so over-approximating candidates is the
+            sound direction."""
+            exact = resolve_callee(table, cands)
+            if exact is not None:
+                return [exact]
+            for c in cands:
+                if c.startswith("*."):
+                    continue
+                head, _, meth = c.rpartition(".")
+                mod = head.rpartition(".")[0]
+                if not mod:
+                    continue
+                hits = [fid for fid in table
+                        if fid.startswith(mod + ".")
+                        and fid.endswith("." + meth)
+                        and fid.count(".") > mod.count(".") + 1]
+                if hits:
+                    return hits
+            return []
+
+        # closure of (desc, waited) blocking facts per function
+        closure = {fid: {(d, w) for d, _l, _h, w in rec.blocking}
+                   for fid, rec in table.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fid, rec in table.items():
+                for cands, _held, _line in rec.calls:
+                    for callee in resolve_all(cands):
+                        extra = closure[callee] - closure[fid]
+                        if extra:
+                            closure[fid] |= extra
+                            changed = True
+
+        def offending(entries, held):
+            """Blocking facts not excused by the cv-park pattern for
+            this held set."""
+            return [(d, w) for d, w in entries
+                    if not (w is not None and w in held)]
+
+        for fid, rec in sorted(table.items()):
+            path = files.get(fid, "?")
+            for desc, line, held, waited in rec.blocking:
+                if not held:
+                    continue
+                if waited is not None and waited in held:
+                    continue   # cv park: wait releases the held lock
+                yield Finding(
+                    rule=self.name, path=path, line=line,
+                    message="blocking call %s while holding %s — "
+                    "every sibling of the lock wedges behind this "
+                    "park; move the blocking call outside the "
+                    "critical section or annotate why the stall is "
+                    "bounded" % (desc, ", ".join(held)))
+            for cands, held, line in rec.calls:
+                if not held:
+                    continue
+                callees = [c for c in resolve_all(cands) if c != fid]
+                bad = offending(
+                    {b for c in callees for b in closure[c]}, held)
+                if bad:
+                    descs = ", ".join(sorted({d for d, _ in bad}))
+                    yield Finding(
+                        rule=self.name, path=path, line=line,
+                        message="call to %s while holding %s can "
+                        "block (%s) — the lock is held across a "
+                        "park; hoist the call or annotate why the "
+                        "stall is bounded"
+                        % (" | ".join(sorted(callees)),
+                           ", ".join(held), descs))
+
+
+RULE = _BlockingLockRule()

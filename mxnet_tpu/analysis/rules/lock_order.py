@@ -63,6 +63,42 @@ class _FuncRecord:
         self.acquisitions = []
         # (callee-candidate-tuple, held-tuple, line)
         self.calls = []
+        # (desc, line, held-tuple, waited-lock-or-None) at each call
+        # that can block (socket IO, cv/event waits, wire rounds) —
+        # consumed by the blocking-under-lock rule, which shares this
+        # extractor so both rules see one acquisition graph
+        self.blocking = []
+
+
+# Calls that can park the thread: holding a lock across one stalls
+# every sibling of that lock (and a cv-less wait can deadlock).  A
+# ``.wait``/``.wait_for`` whose receiver IS a held condition is the
+# legitimate cv-park pattern (wait releases the lock) — recorded with
+# its receiver so the rule can exempt it, while CALLERS of the parking
+# function under a DIFFERENT lock still get flagged transitively.
+_BLOCKING_ATTRS = frozenset({
+    "sendall", "recv", "recv_into", "accept", "connect",
+    "create_connection", "wait", "wait_for", "select", "sleep",
+    "device_get", "mesh_collect", "collect_push", "barrier",
+    "_oneshot_request", "submit",
+})
+_BLOCKING_NAMES = frozenset({"_send_msg", "_recv_msg", "_await"})
+
+
+def resolve_callee(table, cands):
+    """Resolve a call's candidate ids against the extracted function
+    table (exact id, or suffix match for module-qualified ``*.mod.fn``
+    candidates).  Shared by this rule's cycle closure and the
+    blocking-under-lock rule — one resolution scheme, never two."""
+    for c in cands:
+        if c.startswith("*."):
+            suffix = c[1:]          # ".mod.func"
+            for fid in table:
+                if fid.endswith(suffix):
+                    return fid
+        elif c in table:
+            return c
+    return None
 
 
 class _Extractor:
@@ -132,6 +168,11 @@ class _Extractor:
                 return
         if self.func is None:
             return
+        blocking = self._blocking_desc(node)
+        if blocking is not None:
+            self.func.blocking.append(
+                (blocking[0], node.lineno, tuple(self.held),
+                 blocking[1]))
         cands = None
         if isinstance(f, ast.Name):
             scope = "%s.%s" % (self.mod, self.cls) if self.cls else None
@@ -147,6 +188,28 @@ class _Extractor:
                 cands = ("*.%s.%s" % (parts[0], parts[1]),)
         if cands:
             self.func.calls.append((cands, tuple(self.held), node.lineno))
+
+    def _blocking_desc(self, node):
+        """(description, waited-lock-or-None) when the call can block,
+        else None (see _BLOCKING_ATTRS)."""
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in _BLOCKING_NAMES:
+                return f.id, None
+            return None
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _BLOCKING_ATTRS):
+            return None
+        if f.attr == "submit":
+            # only the awaited form blocks: submit(..., wait=True)
+            if not any(kw.arg == "wait"
+                       and isinstance(kw.value, ast.Constant)
+                       and kw.value.value for kw in node.keywords):
+                return None
+        waited = None
+        if f.attr in ("wait", "wait_for"):
+            waited = _lock_name(f.value, self.mod, self.cls)
+        return "." + f.attr, waited
 
 
 class _LockOrderRule:
@@ -171,15 +234,7 @@ class _LockOrderRule:
             return
 
         def resolve(cands):
-            for c in cands:
-                if c.startswith("*."):
-                    suffix = c[1:]          # ".mod.func"
-                    for fid in table:
-                        if fid.endswith(suffix):
-                            return fid
-                elif c in table:
-                    return c
-            return None
+            return resolve_callee(table, cands)
 
         # transitive closure of locks each function acquires
         closure = {fid: {a[0] for a in rec.acquisitions}

@@ -1,0 +1,117 @@
+"""protocol-op: every wire op is declared replay-safe; no stray ops.
+
+The exactly-once envelope replays the whole unacked window on every
+reconnect, so replay-safety is a CORRECTNESS contract for every
+handler behind ``("req", (rank, nonce), seq, msg)`` — not a style
+rule (the mark-exact lost-gradient bug and the closed-channel hang
+were both protocol hazards of exactly this shape).  This rule keeps
+the contract machine-checked from the extracted protocol table
+(:mod:`mxnet_tpu.analysis.protocol`):
+
+* every dispatched op (``_handle`` chains) and every ``register_op``
+  extension carries a ``# protocol: replay(<guard>)`` declaration;
+* guards come from the fixed vocabulary (pure / idempotent /
+  dedup-window / per-generation);
+* a dispatch branch declared ``pure`` that writes ``self.*`` state is
+  flagged — undeclared mutation behind replay;
+* every core op dispatched by ``KVStoreServer._handle`` appears in
+  ``register_op``'s reserved tuple (else an extension could shadow
+  it);
+* every literal client request site (``.request((op, ...))`` /
+  ``.submit`` / ``_oneshot_request``) names a dispatched/registered
+  op — a typo'd op fails lint, not a live job;
+* every literal ``srv.<x>`` span name is a registered op or is
+  declared ``# protocol: span(phase)`` (an internal handler phase).
+"""
+from __future__ import annotations
+
+from .. import protocol
+from ..lint import Finding
+
+_CORE_OWNER = "KVStoreServer"
+
+
+class _ProtocolOpsRule:
+    name = "protocol-op"
+
+    def check_file(self, ctx, project):
+        table = protocol.extract_file(ctx)
+        project.scratch.setdefault("protocol", []).append(table)
+        return ()
+
+    def finalize(self, project):
+        tables = project.scratch.get("protocol", [])
+        table = protocol.ProtocolTable()
+        for t in tables:
+            table.merge(t)
+        if not (table.ops or table.clients or table.spans):
+            return
+
+        for path, line, msg in table.bad_decls:
+            yield Finding(rule=self.name, path=path, line=line,
+                          message=msg)
+
+        seen = set()
+        for op in table.ops:
+            if (op.kind, op.name, op.path, op.line) in seen:
+                continue
+            seen.add((op.kind, op.name, op.path, op.line))
+            if op.decl is None or op.decl.replay is None:
+                yield Finding(
+                    rule=self.name, path=op.path, line=op.line,
+                    message="wire op %r has no replay-safety "
+                    "declaration — a reconnect REPLAYS the unacked "
+                    "window into this handler; declare why that is "
+                    "safe: '# protocol: replay(pure|idempotent|"
+                    "dedup-window|per-generation) reply(<shape>)'"
+                    % op.name)
+
+        for name, path, line, what in table.impure:
+            yield Finding(
+                rule=self.name, path=path, line=line,
+                message="op %r is declared replay(pure) but its "
+                "dispatch branch mutates server state (%s) — "
+                "undeclared mutation behind replay; declare the real "
+                "guard (idempotent / dedup-window / per-generation) "
+                "or hoist the mutation" % (name, what))
+
+        reserved = set(table.reserved)
+        if reserved:
+            for op in table.ops:
+                if op.kind == "core" and op.owner == _CORE_OWNER \
+                        and op.name not in reserved:
+                    yield Finding(
+                        rule=self.name, path=op.path, line=op.line,
+                        message="core op %r is dispatched but missing "
+                        "from register_op's reserved tuple — an "
+                        "extension could shadow it; add it to the "
+                        "reserved core-op list" % op.name)
+
+        if not table.ops:
+            # no dispatch table in scope (a lone client-side fixture
+            # file): nothing to validate sites/spans against
+            return
+        known = table.op_names() | {protocol.ENVELOPE_OP}
+        for site in table.clients:
+            if site.op not in known:
+                yield Finding(
+                    rule=self.name, path=site.path, line=site.line,
+                    message="client sends op %r via %s but no server "
+                    "dispatches or registers it — a typo'd/retired op "
+                    "would fail only at runtime on a live cluster"
+                    % (site.op, site.via))
+
+        for span in table.spans:
+            suffix = span.name[len("srv."):]
+            if span.phase or suffix in known:
+                continue
+            yield Finding(
+                rule=self.name, path=span.path, line=span.line,
+                message="span %r uses the srv.<op> namespace but %r "
+                "is not a registered wire op — name it after the op "
+                "it serves, or declare '# protocol: span(phase)' if "
+                "it is an internal handler phase"
+                % (span.name, suffix))
+
+
+RULE = _ProtocolOpsRule()

@@ -1,0 +1,95 @@
+"""raw-send: client traffic rides the exactly-once envelope machinery.
+
+``_send_msg`` / ``_recv_msg`` are the FRAME layer.  Everything a
+client says to a server must travel as ``("req", (rank, nonce), seq,
+msg)`` through ``_ServerConn`` — that envelope is what buys reconnect
++ full-window replay + server-side dedup (exactly-once), tracing
+propagation, fault-injection targeting and the byte counters.  A raw
+``_send_msg`` call outside the transport layer silently opts out of
+every one of those: its message is lost on the first transport fault
+and replays are re-applied, the lost-gradient shape PR 13's gate run
+caught.
+
+Allowlisted transport internals (the machinery itself):
+
+* ``kvstore_server.py`` — defines the frame fns; the server side,
+  one-shot relay/sweep dials and the beat loop speak raw by design
+  (beats/heartbeats must never stall behind a delay-acks fault plan).
+* ``kvstore._ServerConn`` — the envelope machinery.
+* ``kvstore._MeshLeader`` — the in-host fan-in endpoint's serve half.
+* ``serving/replica.py`` — the replica's pipelined serve/reply half.
+
+Anything else — a new subsystem dialing a server directly — is a
+finding; route through ``_ServerConn.request``/``submit`` or annotate
+with the reason the raw channel is exempt from the replay contract
+(heartbeat-class liveness traffic is the usual one).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding
+
+_FRAME_FNS = ("_send_msg", "_recv_msg")
+
+# (module-relpath predicate, class name or None=whole module)
+_ALLOWED = (
+    ("kvstore_server.py", None),
+    ("kvstore.py", "_ServerConn"),
+    ("kvstore.py", "_MeshLeader"),
+    ("serving/replica.py", None),
+)
+
+
+def _allowed(relpath: str, cls) -> bool:
+    rel = relpath.replace("\\", "/")
+    for mod, klass in _ALLOWED:
+        # anchor on a path segment: tools_kvstore_server.py must NOT
+        # inherit kvstore_server.py's exemption
+        if (rel == mod or rel.endswith("/" + mod)) \
+                and (klass is None or klass == cls):
+            return True
+    return False
+
+
+class _RawSendRule:
+    name = "raw-send"
+
+    def check_file(self, ctx, project):
+        stack = []
+
+        def walk(node):
+            is_cls = isinstance(node, ast.ClassDef)
+            if is_cls:
+                stack.append(node.name)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            if is_cls:
+                stack.pop()
+
+        def visit(node):
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = None
+                if isinstance(f, ast.Name) and f.id in _FRAME_FNS:
+                    name = f.id
+                elif isinstance(f, ast.Attribute) \
+                        and f.attr in _FRAME_FNS:
+                    name = f.attr
+                cls = stack[-1] if stack else None
+                if name is not None and not _allowed(ctx.relpath, cls):
+                    yield Finding(
+                        rule=self.name, path=ctx.relpath,
+                        line=node.lineno,
+                        message="raw %s outside the transport layer: "
+                        "client traffic must ride the ('req', (rank, "
+                        "nonce), seq, msg) envelope (_ServerConn."
+                        "request/submit) to get reconnect+replay+"
+                        "dedup; annotate if this is heartbeat-class "
+                        "liveness traffic" % name)
+            yield from walk(node)
+
+        yield from walk(ctx.tree)
+
+
+RULE = _RawSendRule()

@@ -43,6 +43,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .analysis import hb as _hb
 from .base import MXNetError
 from .ndarray import NDArray
 from . import optimizer as opt
@@ -922,6 +923,7 @@ class _WireHandle:
             # (docs/OBSERVABILITY.md health section)
             wtok = _health.wait_begin("kv.wire_wait")
             try:
+                # analysis: allow(blocking-under-lock): the handle lock's CONTRACT is serializing waiters — every wait() caller expects to park until the wire round resolves, and no other lock ever nests inside it
                 vals = self._resolve()
             finally:
                 # end even when a channel failure raises out of the
@@ -970,7 +972,7 @@ class _PullHandle(_WireHandle):
     def __init__(self, kv, entries):
         super().__init__()
         self._kv = kv
-        self._entries = entries
+        self._entries = _hb.track(entries, "kvstore._PullHandle.entries")
 
     def _nkeys(self):
         return len(self._entries)
@@ -1286,14 +1288,14 @@ class _MeshLeader:
     def _handle(self, inner):
         from . import profiler as _prof
         op = inner[0]
-        if op == "mesh_push":
+        if op == "mesh_push":  # protocol: replay(dedup-window) reply(none)
             _, seq, pairs = inner
             with self._cv:
                 self._pushes.setdefault(int(seq), []).append(pairs)
                 self._cv.notify_all()
             _prof.record_channel_event("kvstore.mesh_push")
             return None
-        if op == "mesh_collect":
+        if op == "mesh_collect":  # protocol: replay(dedup-window) reply(key -> ndarray)
             _, seq, keys = inner
             seq = int(seq)
             with self._cv:
@@ -1313,7 +1315,7 @@ class _MeshLeader:
                     self._handles.pop(seq, None)
             _prof.record_channel_event("kvstore.mesh_collect")
             return {k: vals[k] for k in keys}
-        if op == "command":
+        if op == "command":  # protocol: replay(pure) reply(none)
             return None   # follower channel flush token
         raise MXNetError(f"mesh leader: unknown op {op!r}")
 
@@ -1374,8 +1376,20 @@ class KVStoreDistAsync(KVStore):
         self._failovers = 0           # coordinator successions ridden
         self._coordinator_slot = 0    # bootstrap slot of the coordinator
         self._barrier_seq = 0         # per-worker barrier sequence
-        self._pull_cache: Dict[str, np.ndarray] = {}
-        self._push_log: Dict[str, list] = {}
+        # _elastic_lock guards the pull cache / push log quartet (and
+        # the order deque): _cache_value runs on whatever thread
+        # resolves a _PullHandle — the mesh-collect server threads
+        # included — concurrently with _log_push/_push_mark on the
+        # pushing thread.  Unsynchronized, the absorb accounting
+        # (read-modify-write of _push_log_absorbed, del of list
+        # prefixes) can lose or double re-push log entries across a
+        # roster bump (hb-sanitizer finding, ISSUE 15).  All four
+        # structures are hb-tracked.
+        self._elastic_lock = threading.Lock()
+        self._pull_cache: Dict[str, np.ndarray] = _hb.track(
+            {}, "KVStoreDistAsync._pull_cache")
+        self._push_log: Dict[str, list] = _hb.track(
+            {}, "KVStoreDistAsync._push_log")
         # absolute per-key push positions: _push_log_seq counts every
         # push ever logged, _push_log_absorbed how many of those the
         # cache has absorbed.  A pull's cache sync may only absorb
@@ -1384,14 +1398,17 @@ class KVStoreDistAsync(KVStore):
         # already in flight, and absorbing those would drop them from
         # the elastic re-push log (the exact-bookkeeping half of the
         # ISSUE 14 replan contract)
-        self._push_log_seq: Dict[str, int] = {}
-        self._push_log_absorbed: Dict[str, int] = {}
+        self._push_log_seq: Dict[str, int] = _hb.track(
+            {}, "KVStoreDistAsync._push_log_seq")
+        self._push_log_absorbed: Dict[str, int] = _hb.track(
+            {}, "KVStoreDistAsync._push_log_absorbed")
         self._push_log_order = None
         self._push_log_cap = int(_env("MXNET_KVSTORE_ELASTIC_PUSH_LOG",
                                       256))
         if self._elastic:
             import collections
-            self._push_log_order = collections.deque()
+            self._push_log_order = _hb.track(
+                collections.deque(), "KVStoreDistAsync._push_log_order")
             # dial the bootstrap uris in order until one answers the
             # roster op: slot 0 is the coordinator in the common case,
             # but a late joiner may arrive AFTER churn — any surviving
@@ -1847,9 +1864,11 @@ class KVStoreDistAsync(KVStore):
             for _u, c in fresh:
                 if _u not in old_servers:
                     c.submit(("command", K_CONTROLLER, blob), wait=True)
+        with self._elastic_lock:
+            cache_shapes = {k: v.shape
+                            for k, v in self._pull_cache.items()}
         moved = _mem.plan_handoff(
-            {k: v.shape for k, v in self._pull_cache.items()},
-            old_servers, servers, self._bigarray_bound)
+            cache_shapes, old_servers, servers, self._bigarray_bound)
         self._last_moved_keys = set(moved)
         if moved and self._gc_residual:
             # compression error-feedback residuals are keyed by WIRE key
@@ -1912,6 +1931,15 @@ class KVStoreDistAsync(KVStore):
             # collecting after would read back nothing
             with _tr.span("handoff.collect", cat="elastic"):
                 per_wire = self._collect_handoff_states(moved, old_servers)
+            # one consistent snapshot of the moved keys' cached values
+            # and logged gradients: the wire work below must not hold
+            # the elastic lock (it blocks on replies), and reading the
+            # live structures per-key would race a concurrent
+            # _cache_value from an in-flight handle resolve
+            with self._elastic_lock:
+                cache_snap = {k: self._pull_cache.get(k) for k in moved}
+                log_snap = {k: list(self._push_log.get(k, ()))
+                            for k in moved}
             pendings = []
             # per-phase flight-recorder breadcrumbs: with MXNET_TRACE=0
             # the spans vanish but the postmortem can still name the
@@ -1921,7 +1949,7 @@ class KVStoreDistAsync(KVStore):
                          generation=int(gen))
             with _tr.span("handoff.values", cat="elastic"):
                 for k in moved:
-                    val = self._pull_cache.get(k)
+                    val = cache_snap.get(k)
                     if val is None:
                         continue
                     for wk, uri, part in _mem.restripe_value(
@@ -1936,7 +1964,7 @@ class KVStoreDistAsync(KVStore):
             with _tr.span("handoff.states", cat="elastic"):
                 if per_wire:
                     for k in moved:
-                        shape = self._pull_cache[k].shape
+                        shape = cache_snap[k].shape
                         old_plan = _mem.stripe_plan(
                             k, shape, len(old_servers),
                             self._bigarray_bound)
@@ -1957,7 +1985,7 @@ class KVStoreDistAsync(KVStore):
             _health.note("handoff.repush", generation=int(gen))
             with _tr.span("handoff.repush", cat="elastic"):
                 for k in moved:
-                    for grad in self._push_log.get(k, []):
+                    for grad in log_snap.get(k, ()):
                         _prof.record_channel_event("kvstore.orphan_repush")
                         self._route_push(k, grad)
         finally:
@@ -2015,7 +2043,8 @@ class KVStoreDistAsync(KVStore):
         ENQUEUE time so the later cache sync absorbs exactly the pushes
         that pull observed (per-conn FIFO: everything sent before the
         pull request, nothing after)."""
-        return self._push_log_seq.get(k, 0)
+        with self._elastic_lock:
+            return self._push_log_seq.get(k, 0)
 
     def _cache_value(self, k: str, arr, mark=None):
         """Remember the last synced full value of ``k`` (the quorum
@@ -2025,19 +2054,21 @@ class KVStoreDistAsync(KVStore):
         authoritative state)."""
         if not self._elastic:
             return
-        self._pull_cache[k] = np.asarray(arr)
-        seq = self._push_log_seq.get(k, 0)
-        if mark is None or mark > seq:
-            mark = seq
-        absorbed = self._push_log_absorbed.get(k, 0)
-        n = mark - absorbed
-        if n > 0:
-            entries = self._push_log.get(k)
-            if entries:
-                del entries[:min(n, len(entries))]
-                if not entries:
-                    self._push_log.pop(k, None)
-        self._push_log_absorbed[k] = max(absorbed, mark)
+        arr = np.asarray(arr)
+        with self._elastic_lock:
+            self._pull_cache[k] = arr
+            seq = self._push_log_seq.get(k, 0)
+            if mark is None or mark > seq:
+                mark = seq
+            absorbed = self._push_log_absorbed.get(k, 0)
+            n = mark - absorbed
+            if n > 0:
+                entries = self._push_log.get(k)
+                if entries:
+                    del entries[:min(n, len(entries))]
+                    if not entries:
+                        self._push_log.pop(k, None)
+            self._push_log_absorbed[k] = max(absorbed, mark)
 
     def _log_push(self, k: str, agg: np.ndarray):
         """Remember one pushed gradient until a pull of ``k`` that
@@ -2046,20 +2077,22 @@ class KVStoreDistAsync(KVStore):
         best-effort for jobs that never pull)."""
         if not self._elastic:
             return
-        self._push_log.setdefault(k, []).append(np.asarray(agg))
-        self._push_log_seq[k] = self._push_log_seq.get(k, 0) + 1
-        self._push_log_order.append(k)
-        while len(self._push_log_order) > self._push_log_cap:
-            old = self._push_log_order.popleft()
-            entries = self._push_log.get(old)
-            if entries:
-                entries.pop(0)
-                # a cap-dropped entry counts as absorbed so later
-                # marks keep addressing the list front correctly
-                self._push_log_absorbed[old] = \
-                    self._push_log_absorbed.get(old, 0) + 1
-                if not entries:
-                    self._push_log.pop(old, None)
+        agg = np.asarray(agg)
+        with self._elastic_lock:
+            self._push_log.setdefault(k, []).append(agg)
+            self._push_log_seq[k] = self._push_log_seq.get(k, 0) + 1
+            self._push_log_order.append(k)
+            while len(self._push_log_order) > self._push_log_cap:
+                old = self._push_log_order.popleft()
+                entries = self._push_log.get(old)
+                if entries:
+                    entries.pop(0)
+                    # a cap-dropped entry counts as absorbed so later
+                    # marks keep addressing the list front correctly
+                    self._push_log_absorbed[old] = \
+                        self._push_log_absorbed.get(old, 0) + 1
+                    if not entries:
+                        self._push_log.pop(old, None)
 
     # -- kv ops --------------------------------------------------------------
     def init(self, key, value):

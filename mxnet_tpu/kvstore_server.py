@@ -47,6 +47,7 @@ from . import faultinject
 from . import profiler as _prof
 from . import tracing as _tr
 from . import health as _health
+from .analysis import hb as _hb
 from .base import env as _env
 from .compression import WirePayload, decompress as _decompress
 
@@ -328,7 +329,10 @@ class KVStoreServer:
                  elastic=None, uri=None, roster_servers=None):
         self.server_id = server_id
         self.num_workers = num_workers
-        self._store = {}          # key -> NDArray (host CPU)
+        # the hot shared containers are hb-tracked: identity in
+        # production, race-checked wrappers under the happens-before
+        # sanitizer's shim (mxnet_tpu.analysis.hb)
+        self._store = _hb.track({}, "KVStoreServer._store")
         self._updater = None
         self._lock = threading.Lock()
         self._barrier_cv = threading.Condition()
@@ -383,7 +387,8 @@ class KVStoreServer:
             "MXNET_KVSTORE_DEDUP_WINDOW",
             max(8, 2 * int(_env("MXNET_KVSTORE_WINDOW", 8)))))
         self._dedup_clients = 256
-        self._dedup = OrderedDict()   # client_id -> {inflight, replies}
+        self._dedup = _hb.track(OrderedDict(),
+                                "KVStoreServer._dedup")
         self._dedup_cv = threading.Condition()
         self.dedup_count = 0          # replays served from the window
         # liveness: last ping (or enveloped request) per worker rank.
@@ -434,14 +439,15 @@ class KVStoreServer:
         # from the beat fan-out on EVERY server, so the killed-server
         # recovery source no longer dies with server 0; promoted into
         # the rebuilt ledger on failover.
-        self._peer_snapshots = {}
+        self._peer_snapshots = _hb.track(
+            {}, "KVStoreServer._peer_snapshots")
         # peer stats bank: uri -> (beat seq, compact profiler counters).
         # Beats piggyback profiler.snapshot(compact=True), banked on
         # EVERY server with the same newest-seq-wins rule as snapshots —
         # so the last-known counters of a SIGKILLed member survive its
         # death (and the coordinator's death) and ride the "stats"
         # envelope's stats_bank field (docs/OBSERVABILITY.md)
-        self._peer_stats = {}
+        self._peer_stats = _hb.track({}, "KVStoreServer._peer_stats")
         self._promoted = False        # this server succeeded a dead coord
         self._coord_last_ok = None    # last successful coordinator beat
         self._coord_refused = False   # last coordinator dial was refused
@@ -451,9 +457,12 @@ class KVStoreServer:
         # (values), same for optimizer state; base key -> generation the
         # stale wire forms were purged at.  Quorum re-pushes and
         # replayed envelopes are idempotent through these.
-        self._handoff_gen = {}
-        self._handoff_state_gen = {}
-        self._handoff_base_gen = {}
+        self._handoff_gen = _hb.track(
+            {}, "KVStoreServer._handoff_gen")
+        self._handoff_state_gen = _hb.track(
+            {}, "KVStoreServer._handoff_state_gen")
+        self._handoff_base_gen = _hb.track(
+            {}, "KVStoreServer._handoff_base_gen")
 
     def register_op(self, op: str, fn) -> None:
         """Register an extension envelope type: ``fn(msg, rank) ->
@@ -488,6 +497,7 @@ class KVStoreServer:
                 # child of the srv.push envelope span: on the merged
                 # timeline the optimizer apply separates from
                 # decode/lock time (docs/OBSERVABILITY.md)
+                # protocol: span(phase)
                 with _tr.span("srv.updater_apply", cat="server"):
                     self._updater(_key_int(key), grad, stored)
             else:
@@ -495,13 +505,13 @@ class KVStoreServer:
 
     def _handle(self, msg, rank=None, client=None):
         op = msg[0]
-        if op == "ping":
+        if op == "ping":  # protocol: replay(idempotent) reply(none)
             # heartbeat: out-of-band liveness (its own connection — the
             # data channel may legitimately block in a barrier)
             if len(msg) > 1:
                 self._note_ping(msg[1])
             return None
-        if op == "init":
+        if op == "init":  # protocol: replay(idempotent) reply(none)
             # first init wins; later inits of the same key are ignored
             # (reference: the server keeps the first-arriving value,
             # kvstore_dist_server.h DataHandleDefault init path)
@@ -512,11 +522,11 @@ class KVStoreServer:
                 if key not in self._store:
                     self._store[key] = NDArray(jnp.asarray(arr))
             return None
-        if op == "push":
+        if op == "push":  # protocol: replay(dedup-window) reply(none)
             _, key, arr = msg
             self._apply_push(key, arr)
             return None
-        if op == "push_multi":
+        if op == "push_multi":  # protocol: replay(dedup-window) reply(none)
             # coalesced small-key push: one envelope, applied in order
             # (the worker groups sub-threshold keys bound for this shard
             # into a single frame — one RTT instead of K)
@@ -524,7 +534,7 @@ class KVStoreServer:
             for key, arr in entries:
                 self._apply_push(key, arr)
             return None
-        if op == "assign":
+        if op == "assign":  # protocol: replay(idempotent) reply(none)
             # store the pushed value VERBATIM, bypassing any installed
             # updater, creating the key if absent.  Control-plane
             # metadata (the serving weight-version counter) must be a
@@ -542,14 +552,14 @@ class KVStoreServer:
                 else:
                     stored._set_data(jnp.asarray(arr))
             return None
-        if op == "pull":
+        if op == "pull":  # protocol: replay(pure) reply(ndarray)
             _, key = msg
             with self._lock:
                 stored = self._store.get(key)
                 if stored is None:
                     raise KeyError(f"pull of uninitialized key {key!r}")
                 return np.asarray(stored.asnumpy())
-        if op == "pull_rows":
+        if op == "pull_rows":  # protocol: replay(pure) reply(rows + full shape)
             # O(requested rows) row-sparse pull (reference:
             # DataHandleRowSparse, kvstore_dist_server.h:211 — only the
             # requested rows travel)
@@ -560,7 +570,7 @@ class KVStoreServer:
                     raise KeyError(f"pull of uninitialized key {key!r}")
                 full = np.asarray(stored.asnumpy())
                 return full[ids], full.shape
-        if op == "get_states":
+        if op == "get_states":  # protocol: replay(pure) reply(states blob | None)
             # optimizer-state checkpointing: this shard's {key: state}
             # dict, optionally with the optimizer itself (reference:
             # server-side optimizer states live in the server,
@@ -586,7 +596,7 @@ class KVStoreServer:
                 # key's OWNER, so these can never shadow fresh state
                 return pickle.dumps((states, self._updater.optimizer)
                                     if dump else states)
-        if op == "set_states":
+        if op == "set_states":  # protocol: replay(idempotent) reply(none)
             _, blob = msg
             with self._lock:
                 if self._updater is None:
@@ -596,30 +606,31 @@ class KVStoreServer:
                 # allowlist (Updater.set_states accepts the loaded dict)
                 self._updater.set_states(_restricted_loads(blob))
             return None
-        if op == "command":
+        if op == "command":  # protocol: replay(idempotent) reply(none)
             _, head, body = msg
             return self._command(head, body)
-        if op == "barrier":
+        if op == "barrier":  # protocol: replay(idempotent) reply(generation | generation, realign)
             return self._barrier(rank, msg[1] if len(msg) > 1 else None,
                                  client=client)
-        if op == "stats":
+        if op == "stats":  # protocol: replay(pure) reply(profiler snapshot + stats_bank)
             # the universal observability envelope: EVERY server (and
             # every subclass — the serving replica generalizes its old
             # serving_stats through this) answers with the full
             # profiler snapshot plus server identity and the last-
             # known-stats bank of its peers (docs/OBSERVABILITY.md)
             return self._stats_payload()
-        if op == "roster_get":
+        if op == "roster_get":  # protocol: replay(idempotent) reply(roster wire)
             return self._roster_op(("roster_get",))
+        # protocol: replay(idempotent) reply(roster wire | roster wire + barrier floor)
         if op in ("roster_join", "roster_leave", "roster_dead"):
             _, role, ident = msg
             return self._roster_op((op, role, ident))
-        if op == "roster_fwd":
+        if op == "roster_fwd":  # protocol: replay(idempotent) reply(forwarded op reply)
             # a peer forwarded a roster op it could not serve (it is not
             # the coordinator): dispatch locally, NEVER re-forward — one
             # hop bounds the succession-window relay
             return self._roster_op(tuple(msg[1]), forwarded=True)
-        if op == "roster_beat":
+        if op == "roster_beat":  # protocol: replay(idempotent) reply(roster wire | none)
             # a peer server's liveness beat, optionally carrying its
             # state snapshot (raw message: beats must never be stalled
             # by a delay-acks fault plan, like heartbeats).  EVERY
@@ -637,7 +648,7 @@ class KVStoreServer:
                 return None
             m.note_server_beat(suri, seq=seq, snapshot=snap, stats=stats)
             return m.roster().as_wire()
-        if op == "roster_snapshot":
+        if op == "roster_snapshot":  # protocol: replay(pure) reply(snapshot struct | none)
             # serve from the ledger bank OR the local peer bank: the
             # request must be answerable on whichever server is the
             # coordinator after a failover
@@ -645,21 +656,25 @@ class KVStoreServer:
             m = self._get_membership()
             snap = m.snapshot_of(ident) if m is not None else None
             if snap is None:
-                have = self._peer_snapshots.get(ident)
+                # under self._lock: the beat handlers bank into this
+                # dict under the same lock from other connection
+                # threads (hb-sanitizer finding, ISSUE 15)
+                with self._lock:
+                    have = self._peer_snapshots.get(ident)
                 snap = have[1] if have else None
             if snap is None and m is None:
                 self._require_membership()   # classic not-coordinator error
             return snap
-        if op == "ledger_report":
+        if op == "ledger_report":  # protocol: replay(pure) reply(report dict)
             # ("ledger_report", True) is the SLIM form the promotion
             # sweep uses (generation + beat seq only); the bare op also
             # names the live key set, for operator forensics
             return self._ledger_report(
                 slim=bool(msg[1]) if len(msg) > 1 else False)
-        if op == "handoff":
+        if op == "handoff":  # protocol: replay(per-generation) reply(applied bool)
             _, gen, wire_key, arr, bkey = msg
             return self._apply_handoff(int(gen), wire_key, arr, bkey)
-        if op == "handoff_state":
+        if op == "handoff_state":  # protocol: replay(per-generation) reply(applied bool)
             _, gen, wire_key, state, bkey = msg
             return self._apply_handoff_state(int(gen), wire_key, state,
                                              bkey)
@@ -724,6 +739,7 @@ class KVStoreServer:
                 # trace — the replay carries the ORIGINAL trace field,
                 # so this instant lands in the original trace, proving
                 # the reconnect was absorbed idempotently
+                # protocol: span(phase)
                 _tr.instant("srv.dedup_hit", args={"seq": seq})
                 return st["replies"][seq]
             st["inflight"].add(seq)
@@ -1013,6 +1029,7 @@ class KVStoreServer:
         # ledger rebuild — on the merged timeline the rebuild window
         # sits between the dead coordinator's last span and the first
         # post-succession barrier release (docs/OBSERVABILITY.md)
+        # protocol: span(phase)
         fsp = _tr.span_begin("srv.failover_rebuild", cat="elastic",
                              args={"dead": sorted(dead_uris)})
         try:
@@ -1546,6 +1563,7 @@ class KVStoreServer:
             # srv.barrier envelope span: on the merged timeline the
             # rendezvous skew between ranks — and a renegotiation's
             # eviction window — reads directly off the park widths
+            # protocol: span(phase)
             park = _tr.span_begin("srv.barrier_park", cat="server",
                                   args={"rank": rank, "bseq": bseq})
             # the park is a registered health wait: a rendezvous parked

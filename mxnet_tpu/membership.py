@@ -50,6 +50,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .analysis import hb as _hb
+
 #: stripe-suffix separator, shared with the kvstore wire protocol
 STRIPE_SEP = "@s"
 
@@ -324,14 +326,18 @@ class MembershipCoordinator:
         self._generation = 0
         self._servers: List[str] = list(dict.fromkeys(servers))
         self._workers = set(int(w) for w in workers)
-        self._server_seen: Dict[str, float] = {}
-        self._snapshots: Dict[str, tuple] = {}   # uri -> (seq, blob)
+        self._server_seen: Dict[str, float] = _hb.track(
+            {}, "MembershipCoordinator._server_seen")
+        # uri -> (seq, blob); hb-tracked like the server-side banks
+        self._snapshots: Dict[str, tuple] = _hb.track(
+            {}, "MembershipCoordinator._snapshots")
         # last-known compact profiler counters per server, piggybacked
         # on beats (kvstore_server beat loop) — same newest-seq-wins
         # rule and same outlives-eviction contract as the state
         # snapshots: the counters of a SIGKILLed member stay readable
         # through the coordinator's "stats" envelope
-        self._stats: Dict[str, tuple] = {}       # uri -> (seq, counters)
+        self._stats: Dict[str, tuple] = _hb.track(
+            {}, "MembershipCoordinator._stats")   # uri -> (seq, counters)
         self.evictions = 0
         self.failovers = 0   # ledgers this one succeeded (rebuild_ledger)
 

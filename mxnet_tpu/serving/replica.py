@@ -77,8 +77,11 @@ class ServingReplica(KVStoreServer):
         # must not hold a conn thread inside _exactly_once while the
         # batch forms (that would serialize the batcher per connection)
         self._deferred_ops = {"predict"}
+        # protocol: replay(pure) reply(predictions)
         self.register_op("predict", self._op_predict_sync)
+        # protocol: replay(pure) reply(serving stats dict)
         self.register_op("serving_stats", self._op_stats)
+        # protocol: replay(idempotent) reply(version + refreshed)
         self.register_op("serving_refresh", self._op_refresh)
         if param_servers is None:
             import os

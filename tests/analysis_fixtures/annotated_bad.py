@@ -47,3 +47,24 @@ def bare(q):
     # analysis: allow(bare-thread): fixture — pretend thread death is observable via the queue sentinel
     t = threading.Thread(target=worker, daemon=True)
     return t
+
+
+def send_raw(sock, msg):
+    from mxnet_tpu.kvstore_server import _send_msg
+    # analysis: allow(raw-send): fixture — pretend this is heartbeat-class liveness traffic exempt from the replay contract
+    _send_msg(sock, msg)
+
+
+def hold_and_send(sock):
+    with _a_lock:
+        # analysis: allow(blocking-under-lock): fixture — pretend the peer acks within a bounded budget
+        sock.sendall(b"x")
+
+
+class AnnotatedServer:
+    def _handle(self, msg, rank=None):
+        op = msg[0]
+        # analysis: allow(protocol-op): fixture — pretend this op predates the conformance suite and is being migrated
+        if op == "legacy_undeclared":
+            return None
+        return None

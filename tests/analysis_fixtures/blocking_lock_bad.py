@@ -1,0 +1,26 @@
+"""blocking-under-lock positive fixture: a socket send and a foreign
+cv wait under a held lock, plus a transitive park through a callee."""
+import threading
+import time
+
+_lock = threading.Lock()
+_state_cv = threading.Condition()
+
+
+def send_under_lock(sock):
+    with _lock:
+        sock.sendall(b"payload")
+
+
+def wait_foreign_cv():
+    with _lock:
+        _state_cv.wait()
+
+
+def _helper():
+    time.sleep(1.0)
+
+
+def park_via_callee():
+    with _lock:
+        _helper()

@@ -1,0 +1,22 @@
+"""blocking-under-lock negative fixture: the legal cv park (wait
+releases the held lock) and blocking work hoisted out of the
+critical section."""
+import threading
+
+_state_cv = threading.Condition()
+_items = []
+
+
+def consume():
+    with _state_cv:
+        while not _items:
+            _state_cv.wait(0.1)
+        item = _items.pop()
+    return item
+
+
+def produce_and_send(sock, payload):
+    with _state_cv:
+        _items.append(payload)
+        _state_cv.notify_all()
+    sock.sendall(b"done")

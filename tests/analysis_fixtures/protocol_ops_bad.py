@@ -1,0 +1,26 @@
+"""protocol-op positive fixture: an undeclared handler behind replay,
+a pure-declared branch that mutates, an unknown replay guard, a
+client sending a retired op, and a srv.* span naming a non-op."""
+
+
+class FakeServer:
+    def __init__(self):
+        self._store = {}
+        self._ext = {}
+
+    def _handle(self, msg, rank=None):
+        op = msg[0]
+        if op == "mystery":
+            return None
+        if op == "mutate":  # protocol: replay(pure) reply(none)
+            self._store["k"] = msg[1]
+            return None
+        if op == "odd":  # protocol: replay(sometimes) reply(none)
+            return None
+        return None
+
+
+def client(conn, _tr):
+    pending = conn.request(("retired_op", 1))
+    _tr.span_begin("srv.not_an_op", cat="server")
+    return pending

@@ -1,0 +1,25 @@
+"""protocol-op negative fixture: every op declared with a real guard,
+client sites naming dispatched ops, phase spans declared."""
+
+
+class OkServer:
+    def __init__(self):
+        self._value = None
+        self._seen = {}
+
+    def _handle(self, msg, rank=None):
+        op = msg[0]
+        if op == "peek":  # protocol: replay(pure) reply(value)
+            return self._value
+        if op == "bump":  # protocol: replay(idempotent) reply(none)
+            self._seen["x"] = True
+            return None
+        return None
+
+
+def client(conn, _tr):
+    conn.submit(("bump", 1), wait=False)
+    pending = conn.request(("peek",))
+    # protocol: span(phase)
+    _tr.instant("srv.decode_phase")
+    return pending

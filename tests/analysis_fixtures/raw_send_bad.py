@@ -1,0 +1,15 @@
+"""raw-send positive fixture: frame-layer calls outside the transport
+machinery — these messages would skip the exactly-once envelope
+(no reconnect replay, no dedup, no tracing, no byte counters)."""
+from mxnet_tpu.kvstore_server import _recv_msg, _send_msg
+
+
+def talk(sock, msg):
+    _send_msg(sock, msg)
+    return _recv_msg(sock)
+
+
+class Prober:
+    def probe(self, sock, server_mod):
+        server_mod._send_msg(sock, ("stats",))
+        return server_mod._recv_msg(sock)

