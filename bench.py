@@ -430,6 +430,8 @@ def _run(batch):
     sync0 = _mx_prof.host_sync_total()
     wait0 = _mx_prof.wire_wait_ms()
     round0 = _mx_prof.wire_round_ms()
+    pickle0 = _mx_prof.pickle_bytes_total()
+    syscalls0 = _mx_prof.send_syscalls_total()
     t0 = time.perf_counter()
     for i in range(iters):
         step(i)
@@ -440,6 +442,8 @@ def _run(batch):
     dt = time.perf_counter() - t0
     wire_bytes = _mx_prof.wire_bytes_total() - wire0
     ici_bytes = _mx_prof.ici_bytes_total() - ici0
+    pickle_bytes = _mx_prof.pickle_bytes_total() - pickle0
+    send_syscalls = _mx_prof.send_syscalls_total() - syscalls0
     # overlap over THIS timed region only (wait/round deltas), so
     # warmup and earlier configs can't dilute the reported fraction
     wire_wait_d = _mx_prof.wire_wait_ms() - wait0
@@ -494,6 +498,16 @@ def _run(batch):
         "wire_wait_ms_per_step": round(
             wire_wait_d / iters / steps_per_call, 3),
         "overlap_pct": round(overlap_pct, 1),
+        # frame-layer cost counters (docs/PERF_NOTES.md round 12):
+        # pickle_bytes_per_step must be 0 steady-state with the binary
+        # codec negotiated (MXNET_KVSTORE_CODEC auto/binary — the
+        # regression gate for pickle creeping back onto the hot path);
+        # send_syscalls_per_step tracks the vectored sendmsg win (one
+        # syscall per frame vs 2+N sendalls)
+        "pickle_bytes_per_step": round(
+            pickle_bytes / iters / steps_per_call, 1),
+        "send_syscalls_per_step": round(
+            send_syscalls / iters / steps_per_call, 2),
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
         "remat": (os.environ.get("MXNET_REMAT_POLICY", "full")

@@ -72,6 +72,28 @@ JAX_PLATFORMS=cpu MXNET_KVSTORE_WINDOW=8 \
     python tools/launch.py -n 2 -s 1 \
     python tests/dist/dist_fault_injection.py
 
+echo "== fault-injection smoke: binary wire codec forced (v2 frames replayed)"
+# ISSUE 16's transport gate: the same sever-replay-dedup arithmetic
+# with MXNET_KVSTORE_CODEC=binary forced on every process — the
+# reconnect re-runs the codec hello BEFORE replaying the unacked
+# window, so the replayed envelopes ride the new binary frame.  A
+# framing regression presents as a hang in the receive loop or a
+# broken total.  (launch.py children inherit the launcher's env.)
+JAX_PLATFORMS=cpu MXNET_KVSTORE_CODEC=binary timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 \
+    python tests/dist/dist_fault_injection.py
+
+echo "== mixed-version interop smoke (pickle-pinned server, binary workers)"
+# The negotiation contract across real process boundaries: the server
+# pins MXNET_KVSTORE_CODEC=pickle (what a pre-codec peer looks like on
+# the wire — hellos answered with version 0) while the workers force
+# =binary; every connection must settle on pickle framing and the
+# exact SGD total must survive.  The role-dependent env pin lives in
+# the script itself.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 \
+    python tests/dist/dist_codec_interop.py
+
 echo "== elastic-membership smoke (SIGKILL a server mid-epoch, no restart)"
 # The roster must ACT on the liveness/striping/replay primitives
 # (docs/ROBUSTNESS.md elastic membership): server 1 is REALLY SIGKILLed

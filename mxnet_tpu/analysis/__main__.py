@@ -9,9 +9,11 @@ ci/run_ci.sh.  Explicit paths lint those files/directories instead
 (the fixture tests drive this).
 
 ``--knob-table`` / ``--protocol-table`` print the generated markdown
-tables docs/ROBUSTNESS.md and docs/PROTOCOL.md fold in; ``--check``
-fails (exit 2) when either docs copy is STALE instead of silently
-regenerating — the drift gate ci/run_ci.sh runs next to ``--strict``.
+tables docs/ROBUSTNESS.md and docs/PROTOCOL.md fold in;
+``--codec-table`` prints the generated hot-op block
+mxnet_tpu/wirecodec.py folds in; ``--check`` fails (exit 2) when any
+generated copy is STALE instead of silently regenerating — the drift
+gate ci/run_ci.sh runs next to ``--strict``.
 ``--json`` emits one finding per line (the Finding dataclass fields
 verbatim) so CI and the autotune journal consume findings without
 scraping text.
@@ -46,10 +48,14 @@ def main(argv=None) -> int:
     ap.add_argument("--protocol-table", action="store_true",
                     help="print the generated wire-protocol op table "
                          "for docs/PROTOCOL.md and exit")
+    ap.add_argument("--codec-table", action="store_true",
+                    help="print the generated hot-op codec block for "
+                         "mxnet_tpu/wirecodec.py and exit")
     ap.add_argument("--check", action="store_true",
-                    help="fail (exit 2) when a generated docs table "
-                         "(ROBUSTNESS.md knobs, PROTOCOL.md ops) is "
-                         "stale — the CI drift gate")
+                    help="fail (exit 2) when a generated table "
+                         "(ROBUSTNESS.md knobs, PROTOCOL.md ops, "
+                         "wirecodec.py hot-op codec block) is stale — "
+                         "the CI drift gate")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -60,9 +66,14 @@ def main(argv=None) -> int:
     if args.protocol_table:
         print(protocol.markdown_table())
         return 0
+    if args.codec_table:
+        print(protocol.codec_table_source())
+        return 0
     if args.check:
         problems = [p for p in (knobs.check_drift(package_root()),
-                                protocol.check_drift(package_root()))
+                                protocol.check_drift(package_root()),
+                                protocol.check_codec_drift(
+                                    package_root()))
                     if p]
         for p in problems:
             print(p)

@@ -39,6 +39,14 @@ table (docs/PROTOCOL.md) the way ``--knob-table`` feeds ROBUSTNESS.md.
 ``span(phase)`` declares a ``srv.*`` span that is an internal phase of
 a handler, not an envelope op of its own.
 
+``codec(binary)`` marks a HOT op: its envelopes (and replies) ride the
+registry-generated binary frame codec (:mod:`mxnet_tpu.wirecodec`)
+instead of pickle once a connection has negotiated it.  The codec's
+op set is GENERATED from these declarations (``--codec-table`` emits
+the literal block wirecodec.py folds in between its
+``codec-table:begin/end`` markers); the ``codec-coverage`` rule and
+``--check`` fail when the generated table drifts from the registry.
+
 The projection cannot drift from the code because it IS the code; the
 ``protocol-op`` rule fails CI when a handler, client site or span
 falls outside it.
@@ -53,7 +61,16 @@ from typing import Dict, List, Optional, Tuple
 DOCS_BEGIN = "<!-- protocol-table:begin (generated:"
 DOCS_END = "<!-- protocol-table:end -->"
 
+# markers of the generated hot-op block inside mxnet_tpu/wirecodec.py
+# (python source, so the markers are comments, not HTML)
+CODEC_BEGIN = "# codec-table:begin (generated:"
+CODEC_END = "# codec-table:end"
+
 REPLAY_GUARDS = ("pure", "idempotent", "dedup-window", "per-generation")
+
+# the only codec the registry generates today; the field is a vocabulary
+# so a typo'd value is a bad_decl finding, not a silently-pickled op
+CODEC_KINDS = ("binary",)
 
 # the wire envelope itself — dispatch machinery, not an op
 ENVELOPE_OP = "req"
@@ -69,6 +86,7 @@ class Declaration:
     replay: Optional[str] = None
     reply: Optional[str] = None
     span: Optional[str] = None
+    codec: Optional[str] = None
     unknown: Tuple[str, ...] = ()
 
 
@@ -91,6 +109,10 @@ class OpInfo:
     def reply(self) -> str:
         return (self.decl.reply if self.decl and self.decl.reply
                 else "—")
+
+    @property
+    def codec(self) -> Optional[str]:
+        return self.decl.codec if self.decl else None
 
 
 @dataclasses.dataclass
@@ -167,6 +189,8 @@ def parse_declarations(source) -> Dict[int, Declaration]:
                 decl.reply = val
             elif key == "span":
                 decl.span = val
+            elif key == "codec":
+                decl.codec = val
             else:
                 unknown.append(key)
         decl.unknown = tuple(unknown)
@@ -256,13 +280,19 @@ class _Extractor(ast.NodeVisitor):
                 self.table.bad_decls.append(
                     (self.ctx.relpath, decl.line,
                      "unknown protocol field %r (expected replay/"
-                     "reply/span)" % key))
+                     "reply/span/codec)" % key))
             if decl.replay is not None \
                     and decl.replay not in REPLAY_GUARDS:
                 self.table.bad_decls.append(
                     (self.ctx.relpath, decl.line,
                      "unknown replay guard %r (expected one of %s)"
                      % (decl.replay, ", ".join(REPLAY_GUARDS))))
+            if decl.codec is not None \
+                    and decl.codec not in CODEC_KINDS:
+                self.table.bad_decls.append(
+                    (self.ctx.relpath, decl.line,
+                     "unknown codec %r (expected one of %s)"
+                     % (decl.codec, ", ".join(CODEC_KINDS))))
         return self.table
 
     def visit_ClassDef(self, node):
@@ -390,6 +420,62 @@ def check_drift(package_root) -> Optional[str]:
     return None
 
 
+def codec_ops(table: Optional[ProtocolTable] = None) -> List[str]:
+    """Sorted names of the ops declared ``codec(binary)`` — the hot-op
+    set the generated wire codec covers."""
+    if table is None:
+        table = extract_package()
+    return sorted({o.name for o in table.ops if o.codec == "binary"})
+
+
+def codec_fingerprint(names) -> str:
+    """Fingerprint of a hot-op name list — what
+    CODEC_TABLE_FINGERPRINT must equal for the sorted declared set."""
+    import hashlib
+    return hashlib.sha256(
+        "\n".join(sorted(names)).encode()).hexdigest()[:12]
+
+
+def codec_table_source(table: Optional[ProtocolTable] = None) -> str:
+    """The generated hot-op block mxnet_tpu/wirecodec.py folds in
+    between its codec-table markers (regenerate with
+    ``python -m mxnet_tpu.analysis --codec-table``).  The fingerprint
+    pins the exact op set, so hand-edits drift-fail even when the
+    frozenset itself still parses."""
+    names = codec_ops(table)
+    fp = codec_fingerprint(names)
+    lines = [CODEC_BEGIN + " python -m mxnet_tpu.analysis"
+             " --codec-table)",
+             "HOT_OPS = frozenset({"]
+    lines.extend('    "%s",' % n for n in names)
+    lines.append("})")
+    lines.append('CODEC_TABLE_FINGERPRINT = "%s"' % fp)
+    lines.append(CODEC_END)
+    return "\n".join(lines)
+
+
+def check_codec_drift(package_root) -> Optional[str]:
+    """Stale-codec drift check (``--check``): mxnet_tpu/wirecodec.py
+    must carry the hot-op block generated from the registry verbatim
+    between its codec-table markers.  None when in sync; an error
+    string otherwise (a missing module counts — the codec is born
+    registry-generated)."""
+    from pathlib import Path
+    root = Path(package_root).resolve()
+    path = root / "wirecodec.py"
+    if not path.exists():
+        return ("mxnet_tpu/wirecodec.py does not exist: generate its "
+                "hot-op table with `python -m mxnet_tpu.analysis "
+                "--codec-table`")
+    if codec_table_source(extract_package(root)) not in \
+            path.read_text():
+        return ("mxnet_tpu/wirecodec.py codec table is STALE: "
+                "regenerate with `python -m mxnet_tpu.analysis "
+                "--codec-table` and paste it over the "
+                "codec-table:begin/end block")
+    return None
+
+
 def markdown_table(table: Optional[ProtocolTable] = None) -> str:
     """The protocol table docs/PROTOCOL.md folds in (regenerate with
     ``python -m mxnet_tpu.analysis --protocol-table``)."""
@@ -398,8 +484,8 @@ def markdown_table(table: Optional[ProtocolTable] = None) -> str:
     lines = [
         DOCS_BEGIN + " python -m mxnet_tpu.analysis"
         " --protocol-table) -->",
-        "| op | kind | replay guard | reply | handler |",
-        "|----|------|--------------|-------|---------|",
+        "| op | kind | replay guard | reply | codec | handler |",
+        "|----|------|--------------|-------|-------|---------|",
     ]
     seen = set()
     for op in sorted(table.ops, key=lambda o: (o.kind, o.name, o.line)):
@@ -409,9 +495,10 @@ def markdown_table(table: Optional[ProtocolTable] = None) -> str:
         seen.add(key)
         # no line numbers: the docs copy must only drift when the
         # PROTOCOL changes, not when unrelated edits shift a file
-        lines.append("| `%s` | %s | %s | %s | `%s` (%s) |" % (
+        lines.append("| `%s` | %s | %s | %s | %s | `%s` (%s) |" % (
             op.name, op.kind, op.replay or "**undeclared**",
-            op.reply.replace("|", "\\|"), op.path, op.owner))
+            op.reply.replace("|", "\\|"), op.codec or "pickle",
+            op.path, op.owner))
     phases = sorted({s.name for s in table.spans if s.phase})
     if phases:
         lines.append("")

@@ -5,8 +5,9 @@ string, a ``check_file(ctx, project)`` generator, and optionally a
 ``finalize(project)`` generator for whole-package facts, then list it
 here and give it a fixture pair under tests/analysis_fixtures/.
 """
-from . import (bare_thread, blocking_lock, env_knobs, host_sync,
-               lock_order, protocol_ops, raw_send, unsafe_pickle)
+from . import (bare_thread, blocking_lock, codec_coverage, env_knobs,
+               host_sync, lock_order, protocol_ops, raw_send,
+               unsafe_pickle)
 
 ALL_RULES = (
     host_sync.RULE,
@@ -19,6 +20,7 @@ ALL_RULES = (
     bare_thread.RULE,
     protocol_ops.RULE,
     raw_send.RULE,
+    codec_coverage.RULE,
 )
 
 RULE_NAMES = tuple(r.name for r in ALL_RULES)

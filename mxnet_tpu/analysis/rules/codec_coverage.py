@@ -1,0 +1,120 @@
+"""codec-coverage: the binary wire codec's op table mirrors the registry.
+
+mxnet_tpu/wirecodec.py serializes exactly the ops the protocol
+registry declares ``codec(binary)`` — its ``HOT_OPS`` literal is
+GENERATED (``python -m mxnet_tpu.analysis --codec-table``), never
+hand-maintained.  A drifted copy is a correctness hazard in both
+directions: a declared-hot op missing from the table silently falls
+back to pickle (the perf win evaporates without a test failing), and
+a table entry nobody declares means the codec ships frames no handler
+is contracted to speak.  This rule keeps the generated block
+machine-checked against the extracted registry:
+
+* every op declared ``codec(binary)`` appears in the generated
+  ``HOT_OPS`` set (else the table is stale);
+* every ``HOT_OPS`` entry is backed by a ``codec(binary)``
+  declaration (else the table was hand-edited or the op retired);
+* ``CODEC_TABLE_FINGERPRINT`` matches the declared set — hand-edits
+  that keep the frozenset parseable still drift-fail;
+* declaring ``codec(binary)`` anywhere in scope without a generated
+  table module present is itself a finding (the codec is born
+  registry-generated).
+
+The byte-level twin is ``--check``'s verbatim-source drift gate; this
+rule is the per-op diagnostic that names WHICH op drifted.
+"""
+from __future__ import annotations
+
+import re
+
+from .. import protocol
+from ..lint import Finding
+
+_FP_RE = re.compile(r'^CODEC_TABLE_FINGERPRINT\s*=\s*"([0-9a-f]*)"')
+_NAME_RE = re.compile(r'^\s*"([^"]+)",\s*$')
+
+
+class _CodecCoverageRule:
+    name = "codec-coverage"
+
+    def check_file(self, ctx, project):
+        project.scratch.setdefault("codec-protocol", []).append(
+            protocol.extract_file(ctx))
+        for ln, text in enumerate(ctx.lines, start=1):
+            if not text.startswith(protocol.CODEC_BEGIN):
+                continue
+            names, fp, closed = [], None, False
+            for off, body in enumerate(ctx.lines[ln:], start=ln + 1):
+                if body.startswith(protocol.CODEC_END):
+                    closed = True
+                    break
+                m = _NAME_RE.match(body)
+                if m:
+                    names.append(m.group(1))
+                m = _FP_RE.match(body)
+                if m:
+                    fp = m.group(1)
+            project.scratch.setdefault("codec-modules", []).append(
+                (ctx.relpath, ln, names, fp, closed))
+            break   # one generated block per module
+        return ()
+
+    def finalize(self, project):
+        tables = project.scratch.get("codec-protocol", [])
+        table = protocol.ProtocolTable()
+        for t in tables:
+            table.merge(t)
+        declared = protocol.codec_ops(table)
+        modules = project.scratch.get("codec-modules", [])
+
+        if declared and not modules:
+            sites = {(o.path, o.line): o.name for o in table.ops
+                     if o.codec == "binary"}
+            for (path, line), op in sorted(sites.items()):
+                yield Finding(
+                    rule=self.name, path=path, line=line,
+                    message="op %r is declared codec(binary) but no "
+                    "generated codec table exists in scope — generate "
+                    "one with `python -m mxnet_tpu.analysis "
+                    "--codec-table` (the codec is born "
+                    "registry-generated)" % op)
+            return
+
+        for path, line, names, fp, closed in modules:
+            if not closed:
+                yield Finding(
+                    rule=self.name, path=path, line=line,
+                    message="codec-table:begin has no matching "
+                    "codec-table:end — the generated hot-op block is "
+                    "truncated; regenerate with `python -m "
+                    "mxnet_tpu.analysis --codec-table`")
+                continue
+            have = set(names)
+            for op in declared:
+                if op not in have:
+                    yield Finding(
+                        rule=self.name, path=path, line=line,
+                        message="hot op %r is declared codec(binary) "
+                        "but missing from the generated HOT_OPS table "
+                        "— it silently rides pickle; regenerate with "
+                        "`python -m mxnet_tpu.analysis --codec-table`"
+                        % op)
+            for op in sorted(have - set(declared)):
+                yield Finding(
+                    rule=self.name, path=path, line=line,
+                    message="generated HOT_OPS entry %r has no "
+                    "codec(binary) declaration in the registry — "
+                    "hand-edited or retired; regenerate with "
+                    "`python -m mxnet_tpu.analysis --codec-table`"
+                    % op)
+            want_fp = protocol.codec_fingerprint(declared)
+            if fp != want_fp:
+                yield Finding(
+                    rule=self.name, path=path, line=line,
+                    message="CODEC_TABLE_FINGERPRINT %r does not match "
+                    "the declared codec(binary) op set (want %r) — "
+                    "regenerate with `python -m mxnet_tpu.analysis "
+                    "--codec-table`" % (fp, want_fp))
+
+
+RULE = _CodecCoverageRule()

@@ -249,6 +249,19 @@ declare_env("MXNET_KVSTORE_COALESCE_BYTES", int, 16384,
             "LIST pushes coalesce same-server keys at or below this "
             "many payload bytes into one multi-key envelope",
             tune={"choices": [0, 4096, 16384, 65536, 262144]})
+declare_env("MXNET_KVSTORE_CODEC", str, "auto",
+            "dist kvstore wire codec: 'auto'/'binary' negotiate the "
+            "registry-generated binary frame codec per connection at "
+            "hello time (hot push/pull/predict envelopes serialize "
+            "zero pickled bytes; old peers keep pickle), 'pickle' "
+            "pins the legacy pickle framing — the mixed-version "
+            "escape hatch",
+            tune={"choices": ["auto", "binary", "pickle"]})
+declare_env("MXNET_KVSTORE_SENDMSG", int, 1,
+            "dist kvstore transport: 1 sends each frame with vectored "
+            "socket.sendmsg scatter-gather (one syscall per frame, "
+            "chunked at IOV_MAX); 0 falls back to per-buffer sendall",
+            tune={"choices": [0, 1]})
 declare_env("MXNET_KVSTORE_PICKLE_ALLOWLIST", str, "",
             "extra 'module' or 'module:name' entries (comma-separated) "
             "the wire unpickler admits — the custom-optimizer escape "

@@ -390,6 +390,12 @@ class _ServerConn:
         # INSTANCES — two clients of the same rank (relaunch, tests)
         # must never collide in the server's dedup window.
         self._client_id = (self._rank, uuid.uuid4().hex[:16])
+        # control-plane counter pair for hellos/heartbeats on this
+        # channel family: wire channels use "control*", mesh channels
+        # stay inside the ici_ family ("ici_control*")
+        self._ctrl_kinds = (("control", "control_recv")
+                            if self._byte_kinds[0] == "sent"
+                            else ("ici_control", "ici_control_recv"))
         self._next_seq = 0
         from .base import env as _env
         self._retry_max = int(_env("MXNET_KVSTORE_RETRY_MAX", 8))
@@ -434,7 +440,8 @@ class _ServerConn:
         import socket
         import time
         from . import faultinject
-        from .kvstore_server import _set_nodelay
+        from . import wirecodec as _codec
+        from .kvstore_server import _set_nodelay, _send_msg, _recv_msg
         deadline = time.monotonic() + connect_timeout
         while True:
             try:
@@ -446,6 +453,11 @@ class _ServerConn:
                 # ECONNRESET/EOF when the server process dies
                 sock.settimeout(None)
                 _set_nodelay(sock)
+                # one synchronous codec hello before pipelined traffic:
+                # hot frames go binary when the peer speaks v2, old
+                # peers answer err/None and the socket stays pickle
+                _codec.client_hello(sock, _send_msg, _recv_msg,
+                                    byte_kinds=self._ctrl_kinds)
                 return sock
             except (ConnectionRefusedError, OSError):
                 # the server process is still importing/binding — workers
@@ -702,7 +714,8 @@ class _ServerConn:
         import socket
         from . import faultinject
         from . import profiler as _prof
-        from .kvstore_server import _set_nodelay
+        from . import wirecodec as _codec
+        from .kvstore_server import _set_nodelay, _send_msg, _recv_msg
         try:
             self._sock.close()
         except (OSError, AttributeError):
@@ -732,6 +745,11 @@ class _ServerConn:
                 sock = socket.create_connection(self._addr, timeout=60)
                 sock.settimeout(None)
                 _set_nodelay(sock)
+                # re-negotiate BEFORE the window replay: the fresh
+                # socket starts un-negotiated, and replayed envelopes
+                # must ride whatever codec this round of hello agrees
+                _codec.client_hello(sock, _send_msg, _recv_msg,
+                                    byte_kinds=self._ctrl_kinds)
                 self._sock = sock
                 _prof.record_channel_event("kvstore.reconnect")
                 return
@@ -743,6 +761,7 @@ class _ServerConn:
     def _hb_loop(self):
         import socket
         import time
+        from . import wirecodec as _codec
         from .kvstore_server import _send_msg, _recv_msg
         from . import profiler as _prof
         sock = None
@@ -752,10 +771,15 @@ class _ServerConn:
                     sock = socket.create_connection(
                         self._addr, timeout=self._hb_timeout)
                     sock.settimeout(self._hb_timeout)
+                    # hello the liveness socket too: ping acks are the
+                    # last pickled frames otherwise, and the steady-
+                    # state pin is pickle_bytes == 0 across the job
+                    _codec.client_hello(sock, _send_msg, _recv_msg,
+                                        byte_kinds=self._ctrl_kinds)
                 _send_msg(sock, ("ping", self._rank),
-                          byte_kind=self._byte_kinds[0])
+                          byte_kind=self._ctrl_kinds[0])
                 status, _payload = _recv_msg(
-                    sock, byte_kind=self._byte_kinds[1])
+                    sock, byte_kind=self._ctrl_kinds[1])
                 if status == "ok":
                     self._hb_last_ack = time.monotonic()
                     _prof.record_channel_event("kvstore.heartbeat")
@@ -1231,22 +1255,37 @@ class _MeshLeader:
                 pass
 
     def _serve_conn(self, conn):
+        from . import wirecodec as _codec
         from .kvstore_server import _send_msg, _recv_msg
+        recv_kind = "ici_recv"
         try:
             with conn:
                 while not self._stop.is_set():
                     try:
-                        msg = _recv_msg(conn, byte_kind="ici_recv")
+                        msg = _recv_msg(conn, byte_kind=recv_kind)
                     except (ConnectionError, OSError):
                         return
+                    reply_kind = "ici_sent"
                     if msg and msg[0] == "req":
                         _, cid, seq, inner = msg[:4]
                         reply = self._exactly_once(cid, seq, inner)
                     else:
-                        # raw heartbeat pings from the follower channel
-                        reply = ("ok", None)
+                        # codec hellos + raw heartbeat pings from the
+                        # follower channel (the hello check must come
+                        # FIRST: the blanket ("ok", None) ack is what an
+                        # OLD leader answers, which clients read as
+                        # version 0)
+                        hello = _codec.handle_hello(conn, msg)
+                        reply = hello if hello is not None \
+                            else ("ok", None)
+                        if msg and msg[0] == "ping":
+                            # pings ride the follower's dedicated
+                            # liveness socket; hellos arrive on data
+                            # sockets too and must not latch
+                            recv_kind = "ici_control_recv"
+                            reply_kind = "ici_control"
                     try:
-                        _send_msg(conn, reply, byte_kind="ici_sent")
+                        _send_msg(conn, reply, byte_kind=reply_kind)
                     except (ConnectionError, OSError):
                         return
         except Exception:  # noqa: BLE001 — conn died mid-reply
@@ -1288,14 +1327,14 @@ class _MeshLeader:
     def _handle(self, inner):
         from . import profiler as _prof
         op = inner[0]
-        if op == "mesh_push":  # protocol: replay(dedup-window) reply(none)
+        if op == "mesh_push":  # protocol: replay(dedup-window) reply(none) codec(binary)
             _, seq, pairs = inner
             with self._cv:
                 self._pushes.setdefault(int(seq), []).append(pairs)
                 self._cv.notify_all()
             _prof.record_channel_event("kvstore.mesh_push")
             return None
-        if op == "mesh_collect":  # protocol: replay(dedup-window) reply(key -> ndarray)
+        if op == "mesh_collect":  # protocol: replay(dedup-window) reply(key -> ndarray) codec(binary)
             _, seq, keys = inner
             seq = int(seq)
             with self._cv:

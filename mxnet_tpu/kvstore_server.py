@@ -46,6 +46,7 @@ import numpy as np
 from . import faultinject
 from . import profiler as _prof
 from . import tracing as _tr
+from . import wirecodec as _codec
 from . import health as _health
 from .analysis import hb as _hb
 from .base import env as _env
@@ -259,29 +260,87 @@ def _set_nodelay(sock):
         pass   # non-TCP socket (tests stub with socketpairs)
 
 
+def _iov_max() -> int:
+    try:
+        return min(int(os.sysconf("SC_IOV_MAX")), 1024)
+    except (AttributeError, OSError, ValueError):
+        return 16
+
+
+_IOV_MAX = _iov_max()
+
+
+def _send_vec(sock, parts) -> int:
+    """Write ``parts`` (bytes-likes) in order with as few syscalls as
+    possible: vectored ``sendmsg`` chunked at IOV_MAX with a partial-
+    send resume loop, or per-part ``sendall`` when the platform lacks
+    sendmsg / MXNET_KVSTORE_SENDMSG=0.  Returns the syscall count."""
+    parts = [m for m in (memoryview(p).cast("B") for p in parts)
+             if m.nbytes]   # zero-length iovecs would stall the loop
+    n = 0
+    if not (_env("MXNET_KVSTORE_SENDMSG", 1)
+            and hasattr(sock, "sendmsg")):
+        for p in parts:
+            sock.sendall(p)
+            n += 1
+        return n
+    i = 0
+    while i < len(parts):
+        sent = sock.sendmsg(parts[i:i + _IOV_MAX])
+        n += 1
+        while sent > 0:
+            pn = parts[i].nbytes
+            if sent >= pn:
+                sent -= pn
+                i += 1
+            else:
+                parts[i] = parts[i][sent:]
+                sent = 0
+    return n
+
+
 def _send_msg(sock, obj, fi_role=None, byte_kind="sent"):
-    """Zero-copy framed send (skeleton pickle + raw tensor buffers).
-    ``fi_role`` tags DATA-channel traffic for the deterministic fault-
-    injection hooks ("client" may be severed at an exact message,
-    "server" may delay acks); untagged sends (heartbeats) are exempt so
-    a plan hits only what it targets.  ``byte_kind`` names the byte
-    counter family the frame lands in: the default "sent" is the TCP
-    wire to the parameter servers; the hierarchical kvstore tier's
-    in-host mesh channels count under "ici_sent" so bench.py can report
-    wire vs in-mesh bytes separately (profiler.wire_bytes_total /
-    ici_bytes_total)."""
+    """Zero-copy framed send: the registry-generated binary codec for
+    hot messages on negotiated connections (wirecodec frame v2), the
+    skeleton-pickle frame for everything else — one vectored syscall
+    per frame either way (_send_vec).  ``fi_role`` tags DATA-channel
+    traffic for the deterministic fault-injection hooks ("client" may
+    be severed at an exact message, "server" may delay acks); untagged
+    sends (heartbeats, hellos) are exempt so a plan hits only what it
+    targets.  ``byte_kind`` names the byte counter family the frame
+    lands in: the default "sent" is the TCP data wire to the parameter
+    servers; the hierarchical tier's in-host mesh channels count under
+    "ici_sent", and control-plane traffic (heartbeats, roster beats,
+    hellos) under "control" so bench.py reports gradients, mesh, and
+    control separately (profiler.wire_bytes_total / ici_bytes_total /
+    control_bytes_total)."""
     if fi_role == "client":
         faultinject.client_send(sock)
     elif fi_role == "server":
         faultinject.server_reply_delay()
-    bufs = []
-    skel = pickle.dumps(_pack(obj, bufs),
-                        protocol=pickle.HIGHEST_PROTOCOL)
-    total = 4 + len(skel) + sum(a.nbytes for a in bufs)
-    _prof.record_channel_bytes(byte_kind, 8 + total)
-    sock.sendall(struct.pack(">QI", total, len(skel)) + skel)
-    for arr in bufs:
-        sock.sendall(memoryview(arr).cast("B"))
+    parts = None
+    if _codec.sock_binary(sock) and _codec.is_hot(obj):
+        enc = _codec.encode_frame(obj)
+        if enc is not None:
+            head, bufs = enc
+            _prof.record_serialization("codec_bytes", len(head) - 13)
+            _prof.record_channel_bytes(
+                byte_kind, len(head) + sum(a.nbytes for a in bufs))
+            parts = [head]
+            parts += bufs
+    if parts is None:
+        bufs = []
+        skel = pickle.dumps(_pack(obj, bufs),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        total = 4 + len(skel) + sum(a.nbytes for a in bufs)
+        if not _prof.is_control_byte_kind(byte_kind):
+            _prof.record_serialization("pickle_bytes", len(skel))
+        _prof.record_channel_bytes(byte_kind, 8 + total)
+        # header as its own buffer — NOT `header + skel`, which would
+        # copy the whole skeleton to save one iovec
+        parts = [struct.pack(">QI", total, len(skel)), skel]
+        parts += bufs
+    _prof.record_serialization("send_syscalls", _send_vec(sock, parts))
     if fi_role == "client":
         faultinject.client_sent(sock)
 
@@ -297,9 +356,24 @@ def _recv_exact(sock, n):
 
 
 def _recv_msg(sock, fi_role=None, byte_kind="recv"):
+    """Receive one frame of either format — a v2 binary frame's first
+    byte is the 0xB1 magic, a legacy pickle frame's is the always-zero
+    high byte of its ``>Q`` total, so the receiver self-discriminates
+    and accepts both regardless of negotiation (which only gates what
+    a sender emits)."""
     if fi_role == "client":
         faultinject.client_recv(sock)
-    total, skel_len = struct.unpack(">QI", _recv_exact(sock, 12))
+    hdr = _recv_exact(sock, 12)
+    if hdr[0] == _codec.FRAME_MAGIC:
+        hdr += _recv_exact(sock, 1)
+        total, desc_len = struct.unpack(">QI", hdr[1:13])
+        if desc_len + 4 > total:
+            raise ValueError("wirecodec: descriptor overruns frame")
+        desc = _recv_exact(sock, desc_len)
+        body = _recv_exact(sock, total - 4 - desc_len)
+        _prof.record_channel_bytes(byte_kind, 9 + total)
+        return _codec.decode_frame(desc, body)
+    total, skel_len = struct.unpack(">QI", hdr)
     skel = _restricted_loads(_recv_exact(sock, skel_len))
     body = _recv_exact(sock, total - 4 - skel_len)
     _prof.record_channel_bytes(byte_kind, 8 + total)
@@ -474,7 +548,8 @@ class KVStoreServer:
                   "command", "barrier", "req", "stats", "roster_get",
                   "roster_join", "roster_leave", "roster_dead",
                   "roster_beat", "roster_snapshot", "handoff",
-                  "handoff_state", "ledger_report", "roster_fwd"):
+                  "handoff_state", "ledger_report", "roster_fwd",
+                  "codec_hello"):
             raise ValueError(f"cannot override core kvstore op {op!r}")
         self._ext_ops[op] = fn
 
@@ -522,11 +597,11 @@ class KVStoreServer:
                 if key not in self._store:
                     self._store[key] = NDArray(jnp.asarray(arr))
             return None
-        if op == "push":  # protocol: replay(dedup-window) reply(none)
+        if op == "push":  # protocol: replay(dedup-window) reply(none) codec(binary)
             _, key, arr = msg
             self._apply_push(key, arr)
             return None
-        if op == "push_multi":  # protocol: replay(dedup-window) reply(none)
+        if op == "push_multi":  # protocol: replay(dedup-window) reply(none) codec(binary)
             # coalesced small-key push: one envelope, applied in order
             # (the worker groups sub-threshold keys bound for this shard
             # into a single frame — one RTT instead of K)
@@ -552,7 +627,7 @@ class KVStoreServer:
                 else:
                     stored._set_data(jnp.asarray(arr))
             return None
-        if op == "pull":  # protocol: replay(pure) reply(ndarray)
+        if op == "pull":  # protocol: replay(pure) reply(ndarray) codec(binary)
             _, key = msg
             with self._lock:
                 stored = self._store.get(key)
@@ -1697,8 +1772,10 @@ class KVStoreServer:
                             socks[uri] = sock
                         _send_msg(sock, ("roster_beat", self.uri,
                                          self._beat_seq, snap,
-                                         beat_stats))
-                        status, payload = _recv_msg(sock)
+                                         beat_stats),
+                                  byte_kind="control")
+                        status, payload = _recv_msg(
+                            sock, byte_kind="control_recv")
                         if status == "ok":
                             if snap is not None:
                                 sent_snap = True
@@ -1782,10 +1859,12 @@ class KVStoreServer:
                     self._beat_seq += 1
                     _send_msg(sock, ("roster_beat", self.uri,
                                      self._beat_seq,
-                                     self._snapshot_struct()))
-                    _recv_msg(sock)
-                    _send_msg(sock, ("roster_leave", "server", self.uri))
-                    _recv_msg(sock)
+                                     self._snapshot_struct()),
+                              byte_kind="control")
+                    _recv_msg(sock, byte_kind="control_recv")
+                    _send_msg(sock, ("roster_leave", "server", self.uri),
+                              byte_kind="control")
+                    _recv_msg(sock, byte_kind="control_recv")
                 finally:
                     sock.close()
             except Exception:  # noqa: BLE001 — departing anyway; the
@@ -1795,13 +1874,15 @@ class KVStoreServer:
 
     # -- connection plumbing -------------------------------------------------
     def _serve_conn(self, conn):
+        recv_kind = "recv"
         try:
             with conn:
                 while not self._stop.is_set():
                     try:
-                        msg = _recv_msg(conn)
+                        msg = _recv_msg(conn, byte_kind=recv_kind)
                     except (ConnectionError, OSError):
                         return
+                    reply_kind = "sent"
                     if msg and msg[0] == "req":
                         # client envelope: (op, client_id, seq, inner
                         # [, trace]) — the exactly-once path (reconnect
@@ -1813,18 +1894,35 @@ class KVStoreServer:
                             msg[4] if len(msg) > 4 else None)
                         role = "server"
                     else:
-                        # raw message (heartbeat pings, legacy callers):
-                        # NOT fault-injection targetable — a delay-acks
-                        # plan must never stall the liveness signal
-                        # (faultinject.py's heartbeat-exemption contract)
-                        try:
-                            reply = ("ok", self._handle(msg))
-                        except Exception as exc:  # noqa: BLE001
-                            reply = ("err",
-                                     f"{type(exc).__name__}: {exc}")
+                        # raw message (codec hellos, heartbeat pings,
+                        # legacy callers): NOT fault-injection
+                        # targetable — a delay-acks plan must never
+                        # stall the liveness signal (faultinject.py's
+                        # heartbeat-exemption contract)
+                        hello = _codec.handle_hello(conn, msg)
+                        if hello is not None:
+                            reply = hello
+                        else:
+                            try:
+                                reply = ("ok", self._handle(msg))
+                            except Exception as exc:  # noqa: BLE001
+                                reply = ("err",
+                                         f"{type(exc).__name__}: {exc}")
                         role = None
+                        if msg and msg[0] in ("ping", "roster_beat",
+                                              "roster_leave"):
+                            # these ops live on DEDICATED control
+                            # sockets (heartbeat threads, beat loops) —
+                            # latch this connection's byte family to
+                            # "control" so wire_bytes_per_step measures
+                            # gradients only.  codec_hello must NOT
+                            # latch: every socket (incl. data) hellos
+                            # once at connect
+                            recv_kind = "control_recv"
+                            reply_kind = "control"
                     try:
-                        _send_msg(conn, reply, fi_role=role)
+                        _send_msg(conn, reply, fi_role=role,
+                                  byte_kind=reply_kind)
                     except (ConnectionError, OSError):
                         # the client died / reconnected while we worked:
                         # the reply stays in the dedup window, so the

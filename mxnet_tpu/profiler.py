@@ -328,6 +328,20 @@ def channel_bytes() -> dict:
 # banked, regression-gateable number (docs/PERF_NOTES.md round 11).
 ICI_BYTE_PREFIX = "ici_"
 
+# Control-plane traffic (heartbeats, roster beats/leaves, codec hellos)
+# counts under "control"/"control_recv" kinds — a third family next to
+# the data wire and the mesh, so wire_bytes_per_step measures GRADIENTS
+# only: a heartbeat cadence change must never move a banked wire-byte
+# number.  Mesh-side control rides "ici_control*" and stays inside the
+# ici_ family (the mesh totals already exclude the wire).
+CONTROL_BYTE_PREFIX = "control"
+
+
+def is_control_byte_kind(kind: str) -> bool:
+    """True for control-plane byte kinds on either transport."""
+    return (kind.startswith(CONTROL_BYTE_PREFIX)
+            or kind.startswith(ICI_BYTE_PREFIX + CONTROL_BYTE_PREFIX))
+
 
 def ici_bytes_total() -> int:
     """Total in-mesh (hierarchy-tier) bytes moved so far."""
@@ -337,15 +351,75 @@ def ici_bytes_total() -> int:
 
 
 def wire_bytes_total() -> int:
-    """Total non-mesh transport bytes (TCP wire + host collectives)."""
+    """Total non-mesh DATA bytes (TCP wire + host collectives);
+    control-plane traffic is excluded so the banked per-step number
+    measures gradients, not heartbeat cadence."""
     with _channel_lock:
         return sum(v for k, v in _channel_bytes.items()
-                   if not k.startswith(ICI_BYTE_PREFIX))
+                   if not k.startswith(ICI_BYTE_PREFIX)
+                   and not k.startswith(CONTROL_BYTE_PREFIX))
+
+
+def control_bytes_total() -> int:
+    """Total wire-side control-plane bytes (heartbeats, roster beats,
+    codec hellos); mesh-side control counts into ici_bytes_total."""
+    with _channel_lock:
+        return sum(v for k, v in _channel_bytes.items()
+                   if k.startswith(CONTROL_BYTE_PREFIX))
 
 
 def reset_channel_bytes():
     with _channel_lock:
         _channel_bytes.clear()
+
+
+# -- kvstore serialization counters -------------------------------------------
+# What the frame layer COSTS, separate from what it MOVES: codec_bytes
+# (descriptor bytes emitted by the generated binary codec), pickle_bytes
+# (skeleton bytes emitted by the legacy pickle path), send_syscalls
+# (socket writes per frame — 1 with vectored sendmsg, 2+N without).
+# Deliberately its own dict, not more _channel_bytes kinds: the
+# fault-injection tests assert channel counters by exact equality, and
+# the hot-path acceptance pin is pickle_bytes == 0 over a measured
+# window — bench.py banks both per-step (docs/PERF_NOTES.md round 12).
+_serialization: dict = {}
+_serialization_lock = threading.Lock()
+
+
+def record_serialization(kind: str, n: int):
+    """Add ``n`` to the serialization counter ``kind`` (always on — a
+    dict increment is noise next to the encode it measures)."""
+    with _serialization_lock:
+        _serialization[kind] = _serialization.get(kind, 0) + int(n)
+
+
+def serialization_counts() -> dict:
+    with _serialization_lock:
+        return dict(_serialization)
+
+
+def codec_bytes_total() -> int:
+    """Descriptor bytes emitted by the binary wire codec so far."""
+    with _serialization_lock:
+        return _serialization.get("codec_bytes", 0)
+
+
+def pickle_bytes_total() -> int:
+    """Skeleton bytes pickled by the legacy frame path so far — the
+    steady-state acceptance pin is 0 with the codec negotiated on."""
+    with _serialization_lock:
+        return _serialization.get("pickle_bytes", 0)
+
+
+def send_syscalls_total() -> int:
+    """Socket write syscalls issued by the frame layer so far."""
+    with _serialization_lock:
+        return _serialization.get("send_syscalls", 0)
+
+
+def reset_serialization():
+    with _serialization_lock:
+        _serialization.clear()
 
 
 # -- kvstore wire-overlap counters -------------------------------------------

@@ -43,6 +43,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..base import MXNetError, env
+from .. import wirecodec as _codec
 from ..kvstore_server import KVStoreServer, _send_msg, _recv_msg
 from .. import profiler as _prof
 from .. import tracing as _tr
@@ -77,7 +78,7 @@ class ServingReplica(KVStoreServer):
         # must not hold a conn thread inside _exactly_once while the
         # batch forms (that would serialize the batcher per connection)
         self._deferred_ops = {"predict"}
-        # protocol: replay(pure) reply(predictions)
+        # protocol: replay(pure) reply(predictions) codec(binary)
         self.register_op("predict", self._op_predict_sync)
         # protocol: replay(pure) reply(serving stats dict)
         self.register_op("serving_stats", self._op_stats)
@@ -316,17 +317,18 @@ class ServingReplica(KVStoreServer):
                         msg = _recv_msg(conn)
                     except (ConnectionError, OSError):
                         return
-                    slots.put(self._admit(msg))
+                    slots.put(self._admit(msg, conn))
         except Exception:  # noqa: BLE001 — hostile frame / conn death
             pass
         finally:
             slots.put(None)
             writer.join(timeout=30.0)
 
-    def _admit(self, msg):
+    def _admit(self, msg, conn):
         """Turn one decoded message into a reply slot: deferred serving
-        ops park in the batcher; everything else completes inline
-        through the base server's exactly-once machinery."""
+        ops park in the batcher, codec hellos register the connection,
+        everything else completes inline through the base server's
+        exactly-once machinery."""
         if msg and msg[0] == "req":
             _, cid, seq, inner = msg[:4]
             wctx = msg[4] if len(msg) > 4 else None
@@ -353,10 +355,15 @@ class ServingReplica(KVStoreServer):
             cidt = tuple(cid) if isinstance(cid, list) else cid
             reply = self._traced_exactly_once(cidt, seq, inner, wctx)
             return _CompletedSlot(reply, "server")
+        hello = _codec.handle_hello(conn, msg)
+        if hello is not None:
+            return _CompletedSlot(hello, None, byte_kind="control")
         try:
             reply = ("ok", self._handle(msg))
         except Exception as exc:  # noqa: BLE001 — to the client
             reply = ("err", f"{type(exc).__name__}: {exc}")
+        if msg and msg[0] == "ping":
+            return _CompletedSlot(reply, None, byte_kind="control")
         return _CompletedSlot(reply, None)
 
     def _reply_writer(self, conn, slots):
@@ -371,7 +378,9 @@ class ServingReplica(KVStoreServer):
                 _tr.span_end(getattr(slot, "span", None))
                 try:
                     _send_msg(conn, slot.reply,
-                              fi_role=getattr(slot, "role", None))
+                              fi_role=getattr(slot, "role", None),
+                              byte_kind=getattr(slot, "byte_kind",
+                                                "sent"))
                 except (ConnectionError, OSError):
                     # client gone mid-reply: predict is pure, so the
                     # reconnect replay simply re-runs it — drain the
@@ -395,11 +404,12 @@ class _CompletedSlot:
     """Adapter giving an already-computed reply the _ReplySlot shape the
     writer consumes."""
 
-    __slots__ = ("done", "reply", "role")
+    __slots__ = ("done", "reply", "role", "byte_kind")
     _DONE = threading.Event()
     _DONE.set()
 
-    def __init__(self, reply, role):
+    def __init__(self, reply, role, byte_kind="sent"):
         self.done = self._DONE
         self.reply = reply
         self.role = role
+        self.byte_kind = byte_kind

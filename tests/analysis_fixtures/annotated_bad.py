@@ -68,3 +68,12 @@ class AnnotatedServer:
         if op == "legacy_undeclared":
             return None
         return None
+
+
+# analysis: allow(codec-coverage): fixture — pretend the table regenerates in the release pipeline
+# codec-table:begin (generated: python -m mxnet_tpu.analysis --codec-table)
+HOT_OPS = frozenset({
+    "phantom_op",
+})
+CODEC_TABLE_FINGERPRINT = "000000000000"
+# codec-table:end

@@ -1,0 +1,23 @@
+"""Positive fixture: codec-coverage violations — a declared-hot op
+missing from the generated table, a table entry nobody declares, and a
+fingerprint that matches neither (hand-edited block)."""
+
+
+class S:
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "push":  # protocol: replay(dedup-window) reply(none) codec(binary)
+            return 1
+        if op == "pull":  # protocol: replay(pure) reply(ndarray) codec(binary)
+            return 2
+        if op == "stats":  # protocol: replay(pure) reply(counts)
+            return 3
+
+
+# codec-table:begin (generated: python -m mxnet_tpu.analysis --codec-table)
+HOT_OPS = frozenset({
+    "push",
+    "phantom_op",
+})
+CODEC_TABLE_FINGERPRINT = "deadbeef0000"
+# codec-table:end

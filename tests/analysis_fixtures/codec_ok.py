@@ -1,0 +1,22 @@
+"""Negative fixture: a generated codec table exactly mirroring the
+file's codec(binary) declarations, fingerprint and all."""
+
+
+class S:
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "push":  # protocol: replay(dedup-window) reply(none) codec(binary)
+            return 1
+        if op == "pull":  # protocol: replay(pure) reply(ndarray) codec(binary)
+            return 2
+        if op == "stats":  # protocol: replay(pure) reply(counts)
+            return 3
+
+
+# codec-table:begin (generated: python -m mxnet_tpu.analysis --codec-table)
+HOT_OPS = frozenset({
+    "pull",
+    "push",
+})
+CODEC_TABLE_FINGERPRINT = "742785a77d03"
+# codec-table:end

@@ -1,0 +1,78 @@
+"""Mixed-version wire-codec interop smoke (ISSUE 16).
+
+Run via:  python tools/launch.py -n 2 -s 1 \
+              python tests/dist/dist_codec_interop.py
+
+Old and new peers must interoperate: the SERVER process pins
+MXNET_KVSTORE_CODEC=pickle (the mixed-version escape hatch — it never
+emits binary frames and answers codec hellos with version 0, exactly
+what a pre-codec build looks like on the wire) while the workers force
+=binary.  Negotiation must settle every connection on pickle framing:
+the workers' hellos come back version 0, zero binary frames are
+EMITTED anywhere, and the exact SGD total survives — a worker that
+emitted a v2 frame at a pickle-pinned server would break the
+arithmetic (or hang the server's receive loop).  The in-process twins
+live in tests/test_wirecodec.py; this proves the negotiation across
+real process and socket boundaries under the real launcher.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# role-dependent codec pin — BEFORE importing mxnet_tpu (the server
+# role enters its blocking serve loop at import)
+if os.environ.get("DMLC_ROLE") == "server":
+    os.environ["MXNET_KVSTORE_CODEC"] = "pickle"
+else:
+    os.environ["MXNET_KVSTORE_CODEC"] = "binary"
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    shape = (3, 4)
+
+    kv.init("w", mx.nd.zeros(shape))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0,
+                                      momentum=0.0))
+    kv.barrier()
+
+    profiler.reset_serialization()
+    pushes = 5
+    for _ in range(pushes):
+        kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    kv.barrier()   # flush + rendezvous
+
+    pulled = mx.nd.zeros(shape)
+    kv.pull("w", out=pulled)
+    total = float(pushes * sum(r + 1 for r in range(nworker)))
+    np.testing.assert_allclose(
+        pulled.asnumpy(), np.full(shape, -0.1 * total, np.float32),
+        rtol=1e-5, err_msg="mixed-version run lost or corrupted a push")
+
+    # the hello round settled on version 0: this binary-forced worker
+    # emitted ONLY pickle frames at the pinned server
+    counts = profiler.serialization_counts()
+    assert counts.get("codec_bytes", 0) == 0, counts
+    assert counts.get("pickle_bytes", 0) > 0, counts
+
+    kv.barrier()
+    kv.close()
+    print("dist_codec_interop rank %d/%d OK (binary worker x "
+          "pickle-pinned server stayed pickle, arithmetic exact)"
+          % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

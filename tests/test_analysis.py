@@ -49,6 +49,7 @@ def rules_of(findings):
     ("protocol_ops_bad.py", "protocol-op", 5),
     ("raw_send_bad.py", "raw-send", 4),
     ("blocking_lock_bad.py", "blocking-under-lock", 3),
+    ("codec_bad.py", "codec-coverage", 3),
 ])
 def test_positive_fixture_is_flagged(fixture, rule, min_hits):
     findings = run_lint([FIXTURES / fixture])
@@ -68,6 +69,7 @@ def test_positive_fixture_is_flagged(fixture, rule, min_hits):
     "protocol_ops_ok.py",
     "raw_send_ok.py",
     "blocking_lock_ok.py",
+    "codec_ok.py",
 ])
 def test_negative_fixture_is_clean(fixture):
     findings = run_lint([FIXTURES / fixture])
@@ -78,7 +80,7 @@ def test_every_rule_family_has_fixture_coverage():
     """The parametrizations above must span the full rule catalog."""
     covered = {"host-sync", "unsafe-pickle", "lock-order", "env-knob",
                "bare-thread", "protocol-op", "raw-send",
-               "blocking-under-lock"}
+               "blocking-under-lock", "codec-coverage"}
     assert covered == set(RULE_NAMES)
 
 

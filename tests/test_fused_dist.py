@@ -451,3 +451,29 @@ def test_drive_chunked_dist_overlap_accounting():
     assert overlap1 > overlap0
     assert overlap1 > 25.0   # ~half of each round hides behind compute
     assert overlap0 < 25.0   # barrier'd boundaries expose the wire
+
+
+def test_fused_epoch_serializes_zero_pickled_bytes(monkeypatch):
+    """ISSUE 16 acceptance pin: with the binary codec negotiated
+    (MXNET_KVSTORE_CODEC=binary forced), a fused dist_async run_steps
+    epoch records pickle_bytes == 0 — every push/pull envelope and ack
+    in the steady-state window rides the generated binary frame."""
+    monkeypatch.setenv("MXNET_KVSTORE_CODEC", "binary")
+    data, label, w0 = _int_data(seed=3)
+    srvs = _serve(monkeypatch)
+    try:
+        monkeypatch.setenv("MXNET_KVSTORE_FUSED", "1")
+        monkeypatch.setenv("MXNET_KVSTORE_FUSED_STALENESS", "0")
+        monkeypatch.setenv("MXNET_KVSTORE_FUSED_CHUNK", "2")
+        mod = _make_module(w0)
+        # warm-up epoch: init/optimizer shipping is cold-path pickle
+        mod.run_steps(data, label, k=data.shape[0])
+        prof.reset_serialization()
+        mod.run_steps(data, label, k=data.shape[0])
+        counts = prof.serialization_counts()
+        assert counts.get("pickle_bytes", 0) == 0, counts
+        assert counts.get("codec_bytes", 0) > 0, counts
+        mod._kvstore.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()

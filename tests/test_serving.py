@@ -664,3 +664,34 @@ def test_busy_storm_flips_replica_degraded_and_back(monkeypatch):
             m.delenv("MXNET_HEALTH_BUSY_WINDOW_S", raising=False)
             m.delenv("MXNET_HEALTH_RECOVERY_S", raising=False)
             health.reconfigure()
+
+
+# -- binary wire codec on the serving plane -----------------------------------
+def test_predict_storm_serializes_zero_pickled_bytes(monkeypatch):
+    """ISSUE 16 acceptance pin: a predict storm over a negotiated
+    connection records pickle_bytes == 0 — the predict envelope and its
+    ack both ride the generated binary frame (codec(binary) in the
+    protocol table)."""
+    monkeypatch.setenv("MXNET_KVSTORE_CODEC", "binary")
+    params = _params()
+    rep = ServingReplica(_softmax_symbol(), {'data': (FEAT,)}, params,
+                         buckets=[1, 2, 4], max_wait_s=0.01)
+    rep.start_background()
+    cli = ServingClient(f"127.0.0.1:{rep.port}", window=16)
+    try:
+        rs = np.random.RandomState(7)
+        x = rs.randn(4, FEAT).astype(np.float32)
+        ref = _ref_softmax(x, params)
+        cli.predict(x[:1])               # warm-up: compiles + hello done
+        profiler.reset_serialization()
+        futs = [cli.predict_async(x[i % 4:i % 4 + 1]) for i in range(32)]
+        for i, fut in enumerate(futs):
+            np.testing.assert_allclose(fut.get()[0],
+                                       ref[i % 4:i % 4 + 1],
+                                       rtol=1e-5, atol=1e-6)
+        counts = profiler.serialization_counts()
+        assert counts.get("pickle_bytes", 0) == 0, counts
+        assert counts.get("codec_bytes", 0) > 0, counts
+    finally:
+        cli.close()
+        rep.stop()

@@ -197,7 +197,12 @@ def _captured_sends(monkeypatch):
 def test_trace_off_adds_zero_envelope_bytes(monkeypatch):
     """MXNET_TRACE=0: every request envelope is the classic 4-tuple and
     the measured sent bytes equal the independently-computed frame
-    sizes EXACTLY — the feature is provably free when off."""
+    sizes EXACTLY — the feature is provably free when off.
+
+    Pinned to the pickle codec: _frame_nbytes recomputes the LEGACY
+    frame arithmetic, and hot envelopes otherwise negotiate the binary
+    frame (tests/test_wirecodec.py owns that layout's arithmetic)."""
+    monkeypatch.setenv("MXNET_KVSTORE_CODEC", "pickle")
     srv = _serve(monkeypatch)[0]
     every, reqs = _captured_sends(monkeypatch)
     try:

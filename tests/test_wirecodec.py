@@ -1,0 +1,432 @@
+"""The registry-generated binary wire codec (mxnet_tpu.wirecodec).
+
+Framing: the `>QI` header arithmetic of the legacy pickle frame is
+pinned (satellite of ISSUE 16 — the header rides as its OWN buffer,
+never a header+skeleton concat), and the v2 binary frame is the same
+arithmetic behind a 0xB1 magic byte.  Codec: property/fuzz round-trips
+over randomized shapes/dtypes/key lists assert bit-identity with the
+pickle path; hostile truncated/oversized binary frames are rejected
+with the connection dropped (the hostile-pickle contract).
+Negotiation: hello returns the peer version, MXNET_KVSTORE_CODEC=
+pickle pins version 0 end-to-end, and an old-peer ("ok", None) ack
+reads as version 0.  Byte accounting: heartbeat/control traffic lands
+in the "control" family so wire_bytes_per_step measures gradients
+only, and steady-state dist traffic records pickle_bytes == 0.
+"""
+import pickle
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler as prof
+from mxnet_tpu import wirecodec as wc
+from mxnet_tpu.compression import WirePayload
+from mxnet_tpu.kvstore_server import (_pack, _recv_msg, _restricted_loads,
+                                      _send_msg, _send_vec, _unpack)
+
+SHAPE = (4, 4)
+
+
+def _serve_one(monkeypatch, **kw):
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srv = KVStoreServer(server_id=0, num_workers=1, **kw)
+    srv.start_background()
+    monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    return srv
+
+
+class _RecordingSock:
+    """sendall-only socket double: records each buffer separately, so a
+    header+skeleton concat would show up as ONE part."""
+
+    def __init__(self):
+        self.parts = []
+
+    def sendall(self, data):
+        self.parts.append(bytes(data))
+
+
+class _RecordingVecSock(_RecordingSock):
+    """sendmsg-capable double: accepts every buffer in one call."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+
+    def sendmsg(self, buffers):
+        self.calls += 1
+        chunk = [bytes(b) for b in buffers]
+        self.parts.extend(chunk)
+        return sum(len(b) for b in chunk)
+
+
+# ---------------------------------------------------------------------------
+# framing arithmetic (satellite: no header+skeleton concat; >QI pinned)
+# ---------------------------------------------------------------------------
+def test_pickle_frame_header_arithmetic_is_unchanged():
+    """The legacy frame is EXACTLY `>QI`(total, skel_len) + skeleton +
+    buffers with total = 4 + len(skel) + sum(nbytes) — and the header
+    is its own 12-byte buffer (no skeleton copy per send)."""
+    sock = _RecordingSock()
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    _send_msg(sock, ("push", "w", arr))
+    assert len(sock.parts[0]) == 12, "header must be its own buffer"
+    total, skel_len = struct.unpack(">QI", sock.parts[0])
+    skel = sock.parts[1]
+    assert len(skel) == skel_len
+    assert total == 4 + skel_len + arr.nbytes
+    assert sock.parts[2] == arr.tobytes()
+    # and the skeleton alone decodes through the allowlisted loader
+    op, key, buf = _restricted_loads(skel)
+    assert (op, key) == ("push", "w")
+
+
+def test_binary_frame_same_arithmetic_behind_magic():
+    sock = _RecordingVecSock()
+    wc.register(sock, 1)
+    arr = np.ones((2, 5), dtype=np.float16)
+    msg = ("ok", arr)
+    _send_msg(sock, msg)
+    head = sock.parts[0]
+    assert head[0] == wc.FRAME_MAGIC
+    total, desc_len = struct.unpack(">QI", head[1:13])
+    assert len(head) == 13 + desc_len
+    assert total == 4 + desc_len + arr.nbytes
+    assert sock.parts[1] == arr.tobytes()
+    out = wc.decode_frame(head[13:], sock.parts[1])
+    np.testing.assert_array_equal(out[1], arr)
+
+
+def test_send_vec_chunks_at_iov_max_and_resumes_partials(monkeypatch):
+    import mxnet_tpu.kvstore_server as srv_mod
+
+    class _Stingy:
+        """Accepts at most 3 bytes per sendmsg call."""
+
+        def __init__(self):
+            self.out = b""
+            self.calls = 0
+
+        def sendmsg(self, buffers):
+            self.calls += 1
+            take = b"".join(bytes(b) for b in buffers)[:3]
+            self.out += take
+            return len(take)
+
+    monkeypatch.setattr(srv_mod, "_IOV_MAX", 2)
+    s = _Stingy()
+    n = _send_vec(s, [b"abcd", b"", b"ef", b"ghij"])
+    assert s.out == b"abcdefghij"
+    assert n == s.calls >= 4
+    # sendall fallback path counts one syscall per (non-empty) part
+    plain = _RecordingSock()
+    assert _send_vec(plain, [b"ab", b"", b"cd"]) == 2
+    assert plain.parts == [b"ab", b"cd"]
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip: bit-identity with the pickle path
+# ---------------------------------------------------------------------------
+def _via_pickle(obj):
+    bufs = []
+    skel = pickle.loads(pickle.dumps(_pack(obj, bufs)))
+    body = b"".join(np.ascontiguousarray(a).tobytes() for a in bufs)
+    offsets, off = {}, 0
+    for i, a in enumerate(bufs):
+        offsets[i] = off
+        off += a.nbytes
+    return _unpack(skel, body, offsets)
+
+
+def _via_codec(obj):
+    enc = wc.encode_frame(obj)
+    assert enc is not None, obj
+    head, bufs = enc
+    body = b"".join(np.ascontiguousarray(a).tobytes() for a in bufs)
+    return wc.decode_frame(bytes(head[13:]), body)
+
+
+def _assert_identical(a, b):
+    assert type(a) is type(b) or (
+        isinstance(a, np.ndarray) and isinstance(b, np.ndarray)), (a, b)
+    if isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes(), "bit-identity violated"
+    elif isinstance(a, (tuple, list)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_identical(x, y)
+    elif isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            _assert_identical(a[k], b[k])
+    elif isinstance(a, WirePayload):
+        _assert_identical(a.data, b.data)
+        assert a.kind == b.kind and a.threshold == b.threshold
+        assert tuple(a.shape or ()) == tuple(b.shape or ())
+    else:
+        assert a == b
+
+
+def test_codec_round_trip_fuzz_matches_pickle_path():
+    """Randomized envelopes over every hot-op shape: dtypes incl. fp16,
+    0-d arrays, empty key lists, max-length keys — the binary decode
+    must be BIT-identical to the pickle-path decode."""
+    rng = np.random.default_rng(0xC0DEC)
+    dtypes = [np.float32, np.float64, np.float16, np.int32, np.int64,
+              np.uint8, np.bool_]
+    shapes = [(), (0,), (1,), (7,), (3, 4), (2, 3, 4), (1, 1, 1, 1)]
+
+    def rand_arr():
+        dt = dtypes[rng.integers(len(dtypes))]
+        shape = shapes[rng.integers(len(shapes))]
+        # np.asarray: 0-d arithmetic collapses to numpy SCALARS, which
+        # ride the pickle fallback — here we want true 0-d ndarrays
+        return np.asarray(rng.random(shape) * 100, dtype=dt)
+
+    max_key = "k" * 255
+    for trial in range(60):
+        kind = trial % 6
+        if kind == 0:
+            inner = ("push", max_key, rand_arr())
+        elif kind == 1:
+            inner = ("push_multi",
+                     [(f"w{i}", rand_arr())
+                      for i in range(int(rng.integers(0, 5)))])
+        elif kind == 2:
+            inner = ("pull", int(rng.integers(0, 1000)))
+        elif kind == 3:
+            inner = ("mesh_collect", [f"k{i}" for i in
+                                      range(int(rng.integers(0, 4)))])
+        elif kind == 4:
+            inner = ("predict", {"data": rand_arr(),
+                                 "mask": rand_arr()})
+        else:
+            inner = ("push", "w",
+                     WirePayload("2bit", (4, 4), 0.5,
+                                 [rand_arr(), float(rng.random())]))
+        msg = ("req", (int(rng.integers(0, 8)), "nonce%d" % trial),
+               trial, inner)
+        assert wc.is_hot(msg)
+        _assert_identical(_via_codec(msg), _via_pickle(msg))
+        reply = ("ok", inner[-1] if kind != 2 else rand_arr())
+        _assert_identical(_via_codec(reply), _via_pickle(reply))
+
+
+def test_codec_falls_back_to_pickle_outside_vocabulary():
+    class Custom:
+        pass
+
+    assert wc.encode_frame(("ok", Custom())) is None
+    assert wc.encode_frame(("ok", 1 << 70)) is None
+    obj_arr = np.array([object()], dtype=object)
+    assert wc.encode_frame(("ok", obj_arr)) is None
+    # an unencodable message on a NEGOTIATED socket falls back to the
+    # pickle frame (sets are pickleable but outside the codec vocab)
+    sock = _RecordingSock()
+    wc.register(sock, 1)
+    _send_msg(sock, ("ok", {1, 2}))
+    assert sock.parts[0][0] != wc.FRAME_MAGIC
+
+
+def test_hot_gating_matches_generated_table():
+    for op in sorted(wc.HOT_OPS):
+        assert wc.is_hot(("req", (0, "n"), 1, (op, "x")))
+    for op in ("stats", "roster_beat", "handoff", "barrier"):
+        assert not wc.is_hot(("req", (0, "n"), 1, (op,)))
+    assert wc.is_hot(("ok", None)) and wc.is_hot(("err", "boom"))
+    assert not wc.is_hot(("ping", 0))
+    # the generated block fingerprint pins the registry's op set
+    from mxnet_tpu.analysis import protocol
+    assert sorted(wc.HOT_OPS) == protocol.codec_ops()
+    assert wc.CODEC_TABLE_FINGERPRINT == \
+        protocol.codec_fingerprint(wc.HOT_OPS)
+
+
+# ---------------------------------------------------------------------------
+# hostile binary frames
+# ---------------------------------------------------------------------------
+def _frame_of(obj):
+    head, bufs = wc.encode_frame(obj)
+    body = b"".join(memoryview(np.ascontiguousarray(a)).cast("B")
+                    for a in bufs)
+    return bytes(head[13:]), body
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda d, b: (d[:-1], b),                       # truncated descriptor
+    lambda d, b: (d + b"\x00", b),                  # trailing descriptor
+    lambda d, b: (d, b + b"\x00"),                  # trailing body bytes
+    lambda d, b: (d, b[:-1]),                       # truncated buffers
+    lambda d, b: (b"\x07\xff\xff\xff\xff" + d, b),  # 4B-item tuple claim
+    lambda d, b: (b"\xfe" + d, b),                  # unknown tag
+])
+def test_decode_rejects_malformed_frames(mutate):
+    desc, body = _frame_of(("ok", np.arange(6, dtype=np.float64)))
+    bad_desc, bad_body = mutate(desc, body)
+    with pytest.raises(ValueError):
+        wc.decode_frame(bad_desc, bad_body)
+
+
+def test_decode_rejects_hostile_dtypes_and_overruns():
+    # object dtype must never reconstruct
+    desc = bytes([0x0A, 3]) + b"|O8" + bytes([1]) + struct.pack(">q", 1)
+    with pytest.raises(ValueError):
+        wc.decode_frame(desc, b"\x00" * 8)
+    # tensor claiming more bytes than the body carries
+    desc = bytes([0x0A, 3]) + b"<f8" + bytes([1]) + struct.pack(">q", 10)
+    with pytest.raises(ValueError):
+        wc.decode_frame(desc, b"\x00" * 8)
+    # negative dimension
+    desc = bytes([0x0A, 3]) + b"<f8" + bytes([1]) + struct.pack(">q", -1)
+    with pytest.raises(ValueError):
+        wc.decode_frame(desc, b"")
+
+
+def test_wire_rejects_hostile_binary_frame(monkeypatch):
+    """A malformed v2 frame is refused exactly like a hostile pickle:
+    connection dropped, no side effect, server keeps serving — and no
+    negotiation is needed to reach the binary decoder (the frame's
+    magic byte self-selects it)."""
+    import socket as _socket
+    srv = _serve_one(monkeypatch)
+    try:
+        s = _socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        desc = b"\x07\xff\xff\xff\xff"   # tuple claiming 2**32-1 items
+        total = 4 + len(desc)
+        s.sendall(bytes([wc.FRAME_MAGIC])
+                  + struct.pack(">QI", total, len(desc)) + desc)
+        with pytest.raises((ConnectionError, OSError)):
+            _recv_msg(s)
+        s.close()
+        # well-formed clients are unaffected
+        kv = mx.kv.create('dist_async')
+        kv.init('ok', mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('ok', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# negotiation
+# ---------------------------------------------------------------------------
+def test_hello_negotiates_and_pickle_mode_pins_version_zero(monkeypatch):
+    import socket as _socket
+    srv = _serve_one(monkeypatch)
+    try:
+        s = _socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        _send_msg(s, wc.hello_msg())
+        assert _recv_msg(s) == ("ok", wc.CODEC_VERSION)
+        s.close()
+        # a codec-pinned process advertises (and emits) version 0
+        monkeypatch.setenv("MXNET_KVSTORE_CODEC", "pickle")
+        s = _socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        _send_msg(s, wc.hello_msg())
+        assert _recv_msg(s) == ("ok", 0)
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_client_hello_reads_old_peer_acks_as_version_zero():
+    replies = [("ok", None),                  # old mesh leader blanket ack
+               ("err", "ValueError: unknown op 'codec_hello'"),  # old server
+               ("ok", True),                  # bool is NOT a version int
+               ("ok", 1)]                     # real v1 peer
+    got = []
+
+    class _S:
+        pass
+
+    for reply in replies:
+        sock = _S()
+        got.append(wc.client_hello(
+            sock, lambda s, m, byte_kind: None,
+            lambda s, byte_kind: reply))
+        assert wc.sock_binary(sock) == (got[-1] >= 1)
+    assert got == [0, 0, 0, 1]
+
+
+def test_pickle_pin_keeps_wire_correct_and_codec_silent(monkeypatch):
+    """MXNET_KVSTORE_CODEC=pickle end-to-end: the mixed-version escape
+    hatch — no hellos sent, no binary frames, arithmetic unchanged."""
+    monkeypatch.setenv("MXNET_KVSTORE_CODEC", "pickle")
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.zeros(SHAPE))
+        prof.reset_serialization()
+        for i in range(4):
+            # no optimizer installed: assign-on-merge, last value wins
+            kv.push('w', mx.nd.ones(SHAPE) * (i + 1))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('w', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 4.0)
+        counts = prof.serialization_counts()
+        assert counts.get("codec_bytes", 0) == 0, counts
+        assert counts.get("pickle_bytes", 0) > 0, counts
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# byte accounting: control split + zero pickled bytes steady-state
+# ---------------------------------------------------------------------------
+def test_heartbeat_bytes_count_as_control_not_wire(monkeypatch):
+    """Satellite: wire_bytes_per_step measures gradients only — an idle
+    heartbeat cadence moves the 'control' family, never 'sent'/'recv'."""
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.05")
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.zeros(SHAPE))
+        time.sleep(0.3)   # let the hb socket dial + hello settle
+        prof.reset_channel_bytes()
+        time.sleep(0.4)   # idle: only heartbeats tick
+        assert prof.control_bytes_total() > 0
+        assert prof.wire_bytes_total() == 0, prof.channel_bytes()
+        assert prof.is_control_byte_kind("control")
+        assert prof.is_control_byte_kind("ici_control_recv")
+        assert not prof.is_control_byte_kind("sent")
+        assert not prof.is_control_byte_kind("ici_sent")
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+def test_steady_state_records_zero_pickle_bytes(monkeypatch):
+    """THE acceptance pin: with the codec negotiated (default auto), a
+    warmed-up push/pull stream serializes zero pickled bytes while
+    heartbeats keep beating."""
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.05")
+    srv = _serve_one(monkeypatch)
+    try:
+        kv = mx.kv.create('dist_async')
+        kv.init('w', mx.nd.zeros(SHAPE))
+        kv.push('w', mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('w', out=out)
+        time.sleep(0.2)   # hb socket hello done
+        prof.reset_serialization()
+        for i in range(10):
+            # assign-on-merge (no optimizer): pull sees the last push
+            kv.push('w', mx.nd.ones(SHAPE) * (i + 2))
+            kv.pull('w', out=out)
+        time.sleep(0.2)   # heartbeats inside the measured window
+        counts = prof.serialization_counts()
+        assert counts.get("pickle_bytes", 0) == 0, counts
+        assert counts.get("codec_bytes", 0) > 0, counts
+        assert counts.get("send_syscalls", 0) > 0, counts
+        np.testing.assert_allclose(out.asnumpy(), 11.0)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
