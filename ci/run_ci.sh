@@ -340,6 +340,30 @@ JAX_PLATFORMS=cpu timeout -k 10 240 \
     python tools/launch.py -n 1 -s 1 \
     python tests/dist/dist_serving_smoke.py
 
+echo "== fleet chaos smoke (kill one of three mid-storm + a blackhole)"
+# ISSUE 17's fleet acceptance (docs/SERVING.md): a FleetClient over 3
+# real replica processes survives one replica REALLY SIGKILLed
+# mid-storm (MXNET_FI_KILL_PROCESS_AFTER) and a second gray-failed
+# (MXNET_FI_BLACKHOLE_AFTER: accepts requests, never replies) with
+# ZERO failed client requests out of a 64-thread predict storm; the
+# routing counters prove follow-up traffic shifted entirely off both
+# casualties, and tools/postmortem.py names the SIGKILLed corpse from
+# bundle ABSENCE.  Self-launching (the script spawns its own replicas).
+# Time-boxed: a retry/quarantine regression presents as a failed
+# request or a hang on a swallowed reply.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tests/dist/dist_fleet_chaos.py
+
+echo "== fleet canary rollback smoke (forced SLO regression)"
+# The versioned-rollout acceptance (docs/SERVING.md): a 50/50 canary
+# split against a replica whose replies are delayed 80 ms
+# (MXNET_FI_DELAY_ACK_MS) must auto-roll back mid-stream on the p99
+# SLO breach — canary drained, canary_rollback in the flight recorder,
+# follow-up traffic 100% baseline — with zero failed requests (slow is
+# not broken; the rollback is the point).  Self-launching.
+JAX_PLATFORMS=cpu timeout -k 10 180 \
+    python tests/dist/dist_fleet_canary.py
+
 echo "== tracing smoke (spans on the wire + merged timeline + stats sweep)"
 # ISSUE 12's cluster-observability gate (docs/OBSERVABILITY.md): a
 # 2-worker/1-server launcher job with MXNET_TRACE=1 must (a) pass the

@@ -226,6 +226,13 @@ declare_env("MXNET_HEALTH_BUSY_STORM", int, 8,
 declare_env("MXNET_HEALTH_BUSY_WINDOW_S", float, 1.0,
             "health: sliding window (seconds) the BUSY-shed storm rule "
             "counts busy_shed events over")
+declare_env("MXNET_HEALTH_STALE_S", float, 30.0,
+            "health: staleness horizon for REMOTE health verdicts — a "
+            "banked/beat-piggybacked health block whose wall-clock ts "
+            "stamp is older than this many seconds no longer earns an "
+            "OK (cluster_health and the serving fleet router floor it "
+            "at DEGRADED: the last word of a corpse is forensics, not "
+            "a live verdict); 0 disables the discount")
 declare_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
             "host worker threads for the data pipeline")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19,
@@ -389,6 +396,56 @@ declare_env("MXNET_SERVING_LATENCY_WINDOW", int, 2048,
             "serving: ring size of the profiler's per-kind latency "
             "sample window (p50/p99/QPS are computed over this window; "
             "count/total stay lifetime)")
+# -- serving fleet (mxnet_tpu.serving.fleet; docs/SERVING.md) ----------------
+declare_env("MXNET_SERVING_FLEET_RETRIES", int, 3,
+            "serving fleet: per-request retry budget — after the first "
+            "attempt, at most this many more replicas are tried on "
+            "BusyError / connection failure / reply timeout (predict is "
+            "pure, so a cross-replica retry can never double-apply)",
+            tune={"choices": [1, 3, 6]})
+declare_env("MXNET_SERVING_FLEET_DEADLINE_S", float, 30.0,
+            "serving fleet: per-request wall deadline — routing, "
+            "backoff sleeps and retries all stop here and the LAST "
+            "error surfaces, naming every attempted replica")
+declare_env("MXNET_SERVING_FLEET_ATTEMPT_S", float, 5.0,
+            "serving fleet: per-attempt reply timeout — a replica that "
+            "accepted the request but never answers (gray failure / "
+            "blackhole) is abandoned after this many seconds and the "
+            "request retries on a different replica")
+declare_env("MXNET_SERVING_FLEET_BACKOFF_MS", float, 10.0,
+            "serving fleet: initial retry backoff; doubles per retry "
+            "up to MXNET_SERVING_FLEET_BACKOFF_MAX_MS")
+declare_env("MXNET_SERVING_FLEET_BACKOFF_MAX_MS", float, 500.0,
+            "serving fleet: retry backoff cap")
+declare_env("MXNET_SERVING_FLEET_JITTER", float, 0.5,
+            "serving fleet: jitter fraction on each backoff sleep "
+            "(delay * (1 +/- jitter*U) — decorrelates a thundering "
+            "retry herd); 0 = the pinned deterministic schedule the "
+            "backoff tests assert")
+declare_env("MXNET_SERVING_FLEET_STATS_S", float, 1.0,
+            "serving fleet: scoreboard poll interval — each tick asks "
+            "every replica for serving_stats (health verdict, queue "
+            "depth, draining flag) and re-probes quarantined replicas; "
+            "0 = no background thread, poll_once() only")
+declare_env("MXNET_SERVING_FLEET_DEGRADED_PENALTY", float, 4.0,
+            "serving fleet: load multiplier applied to a DEGRADED "
+            "replica in weighted-least-loaded routing (it still "
+            "serves, just proportionally less; CRITICAL/dead/draining "
+            "replicas are excluded outright)",
+            tune={"choices": [2.0, 4.0, 8.0]})
+declare_env("MXNET_SERVING_FLEET_CANARY_FRACTION", float, 0.1,
+            "serving fleet: fraction of requests routed to the canary "
+            "cohort while a canary is active")
+declare_env("MXNET_SERVING_FLEET_CANARY_MIN_N", int, 32,
+            "serving fleet: minimum completed requests in BOTH cohorts "
+            "before the canary SLO comparison may trigger a rollback")
+declare_env("MXNET_SERVING_FLEET_CANARY_P99_X", float, 2.0,
+            "serving fleet: canary p99 regression factor — canary p99 "
+            "above baseline p99 times this rolls the canary back")
+declare_env("MXNET_SERVING_FLEET_CANARY_ERR_X", float, 2.0,
+            "serving fleet: canary error-rate regression factor — "
+            "canary error rate above baseline rate times this (plus a "
+            "1% absolute floor) rolls the canary back")
 declare_env("MXNET_CKPT_RENDEZVOUS_TIMEOUT", float, 600.0,
             "async checkpoint: seconds rank 0 waits for every rank's "
             "shard (and ranks wait for the index) before failing")
@@ -463,6 +520,14 @@ declare_env("MXNET_FI_KILL_ON_BEAT_SEQ", int, None,
             "beat loop sends beat number N — the deterministic beat-"
             "boundary kill point for coordinator-failover tests, where "
             "the enveloped-ack count is timing-dependent (unset = off)")
+declare_env("MXNET_FI_BLACKHOLE_AFTER", int, None,
+            "fault injection: serve exactly N enveloped data-channel "
+            "replies normally, then SWALLOW every later one — the "
+            "socket stays open, requests are still accepted and "
+            "heartbeats still ack, but no reply ever arrives.  The "
+            "gray-failure shape (a stalled-not-dead server) the "
+            "serving fleet's reply timeouts must route around, where "
+            "liveness alone says everything is fine (unset = off)")
 # -- bench-script knobs (bench.py / benchmark/*) -----------------------------
 # Read by the repo-level bench scripts, which sit OUTSIDE the linted
 # package — declared here anyway because registration is what makes a

@@ -275,7 +275,10 @@ def cluster_health() -> dict:
     local ``num_dead_nodes()``.  Peer entries without a self-reported
     health block are evaluated against the local SLO rule thresholds
     (``health.evaluate``) so an old or minimal snapshot still gets a
-    verdict instead of a silent OK."""
+    verdict instead of a silent OK.  Self-reported verdicts carry a
+    wall-clock ``ts`` stamp: one older than ``MXNET_HEALTH_STALE_S``
+    no longer earns an OK (``health.discount_stale``) — the discounted
+    nodes are listed under ``stale``."""
     from . import health as _health
     order = {"OK": 0, "DEGRADED": 1, "CRITICAL": 2}
     # compact sweep: the health block (and the channel/wire families
@@ -285,19 +288,28 @@ def cluster_health() -> dict:
     stats = cluster_stats(compact=True)
     nodes: dict = {}
     dead: list = []
+    stale: list = []
     worst = "OK"
 
-    def verdict(snap):
+    def verdict(snap, name=None):
         h = snap.get("health") if isinstance(snap, dict) else None
         if isinstance(h, dict) and h.get("status") in order:
-            return h["status"]
+            st = h["status"]
+            # discount a stale verdict: a banked block whose ts stamp
+            # is past MXNET_HEALTH_STALE_S no longer earns an OK — the
+            # member went silent, and silence is not health
+            age = _health.verdict_age_s(h)
+            discounted = _health.discount_stale(st, age)
+            if discounted != st and name is not None:
+                stale.append(name)
+            return discounted
         st, _failed = _health.evaluate(snap if isinstance(snap, dict)
                                        else {})
         return st
 
     def fold(name, snap):
         nonlocal worst
-        st = verdict(snap)
+        st = verdict(snap, name=name)
         nodes[name] = st
         if order[st] > order[worst]:
             worst = st
@@ -316,14 +328,14 @@ def cluster_health() -> dict:
         # CRITICAL must not escalate a repaired cluster forever, so a
         # dead member contributes exactly the DEGRADED floor
         dead.append(uri)
-        nodes["dead-%s" % uri] = verdict(entry)
+        nodes["dead-%s" % uri] = verdict(entry, name="dead-%s" % uri)
         if order[worst] < order["DEGRADED"]:
             worst = "DEGRADED"
     n_dead = num_dead_nodes()
     if n_dead and order[worst] < order["DEGRADED"]:
         worst = "DEGRADED"
     return {"status": worst, "nodes": nodes, "dead": sorted(dead),
-            "num_dead_nodes": n_dead}
+            "stale": sorted(stale), "num_dead_nodes": n_dead}
 
 
 def shutdown() -> None:

@@ -78,6 +78,9 @@ class _Plan:
         self.kill_on_beat_seq = None    # SIGKILL self at beat number n
         self.stall_barrier_s = 0.0      # injected barrier-arrival delay
         self.stall_barrier_times = 0    # remaining stalls to inject
+        self.blackhole_after = None     # go reply-silent after n replies
+        self.bh_seen = 0                # server replies counted
+        self.blackholed = 0             # replies swallowed
 
 
 _plan = _Plan()
@@ -125,14 +128,16 @@ def stats() -> dict:
                 "connects_refused": _plan.connects_refused,
                 "accepts_refused": _plan.accepts_refused,
                 "messages_seen": _plan.sent,
-                "acks_served": _plan.acks_served}
+                "acks_served": _plan.acks_served,
+                "replies_blackholed": _plan.blackholed}
 
 
 def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
               refuse_connects=0, refuse_accepts=0, only_rank=None,
               kill_unacked=None, kill_process_after=None, only_server=None,
               only_coordinator=False, kill_on_beat_seq=None,
-              stall_barrier_s=0.0, stall_barrier_times=1):
+              stall_barrier_s=0.0, stall_barrier_times=1,
+              blackhole_after=None):
     """Arm a plan directly (the non-context-manager form; multi-process
     scripts use this after deciding per-rank what to inject)."""
     if kill_point not in KILL_POINTS:
@@ -160,6 +165,10 @@ def configure(kill_after=None, kill_point="before_send", delay_ack_s=0.0,
         _plan.stall_barrier_s = float(stall_barrier_s)
         _plan.stall_barrier_times = (int(stall_barrier_times)
                                      if stall_barrier_s > 0 else 0)
+        _plan.blackhole_after = (int(blackhole_after)
+                                 if blackhole_after is not None else None)
+        _plan.bh_seen = 0
+        _plan.blackholed = 0
 
 
 @contextlib.contextmanager
@@ -255,6 +264,28 @@ def delay_barrier_release(ms, times=1):
         with _lock:
             _plan.stall_barrier_s = 0.0
             _plan.stall_barrier_times = 0
+
+
+@contextlib.contextmanager
+def blackhole_after_replies(n):
+    """GRAY failure: serve ``n`` enveloped data-channel replies
+    normally, then swallow every later one — the connection stays open,
+    requests are still read and handled, heartbeats still ack, but no
+    reply ever leaves.  To a liveness check the server looks perfectly
+    healthy; to a caller every request stalls forever.  The stall shape
+    a router's reply timeout (not its heartbeat feed) must catch.  Env
+    form: ``MXNET_FI_BLACKHOLE_AFTER`` (composes with
+    ``MXNET_FI_ONLY_SERVER`` / ``MXNET_FI_ONLY_COORDINATOR``)."""
+    with _lock:
+        _plan.blackhole_after = int(n)
+        _plan.bh_seen = 0
+        _plan.blackholed = 0
+    try:
+        yield
+    finally:
+        with _lock:
+            _plan.blackhole_after = None
+            _plan.bh_seen = 0
 
 
 @contextlib.contextmanager
@@ -388,6 +419,23 @@ def server_reply_delay():
         time.sleep(d)
 
 
+def server_blackhole() -> bool:
+    """Called before every server data-channel reply send; True =
+    swallow the reply (the caller returns without writing a byte).
+    Counts only the replies that reach this hook, so heartbeat acks and
+    raw control replies (``fi_role=None`` sends) are exempt — exactly
+    the gray-failure contract: liveness keeps answering while the
+    request stream goes silent."""
+    with _lock:
+        if _plan.blackhole_after is None or not _server_active():
+            return False
+        _plan.bh_seen += 1
+        if _plan.bh_seen <= _plan.blackhole_after:
+            return False
+        _plan.blackholed += 1
+        return True
+
+
 def barrier_stall():
     """Called by the server at every barrier arrival, BEFORE the
     arrival registers.  Fires the armed one-shot(s) of
@@ -457,10 +505,11 @@ def _arm_from_env():
     kp = os.environ.get("MXNET_FI_KILL_PROCESS_AFTER")
     kb = os.environ.get("MXNET_FI_KILL_ON_BEAT_SEQ")
     sb = os.environ.get("MXNET_FI_STALL_BARRIER_MS")
+    bh = os.environ.get("MXNET_FI_BLACKHOLE_AFTER")
     orank = os.environ.get("MXNET_FI_ONLY_RANK")
     osrv = os.environ.get("MXNET_FI_ONLY_SERVER")
     ocoord = os.environ.get("MXNET_FI_ONLY_COORDINATOR")
-    if not (ka or ku or rc or ra or dl or kp or kb or sb):
+    if not (ka or ku or rc or ra or dl or kp or kb or sb or bh):
         return
     configure(
         kill_after=int(ka) if ka else None,
@@ -475,7 +524,8 @@ def _arm_from_env():
         only_coordinator=bool(ocoord) and
         ocoord.lower() not in ("0", "false", "off", ""),
         kill_on_beat_seq=int(kb) if kb else None,
-        stall_barrier_s=float(sb) / 1000.0 if sb else 0.0)
+        stall_barrier_s=float(sb) / 1000.0 if sb else 0.0,
+        blackhole_after=int(bh) if bh else None)
 
 
 _arm_from_env()

@@ -137,6 +137,7 @@ def reconfigure():
         _state.busy_storm = int(env("MXNET_HEALTH_BUSY_STORM", 8))
         _state.busy_window_s = float(
             env("MXNET_HEALTH_BUSY_WINDOW_S", 1.0))
+        _state.stale_s = float(env("MXNET_HEALTH_STALE_S", 30.0))
         ring = max(16, int(env("MXNET_HEALTH_EVENTS", 256)))
         if ring != _state.events.maxlen:
             _state.events = deque(_state.events, maxlen=ring)
@@ -462,6 +463,35 @@ def evaluate(snap: dict) -> tuple:
     return sev, failed
 
 
+def verdict_age_s(block, now: Optional[float] = None):
+    """Seconds since a (possibly remote) ``health`` block's verdict was
+    produced, from the wall-clock ``ts`` stamp every
+    :func:`snapshot_section` carries.  None when the block has no stamp
+    (a pre-stamp peer, or health disabled on its side) — absence of
+    evidence is not staleness evidence."""
+    if not isinstance(block, dict):
+        return None
+    ts = block.get("ts")
+    if not isinstance(ts, (int, float)):
+        return None
+    now = time.time() if now is None else float(now)
+    return max(0.0, now - float(ts))
+
+
+def discount_stale(status_: str, age_s, stale_s: Optional[float] = None
+                   ) -> str:
+    """Fold verdict staleness into a REMOTE status: an ``OK`` older
+    than the staleness horizon (``MXNET_HEALTH_STALE_S``) floors at
+    DEGRADED — a silent replica's last word is forensics, not a live
+    all-clear.  Worse-than-OK verdicts pass through unchanged (stale
+    bad news is still news), as does an unknown age."""
+    stale = _state.stale_s if stale_s is None else float(stale_s)
+    if (status_ == OK and stale > 0 and age_s is not None
+            and float(age_s) > stale):
+        return DEGRADED
+    return status_
+
+
 def _raw_conditions(now: float) -> tuple:
     """(severity, active condition names, SLO rule verdicts) from live
     local state — tripped in-flight waits, outstanding channel poison,
@@ -552,6 +582,12 @@ def snapshot_section(compact: bool = False) -> dict:
     with _lock:
         out = {"status": st,
                "worst": _state.worst,
+               # wall-clock stamp of THIS verdict: a consumer reading
+               # the block later (beat-banked snapshot, fleet
+               # scoreboard) derives age_s = now - ts and discounts a
+               # stale OK (verdict_age_s / discount_stale) instead of
+               # trusting the last word of a corpse
+               "ts": round(time.time(), 3),
                "trips": dict(_state.trips),
                "event_counts": dict(_state.counts)}
     if compact:

@@ -888,6 +888,26 @@ class _ServerConn:
         # its poison (if any) stops contributing CRITICAL
         _health.clear_channel_poison(self._uri)
 
+    def abort(self, join_timeout=5.0):
+        """Abortive close for a channel the caller KNOWS is gray-failed
+        (the peer accepts and heartbeats but stopped replying).  A
+        flushing ``close()`` would wait on acks that will never come —
+        and because acks are consumed strictly FIFO against the window,
+        one swallowed reply misaligns every later ack on this stream,
+        so the connection is unusable even if the peer recovers.  Fail
+        everything in flight NOW and tear the socket down; the caller
+        re-dials a fresh channel if it still wants this peer."""
+        self._closing.set()
+        if self._err is None:
+            self._err = MXNetError(
+                f"kvstore channel to {self._uri} aborted: peer stopped "
+                f"replying (gray failure) — in-flight window failed")
+        try:
+            self._sock.close()      # wakes the IO thread mid-select
+        except (OSError, AttributeError):
+            pass
+        self.close(join_timeout=join_timeout, retry=False)
+
 
 class _Pending:
     """Reply rendezvous for one in-flight request."""

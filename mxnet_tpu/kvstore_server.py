@@ -318,6 +318,10 @@ def _send_msg(sock, obj, fi_role=None, byte_kind="sent"):
         faultinject.client_send(sock)
     elif fi_role == "server":
         faultinject.server_reply_delay()
+        if faultinject.server_blackhole():
+            # injected gray failure: the reply is swallowed, the
+            # connection stays open — the caller believes it sent
+            return
     parts = None
     if _codec.sock_binary(sock) and _codec.is_hot(obj):
         enc = _codec.encode_frame(obj)

@@ -122,6 +122,21 @@ def elect_successor(servers: Optional[Sequence[str]],
     return None
 
 
+def roster_diff(old: Optional[Sequence[str]],
+                new: Optional[Sequence[str]]) -> Tuple[List[str],
+                                                       List[str]]:
+    """``(added, removed)`` between two ordered server rosters, order-
+    preserving and duplicate-free — the pure arithmetic behind roster
+    OBSERVATION: the serving fleet reconciles its replica set against
+    each observed generation (a removed uri gets drained, an added one
+    becomes routable) without ever joining the roster itself."""
+    old_set = {u for u in (old or ()) if u}
+    new_set = {u for u in (new or ()) if u}
+    added = [u for u in (new or ()) if u and u not in old_set]
+    removed = [u for u in (old or ()) if u and u not in new_set]
+    return added, removed
+
+
 def host_groups(workers: Sequence[int],
                 workers_per_host: int) -> List[Tuple[int, ...]]:
     """Partition worker ranks into per-host mesh groups — the pure

@@ -299,6 +299,17 @@ def reset_channel_counts():
         _channel_counts.clear()
 
 
+def fleet_route_counts() -> dict:
+    """Per-replica routing counters for a serving fleet: {uri: attempts
+    routed there}, stripped of the ``fleet.route:`` prefix.  The chaos
+    gate asserts on a DELTA of this map — after a kill/blackhole, the
+    dead replicas' counts must stop moving while the survivors' climb."""
+    with _channel_lock:
+        return {k[len("fleet.route:"):]: v
+                for k, v in _channel_counts.items()
+                if k.startswith("fleet.route:")}
+
+
 # -- kvstore channel byte counters -------------------------------------------
 # Bytes moved per transport DIRECTION ("sent"/"recv" for the socket wire,
 # "allgather" for host collectives).  Separate from the event counters:

@@ -21,6 +21,12 @@ this codebase's idioms):
   cluster.
 * :class:`ServingClient` — pipelined client riding the kvstore channel
   (reconnect/replay and heartbeats included).
+* :class:`FleetClient` — a health-routed replica-set client over N
+  replicas: scoreboard-driven weighted-least-loaded routing, cross-
+  replica retries under a deadline + retry budget (predict is pure),
+  operator/roster drain, and versioned canary rollout with automatic
+  SLO rollback.  Replica death, degradation and overload stop being the
+  caller's problem.
 
 Latency SLOs are first-class: every request records into
 ``profiler.record_latency``; ``profiler.latency_stats("serving.
@@ -32,12 +38,13 @@ topology.
 from .bucketed import BucketedPredictor, parse_buckets
 from .batcher import BusyError, DynamicBatcher
 from .replica import ServingReplica, VERSION_KEY
-from .client import PredictFuture, ServingClient
+from .client import PredictFuture, PredictTimeout, ServingClient
+from .fleet import FleetClient, FleetError
 
 __all__ = [
-    "BucketedPredictor", "BusyError", "DynamicBatcher", "PredictFuture",
-    "ServingClient", "ServingReplica", "VERSION_KEY", "parse_buckets",
-    "publish_version",
+    "BucketedPredictor", "BusyError", "DynamicBatcher", "FleetClient",
+    "FleetError", "PredictFuture", "PredictTimeout", "ServingClient",
+    "ServingReplica", "VERSION_KEY", "parse_buckets", "publish_version",
 ]
 
 

@@ -48,6 +48,7 @@ from ..kvstore_server import KVStoreServer, _send_msg, _recv_msg
 from .. import profiler as _prof
 from .. import tracing as _tr
 from .. import health as _health
+from .. import faultinject as _fi
 from .batcher import DynamicBatcher, _ReplySlot
 from .bucketed import BucketedPredictor
 
@@ -77,13 +78,26 @@ class ServingReplica(KVStoreServer):
         # is PURE, so a post-reconnect replay re-runs harmlessly — and
         # must not hold a conn thread inside _exactly_once while the
         # batch forms (that would serialize the batcher per connection)
-        self._deferred_ops = {"predict"}
+        self._deferred_ops = {"predict", "predict_canary"}
         # protocol: replay(pure) reply(predictions) codec(binary)
         self.register_op("predict", self._op_predict_sync)
+        # the canary-tagged twin of predict: same batcher, same reply
+        # shape, but counted separately (serving.canary_predict) so a
+        # fleet's canary fraction is provable server-side; rides pickle
+        # (the canary cohort is a fraction — never the hot path)
+        # protocol: replay(pure) reply(predictions)
+        self.register_op("predict_canary", self._op_predict_sync)
         # protocol: replay(pure) reply(serving stats dict)
         self.register_op("serving_stats", self._op_stats)
         # protocol: replay(idempotent) reply(version + refreshed)
         self.register_op("serving_refresh", self._op_refresh)
+        # operator drain: an advisory flag the stats reply carries —
+        # routers stop sending new work, in-flight requests finish
+        # normally (("drain", False) undoes it; setting the same flag
+        # twice is a no-op, hence idempotent)
+        # protocol: replay(idempotent) reply(draining flag)
+        self.register_op("drain", self._op_drain)
+        self._draining = False
         if param_servers is None:
             import os
             param_servers = os.environ.get("MXT_SERVER_URIS") or None
@@ -140,6 +154,8 @@ class ServingReplica(KVStoreServer):
         """Pipelined path: park the predict in the batcher, return the
         reply slot the connection writer awaits (``span`` attaches to
         the slot BEFORE it is queued — see DynamicBatcher.submit)."""
+        if inner and inner[0] == "predict_canary":
+            _prof.record_channel_event("serving.canary_predict")
         payload = inner[1] if len(inner) > 1 else None
         return self._batcher.submit(payload, span=span)
 
@@ -161,6 +177,10 @@ class ServingReplica(KVStoreServer):
             "batches": self._batcher.batches,
             "shed": self._batcher.shed,
             "refreshes": self.refreshes,
+            # the operator drain flag (("drain",) envelope): advisory —
+            # a fleet router treats a draining replica as ineligible
+            # for NEW work while everything in flight completes
+            "draining": self._draining,
             # which membership epoch the weight-refresh client last
             # converged onto (0 = static roster or no client yet): lets
             # an operator correlate a served-version stall with training
@@ -184,6 +204,22 @@ class ServingReplica(KVStoreServer):
 
     def _op_refresh(self, msg, rank):
         return self._refresh_once()
+
+    def _op_drain(self, msg, rank):
+        """Operator drain toggle: ``("drain",)`` / ``("drain", True)``
+        marks this replica draining, ``("drain", False)`` restores it.
+        Advisory by design — the stats reply carries the flag and a
+        router stops ROUTING here, while requests already in flight
+        (and any client that ignores the flag) still complete: a drain
+        must never fail the work it is trying to move elsewhere."""
+        enable = bool(msg[1]) if len(msg) > 1 else True
+        changed = enable != self._draining
+        self._draining = enable
+        if changed:
+            _prof.record_channel_event("serving.drain" if enable
+                                       else "serving.undrain")
+            _health.note("serving_drain", enabled=enable, port=self.port)
+        return {"draining": enable}
 
     def _stats_payload(self):
         """The universal ``("stats",)`` envelope, serving-flavored: the
@@ -386,6 +422,12 @@ class ServingReplica(KVStoreServer):
                     # reconnect replay simply re-runs it — drain the
                     # remaining slots without sending
                     return
+                if getattr(slot, "role", None) == "server":
+                    # the serving tier honors the same deterministic
+                    # kill dial as the base serve loop: SIGKILL after
+                    # exactly N enveloped replies (the chaos gate's
+                    # mid-storm replica death)
+                    _fi.server_replied()
         except Exception:  # noqa: BLE001 — conn died; client reconnects
             pass
 

@@ -1,0 +1,171 @@
+"""Fleet canary rollback smoke: a forced SLO regression on the canary
+cohort auto-rolls traffic back to the baseline — zero failed requests.
+
+Run directly (the script is its own 2-process launcher):
+
+    python tests/dist/dist_fleet_canary.py
+
+Two ServingReplica children: rank 0 is the BASELINE, rank 1 the CANARY.
+The canary child is armed with ``MXNET_FI_DELAY_ACK_MS=80`` — every
+enveloped reply it sends stalls 80 ms, a tail-latency regression far
+past the rollback multiplier (``MXNET_SERVING_FLEET_CANARY_P99_X``)
+while staying well inside the per-attempt timeout, so nothing FAILS;
+the canary is merely, measurably, slower.  The parent proves:
+
+1. ``start_canary`` splits live traffic 50/50 by cohort (the canary
+   side rides the ``predict_canary`` wire op);
+2. once both cohort SLO windows have ``canary_min_n`` samples the
+   client rolls back ON ITS OWN mid-stream: the canary drains,
+   ``canary_active`` drops, and ``last_rollback`` names a p99 breach
+   with both cohorts' numbers;
+3. the rollback lands in the flight recorder (a ``canary_rollback``
+   health event naming the drained uri) and follow-up traffic routes
+   100% to the baseline;
+4. every request in the stream — before, during and after the
+   rollback — succeeded with bit-correct outputs.
+
+Time-boxed by ci/run_ci.sh; a cohort-accounting or rollback regression
+presents as a stuck canary, a failed request, or a missing event.
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+FEAT, HIDDEN = 4, 3
+MAX_REQUESTS = 400
+MIN_N = 20
+
+
+def _model():
+    import numpy as np
+    import mxnet_tpu as mx
+    rs = np.random.RandomState(0)
+    w = rs.randn(HIDDEN, FEAT).astype(np.float32)
+    b = rs.randn(HIDDEN).astype(np.float32)
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=HIDDEN, name='fc')
+    sym = mx.sym.SoftmaxOutput(fc, name='softmax')
+    params = {'fc_weight': mx.nd.NDArray(w), 'fc_bias': mx.nd.NDArray(b)}
+    return sym, params, w, b
+
+
+def child():
+    from cpu_pin import pin_cpu
+    pin_cpu(n_devices=None)
+    from mxnet_tpu import serving
+    sym, params, _w, _b = _model()
+    rep = serving.ServingReplica(
+        sym, {'data': (FEAT,)}, params, buckets=[1, 2, 4, 8],
+        port=int(os.environ["FLEET_CANARY_PORT"]), queue_depth=512,
+        max_wait_s=0.002, warmup=True)
+    rep.start_background()
+    print("READY %d" % rep.port, flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def main():
+    import numpy as np
+    from cpu_pin import pin_cpu
+    pin_cpu(n_devices=None)
+    from mxnet_tpu import health, profiler
+    from mxnet_tpu.serving import FleetClient
+
+    ports = _free_ports(2)
+    uris = ["127.0.0.1:%d" % p for p in ports]
+    base_uri, canary_uri = uris
+
+    children = []
+    for rank, port in enumerate(ports):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "FLEET_CANARY_PORT": str(port)})
+        if rank == 1:
+            env["MXNET_FI_DELAY_ACK_MS"] = "80"   # the forced regression
+        children.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, stdout=subprocess.PIPE, text=True))
+    try:
+        for rank, proc in enumerate(children):
+            line = proc.stdout.readline()
+            while line and not line.startswith("READY"):
+                line = proc.stdout.readline()
+            assert line.startswith("READY"), \
+                "replica %d never came up: %r" % (rank, line)
+
+        fl = FleetClient(uris, retries=3, attempt_s=5.0, deadline_s=30.0,
+                         stats_interval=0.0, connect_timeout=15.0,
+                         canary_min_n=MIN_N)
+        assert set(fl.poll_once().values()) == {"OK"}
+
+        _sym, _params, w, b = _model()
+        x = np.random.RandomState(7).randn(2, FEAT).astype(np.float32)
+        logits = x @ w.T + b
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        ref = e / e.sum(axis=1, keepdims=True)
+
+        fl.start_canary([canary_uri], fraction=0.5, refresh=False)
+        assert fl.canary_active
+
+        # -- the stream: rollback must happen ON ITS OWN mid-stream ------
+        n_sent = 0
+        while fl.canary_active and n_sent < MAX_REQUESTS:
+            outs = fl.predict({'data': x})
+            np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+            n_sent += 1
+        assert not fl.canary_active, \
+            "no auto-rollback after %d requests: %s" \
+            % (n_sent, fl.canary_report())
+
+        rb = fl.last_rollback
+        assert rb and "p99" in rb["reasons"], rb
+        assert rb["canary_p99_ms"] > rb["baseline_p99_ms"], rb
+        assert fl.scoreboard()[canary_uri]["state"] == "DRAINING"
+        assert profiler.channel_counts().get("fleet.rollback") == 1
+        kinds = [ev for ev in health.events()
+                 if ev["kind"] == "canary_rollback"]
+        assert len(kinds) == 1 and kinds[0]["uris"] == [canary_uri], kinds
+
+        # -- post-rollback: traffic is 100% baseline ---------------------
+        before = profiler.fleet_route_counts()
+        for _ in range(32):
+            outs = fl.predict({'data': x})
+            np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+        after = profiler.fleet_route_counts()
+        assert after.get(base_uri, 0) - before.get(base_uri, 0) == 32
+        assert after.get(canary_uri, 0) == before.get(canary_uri, 0)
+        fl.close()
+
+        print("fleet canary OK: rollback after %d requests (canary p99 "
+              "%.1f ms vs baseline %.1f ms), 0 failures, canary %s "
+              "drained; follow-up traffic 100%% baseline"
+              % (n_sent, rb["canary_p99_ms"], rb["baseline_p99_ms"],
+                 canary_uri), flush=True)
+    finally:
+        for proc in children:
+            if proc.poll() is None:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -1,0 +1,242 @@
+"""Fleet chaos smoke: kill one replica of three mid-storm, blackhole a
+second — ZERO failed client requests (the ISSUE 17 acceptance gate).
+
+Run directly (the script is its own 3-process launcher):
+
+    python tests/dist/dist_fleet_chaos.py
+
+Topology: three ServingReplica child processes (DMLC_ROLE=server,
+ranks 0..2) sharing one MXNET_HEALTH_DIR; a FleetClient in the parent.
+Fault plan, armed per-child through the env:
+
+* rank 1 (the VICTIM): ``MXNET_FI_KILL_PROCESS_AFTER=25`` — REAL
+  SIGKILL after exactly 25 enveloped predict replies, mid-storm; no
+  goodbye bundle.
+* rank 2 (the GRAY one): ``MXNET_FI_BLACKHOLE_AFTER=15`` — serves 15
+  replies, then swallows every later one while the process, its accept
+  loop and its heartbeat acks stay perfectly alive.
+
+The parent then proves, across genuine process/socket boundaries:
+
+1. a 64-thread predict storm (256 requests) completes with ZERO
+   client-visible failures and bit-correct outputs — BUSY sheds,
+   connection deaths and reply timeouts all retried onto survivors;
+2. the scoreboard marks both casualties DEAD and the per-replica
+   routing counters (``profiler.fleet_route_counts``) show follow-up
+   traffic shifted ENTIRELY off the dead + blackholed replicas;
+3. after SIGTERMing the survivors (they dump goodbye bundles),
+   ``tools/postmortem.py`` names the SIGKILLed victim from bundle
+   ABSENCE alone — shape "sigkill" — and lists the survivors under
+   ``terminated``.
+
+Time-boxed by ci/run_ci.sh; a routing/retry regression presents as a
+failed request, a stuck counter, or a corpse the report cannot name.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+FEAT, HIDDEN = 4, 3
+N_REPLICAS = 3
+VICTIM, GRAY = 1, 2          # rank 1 dies, rank 2 goes reply-silent
+STORM_THREADS = 64
+STORM_PER_THREAD = 4
+
+
+def _model():
+    import numpy as np
+    import mxnet_tpu as mx
+    rs = np.random.RandomState(0)
+    w = rs.randn(HIDDEN, FEAT).astype(np.float32)
+    b = rs.randn(HIDDEN).astype(np.float32)
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=HIDDEN, name='fc')
+    sym = mx.sym.SoftmaxOutput(fc, name='softmax')
+    params = {'fc_weight': mx.nd.NDArray(w), 'fc_bias': mx.nd.NDArray(b)}
+    return sym, params, w, b
+
+
+def child():
+    """One serving replica on the port the parent assigned; serves
+    until killed (SIGKILL via the armed fault plan, or the parent's
+    end-of-test SIGTERM — which dumps the goodbye bundle).
+
+    DMLC_ROLE is set AFTER the import: with it in the spawn env the
+    package would bootstrap a blocking raw parameter server at import
+    time instead of running this replica.  The health bundle's env
+    fingerprint and role_rank() both read os.environ at dump time, so
+    the postmortem still sees a fully-labeled server process."""
+    from cpu_pin import pin_cpu
+    pin_cpu(n_devices=None)
+    from mxnet_tpu import health, serving
+    os.environ["DMLC_ROLE"] = "server"
+    health.reconfigure()      # re-derive role_rank → server-<rank> bundle
+    sym, params, _w, _b = _model()
+    rep = serving.ServingReplica(
+        sym, {'data': (FEAT,)}, params, buckets=[1, 2, 4, 8],
+        port=int(os.environ["FLEET_CHAOS_PORT"]), queue_depth=512,
+        max_wait_s=0.002, warmup=True)
+    rep.start_background()
+    print("READY %d" % rep.port, flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def main():
+    import numpy as np
+    from cpu_pin import pin_cpu
+    pin_cpu(n_devices=None)
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import FleetClient
+
+    health_dir = tempfile.mkdtemp(prefix="fleet_chaos_health_")
+    ports = _free_ports(N_REPLICAS)
+    uris = ["127.0.0.1:%d" % p for p in ports]
+
+    children = []
+    for rank in range(N_REPLICAS):
+        env = dict(os.environ)
+        # no DMLC_ROLE here — the child sets it post-import (see child())
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "DMLC_SERVER_ID": str(rank),
+            "DMLC_NUM_SERVER": str(N_REPLICAS),
+            "DMLC_NUM_WORKER": "0",
+            "MXT_SERVER_URIS": ",".join(uris),
+            "MXNET_HEALTH_DIR": health_dir,
+            "FLEET_CHAOS_PORT": str(ports[rank]),
+        })
+        if rank == VICTIM:
+            env["MXNET_FI_KILL_PROCESS_AFTER"] = "25"
+        if rank == GRAY:
+            env["MXNET_FI_BLACKHOLE_AFTER"] = "15"
+        children.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, stdout=subprocess.PIPE, text=True))
+    try:
+        for rank, proc in enumerate(children):
+            line = proc.stdout.readline()
+            while line and not line.startswith("READY"):
+                line = proc.stdout.readline()
+            assert line.startswith("READY"), \
+                "replica %d never came up: %r" % (rank, line)
+
+        fl = FleetClient(uris, retries=4, attempt_s=2.0, deadline_s=30.0,
+                         backoff_ms=5.0, backoff_max_ms=50.0,
+                         stats_interval=0.5, connect_timeout=15.0)
+        assert set(fl.poll_once().values()) == {"OK"}
+
+        _sym, _params, w, b = _model()
+        x = np.random.RandomState(7).randn(4, FEAT).astype(np.float32)
+        logits = x @ w.T + b
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        ref = e / e.sum(axis=1, keepdims=True)
+
+        # -- 1: the storm (the victim dies and the gray one goes silent
+        # while these 256 requests are in flight) -----------------------
+        errors = []
+
+        def storm():
+            for _ in range(STORM_PER_THREAD):
+                try:
+                    outs = fl.predict({'data': x})
+                    np.testing.assert_allclose(outs[0], ref,
+                                               rtol=1e-5, atol=1e-6)
+                except Exception as exc:  # noqa: BLE001 — counted
+                    errors.append(repr(exc))
+
+        threads = [threading.Thread(target=storm)
+                   for _ in range(STORM_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, \
+            "client-visible failures during the storm: %s" % errors[:5]
+        deadline = time.monotonic() + 20
+        while children[VICTIM].poll() is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        rc = children[VICTIM].poll()
+        assert rc is not None and rc != 0, \
+            "the victim outlived its kill plan (rc=%r)" % rc
+
+        # -- 2: routing shifted entirely off the casualties --------------
+        fl.poll_once()               # settle the scoreboard
+        states = {u: s for u, s in fl.scoreboard().items()}
+        assert states[uris[VICTIM]]["state"] == "DEAD", states
+        assert states[uris[GRAY]]["state"] == "DEAD", states
+        assert states[uris[0]]["state"] == "OK", states
+        before = profiler.fleet_route_counts()
+        for _ in range(64):
+            outs = fl.predict({'data': x})
+            np.testing.assert_allclose(outs[0], ref,
+                                       rtol=1e-5, atol=1e-6)
+        after = profiler.fleet_route_counts()
+        delta = {u: after.get(u, 0) - before.get(u, 0) for u in uris}
+        assert delta[uris[0]] == 64, delta
+        assert delta[uris[VICTIM]] == 0 and delta[uris[GRAY]] == 0, delta
+        counts = profiler.channel_counts()
+        assert counts.get("fleet.retry", 0) > 0
+        assert counts.get("fleet.conn_error", 0) \
+            + counts.get("fleet.timeout", 0) > 0
+        fl.close()
+
+        # -- 3: the postmortem names the corpse from bundle ABSENCE ------
+        for rank, proc in enumerate(children):
+            if rank != VICTIM:
+                proc.send_signal(signal.SIGTERM)
+        for rank, proc in enumerate(children):
+            if rank != VICTIM:
+                assert proc.wait(timeout=30) is not None
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "..", "..", "tools"))
+        import postmortem
+        report = postmortem.build_report(health_dir)
+        dead = report["dead"]
+        assert len(dead) == 1, json.dumps(dead, indent=2, default=str)
+        assert dead[0]["role"] == "server" \
+            and dead[0]["rank"] == str(VICTIM), dead
+        assert dead[0]["shape"] == "sigkill", dead
+        assert dead[0]["uri"] == uris[VICTIM], dead
+        terminated = set(report["terminated"])
+        assert "server-0" in terminated \
+            and ("server-%d" % GRAY) in terminated, report["terminated"]
+
+        print("fleet chaos OK: %d requests, 0 failures; victim=%s "
+              "sigkilled + named from bundle absence, gray=%s routed "
+              "around; survivor took all follow-up traffic"
+              % (STORM_THREADS * STORM_PER_THREAD + 64,
+                 uris[VICTIM], uris[GRAY]), flush=True)
+    finally:
+        for proc in children:
+            if proc.poll() is None:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -510,3 +510,61 @@ def test_io_thread_crash_poisons_channel_instead_of_hanging(monkeypatch):
         assert not conn._thread.is_alive()
     finally:
         srv.stop()
+
+
+# -- the gray-failure injector (reply blackhole, ISSUE 17) --------------------
+def test_blackhole_counts_and_disarms():
+    """First N replies flow, later ones are swallowed and counted; the
+    context exit disarms without losing the forensic count."""
+    with faultinject.blackhole_after_replies(2):
+        assert faultinject.server_blackhole() is False   # reply 1 flows
+        assert faultinject.server_blackhole() is False   # reply 2 flows
+        assert faultinject.server_blackhole() is True    # swallowed
+        assert faultinject.server_blackhole() is True    # still silent
+        assert faultinject.stats()["replies_blackholed"] == 2
+    assert faultinject.server_blackhole() is False       # disarmed
+    assert faultinject.stats()["replies_blackholed"] == 2
+
+
+def test_blackhole_only_server_filter(monkeypatch):
+    """MXNET_FI_ONLY_SERVER scopes the blackhole to one replica in a
+    multi-process job — the chaos gate's one-corpse-of-three shape."""
+    faultinject.configure(blackhole_after=0, only_server=3)
+    monkeypatch.setenv("DMLC_SERVER_ID", "1")
+    assert faultinject.server_blackhole() is False
+    monkeypatch.setenv("DMLC_SERVER_ID", "3")
+    assert faultinject.server_blackhole() is True
+
+
+def test_blackhole_env_arming(monkeypatch):
+    monkeypatch.setenv("MXNET_FI_BLACKHOLE_AFTER", "1")
+    faultinject._arm_from_env()
+    assert faultinject.server_blackhole() is False
+    assert faultinject.server_blackhole() is True
+    assert faultinject.stats()["replies_blackholed"] == 1
+
+
+def test_blackholed_reply_leaves_connection_open(monkeypatch):
+    """Wire-level gray failure: the server reads and HANDLES the
+    request but the reply never leaves — the socket stays connected
+    (liveness looks fine) and only the caller's reply timeout sees it.
+    After disarming, the same connection cannot be trusted: its FIFO
+    ack stream is misaligned, which is exactly why the fleet replaces
+    quarantined conns (_ServerConn.abort)."""
+    from mxnet_tpu.serving.client import PredictTimeout, _timed_await
+    srv = KVStoreServer(num_workers=1)
+    srv.start_background()
+    try:
+        from mxnet_tpu.kvstore import _ServerConn
+        conn = _ServerConn(f"127.0.0.1:{srv.port}")
+        try:
+            assert conn.submit(("ping", 0), wait=True) is None
+            with faultinject.blackhole_after_replies(0):
+                pending = conn.request(("pull", "nothing"))
+                with pytest.raises(PredictTimeout):
+                    _timed_await(pending, 0.4)
+                assert faultinject.stats()["replies_blackholed"] >= 1
+        finally:
+            conn.abort()
+    finally:
+        srv.stop()

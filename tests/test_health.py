@@ -464,3 +464,63 @@ def test_postmortem_cli_writes_report_and_renders(tmp_path):
                                        "server-0", "server-1"}
     assert len(report["dead"]) == 3
     assert postmortem.main([str(tmp_path / "nope")]) == 2
+
+
+# -- verdict staleness (ISSUE 17: age_s on banked/remote verdicts) -----------
+def test_health_block_carries_ts_stamp(monkeypatch):
+    health.reconfigure()
+    for compact in (True, False):
+        block = health.snapshot_section(compact=compact)
+        assert abs(time.time() - block["ts"]) < 5.0
+        age = health.verdict_age_s(block)
+        assert age is not None and age < 5.0
+    # no stamp (pre-stamp peer / disabled block) -> age unknown, never 0
+    assert health.verdict_age_s({"status": "OK"}) is None
+    assert health.verdict_age_s(None) is None
+    assert health.verdict_age_s({"status": "OK", "ts": "bogus"}) is None
+    old = {"status": "OK", "ts": time.time() - 120.0}
+    assert health.verdict_age_s(old) >= 119.0
+    # injectable now: deterministic arithmetic
+    assert health.verdict_age_s({"ts": 100.0}, now=130.0) == 30.0
+    assert health.verdict_age_s({"ts": 200.0}, now=130.0) == 0.0
+
+
+def test_discount_stale_table(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_STALE_S", "30")
+    health.reconfigure()
+    assert health.discount_stale("OK", 5.0) == "OK"
+    assert health.discount_stale("OK", 31.0) == "DEGRADED"
+    # unknown age passes through: absence of evidence is not staleness
+    assert health.discount_stale("OK", None) == "OK"
+    # stale BAD news is still news — never improved, never doubled
+    assert health.discount_stale("DEGRADED", 9999.0) == "DEGRADED"
+    assert health.discount_stale("CRITICAL", 9999.0) == "CRITICAL"
+    # explicit horizon overrides the knob; 0 disables the discount
+    assert health.discount_stale("OK", 31.0, stale_s=60.0) == "OK"
+    assert health.discount_stale("OK", 1e9, stale_s=0.0) == "OK"
+
+
+def test_cluster_health_discounts_stale_verdicts(monkeypatch):
+    """A banked (or live) OK stamped past MXNET_HEALTH_STALE_S reads
+    DEGRADED in the roll-up and the node is listed under ``stale`` —
+    silence is not health."""
+    monkeypatch.setenv("MXNET_HEALTH_STALE_S", "30")
+    health.reconfigure()
+    now = time.time()
+    synth = {
+        "workers": {0: {"health": {"status": "OK", "ts": now}}},
+        "servers": {"s:1": {"health": {"status": "OK",
+                                       "ts": now - 300.0}}},
+        "stats_bank": {"s:2": {"health": {"status": "OK",
+                                          "ts": now - 300.0}}},
+    }
+    monkeypatch.setattr(distributed, "cluster_stats",
+                        lambda compact=True: synth)
+    monkeypatch.setattr(distributed, "num_dead_nodes", lambda: 0)
+    ch = distributed.cluster_health()
+    assert ch["nodes"]["worker-0"] == "OK"
+    assert ch["nodes"]["server-s:1"] == "DEGRADED"     # live but stale
+    assert ch["nodes"]["dead-s:2"] == "DEGRADED"       # banked + stale
+    assert ch["status"] == "DEGRADED"
+    assert ch["stale"] == ["dead-s:2", "server-s:1"]
+    assert ch["dead"] == ["s:2"]

@@ -1155,3 +1155,19 @@ def test_serving_replica_tolerates_roster_bump(monkeypatch):
     finally:
         srv0.stop()
         srv1.stop()
+
+
+# -- roster_diff (the fleet's roster-observation primitive) -------------------
+def test_roster_diff_pure_arithmetic():
+    added, removed = membership.roster_diff(
+        ["a:1", "b:2", "c:3"], ["b:2", "d:4", "c:3"])
+    assert added == ["d:4"] and removed == ["a:1"]
+    # order of the NEW roster is preserved for added; old for removed
+    added, removed = membership.roster_diff([], ["x:1", "y:2"])
+    assert added == ["x:1", "y:2"] and removed == []
+    added, removed = membership.roster_diff(["x:1", "y:2"], [])
+    assert added == [] and removed == ["x:1", "y:2"]
+    # identical rosters are a no-op; empties/Nones are ignored
+    assert membership.roster_diff(["a:1"], ["a:1"]) == ([], [])
+    assert membership.roster_diff(["a:1", ""], ["a:1", None]) == ([], [])
+    assert membership.roster_diff(None, None) == ([], [])
