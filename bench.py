@@ -432,6 +432,8 @@ def _run(batch):
     round0 = _mx_prof.wire_round_ms()
     pickle0 = _mx_prof.pickle_bytes_total()
     syscalls0 = _mx_prof.send_syscalls_total()
+    shm0 = _mx_prof.shm_bytes_total()
+    fanin_ms0 = _mx_prof.mesh_fanin_wait_ms()
     t0 = time.perf_counter()
     for i in range(iters):
         step(i)
@@ -444,6 +446,8 @@ def _run(batch):
     ici_bytes = _mx_prof.ici_bytes_total() - ici0
     pickle_bytes = _mx_prof.pickle_bytes_total() - pickle0
     send_syscalls = _mx_prof.send_syscalls_total() - syscalls0
+    shm_bytes = _mx_prof.shm_bytes_total() - shm0
+    fanin_ms = _mx_prof.mesh_fanin_wait_ms() - fanin_ms0
     # overlap over THIS timed region only (wait/round deltas), so
     # warmup and earlier configs can't dilute the reported fraction
     wire_wait_d = _mx_prof.wire_wait_ms() - wait0
@@ -508,6 +512,17 @@ def _run(batch):
             pickle_bytes / iters / steps_per_call, 1),
         "send_syscalls_per_step": round(
             send_syscalls / iters / steps_per_call, 2),
+        # same-host transport counters (docs/PERF_NOTES.md round 13):
+        # shm_bytes_per_step = mesh frames that rode the shared-memory
+        # lane instead of loopback TCP (MXNET_KVSTORE_SHM; 0 flat or
+        # with the lane off — paired with send_syscalls_per_step
+        # dropping to the control-plane floor); mesh_fanin_ms_per_step
+        # = leader wall-clock blocked collecting the followers' round
+        # (the number MXNET_KVSTORE_MESH_ACCEPTORS parallelism shrinks)
+        "shm_bytes_per_step": round(
+            shm_bytes / iters / steps_per_call, 1),
+        "mesh_fanin_ms_per_step": round(
+            fanin_ms / iters / steps_per_call, 3),
         # report from the env the executor actually reads, so an
         # externally-set MXNET_BACKWARD_DO_MIRROR is labeled correctly
         "remat": (os.environ.get("MXNET_REMAT_POLICY", "full")

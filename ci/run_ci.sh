@@ -260,10 +260,12 @@ echo "== hierarchical kvstore smoke (in-mesh reduce + per-host wire shipping)"
 # and kv.leader_ship spans descending from a fused.chunk.  Time-boxed:
 # a fan-in regression presents as a hang, a byte regression as a
 # failed inequality.
+# MXNET_KVSTORE_SHM=0 pins this run to loopback TCP: it is the byte
+# and send_syscalls baseline the shm gates below compare against
 rm -rf /tmp/_trace_hier && mkdir -p /tmp/_trace_hier
 JAX_PLATFORMS=cpu MXNET_TRACE=1 MXNET_TRACE_DIR=/tmp/_trace_hier \
     timeout -k 10 240 \
-    python tools/launch.py -n 2 -s 1 --workers-per-host 2 \
+    python tools/launch.py -n 2 -s 1 --workers-per-host 2 --shm off \
     python tests/dist/dist_hier_smoke.py
 python tools/trace_merge.py --spans /tmp/_trace_hier \
     -o /tmp/_trace_hier_merged.json
@@ -290,6 +292,30 @@ assert any(e["name"] == "kv.wire_wait" and e["args"].get("mesh")
     "no follower mesh wire_wait span"
 print("hier trace OK: mesh_reduce + leader_ship under fused.chunk")
 PY
+
+echo "== shm-lane smoke (4 workers/host: follower payload off the sockets)"
+# ISSUE 18's tentpole gate: the SAME smoke, now five ranks deep in one
+# host group with the shared-memory lane forced on.  Every rank must
+# land bit-identical on the analytic golden (concurrent follower
+# deposits through the leader's acceptor pool == sequential), each
+# follower's gradient frames must ride the "shm_*" counter family with
+# the socket ici payload down to handshake residue (asserted inside
+# the smoke), and steady-state frames cost zero socket syscalls.
+timeout -k 10 300 \
+    python tools/launch.py -n 4 -s 1 --workers-per-host 4 --shm on \
+    python tests/dist/dist_hier_smoke.py
+
+echo "== shm-lane wedge fallback (leader stops draining; TCP replay, zero failed steps)"
+# MXNET_FI_SHM_WEDGE_AFTER=6 wedges the leader's ring drain mid-run;
+# each follower's stall watchdog (tightened to 1s) must mark its lane
+# dead and fail over to TCP through the ordinary reconnect+replay
+# path: the run completes every step bit-identical and the follower
+# records a kvstore.shm_fallback event (asserted inside the smoke).
+timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 1 --workers-per-host 2 --shm on \
+    --env MXNET_FI_SHM_WEDGE_AFTER=6 \
+    --env MXNET_KVSTORE_SHM_STALL_S=1 \
+    python tests/dist/dist_hier_smoke.py
 
 echo "== elastic-fused smoke (SIGKILL a server mid-drive of the chunked driver)"
 # The fused x elastic composition (ISSUE 14's second half): a single

@@ -364,8 +364,43 @@ declare_env("MXNET_KVSTORE_MESH_FANIN_S", float, 120.0,
             "waits for every follower's contribution to a push round "
             "(and a follower's collect waits for the leader's wire "
             "round) before failing loudly — the fan-in watchdog that "
-            "turns a dead group member into a named error instead of "
-            "a silent hang (the wait is also health-registered)")
+            "turns a dead group member into a NAMED error (missing "
+            "ranks + last-heard ages, plus a flight-recorder event) "
+            "instead of a silent hang (the wait is also "
+            "health-registered)")
+declare_env("MXNET_KVSTORE_MESH_ACCEPTORS", int, 8,
+            "hierarchical kvstore tier: serve threads in the leader's "
+            "mesh fan-in pool — follower connections spread across "
+            "them so W followers' push frames decode CONCURRENTLY "
+            "instead of serializing through one recv loop (reduction "
+            "itself stays single-threaded at the local_allreduce_sum "
+            "barrier); 1 restores the serialized single-acceptor "
+            "drain, values past the follower count change nothing",
+            tune={"choices": [1, 2, 4, 8, 16]})
+declare_env("MXNET_KVSTORE_SHM", str, "auto",
+            "hierarchical kvstore tier: same-host shared-memory lane "
+            "for follower<->leader mesh frames (mxnet_tpu/shmlane.py; "
+            "negotiated per connection by the shm_hello wire op) — "
+            "'auto' tries it when the mesh endpoint is a local "
+            "address, 'on'/'1' always tries, 'off'/'0' never; segment "
+            "creation or cross-host attach failures fall back to the "
+            "TCP loopback path per connection.  Lane bytes land in "
+            "the shm_* counter family (profiler.shm_bytes_total) with "
+            "ZERO socket syscalls behind them; the socket's ici_* "
+            "drops to control traffic",
+            tune={"choices": ["auto", "on", "off"]})
+declare_env("MXNET_KVSTORE_SHM_RING_KB", int, 4096,
+            "shm lane: ring capacity per direction in KiB — a frame "
+            "larger than the ring rides the TCP path for that round "
+            "(safe: mesh channels run a one-envelope window, so no "
+            "reordering is possible)",
+            tune={"choices": [256, 1024, 4096, 16384]})
+declare_env("MXNET_KVSTORE_SHM_STALL_S", float, 5.0,
+            "shm lane: seconds a pushed request may sit unconsumed in "
+            "the ring before the follower declares the lane wedged, "
+            "marks it dead and fails over to TCP via the channel's "
+            "ordinary reconnect-and-replay (exactly-once via the "
+            "leader's dedup window)")
 # -- serving tier (mxnet_tpu.serving) ---------------------------------------
 declare_env("MXNET_SERVING_BUCKETS", str, "1,2,4,8,16,32",
             "serving: comma-separated batch-size buckets the replica "
@@ -528,6 +563,13 @@ declare_env("MXNET_FI_BLACKHOLE_AFTER", int, None,
             "gray-failure shape (a stalled-not-dead server) the "
             "serving fleet's reply timeouts must route around, where "
             "liveness alone says everything is fine (unset = off)")
+declare_env("MXNET_FI_SHM_WEDGE_AFTER", int, None,
+            "fault injection: the mesh leader drains exactly N shm-"
+            "lane ring frames normally, then stops popping — requests "
+            "pile up unconsumed, the wedged-drain shape the "
+            "follower's MXNET_KVSTORE_SHM_STALL_S watchdog must turn "
+            "into a clean TCP fallback with zero lost envelopes "
+            "(composes with MXNET_FI_ONLY_RANK; unset = off)")
 # -- bench-script knobs (bench.py / benchmark/*) -----------------------------
 # Read by the repo-level bench scripts, which sit OUTSIDE the linted
 # package — declared here anyway because registration is what makes a

@@ -407,6 +407,14 @@ class _ServerConn:
         self._retry_attempts = 0
         self._closing = threading.Event()
         self._last_transport_err = None
+        # same-host shm lane (mxnet_tpu/shmlane.py): set up AFTER the
+        # channel exists via setup_shm_lane() — None means plain TCP.
+        # Written on the caller's thread before any request that could
+        # ride it is enqueued (the queue put is the happens-before
+        # edge); read only by the IO thread afterwards.
+        self._shm = None
+        self._shm_stall_s = float(_env("MXNET_KVSTORE_SHM_STALL_S", 5.0))
+        self._shm_sent_at = None
         self._sock = self._dial(connect_timeout)
         self._q = queue.Queue()
         self._err = None
@@ -545,6 +553,9 @@ class _ServerConn:
                 if stopping:
                     return
                 continue
+            if self._shm is not None:
+                self._await_ack_shm(select)
+                continue
             try:
                 ready, _, _ = select.select(
                     [self._sock, self._wake_r], [], [])
@@ -613,6 +624,28 @@ class _ServerConn:
             envelope = ("req", self._client_id, self._next_seq, msg)
         self._next_seq += 1
         self._inflight.append([envelope, pending, False])
+        lane = self._shm
+        if lane is not None and lane.dead():
+            # peer marked it dead (leader teardown) — quiet drop, the
+            # socket still works
+            self._shm_drop()
+            lane = None
+        if lane is not None:
+            from . import wirecodec as _codec
+            try:
+                sent = lane.send_request(
+                    envelope, binary_ok=_codec.sock_binary(self._sock))
+            except MXNetError:
+                sent = False   # ring corrupt: fall through to TCP and
+                #                let the next wait cycle kill the lane
+            if sent:
+                # one memcpy into the ring, zero socket syscalls; the
+                # stall watchdog clock starts now.  fi kill hooks stay
+                # socket-only — the lane has its own fault point
+                # (MXNET_FI_SHM_WEDGE_AFTER).
+                import time as _time
+                self._shm_sent_at = _time.monotonic()
+                return
         try:
             if self._sock is None:
                 raise ConnectionError("channel has no connection")
@@ -622,19 +655,138 @@ class _ServerConn:
         except Exception as exc:  # noqa: BLE001 — transport fault
             self._recover_or_fail(exc)
 
+    def _await_ack_shm(self, select):
+        """The shm-lane flavor of the ack wait: poll the reply ring
+        (payload acks ride back the same lane) TOGETHER with the
+        socket (server-side fallback replies — e.g. a frame too big
+        for the ring went over TCP and so does its ack) and the wakeup
+        pair.  Adaptive poll interval: sub-millisecond while hot (the
+        in-host RTT this lane exists for), backing off to 2 ms so an
+        idle wait doesn't spin a core.  The stall watchdog rides the
+        same loop: a request sitting unconsumed in the ring past
+        MXNET_KVSTORE_SHM_STALL_S means the leader stopped draining —
+        mark the lane dead and fail over through the ordinary
+        reconnect-and-replay path (closing the old socket is what
+        makes a racing leader reply harmless: it dies with the
+        connection, and the replayed envelope is deduped)."""
+        import time
+        lane = self._shm
+        poll = 0.0002
+        while self._inflight:
+            try:
+                reply = lane.recv_reply()
+            except MXNetError as exc:
+                self._shm_fault(f"reply ring corrupt: {exc}")
+                return
+            if reply is not None:
+                self._ack_obj(reply)
+                return
+            if lane.dead():
+                self._shm_fault("peer marked the lane dead")
+                return
+            try:
+                ready, _, _ = select.select(
+                    [self._sock, self._wake_r], [], [], poll)
+            except (OSError, ValueError, TypeError):
+                ready = [self._sock]
+            if self._wake_r in ready:
+                try:
+                    while self._wake_r.recv(4096):
+                        pass
+                except (BlockingIOError, OSError):
+                    pass
+                return   # new work enqueued — go fill the window
+            if self._sock in ready:
+                self._recv_ack()
+                return
+            if (self._shm_sent_at is not None
+                    and lane.request_backlog() > 0
+                    and time.monotonic() - self._shm_sent_at
+                    > self._shm_stall_s
+                    and lane.drain_stalled(self._shm_stall_s)):
+                self._shm_fault(
+                    f"leader stopped draining the request ring for "
+                    f">{self._shm_stall_s}s (MXNET_KVSTORE_SHM_STALL_S)")
+                return
+            poll = min(poll * 2, 0.002)
+
+    def _shm_drop(self, record=False):
+        """Forget the lane (quietly or loudly) — mark dead so the peer
+        stops serving it, unlink the segment (our mapping and any
+        still-open peer mapping stay valid until their own close)."""
+        lane, self._shm = self._shm, None
+        self._shm_sent_at = None
+        if lane is None:
+            return
+        try:
+            lane.mark_dead()
+            lane.destroy()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        if record:
+            from . import profiler as _prof
+            _prof.record_channel_event("kvstore.shm_fallback")
+
+    def _shm_fault(self, why):
+        """Lane failure → the transport-fault path the channel already
+        survives: drop the lane, then reconnect-and-replay over TCP
+        (the leader's per-client dedup keeps the replayed window
+        exactly-once; the dead old socket swallows any reply the
+        leader raced out)."""
+        self._shm_drop(record=True)
+        _health.note("shm.fallback", uri=self._uri, why=str(why))
+        self._recover_or_fail(
+            ConnectionError(f"shm lane to {self._uri}: {why}"))
+
+    def setup_shm_lane(self):
+        """Negotiate the same-host shared-memory lane for this channel
+        (hierarchical-tier followers call it right after dialing,
+        before any mesh traffic).  Window-1 channels only — strict
+        request/reply alternation is what lets oversized frames ride
+        TCP per-round with no reordering.  Returns True when the lane
+        is live; every failure (knob off, remote host, segment
+        creation failure, old/cross-host leader erring the hello)
+        quietly keeps the channel on TCP."""
+        from . import profiler as _prof
+        from . import shmlane
+        if self._window != 1 or not shmlane.client_enabled(self._addr[0]):
+            return False
+        try:
+            lane = shmlane.ShmLane.create()
+        except Exception:  # noqa: BLE001 — no /dev/shm, quota, ...
+            return False
+        try:
+            ver = _await(self.request(("shm_hello", lane.name)))
+        except MXNetError:
+            lane.destroy()
+            return False
+        if not ver:
+            lane.destroy()
+            return False
+        self._shm = lane
+        _prof.record_channel_event("kvstore.shm_lane")
+        return True
+
     def _recv_ack(self):
         """Consume ONE ack for the head of the window (acks arrive in
         seq order on the single TCP stream)."""
         from .kvstore_server import _recv_msg
-        from . import profiler as _prof
         try:
             reply = _recv_msg(self._sock, fi_role="client",
                               byte_kind=self._byte_kinds[1])
         except Exception as exc:  # noqa: BLE001 — transport fault
             self._recover_or_fail(exc)
             return
+        self._ack_obj(reply)
+
+    def _ack_obj(self, reply):
+        """Complete the head-of-window slot with ``reply`` — shared by
+        the socket and shm-lane receive paths (the ring pops whole
+        decoded frames, so both land here with the same shapes)."""
+        from . import profiler as _prof
         # a complete round trip proves the transport healthy again
         self._retry_attempts = 0
+        self._shm_sent_at = None
         envelope, pending, replayed = self._inflight.popleft()
         if replayed:
             _prof.record_channel_event("kvstore.replay_acked")
@@ -721,6 +873,12 @@ class _ServerConn:
         except (OSError, AttributeError):
             pass
         self._sock = None
+        # any reconnect invalidates the shm lane: the leader's per-
+        # connection attach dies with the old socket, so a fresh
+        # connection runs plain TCP (rare path — lanes only die with
+        # their transport or via the stall watchdog)
+        if self._shm is not None:
+            self._shm_drop(record=True)
         last = cause
         while True:
             if self._retry_attempts >= self._retry_max:
@@ -869,6 +1027,9 @@ class _ServerConn:
             self._sock.close()
         except (OSError, AttributeError):
             pass
+        # the IO thread is down (or leaked) — tear the lane off last so
+        # the final flush above could still ride it
+        self._shm_drop()
         for s in (self._wake_r, self._wake_w):
             try:
                 s.close()
@@ -1185,26 +1346,40 @@ class _MeshLeader:
     — a loud error naming the missing round, never a silent hang (the
     wait is also health-registered, so the watchdog sees it age)."""
 
-    def __init__(self, uri, n_followers):
+    def __init__(self, uri, n_followers, follower_ranks=None):
         import socket
         from .base import env as _env
         from .kvstore_server import _set_nodelay
         host, port = uri.rsplit(":", 1)
         self._uri = uri
         self._n_followers = int(n_followers)
+        self._follower_ranks = (sorted(int(r) for r in follower_ranks)
+                                if follower_ranks is not None else None)
         self._fanin_s = float(_env("MXNET_KVSTORE_MESH_FANIN_S", 120.0))
+        self._acceptors = max(1, int(_env(
+            "MXNET_KVSTORE_MESH_ACCEPTORS", 8)))
         self._listener = socket.create_server((host, int(port)))
         self._listener.settimeout(0.5)
         self._stop = threading.Event()
         self._cv = threading.Condition()
         self._pushes: Dict[int, list] = {}    # seq -> [pairs, ...]
         self._handles: Dict[int, list] = {}   # seq -> [handle, served]
+        # fan-in forensics (guarded by _cv): which ranks deposited each
+        # round, and when each rank was last heard from at all — the
+        # timeout error names the missing ranks with last-heard ages,
+        # mirroring the static barrier failure (kvstore_server).
+        self._push_ranks: Dict[int, set] = {}
+        self._last_heard: Dict[int, float] = {}
         # per-CLIENT envelope dedup (survives reconnects — a replay
         # arrives on a FRESH connection): cid -> (seq, reply), plus the
         # in-flight rendezvous for a replay racing the original
         self._dedup: Dict[tuple, tuple] = {}
         self._dedup_inflight: Dict[tuple, int] = {}
         self._conns: list = []
+        self._pool: list = []     # _MeshAcceptor workers (accept thread
+        #                           creates/assigns; each worker's conn
+        #                           set is its own thread's after that)
+        self._assigned = 0
         self._set_nodelay = _set_nodelay
         # analysis: allow(bare-thread): a crash closes the listener in run()'s finally — followers observe refused connects / EOF and fail their channels loudly, exactly like a dead parameter server
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -1214,7 +1389,10 @@ class _MeshLeader:
     def collect_push(self, seq):
         """Block until every follower's round ``seq`` gradients arrived;
         pop and return them (a list of ``[(key, grad), ...]``)."""
+        import time as _time
+        from . import profiler as _prof
         wtok = _health.wait_begin("kv.mesh_fanin")
+        t0 = _time.monotonic()
         try:
             with self._cv:
                 ok = self._cv.wait_for(
@@ -1222,14 +1400,42 @@ class _MeshLeader:
                     >= self._n_followers or self._stop.is_set(),
                     timeout=self._fanin_s)
                 if not ok or self._stop.is_set():
+                    got = len(self._pushes.get(seq, ()))
+                    missing, detail = self._missing_followers(seq)
+                    _health.note("mesh.fanin_timeout", seq=int(seq),
+                                 got=got, want=self._n_followers,
+                                 missing=missing)
                     raise MXNetError(
                         f"mesh leader {self._uri}: round {seq} fan-in "
-                        f"incomplete ({len(self._pushes.get(seq, ()))} "
-                        f"of {self._n_followers} followers) within "
-                        f"MXNET_KVSTORE_MESH_FANIN_S={self._fanin_s}s")
-                return self._pushes.pop(seq)
+                        f"incomplete ({got} of {self._n_followers} "
+                        f"followers) within "
+                        f"MXNET_KVSTORE_MESH_FANIN_S={self._fanin_s}s"
+                        f"{detail}")
+                self._push_ranks.pop(seq, None)
+                out = self._pushes.pop(seq)
+            _prof.record_mesh_fanin_wait(_time.monotonic() - t0)
+            return out
         finally:
             _health.wait_end(wtok)
+
+    def _missing_followers(self, seq):
+        """(missing rank list, human detail) for a fan-in timeout —
+        caller holds _cv.  Degrades gracefully when the roster wasn't
+        passed (direct _MeshLeader construction)."""
+        import time as _time
+        if self._follower_ranks is None:
+            return [], ""
+        present = self._push_ranks.get(seq, set())
+        missing = [r for r in self._follower_ranks if r not in present]
+        if not missing:
+            return [], ""
+        now = _time.monotonic()
+        ages = "; ".join(
+            "rank %s: %s" % (
+                r, "never heard from" if self._last_heard.get(r) is None
+                else "last heard %.1fs ago" % (now - self._last_heard[r]))
+            for r in missing)
+        return missing, f" — missing {ages}"
 
     def publish_handle(self, seq, handle):
         """Register the leader's wire pull for round ``seq`` so
@@ -1246,11 +1452,16 @@ class _MeshLeader:
             self._listener.close()
         except OSError:
             pass
+        for w in list(self._pool):
+            w.poke()
         for c in list(self._conns):
             try:
                 c.close()
             except OSError:
                 pass
+        for w in list(self._pool):
+            w.thread.join(timeout=5.0)
+            w.close_wake()
 
     # -- serve side -------------------------------------------------------
     def _run(self):
@@ -1265,53 +1476,235 @@ class _MeshLeader:
                     break
                 self._set_nodelay(conn)
                 self._conns.append(conn)
-                t = threading.Thread(target=self._serve_conn,
-                                     args=(conn,), daemon=True)
-                t.start()
+                self._assign(conn)
         finally:
             try:
                 self._listener.close()
             except OSError:
                 pass
+            for w in list(self._pool):
+                w.poke()
 
-    def _serve_conn(self, conn):
-        from . import wirecodec as _codec
-        from .kvstore_server import _send_msg, _recv_msg
-        recv_kind = "ici_recv"
+    def _assign(self, conn):
+        """Hand a fresh connection to a pool worker (round-robin),
+        growing the pool up to MXNET_KVSTORE_MESH_ACCEPTORS threads.
+        Only the accept thread touches pool membership; each worker's
+        connection set is thereafter its own thread's alone (adoption
+        rides the worker's inbox Queue, a happens-before edge)."""
+        if len(self._pool) < self._acceptors:
+            w = _MeshAcceptor(self)
+            self._pool.append(w)
+        else:
+            w = self._pool[self._assigned % len(self._pool)]
+        self._assigned += 1
+        w.adopt(conn)
+
+    def _serve_pool(self, w):
+        """One acceptor-pool thread: multiplex its adopted connections
+        (sockets + shm lanes) with select, serving one frame per ready
+        source per sweep.  mesh_collect frames that arrive before the
+        leader registered the round are PARKED in ``pending`` rather
+        than blocking this thread — a blocked wait here would also
+        stall every other follower this thread serves, including the
+        very mesh_push frames the round is waiting on."""
+        import queue
+        import select as _select
+        conns: list = []     # _MeshConnState — this thread's alone
+        pending: list = []   # deferred mesh_collects
+        poll = 0.0002
         try:
-            with conn:
-                while not self._stop.is_set():
+            while not self._stop.is_set():
+                while True:
                     try:
-                        msg = _recv_msg(conn, byte_kind=recv_kind)
-                    except (ConnectionError, OSError):
-                        return
-                    reply_kind = "ici_sent"
-                    if msg and msg[0] == "req":
-                        _, cid, seq, inner = msg[:4]
-                        reply = self._exactly_once(cid, seq, inner)
-                    else:
-                        # codec hellos + raw heartbeat pings from the
-                        # follower channel (the hello check must come
-                        # FIRST: the blanket ("ok", None) ack is what an
-                        # OLD leader answers, which clients read as
-                        # version 0)
-                        hello = _codec.handle_hello(conn, msg)
-                        reply = hello if hello is not None \
-                            else ("ok", None)
-                        if msg and msg[0] == "ping":
-                            # pings ride the follower's dedicated
-                            # liveness socket; hellos arrive on data
-                            # sockets too and must not latch
-                            recv_kind = "ici_control_recv"
-                            reply_kind = "ici_control"
+                        conns.append(_MeshConnState(w.inbox.get_nowait()))
+                    except queue.Empty:
+                        break
+                lanes = any(st.lane is not None for st in conns)
+                timeout = poll if (lanes or pending) else None
+                try:
+                    ready, _, _ = _select.select(
+                        [st.sock for st in conns] + [w.wake_r],
+                        [], [], timeout)
+                except (OSError, ValueError):
+                    for st in [s for s in list(conns)
+                               if s.sock.fileno() < 0]:
+                        self._drop_conn(st, conns)
+                    continue
+                if w.wake_r in ready:
                     try:
-                        _send_msg(conn, reply, byte_kind=reply_kind)
-                    except (ConnectionError, OSError):
-                        return
-        except Exception:  # noqa: BLE001 — conn died mid-reply
+                        w.wake_r.recv(4096)
+                    except (OSError, BlockingIOError):
+                        pass
+                busy = False
+                rset = set(ready)
+                for st in list(conns):
+                    if st.sock in rset:
+                        busy |= self._serve_sock(st, conns, pending)
+                    if st.lane is not None:
+                        busy |= self._serve_lane(st, conns, pending)
+                busy |= self._scan_pending(conns, pending)
+                poll = 0.0002 if busy else min(poll * 2, 0.002)
+        finally:
+            for st in list(conns):
+                self._drop_conn(st, conns)
+
+    def _serve_sock(self, st, conns, pending):
+        from . import wirecodec as _codec
+        from .kvstore_server import _recv_msg
+        try:
+            msg = _recv_msg(st.sock, byte_kind=st.recv_kind)
+        except (ConnectionError, OSError):
+            self._drop_conn(st, conns)
+            return True
+        reply_kind = "ici_sent"
+        if msg and msg[0] == "req":
+            _, cid, seq, inner = msg[:4]
+            self._note_heard(cid)
+            if self._defer_collect(st, pending, cid, seq, inner, False):
+                return True
+            reply = self._exactly_once(cid, seq, inner, st=st)
+        else:
+            # codec hellos + raw heartbeat pings from the follower
+            # channel (the hello check must come FIRST: the blanket
+            # ("ok", None) ack is what an OLD leader answers, which
+            # clients read as version 0)
+            hello = _codec.handle_hello(st.sock, msg)
+            reply = hello if hello is not None else ("ok", None)
+            if msg and msg[0] == "ping":
+                # pings ride the follower's dedicated liveness socket;
+                # hellos arrive on data sockets too and must not latch
+                st.recv_kind = "ici_control_recv"
+                reply_kind = "ici_control"
+        self._reply(st, conns, reply, False, reply_kind)
+        return True
+
+    def _serve_lane(self, st, conns, pending):
+        lane = st.lane
+        if lane.dead():
+            self._drop_lane(st)
+            return False
+        try:
+            msg = lane.recv_request()
+        except MXNetError:
+            # a corrupt ring record poisons the whole lane (framing is
+            # lost) — kill the lane; the follower's stall watchdog
+            # fails over to TCP and replays its window
+            self._drop_lane(st)
+            return False
+        if msg is None:
+            return False
+        if msg and msg[0] == "req":
+            _, cid, seq, inner = msg[:4]
+            self._note_heard(cid)
+            if self._defer_collect(st, pending, cid, seq, inner, True):
+                return True
+            reply = self._exactly_once(cid, seq, inner, st=st)
+        else:
+            reply = ("ok", None)
+        self._reply(st, conns, reply, True)
+        return True
+
+    def _defer_collect(self, st, pending, cid, seq, inner, via_shm):
+        """Park a mesh_collect whose wire round is not registered yet.
+        Blocking this pool thread on ``_handles`` instead would be a
+        deadlock: another follower's mesh_push — the frame the round
+        needs to complete — may be sitting unread on a connection this
+        same thread owns.  Returns True when parked."""
+        import time as _time
+        if not inner or inner[0] != "mesh_collect":
+            return False
+        with self._cv:
+            have = self._dedup.get(cid)
+            if have is not None and have[0] == seq:
+                return False   # replay with a cached reply — serve now
+            if int(inner[1]) in self._handles or self._stop.is_set():
+                return False   # resolvable (or failing fast) already
+        pending.append((st, cid, seq, inner, via_shm,
+                        _time.monotonic() + self._fanin_s))
+        return True
+
+    def _scan_pending(self, conns, pending):
+        import time as _time
+        if not pending:
+            return False
+        busy = False
+        for item in list(pending):
+            st, cid, seq, inner, via_shm, deadline = item
+            with self._cv:
+                have = self._dedup.get(cid)
+                served = (int(inner[1]) in self._handles
+                          or self._stop.is_set()
+                          or (have is not None and have[0] == seq))
+            if served:
+                pending.remove(item)
+                reply = self._exactly_once(cid, seq, inner, st=st)
+                self._reply(st, conns, reply, via_shm)
+                busy = True
+            elif _time.monotonic() > deadline:
+                pending.remove(item)
+                self._reply(st, conns, (
+                    "err", f"MXNetError: mesh leader {self._uri}: no "
+                           f"wire round registered for collect seq "
+                           f"{int(inner[1])} within {self._fanin_s}s"),
+                    via_shm)
+                busy = True
+        return busy
+
+    def _reply(self, st, conns, reply, via_shm, reply_kind="ici_sent"):
+        """Send a reply back the way the request came: shm-borne
+        requests get shm replies (falling back to the socket when the
+        reply outgrows the ring — the follower polls both)."""
+        from . import wirecodec as _codec
+        from .kvstore_server import _send_msg
+        if via_shm and st.lane is not None and not st.lane.dead():
+            try:
+                if st.lane.send_reply(
+                        reply, binary_ok=_codec.sock_binary(st.sock)):
+                    return
+            except MXNetError:
+                self._drop_lane(st)
+        try:
+            _send_msg(st.sock, reply, byte_kind=reply_kind)
+        except (ConnectionError, OSError):
+            self._drop_conn(st, conns)
+
+    def _note_heard(self, cid):
+        import time as _time
+        if not isinstance(cid, (tuple, list)) or not cid:
+            return
+        try:
+            rank = int(cid[0])
+        except (TypeError, ValueError):
+            return
+        with self._cv:
+            self._last_heard[rank] = _time.monotonic()
+
+    def _drop_lane(self, st):
+        lane, st.lane = st.lane, None
+        if lane is None:
+            return
+        try:
+            lane.mark_dead()
+        except Exception:  # noqa: BLE001 — segment may be gone
+            pass
+        lane.close()
+
+    def _drop_conn(self, st, conns):
+        self._drop_lane(st)
+        try:
+            st.sock.close()
+        except OSError:
+            pass
+        try:
+            conns.remove(st)
+        except ValueError:
+            pass
+        try:
+            self._conns.remove(st.sock)
+        except ValueError:
             pass
 
-    def _exactly_once(self, cid, seq, inner):
+    def _exactly_once(self, cid, seq, inner, st=None):
         """Per-CLIENT single-slot dedup, keyed (client_id, seq) like
         the real server's window so a reconnect REPLAY — which arrives
         on a FRESH connection whose thread has no local state — still
@@ -1333,8 +1726,14 @@ class _MeshLeader:
                 if not self._cv.wait(timeout=self._fanin_s):
                     return ("err", "mesh leader: duplicate envelope "
                                    "parked past the fan-in budget")
+        rank = None
+        if isinstance(cid, (tuple, list)) and cid:
+            try:
+                rank = int(cid[0])
+            except (TypeError, ValueError):
+                rank = None
         try:
-            reply = ("ok", self._handle(inner))
+            reply = ("ok", self._handle(inner, st=st, rank=rank))
         except Exception as exc:  # noqa: BLE001
             reply = ("err", f"{type(exc).__name__}: {exc}")
         with self._cv:
@@ -1344,13 +1743,15 @@ class _MeshLeader:
             self._cv.notify_all()
         return reply
 
-    def _handle(self, inner):
+    def _handle(self, inner, st=None, rank=None):
         from . import profiler as _prof
         op = inner[0]
         if op == "mesh_push":  # protocol: replay(dedup-window) reply(none) codec(binary)
             _, seq, pairs = inner
             with self._cv:
                 self._pushes.setdefault(int(seq), []).append(pairs)
+                if rank is not None:
+                    self._push_ranks.setdefault(int(seq), set()).add(rank)
                 self._cv.notify_all()
             _prof.record_channel_event("kvstore.mesh_push")
             return None
@@ -1374,9 +1775,73 @@ class _MeshLeader:
                     self._handles.pop(seq, None)
             _prof.record_channel_event("kvstore.mesh_collect")
             return {k: vals[k] for k in keys}
+        if op == "shm_hello":  # protocol: replay(idempotent) reply(lane version | err)
+            # follower created a shared-memory lane and names its
+            # segment; attach and serve this connection's traffic off
+            # the ring from here on.  Idempotent: re-attaching the same
+            # segment (reconnect replay) just replaces the attachment.
+            from . import shmlane
+            _, name = inner[:2]
+            if st is None:
+                raise MXNetError(
+                    "mesh leader: shm_hello outside a connection")
+            lane = shmlane.ShmLane.attach(str(name))
+            self._drop_lane(st)
+            st.lane = lane
+            _prof.record_channel_event("kvstore.shm_attach")
+            return shmlane.VERSION
         if op == "command":  # protocol: replay(pure) reply(none)
             return None   # follower channel flush token
         raise MXNetError(f"mesh leader: unknown op {op!r}")
+
+
+class _MeshConnState:
+    """Per-connection state owned by exactly one acceptor-pool thread:
+    the socket, the (optional) attached shm lane serving it, and the
+    latched byte-kind for liveness pings."""
+
+    __slots__ = ("sock", "lane", "recv_kind")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.lane = None
+        self.recv_kind = "ici_recv"
+
+
+class _MeshAcceptor:
+    """One worker of the mesh leader's bounded serve pool.  The accept
+    thread hands connections over via ``inbox`` (a queue.Queue — the
+    put/get pair is the happens-before edge for the socket object);
+    ``poke()`` wakes the worker out of its select so adoption and
+    shutdown are prompt."""
+
+    def __init__(self, leader):
+        import queue
+        import socket
+        self.inbox = queue.Queue()
+        self.wake_r, self._wake_w = socket.socketpair()
+        self.wake_r.setblocking(False)
+        # analysis: allow(bare-thread): pool threads serve sockets the leader owns — close() closes those sockets and pokes the wake pipe, so a crashed worker surfaces as dropped connections and loud channel failures on every follower it served
+        self.thread = threading.Thread(target=leader._serve_pool,
+                                       args=(self,), daemon=True)
+        self.thread.start()
+
+    def adopt(self, conn):
+        self.inbox.put(conn)
+        self.poke()
+
+    def poke(self):
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    def close_wake(self):
+        for s in (self.wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class KVStoreDistAsync(KVStore):
@@ -1622,8 +2087,9 @@ class KVStoreDistAsync(KVStore):
         self._hier = True
         self._mesh_group = members
         if self.rank == leader:
-            self._mesh_leader = _MeshLeader(uris[gi],
-                                            n_followers=len(members) - 1)
+            self._mesh_leader = _MeshLeader(
+                uris[gi], n_followers=len(members) - 1,
+                follower_ranks=[r for r in members if r != leader])
         else:
             # window 1: the replay window is one envelope, which the
             # leader's one-slot dedup makes exactly-once (loopback
@@ -1631,6 +2097,11 @@ class KVStoreDistAsync(KVStore):
             self._mesh_conn = _ServerConn(
                 uris[gi], window=1, rank=self.rank,
                 byte_kinds=("ici_sent", "ici_recv"))
+            # same-host fast path: one memcpy into a shared-memory
+            # ring instead of a socket round-trip (MXNET_KVSTORE_SHM;
+            # falls back to TCP silently if the leader predates the
+            # lane or the segment can't be created)
+            self._mesh_conn.setup_shm_lane()
 
     def _mesh_reduce(self, pairs, contribs):
         """In-mesh sum of the leader's own gradients with every

@@ -275,8 +275,10 @@ def _send_vec(sock, parts) -> int:
     possible: vectored ``sendmsg`` chunked at IOV_MAX with a partial-
     send resume loop, or per-part ``sendall`` when the platform lacks
     sendmsg / MXNET_KVSTORE_SENDMSG=0.  Returns the syscall count."""
-    parts = [m for m in (memoryview(p).cast("B") for p in parts)
-             if m.nbytes]   # zero-length iovecs would stall the loop
+    # drop zero-length parts BEFORE casting (empty iovecs would stall
+    # the loop, and casting a 0-in-shape ndarray view raises)
+    parts = [m.cast("B") for m in (memoryview(p) for p in parts)
+             if m.nbytes]
     n = 0
     if not (_env("MXNET_KVSTORE_SENDMSG", 1)
             and hasattr(sock, "sendmsg")):
@@ -299,6 +301,57 @@ def _send_vec(sock, parts) -> int:
     return n
 
 
+def _frame_parts(obj, binary_ok):
+    """Encode ``obj`` into its on-wire frame as an ordered list of
+    bytes-likes plus counter meta ``(parts, frame_bytes, codec_bytes,
+    pickle_bytes)``.  Both transports carry the IDENTICAL bytes — the
+    socket path scatter-gathers the parts through ``sendmsg``
+    (:func:`_send_msg`), the same-host shm lane memcpys them into a
+    ring record (mxnet_tpu/shmlane.py) — so receivers self-
+    discriminate on the first byte either way (0xB1 = v2 binary frame,
+    0x00 = the legacy pickle frame's ``>Q`` high byte)."""
+    if binary_ok and _codec.is_hot(obj):
+        enc = _codec.encode_frame(obj)
+        if enc is not None:
+            head, bufs = enc
+            total = len(head) + sum(a.nbytes for a in bufs)
+            return [head] + list(bufs), total, len(head) - 13, 0
+    bufs = []
+    skel = pickle.dumps(_pack(obj, bufs),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    total = 4 + len(skel) + sum(a.nbytes for a in bufs)
+    # header as its own buffer — NOT `header + skel`, which would
+    # copy the whole skeleton to save one iovec
+    parts = [struct.pack(">QI", total, len(skel)), skel]
+    parts += bufs
+    return parts, 8 + total, 0, len(skel)
+
+
+def _frame_obj(data):
+    """Decode ONE complete frame from a contiguous buffer — the shm
+    ring pops whole records, so unlike :func:`_recv_msg` there is no
+    short-read loop, but the two formats and the restricted-pickle
+    trust boundary are identical."""
+    view = memoryview(data)
+    if view[0] == _codec.FRAME_MAGIC:
+        total, desc_len = struct.unpack(">QI", view[1:13])
+        desc = bytes(view[13:13 + desc_len])
+        body = bytes(view[13 + desc_len:13 + total - 4])
+        return _codec.decode_frame(desc, body)
+    total, skel_len = struct.unpack(">QI", view[:12])
+    skel = _restricted_loads(bytes(view[12:12 + skel_len]))
+    body = bytes(view[12 + skel_len:12 + total - 4])
+    refs = []
+    _collect_bufs(skel, refs)
+    if not refs:
+        return skel
+    offsets, off = {}, 0
+    for ref in sorted(refs, key=lambda r: r.i):
+        offsets[ref.i] = off
+        off += ref.nbytes
+    return _unpack(skel, body, offsets)
+
+
 def _send_msg(sock, obj, fi_role=None, byte_kind="sent"):
     """Zero-copy framed send: the registry-generated binary codec for
     hot messages on negotiated connections (wirecodec frame v2), the
@@ -310,10 +363,11 @@ def _send_msg(sock, obj, fi_role=None, byte_kind="sent"):
     targets.  ``byte_kind`` names the byte counter family the frame
     lands in: the default "sent" is the TCP data wire to the parameter
     servers; the hierarchical tier's in-host mesh channels count under
-    "ici_sent", and control-plane traffic (heartbeats, roster beats,
-    hellos) under "control" so bench.py reports gradients, mesh, and
-    control separately (profiler.wire_bytes_total / ici_bytes_total /
-    control_bytes_total)."""
+    "ici_sent" (or "shm_sent" when the same-host lane carries them),
+    and control-plane traffic (heartbeats, roster beats, hellos) under
+    "control" so bench.py reports gradients, mesh, and control
+    separately (profiler.wire_bytes_total / ici_bytes_total /
+    shm_bytes_total / control_bytes_total)."""
     if fi_role == "client":
         faultinject.client_send(sock)
     elif fi_role == "server":
@@ -322,28 +376,13 @@ def _send_msg(sock, obj, fi_role=None, byte_kind="sent"):
             # injected gray failure: the reply is swallowed, the
             # connection stays open — the caller believes it sent
             return
-    parts = None
-    if _codec.sock_binary(sock) and _codec.is_hot(obj):
-        enc = _codec.encode_frame(obj)
-        if enc is not None:
-            head, bufs = enc
-            _prof.record_serialization("codec_bytes", len(head) - 13)
-            _prof.record_channel_bytes(
-                byte_kind, len(head) + sum(a.nbytes for a in bufs))
-            parts = [head]
-            parts += bufs
-    if parts is None:
-        bufs = []
-        skel = pickle.dumps(_pack(obj, bufs),
-                            protocol=pickle.HIGHEST_PROTOCOL)
-        total = 4 + len(skel) + sum(a.nbytes for a in bufs)
-        if not _prof.is_control_byte_kind(byte_kind):
-            _prof.record_serialization("pickle_bytes", len(skel))
-        _prof.record_channel_bytes(byte_kind, 8 + total)
-        # header as its own buffer — NOT `header + skel`, which would
-        # copy the whole skeleton to save one iovec
-        parts = [struct.pack(">QI", total, len(skel)), skel]
-        parts += bufs
+    parts, frame_bytes, codec_bytes, pickle_bytes = _frame_parts(
+        obj, _codec.sock_binary(sock))
+    if codec_bytes:
+        _prof.record_serialization("codec_bytes", codec_bytes)
+    if pickle_bytes and not _prof.is_control_byte_kind(byte_kind):
+        _prof.record_serialization("pickle_bytes", pickle_bytes)
+    _prof.record_channel_bytes(byte_kind, frame_bytes)
     _prof.record_serialization("send_syscalls", _send_vec(sock, parts))
     if fi_role == "client":
         faultinject.client_sent(sock)

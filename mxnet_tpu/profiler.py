@@ -354,21 +354,53 @@ def is_control_byte_kind(kind: str) -> bool:
             or kind.startswith(ICI_BYTE_PREFIX + CONTROL_BYTE_PREFIX))
 
 
+# Same-host shared-memory lane traffic (mxnet_tpu/shmlane.py) counts
+# under "shm_sent"/"shm_recv" — a fourth family next to the socket mesh
+# kinds, because the lane's whole point is that these bytes never cross
+# a socket: when MXNET_KVSTORE_SHM is on, follower<->leader payload
+# moves from ici_* to shm_* and the socket's ici_* drops to control
+# traffic (hellos, heartbeats).  bench.py banks shm_bytes_per_step so
+# the shift is a regression-gateable number.
+SHM_BYTE_PREFIX = "shm_"
+
+
 def ici_bytes_total() -> int:
-    """Total in-mesh (hierarchy-tier) bytes moved so far."""
+    """Total in-mesh (hierarchy-tier) bytes moved over SOCKETS so far;
+    the shm lane's share counts under shm_bytes_total."""
     with _channel_lock:
         return sum(v for k, v in _channel_bytes.items()
                    if k.startswith(ICI_BYTE_PREFIX))
 
 
+def ici_payload_bytes_total() -> int:
+    """The mesh sockets' DATA share: ici_* minus ici_control* — with
+    the shm lane active this is ≈0 (payload rides the ring), which is
+    exactly what the CI shm gate pins."""
+    with _channel_lock:
+        return sum(v for k, v in _channel_bytes.items()
+                   if k.startswith(ICI_BYTE_PREFIX)
+                   and not k.startswith(ICI_BYTE_PREFIX
+                                        + CONTROL_BYTE_PREFIX))
+
+
+def shm_bytes_total() -> int:
+    """Total same-host shared-memory lane bytes moved so far (both
+    directions; zero socket syscalls behind any of them)."""
+    with _channel_lock:
+        return sum(v for k, v in _channel_bytes.items()
+                   if k.startswith(SHM_BYTE_PREFIX))
+
+
 def wire_bytes_total() -> int:
     """Total non-mesh DATA bytes (TCP wire + host collectives);
     control-plane traffic is excluded so the banked per-step number
-    measures gradients, not heartbeat cadence."""
+    measures gradients, not heartbeat cadence — and the in-host
+    families (ici_*, shm_*) are excluded so it measures the WIRE."""
     with _channel_lock:
         return sum(v for k, v in _channel_bytes.items()
                    if not k.startswith(ICI_BYTE_PREFIX)
-                   and not k.startswith(CONTROL_BYTE_PREFIX))
+                   and not k.startswith(CONTROL_BYTE_PREFIX)
+                   and not k.startswith(SHM_BYTE_PREFIX))
 
 
 def control_bytes_total() -> int:
@@ -512,6 +544,45 @@ def reset_wire_counters():
         _wire["wait_s"] = 0.0
         _wire["round_s"] = 0.0
         _wire["rounds"] = 0
+
+
+# -- mesh fan-in clock --------------------------------------------------------
+# Host time the hierarchy-tier LEADER spends blocked in collect_push
+# waiting for every follower's round to arrive — the serialization the
+# parallel acceptor pool + shm lane exist to shrink.  bench.py banks
+# mesh_fanin_ms_per_step next to shm_bytes_per_step so the acceptors ×
+# shm A/B (docs/PERF_NOTES.md round 13) is a regression-gateable number.
+_fanin_lock = threading.Lock()
+_fanin = {"wait_s": 0.0, "rounds": 0}
+
+
+def record_mesh_fanin_wait(dur_s: float):
+    """Add one collect_push round's blocked seconds (chrome-trace event
+    "wire" category when the profiler runs, like the wire clocks)."""
+    with _fanin_lock:
+        _fanin["wait_s"] += float(dur_s)
+        _fanin["rounds"] += 1
+    if _profiler.state == PROFILER_STATE_RUN:
+        dur_us = float(dur_s) * 1e6
+        _profiler.record("kvstore.mesh_fanin",
+                         time.perf_counter_ns() // 1000 - int(dur_us),
+                         dur_us, "wire")
+
+
+def mesh_fanin_wait_ms() -> float:
+    with _fanin_lock:
+        return _fanin["wait_s"] * 1e3
+
+
+def mesh_fanin_rounds() -> int:
+    with _fanin_lock:
+        return _fanin["rounds"]
+
+
+def reset_mesh_fanin():
+    with _fanin_lock:
+        _fanin["wait_s"] = 0.0
+        _fanin["rounds"] = 0
 
 
 # -- serving latency / QPS counters ------------------------------------------

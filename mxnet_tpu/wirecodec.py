@@ -412,3 +412,28 @@ def decode_frame(desc, body):
         raise ValueError("wirecodec: %d trailing body byte(s)"
                          % (len(body) - r.body_off))
     return obj
+
+
+def frame_len(prefix) -> int:
+    """Frame-in-ring framing arithmetic: the COMPLETE byte length of
+    the frame whose first 13 bytes begin ``prefix``, for either format.
+    A v2 binary frame occupies 13 header bytes (magic + ``>QI``) plus
+    ``total - 4`` descriptor/body bytes = ``9 + total``; a legacy
+    pickle frame 12 header bytes plus ``total - 4`` = ``8 + total``.
+    The same-host shm lane stores ONE frame per length-prefixed ring
+    record, and both ends cross-check the record length against this
+    before decoding — shared memory has no short reads, so a mismatch
+    means ring corruption and kills the lane (TCP fallback), never a
+    partial frame."""
+    view = memoryview(prefix)
+    if view.nbytes < 13:
+        raise ValueError("wirecodec: frame prefix shorter than 13 bytes")
+    if view[0] == FRAME_MAGIC:
+        total, desc_len = struct.unpack(">QI", view[1:13])
+        if desc_len + 4 > total:
+            raise ValueError("wirecodec: descriptor overruns frame")
+        return 9 + int(total)
+    total, skel_len = struct.unpack(">QI", view[0:12])
+    if skel_len + 4 > total:
+        raise ValueError("wirecodec: skeleton overruns frame")
+    return 8 + int(total)

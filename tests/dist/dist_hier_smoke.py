@@ -18,9 +18,15 @@ The byte half of the gate: rank 0 reads the server's own ("stats",)
 byte counters around each phase — the hierarchy phase's wire traffic
 must sit at <= 60% of the flat phase's (the >= 40% acceptance drop;
 the structural number is ~50% for 2 workers/host) — and the follower
-asserts its own push bytes moved from the "sent" family to "ici_sent"
-(profiler.ici_bytes_total, the counters behind bench.py's
-ici_bytes_per_step).
+asserts its own push bytes moved from the "sent" family onto the mesh
+channel: the "ici_*" family when the channel rides loopback TCP
+(MXNET_KVSTORE_SHM=0), the "shm_*" family when the same-host
+shared-memory lane carries it (the ISSUE 18 acceptance — payload off
+the sockets entirely, socket ici down to handshake residue).  With
+MXNET_FI_SHM_WEDGE_AFTER armed the leader stops draining the ring
+mid-run: the run must still complete every step bit-identical, with
+the follower recording a kvstore.shm_fallback event (lane death ->
+reconnect -> TCP replay, exactly-once).
 """
 import os
 import sys
@@ -122,13 +128,17 @@ def main():
     kv.barrier()
     b1 = server_wire_bytes(kv)
 
-    # -- phase 2: hierarchical — leader ships, follower rides ICI -----
+    # -- phase 2: hierarchical — leader ships, follower rides the mesh
     ici0 = profiler.ici_bytes_total()
+    ici_pay0 = profiler.ici_payload_bytes_total()
+    shm0 = profiler.shm_bytes_total()
     sent0 = profiler.channel_bytes().get("sent", 0)
     mod_h.run_steps(data, k=K)
     kv.barrier()
     b2 = server_wire_bytes(kv)
     ici_d = profiler.ici_bytes_total() - ici0
+    ici_pay_d = profiler.ici_payload_bytes_total() - ici_pay0
+    shm_d = profiler.shm_bytes_total() - shm0
     sent_d = profiler.channel_bytes().get("sent", 0) - sent0
 
     # -- bit-identity: BOTH modes == the one analytic golden ----------
@@ -147,11 +157,34 @@ def main():
         (f"hierarchical wire bytes {hier_bytes} not under 60% of the "
          f"flat baseline {flat_bytes} (acceptance: >= 40% drop)")
     payload = NH * NIN * 4
+    from mxnet_tpu import shmlane
+    mesh_host = os.environ.get("MXT_MESH_URIS", "").split(",")[0] \
+                                                 .rsplit(":", 1)[0]
+    lane_on = shmlane.client_enabled(mesh_host)
+    wedged = bool(os.environ.get("MXNET_FI_SHM_WEDGE_AFTER"))
     if rank == 0:
-        assert ici_d > 0, "leader served no in-mesh traffic"
+        assert ici_d + shm_d > 0, "leader served no in-mesh traffic"
+        if lane_on and not wedged:
+            assert shm_d > 0, "lane armed but no bytes rode the ring"
+    elif wedged:
+        # the leader wedged the drain mid-run: the follower must have
+        # noticed (stall watchdog -> lane dead -> TCP replay) and still
+        # completed every step — bit-identity above is the real gate
+        fb = profiler.channel_counts().get("kvstore.shm_fallback", 0)
+        assert fb >= 1, "wedged drain but no shm_fallback recorded"
+        assert sent_d < K * payload, (sent_d, K * payload)
+    elif lane_on:
+        # the follower's gradient frames ride the RING: payload lands
+        # 100% in the shm_ family, the sockets keep only handshake
+        # residue (hello/shm_hello — under one tensor's worth)
+        assert shm_d > K * payload, (shm_d, K * payload)
+        assert ici_pay_d < payload, \
+            (f"follower payload leaked onto the socket: {ici_pay_d}b "
+             f"ici payload with the shm lane armed")
+        assert sent_d < K * payload, (sent_d, K * payload)
     else:
-        # the follower's gradients now ride the mesh, not the wire:
-        # K pushes + K/CHUNK collects of a 32 KiB tensor each
+        # pure-TCP mesh: K pushes + K/CHUNK collects of a 32 KiB
+        # tensor each ride the ici_ socket family
         assert ici_d > K * payload, (ici_d, K * payload)
         assert sent_d < K * payload, \
             (f"follower still pushed over the wire: sent {sent_d}b in "
@@ -161,8 +194,10 @@ def main():
     for m in (mod_h, mod_f):
         m._kvstore.close()
     print("dist_hier_smoke rank %d/%d OK (golden exact; wire %db -> "
-          "%db, ici %db)" % (rank, NWORKER, flat_bytes, hier_bytes,
-                             ici_d), flush=True)
+          "%db, ici %db, shm %db%s)"
+          % (rank, NWORKER, flat_bytes, hier_bytes, ici_d, shm_d,
+             ", wedge->tcp fallback" if wedged and rank else ""),
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -485,3 +485,82 @@ def test_hb_mesh_fanin_race_clean(monkeypatch):
         finally:
             srv.stop()
     _assert_clean(san)
+
+
+def test_hb_mesh_acceptor_pool_race_clean(monkeypatch):
+    """The PARALLEL fan-in under the STRICT shim: three ranks share a
+    two-thread acceptor pool (pool < connection count, so one worker
+    thread multiplexes several followers' sockets AND their shm lanes)
+    while both followers deposit concurrently through the rings —
+    race-clean, bit-identical to the analytic sequential result."""
+    import socket as _socket
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    SHAPE, STEPS, LR = (6, 8), 3, 0.25
+
+    def grad(rank, step):
+        rs = np.random.RandomState(100 * rank + step)
+        return rs.randint(-2, 3, SHAPE).astype(np.float32)
+
+    monkeypatch.setenv("DMLC_NUM_WORKER", "3")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("MXNET_KVSTORE_HIERARCHY", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_WORKERS_PER_HOST", "3")
+    monkeypatch.setenv("MXNET_KVSTORE_MESH_ACCEPTORS", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_SHM", "1")
+    monkeypatch.setenv("MXT_MESH_URIS", f"127.0.0.1:{free_port()}")
+    w0 = np.arange(np.prod(SHAPE), dtype=np.float32).reshape(SHAPE)
+    results, errors = {}, []
+    with hb.shim(strict=True) as san:
+        srv = KVStoreServer(server_id=0, num_workers=3)
+        srv.start_background()
+        monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+
+        def worker(rank, kv):
+            try:
+                kv.init("w", mx.nd.NDArray(w0))
+                kv.set_optimizer(mx.optimizer.SGD(
+                    learning_rate=LR, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0))
+                kv.barrier()
+                out = mx.nd.zeros(SHAPE)
+                for s in range(STEPS):
+                    kv.push("w", mx.nd.NDArray(grad(rank, s)))
+                    kv.pull("w", out=out)
+                kv.barrier()
+                kv.pull("w", out=out)
+                results[rank] = out.asnumpy().copy()
+            except BaseException as exc:  # noqa: BLE001 — to main
+                errors.append((rank, exc))
+
+        try:
+            kv0 = KVStoreDistAsync(rank=0)   # leader binds the mesh
+            kvs = [kv0] + [KVStoreDistAsync(rank=r) for r in (1, 2)]
+            assert kv0._mesh_leader._acceptors == 2
+            threads = [threading.Thread(target=worker, args=(r, kv))
+                       for r, kv in enumerate(kvs)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            assert all(not t.is_alive() for t in threads), "worker hung"
+            expected = w0.copy()
+            for s in range(STEPS):
+                expected = expected - np.float32(LR) * (
+                    grad(0, s) + grad(1, s) + grad(2, s))
+            for r in range(3):
+                np.testing.assert_array_equal(results[r], expected)
+            assert prof.shm_bytes_total() > 0
+            for kv in kvs[1:]:
+                kv.close()
+            kv0.close(stop_servers=True)
+        finally:
+            srv.stop()
+    _assert_clean(san)

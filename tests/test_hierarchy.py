@@ -88,7 +88,10 @@ def _grad(rank, step):
 def _run_pair(monkeypatch, hier):
     """Two worker stores (ranks 0/1) against one fresh server; returns
     (final pulled weight, wire sent bytes, ici sent bytes) measured
-    over the training rounds only."""
+    over the training rounds only.  Pins MXNET_KVSTORE_SHM=0: this
+    harness is the pure-TCP baseline the byte assertions (and the CI
+    gate's send_syscalls_per_step comparison) are anchored to — the
+    shm lane has its own tests below."""
     srv = KVStoreServer(server_id=0, num_workers=2)
     srv.start_background()
     monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
@@ -96,6 +99,7 @@ def _run_pair(monkeypatch, hier):
     monkeypatch.setenv("DMLC_WORKER_ID", "0")
     monkeypatch.setenv("MXNET_KVSTORE_HIERARCHY", "1" if hier else "0")
     monkeypatch.setenv("MXNET_KVSTORE_WORKERS_PER_HOST", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_SHM", "0")
     monkeypatch.setenv("MXT_MESH_URIS", f"127.0.0.1:{_free_port()}")
     w0 = np.arange(np.prod(SHAPE), dtype=np.float32).reshape(SHAPE)
     results, errors = {}, []
@@ -176,6 +180,148 @@ def test_hierarchy_refuses_elastic(monkeypatch):
             KVStoreDistAsync()
     finally:
         srvs[0].stop()
+
+
+# ---------------------------------------------------------------------------
+# shared-memory lane: 4 followers fan in over rings, bit-identical,
+# payload off the sockets; a wedged drain falls back to TCP cleanly
+# ---------------------------------------------------------------------------
+def _run_group(monkeypatch, n_ranks, steps=3):
+    """One host group of ``n_ranks`` workers (leader + followers, all
+    in-process via the rank override) against one real server, shm lane
+    ON.  Returns (per-rank final weights, shm bytes, socket ici payload
+    bytes, socket send syscalls) measured over the training rounds."""
+    srv = KVStoreServer(server_id=0, num_workers=n_ranks)
+    srv.start_background()
+    monkeypatch.setenv("MXT_SERVER_URIS", f"127.0.0.1:{srv.port}")
+    monkeypatch.setenv("DMLC_NUM_WORKER", str(n_ranks))
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("MXNET_KVSTORE_HIERARCHY", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_WORKERS_PER_HOST", str(n_ranks))
+    monkeypatch.setenv("MXNET_KVSTORE_SHM", "1")
+    monkeypatch.setenv("MXT_MESH_URIS", f"127.0.0.1:{_free_port()}")
+    w0 = np.arange(np.prod(SHAPE), dtype=np.float32).reshape(SHAPE)
+    results, errors, marks = {}, [], {}
+
+    def worker(rank, kv):
+        try:
+            kv.init("w", mx.nd.NDArray(w0))
+            kv.set_optimizer(mx.optimizer.SGD(
+                learning_rate=LR, momentum=0.0, wd=0.0, rescale_grad=1.0))
+            kv.barrier()
+            if rank == 0:
+                prof.reset_channel_bytes()
+                prof.reset_serialization()
+            kv.barrier()
+            out = mx.nd.zeros(SHAPE)
+            for s in range(steps):
+                kv.push("w", mx.nd.NDArray(_grad(rank, s)))
+                kv.pull("w", out=out)
+            kv.barrier()
+            if rank == 0:
+                marks["shm"] = prof.shm_bytes_total()
+                marks["ici_payload"] = prof.ici_payload_bytes_total()
+                marks["syscalls"] = prof.send_syscalls_total()
+            kv.barrier()
+            kv.pull("w", out=out)
+            results[rank] = out.asnumpy().copy()
+        except BaseException as exc:  # noqa: BLE001 — surface in main
+            errors.append((rank, exc))
+
+    try:
+        kvs = [KVStoreDistAsync(rank=0)]   # leader binds the mesh first
+        kvs += [KVStoreDistAsync(rank=r) for r in range(1, n_ranks)]
+        threads = [threading.Thread(target=worker, args=(r, kv))
+                   for r, kv in enumerate(kvs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert not errors, errors
+        assert all(not t.is_alive() for t in threads), "worker hung"
+        for kv in kvs[1:]:
+            kv.close()
+        kvs[0].close(stop_servers=True)
+        return (results, marks["shm"], marks["ici_payload"],
+                marks["syscalls"])
+    finally:
+        srv.stop()
+
+
+def _golden(n_ranks, steps=3):
+    want = np.arange(np.prod(SHAPE), dtype=np.float32).reshape(SHAPE)
+    for r in range(n_ranks):
+        for s in range(steps):
+            want = want - np.float32(LR) * _grad(r, s)
+    return want
+
+
+def test_mesh_shm_four_followers_bit_identical(monkeypatch):
+    """THE tentpole gate, in-process: 5 workers per host (1 leader + 4
+    followers), shm lane on.  Concurrent follower deposits through the
+    acceptor pool land bit-identical to the analytic sequential
+    result; follower payload bytes ride the shm_ family; the sockets
+    carry (close to) control traffic only."""
+    results, shm, ici_payload, _ = _run_group(monkeypatch, n_ranks=5)
+    want = _golden(5)
+    for r in range(5):
+        np.testing.assert_array_equal(results[r], want)
+    assert shm > 0, "no bytes rode the shm lane"
+    # steady-state: every mesh frame (pushes, collects, flush tokens)
+    # is in the ring — socket ici payload over the rounds is at most
+    # handshake residue, far below one gradient (6*8*4 = 192B each)
+    assert ici_payload < shm / 4, (ici_payload, shm)
+
+
+def test_mesh_shm_wedge_falls_back_bit_identical(monkeypatch):
+    """MXNET_FI_SHM_WEDGE_AFTER: the leader stops draining the ring
+    mid-run; the follower's stall watchdog must mark the lane dead and
+    fail over to TCP — replaying its window, exactly-once — with zero
+    failed steps and the same bits as a clean run."""
+    from mxnet_tpu import faultinject
+    monkeypatch.setenv("MXNET_KVSTORE_SHM_STALL_S", "0.5")
+    faultinject.reset()
+    try:
+        with faultinject.shm_wedge_after_frames(3):
+            results, _, _, _ = _run_group(monkeypatch, n_ranks=3)
+            st = faultinject.stats()
+        want = _golden(3)
+        for r in range(3):
+            np.testing.assert_array_equal(results[r], want)
+        assert st["shm_frames_wedged"] > 0, st
+        assert prof.channel_counts().get("kvstore.shm_fallback", 0) >= 1
+    finally:
+        faultinject.reset()
+
+
+def test_mesh_fanin_timeout_names_missing_ranks(monkeypatch):
+    """A fan-in timeout must say WHICH followers never deposited and
+    how stale they are — 'incomplete (1 of 2)' alone is undebuggable
+    at 3am (satellite: named barrier errors + flight-recorder note)."""
+    from mxnet_tpu import health as _health
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore import _MeshLeader, _ServerConn, _await
+    monkeypatch.setenv("MXNET_KVSTORE_MESH_FANIN_S", "0.4")
+    monkeypatch.setenv("MXNET_KVSTORE_SHM", "0")
+    leader = _MeshLeader("127.0.0.1:0", n_followers=2,
+                         follower_ranks=[1, 2])
+    port = leader._listener.getsockname()[1]
+    conn = _ServerConn(f"127.0.0.1:{port}", window=1, rank=1,
+                       byte_kinds=("ici_sent", "ici_recv"))
+    try:
+        _await(conn.request(
+            ("mesh_push", 0, [("w", np.ones(2, np.float32))])))
+        with pytest.raises(MXNetError) as ei:
+            leader.collect_push(0)
+        msg = str(ei.value)
+        assert "rank 2" in msg and "never heard from" in msg, msg
+        assert "rank 1" not in msg.split("missing")[1], msg
+        notes = [e for e in _health.events()
+                 if e.get("kind") == "mesh.fanin_timeout"]
+        assert notes and notes[-1]["missing"] == [2], notes
+    finally:
+        conn.close()
+        leader.close()
 
 
 # ---------------------------------------------------------------------------

@@ -218,6 +218,27 @@ def test_codec_round_trip_fuzz_matches_pickle_path():
         _assert_identical(_via_codec(reply), _via_pickle(reply))
 
 
+def test_frame_len_matches_every_emitted_frame():
+    """`frame_len` (the shm ring's per-record cross-check) must name
+    the EXACT byte length of whatever _send_msg emits — binary v2 and
+    pickle framings alike — from the first 13 bytes alone."""
+    rng = np.random.default_rng(0xF7A3E)
+    for trial in range(40):
+        arr = np.asarray(rng.random((int(rng.integers(0, 5)),
+                                     int(rng.integers(1, 5)))),
+                         dtype=[np.float32, np.float16][trial % 2])
+        msg = ("req", (0, "n%d" % trial), trial,
+               ("mesh_push", trial, [("w", arr)]))
+        for version in (1, 0):     # negotiated binary / pickle pin
+            sock = _RecordingVecSock()
+            wc.register(sock, version)
+            _send_msg(sock, msg)
+            frame = b"".join(sock.parts)
+            assert wc.frame_len(frame[:13]) == len(frame), \
+                (version, trial)
+        assert sock.parts[0][0] != wc.FRAME_MAGIC   # v0 stayed pickle
+
+
 def test_codec_falls_back_to_pickle_outside_vocabulary():
     class Custom:
         pass

@@ -82,6 +82,11 @@ def _worker_env(args, coord_uri, port, wid):
         env.setdefault("MXNET_KVSTORE_HIERARCHY", "1")
         env.setdefault("MXNET_KVSTORE_WORKERS_PER_HOST",
                        str(args.workers_per_host))
+    if getattr(args, "shm", None):
+        # same-host follower->leader lane (mxnet_tpu/shmlane.py);
+        # the knob also rides --env / the parent environment — this
+        # flag just spells the common toggle
+        env["MXNET_KVSTORE_SHM"] = args.shm
     return env
 
 
@@ -232,6 +237,13 @@ def main():
                          "gradients over the wire; allocates one mesh "
                          "endpoint (MXT_MESH_URIS) per group.  0 = "
                          "flat dist_async")
+    ap.add_argument("--shm", choices=("auto", "on", "off"), default=None,
+                    help="same-host shared-memory lane for the mesh "
+                         "tier's follower->leader traffic "
+                         "(MXNET_KVSTORE_SHM): auto (default) uses it "
+                         "when the mesh endpoint is local, falling "
+                         "back to loopback TCP otherwise; unset "
+                         "leaves the workers' environment alone")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic membership (MXNET_KVSTORE_ELASTIC): a "
                          "parameter server exiting — even killed, even "
