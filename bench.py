@@ -202,6 +202,110 @@ def main():
             raise
 
 
+def _run_sparse(dev):
+    """BENCH_SPARSE=1: row-sparse kvstore wire bench — an embedding
+    table push loop at BENCH_SPARSE_DENSITY touch density through the
+    dist_async store, sparse wire vs the dense baseline on the SAME
+    rounds.  Banks sparse_rows_per_step next to wire_bytes_per_step
+    (the regression gate: wire_bytes_per_step ~ density x dense at low
+    density, rows x (8 + 4*dim) + frame overhead).  Self-contained:
+    spins up in-process servers when MXT_SERVER_URIS is unset, so a
+    smoke run needs no launcher."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler as _mx_prof
+    from mxnet_tpu.ndarray import sparse as _sp
+
+    vocab = int(os.environ.get("BENCH_SPARSE_VOCAB", "65536"))
+    dim = int(os.environ.get("BENCH_SPARSE_DIM", "64"))
+    density = float(os.environ.get("BENCH_SPARSE_DENSITY", "0.01"))
+    iters = int(os.environ.get("BENCH_SPARSE_ITERS", "20"))
+    touch = max(1, int(vocab * density))
+
+    own_servers = []
+    if not os.environ.get("MXT_SERVER_URIS"):
+        from mxnet_tpu.kvstore_server import KVStoreServer
+        n = int(os.environ.get("BENCH_SPARSE_SERVERS", "2"))
+        own_servers = [KVStoreServer(server_id=i, num_workers=1)
+                       for i in range(n)]
+        for s in own_servers:
+            s.start_background()
+        os.environ["MXT_SERVER_URIS"] = ",".join(
+            "127.0.0.1:%d" % s.port for s in own_servers)
+        os.environ.setdefault("DMLC_NUM_WORKER", "1")
+        os.environ.setdefault("DMLC_WORKER_ID", "0")
+        # stripe the table across the in-process roster
+        os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND",
+                              str(max(dim, vocab * dim // (2 * n))))
+    _mark("sparse bench: %dx%d table, %d rows/step, %d iters"
+          % (vocab, dim, touch, iters))
+
+    rng = np.random.RandomState(0)
+    rounds = []
+    for _ in range(iters):
+        ids = np.sort(rng.choice(vocab, size=touch,
+                                 replace=False)).astype(np.int64)
+        rounds.append((ids, rng.randn(touch, dim).astype(np.float32)))
+
+    def one_pass(sparse_wire):
+        os.environ["MXNET_KVSTORE_SPARSE"] = "1" if sparse_wire else "0"
+        kv = mx.kv.create("dist_async")
+        kv.init("emb", mx.nd.zeros((vocab, dim)))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.1, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        kv._flush_all()
+        b0 = _mx_prof.wire_bytes_total()
+        r0 = _mx_prof.channel_counts().get("kvstore.sparse_rows", 0)
+        t0 = time.perf_counter()
+        for ids, vals in rounds:
+            kv.push("emb", _sp.row_sparse_array((vals, ids),
+                                                shape=(vocab, dim)))
+        kv._flush_all()          # every push acked: bytes are banked
+        dt = time.perf_counter() - t0
+        wire = _mx_prof.wire_bytes_total() - b0
+        rows = _mx_prof.channel_counts().get("kvstore.sparse_rows",
+                                             0) - r0
+        kv.close(stop_servers=False)
+        return wire, rows, dt
+
+    try:
+        dense_wire, _, dense_dt = one_pass(sparse_wire=False)
+        wire, rows, dt = one_pass(sparse_wire=True)
+    finally:
+        for s in own_servers:
+            s.stop()
+
+    out = {
+        "metric": "sparse_embed_push_rows_per_sec",
+        "value": round(rows / dt, 1) if dt else None,
+        "unit": "rows/sec",
+        "device": dev.device_kind,
+        "vocab": vocab,
+        "dim": dim,
+        "density": density,
+        "iters": iters,
+        "step_ms": round(dt / iters * 1e3, 2),
+        "sparse_rows_per_step": round(rows / iters, 1),
+        "wire_bytes_per_step": round(wire / iters, 1),
+        # the dense equivalent IS the baseline: same rounds, sparse
+        # wire off (worker densifies before push)
+        "dense_wire_bytes_per_step": round(dense_wire / iters, 1),
+        "dense_step_ms": round(dense_dt / iters * 1e3, 2),
+        "wire_reduction_x": (round(dense_wire / wire, 1)
+                             if wire else None),
+    }
+    from benchmark._bench_common import is_cpu_device
+    if out.get("device") and not is_cpu_device(out["device"]):
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_LOG.jsonl"), "a") as f:
+                f.write(json.dumps(dict(out, ts=time.time())) + "\n")
+        except OSError:
+            pass
+    print(json.dumps(out))
+    return 0
+
+
 def _run(batch):
     # initialize the backend explicitly, with a deadline per attempt and
     # a clear diagnostic (guarded_backend_init: the single-client tunnel
@@ -226,6 +330,10 @@ def _run(batch):
     # a lost tunnel RPC blocks forever with zero CPU — self-bound the run
     # so a parseable error line still lands (BENCH_STALL_DEADLINE_S)
     start_stall_watchdog(_mark, _with_last_good(_ERR_BASE))
+    if os.environ.get("BENCH_SPARSE", "0") == "1":
+        # row-sparse kvstore wire mode: no model, the table IS the
+        # workload (two-tower scenario's wire cost, isolated)
+        return _run_sparse(dev)
     import jax  # deliberately AFTER the guard: refusals never load PJRT
     import jax.numpy as jnp
     # topology known only now (device kind + process count): resolve the
@@ -434,6 +542,7 @@ def _run(batch):
     syscalls0 = _mx_prof.send_syscalls_total()
     shm0 = _mx_prof.shm_bytes_total()
     fanin_ms0 = _mx_prof.mesh_fanin_wait_ms()
+    srows0 = _mx_prof.channel_counts().get("kvstore.sparse_rows", 0)
     t0 = time.perf_counter()
     for i in range(iters):
         step(i)
@@ -448,6 +557,8 @@ def _run(batch):
     send_syscalls = _mx_prof.send_syscalls_total() - syscalls0
     shm_bytes = _mx_prof.shm_bytes_total() - shm0
     fanin_ms = _mx_prof.mesh_fanin_wait_ms() - fanin_ms0
+    sparse_rows = _mx_prof.channel_counts().get(
+        "kvstore.sparse_rows", 0) - srows0
     # overlap over THIS timed region only (wait/round deltas), so
     # warmup and earlier configs can't dilute the reported fraction
     wire_wait_d = _mx_prof.wire_wait_ms() - wait0
@@ -481,6 +592,13 @@ def _run(batch):
         "steps_per_call": steps_per_call,
         "wire_bytes_per_step": round(
             wire_bytes / iters / steps_per_call, 1),
+        # row-sparse wire rows per TRAINING step (ISSUE 19): 0 for the
+        # dense resnet grads; nonzero means some param rode the sparse
+        # path — next to wire_bytes_per_step so a density regression
+        # (sparse rows up, bytes up) is one-row-visible.  BENCH_SPARSE=1
+        # runs the dedicated embedding-table wire bench instead.
+        "sparse_rows_per_step": round(
+            sparse_rows / iters / steps_per_call, 1),
         # in-host mesh bytes of the hierarchical kvstore tier
         # (MXNET_KVSTORE_HIERARCHY): the bytes the tier moved OFF the
         # wire and onto ICI — 0 when the tier is off.  Its companion

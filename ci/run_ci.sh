@@ -228,6 +228,35 @@ print("postmortem OK (MXNET_TRACE=0): coordinator %s-%s died during %s;"
                           ", ".join(d["named_by"])))
 PY
 
+echo "== row-sparse wire smoke (1% density <= 5% of dense bytes, bit-identical)"
+# ISSUE 19's wire gate under the real launcher: two workers push the
+# same dyadic row-sparse gradients twice against two striped servers —
+# densified (the baseline) and as RowSparsePayload frames.  Both tables
+# must EQUAL the analytic golden bit-for-bit while the sparse pass
+# moves <= 5% of the dense pass's bytes.  Time-boxed: a sparse-wire
+# regression presents as a broken inequality or a diverged table.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py -n 2 -s 2 \
+    python tests/dist/dist_sparse_embed.py
+
+echo "== row-sparse restripe smoke (SIGKILL a server mid-job, exact row ranges)"
+# The elastic machinery under SPARSE traffic: server 1 is REALLY
+# SIGKILLed at a beat boundary mid-push-stream (beat-seq kill: ack
+# arithmetic is density-dependent for sparse frames, the beat loop is
+# not), taking its row range with it.  The roster must evict it,
+# re-derive the row-range striping and finish WITHOUT RESTART with the
+# bit-identical table — a mis-moved row range or a lost sparse push
+# breaks equality.  Time-boxed: a restripe regression presents as a
+# hang in the repair.
+JAX_PLATFORMS=cpu timeout -k 10 240 \
+    python tools/launch.py --elastic -n 2 -s 2 \
+    --env MXNET_KVSTORE_HEARTBEAT_INTERVAL=0.5 \
+    --env MXNET_KVSTORE_HEARTBEAT_TIMEOUT=2.0 \
+    --env MXNET_FI_KILL_ON_BEAT_SEQ=4 \
+    --env MXNET_FI_ONLY_SERVER=1 \
+    --env MXT_SPARSE_KILL=1 \
+    python tests/dist/dist_sparse_embed.py
+
 echo "== fused-dist smoke (K-step scan over the dist_async wire, overlapped)"
 # The two headline wins finally compose (ISSUE 10 / PERF_NOTES round 10):
 # run_steps on update-on-kvstore drives the chunked scanned driver — one

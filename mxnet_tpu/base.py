@@ -401,6 +401,23 @@ declare_env("MXNET_KVSTORE_SHM_STALL_S", float, 5.0,
             "marks it dead and fails over to TCP via the channel's "
             "ordinary reconnect-and-replay (exactly-once via the "
             "leader's dedup window)")
+declare_env("MXNET_KVSTORE_SPARSE", bool, True,
+            "dist_async: ship row-sparse gradients (RowSparseNDArray "
+            "pushes, e.g. embedding tables under sparse_grad) as "
+            "RowSparsePayload wire values — only the touched rows plus "
+            "8 bytes per row id travel, cutting push bytes by roughly "
+            "the touch density (docs/PERF_NOTES.md round 14); 0 "
+            "densifies at the push boundary (the pre-PR-19 wire "
+            "format, every byte dense)",
+            tune={"choices": [0, 1]})
+declare_env("MXNET_KVSTORE_SPARSE_DENSITY_CUTOVER", float, 0.5,
+            "dist_async sparse wire: touch-density threshold above "
+            "which a row-sparse push goes DENSE instead — past ~50% "
+            "touched rows the 8-bytes-per-id index overhead plus the "
+            "gather outweighs the skipped rows, and the dense path's "
+            "2-bit quantization packs tighter per element; 1.0 keeps "
+            "every sparse push sparse, 0.0 densifies all",
+            tune={"min": 0.05, "max": 1.0, "log": True})
 # -- serving tier (mxnet_tpu.serving) ---------------------------------------
 declare_env("MXNET_SERVING_BUCKETS", str, "1,2,4,8,16,32",
             "serving: comma-separated batch-size buckets the replica "

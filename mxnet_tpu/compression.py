@@ -52,6 +52,78 @@ class WirePayload:
                 (self.kind, self.shape, self.threshold, self.data))
 
 
+class RowSparsePayload:
+    """A row-sparse value on the wire: (row indices i64, logical row
+    count of the destination table, values block).
+
+    ``indices`` is a 1-D sorted strictly-increasing int64 array of the
+    touched row ids; ``data`` is either the raw fp row block (one row
+    per index, ``data.shape[0] == indices.size``) or a
+    :class:`WirePayload` compressing that block.  ``nrows`` pins the
+    destination's logical row count so the receiver can range-check the
+    ids before touching its table.  Picklable by construction and, like
+    WirePayload, framed with ``indices``/``data`` as raw zero-copy
+    buffers — only the touched rows (plus 8 bytes per row id) ride the
+    wire."""
+
+    __slots__ = ("indices", "nrows", "data")
+
+    def __init__(self, indices, nrows, data):
+        self.indices = indices
+        self.nrows = int(nrows)
+        self.data = data
+
+    def __reduce__(self):
+        return (RowSparsePayload, (self.indices, self.nrows, self.data))
+
+
+def validate_rowsparse(p):
+    """Hostile-input gate for a decoded RowSparsePayload: raises
+    ValueError unless the indices are a 1-D non-negative strictly
+    increasing int64 array that fits in ``nrows`` rows and the values
+    block carries exactly one row per index.  Shared by the binary
+    codec decoder and the server's pickle-path apply, so a malformed
+    descriptor drops the connection instead of corrupting a table."""
+    idx = p.indices
+    if not isinstance(idx, np.ndarray) or idx.dtype != np.int64 \
+            or idx.ndim != 1:
+        raise ValueError("row-sparse indices must be a 1-D int64 array")
+    nrows = p.nrows
+    if not isinstance(nrows, int) or isinstance(nrows, bool) \
+            or nrows < 0:
+        raise ValueError(
+            f"row-sparse nrows must be a non-negative int, got {nrows!r}")
+    if idx.size:
+        if int(idx[0]) < 0:
+            raise ValueError(
+                f"row-sparse index out of range: {int(idx[0])}")
+        if int(idx[-1]) >= nrows:
+            raise ValueError(
+                f"row-sparse index {int(idx[-1])} out of range for "
+                f"{nrows} rows")
+        if idx.size > 1 and not bool(np.all(idx[1:] > idx[:-1])):
+            raise ValueError(
+                "row-sparse indices must be strictly increasing "
+                "(sorted, no duplicates)")
+    data = p.data
+    if isinstance(data, WirePayload):
+        if not data.shape:
+            raise ValueError(
+                "row-sparse compressed values must keep a row shape")
+        got = int(data.shape[0])
+    elif isinstance(data, np.ndarray) and data.ndim >= 1:
+        got = int(data.shape[0])
+    else:
+        raise ValueError(
+            "row-sparse values must be an ndarray of rows or a "
+            "WirePayload")
+    if got != idx.size:
+        raise ValueError(
+            f"row-sparse index/value mismatch: {idx.size} ids vs "
+            f"{got} value rows")
+    return p
+
+
 class GradientCompression:
     """Validated compression config + the worker-side compressor."""
 
@@ -90,6 +162,29 @@ class GradientCompression:
                                arr.astype(np.float16))
         payload, residuals[wire_key] = quantize_2bit(
             arr, residuals.get(wire_key), self.threshold)
+        return payload
+
+    def compress_rows(self, global_ids, rows, row_residuals):
+        """Compress a row-sparse value block.  ``rows`` holds one row
+        per entry of ``global_ids``; ``row_residuals`` maps GLOBAL row
+        id -> fp32 residual row (mutated in place for 2bit), so a
+        restripe can drop exactly the rows that moved servers instead
+        of nuking the whole key's residual.  Returns the raw block
+        unchanged when inactive or non-float."""
+        if not self.active or rows.dtype not in (np.float32, np.float64):
+            return rows
+        rows = np.asarray(rows, dtype=np.float32)
+        if self.type == "fp16":
+            return WirePayload("fp16", rows.shape, 0.0,
+                               rows.astype(np.float16))
+        res = np.zeros(rows.shape, np.float32)
+        for j, rid in enumerate(global_ids):
+            prev = row_residuals.get(int(rid))
+            if prev is not None:
+                res[j] = prev
+        payload, work = quantize_2bit(rows + res, None, self.threshold)
+        for j, rid in enumerate(global_ids):
+            row_residuals[int(rid)] = work[j]
         return payload
 
 

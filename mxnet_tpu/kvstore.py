@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from .analysis import hb as _hb
 from .base import MXNetError
+from .compression import RowSparsePayload
 from .ndarray import NDArray
 from . import optimizer as opt
 from . import tracing as _tr
@@ -180,7 +181,18 @@ class KVStore:
         keys, outs = self._canon(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
+        from . import membership as _mem
         for k, os_, rid in zip(keys, outs, row_ids):
+            if _mem.STRIPE_SEP in k:
+                # same reservation the dist stripe planner enforces:
+                # a user key carrying the separator would collide with
+                # striped wire keys the moment the job goes dist
+                raise MXNetError(
+                    f"kvstore {self.type}: key {k!r} contains the "
+                    f"reserved stripe separator "
+                    f"'{_mem.STRIPE_SEP}' — rename the parameter")
+            if k not in self._store:
+                raise MXNetError(f"pull of uninitialized key {k}")
             src = self._store[k]
             # dedup row ids (reference: PullRowSparseImpl dedups before
             # gathering) — duplicates would double-count in the rsp view
@@ -1995,6 +2007,18 @@ class KVStoreDistAsync(KVStore):
         # activation mirrors the launcher's env-propagation model, so a
         # whole job flips compression on without touching user code.
         self._gc_residual: Dict[str, np.ndarray] = {}
+        # row-sparse pushes keep their residuals PER GLOBAL ROW ID
+        # ({base_key: {row_id: fp32 row}}) so a restripe can drop
+        # exactly the rows whose owning server changed
+        # (membership.moved_row_spans) instead of nuking whole keys —
+        # the PR 7 lesson applied at row granularity.  _sparse_shapes
+        # remembers each sparse key's full table shape for that
+        # arithmetic (and for re-routing logged sparse pushes).
+        self._sparse_residual: Dict[str, Dict[int, np.ndarray]] = {}
+        self._sparse_shapes: Dict[str, tuple] = {}
+        self._sparse_wire = bool(_env("MXNET_KVSTORE_SPARSE", True))
+        self._sparse_cutover = float(_env(
+            "MXNET_KVSTORE_SPARSE_DENSITY_CUTOVER", 0.5))
         ctype = os.environ.get("MXNET_KVSTORE_COMPRESSION", "")
         if ctype and ctype != "none":
             self.set_gradient_compression({
@@ -2122,9 +2146,42 @@ class KVStoreDistAsync(KVStore):
                         "have diverged (mesh members must run the same "
                         "program)")
                 parts.append(c[k])
+            if any(isinstance(p, RowSparsePayload) for p in parts):
+                reduced.append((k, self._merge_sparse(parts)))
+                continue
             reduced.append((k, np.asarray(
                 local_allreduce_sum(parts), dtype=agg.dtype)))
         return reduced
+
+    @staticmethod
+    def _merge_sparse(parts):
+        """Merge one mesh round's contributions for a row-sparse key
+        into ONE deduped sparse sum: indices unioned, rows landing on
+        the same id accumulated — the leader ships a single
+        RowSparsePayload instead of every member's index set.  A mixed
+        round (a member crossed the density cutover and densified its
+        copy) degrades to the dense sum, since a dense contribution
+        already touches every row."""
+        if not all(isinstance(p, RowSparsePayload) for p in parts):
+            dense = None
+            for p in parts:
+                if isinstance(p, RowSparsePayload):
+                    rows = np.asarray(p.data)
+                    d = np.zeros((p.nrows,) + rows.shape[1:], rows.dtype)
+                    np.add.at(d, np.asarray(p.indices, np.int64), rows)
+                else:
+                    d = np.asarray(p)
+                dense = d if dense is None else dense + d
+            return dense
+        allidx = np.concatenate(
+            [np.asarray(p.indices, np.int64) for p in parts])
+        allrows = np.concatenate(
+            [np.asarray(p.data) for p in parts], axis=0)
+        uniq, inv = np.unique(allidx, return_inverse=True)
+        summed = np.zeros((uniq.size,) + allrows.shape[1:],
+                          allrows.dtype)
+        np.add.at(summed, inv, allrows)
+        return RowSparsePayload(uniq, parts[0].nrows, summed)
 
     # -- big-array striping --------------------------------------------------
     def _stripe_plan(self, k: str, shape):
@@ -2413,6 +2470,32 @@ class KVStoreDistAsync(KVStore):
             for wk in [w for w in self._gc_residual
                        if _mem.base_key(w) in moved_set]:
                 del self._gc_residual[wk]
+        if moved and self._sparse_residual:
+            # row-sparse residuals are keyed by GLOBAL row id, so the
+            # restripe arithmetic can be exact: drop only the rows whose
+            # owning server changed (membership.moved_row_spans) — a row
+            # that stayed with its server keeps its un-drained error,
+            # the whole point of keying residuals per row (PR 7's
+            # moved-key lesson applied at row granularity)
+            moved_set = set(moved)
+            for bk in [b for b in self._sparse_residual
+                       if b in moved_set]:
+                shape = self._sparse_shapes.get(bk) \
+                    or cache_shapes.get(bk)
+                if shape is None:
+                    # no recorded geometry to compute spans against:
+                    # dropping the whole bank is the safe degradation
+                    del self._sparse_residual[bk]
+                    continue
+                spans = _mem.moved_row_spans(
+                    bk, shape, old_servers, servers,
+                    self._bigarray_bound)
+                bank = self._sparse_residual[bk]
+                for rid in [r for r in bank
+                            if any(lo <= r < hi for lo, hi in spans)]:
+                    del bank[rid]
+                if not bank:
+                    del self._sparse_residual[bk]
         if moved:
             self._handoff(moved, old_servers)
 
@@ -2552,10 +2635,15 @@ class KVStoreDistAsync(KVStore):
                 per_wire[str(wk)] = _state_to_np(st)
         return per_wire if have_updater else {}
 
-    def _route_push(self, k: str, agg: np.ndarray):
+    def _route_push(self, k: str, agg):
         """Send one (possibly compressed) push of a full gradient under
         the CURRENT stripe plan — the shared tail of push() and the
-        orphan re-push."""
+        orphan re-push.  A logged row-sparse gradient re-routes through
+        the same per-stripe sparse planner as the original push."""
+        if isinstance(agg, RowSparsePayload):
+            for _wk, conn, msg in self._sparse_wire_entries(k, agg):
+                conn.submit(msg, wait=False)
+            return
         plan = self._stripe_plan(k, agg.shape)
         if plan is None:
             self._conn_of(k).submit(
@@ -2607,7 +2695,8 @@ class KVStoreDistAsync(KVStore):
         best-effort for jobs that never pull)."""
         if not self._elastic:
             return
-        agg = np.asarray(agg)
+        if not isinstance(agg, RowSparsePayload):
+            agg = np.asarray(agg)
         with self._elastic_lock:
             self._push_log.setdefault(k, []).append(agg)
             self._push_log_seq[k] = self._push_log_seq.get(k, 0) + 1
@@ -2659,9 +2748,91 @@ class KVStoreDistAsync(KVStore):
     @staticmethod
     def _payload_nbytes(payload) -> int:
         from .compression import WirePayload
+        if isinstance(payload, RowSparsePayload):
+            data = payload.data
+            if isinstance(data, WirePayload):
+                data = data.data
+            return int(data.nbytes) + int(payload.indices.nbytes)
         data = payload.data if isinstance(payload, WirePayload) \
             else payload
         return int(data.nbytes)
+
+    def _sparse_agg(self, k, vs):
+        """Merge one key's device copies into a raw RowSparsePayload
+        (sorted unique GLOBAL row ids, duplicate rows summed) without
+        EVER densifying, or None when the sparse wire doesn't apply —
+        values not row-sparse, the knob off, or the touch density past
+        MXNET_KVSTORE_SPARSE_DENSITY_CUTOVER (at which point the dense
+        path's tighter per-element packing wins).  Runs BEFORE
+        ``_reduce``: reducing through ``._data`` would lazily densify
+        the RowSparseNDArray and the wire would never see sparsity."""
+        from .ndarray.sparse import RowSparseNDArray
+        if not self._sparse_wire \
+                or not all(isinstance(v, RowSparseNDArray) for v in vs):
+            return None
+        nrows = int(vs[0].shape[0])
+        idx_parts = [np.asarray(v.indices.asnumpy(), np.int64)
+                     for v in vs]
+        row_parts = [np.asarray(v.data.asnumpy()) for v in vs]
+        allidx = np.concatenate(idx_parts)
+        allrows = np.concatenate(row_parts, axis=0)
+        uniq, inv = np.unique(allidx, return_inverse=True)
+        if uniq.size and (int(uniq[0]) < 0 or int(uniq[-1]) >= nrows):
+            raise MXNetError(
+                f"row-sparse push of key {k!r}: row ids span "
+                f"[{int(uniq[0])}, {int(uniq[-1])}], key has "
+                f"{nrows} rows")
+        if uniq.size > self._sparse_cutover * nrows:
+            return None
+        summed = np.zeros((uniq.size,) + allrows.shape[1:],
+                          allrows.dtype)
+        np.add.at(summed, inv, allrows)
+        self._sparse_shapes[k] = tuple(vs[0].shape)
+        return RowSparsePayload(uniq, nrows, summed)
+
+    def _wire_sparse_payload(self, base_key, global_ids, wire_ids,
+                             rows, nrows):
+        """Build the on-wire RowSparsePayload for one destination:
+        ``wire_ids`` are LOCAL to the receiving stripe (its row 0),
+        while compression residuals stay keyed by ``base_key`` +
+        GLOBAL row id — so a restripe drops exactly the moved rows'
+        residuals and nothing else."""
+        ids = np.ascontiguousarray(np.asarray(wire_ids, np.int64))
+        gc = self._gcompress
+        if gc is None or not gc.active:
+            return RowSparsePayload(ids, nrows,
+                                    np.ascontiguousarray(rows))
+        bank = self._sparse_residual.setdefault(base_key, {})
+        return RowSparsePayload(
+            ids, nrows, gc.compress_rows(global_ids, rows, bank))
+
+    def _sparse_wire_entries(self, k, p):
+        """Plan one row-sparse push: ``[(wire_key, conn, msg)]`` with
+        one entry per stripe the index set actually touches — an
+        untouched stripe sends NOTHING, which is the whole wire win."""
+        from . import membership as _mem
+        from . import profiler as _prof
+        idx = np.asarray(p.indices, np.int64)
+        if idx.size == 0:
+            return []
+        rows = np.asarray(p.data)
+        shape = self._sparse_shapes.get(k, (p.nrows,) + rows.shape[1:])
+        plan = self._stripe_plan(k, shape)
+        _prof.record_channel_count("kvstore.sparse_rows", int(idx.size))
+        if plan is None:
+            payload = self._wire_sparse_payload(k, idx, idx, rows,
+                                                p.nrows)
+            return [(k, self._conn_of(k), ("push", k, payload))]
+        out = []
+        for i, local_ids, pos in _mem.sparse_route(plan, idx):
+            wk = f"{k}@s{i}"
+            payload = self._wire_sparse_payload(
+                k, idx[pos], local_ids,
+                np.ascontiguousarray(rows[pos]),
+                plan[i + 1] - plan[i])
+            out.append((wk, self._stripe_conn(k, i),
+                        ("push", wk, payload)))
+        return out
 
     def push(self, key, value, priority=0):
         """Locally reduce, then hand to the channel — returns immediately;
@@ -2683,9 +2854,12 @@ class KVStoreDistAsync(KVStore):
         log."""
         keys, values = self._canon(key, value)
         with _tr.span("kv.push", args={"keys": len(keys)}):
-            self._push_aggregated(
-                [(k, np.asarray(self._reduce(vs)))
-                 for k, vs in zip(keys, values)])
+            pairs = []
+            for k, vs in zip(keys, values):
+                sp = self._sparse_agg(k, vs)
+                pairs.append((k, sp) if sp is not None
+                             else (k, np.asarray(self._reduce(vs))))
+            self._push_aggregated(pairs)
 
     def _push_aggregated(self, pairs):
         """Plan and submit one push round of already-reduced HOST
@@ -2711,7 +2885,8 @@ class KVStoreDistAsync(KVStore):
             if self._mesh_conn is not None:   # follower
                 self._mesh_conn.submit(
                     ("mesh_push", seq,
-                     [(k, np.ascontiguousarray(a)) for k, a in pairs]),
+                     [(k, a if isinstance(a, RowSparsePayload)
+                       else np.ascontiguousarray(a)) for k, a in pairs]),
                     wait=False)
                 return
             with _tr.span("kv.mesh_reduce", cat="hier",
@@ -2730,6 +2905,23 @@ class KVStoreDistAsync(KVStore):
         small: Dict[int, list] = {}   # conn index -> [(wire_key, payload)]
         planned = []                  # (base_key, conn, msg)
         for k, agg in pairs:
+            if isinstance(agg, RowSparsePayload):
+                if np.asarray(agg.indices).size == 0:
+                    continue   # nothing touched: nothing rides, nothing logged
+                self._log_push(k, agg)
+                for wk, conn, msg in self._sparse_wire_entries(k, agg):
+                    if (wk == k and len(pairs) > 1
+                            and self._payload_nbytes(msg[2])
+                            <= self._coalesce_bytes):
+                        # unstriped tiny sparse pushes coalesce like
+                        # dense ones; striped wire keys stay standalone
+                        # (a push_multi reroute re-hashes by entry key)
+                        small.setdefault(
+                            self._conns.index(conn), []).append(
+                                (k, msg[2]))
+                    else:
+                        planned.append((k, conn, msg))
+                continue
             self._log_push(k, agg)
             plan = self._stripe_plan(k, agg.shape)
             if plan is None:
@@ -2970,12 +3162,20 @@ class KVStoreDistAsync(KVStore):
 
     def _row_sparse_pull_impl(self, key, out, row_ids):
         import jax.numpy as jnp
+        from . import membership as _mem
         assert out is not None and row_ids is not None
         keys, outs = self._canon(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
         reqs = []
         for k, os_, rid in zip(keys, outs, row_ids):
+            if _mem.STRIPE_SEP in k:
+                # same reservation the local store enforces: a user key
+                # carrying the separator collides with striped wire keys
+                raise MXNetError(
+                    f"kvstore {self.type}: key {k!r} contains the "
+                    f"reserved stripe separator "
+                    f"'{_mem.STRIPE_SEP}' — rename the parameter")
             idx = np.unique(np.asarray(rid.asnumpy(), dtype=np.int64))
             # out (dense or row-sparse) carries the full logical shape, so
             # a fresh client derives the stripe plan just like pull()
@@ -2987,32 +3187,51 @@ class KVStoreDistAsync(KVStore):
                     f"[{idx[0]}, {idx[-1]}], key has {plan[-1]} rows")
             if plan is None:
                 reqs.append((idx, self._conn_of(k).request(
-                    ("pull_rows", k, idx))))
+                    ("pull_rowsparse", k, idx))))
             else:
-                # route each global row id to its stripe; stripes are
-                # contiguous and idx is sorted, so concatenating the
-                # per-stripe replies in stripe order realigns with idx
-                stripe_of = np.searchsorted(plan, idx, side="right") - 1
-                parts = []
-                for i in range(len(plan) - 1):
-                    local = idx[stripe_of == i] - plan[i]
-                    if local.size or (i == 0 and not idx.size):
-                        # the empty-idx degenerate still needs one reply
-                        # to learn the row tail shape
-                        parts.append(self._stripe_conn(k, i).request(
-                            ("pull_rows", f"{k}@s{i}", local)))
+                # route each global row id to its stripe
+                # (membership.sparse_route); stripes are contiguous and
+                # idx is sorted, so concatenating the per-stripe
+                # replies in stripe order realigns with idx
+                parts = [
+                    (self._stripe_conn(k, i).request(
+                        ("pull_rowsparse", f"{k}@s{i}", local)))
+                    for i, local, _pos in _mem.sparse_route(plan, idx)]
+                if not parts:
+                    # the empty-idx degenerate still needs one reply
+                    # to learn the row tail shape
+                    parts = [self._stripe_conn(k, 0).request(
+                        ("pull_rowsparse", f"{k}@s0",
+                         np.zeros(0, np.int64)))]
                 reqs.append((idx, (plan, parts)))
-        for (idx, pending), os_ in zip(reqs, outs):
+        for (idx, pending), (k, os_) in zip(reqs, zip(keys, outs)):
             if isinstance(pending, tuple):
                 plan, parts = pending
-                replies = [_await(p) for p in parts]
+                replies = [self._await_rows(p, k) for p in parts]
                 rows = jnp.concatenate(
                     [jnp.asarray(r) for r, _shape in replies], axis=0)
                 full_shape = (plan[-1],) + tuple(replies[0][1][1:])
             else:
-                rows_np, full_shape = _await(pending)
+                rows_np, full_shape = self._await_rows(pending, k)
                 rows = jnp.asarray(rows_np)
             _write_row_sparse_out(os_, rows, idx, full_shape)
+
+    @staticmethod
+    def _await_rows(pending, k):
+        """Await one pull_rowsparse reply, mapping the server's
+        uninitialized-key error back to the TYPED KeyError the local
+        store raises — the caller (e.g. a serving refresh probing for a
+        key) must get a catchable KeyError, not an MXNetError that the
+        elastic retry loop would spin on while the window sits wedged
+        behind a request that can never succeed."""
+        try:
+            return _await(pending)
+        except MXNetError as exc:
+            msg = str(exc)
+            if "KeyError" in msg and "uninitialized key" in msg:
+                raise KeyError(
+                    f"pull of uninitialized key {k!r}") from exc
+            raise
 
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the servers (reference kvstore.py:353:

@@ -50,7 +50,9 @@ from . import wirecodec as _codec
 from . import health as _health
 from .analysis import hb as _hb
 from .base import env as _env
-from .compression import WirePayload, decompress as _decompress
+from .compression import (RowSparsePayload, WirePayload,
+                          decompress as _decompress,
+                          validate_rowsparse as _validate_rowsparse)
 
 # reference command codes (kvstore_dist_server.h:44-45): kStopServer=-1
 # tears down, kSyncMode=-2 switches the reference server to sync
@@ -118,6 +120,9 @@ def _pack(obj, bufs):
     if isinstance(obj, WirePayload):
         return WirePayload(obj.kind, obj.shape, obj.threshold,
                            _pack(obj.data, bufs))
+    if isinstance(obj, RowSparsePayload):
+        return RowSparsePayload(_pack(obj.indices, bufs), obj.nrows,
+                                _pack(obj.data, bufs))
     return obj
 
 
@@ -136,6 +141,10 @@ def _unpack(obj, body, offsets):
     if isinstance(obj, WirePayload):
         return WirePayload(obj.kind, obj.shape, obj.threshold,
                            _unpack(obj.data, body, offsets))
+    if isinstance(obj, RowSparsePayload):
+        return RowSparsePayload(_unpack(obj.indices, body, offsets),
+                                obj.nrows,
+                                _unpack(obj.data, body, offsets))
     return obj
 
 
@@ -149,6 +158,9 @@ def _collect_bufs(obj, refs):
         for v in obj.values():
             _collect_bufs(v, refs)
     elif isinstance(obj, WirePayload):
+        _collect_bufs(obj.data, refs)
+    elif isinstance(obj, RowSparsePayload):
+        _collect_bufs(obj.indices, refs)
         _collect_bufs(obj.data, refs)
 
 
@@ -181,6 +193,7 @@ _SAFE_GLOBALS = frozenset({
     # listening socket) that must stay out of REDUCE reach
     ("mxnet_tpu.kvstore_server", "_Buf"),
     ("mxnet_tpu.compression", "WirePayload"),
+    ("mxnet_tpu.compression", "RowSparsePayload"),
 })
 # Only CLASSES from these modules — the pickle surface the reference
 # semantics actually ship (optimizer/updater/scheduler objects, NDArray
@@ -587,7 +600,8 @@ class KVStoreServer:
         envelope, allowlisted decode and error-reply contract as the
         built-in ops; core op names are reserved."""
         if op in ("ping", "init", "push", "push_multi", "pull",
-                  "pull_rows", "assign", "get_states", "set_states",
+                  "pull_rows", "pull_rowsparse", "assign",
+                  "get_states", "set_states",
                   "command", "barrier", "req", "stats", "roster_get",
                   "roster_join", "roster_leave", "roster_dead",
                   "roster_beat", "roster_snapshot", "handoff",
@@ -604,6 +618,8 @@ class KVStoreServer:
         wire mode) is dequantized here — the stored weight stays fp32."""
         from .ndarray import NDArray
         import jax.numpy as jnp
+        if isinstance(arr, RowSparsePayload):
+            return self._apply_push_sparse(key, arr)
         if isinstance(arr, WirePayload):
             arr = _decompress(arr)
         grad = NDArray(jnp.asarray(arr))
@@ -620,6 +636,61 @@ class KVStoreServer:
                     self._updater(_key_int(key), grad, stored)
             else:
                 stored._set_data(grad._data)
+
+    def _apply_push_sparse(self, key, p):
+        """Row-sparse push: only the touched rows arrived.  Re-validate
+        the descriptor here — the binary codec already gated it, but
+        the pickle path has no decode-time check — then hand the
+        updater a RowSparseNDArray so the optimizer's sparse impl
+        touches exactly those rows (momentum rows included)."""
+        from .ndarray import NDArray
+        from .ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        _validate_rowsparse(p)
+        data = p.data
+        if isinstance(data, WirePayload):
+            data = _decompress(data)
+        idx = np.asarray(p.indices, dtype=np.int64)
+        # bucket the row count to the next power of two — zero rows
+        # under an out-of-range id, which dedup_rows/mode='drop'
+        # scatters discard.  Per-stripe counts vary every push, and each
+        # fresh row count would otherwise cost an XLA compile of the
+        # sparse-update kernels (the serving tier's bucketed-predict
+        # trick, applied to the updater).
+        n = int(idx.shape[0])
+        cap = (1 << (n - 1).bit_length()) if n else 1
+        if cap != n:
+            data = np.concatenate(
+                [np.asarray(data),
+                 np.zeros((cap - n,) + tuple(np.shape(data))[1:],
+                          np.asarray(data).dtype)])
+            idx = np.concatenate([idx, np.full(cap - n, p.nrows,
+                                               np.int64)])
+        with self._lock:
+            stored = self._store.get(key)
+            if stored is None:
+                raise KeyError(f"push to uninitialized key {key!r}")
+            if p.nrows != int(stored.shape[0]):
+                raise ValueError(
+                    f"row-sparse push to key {key!r}: payload declares "
+                    f"{p.nrows} rows, stored table has "
+                    f"{int(stored.shape[0])}")
+            if tuple(np.shape(data))[1:] != tuple(stored.shape)[1:]:
+                raise ValueError(
+                    f"row-sparse push to key {key!r}: row shape "
+                    f"{tuple(np.shape(data))[1:]} does not match stored "
+                    f"{tuple(stored.shape)[1:]}")
+            if self._updater is not None:
+                grad = RowSparseNDArray(
+                    NDArray(jnp.asarray(data)), NDArray(jnp.asarray(idx)),
+                    tuple(stored.shape))
+                # protocol: span(phase)
+                with _tr.span("srv.updater_apply", cat="server"):
+                    self._updater(_key_int(key), grad, stored)
+            elif idx.size:
+                # assign semantics, restricted to the touched rows
+                stored._set_data(stored._data.at[jnp.asarray(idx)]
+                                 .set(jnp.asarray(data)))
 
     def _handle(self, msg, rank=None, client=None):
         op = msg[0]
@@ -688,6 +759,24 @@ class KVStoreServer:
                     raise KeyError(f"pull of uninitialized key {key!r}")
                 full = np.asarray(stored.asnumpy())
                 return full[ids], full.shape
+        if op == "pull_rowsparse":  # protocol: replay(pure) reply(rows + full shape) codec(binary)
+            # binary-codec row-sparse pull: the id list arrives as one
+            # i64 tensor buffer and the row block replies zero-copy —
+            # wire cost is rows_touched x row_bytes + 8 x rows_touched,
+            # never the full table (reference: PullRowSparse)
+            _, key, ids = msg
+            ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+            with self._lock:
+                stored = self._store.get(key)
+                if stored is None:
+                    raise KeyError(f"pull of uninitialized key {key!r}")
+                full = np.asarray(stored.asnumpy())
+                if ids.size and (int(ids.min()) < 0
+                                 or int(ids.max()) >= full.shape[0]):
+                    raise ValueError(
+                        f"pull_rowsparse of key {key!r}: row ids out of "
+                        f"range for {full.shape[0]} rows")
+                return np.ascontiguousarray(full[ids]), full.shape
         if op == "get_states":  # protocol: replay(pure) reply(states blob | None)
             # optimizer-state checkpointing: this shard's {key: state}
             # dict, optionally with the optimizer itself (reference:

@@ -208,6 +208,61 @@ def base_key(wire_key: str) -> str:
     return wire_key
 
 
+def sparse_route(plan: List[int], indices: np.ndarray
+                 ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+    """Route sorted row ids onto a stripe plan: ``[(stripe_index,
+    local_ids, positions)]`` for the NON-empty stripes only — a sparse
+    push/pull skips every stripe its batch never touched, which is the
+    whole wire win.  ``local_ids`` are the ids rebased to the stripe's
+    row 0; ``positions`` index back into ``indices`` (and the caller's
+    row block).  Deterministic from (plan, indices) under the same
+    contract as :func:`stripe_plan`."""
+    idx = np.asarray(indices, dtype=np.int64)
+    stripe_of = np.searchsorted(plan, idx, side="right") - 1
+    out = []
+    for i in range(len(plan) - 1):
+        pos = np.nonzero(stripe_of == i)[0]
+        if pos.size:
+            out.append((i, idx[pos] - plan[i], pos))
+    return out
+
+
+def moved_row_spans(key: str, shape, old_servers: Sequence[str],
+                    new_servers: Sequence[str],
+                    bigarray_bound: int) -> List[Tuple[int, int]]:
+    """The row spans of ``key`` whose OWNING server changes between two
+    rosters: merged, sorted, half-open ``[(lo, hi)]``.  The pure
+    arithmetic behind per-row residual invalidation — a restripe must
+    drop exactly the error-feedback rows that moved to a different
+    server (their un-drained error belongs to the OLD owner's applied
+    history) and keep every row that stayed put."""
+    old = wire_layout(key, shape, old_servers, bigarray_bound)
+    new = wire_layout(key, shape, new_servers, bigarray_bound)
+    rows = int(shape[0]) if shape else 0
+    cuts = {0, rows}
+    for _, lo, hi in list(old.values()) + list(new.values()):
+        cuts.add(min(max(lo, 0), rows))
+        cuts.add(min(max(hi, 0), rows))
+    cuts = sorted(cuts)
+
+    def owner_at(layout, row):
+        for uri, lo, hi in layout.values():
+            if lo <= row < hi:
+                return uri
+        return None
+
+    moved: List[Tuple[int, int]] = []
+    for lo, hi in zip(cuts, cuts[1:]):
+        if lo >= hi:
+            continue
+        if owner_at(old, lo) != owner_at(new, lo):
+            if moved and moved[-1][1] == lo:
+                moved[-1] = (moved[-1][0], hi)
+            else:
+                moved.append((lo, hi))
+    return moved
+
+
 def plan_handoff(key_shapes: Dict[str, tuple], old_servers: Sequence[str],
                  new_servers: Sequence[str],
                  bigarray_bound: int) -> List[str]:

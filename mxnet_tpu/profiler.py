@@ -280,6 +280,17 @@ def record_channel_event(kind: str):
         _channel_counts[kind] = _channel_counts.get(kind, 0) + 1
 
 
+def record_channel_count(kind: str, n: int):
+    """Add ``n`` to the transport counter ``kind`` — the bulk form of
+    :func:`record_channel_event` for per-row accounting (e.g.
+    ``kvstore.sparse_rows``: one sparse push moves thousands of rows;
+    counting them one event at a time would put a lock round-trip per
+    row on the push path).  Lives in _channel_counts, NOT the byte
+    counters, so row counts never pollute wire_bytes_total."""
+    with _channel_lock:
+        _channel_counts[kind] = _channel_counts.get(kind, 0) + int(n)
+
+
 def record_channel_gauge(kind: str, value):
     """SET a transport gauge (last-value, not a count): the elastic
     roster generation is the canonical one — ``kvstore.roster_generation``

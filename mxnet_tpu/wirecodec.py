@@ -60,7 +60,7 @@ import weakref
 import numpy as np
 
 from .base import env as _env
-from .compression import WirePayload
+from .compression import RowSparsePayload, WirePayload, validate_rowsparse
 
 # codec-table:begin (generated: python -m mxnet_tpu.analysis --codec-table)
 HOT_OPS = frozenset({
@@ -68,10 +68,11 @@ HOT_OPS = frozenset({
     "mesh_push",
     "predict",
     "pull",
+    "pull_rowsparse",
     "push",
     "push_multi",
 })
-CODEC_TABLE_FINGERPRINT = "d3ae4e17ec7b"
+CODEC_TABLE_FINGERPRINT = "f46bdbfc897f"
 # codec-table:end
 
 CODEC_VERSION = 1
@@ -95,6 +96,7 @@ _T_LIST = 0x08     # >I count + items
 _T_DICT = 0x09     # >I count + (key, value) item pairs
 _T_NDARRAY = 0x0A  # >B dtype-str length + dtype str + >B ndim + >q*ndim
 _T_PAYLOAD = 0x0B  # WirePayload: kind, shape, threshold, data items
+_T_ROWSPARSE = 0x0C  # RowSparsePayload: nrows, indices ndarray, data
 
 _INT64_MIN = -(1 << 63)
 _INT64_MAX = (1 << 63) - 1
@@ -273,6 +275,16 @@ def _enc(obj, out, bufs, depth=0):
              out, bufs, depth + 1)
         _enc(obj.threshold, out, bufs, depth + 1)
         _enc(obj.data, out, bufs, depth + 1)
+    elif isinstance(obj, RowSparsePayload):
+        # indices and value rows each ride as a zero-copy tensor
+        # buffer; anything the ndarray branch can't express (e.g. a
+        # _Buf placeholder from the pickle path) falls back there
+        out.append(_T_ROWSPARSE)
+        _enc(int(obj.nrows), out, bufs, depth + 1)
+        if not isinstance(obj.indices, np.ndarray):
+            raise Unencodable("row-sparse indices not an ndarray")
+        _enc(obj.indices, out, bufs, depth + 1)
+        _enc(obj.data, out, bufs, depth + 1)
     else:
         raise Unencodable(type(obj).__name__)
 
@@ -394,6 +406,17 @@ def _dec(r, depth=0):
         threshold = _dec(r, depth + 1)
         data = _dec(r, depth + 1)
         return WirePayload(kind, shape, threshold, data)
+    if tag == _T_ROWSPARSE:
+        nrows = _dec(r, depth + 1)
+        if not isinstance(nrows, int) or isinstance(nrows, bool):
+            raise ValueError("wirecodec: row-sparse nrows not an int")
+        indices = _dec(r, depth + 1)
+        data = _dec(r, depth + 1)
+        try:
+            return validate_rowsparse(
+                RowSparsePayload(indices, nrows, data))
+        except (ValueError, TypeError, OverflowError) as exc:
+            raise ValueError(f"wirecodec: {exc}") from exc
     raise ValueError("wirecodec: unknown tag 0x%02x" % tag)
 
 

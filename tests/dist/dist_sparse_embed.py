@@ -1,0 +1,158 @@
+"""Row-sparse wire smoke: at 1% touch density the sparse push stream
+must move <= 5% of the dense baseline's bytes AND land the bit-identical
+table — under the real launcher, striped across two real servers.
+
+Run via:  python tools/launch.py -n 2 -s 2 \
+              python tests/dist/dist_sparse_embed.py
+
+Each worker pushes the SAME deterministic dyadic row-sparse gradients
+twice: once densified (``emb_dense`` — the dense-equivalent baseline,
+``w -= lr*0`` on untouched rows is a bit-exact no-op) and once as
+row-sparse payloads (``emb_sparse``).  Plain SGD with dyadic values at
+a power-of-two lr makes every update exact and order-independent, so
+BOTH tables must EQUAL the analytic golden bit-for-bit, while the
+sparse pass's wire-byte delta is a tiny fraction of the dense pass's.
+
+MXT_SPARSE_KILL=1 (run via ``tools/launch.py --elastic -n 2 -s 2
+--env MXNET_FI_KILL_ON_BEAT_SEQ=<n> --env MXNET_FI_ONLY_SERVER=1``)
+is the restripe pass: server 1 is REALLY SIGKILLed at a beat boundary
+mid-job, taking its row range to its grave.  The surviving roster must
+evict it, re-derive the row-range striping, hand off / replay, and the
+job must finish WITHOUT RESTART with the same bit-identical table — a
+mis-moved row range, a lost sparse push, or a stale per-row residual
+all break equality.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND", "64")
+KILL_MODE = os.environ.get("MXT_SPARSE_KILL", "0") == "1"
+if KILL_MODE:
+    os.environ.setdefault("MXNET_KVSTORE_ELASTIC", "1")
+    os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX", "3")
+    os.environ.setdefault("MXNET_KVSTORE_RETRY_INITIAL_MS", "20")
+    os.environ.setdefault("MXNET_KVSTORE_RETRY_MAX_MS", "200")
+    os.environ.setdefault("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.5")
+    os.environ.setdefault("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "2.0")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from cpu_pin import pin_cpu  # noqa: E402
+
+pin_cpu(n_devices=None)
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu.ndarray import sparse  # noqa: E402
+
+VOCAB, DIM = 400, 32
+TOUCH = 4               # 4/400 rows per push: 1% density
+ROUNDS = 6
+LR = 0.5                # power of two: every update exact in fp32
+
+
+def worker_grads(rank):
+    """Deterministic per-rank rounds: sorted unique row ids, dyadic
+    values (n/4) so plain SGD is exact and order-independent."""
+    rng = np.random.RandomState(100 + rank)
+    rounds = []
+    for _ in range(ROUNDS):
+        ids = np.sort(rng.choice(VOCAB, size=TOUCH,
+                                 replace=False)).astype(np.int64)
+        vals = (rng.randint(-8, 8, (TOUCH, DIM)) / 4.0).astype(np.float32)
+        rounds.append((ids, vals))
+    return rounds
+
+
+def golden(nworker):
+    """The analytic table every pass must hit bit-for-bit."""
+    acc = np.zeros((VOCAB, DIM), np.float32)
+    for r in range(nworker):
+        for ids, vals in worker_grads(r):
+            np.add.at(acc, ids, vals)
+    return -LR * acc
+
+
+def push_rounds(kv, key, rounds, dense):
+    """Push every round to ``key``; returns this worker's wire-byte
+    delta (bracketed by _flush_all: submits ride a background IO
+    thread, so byte counters lag until every push is acked)."""
+    kv._flush_all()
+    b0 = profiler.wire_bytes_total()
+    for ids, vals in rounds:
+        if dense:
+            g = np.zeros((VOCAB, DIM), np.float32)
+            g[ids] = vals
+            kv.push(key, mx.nd.NDArray(g))
+        else:
+            kv.push(key, sparse.row_sparse_array((vals, ids),
+                                                 shape=(VOCAB, DIM)))
+        if KILL_MODE:
+            time.sleep(0.6)   # straddle the armed beat-boundary kill
+    kv._flush_all()
+    return profiler.wire_bytes_total() - b0
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == 2, nworker
+    gold = golden(nworker)
+    rounds = worker_grads(rank)
+
+    keys = ["emb_sparse"] if KILL_MODE else ["emb_dense", "emb_sparse"]
+    for k in keys:
+        kv.init(k, mx.nd.zeros((VOCAB, DIM)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=LR, momentum=0.0,
+                                      wd=0.0, rescale_grad=1.0))
+
+    dense_bytes = sparse_bytes = None
+    if not KILL_MODE:
+        dense_bytes = push_rounds(kv, "emb_dense", rounds, dense=True)
+        kv.barrier()
+    rows0 = profiler.channel_counts().get("kvstore.sparse_rows", 0)
+    sparse_bytes = push_rounds(kv, "emb_sparse", rounds, dense=False)
+    kv.barrier()
+    assert profiler.channel_counts().get("kvstore.sparse_rows",
+                                         0) - rows0 > 0
+
+    out = mx.nd.zeros((VOCAB, DIM))
+    kv.pull("emb_sparse", out=out)
+    np.testing.assert_array_equal(
+        out.asnumpy(), gold,
+        err_msg="sparse-wire table diverged from the analytic golden")
+
+    if KILL_MODE:
+        # the beat-armed SIGKILL really landed and the roster acted:
+        # the job finished on ONE surviving server, and the bit-exact
+        # table above proves the row ranges restriped exactly
+        counts = profiler.channel_counts()
+        assert counts.get("kvstore.roster_bump", 0) >= 1, counts
+        assert len(kv._conns) == 1, len(kv._conns)
+    else:
+        kv.pull("emb_dense", out=out)
+        np.testing.assert_array_equal(
+            out.asnumpy(), gold,
+            err_msg="dense-baseline table diverged from the golden")
+        # THE wire gate: 1% density -> <= 5% of the dense bytes
+        assert sparse_bytes <= 0.05 * dense_bytes, \
+            (sparse_bytes, dense_bytes)
+
+    kv.barrier()
+    kv.close(stop_servers=True)
+    if KILL_MODE:
+        print("dist_sparse_embed rank %d/%d OK (SIGKILL survived, "
+              "restripe bit-identical)" % (rank, nworker), flush=True)
+    else:
+        print("dist_sparse_embed rank %d/%d OK (sparse %d B vs dense "
+              "%d B = %.1f%%, bit-identical)"
+              % (rank, nworker, sparse_bytes, dense_bytes,
+                 100.0 * sparse_bytes / dense_bytes), flush=True)
+
+
+if __name__ == "__main__":
+    main()

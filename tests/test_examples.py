@@ -220,6 +220,19 @@ def test_matrix_fact_recommender_example():
     assert float(m.group(1)) < 0.2, log[-300:]  # noise floor is 0.1
 
 
+def test_two_tower_recommender_example():
+    """Row-sparse two-tower retrieval (reference example/recommenders +
+    the row_sparse embedding path): sparse_grad towers on a planted
+    clickstream, then top-k served through a ServingReplica."""
+    log = _run("examples/recommender/two_tower.py", "--epochs", "10",
+               "--serve", timeout=600)
+    import re
+    m = re.search(r"final hit@10 ([\d.]+)", log)
+    assert m, log[-500:]
+    assert float(m.group(1)) > 0.8, log[-300:]
+    assert "serving done" in log, log[-500:]
+
+
 def test_neural_style_example():
     """Optimization over the INPUT (reference example/neural-style/
     nstyle.py): grads w.r.t. the image, Gram losses, manual Adam."""
@@ -262,6 +275,26 @@ def test_decode_bench_smoke():
     assert row["value"] is not None and row["value"] > 0
     assert row["device"] == "cpu"
     assert [r["batch"] for r in row["per_batch"]] == [1, 4]
+
+
+def test_sparse_bench_smoke():
+    """BENCH_SPARSE=1: the row-sparse kvstore wire bench (bench.py's
+    sparse mode) runs end-to-end on CPU; at 1% touch density the sparse
+    wire must be a small fraction of the dense baseline's."""
+    import json
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SPARSE="1",
+               BENCH_SPARSE_VOCAB="2048", BENCH_SPARSE_DIM="16",
+               BENCH_SPARSE_ITERS="4")
+    for k in ("RELAY_DEADLINE_EPOCH", "XLA_FLAGS", "MXT_SERVER_URIS"):
+        env.pop(k, None)
+    out = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                         env=env, capture_output=True, text=True,
+                         timeout=600, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "sparse_embed_push_rows_per_sec"
+    assert row["sparse_rows_per_step"] > 0
+    assert row["wire_bytes_per_step"] < 0.05 * row["dense_wire_bytes_per_step"]
 
 
 def test_bi_lstm_sort_example():

@@ -84,6 +84,54 @@ def test_wire_layout_owner_stability_under_eviction():
     assert (lo, hi) == (0, 2) and uri in servers2
 
 
+def test_sparse_route_rebases_and_skips_untouched_stripes():
+    """sparse_route is pure arithmetic over (plan, indices): local ids
+    are rebased to the stripe's row 0, positions index the caller's
+    row block, and stripes the batch never touched simply don't appear
+    — that silence IS the sparse wire win."""
+    plan = membership.stripe_plan("emb", (100, 4), 2, 8)
+    assert plan == [0, 50, 100]
+    idx = np.array([3, 49, 50, 99], dtype=np.int64)
+    routed = membership.sparse_route(plan, idx)
+    assert [(i, list(loc), list(pos)) for i, loc, pos in routed] == [
+        (0, [3, 49], [0, 1]), (1, [0, 49], [2, 3])]
+    # a batch confined to one stripe names only that stripe
+    routed = membership.sparse_route(plan, np.array([60, 70], np.int64))
+    assert [i for i, _l, _p in routed] == [1]
+    # empty batch routes nowhere; determinism across calls
+    assert membership.sparse_route(plan, np.zeros(0, np.int64)) == []
+    again = membership.sparse_route(plan, idx)
+    for (i, l, p), (j, l2, p2) in zip(routed, routed):
+        assert i == j and list(l) == list(l2) and list(p) == list(p2)
+    del again
+
+
+def test_moved_row_spans_names_exactly_the_moved_rows():
+    """moved_row_spans is the arithmetic behind per-row residual
+    invalidation: a roster bump must name exactly the half-open row
+    spans whose owning server changed — merged and sorted — and an
+    identical roster names none."""
+    two = ["hostA:1", "hostB:2"]
+    one = ["hostA:1"]
+    spans = membership.moved_row_spans("emb", (100, 4), two, one, 8)
+    lay2 = membership.wire_layout("emb", (100, 4), two, 8)
+    # rows hostA already owned stay put; hostB's rows all move
+    kept = [(lo, hi) for uri, lo, hi in lay2.values() if uri == "hostA:1"]
+    lost = sorted((lo, hi) for uri, lo, hi in lay2.values()
+                  if uri == "hostB:2")
+    assert spans == lost
+    for lo, hi in kept:
+        assert all(hi <= s_lo or lo >= s_hi for s_lo, s_hi in spans)
+    # identical roster: nothing moved
+    assert membership.moved_row_spans("emb", (100, 4), two, two, 8) == []
+    # spans are merged, sorted, half-open, in range
+    spans3 = membership.moved_row_spans("emb", (100, 4), two,
+                                        ["hostC:3"], 8)
+    assert spans3 == [(0, 100)]  # every owner changed -> one merged span
+    for lo, hi in spans3:
+        assert 0 <= lo < hi <= 100
+
+
 def test_plan_handoff_flags_only_moved_keys():
     servers2 = ["hostA:1", "hostB:2"]
     servers1 = ["hostA:1"]

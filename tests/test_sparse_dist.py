@@ -1,0 +1,354 @@
+"""Row-sparse dist data path (ISSUE 19): only touched rows ride the
+wire.
+
+The tentpole acceptance lives here, in-process: a 2-server striped
+embedding push/pull round at 1% touch density moves <= 5% of the dense
+run's wire bytes and converges to the BIT-identical table (plain SGD,
+dyadic grads — the arithmetic is exact in fp32).  Around it: sparse x
+2-bit compression with PER-ROW error-feedback residuals (keyed by
+global row id) draining exactly; a roster bump dropping exactly the
+moved rows' residuals and no others (membership.moved_row_spans); the
+mesh leader's deduped sparse merge; and the typed-error fixes
+(`@s` user keys refused, pull of an unknown key raising a catchable
+KeyError instead of wedging the window behind elastic retries).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import membership, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.compression import RowSparsePayload
+from mxnet_tpu.kvstore import KVStoreDistAsync, _await
+from mxnet_tpu.kvstore_server import KVStoreServer
+from mxnet_tpu.ndarray import sparse
+
+VOCAB, DIM = 400, 32
+
+
+def _serve(monkeypatch, n=2, **kw):
+    srvs = [KVStoreServer(server_id=i, num_workers=1, **kw)
+            for i in range(n)]
+    for s in srvs:
+        s.start_background()
+    monkeypatch.setenv("MXT_SERVER_URIS",
+                       ",".join(f"127.0.0.1:{s.port}" for s in srvs))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    return srvs
+
+
+def _sgd(lr=0.5):
+    return mx.optimizer.SGD(learning_rate=lr, momentum=0.0, wd=0.0,
+                            rescale_grad=1.0)
+
+
+def _grad_rounds(nrounds=6, touch=4):
+    """1% touch density: `touch` of VOCAB rows per round, dyadic values
+    (n/4) so plain SGD at a power-of-two lr is exact in fp32."""
+    rng = np.random.RandomState(7)
+    rounds = []
+    for _ in range(nrounds):
+        ids = np.sort(rng.choice(VOCAB, size=touch,
+                                 replace=False)).astype(np.int64)
+        vals = (rng.randint(-8, 8, (touch, DIM)) / 4.0).astype(np.float32)
+        rounds.append((ids, vals))
+    return rounds
+
+
+def _run_embedding(monkeypatch, sparse_wire, rounds):
+    """One striped push/pull job; returns (table, push_wire_bytes)."""
+    srvs = _serve(monkeypatch, n=2)
+    try:
+        monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "64")
+        monkeypatch.setenv("MXNET_KVSTORE_SPARSE",
+                           "1" if sparse_wire else "0")
+        kv = mx.kv.create("dist_async")
+        kv.init("emb", mx.nd.zeros((VOCAB, DIM)))
+        kv.set_optimizer(_sgd())
+        kv._flush_all()
+        b0 = profiler.wire_bytes_total()
+        for ids, vals in rounds:
+            kv.push("emb", sparse.row_sparse_array(
+                (vals, ids), shape=(VOCAB, DIM)))
+        kv._flush_all()          # every push acked: bytes are banked
+        push_bytes = profiler.wire_bytes_total() - b0
+        out = mx.nd.zeros((VOCAB, DIM))
+        kv.pull("emb", out=out)
+        table = out.asnumpy().copy()
+        kv.close(stop_servers=True)
+        return table, push_bytes
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_sparse_wire_bytes_tiny_fraction_of_dense_bit_identical_table(
+        monkeypatch):
+    """THE acceptance row: at 1% touch density the sparse wire moves
+    <= 5% of the dense run's push bytes, and the two runs converge to
+    the BIT-identical table (dense applies -lr*0 to untouched rows;
+    sparse never names them — same fp32 arithmetic either way).  The
+    run is striped across 2 servers, so the routing, local-id rebase
+    and per-stripe silence are all load-bearing."""
+    rounds = _grad_rounds()
+    rows0 = profiler.channel_counts().get("kvstore.sparse_rows", 0)
+    sparse_table, sparse_bytes = _run_embedding(monkeypatch, True, rounds)
+    dense_table, dense_bytes = _run_embedding(monkeypatch, False, rounds)
+    assert dense_bytes > 0 and sparse_bytes > 0
+    assert sparse_bytes <= 0.05 * dense_bytes, \
+        (sparse_bytes, dense_bytes)
+    np.testing.assert_array_equal(sparse_table, dense_table)
+    # the analytic golden: exact SGD over the touched rows only
+    golden = np.zeros((VOCAB, DIM), np.float32)
+    for ids, vals in rounds:
+        golden[ids] -= np.float32(0.5) * vals
+    np.testing.assert_array_equal(sparse_table, golden)
+    # bench's banked counter saw exactly the touched rows (sparse run)
+    rows = profiler.channel_counts().get("kvstore.sparse_rows", 0)
+    assert rows - rows0 == sum(ids.size for ids, _ in rounds)
+
+
+def test_sparse_2bit_per_row_residuals_drain_exact(monkeypatch):
+    """Sparse pushes compose with 2-bit compression through PER-ROW
+    error feedback: a 0.25 gradient under a 0.5 threshold quantizes to
+    nothing and parks in the residual bank — keyed by base key +
+    GLOBAL row id even though the wire carries stripe-local ids — and
+    the next push drains it exactly (0.25 + 0.25 -> one 0.5 quantum).
+    After 2k pushes the applied sum equals the true sum bit-for-bit."""
+    srvs = _serve(monkeypatch, n=2)
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESSION", "2bit")
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESSION_THRESHOLD", "0.5")
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("emb", mx.nd.zeros((10, 4)))
+        kv.set_optimizer(_sgd(lr=1.0))
+        assert kv._stripe_plan("emb", (10, 4)) == [0, 5, 10]
+        ids = np.array([1, 7], dtype=np.int64)   # one row per stripe
+        grad = sparse.row_sparse_array(
+            (np.full((2, 4), 0.25, np.float32), ids), shape=(10, 4))
+        for k in range(3):
+            kv.push("emb", grad)                 # sub-threshold: parks
+            # residuals are keyed by GLOBAL row id (7, not stripe-1's
+            # local 2) — the geometry restriping arithmetic needs
+            bank = kv._sparse_residual["emb"]
+            assert set(bank) == {1, 7}
+            np.testing.assert_array_equal(bank[1], 0.25)
+            np.testing.assert_array_equal(bank[7], 0.25)
+            kv.push("emb", grad)                 # drains: one quantum
+            np.testing.assert_array_equal(
+                kv._sparse_residual["emb"][1], 0.0)
+            out = mx.nd.zeros((10, 4))
+            kv.pull("emb", out=out)
+            table = out.asnumpy()
+            golden = np.zeros((10, 4), np.float32)
+            golden[ids] = -0.5 * (k + 1)
+            np.testing.assert_array_equal(table, golden)
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_roster_bump_drops_exactly_the_moved_rows_residuals(monkeypatch):
+    """The PR 7 lesson at row granularity: a restripe must drop ONLY
+    the per-row residuals whose owning server changed
+    (membership.moved_row_spans) — a row that stayed with its server
+    keeps its un-drained error.  Residuals are injected directly so no
+    push-log replay muddies the observable bank."""
+    monkeypatch.setenv("MXNET_KVSTORE_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "50")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+    srv0 = KVStoreServer(server_id=0, num_workers=1, elastic=True)
+    srv1 = KVStoreServer(server_id=1, num_workers=1, elastic=True)
+    uris = [f"127.0.0.1:{srv0.port}", f"127.0.0.1:{srv1.port}"]
+    monkeypatch.setenv("MXT_SERVER_URIS", ",".join(uris))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    srv0._roster_servers = list(uris)
+    srv1._roster_servers = list(uris)
+    srv0.start_background()
+    srv1.start_background()
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("emb", mx.nd.zeros((10, 4)))
+        kv.set_optimizer(_sgd(lr=1.0))
+        out = mx.nd.zeros((10, 4))
+        kv.pull("emb", out=out)        # pull cache learns the geometry
+        # a pending residual on EVERY row, as if many sub-threshold
+        # sparse pushes had parked error here
+        kv._sparse_shapes["emb"] = (10, 4)
+        kv._sparse_residual["emb"] = {
+            r: np.full((4,), 0.25, np.float32) for r in range(10)}
+        spans = membership.moved_row_spans(
+            "emb", (10, 4), uris, uris[:1], 16)
+        moved = {r for r in range(10)
+                 if any(lo <= r < hi for lo, hi in spans)}
+        assert 0 < len(moved) < 10     # a real split: some stay, some move
+        srv1.stop()                    # SIGKILL-equivalent
+        kv.pull("emb", out=out)        # rides the repair path
+        assert kv._roster_servers == uris[:1]
+        bank = kv._sparse_residual["emb"]
+        assert set(bank) == set(range(10)) - moved
+        for r in bank:                 # survivors keep their exact error
+            np.testing.assert_array_equal(bank[r], 0.25)
+        kv.close(stop_servers=True)
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_mesh_merge_sparse_dedups_and_mixed_degrades_dense():
+    """The hierarchy leader merges follower contributions into ONE
+    deduped sparse sum — indices unioned, same-id rows accumulated; a
+    mixed round (one member crossed the density cutover) degrades to
+    the dense sum."""
+    a = RowSparsePayload(np.array([1, 3], np.int64), 6,
+                         np.ones((2, 2), np.float32))
+    b = RowSparsePayload(np.array([3, 5], np.int64), 6,
+                         np.full((2, 2), 2.0, np.float32))
+    m = KVStoreDistAsync._merge_sparse([a, b])
+    assert isinstance(m, RowSparsePayload) and m.nrows == 6
+    np.testing.assert_array_equal(m.indices, [1, 3, 5])
+    np.testing.assert_array_equal(
+        m.data, [[1.0, 1.0], [3.0, 3.0], [2.0, 2.0]])
+    dense = np.ones((6, 2), np.float32)
+    mixed = KVStoreDistAsync._merge_sparse([a, dense])
+    assert isinstance(mixed, np.ndarray)
+    want = dense.copy()
+    want[[1, 3]] += 1.0
+    np.testing.assert_array_equal(mixed, want)
+
+
+def test_density_cutover_falls_back_to_dense(monkeypatch):
+    """Past MXNET_KVSTORE_SPARSE_DENSITY_CUTOVER the dense path's
+    tighter per-element packing wins: a 90%-touched push rides the
+    dense wire (no sparse_rows banked) but lands the same update."""
+    srvs = _serve(monkeypatch, n=1)
+    monkeypatch.setenv("MXNET_KVSTORE_SPARSE_DENSITY_CUTOVER", "0.5")
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("w", mx.nd.zeros((10, 4)))
+        kv.set_optimizer(_sgd(lr=1.0))
+        r0 = profiler.channel_counts().get("kvstore.sparse_rows", 0)
+        ids = np.arange(9, dtype=np.int64)
+        kv.push("w", sparse.row_sparse_array(
+            (np.ones((9, 4), np.float32), ids), shape=(10, 4)))
+        out = mx.nd.zeros((10, 4))
+        kv.pull("w", out=out)
+        golden = np.zeros((10, 4), np.float32)
+        golden[ids] = -1.0
+        np.testing.assert_array_equal(out.asnumpy(), golden)
+        assert profiler.channel_counts().get(
+            "kvstore.sparse_rows", 0) == r0   # went dense
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_server_rejects_mismatched_rowsparse_push_and_keeps_serving(
+        monkeypatch):
+    """A well-formed payload whose declared geometry contradicts the
+    stored table is an op-level error (typed, named), not a poison
+    pill: the reply is an MXNetError and the connection keeps
+    serving."""
+    srvs = _serve(monkeypatch, n=1)
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("w", mx.nd.zeros((10, 4)))
+        conn = kv._conn_of("w")
+        bad = RowSparsePayload(np.array([1], np.int64), 99,
+                               np.ones((1, 4), np.float32))
+        with pytest.raises(MXNetError, match="declares 99 rows"):
+            _await(conn.request(("push", "w", bad)))
+        badrow = RowSparsePayload(np.array([1], np.int64), 10,
+                                  np.ones((1, 3), np.float32))
+        with pytest.raises(MXNetError, match="row-sparse"):
+            _await(conn.request(("push", "w", badrow)))
+        # same connection, next op: unharmed
+        out = mx.nd.zeros((10, 4))
+        kv.pull("w", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), 0.0)
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_pull_rowsparse_unknown_key_raises_typed_keyerror(monkeypatch):
+    """Satellite fix: an unknown key must surface as a catchable
+    KeyError — NOT an MXNetError the elastic retry loop would spin on
+    while the window sits wedged behind a request that can never
+    succeed (ServingReplica's refresh probe depends on this)."""
+    srvs = _serve(monkeypatch, n=1)
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("known", mx.nd.zeros((4, 2)))
+        out = sparse.zeros('row_sparse', (4, 2))
+        with pytest.raises(KeyError, match="uninitialized key 'nope'"):
+            kv.row_sparse_pull("nope", out=out,
+                               row_ids=mx.nd.array([0.0, 1.0]))
+        # the window is NOT wedged: the next pull completes
+        kv.row_sparse_pull("known", out=out,
+                           row_ids=mx.nd.array([1.0, 3.0]))
+        np.testing.assert_array_equal(out.indices.asnumpy(), [1, 3])
+        np.testing.assert_array_equal(out.data.asnumpy(), 0.0)
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_row_sparse_user_keys_reject_stripe_separator(monkeypatch):
+    """Satellite fix: a user key carrying the reserved '@s' separator
+    would collide with striped wire keys — refused up front, local and
+    dist alike."""
+    local = mx.kv.create("local")
+    local.init("ok", mx.nd.zeros((4, 2)))
+    out = sparse.zeros('row_sparse', (4, 2))
+    with pytest.raises(MXNetError, match="reserved stripe separator"):
+        local.row_sparse_pull("bad@s0", out=out,
+                              row_ids=mx.nd.array([0.0]))
+    with pytest.raises(MXNetError, match="uninitialized key"):
+        local.row_sparse_pull("nope", out=out,
+                              row_ids=mx.nd.array([0.0]))
+    srvs = _serve(monkeypatch, n=1)
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("ok", mx.nd.zeros((4, 2)))
+        with pytest.raises(MXNetError, match="reserved stripe separator"):
+            kv.row_sparse_pull("bad@s0", out=out,
+                               row_ids=mx.nd.array([0.0]))
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_sparse_push_composes_with_fp16_wire(monkeypatch):
+    """fp16 wire compression halves the sparse value block; values
+    representable in fp16 round-trip exactly."""
+    srvs = _serve(monkeypatch, n=1)
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESSION", "fp16")
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("w", mx.nd.zeros((10, 4)))
+        kv.set_optimizer(_sgd(lr=1.0))
+        ids = np.array([2, 9], dtype=np.int64)
+        kv.push("w", sparse.row_sparse_array(
+            (np.full((2, 4), 0.5, np.float32), ids), shape=(10, 4)))
+        out = mx.nd.zeros((10, 4))
+        kv.pull("w", out=out)
+        golden = np.zeros((10, 4), np.float32)
+        golden[ids] = -0.5
+        np.testing.assert_array_equal(out.asnumpy(), golden)
+        kv.close(stop_servers=True)
+    finally:
+        for s in srvs:
+            s.stop()

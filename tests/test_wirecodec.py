@@ -23,7 +23,7 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import profiler as prof
 from mxnet_tpu import wirecodec as wc
-from mxnet_tpu.compression import WirePayload
+from mxnet_tpu.compression import RowSparsePayload, WirePayload
 from mxnet_tpu.kvstore_server import (_pack, _recv_msg, _restricted_loads,
                                       _send_msg, _send_vec, _unpack)
 
@@ -169,6 +169,10 @@ def _assert_identical(a, b):
         _assert_identical(a.data, b.data)
         assert a.kind == b.kind and a.threshold == b.threshold
         assert tuple(a.shape or ()) == tuple(b.shape or ())
+    elif isinstance(a, RowSparsePayload):
+        assert a.nrows == b.nrows
+        _assert_identical(a.indices, b.indices)
+        _assert_identical(a.data, b.data)
     else:
         assert a == b
 
@@ -322,6 +326,120 @@ def test_wire_rejects_hostile_binary_frame(monkeypatch):
         total = 4 + len(desc)
         s.sendall(bytes([wc.FRAME_MAGIC])
                   + struct.pack(">QI", total, len(desc)) + desc)
+        with pytest.raises((ConnectionError, OSError)):
+            _recv_msg(s)
+        s.close()
+        # well-formed clients are unaffected
+        kv = mx.kv.create('dist_async')
+        kv.init('ok', mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull('ok', out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+        kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# row-sparse payloads
+# ---------------------------------------------------------------------------
+def _rand_rsp(rng, fp16=False, width=None, empty=False):
+    nrows = int(rng.integers(4, 40))
+    if width is None:
+        width = int(rng.integers(0, 5))
+    if empty:
+        ids = np.zeros(0, dtype=np.int64)
+    else:
+        k = int(rng.integers(1, nrows + 1))
+        ids = np.sort(rng.choice(nrows, size=k,
+                                 replace=False)).astype(np.int64)
+    rows = np.asarray(rng.random((ids.size, width)), dtype=np.float32)
+    if fp16:
+        data = WirePayload("fp16", rows.shape, 0.0, rows.astype(np.float16))
+    else:
+        data = rows
+    return RowSparsePayload(ids, nrows, data)
+
+
+def test_rowsparse_codec_round_trip_fuzz_matches_pickle_path():
+    """Row-sparse payloads — empty index sets, 0-width rows, fp16
+    value blocks, max-length key lists — must round-trip the binary
+    codec BIT-identically to the pickle path."""
+    rng = np.random.default_rng(0x59A125)
+    max_key = "k" * 255
+    for trial in range(40):
+        kind = trial % 4
+        p = _rand_rsp(rng, fp16=(kind == 1),
+                      width=0 if kind == 2 else None,
+                      empty=(kind == 3))
+        if trial % 2:
+            inner = ("push", max_key, p)
+        else:
+            inner = ("push_multi", [(max_key, p),
+                                    ("w", _rand_rsp(rng))])
+        msg = ("req", (int(rng.integers(0, 8)), "n%d" % trial),
+               trial, inner)
+        assert wc.is_hot(msg)
+        _assert_identical(_via_codec(msg), _via_pickle(msg))
+        reply = ("ok", p)
+        _assert_identical(_via_codec(reply), _via_pickle(reply))
+
+
+def test_frame_len_pins_rowsparse_frames():
+    """frame_len must name the exact emitted length for row-sparse
+    frames too — binary v2 and pickle framings alike."""
+    rng = np.random.default_rng(0x59B0B)
+    for trial in range(20):
+        p = _rand_rsp(rng, fp16=bool(trial % 2), empty=(trial % 5 == 0))
+        msg = ("req", (0, "n%d" % trial), trial, ("push", "emb", p))
+        for version in (1, 0):
+            sock = _RecordingVecSock()
+            wc.register(sock, version)
+            _send_msg(sock, msg)
+            frame = b"".join(sock.parts)
+            assert wc.frame_len(frame[:13]) == len(frame), \
+                (version, trial)
+
+
+def _rsp(ids, nrows, rows):
+    return RowSparsePayload(np.asarray(ids), nrows,
+                            np.asarray(rows, dtype=np.float32))
+
+
+@pytest.mark.parametrize("hostile", [
+    _rsp(np.array([-1], np.int64), 8, np.ones((1, 2))),      # negative id
+    _rsp(np.array([3, 3], np.int64), 8, np.ones((2, 2))),    # duplicate ids
+    _rsp(np.array([5, 3], np.int64), 8, np.ones((2, 2))),    # unsorted ids
+    _rsp(np.array([9], np.int64), 8, np.ones((1, 2))),       # id >= nrows
+    _rsp(np.array([1, 2], np.int64), 8, np.ones((3, 2))),    # len mismatch
+    _rsp(np.array([1], np.int64), -1, np.ones((1, 2))),      # negative nrows
+    _rsp(np.array([1.0], np.float32), 8, np.ones((1, 2))),   # float ids
+    _rsp(np.array([[1]], np.int64), 8, np.ones((1, 2))),     # 2-D ids
+])
+def test_decode_rejects_hostile_rowsparse_descriptors(hostile):
+    """Hostile row-sparse descriptors encode fine (the sender is the
+    adversary) but must never DECODE: negative/duplicate/out-of-range
+    row ids, index/value mismatch, overflowed row counts — all refused
+    at the frame layer before any server state is touched."""
+    desc, body = _frame_of(("ok", hostile))
+    with pytest.raises(ValueError):
+        wc.decode_frame(desc, body)
+
+
+def test_wire_rejects_hostile_rowsparse_frame(monkeypatch):
+    """A binary frame carrying duplicate row ids is refused like any
+    hostile frame: connection dropped, no side effect, and the server
+    keeps serving well-formed clients."""
+    import socket as _socket
+    srv = _serve_one(monkeypatch)
+    try:
+        bad = RowSparsePayload(np.array([3, 3], dtype=np.int64), 8,
+                               np.ones((2, 2), dtype=np.float32))
+        head, bufs = wc.encode_frame(
+            ("req", (0, "h0"), 1, ("push", "emb", bad)))
+        body = b"".join(np.ascontiguousarray(a).tobytes() for a in bufs)
+        s = _socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(bytes(head) + body)
         with pytest.raises((ConnectionError, OSError)):
             _recv_msg(s)
         s.close()
