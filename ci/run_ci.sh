@@ -22,6 +22,43 @@ echo "== analysis gate: generated doc tables in sync (--check drift mode)"
 # rotting the docs (regenerate: --knob-table / --protocol-table).
 JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --check
 
+echo "== interleaving explorer gate (PCT schedules + seeded-bug detection)"
+# The systematic-interleaving surface (docs/ANALYSIS.md explorer
+# section): every real distributed-plane scenario must survive a
+# small-N seeded schedule sweep race-, deadlock- and starvation-clean
+# (the full N=20 acceptance sweep runs inside the test suite below,
+# concurrently), and the explorer must PROVE it still finds bugs: the
+# planted ABBA deadlock and check-then-act race must fail the run
+# (nonzero exit) leaving a journal that --replay reproduces.
+# Time-boxed: a scheduler regression presents as a hang.
+rm -rf /tmp/_sched_ci && mkdir -p /tmp/_sched_ci
+for sc in kill_replay handoff failover replan mesh_fanin shm_ring \
+          acceptor_park; do
+  JAX_PLATFORMS=cpu timeout -k 10 240 \
+      python -m mxnet_tpu.analysis --explore "$sc" --schedules 3 \
+      --seed 0 --journal-dir /tmp/_sched_ci/"$sc"
+done
+for bug in bug_deadlock bug_atomicity; do
+  if JAX_PLATFORMS=cpu timeout -k 10 240 \
+      python -m mxnet_tpu.analysis --explore "$bug" --schedules 25 \
+      --seed 0 --journal-dir /tmp/_sched_ci/"$bug"; then
+    echo "EXPLORER GATE VIOLATION: planted $bug was NOT found" >&2
+    exit 1
+  fi
+  journal=$(ls /tmp/_sched_ci/"$bug"/*.jsonl | head -1)
+  if [ -z "$journal" ]; then
+    echo "EXPLORER GATE VIOLATION: $bug left no journal artifact" >&2
+    exit 1
+  fi
+  # the journal must REPLAY to the same failure (nonzero again)
+  if JAX_PLATFORMS=cpu timeout -k 10 240 \
+      python -m mxnet_tpu.analysis --replay "$journal" \
+      --journal-dir /tmp/_sched_ci/replay-"$bug"; then
+    echo "EXPLORER GATE VIOLATION: $bug journal replayed clean" >&2
+    exit 1
+  fi
+done
+
 echo "== unit + integration suite (8-device CPU mesh via tests/conftest.py)"
 # -m "" overrides pytest.ini's default "not slow": CI runs everything.
 # test_run_steps.py is excluded here because the dedicated gate below

@@ -27,6 +27,42 @@ from . import knobs, protocol
 from .lint import lint_paths, package_root
 
 
+def _run_explorer(args) -> int:
+    """--explore / --replay: the interleaving-exploration entrypoint
+    (ISSUE 20).  Exit 1 on any finding — CI runs the seven real
+    scenarios expecting 0 and the seeded bugs expecting 1."""
+    from ..base import env as _env
+    from . import sched
+    if args.replay:
+        r = sched.replay(args.replay, journal_dir=args.journal_dir)
+        print("replay %s: scenario=%s %d decisions, %d finding(s)"
+              % (args.replay, r.scenario, r.ops, len(r.findings)))
+        for kind, detail in r.findings:
+            print("[%s] %s" % (kind, detail))
+        return 1 if r.findings else 0
+    schedules = args.schedules if args.schedules is not None else \
+        int(_env("MXNET_SCHED_SCHEDULES", 20))
+    seed = args.seed if args.seed is not None else \
+        int(_env("MXNET_SCHED_SEED", 0))
+    res = sched.explore(args.explore, schedules=schedules, seed=seed,
+                        depth=args.depth, journal_dir=args.journal_dir)
+    ran = len(res.schedules)
+    ops = sum(r.ops for r in res.schedules)
+    if not res.findings:
+        print("explore %s: %d schedules (seed %d, %d decisions) clean"
+              % (args.explore, ran, seed, ops))
+        return 0
+    bad = res.failing
+    print("explore %s: findings at schedule %d of %d (seed %d); "
+          "journal: %s" % (args.explore, bad.index, ran, seed,
+                           bad.journal_path))
+    for kind, detail in bad.findings:
+        print("[%s] %s" % (kind, detail))
+    print("replay with: python -m mxnet_tpu.analysis --replay %s"
+          % bad.journal_path)
+    return 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.analysis",
@@ -58,7 +94,40 @@ def main(argv=None) -> int:
                          "the CI drift gate")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--explore", metavar="SCENARIO",
+                    help="run SCENARIO under N seeded controlled "
+                         "schedules (PCT) with race/deadlock/"
+                         "starvation detection; exit 1 on any finding")
+    ap.add_argument("--schedules", type=int, default=None,
+                    help="schedules per --explore run (default "
+                         "MXNET_SCHED_SCHEDULES)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="schedule seed (default MXNET_SCHED_SEED); "
+                         "(seed, scenario, index) names a schedule")
+    ap.add_argument("--depth", type=int, default=None,
+                    help="PCT priority-change points + 1 (default "
+                         "MXNET_SCHED_DEPTH)")
+    ap.add_argument("--replay", metavar="JOURNAL",
+                    help="re-execute a recorded schedule journal "
+                         "decision for decision and exit 1 when its "
+                         "findings reproduce")
+    ap.add_argument("--journal-dir", default=None,
+                    help="where schedule journals land (default "
+                         "MXNET_SCHED_JOURNAL_DIR); failing schedules "
+                         "keep theirs, clean ones are deleted")
+    ap.add_argument("--list-scenarios", action="store_true",
+                    help="print the explorer scenario catalog and exit")
     args = ap.parse_args(argv)
+
+    if args.list_scenarios:
+        from . import scenarios as _scen
+        for name in _scen.names():
+            sc = _scen.get(name)
+            first = sc.doc.splitlines()[0] if sc.doc else ""
+            print("%-16s [%s] %s" % (name, sc.kind, first))
+        return 0
+    if args.explore or args.replay:
+        return _run_explorer(args)
 
     if args.knob_table:
         print(knobs.markdown_table())

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 __all__ = [
     "RaceError", "Sanitizer", "HBLock", "shim", "track", "active",
     "TrackedDict", "TrackedOrderedDict", "TrackedList", "TrackedDeque",
+    "set_scheduler", "scheduler", "note_spsc",
 ]
 
 
@@ -61,6 +62,25 @@ class RaceError(RuntimeError):
 
 
 _ACTIVE: Optional["Sanitizer"] = None
+
+# The pluggable yield hook (ISSUE 20): when a controlled scheduler is
+# installed (analysis.sched), every interception point this shim
+# already owns — lock acquire/release, queue put/get (via the patched
+# Condition the queue's mutex rides), thread start/join, tracked
+# container accesses — doubles as a SCHEDULING point.  None in
+# production and under plain hb runs: one global load per op.
+_SCHED = None
+
+
+def set_scheduler(sch) -> None:
+    """Install (or clear, with None) the controlled scheduler that the
+    shim's yield points report to."""
+    global _SCHED
+    _SCHED = sch
+
+
+def scheduler():
+    return _SCHED
 
 
 def active() -> Optional["Sanitizer"]:
@@ -75,6 +95,19 @@ def _stack() -> str:
             if not f.filename.endswith("analysis/hb.py")
             and f.filename != threading.__file__]
     return "".join(traceback.format_list(keep[-8:]))
+
+
+def _lock_site() -> str:
+    """Allocation site of a lock born under the controlled scheduler —
+    schedule journals name resources by where they were created."""
+    import queue as _queue
+    for f in reversed(traceback.extract_stack(limit=12)):
+        fn = f.filename
+        if fn.endswith("analysis/hb.py") or fn == threading.__file__ \
+                or fn == _queue.__file__:
+            continue
+        return "%s:%d" % (fn.rsplit("/", 1)[-1], f.lineno)
+    return "?"
 
 
 class _Access:
@@ -100,6 +133,7 @@ class Sanitizer:
         self._clocks: Dict[int, Dict[int, int]] = {}
         self._sync: Dict[object, Dict[int, int]] = {}   # release clocks
         self._cells: Dict[int, Dict[str, object]] = {}  # cid -> cell
+        self._owners: Dict[object, tuple] = {}  # SPSC key -> writer
         self._violations: List[str] = []
         self._ops = 0
 
@@ -182,6 +216,9 @@ class Sanitizer:
     def access(self, cid: int, name: str, write: bool) -> None:
         if self.closed:
             return
+        sch = _SCHED
+        if sch is not None:
+            sch.yield_point("track", name)
         tid = _thread.get_ident()
         me = _Access(tid, threading.current_thread().name, 0, write,
                      _stack())
@@ -225,6 +262,33 @@ class Sanitizer:
         if new_races and self.strict:
             raise RaceError(messages[-1])
 
+    def single_writer(self, key, name: str) -> None:
+        """Enforce single-WRITER discipline on deliberately lock-free
+        state (the shmlane SPSC ring indices): whole-structure vector
+        clocks would false-positive there — the rings synchronize
+        through the index stores themselves — but the design contract
+        is exactly one writer thread per index, and THAT is checkable."""
+        if self.closed:
+            return
+        tid = _thread.get_ident()
+        msg = None
+        with self._meta:
+            self._ops += 1
+            have = self._owners.get(key)
+            if have is None:
+                self._owners[key] = (
+                    tid, threading.current_thread().name, _stack())
+            elif have[0] != tid:
+                msg = ("SPSC single-writer violation on %s: thread %r "
+                       "writes an index owned by thread %r\n"
+                       "-- owning write stack --\n%s"
+                       "-- violating write stack --\n%s"
+                       % (name, threading.current_thread().name,
+                          have[1], have[2], _stack()))
+                self._violations.append(msg)
+        if msg is not None and self.strict:
+            raise RaceError(msg)
+
 
 class HBLock:
     """Instrumented lock recording release→acquire edges into a
@@ -232,11 +296,26 @@ class HBLock:
     forwards the ``Condition`` protocol so cv parks re-join the
     notifier's clock on wake)."""
 
-    def __init__(self, san: Sanitizer, rlock: bool = False):
+    def __init__(self, san: Sanitizer, rlock: bool = False,
+                 name: Optional[str] = None):
         self._inner = _thread.RLock() if rlock else _thread.allocate_lock()
         self._san = san
+        self._rlock = rlock
+        self.name = name
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        sch = _SCHED
+        if sch is not None:
+            got = sch.lock_acquire(self, blocking, timeout)
+            if got is not None:      # modeled: the scheduler owns blocking
+                if not got:
+                    return False
+                # granted — uncontended among controlled threads, so the
+                # real acquire below is immediate (token serialization
+                # keeps the real lock state mirroring the model)
+                self._inner.acquire()
+                self._san.acquire_edge(id(self))
+                return True
         if timeout == -1:
             ok = self._inner.acquire(blocking)
         else:
@@ -246,6 +325,12 @@ class HBLock:
         return ok
 
     def release(self) -> None:
+        sch = _SCHED
+        if sch is not None and sch.lock_release(self):
+            self._san.release_edge(id(self))
+            self._inner.release()
+            sch.after_release(self)   # the post-release scheduling point
+            return
         self._san.release_edge(id(self))
         self._inner.release()
 
@@ -290,6 +375,8 @@ class HBLock:
         return True
 
     def __repr__(self):
+        if self.name:
+            return "<HBLock %s %#x>" % (self.name, id(self))
         return "<HBLock %#x>" % id(self)
 
 
@@ -394,6 +481,21 @@ def track(obj, name: str):
     return obj
 
 
+def note_spsc(key, name: str, write: bool) -> None:
+    """Probe for the shmlane rings' free-running indices and dead
+    flag: a scheduling point under the controlled scheduler, plus
+    single-writer enforcement for index WRITES (the only invariant a
+    lock-free SPSC ring actually promises).  The dead flag is a sticky
+    monotonic bit both sides may set, so it probes with
+    ``write=False``.  No-ops to two global loads in production."""
+    sch = _SCHED
+    if sch is not None:
+        sch.yield_point("spsc", name)
+    san = _ACTIVE
+    if san is not None and not san.closed and write:
+        san.single_writer(key, name)
+
+
 # -- the shim -----------------------------------------------------------------
 class _Stamped:
     """Queue item carrying its producer's clock (put→get edge)."""
@@ -455,26 +557,52 @@ def shim(strict: bool = False, san: Optional[Sanitizer] = None):
     orig_put = _queue.Queue.put
 
     def make_lock():
-        return HBLock(s)
+        sch = _SCHED
+        return HBLock(s, name=_lock_site() if sch is not None else None)
 
     def make_rlock():
-        return HBLock(s, rlock=True)
+        sch = _SCHED
+        return HBLock(s, rlock=True,
+                      name=_lock_site() if sch is not None else None)
 
     def start(self):
+        sch = _SCHED if not s.closed else None
         if not s.closed:
             snap = s.publish_snapshot()
             orig_run = self.run
+            if sch is not None:
+                sch.thread_spawn(self)   # logical id = creation order
 
             def run():
                 s.adopt(snap)
+                if sch is not None:
+                    sch.thread_begin(self)   # parks until scheduled
                 try:
                     orig_run()
                 finally:
                     self._hb_final = s.publish_snapshot()
+                    if sch is not None:
+                        sch.thread_end(self)
             self.run = run
+        if sch is not None:
+            # deterministic start: the _started handshake runs
+            # passthrough, then a rendezvous + one scheduling point
+            return sch.thread_start(self, orig_start)
         return orig_start(self)
 
     def join(self, timeout=None):
+        sch = _SCHED
+        if sch is not None:
+            r = sch.thread_join(self, timeout)
+            if r == "timeout":
+                # the modeled wait consumed the budget; poke the real
+                # join only to sync an already-exited thread state
+                orig_join(self, 0.001)
+                final = getattr(self, "_hb_final", None)
+                if final is not None and not self.is_alive() \
+                        and not s.closed:
+                    s.adopt(final)
+                return
         orig_join(self, timeout)
         final = getattr(self, "_hb_final", None)
         if final is not None and not self.is_alive() and not s.closed:

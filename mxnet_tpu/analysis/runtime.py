@@ -47,6 +47,14 @@ class LockOrderError(RuntimeError):
     """An acquisition closed a cycle in the global lock-order graph."""
 
 
+def _scheduler():
+    """The pluggable yield hook (ISSUE 20): OrderedLock consults the
+    controlled scheduler — one shared holder with the hb shim — so a
+    lock-order-instrumented lock is also a scheduling point."""
+    from . import hb as _hb
+    return _hb.scheduler()
+
+
 def _alloc_site() -> str:
     """file:line of the frame that constructed the lock (first frame
     outside this module and threading.py)."""
@@ -195,6 +203,15 @@ class OrderedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         self._graph._before_acquire(self._name, blocking)
+        sch = _scheduler()
+        if sch is not None:
+            got = sch.lock_acquire(self, blocking, timeout)
+            if got is not None:   # modeled: the scheduler owned blocking
+                if not got:
+                    return False
+                self._inner.acquire()
+                self._graph._after_acquire(self._name)
+                return True
         if timeout == -1:
             ok = self._inner.acquire(blocking)
         else:
@@ -204,6 +221,12 @@ class OrderedLock:
         return ok
 
     def release(self) -> None:
+        sch = _scheduler()
+        if sch is not None and sch.lock_release(self):
+            self._inner.release()
+            self._graph._on_release(self._name)
+            sch.after_release(self)
+            return
         self._inner.release()
         self._graph._on_release(self._name)
 

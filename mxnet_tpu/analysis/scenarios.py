@@ -1,0 +1,567 @@
+"""Scenario registry for the controlled scheduler (analysis.sched).
+
+A scenario is a plain callable run under one controlled schedule: the
+explorer calls it N times with N different seeded schedules, each
+inside a fresh strict-free hb shim (so FastTrack race detection rides
+every schedule) with the scheduler installed at the shim's yield
+points.  Scenarios must therefore be:
+
+* self-contained — construct every server/store/lane INSIDE the call
+  (the shim only instruments locks born inside the block);
+* re-runnable — tear everything down in ``finally`` even when a
+  schedule aborts (the scheduler unwinds threads with ``SchedAbort``);
+* self-checking — assert their arithmetic: a scenario exception is a
+  FINDING (the check-then-act seeded bug is caught exactly this way).
+
+The seven REAL scenarios are the distributed plane's most
+schedule-sensitive flows (the five test_hb.py acceptance scenarios
+plus the shmlane ring collapse and the acceptor-pool collect parking);
+the two BUG scenarios are deliberately planted concurrency bugs —
+a two-lock ABBA deadlock and a check-then-act atomicity race — that
+survive free-running execution (see tests/test_sched.py) and exist to
+prove the explorer finds what the OS scheduler doesn't.
+
+Add a scenario::
+
+    @register("my_scenario", env={"MXNET_...": "1"})
+    def _sc_my_scenario():
+        ...build, run, assert, tear down...
+
+and it is reachable via ``python -m mxnet_tpu.analysis --explore
+my_scenario`` and picked up by the CI explorer gate.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import hb
+
+__all__ = ["Scenario", "register", "get", "names", "REAL", "BUGS",
+           "deadlock_once", "atomicity_once"]
+
+
+class Scenario:
+    """A registered scenario: the callable plus the env overlay the
+    explorer applies around the shim (static knobs only — dynamic
+    values like ports are set inside the callable)."""
+
+    def __init__(self, name: str, fn: Callable[[], None],
+                 env: Optional[Dict[str, str]], kind: str, doc: str,
+                 lease_s: float = 0.5):
+        self.name = name
+        self.fn = fn
+        self.env = dict(env or {})
+        self.kind = kind          # "real" | "bug"
+        self.doc = doc
+        # How long the scheduler lets the token holder run outside the
+        # model (real socket IO, compute) before leasing the token away.
+        # Socket-heavy scenarios set this low: every blocking recv while
+        # holding the token costs one full lease, so 0.5s leases make a
+        # heartbeat-driven scenario crawl at ~2 decisions/s.
+        self.lease_s = float(lease_s)
+
+
+_REGISTRY: "OrderedDict[str, Scenario]" = OrderedDict()
+
+
+def register(name: str, env: Optional[Dict[str, str]] = None,
+             kind: str = "real", lease_s: float = 0.5):
+    def deco(fn):
+        _REGISTRY[name] = Scenario(name, fn, env, kind,
+                                   (fn.__doc__ or "").strip(),
+                                   lease_s=lease_s)
+        return fn
+    return deco
+
+
+def get(name: str) -> Scenario:
+    sc = _REGISTRY.get(name)
+    if sc is None:
+        raise KeyError("unknown scenario %r (have: %s)"
+                       % (name, ", ".join(_REGISTRY)))
+    return sc
+
+
+def names(kind: Optional[str] = None) -> List[str]:
+    return [n for n, sc in _REGISTRY.items()
+            if kind is None or sc.kind == kind]
+
+
+@contextlib.contextmanager
+def _envctx(**kv):
+    """Scoped os.environ overlay for DYNAMIC values (ports picked at
+    run time); the static per-scenario env rides Scenario.env."""
+    saved = {k: os.environ.get(k) for k in kv}
+    os.environ.update({k: str(v) for k, v in kv.items()})
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# the seeded bugs (exported plain so tests can free-run them WITHOUT
+# the scheduler and show they survive hundreds of iterations)
+# ---------------------------------------------------------------------------
+def deadlock_once(join_timeout: Optional[float] = None) -> bool:
+    """One round of the planted ABBA deadlock: two threads take two
+    locks in opposite orders with a tracked-dict touch between the
+    acquisitions (a few microseconds free-running — the OS essentially
+    never preempts inside it; one PCT priority change always can).
+    Returns True when the round deadlocked (threads still alive after
+    ``join_timeout``); under the controlled scheduler the untimed
+    joins let the deadlock detector fire instead."""
+    la, lb = threading.Lock(), threading.Lock()
+    d = hb.track({}, "bug.deadlock.step")
+
+    def ab():
+        with la:
+            d["ab"] = 1
+            with lb:
+                d["ab"] = 2
+
+    def ba():
+        with lb:
+            d["ba"] = 1
+            with la:
+                d["ba"] = 2
+
+    # analysis: allow(bare-thread): planted-bug threads — their death OR hang is the observed outcome (joined with a timeout; the deadlock detector watches them under the scheduler)
+    ts = [threading.Thread(target=ab, name="ab"),
+          # analysis: allow(bare-thread): planted-bug thread — see 'ab' above
+          threading.Thread(target=ba, name="ba")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_timeout)
+    return any(t.is_alive() for t in ts)
+
+
+def atomicity_once() -> int:
+    """One round of the planted check-then-act race: a balance of 1,
+    two withdrawers, every ACCESS individually locked (so there is no
+    data race for the hb sanitizer to flag) — but the check and the
+    act are separate critical sections, and a preemption in between
+    lets both threads see the 1 and both withdraw.  Returns the final
+    balance; the caller asserts it never went negative."""
+    lock = threading.Lock()
+    bal = hb.track({"v": 1}, "bug.balance")
+
+    def withdraw():
+        with lock:
+            ok = bal["v"] >= 1
+        if ok:
+            with lock:
+                bal["v"] -= 1
+
+    # analysis: allow(bare-thread): planted-bug threads — both are joined untimed and the caller's balance assertion is the failure detector
+    ts = [threading.Thread(target=withdraw, name="w%d" % i)
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return bal["v"]
+
+
+@register("bug_deadlock", kind="bug")
+def _sc_bug_deadlock():
+    """Planted ABBA deadlock (two rounds per schedule — each an
+    independent chance for the priority change to land inside the
+    lock-order window)."""
+    for _ in range(2):
+        deadlock_once(join_timeout=None)
+
+
+@register("bug_atomicity", kind="bug")
+def _sc_bug_atomicity():
+    """Planted check-then-act overdraw; the assertion failure becomes
+    a scenario-error finding."""
+    for _ in range(2):
+        v = atomicity_once()
+        assert v >= 0, "balance overdrawn to %d: check-then-act " \
+                       "withdraw is not atomic" % v
+
+
+# ---------------------------------------------------------------------------
+# the seven real scenarios
+# ---------------------------------------------------------------------------
+@register("kill_replay", lease_s=0.05, env={
+    "MXNET_KVSTORE_RETRY_MAX": "8",
+    "MXNET_KVSTORE_RETRY_INITIAL_MS": "10",
+    "MXNET_KVSTORE_RETRY_MAX_MS": "50",
+    "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0",
+    "MXNET_KVSTORE_WINDOW": "4",
+    "DMLC_NUM_WORKER": "1",
+    "DMLC_WORKER_ID": "0",
+})
+def _sc_kill_replay():
+    """Pipelined pushes, mid-window connection kill, full-window
+    replay against the server dedup — arithmetic must stay exact under
+    every schedule (a double-apply or a lost push moves the sum)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import faultinject
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    faultinject.reset()
+    shape = (2, 3)
+    srv = KVStoreServer(server_id=0, num_workers=1)
+    srv.start_background()
+    try:
+        with _envctx(MXT_SERVER_URIS="127.0.0.1:%d" % srv.port):
+            kv = mx.kv.create("dist_async")
+            kv.init("w", mx.nd.ones(shape))
+            kv.set_optimizer(mx.optimizer.SGD(
+                learning_rate=0.5, momentum=0.0, wd=0.0,
+                rescale_grad=1.0))
+            out = mx.nd.zeros(shape)
+            with faultinject.delay_acks(0.05):
+                with faultinject.kill_when_unacked(2):
+                    for i in range(3):
+                        kv.push("w", mx.nd.ones(shape) * (i + 1))
+                    kv.pull("w", out=out)
+            # 1+2+3 applied exactly once each regardless of the kill
+            np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.5 * 6,
+                                       rtol=1e-6)
+            kv.close(stop_servers=True)
+    finally:
+        srv.stop()
+        faultinject.reset()
+
+
+_ELASTIC_ENV = {
+    "MXNET_KVSTORE_ELASTIC": "1",
+    # a schedule can legally park the client across the whole
+    # stop->promote window, so give reconnects more headroom than the
+    # free-running test_hb variants need
+    "MXNET_KVSTORE_RETRY_MAX": "8",
+    "MXNET_KVSTORE_RETRY_INITIAL_MS": "10",
+    "MXNET_KVSTORE_RETRY_MAX_MS": "50",
+    "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.1",
+    "MXNET_KVSTORE_HEARTBEAT_TIMEOUT": "0.5",
+    "MXNET_KVSTORE_BIGARRAY_BOUND": "16",
+    "MXNET_KVSTORE_SNAPSHOT_S": "0.0",
+    "DMLC_NUM_WORKER": "1",
+    "DMLC_WORKER_ID": "0",
+}
+
+
+@contextlib.contextmanager
+def _elastic_pair():
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    srv0 = KVStoreServer(server_id=0, num_workers=1, elastic=True)
+    srv1 = KVStoreServer(server_id=1, num_workers=1, elastic=True)
+    uris = "127.0.0.1:%d,127.0.0.1:%d" % (srv0.port, srv1.port)
+    srv0._roster_servers = uris.split(",")
+    srv1._roster_servers = uris.split(",")
+    try:
+        with _envctx(MXT_SERVER_URIS=uris):
+            srv0.start_background()
+            srv1.start_background()
+            yield srv0, srv1
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+@register("handoff", env=_ELASTIC_ENV, lease_s=0.05)
+def _sc_handoff():
+    """Kill a striped elastic server mid-training and ride the
+    three-phase handoff (quorum re-push, restripe, orphan re-push)."""
+    import mxnet_tpu as mx
+    with _elastic_pair() as (srv0, srv1):
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.init("small", mx.nd.ones((2, 2)))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((10, 4)))
+        kv.push("small", mx.nd.ones((2, 2)))
+        out_b, out_s = mx.nd.zeros((10, 4)), mx.nd.zeros((2, 2))
+        kv.pull("big", out=out_b)
+        kv.pull("small", out=out_s)
+        srv1.stop()
+        kv.push("big", mx.nd.ones((10, 4)) * 2)
+        kv.push("small", mx.nd.ones((2, 2)) * 2)
+        kv.barrier()
+        kv.pull("big", out=out_b)
+        kv.pull("small", out=out_s)
+        np.testing.assert_array_equal(out_b.asnumpy(), big - 0.125 * 3)
+        np.testing.assert_array_equal(out_s.asnumpy(), 1.0 - 0.125 * 3)
+        kv.close(stop_servers=True)
+
+
+@register("failover", env=_ELASTIC_ENV, lease_s=0.05)
+def _sc_failover():
+    """Kill the COORDINATOR: succession election, ledger rebuild,
+    idempotent barrier retry against the successor."""
+    import mxnet_tpu as mx
+    with _elastic_pair() as (srv0, srv1):
+        kv = mx.kv.create("dist_async")
+        big = np.arange(40, dtype=np.float32).reshape(10, 4)
+        kv.init("big", mx.nd.NDArray(big))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((10, 4)))
+        out_b = mx.nd.zeros((10, 4))
+        kv.pull("big", out=out_b)
+        srv0.stop()
+        kv.push("big", mx.nd.ones((10, 4)) * 2)
+        kv.barrier()
+        kv.pull("big", out=out_b)
+        np.testing.assert_array_equal(out_b.asnumpy(), big - 0.125 * 3)
+        assert srv1._promoted
+        kv.close(stop_servers=True)
+
+
+@register("replan", env=_ELASTIC_ENV, lease_s=0.05)
+def _sc_replan():
+    """A striped pull in flight when its server dies: wait() repairs
+    the roster and re-issues the unserved tail (values exact; whether
+    THIS schedule needed the replan is timing-dependent — the
+    deterministic count assertion lives in test_hb.py)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import faultinject, membership
+    i = 0
+    while True:
+        small = "sm%d" % i
+        if membership.server_index(small, 2) == 0 \
+                and membership.server_index(small, 1) == 0:
+            break
+        i += 1
+    big0 = np.arange(40, dtype=np.float32).reshape(10, 4)
+    with _elastic_pair() as (srv0, srv1):
+        kv = mx.kv.create("dist_async")
+        assert kv._stripe_plan("big", (10, 4)) is not None
+        kv.init("big", mx.nd.NDArray(big0))
+        kv.init(small, mx.nd.ones((2, 2)))
+        kv.set_optimizer(mx.optimizer.SGD(
+            learning_rate=0.125, momentum=0.0, wd=0.0, rescale_grad=1.0))
+        kv.push("big", mx.nd.ones((10, 4)))
+        kv.push(small, mx.nd.ones((2, 2)))
+        out_b, out_s = mx.nd.zeros((10, 4)), mx.nd.zeros((2, 2))
+        kv.pull("big", out=out_b)
+        kv.pull(small, out=out_s)
+        with faultinject.delay_acks(0.3):
+            handle = kv.pull_async(["big", small], [(10, 4), (2, 2)])
+            time.sleep(0.05)
+            srv1.stop()
+            vals = handle.wait()
+        np.testing.assert_array_equal(vals["big"], big0 - 0.125)
+        np.testing.assert_array_equal(vals[small], 1.0 - 0.125)
+        kv.close(stop_servers=True)
+    faultinject.reset()
+
+
+def _mesh_scenario(n_ranks, steps, extra_env):
+    import mxnet_tpu as mx
+    from mxnet_tpu.kvstore import KVStoreDistAsync
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    SHAPE, LR = (4, 4), 0.25
+
+    def grad(rank, step):
+        rs = np.random.RandomState(100 * rank + step)
+        return rs.randint(-2, 3, SHAPE).astype(np.float32)
+
+    w0 = np.arange(np.prod(SHAPE), dtype=np.float32).reshape(SHAPE)
+    results, errors = {}, []
+    env = {"DMLC_NUM_WORKER": str(n_ranks), "DMLC_WORKER_ID": "0",
+           "MXNET_KVSTORE_HIERARCHY": "1",
+           "MXNET_KVSTORE_WORKERS_PER_HOST": str(n_ranks),
+           "MXT_MESH_URIS": "127.0.0.1:%d" % _free_port()}
+    env.update(extra_env)
+    with _envctx(**env):
+        srv = KVStoreServer(server_id=0, num_workers=n_ranks)
+        srv.start_background()
+        try:
+            with _envctx(MXT_SERVER_URIS="127.0.0.1:%d" % srv.port):
+
+                def worker(rank, kv):
+                    try:
+                        kv.init("w", mx.nd.NDArray(w0))
+                        kv.set_optimizer(mx.optimizer.SGD(
+                            learning_rate=LR, momentum=0.0, wd=0.0,
+                            rescale_grad=1.0))
+                        kv.barrier()
+                        out = mx.nd.zeros(SHAPE)
+                        for s in range(steps):
+                            kv.push("w", mx.nd.NDArray(grad(rank, s)))
+                            kv.pull("w", out=out)
+                        kv.barrier()
+                        kv.pull("w", out=out)
+                        results[rank] = out.asnumpy().copy()
+                    except BaseException as exc:  # noqa: BLE001 — to main
+                        errors.append((rank, exc))
+                        raise
+
+                kv0 = KVStoreDistAsync(rank=0)   # leader binds the mesh
+                kvs = [kv0] + [KVStoreDistAsync(rank=r)
+                               for r in range(1, n_ranks)]
+                threads = [threading.Thread(target=worker, args=(r, kv))
+                           for r, kv in enumerate(kvs)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+                assert not errors, errors
+                assert all(not t.is_alive() for t in threads), \
+                    "worker hung"
+                expected = w0.copy()
+                for s in range(steps):
+                    expected = expected - np.float32(LR) * sum(
+                        grad(r, s) for r in range(n_ranks))
+                for r in range(n_ranks):
+                    np.testing.assert_array_equal(results[r], expected)
+                for kv in kvs[1:]:
+                    kv.close()
+                kv0.close(stop_servers=True)
+        finally:
+            srv.stop()
+
+
+@register("mesh_fanin", lease_s=0.05)
+def _sc_mesh_fanin():
+    """Hierarchical mesh fan-in: leader + follower reduce in-mesh and
+    resolve the same wire round through the leader's handle."""
+    _mesh_scenario(n_ranks=2, steps=2, extra_env={})
+
+
+@register("shm_ring")
+def _sc_shm_ring():
+    """Shmlane SPSC ring producer/consumer, then the stall-watchdog
+    collapse: the consumer stops draining, the producer detects the
+    stall and marks the lane dead; a dead lane refuses traffic."""
+    from mxnet_tpu import shmlane
+    lane = shmlane.ShmLane.create(8 * 1024)
+    got: list = []
+    try:
+        def consumer():
+            while len(got) < 6:
+                msg = lane.recv_request()
+                if msg is None:
+                    time.sleep(0.001)
+                    continue
+                got.append(msg["i"])
+
+        # the ring is SPSC and the sanitizer holds it to ONE writer
+        # thread per index for the lane's whole lifetime — so the main
+        # thread is the producer for BOTH phases (a thread-per-phase
+        # producer is itself a single-writer violation, and the
+        # explorer flags it)
+        # analysis: allow(bare-thread): scenario thread — joined untimed right below; a crash leaves got short and fails the FIFO assertion loudly
+        t = threading.Thread(target=consumer, name="ring-cons")
+        t.start()
+        for i in range(6):
+            while not lane.send_request({"i": i}):
+                time.sleep(0.001)
+        t.join()
+        assert got == list(range(6)), got   # SPSC: FIFO, no loss
+        # phase 2: nobody drains — the producer's watchdog collapses
+        # the lane instead of wedging forever
+        assert lane.send_request({"i": 99})
+        deadline = time.monotonic() + 30
+        while not lane.drain_stalled(0.05):
+            assert time.monotonic() < deadline, "stall never detected"
+            time.sleep(0.01)
+        lane.mark_dead()
+        assert lane.dead()
+        assert not lane.send_request({"i": 100}), \
+            "dead lane accepted traffic"
+    finally:
+        lane.destroy()
+
+
+@register("acceptor_park", lease_s=0.05, env={
+    "MXNET_KVSTORE_MESH_ACCEPTORS": "1",
+    "MXNET_KVSTORE_MESH_FANIN_S": "30",
+})
+def _sc_acceptor_park():
+    """Acceptor-pool collect parking: two followers on ONE pool thread
+    send their round-0 mesh_collect BEFORE the leader registered the
+    round — both must park in the worker's pending list (blocking the
+    thread would starve the mesh_push it is also serving) and be
+    served when the leader publishes the handle."""
+    from mxnet_tpu.kvstore import _MeshLeader
+    from mxnet_tpu.kvstore_server import _recv_msg, _send_msg
+    port = _free_port()
+    leader = _MeshLeader("127.0.0.1:%d" % port, n_followers=2)
+    replies: dict = {}
+    errors: list = []
+    try:
+        def follower(rank):
+            try:
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=30)
+                try:
+                    g = np.full((2, 2), float(rank + 1),
+                                dtype=np.float32)
+                    cid = (rank, "park")
+                    # analysis: allow(raw-send): the POINT of this scenario is hand-rolled follower frames hitting the acceptor before the leader registers the round — the envelope client would serialize exactly the ordering under test
+                    _send_msg(s, ("req", cid, 0,
+                                  ("mesh_push", 0, [("w", g)])),
+                              byte_kind="ici_sent")
+                    # analysis: allow(raw-send): see the mesh_push frame above
+                    st, _ = _recv_msg(s, byte_kind="ici_recv")
+                    assert st == "ok"
+                    # analysis: allow(raw-send): see the mesh_push frame above
+                    _send_msg(s, ("req", cid, 1,
+                                  ("mesh_collect", 0, ["w"])),
+                              byte_kind="ici_sent")
+                    # analysis: allow(raw-send): see the mesh_push frame above
+                    st, vals = _recv_msg(s, byte_kind="ici_recv")
+                    assert st == "ok", vals
+                    replies[rank] = np.asarray(vals["w"])
+                finally:
+                    s.close()
+            except BaseException as exc:  # noqa: BLE001 — to main
+                errors.append((rank, exc))
+                raise
+
+        ts = [threading.Thread(target=follower, args=(r,),
+                               name="follower-%d" % r) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        pairs = leader.collect_push(0)    # fan-in: both rounds arrive
+        assert len(pairs) == 2, pairs
+        summed = sum(np.asarray(g) for plist in pairs
+                     for _, g in plist)
+
+        class _Handle:
+            def wait(self):
+                return {"w": summed}
+
+        leader.publish_handle(0, _Handle())
+        for t in ts:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert all(not t.is_alive() for t in ts), "follower hung"
+        for r in (0, 1):
+            np.testing.assert_array_equal(replies[r], summed)
+        np.testing.assert_array_equal(
+            summed, np.full((2, 2), 3.0, dtype=np.float32))
+    finally:
+        leader.close()
+
+
+REAL = names("real")
+BUGS = names("bug")

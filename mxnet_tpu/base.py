@@ -650,6 +650,31 @@ declare_env("MXNET_AUTOTUNE_CANDIDATES", int, 64,
             "autotune: candidate pool size the model searcher scores "
             "per proposal (random samples + neighbors of the measured "
             "best)")
+# -- interleaving explorer (mxnet_tpu.analysis.sched) ------------------------
+declare_env("MXNET_SCHED_SCHEDULES", int, 20,
+            "interleaving explorer: controlled schedules per "
+            "--explore run (each is a fresh seeded PCT priority "
+            "assignment run under the hb sanitizer)")
+declare_env("MXNET_SCHED_SEED", int, 0,
+            "interleaving explorer: schedule seed — (seed, scenario, "
+            "schedule index) names a bit-identical schedule for pure "
+            "thread scenarios, so a finding reported for one seed "
+            "reproduces from the seed alone even without its journal")
+declare_env("MXNET_SCHED_DEPTH", int, 3,
+            "interleaving explorer: PCT bug depth d — each schedule "
+            "plants d-1 seeded priority-change points, enough for "
+            "every ordering bug reachable by d-1 preemptions "
+            "(Burckhardt et al.'s probabilistic guarantee)")
+declare_env("MXNET_SCHED_STARVE_OPS", int, 20000,
+            "interleaving explorer: starvation budget — a thread "
+            "RUNNABLE for this many consecutive scheduling decisions "
+            "without ever being picked is a finding (0 disables; the "
+            "counter resets whenever the thread runs or blocks, so "
+            "PCT's legitimate long demotions don't trip it)")
+declare_env("MXNET_SCHED_JOURNAL_DIR", str, "_sched_journals",
+            "interleaving explorer: where fsync'd JSONL schedule "
+            "journals land — failing schedules keep theirs (the "
+            "--replay input), clean schedules delete theirs")
 
 
 # ---------------------------------------------------------------------------

@@ -1522,7 +1522,10 @@ class _MeshLeader:
         import queue
         import select as _select
         conns: list = []     # _MeshConnState — this thread's alone
-        pending: list = []   # deferred mesh_collects
+        # deferred mesh_collects: appended here, but drained by
+        # _scan_pending against rounds the LEADER thread registers —
+        # the cross-thread handoff the hb shim should see
+        pending: list = _hb.track([], "kvstore._MeshAcceptor.pending")
         poll = 0.0002
         try:
             while not self._stop.is_set():
@@ -2006,7 +2009,8 @@ class KVStoreDistAsync(KVStore):
         # one per WIRE key (stripes quantize independently).  Env
         # activation mirrors the launcher's env-propagation model, so a
         # whole job flips compression on without touching user code.
-        self._gc_residual: Dict[str, np.ndarray] = {}
+        self._gc_residual: Dict[str, np.ndarray] = _hb.track(
+            {}, "kvstore._gc_residual")
         # row-sparse pushes keep their residuals PER GLOBAL ROW ID
         # ({base_key: {row_id: fp32 row}}) so a restripe can drop
         # exactly the rows whose owning server changed
@@ -2014,8 +2018,10 @@ class KVStoreDistAsync(KVStore):
         # the PR 7 lesson applied at row granularity.  _sparse_shapes
         # remembers each sparse key's full table shape for that
         # arithmetic (and for re-routing logged sparse pushes).
-        self._sparse_residual: Dict[str, Dict[int, np.ndarray]] = {}
-        self._sparse_shapes: Dict[str, tuple] = {}
+        self._sparse_residual: Dict[str, Dict[int, np.ndarray]] = \
+            _hb.track({}, "kvstore._sparse_residual")
+        self._sparse_shapes: Dict[str, tuple] = _hb.track(
+            {}, "kvstore._sparse_shapes")
         self._sparse_wire = bool(_env("MXNET_KVSTORE_SPARSE", True))
         self._sparse_cutover = float(_env(
             "MXNET_KVSTORE_SPARSE_DENSITY_CUTOVER", 0.5))
@@ -2802,7 +2808,11 @@ class KVStoreDistAsync(KVStore):
         if gc is None or not gc.active:
             return RowSparsePayload(ids, nrows,
                                     np.ascontiguousarray(rows))
-        bank = self._sparse_residual.setdefault(base_key, {})
+        # the per-key row bank is itself shared across pushes and the
+        # restripe GC — track it at row granularity too
+        bank = self._sparse_residual.setdefault(
+            base_key, _hb.track({}, "kvstore._sparse_residual[%s]"
+                                % base_key))
         return RowSparsePayload(
             ids, nrows, gc.compress_rows(global_ids, rows, bank))
 

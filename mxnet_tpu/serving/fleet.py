@@ -66,6 +66,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..analysis import hb as _hb
 from ..base import MXNetError, env
 from .. import health as _health
 from .. import profiler as _prof
@@ -175,8 +176,12 @@ class FleetClient:
         self._sleep = sleep
         self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
-        self._entries: Dict[str, _Replica] = {
-            str(u): _Replica(str(u)) for u in uris}
+        # the scoreboard is read by every route() and mutated by the
+        # poll loop + roster updates: identity in production, a
+        # race-checked wrapper under the hb shim
+        self._entries: Dict[str, _Replica] = _hb.track(
+            {str(u): _Replica(str(u)) for u in uris},
+            "fleet.HealthRoutedClient._entries")
         self._rr = 0               # round-robin tie-breaker
         self._canary_active = False
         self._cohorts = {c: {"lat": deque(maxlen=512), "n": 0, "err": 0}

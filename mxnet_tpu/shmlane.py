@@ -64,6 +64,7 @@ from __future__ import annotations
 import struct
 import time
 
+from .analysis import hb as _hb
 from .base import MXNetError, env as _env
 
 VERSION = 1
@@ -125,13 +126,14 @@ class _Ring:
     owner — just index arithmetic over the lane's buffer; `desc` is
     the byte offset of its {data_off, cap, widx, ridx} descriptor."""
 
-    __slots__ = ("_buf", "_desc", "_data", "_cap")
+    __slots__ = ("_buf", "_desc", "_data", "_cap", "_tag")
 
-    def __init__(self, buf, desc):
+    def __init__(self, buf, desc, tag=""):
         self._buf = buf
         self._desc = desc
         self._data = struct.unpack_from("<I", buf, desc + _D_DATA)[0]
         self._cap = struct.unpack_from("<I", buf, desc + _D_CAP)[0]
+        self._tag = tag    # "<segment>.req" / "<segment>.rsp"
 
     @staticmethod
     def format(buf, desc, data_off, cap):
@@ -166,6 +168,12 @@ class _Ring:
         if total + 4 > cap:
             return False
         widx, ridx = self._widx(), self._ridx()
+        # the ring is deliberately lock-free: the one invariant is one
+        # writer thread per index, and the probe sits inside the
+        # read-indices -> publish-widx window so the controlled
+        # scheduler can preempt exactly there
+        _hb.note_spsc(("shmring", self._tag, "widx"),
+                      "shmlane.%s.widx" % (self._tag or "ring"), True)
         free = cap - ((widx - ridx) & _M32)
         pos = widx % cap
         room = cap - pos
@@ -202,6 +210,9 @@ class _Ring:
         cap = self._cap
         while True:
             widx, ridx = self._widx(), self._ridx()
+            _hb.note_spsc(("shmring", self._tag, "ridx"),
+                          "shmlane.%s.ridx" % (self._tag or "ring"),
+                          True)
             used = (widx - ridx) & _M32
             if used == 0:
                 return None
@@ -263,8 +274,8 @@ class ShmLane:
                 raise MXNetError(
                     f"shm lane {shm.name}: version {version} != "
                     f"{VERSION} (mixed builds on one host?)")
-        self._req = _Ring(self._buf, _REQ_DESC)
-        self._rsp = _Ring(self._buf, _RSP_DESC)
+        self._req = _Ring(self._buf, _REQ_DESC, "%s.req" % shm.name)
+        self._rsp = _Ring(self._buf, _RSP_DESC, "%s.rsp" % shm.name)
 
     # -- lifecycle --------------------------------------------------------
     @classmethod
@@ -302,6 +313,9 @@ class ShmLane:
         it; the survivor stops serving the rings immediately."""
         if self._closed:
             return
+        # sticky monotonic bit BOTH sides may set — a yield point but
+        # not a single-writer probe
+        _hb.note_spsc(("shmdead", self.name), "shmlane.dead", False)
         try:
             flags = struct.unpack_from("<I", self._buf, 8)[0]
             struct.pack_into("<I", self._buf, 8, flags | _FLAG_DEAD)
@@ -311,6 +325,7 @@ class ShmLane:
     def dead(self) -> bool:
         if self._closed:
             return True
+        _hb.note_spsc(("shmdead", self.name), "shmlane.dead", False)
         try:
             return bool(struct.unpack_from("<I", self._buf, 8)[0]
                         & _FLAG_DEAD)

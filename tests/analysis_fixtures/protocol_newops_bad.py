@@ -1,0 +1,45 @@
+"""protocol-op positive fixture for the NEWER op families (the shm
+handshake, the row-sparse binary pull, the serving canary/refresh
+surface): an undeclared shm handshake handler, a row-sparse branch
+declared pure that mutates, a bad guard word, an undeclared
+register_op extension, a client sending a typo'd shm op, and a
+rowsparse srv.* span naming a non-op."""
+
+
+class BadShmRowServer:
+    def __init__(self):
+        self._store = {}
+        self._lanes = {}
+
+    def _handle(self, msg, rank=None):
+        op = msg[0]
+        if op == "shm_hello":
+            # no replay declaration at all: a reconnect replays the
+            # unacked window straight into the lane attach
+            self._lanes[msg[1]] = object()
+            return ("ok", 1)
+        if op == "pull_rowsparse":  # protocol: replay(pure) reply(rows + full shape)
+            _, key, ids = msg
+            self._store[key] = ids      # mutation behind replay(pure)
+            return self._store.get(key)
+        if op == "shm_detach":  # protocol: replay(maybe) reply(none)
+            return None
+        return None
+
+
+class BadCanaryReplica:
+    def __init__(self):
+        # extension op with no replay declaration anywhere near it
+        self.register_op("predict_canary", self._op_predict)
+
+    def register_op(self, name, fn):
+        pass
+
+    def _op_predict(self, msg):
+        return None
+
+
+def client(conn, _tr):
+    pending = conn.request(("shm_helo", "segment-1"))   # typo'd op
+    _tr.span_begin("srv.rowsparse_decode", cat="server")
+    return pending

@@ -1,0 +1,52 @@
+"""protocol-op negative fixture for the NEWER op families: the shm
+handshake declared idempotent (re-attach replaces the attachment),
+the row-sparse pull declared pure with a read-only branch, the
+canary/refresh serving surface declared at its register_op sites,
+client sites naming real ops, and spans either named after their op
+or declared internal phases."""
+
+
+class OkShmRowServer:
+    def __init__(self):
+        self._store = {}
+        self._lanes = {}
+
+    def _handle(self, msg, rank=None):
+        op = msg[0]
+        if op == "shm_hello":  # protocol: replay(idempotent) reply(lane version | err)
+            # re-attaching the same segment just replaces the
+            # attachment, so a reconnect replay is harmless
+            self._lanes[msg[1]] = object()
+            return ("ok", 1)
+        if op == "pull_rowsparse":  # protocol: replay(pure) reply(rows + full shape)
+            _, key, ids = msg
+            stored = self._store.get(key)
+            return None if stored is None else (stored, ids)
+        return None
+
+
+class OkCanaryReplica:
+    def __init__(self):
+        # protocol: replay(pure) reply(predictions)
+        self.register_op("predict_canary", self._op_predict)
+        # protocol: replay(idempotent) reply(version + refreshed)
+        self.register_op("serving_refresh", self._op_refresh)
+
+    def register_op(self, name, fn):
+        pass
+
+    def _op_predict(self, msg):
+        return None
+
+    def _op_refresh(self, msg):
+        return None
+
+
+def client(conn, _tr):
+    conn.submit(("shm_hello", "segment-1"), wait=False)
+    pending = conn.request(("pull_rowsparse", "w", [1, 7]))
+    conn.request(("predict_canary", [0.0]))
+    _tr.span_begin("srv.pull_rowsparse", cat="server")
+    # protocol: span(phase)
+    _tr.instant("srv.rowsparse_gather_phase")
+    return pending

@@ -47,6 +47,7 @@ def rules_of(findings):
     ("knobs_bad.py", "env-knob", 5),
     ("thread_bad.py", "bare-thread", 2),
     ("protocol_ops_bad.py", "protocol-op", 5),
+    ("protocol_newops_bad.py", "protocol-op", 6),
     ("raw_send_bad.py", "raw-send", 4),
     ("blocking_lock_bad.py", "blocking-under-lock", 3),
     ("codec_bad.py", "codec-coverage", 3),
@@ -67,6 +68,7 @@ def test_positive_fixture_is_flagged(fixture, rule, min_hits):
     "knobs_ok.py",
     "thread_ok.py",
     "protocol_ops_ok.py",
+    "protocol_newops_ok.py",
     "raw_send_ok.py",
     "blocking_lock_ok.py",
     "codec_ok.py",

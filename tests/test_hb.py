@@ -564,3 +564,151 @@ def test_hb_mesh_acceptor_pool_race_clean(monkeypatch):
         finally:
             srv.stop()
     _assert_clean(san)
+
+
+# ---------------------------------------------------------------------------
+# tracked-coverage regressions (ISSUE 20 satellite): the structures
+# the interleaving-explorer PR put under hb — the fleet scoreboard,
+# the shmlane ring indices + dead flag, the acceptor-pool pending
+# lists, and the per-row sparse residual banks — each exercised under
+# the shim, the deliberately lock-free ring with its single-writer
+# probes instead of vector clocks.
+# ---------------------------------------------------------------------------
+def test_hb_fleet_scoreboard_tracked_race_clean():
+    """Scoreboard sweeps from a poll thread concurrent with routed
+    predicts on the main thread: the tracked ``_entries`` map stays
+    race-clean (dict reads on both sides; mutation is lock-held)."""
+    from mxnet_tpu.serving.fleet import FleetClient
+
+    class _C:
+        def predict_async(self, data, name="data", canary=False):
+            class _F:
+                def get(self, timeout=None):
+                    return [np.zeros((1, 3), np.float32)]
+            return _F()
+
+        def stats(self, timeout=None):
+            return {"health": {"status": "OK", "ts": time.time()},
+                    "queue_depth": 0, "queue_limit": 8, "version": 1}
+
+        def is_dead(self):
+            return False
+
+        def close(self):
+            pass
+
+        def abort(self):
+            pass
+
+    with hb.shim(strict=True) as san:
+        fl = FleetClient(["a", "b"], stats_interval=0, retries=0,
+                         jitter=0.0, deadline_s=1000.0, attempt_s=5.0)
+        assert type(fl._entries).__name__ == "TrackedDict"
+        for u in ("a", "b"):
+            fl._entries[u].client = _C()
+
+        def poller():
+            for _ in range(4):
+                fl.poll_once()
+
+        t = threading.Thread(target=poller)
+        t.start()
+        for _ in range(8):
+            outs = fl.predict(np.zeros((1, 4), np.float32))
+            assert outs[0].shape == (1, 3)
+        t.join()
+    _assert_clean(san, min_ops=10)
+
+
+def test_hb_shmlane_spsc_clean_then_cross_writer_caught():
+    """One producer thread + one consumer thread over a lane is the
+    design contract — zero violations.  Then the main thread pushes on
+    the req ring the producer owned: the single-writer probe fires
+    with both stacks, WITHOUT vector-clocking the (deliberately
+    lock-free) index arithmetic itself."""
+    from mxnet_tpu import shmlane
+    with hb.shim() as san:
+        lane = shmlane.ShmLane.create(8 * 1024)
+        try:
+            def produce():
+                for i in range(5):
+                    while not lane.send_request({"i": i}):
+                        time.sleep(0.001)
+
+            t = threading.Thread(target=produce)
+            t.start()
+            got = []
+            deadline = time.monotonic() + 10
+            while len(got) < 5 and time.monotonic() < deadline:
+                m = lane.recv_request()
+                if m is None:
+                    time.sleep(0.001)
+                    continue
+                got.append(m["i"])
+            t.join()
+            assert got == list(range(5))
+            assert not lane.dead()        # dead-flag probe is benign
+            assert san.violations() == [], "\n".join(san.violations())
+            lane.send_request({"i": 99})  # main writes producer's widx
+            assert any("single-writer" in v for v in san.violations())
+        finally:
+            lane.destroy()
+
+
+def test_hb_acceptor_pending_deferred_collect_race_clean():
+    """The acceptor-park explorer scenario straight under the strict
+    shim (no controlled scheduler): a mesh_collect arriving before the
+    leader registers the round parks in the acceptor's TRACKED pending
+    list and is served cross-thread when collect_push lands."""
+    from mxnet_tpu.analysis import scenarios as scen
+    sc = scen.get("acceptor_park")
+    with scen._envctx(**sc.env):
+        with hb.shim(strict=True) as san:
+            sc.fn()
+    _assert_clean(san)
+
+
+def test_hb_sparse_residual_banks_race_clean(monkeypatch):
+    """Row-sparse pushes with 2-bit error feedback under the strict
+    shim: the tracked residual maps (outer key map + per-row banks)
+    stay race-clean and the park/drain arithmetic is unchanged."""
+    from mxnet_tpu.ndarray import sparse
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESSION", "2bit")
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESSION_THRESHOLD", "0.5")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    with hb.shim(strict=True) as san:
+        srvs = [KVStoreServer(server_id=i, num_workers=1)
+                for i in range(2)]
+        for s in srvs:
+            s.start_background()
+        monkeypatch.setenv("MXT_SERVER_URIS", ",".join(
+            "127.0.0.1:%d" % s.port for s in srvs))
+        try:
+            kv = mx.kv.create("dist_async")
+            kv.init("emb", mx.nd.zeros((10, 4)))
+            kv.set_optimizer(mx.optimizer.SGD(
+                learning_rate=1.0, momentum=0.0, wd=0.0,
+                rescale_grad=1.0))
+            ids = np.array([1, 7], dtype=np.int64)
+            grad = sparse.row_sparse_array(
+                (np.full((2, 4), 0.25, np.float32), ids),
+                shape=(10, 4))
+            kv.push("emb", grad)            # sub-threshold: parks
+            kv._flush_all()
+            bank = kv._sparse_residual["emb"]
+            assert type(bank).__name__ == "TrackedDict"
+            assert set(bank) == {1, 7}
+            kv.push("emb", grad)            # drains: one 0.5 quantum
+            out = mx.nd.zeros((10, 4))
+            kv.pull("emb", out=out)
+            golden = np.zeros((10, 4), np.float32)
+            golden[ids] = -0.5
+            np.testing.assert_array_equal(out.asnumpy(), golden)
+            kv.close(stop_servers=True)
+        finally:
+            for s in srvs:
+                s.stop()
+    _assert_clean(san)
